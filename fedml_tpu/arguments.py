@@ -1,0 +1,142 @@
+"""YAML-first configuration, matching the reference schema.
+
+The reference flattens job-YAML sections (``common_args/data_args/model_args/
+train_args/validation_args/device_args/comm_args/tracking_args``, see
+``python/fedml/config/simulation_sp/fedml_config.yaml`` and
+``python/fedml/arguments.py:75-89``) onto a single namespace so algorithm code
+reads ``args.learning_rate`` etc.  We keep that exact surface (users' YAMLs
+port unchanged) and add a ``tpu_args`` section for mesh shape / precision.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any, Dict, Optional
+
+import yaml
+
+from .constants import (
+    FEDML_TRAINING_PLATFORM_SIMULATION,
+    FEDML_SIMULATION_TYPE_SP,
+)
+
+_SECTION_SUFFIX = "_args"
+
+
+class Arguments:
+    """Flat namespace over nested YAML sections (reference:
+    ``python/fedml/arguments.py:75`` ``Arguments.load_yaml_config``)."""
+
+    def __init__(self, cmd_args: Optional[argparse.Namespace] = None,
+                 training_type: Optional[str] = None,
+                 comm_backend: Optional[str] = None):
+        if cmd_args is not None:
+            self.__dict__.update(vars(cmd_args))
+        cf = getattr(self, "yaml_config_file", None) or getattr(self, "cf", None)
+        if cf:
+            self.load_yaml_config(cf)
+        if training_type and not hasattr(self, "training_type"):
+            self.training_type = training_type
+        if comm_backend and not hasattr(self, "backend"):
+            self.backend = comm_backend
+
+    # -- yaml handling -----------------------------------------------------
+    def load_yaml_config(self, yaml_path: str):
+        with open(yaml_path, "r") as f:
+            cfg = yaml.safe_load(f) or {}
+        self.yaml_paths = [yaml_path]
+        self.apply_config(cfg)
+        return cfg
+
+    def apply_config(self, cfg: Dict[str, Any]):
+        """Flatten one level: each ``*_args`` section's keys land directly on
+        the namespace; top-level scalars land as-is."""
+        for key, val in cfg.items():
+            if key.endswith(_SECTION_SUFFIX) and isinstance(val, dict):
+                for k, v in val.items():
+                    setattr(self, k, v)
+            else:
+                setattr(self, key, val)
+
+    def update(self, **kwargs):
+        self.__dict__.update(kwargs)
+        return self
+
+    def get(self, key: str, default=None):
+        return getattr(self, key, default)
+
+    def __contains__(self, key):
+        return hasattr(self, key)
+
+    def __repr__(self):
+        keys = ", ".join(sorted(self.__dict__))
+        return f"Arguments({keys})"
+
+
+def add_args(parser: Optional[argparse.ArgumentParser] = None) -> argparse.Namespace:
+    """CLI surface parity with reference ``python/fedml/arguments.py:36``."""
+    parser = parser or argparse.ArgumentParser(description="fedml_tpu")
+    parser.add_argument("--yaml_config_file", "--cf", dest="yaml_config_file",
+                        type=str, default="", help="config yaml path")
+    parser.add_argument("--run_id", type=str, default="0")
+    parser.add_argument("--rank", type=int, default=0)
+    parser.add_argument("--role", type=str, default="client")
+    parser.add_argument("--local_rank", type=int, default=0)
+    parser.add_argument("--node_rank", type=int, default=0)
+    args, _ = parser.parse_known_args()
+    return args
+
+
+_DEFAULTS: Dict[str, Any] = dict(
+    # common_args
+    training_type=FEDML_TRAINING_PLATFORM_SIMULATION,
+    random_seed=0,
+    scenario="horizontal",
+    # data_args
+    dataset="synthetic_mnist",
+    data_cache_dir=os.path.expanduser("~/.cache/fedml_tpu/data"),
+    partition_method="hetero",
+    partition_alpha=0.5,
+    # model_args
+    model="lr",
+    # train_args
+    federated_optimizer="FedAvg",
+    client_id_list="[]",
+    client_num_in_total=1000,
+    client_num_per_round=10,
+    comm_round=200,
+    epochs=1,
+    batch_size=10,
+    client_optimizer="sgd",
+    learning_rate=0.03,
+    weight_decay=0.001,
+    # validation_args
+    frequency_of_the_test=5,
+    # device_args
+    using_gpu=False,
+    # comm_args
+    backend=FEDML_SIMULATION_TYPE_SP,
+    # tracking_args
+    enable_tracking=False,
+    # tpu_args
+    mesh_client=-1,
+    mesh_data=1,
+    mesh_model=1,
+    mesh_seq=1,
+    compute_dtype="float32",
+    clients_per_device=1,
+)
+
+
+def load_arguments(training_type: Optional[str] = None,
+                   comm_backend: Optional[str] = None,
+                   cmd_args: Optional[argparse.Namespace] = None) -> Arguments:
+    """Entry used by ``fedml_tpu.init()``; fills reference defaults
+    (``python/fedml/arguments.py:100`` get_default_yaml_config) so a bare
+    ``init()`` runs the canonical sp_fedavg_mnist_lr workload."""
+    args = Arguments(cmd_args, training_type, comm_backend)
+    for k, v in _DEFAULTS.items():
+        if not hasattr(args, k):
+            setattr(args, k, v)
+    return args

@@ -1,0 +1,49 @@
+"""Framework constants — parity with ``python/fedml/constants.py`` in the
+reference (training platforms, simulation backends, federated optimizers)."""
+
+FEDML_TRAINING_PLATFORM_SIMULATION = "simulation"
+FEDML_TRAINING_PLATFORM_CROSS_SILO = "cross_silo"
+FEDML_TRAINING_PLATFORM_CROSS_DEVICE = "cross_device"
+FEDML_TRAINING_PLATFORM_CROSS_CLOUD = "cross_cloud"
+FEDML_TRAINING_PLATFORM_SERVING = "model_serving"
+
+# Simulation backends.  The reference has sp / MPI / NCCL
+# (``python/fedml/__init__.py:214-233``); the TPU build keeps "sp" (one
+# process, sequential clients — debugging / tiny runs) and replaces both MPI
+# and NCCL with "mesh" (clients sharded over the jax device mesh).
+FEDML_SIMULATION_TYPE_SP = "sp"
+FEDML_SIMULATION_TYPE_MESH = "mesh"
+# Accepted aliases mapping reference names onto the mesh engine.
+FEDML_SIMULATION_TYPE_MPI = "MPI"
+FEDML_SIMULATION_TYPE_NCCL = "NCCL"
+
+FEDML_CROSS_SILO_SCENARIO_HORIZONTAL = "horizontal"
+FEDML_CROSS_SILO_SCENARIO_HIERARCHICAL = "hierarchical"
+
+# Federated optimizers (reference ``constants.py`` FEDML_FEDERATED_OPTIMIZER_*)
+FED_AVG = "FedAvg"
+FED_AVG_SEQ = "FedAvg_seq"
+FED_OPT = "FedOpt"
+FED_OPT_SEQ = "FedOpt_seq"
+FED_PROX = "FedProx"
+FED_DYN = "FedDyn"
+FED_NOVA = "FedNova"
+SCAFFOLD = "SCAFFOLD"
+MIME = "Mime"
+FED_SGD = "FedSGD"
+ASYNC_FED_AVG = "Async_FedAvg"
+HIERARCHICAL_FED_AVG = "HierarchicalFL"
+DECENTRALIZED_FL = "decentralized_fl"
+TURBO_AGGREGATE = "turboaggregate"
+VERTICAL_FL = "vertical_fl"
+SPLIT_NN = "split_nn"
+FED_GKT = "FedGKT"
+FED_NAS = "FedNAS"
+FED_GAN = "FedGAN"
+FED_SEG = "FedSeg"
+LSA = "LightSecAgg"
+SEC_AGG = "SecAgg"
+
+CLIENT_STATUS_IDLE = "IDLE"
+CLIENT_STATUS_TRAINING = "TRAINING"
+CLIENT_STATUS_FINISHED = "FINISHED"

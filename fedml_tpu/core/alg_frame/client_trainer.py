@@ -1,0 +1,85 @@
+"""ClientTrainer ABC — the client-side half of the user-facing algorithm
+frame (reference: ``python/fedml/core/alg_frame/client_trainer.py:10``).
+
+Surface parity: ``train / get_model_params / set_model_params`` plus the
+``on_before_local_training`` / ``on_after_local_training`` hook pair through
+which the trust plugins (attacks for red-team runs, DP local noise, FHE
+encrypt) are threaded — same wiring as reference ``client_trainer.py:61-87``.
+
+TPU-native difference: ``model`` is a :class:`fedml_tpu.models.FlaxModel` and
+"params" is a JAX pytree, not a ``state_dict``; subclasses implement
+``train_step`` (pure, jittable) instead of an eager epoch loop, and the base
+class provides the scanned local-training driver so every subclass gets a
+compiled hot loop for free.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ..fhe.fhe_agg import FedMLFHE
+from ..security.fedml_attacker import FedMLAttacker
+
+
+class ClientTrainer(abc.ABC):
+    def __init__(self, model, args):
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.local_sample_number = 0
+        self.rid = 0
+        self.template_model_params = None
+        FedMLAttacker.get_instance().init(args)
+        FedMLDifferentialPrivacy.get_instance().init(args)
+        FedMLFHE.get_instance().init(args)
+
+    def set_id(self, trainer_id):
+        self.id = trainer_id
+
+    def is_main_process(self) -> bool:
+        return True
+
+    @abc.abstractmethod
+    def get_model_params(self):
+        ...
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters):
+        ...
+
+    def on_before_local_training(self, train_data, device, args):
+        """Hook order per reference ``client_trainer.py:61-75``:
+        data poisoning (red-team) then FHE decrypt of incoming global model."""
+        atk = FedMLAttacker.get_instance()
+        if atk.is_data_poisoning_attack() and atk.is_to_poison_data():
+            train_data = atk.poison_data(train_data)
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            self.set_model_params(
+                FedMLFHE.get_instance().fhe_dec("local", self.get_model_params())
+            )
+        return train_data
+
+    @abc.abstractmethod
+    def train(self, train_data, device, args):
+        ...
+
+    def on_after_local_training(self, train_data, device, args):
+        """DP local noise, model poisoning, FHE encrypt of the update
+        (reference ``client_trainer.py:80-87``)."""
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_local_dp_enabled():
+            self.set_model_params(dp.add_local_noise(self.get_model_params()))
+        atk = FedMLAttacker.get_instance()
+        if atk.is_model_attack():
+            self.set_model_params(
+                atk.attack_model(self.get_model_params(), self.local_sample_number)
+            )
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            self.set_model_params(
+                FedMLFHE.get_instance().fhe_enc("local", self.get_model_params())
+            )
+
+    def test(self, test_data, device, args) -> Any:
+        return None

@@ -1,0 +1,99 @@
+"""ServerAggregator ABC — server-side half of the algorithm frame
+(reference: ``python/fedml/core/alg_frame/server_aggregator.py:14``).
+
+Hook pipeline parity (reference ``:44-105``): ``on_before_aggregation``
+(FHE path vs. [defense → DP clip] path) → ``aggregate`` → ``on_after_aggregation``
+(defense post-pass, central DP noise, FHE decrypt) → ``assess_contribution``.
+All hooks take/return *lists of (num_samples, params-pytree)* so defenses can
+operate on the stacked client tensor in one fused pass.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Tuple
+
+from ..contribution.contribution_assessor_manager import ContributionAssessorManager
+from ..dp.fedml_differential_privacy import FedMLDifferentialPrivacy
+from ..fhe.fhe_agg import FedMLFHE
+from ..security.fedml_attacker import FedMLAttacker
+from ..security.fedml_defender import FedMLDefender
+
+
+class ServerAggregator(abc.ABC):
+    def __init__(self, model, args):
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.eval_data = None
+        FedMLAttacker.get_instance().init(args)
+        FedMLDefender.get_instance().init(args)
+        FedMLDifferentialPrivacy.get_instance().init(args)
+        FedMLFHE.get_instance().init(args)
+        self.contribution_assessor_mgr = ContributionAssessorManager(args)
+        self.final_contribution_assigned_by_group = {}
+
+    def set_id(self, aggregator_id):
+        self.id = aggregator_id
+
+    @abc.abstractmethod
+    def get_model_params(self):
+        ...
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters):
+        ...
+
+    def on_before_aggregation(
+        self, raw_client_model_or_grad_list: List[Tuple[float, Any]]
+    ):
+        """Reference ``server_aggregator.py:44-73``: model-poison attack
+        injection (red-team), then either FHE passthrough or defense + global
+        DP clipping."""
+        client_idxs = list(range(len(raw_client_model_or_grad_list)))
+        atk = FedMLAttacker.get_instance()
+        if atk.is_model_attack() and atk.is_server_sim_attack():
+            raw_client_model_or_grad_list = atk.attack_model_list(
+                raw_client_model_or_grad_list
+            )
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            return raw_client_model_or_grad_list, client_idxs
+        if FedMLDefender.get_instance().is_defense_enabled():
+            raw_client_model_or_grad_list = FedMLDefender.get_instance().defend_before_aggregation(
+                raw_client_model_or_grad_list, self.get_model_params()
+            )
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_global_dp_enabled() and dp.is_clipping():
+            raw_client_model_or_grad_list = dp.global_clip(raw_client_model_or_grad_list)
+        return raw_client_model_or_grad_list, client_idxs
+
+    @abc.abstractmethod
+    def aggregate(self, raw_client_model_or_grad_list: List[Tuple[float, Any]]):
+        ...
+
+    def on_after_aggregation(self, aggregated_model_or_grad: Any) -> Any:
+        """Reference ``server_aggregator.py:90-103``."""
+        if FedMLFHE.get_instance().is_fhe_enabled():
+            return FedMLFHE.get_instance().fhe_dec("global", aggregated_model_or_grad)
+        if FedMLDefender.get_instance().is_defense_enabled():
+            aggregated_model_or_grad = FedMLDefender.get_instance().defend_after_aggregation(
+                aggregated_model_or_grad
+            )
+        dp = FedMLDifferentialPrivacy.get_instance()
+        if dp.is_global_dp_enabled():
+            aggregated_model_or_grad = dp.add_global_noise(aggregated_model_or_grad)
+        return aggregated_model_or_grad
+
+    def assess_contribution(self, client_idxs, model_list, aggregated_model, val_fn):
+        """Reference ``server_aggregator.py:105``; delegated to the Shapley
+        assessors in ``core/contribution``."""
+        if self.contribution_assessor_mgr is None:
+            return
+        self.contribution_assessor_mgr.run(
+            client_idxs, model_list, aggregated_model, val_fn,
+            self.final_contribution_assigned_by_group,
+        )
+
+    @abc.abstractmethod
+    def test(self, test_data, device, args):
+        ...

@@ -1,0 +1,75 @@
+"""Differential-privacy orchestrator singleton (reference:
+``python/fedml/core/dp/fedml_differential_privacy.py:13``).
+
+``enable_dp: true`` + ``dp_mechanism_type`` (gaussian|laplace) +
+``dp_solution_type`` (local|global, i.e. LDP vs CDP — reference frames in
+``core/dp/frames/``).  Noise addition is a pure pytree transform built on
+jax.random, so local DP composes into the jitted client step and global DP is
+one fused pass over the aggregated model.
+"""
+
+from __future__ import annotations
+
+import jax
+
+DP_SOLUTION_LOCAL = "local_dp"
+DP_SOLUTION_GLOBAL = "global_dp"
+DP_SOLUTION_NBAFL = "nbafl"
+
+
+class FedMLDifferentialPrivacy:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLDifferentialPrivacy":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.is_enabled = False
+        self.solution = None
+        self.frame = None
+        self._key = None
+
+    def init(self, args):
+        if args is None or not getattr(args, "enable_dp", False):
+            return
+        self.is_enabled = True
+        sol = str(getattr(args, "dp_solution_type", DP_SOLUTION_LOCAL)).strip().lower()
+        self.solution = sol
+        self._key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)) + 0xD9)
+        from .frames import create_dp_frame
+
+        self.frame = create_dp_frame(sol, args)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def is_dp_enabled(self) -> bool:
+        return self.is_enabled
+
+    def is_local_dp_enabled(self) -> bool:
+        return self.is_enabled and self.solution in (DP_SOLUTION_LOCAL, DP_SOLUTION_NBAFL)
+
+    def is_global_dp_enabled(self) -> bool:
+        return self.is_enabled and self.solution in (DP_SOLUTION_GLOBAL, DP_SOLUTION_NBAFL)
+
+    def is_clipping(self) -> bool:
+        return self.is_enabled and self.frame is not None and self.frame.is_clipping()
+
+    def add_local_noise(self, local_grad):
+        """Reference ``fedml_differential_privacy.py:88``."""
+        return self.frame.add_local_noise(local_grad, self._next_key())
+
+    def add_global_noise(self, global_model):
+        """Reference ``fedml_differential_privacy.py:93``."""
+        return self.frame.add_global_noise(global_model, self._next_key())
+
+    def global_clip(self, raw_client_list):
+        return self.frame.global_clip(raw_client_list)
+
+    def set_params_for_dp(self, raw_client_list):
+        if self.frame is not None and hasattr(self.frame, "set_params_for_dp"):
+            self.frame.set_params_for_dp(raw_client_list)

@@ -1,0 +1,19 @@
+"""DP deployment frames (reference ``python/fedml/core/dp/frames/``):
+local DP (noise on each client update), global/central DP (clip + noise on
+the aggregate), NbAFL (both sides)."""
+
+from __future__ import annotations
+
+
+def create_dp_frame(solution_type: str, args):
+    t = solution_type.strip().lower()
+    if t == "local_dp":
+        from .local_dp import LocalDP
+        return LocalDP(args)
+    if t == "global_dp":
+        from .global_dp import GlobalDP
+        return GlobalDP(args)
+    if t == "nbafl":
+        from .nbafl import NbAFL
+        return NbAFL(args)
+    raise ValueError(f"unknown dp_solution_type {solution_type!r}")

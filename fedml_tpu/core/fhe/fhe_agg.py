@@ -1,0 +1,101 @@
+"""Homomorphic-encryption aggregation hook (reference:
+``python/fedml/core/fhe/fhe_agg.py:10`` — TenSEAL CKKS).
+
+TenSEAL is not available in this environment (and FHE math cannot run on the
+TPU anyway), so the rebuild keeps the exact hook surface — encrypt client
+updates before upload, aggregate ciphertexts server-side, decrypt the merged
+model — implemented as a host-side callback at the round boundary, exactly
+where the reference places it.  The default backend is an additive-masking
+"mock CKKS" that preserves the protocol shape (server only ever sees
+ciphertext objects, addition happens in ciphertext space); a real CKKS backend
+can be slotted in by registering another codec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+from ..tree import tree_flatten_1d, tree_unflatten_1d
+
+
+@dataclasses.dataclass
+class _Ciphertext:
+    """Opaque ciphertext envelope: flat masked vector + bookkeeping."""
+    payload: np.ndarray
+    n_addends: int = 1
+
+
+class _AdditiveMaskCodec:
+    """Mock-CKKS codec: enc(x) = x + m (mask derived from a key held only by
+    clients); ciphertexts add homomorphically; dec subtracts n*m."""
+
+    def __init__(self, seed: int):
+        self._seed = seed
+
+    def _mask(self, size: int) -> np.ndarray:
+        rng = np.random.Generator(np.random.Philox(key=[self._seed, size, 0, 0]))
+        return rng.standard_normal(size).astype(np.float64) * 1e3
+
+    def encrypt(self, vec: np.ndarray) -> _Ciphertext:
+        return _Ciphertext(vec.astype(np.float64) + self._mask(vec.size))
+
+    def add(self, a: _Ciphertext, b: _Ciphertext) -> _Ciphertext:
+        return _Ciphertext(a.payload + b.payload, a.n_addends + b.n_addends)
+
+    def scale(self, a: _Ciphertext, s: float) -> _Ciphertext:
+        # CKKS supports plaintext-scalar multiply; mask scales too, tracked
+        # via fractional n_addends.
+        return _Ciphertext(a.payload * s, a.n_addends * s)
+
+    def decrypt(self, ct: _Ciphertext) -> np.ndarray:
+        return ct.payload - ct.n_addends * self._mask(ct.payload.size)
+
+
+class FedMLFHE:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLFHE":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.is_enabled = False
+        self.codec = None
+        self._template = None
+
+    def init(self, args):
+        if args is None or not getattr(args, "enable_fhe", False):
+            return
+        self.is_enabled = True
+        self.codec = _AdditiveMaskCodec(int(getattr(args, "random_seed", 0)) ^ 0xF4E)
+
+    def is_fhe_enabled(self) -> bool:
+        return self.is_enabled
+
+    # -- hook surface (reference fhe_agg.py:47-120) ------------------------
+    def fhe_enc(self, enc_type: str, model_params: Any) -> _Ciphertext:
+        self._template = jax.tree_util.tree_map(lambda x: x, model_params)
+        flat = np.asarray(tree_flatten_1d(model_params))
+        return self.codec.encrypt(flat)
+
+    def fhe_dec(self, dec_type: str, enc_model_params: Any) -> Any:
+        if not isinstance(enc_model_params, _Ciphertext):
+            return enc_model_params  # first round: plaintext global model
+        flat = self.codec.decrypt(enc_model_params)
+        return tree_unflatten_1d(np.asarray(flat, dtype=np.float32), self._template)
+
+    def fhe_fedavg(self, raw_client_list: List[Tuple[float, _Ciphertext]]) -> _Ciphertext:
+        """Weighted FedAvg entirely in ciphertext space (reference
+        ``fhe_agg.py:95``)."""
+        total = float(sum(n for n, _ in raw_client_list))
+        acc = None
+        for n, ct in raw_client_list:
+            scaled = self.codec.scale(ct, n / total)
+            acc = scaled if acc is None else self.codec.add(acc, scaled)
+        return acc

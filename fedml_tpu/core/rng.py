@@ -1,0 +1,52 @@
+"""Deterministic randomness for federated simulation.
+
+The reference seeds global RNGs once (``python/fedml/__init__.py:103-109``:
+random / np / torch manual_seed) and re-seeds numpy per round for client
+sampling (``simulation/sp/fedavg/fedavg_api.py:133``).  JAX's splittable
+threefry keys let us do strictly better: every (round, client, purpose) gets
+its own key derived by folding, so runs are bitwise reproducible regardless of
+execution order, device count, or sharding layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+from . import hostrng
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def round_key(key: jax.Array, round_idx: int) -> jax.Array:
+    return jax.random.fold_in(key, round_idx)
+
+
+def client_key(key: jax.Array, round_idx: int, client_idx: int) -> jax.Array:
+    return jax.random.fold_in(jax.random.fold_in(key, round_idx), client_idx)
+
+
+def purpose_key(key: jax.Array, purpose: str) -> jax.Array:
+    """Fold a string purpose tag ("sample", "init", "dropout", "dp") into a key."""
+    tag = int.from_bytes(hashlib.sha256(purpose.encode()).digest()[:4], "little")
+    return jax.random.fold_in(key, tag)
+
+
+def sample_clients(seed: int, round_idx: int, num_clients: int,
+                   clients_per_round: int) -> np.ndarray:
+    """Per-round client sampling, host-side (drives the Python round loop).
+
+    Mirrors the semantics of ``FedAvgAPI._client_sampling``
+    (``simulation/sp/fedavg/fedavg_api.py:127-137``): if every client fits, take
+    all; otherwise sample without replacement, deterministically per round.
+    Uses numpy's Philox generator keyed on (seed, round) so the schedule is
+    stable without mutating global RNG state.
+    """
+    if num_clients <= clients_per_round:
+        return np.arange(num_clients)
+    rng = hostrng.gen(seed, round_idx, 0xC11E)
+    return np.sort(rng.choice(num_clients, clients_per_round, replace=False))

@@ -1,0 +1,27 @@
+"""Defense zoo factory (reference: ``python/fedml/core/security/defense/`` —
+23 defense modules orchestrated by ``FedMLDefender``)."""
+
+from __future__ import annotations
+
+_REGISTRY = {}
+
+
+def register(name):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def create_defender(defense_type: str, args):
+    t = defense_type.strip().lower()
+    # Import defense modules on demand; each registers itself.
+    from . import robust_aggregation  # krum / multikrum / bulyan / median / trimmed_mean / rfa
+    from . import clipping            # norm_diff_clipping / cclip / weak_dp / crfl
+    from . import reweighting         # foolsgold / residual_based / robust_lr / slsgd / wbc
+    from . import outlier             # three_sigma variants / outlier_detection / cross_round
+    from . import soteria_defense     # soteria
+
+    if t not in _REGISTRY:
+        raise ValueError(f"unknown defense_type {defense_type!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[t](args)

@@ -1,0 +1,74 @@
+"""Functional training state.
+
+The reference's mutable ``nn.Module`` + optimizer pairs (e.g.
+``ml/trainer/my_model_trainer_classification.py``) become an immutable
+``TrainState`` pytree: params + optimizer state + rng key.  Because the whole
+state is a pytree, a cohort of clients is just a *stacked* TrainState (leading
+client axis) that vmaps/shard_maps cleanly — this one design choice is what
+lets FedML's "many clients per device" sequential scheduler
+(``core/schedule/seq_train_scheduler.py``) collapse into ``vmap``/``scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    # Extra per-client slots used by stateful FL algorithms:
+    #   SCAFFOLD control variates, FedDyn lagrangian residuals, Mime momentum.
+    # None for stateless algorithms (FedAvg/FedProx/FedOpt client side).
+    extra: Any = None
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation, rng, extra=None):
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng,
+            extra=extra,
+        )
+
+    def apply_gradients(self, tx: optax.GradientTransformation, grads):
+        updates, new_opt = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params, opt_state=new_opt)
+
+
+def make_sgd(lr: float, momentum: float = 0.0, weight_decay: float = 0.0,
+             clip_grad: Optional[float] = None) -> optax.GradientTransformation:
+    """The reference's default client optimizer (torch SGD, see
+    ``ml/trainer/my_model_trainer_classification.py`` optimizer setup)."""
+    chain = []
+    if clip_grad:
+        chain.append(optax.clip_by_global_norm(clip_grad))
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.sgd(lr, momentum=momentum if momentum else None))
+    return optax.chain(*chain)
+
+
+def make_client_optimizer(args) -> optax.GradientTransformation:
+    """Build the client optimizer from flat YAML args (``train_args`` section,
+    reference schema ``config/simulation_sp/fedml_config.yaml:20-28``)."""
+    opt = str(getattr(args, "client_optimizer", "sgd")).lower()
+    lr = float(getattr(args, "learning_rate", 0.03))
+    wd = float(getattr(args, "weight_decay", 0.0))
+    if opt == "adam":
+        tx = optax.adamw(lr, weight_decay=wd) if wd else optax.adam(lr)
+    else:
+        tx = make_sgd(lr, momentum=float(getattr(args, "momentum", 0.0)),
+                      weight_decay=wd)
+    return tx

@@ -1,0 +1,4 @@
+from .data_loader import load
+from .federated_dataset import FederatedDataset, build_federated
+
+__all__ = ["load", "FederatedDataset", "build_federated"]

@@ -1,0 +1,133 @@
+"""``fedml_tpu.data.load(args)`` — dataset dispatcher, parity with
+``fedml.data.load`` (reference ``python/fedml/data/data_loader.py:234``).
+
+Dispatches on ``args.dataset`` over the reference's dataset names (mnist,
+femnist, cifar10/100, cinic10, fed_cifar100, shakespeare, fed_shakespeare,
+stackoverflow_lr/nwp, synthetic_*).  Real data is used when found under
+``args.data_cache_dir`` (``.npz`` with train_x/train_y/test_x/test_y, or the
+classic MNIST idx-ubyte files); otherwise a deterministic synthetic dataset of
+identical shape/cardinality is generated (no-egress environment — see
+:mod:`fedml_tpu.data.synthetic`).
+
+Returns ``(dataset, class_num)`` where dataset is a
+:class:`FederatedDataset`; call ``.as_reference_tuple(batch_size)`` for the
+legacy 8-tuple surface.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .federated_dataset import FederatedDataset, build_federated
+from .synthetic import synthetic_image_classification, synthetic_lm_tokens
+
+# (classes, img shape, train_n, test_n) per image dataset, matching reference
+# dataset cardinalities (python/fedml/data/<name>/data_loader.py)
+_IMAGE_SPECS = {
+    "mnist": (10, (28, 28, 1), 60000, 10000),
+    "synthetic_mnist": (10, (28, 28, 1), 60000, 10000),
+    "femnist": (62, (28, 28, 1), 60000, 10000),
+    "fashionmnist": (10, (28, 28, 1), 60000, 10000),
+    "emnist": (62, (28, 28, 1), 60000, 10000),
+    "cifar10": (10, (32, 32, 3), 50000, 10000),
+    "cifar100": (100, (32, 32, 3), 50000, 10000),
+    "fed_cifar100": (100, (32, 32, 3), 50000, 10000),
+    "cinic10": (10, (32, 32, 3), 90000, 90000),
+}
+
+_LM_SPECS = {
+    # vocab, seq_len, train_n, test_n
+    "shakespeare": (90, 80, 16000, 2000),
+    "fed_shakespeare": (90, 80, 16000, 2000),
+    "stackoverflow_nwp": (10004, 20, 50000, 5000),
+    "stackoverflow_lr": (10004, 20, 50000, 5000),
+    "reddit": (10004, 20, 50000, 5000),
+}
+
+
+def _try_load_npz(cache_dir: str, name: str):
+    path = os.path.join(cache_dir, f"{name}.npz")
+    if os.path.exists(path):
+        d = np.load(path)
+        return d["train_x"], d["train_y"], d["test_x"], d["test_y"]
+    return None
+
+
+def _try_load_mnist_idx(cache_dir: str):
+    """Classic yann-lecun idx-ubyte files, optionally gzipped."""
+    def read_idx(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, = struct.unpack(">H", f.read(4)[2:])
+            ndim = magic & 0xFF
+            dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+            return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+    base = os.path.join(cache_dir, "MNIST", "raw")
+    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    found = []
+    for n in names:
+        for cand in (os.path.join(base, n), os.path.join(base, n + ".gz"),
+                     os.path.join(cache_dir, n), os.path.join(cache_dir, n + ".gz")):
+            if os.path.exists(cand):
+                found.append(cand)
+                break
+    if len(found) != 4:
+        return None
+    tx, ty, vx, vy = (read_idx(p) for p in found)
+    tx = (tx.astype(np.float32) / 255.0)[..., None]
+    vx = (vx.astype(np.float32) / 255.0)[..., None]
+    return tx, ty.astype(np.int64), vx, vy.astype(np.int64)
+
+
+def load(args) -> Tuple[FederatedDataset, int]:
+    name = str(getattr(args, "dataset", "synthetic_mnist")).lower()
+    cache = str(getattr(args, "data_cache_dir", "") or "")
+    seed = int(getattr(args, "random_seed", 0))
+    client_num = int(getattr(args, "client_num_in_total", 10))
+    method = str(getattr(args, "partition_method", "hetero"))
+    alpha = float(getattr(args, "partition_alpha", 0.5))
+
+    if name in _IMAGE_SPECS:
+        classes, shape, train_n, test_n = _IMAGE_SPECS[name]
+        real = _try_load_npz(cache, name) if cache else None
+        if real is None and name in ("mnist", "synthetic_mnist") and cache:
+            real = _try_load_mnist_idx(cache)
+        if real is not None:
+            tx, ty, vx, vy = real
+        else:
+            noise = float(getattr(args, "synthetic_noise", 0.35))
+            tx, ty, vx, vy = synthetic_image_classification(
+                train_n, test_n, classes, shape, seed, noise)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method, alpha, seed)
+        return ds, classes
+
+    if name in _LM_SPECS:
+        vocab, seq_len, train_n, test_n = _LM_SPECS[name]
+        seq_len = int(getattr(args, "seq_len", seq_len))
+        real = _try_load_npz(cache, name) if cache else None
+        if real is not None:
+            tx, ty, vx, vy = real
+        else:
+            tx, ty, vx, vy = synthetic_lm_tokens(train_n, test_n, vocab, seq_len, seed)
+        ds = build_federated(tx, ty, vx, vy, vocab, client_num, method="homo",
+                             alpha=alpha, seed=seed)
+        return ds, vocab
+
+    if name.startswith("synthetic"):
+        # synthetic_<classes>_<dim...> generic fallback
+        classes = int(getattr(args, "num_classes", 10))
+        shape = tuple(getattr(args, "input_shape", (28, 28, 1)))
+        tx, ty, vx, vy = synthetic_image_classification(
+            int(getattr(args, "train_size", 10000)),
+            int(getattr(args, "test_size", 2000)), classes, shape, seed)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method, alpha, seed)
+        return ds, classes
+
+    raise ValueError(f"unknown dataset {name!r}")

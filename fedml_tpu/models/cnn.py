@@ -1,0 +1,66 @@
+"""CNNs from the reference model zoo.
+
+- ``CNNDropOut`` — the FedAvg-paper FEMNIST CNN (reference
+  ``python/fedml/model/cv/cnn.py`` ``CNN_DropOut``: 2×conv5x5 + maxpool +
+  dense 128, dropout).
+- ``CNNWeb`` — the lighter web variant (reference ``cnn_web``).
+- ``CNNCifar`` — the CIFAR CNN used in simulation examples.
+All use NHWC (TPU-native layout; conv lowers onto the MXU without transposes).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNDropOut(nn.Module):
+    output_dim: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else self.output_dim)(x)
+
+
+class CNNWeb(nn.Module):
+    """Small single-conv model (reference ``model/cv/cnn.py`` cnn_web path)."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(16, (3, 3), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.output_dim)(x)
+
+
+class CNNCifar(nn.Module):
+    """LeNet-style CIFAR CNN (reference ``model/cv/cnn_cifar.py``-alike)."""
+
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        return nn.Dense(self.output_dim)(x)

@@ -1,0 +1,32 @@
+"""Linear models (reference: ``python/fedml/model/linear/lr.py`` —
+LogisticRegression used by the canonical sp_fedavg_mnist_lr workload)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """y = sigmoid-free logits over flattened input; reference
+    ``model/linear/lr.py`` (torch ``nn.Linear(28*28, out)``)."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(x)
+
+
+class MLP(nn.Module):
+    """Two-layer perceptron (reference ``model/shallow_nn/``)."""
+
+    hidden: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.output_dim)(x)

@@ -1,0 +1,78 @@
+"""MobileNetV3-style model (reference ``python/fedml/model/cv/mobilenet_v3.py``)
+with GroupNorm for FL-safety (same rationale as resnet_gn).  Depthwise convs
+map to the VPU; pointwise 1x1 convs are MXU matmuls."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _hswish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.relu(nn.Conv(max(c // self.reduce, 8), (1, 1))(s))
+        s = nn.hard_sigmoid(nn.Conv(c, (1, 1))(s))
+        return x * s
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    expand: int
+    kernel: int = 3
+    strides: int = 1
+    use_se: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        y = nn.Conv(self.expand, (1, 1), use_bias=False)(x)
+        y = _hswish(nn.GroupNorm(num_groups=8)(y))
+        y = nn.Conv(self.expand, (self.kernel, self.kernel),
+                    strides=(self.strides, self.strides), padding="SAME",
+                    feature_group_count=self.expand, use_bias=False)(y)
+        y = _hswish(nn.GroupNorm(num_groups=8)(y))
+        if self.use_se:
+            y = SqueezeExcite()(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.filters))(y)
+        if self.strides == 1 and inp == self.filters:
+            y = y + x
+        return y
+
+
+class MobileNetV3Small(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), strides=(1, 1), padding="SAME", use_bias=False)(x)
+        x = _hswish(nn.GroupNorm(num_groups=8)(x))
+        cfg = [  # (filters, expand, kernel, strides, se)
+            (16, 16, 3, 2, True),
+            (24, 72, 3, 2, False),
+            (24, 88, 3, 1, False),
+            (40, 96, 5, 2, True),
+            (40, 240, 5, 1, True),
+            (48, 120, 5, 1, True),
+            (96, 288, 5, 2, True),
+        ]
+        for f, e, k, s, se in cfg:
+            x = InvertedResidual(f, e, k, s, se)(x)
+        x = nn.Conv(576, (1, 1), use_bias=False)(x)
+        x = _hswish(nn.GroupNorm(num_groups=8)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = _hswish(nn.Dense(1024)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def mobilenet_v3_small(num_classes: int) -> MobileNetV3Small:
+    return MobileNetV3Small(num_classes)

@@ -1,0 +1,72 @@
+"""Model factory — parity with ``fedml.model.create``
+(reference ``python/fedml/model/model_hub.py:19``).
+
+Dispatches on ``args.model`` names used across the reference configs/examples
+(lr, cnn, cnn_web, resnet18_gn, resnet56, resnet20, mobilenet, rnn,
+rnn_stackoverflow, mlp, transformer/llm names) and returns a
+:class:`FlaxModel` wrapper.  ``output_dim`` mirrors the reference's second
+positional arg.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from .base import FlaxModel
+from .cnn import CNNCifar, CNNDropOut, CNNWeb
+from .linear import MLP, LogisticRegression
+from .resnet import resnet18_gn, resnet20, resnet56
+from .rnn import RNNOriginalFedAvg, RNNStackOverflow
+
+_IMG28 = (28, 28, 1)
+_IMG32 = (32, 32, 3)
+
+
+def _img_shape(args) -> Tuple[int, ...]:
+    ds = str(getattr(args, "dataset", "")).lower()
+    if "cifar" in ds or "cinic" in ds:
+        return _IMG32
+    return _IMG28
+
+
+def create(args, output_dim: int = 10) -> FlaxModel:
+    name = str(getattr(args, "model", "lr")).lower()
+    ds = str(getattr(args, "dataset", "")).lower()
+
+    if name in ("lr", "logistic_regression"):
+        return FlaxModel(LogisticRegression(output_dim), _img_shape(args))
+    if name == "mlp":
+        return FlaxModel(MLP(hidden=128, output_dim=output_dim), _img_shape(args))
+    if name == "cnn":
+        # reference: CNN_DropOut for femnist/mnist (model_hub.py:30-40)
+        only_digits = "femnist" not in ds and "emnist" not in ds
+        out = output_dim if output_dim else (10 if only_digits else 62)
+        return FlaxModel(CNNDropOut(out, only_digits=only_digits), _IMG28,
+                         has_dropout=True)
+    if name == "cnn_web":
+        return FlaxModel(CNNWeb(output_dim), _img_shape(args))
+    if name == "cnn_cifar":
+        return FlaxModel(CNNCifar(output_dim), _IMG32)
+    if name in ("resnet18", "resnet18_gn"):
+        return FlaxModel(resnet18_gn(output_dim), _IMG32)
+    if name == "resnet56":
+        return FlaxModel(resnet56(output_dim), _IMG32)
+    if name in ("resnet20", "resnet20_mnn"):
+        return FlaxModel(resnet20(output_dim), _IMG32)
+    if name in ("rnn", "rnn_fedavg", "rnn_shakespeare"):
+        seq = int(getattr(args, "seq_len", 80))
+        return FlaxModel(RNNOriginalFedAvg(vocab_size=output_dim or 90),
+                         (seq,), input_dtype=jnp.int32, task="lm")
+    if name in ("rnn_stackoverflow", "rnn_nwp"):
+        seq = int(getattr(args, "seq_len", 20))
+        return FlaxModel(RNNStackOverflow(vocab_size=output_dim or 10004),
+                         (seq,), input_dtype=jnp.int32, task="lm")
+    if name in ("mobilenet", "mobilenet_v3", "efficientnet"):
+        from .mobilenet import mobilenet_v3_small
+        return FlaxModel(mobilenet_v3_small(output_dim), _IMG32)
+    if name in ("transformer", "gpt", "llama", "tiny_llama"):
+        from ..llm.model import build_causal_lm
+        return build_causal_lm(args, output_dim)
+    raise ValueError(f"unknown model {name!r}")

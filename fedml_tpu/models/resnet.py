@@ -1,0 +1,79 @@
+"""ResNets with GroupNorm — the FL-correct normalization.
+
+The reference ships ``resnet18_gn`` / ``resnet56`` with GroupNorm instead of
+BatchNorm (``python/fedml/model/cv/resnet_gn.py``, ``resnet56`` in
+``model/model_hub.py``) because BatchNorm statistics break under federated
+averaging of non-IID clients.  GroupNorm is also jit-friendlier: no mutable
+batch_stats collection, so the whole model stays a pure function of params.
+NHWC layout throughout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    groups: int = 8
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.filters))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(self.groups, self.filters))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False)(residual)
+            residual = nn.GroupNorm(num_groups=min(self.groups, self.filters))(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int
+    width: int = 64
+    cifar_stem: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.cifar_stem:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
+                        use_bias=False)(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2 ** i)
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(filters, strides)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet18_gn(num_classes: int) -> ResNet:
+    """Reference ``resnet18_gn`` (cross_silo CIFAR workloads)."""
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes)
+
+
+def resnet56(num_classes: int) -> ResNet:
+    """Reference ``resnet56`` (simulation CIFAR workloads): 3 stages × 9
+    blocks, width 16."""
+    return ResNet(stage_sizes=(9, 9, 9), num_classes=num_classes, width=16)
+
+
+def resnet20(num_classes: int) -> ResNet:
+    """Mobile-grade resnet20 (reference MNN export ``model/mobile/``)."""
+    return ResNet(stage_sizes=(3, 3, 3), num_classes=num_classes, width=16)

@@ -1,0 +1,57 @@
+"""RNN language models from the reference zoo.
+
+- ``RNNOriginalFedAvg`` — the FedAvg-paper Shakespeare char-LM (reference
+  ``python/fedml/model/nlp/rnn.py``: embed(8) → 2×LSTM(256) → dense(vocab)).
+- ``RNNStackOverflow`` — next-word-prediction model (embed 96 → LSTM 670 →
+  dense 96 → dense vocab; reference same file).
+
+Implemented with ``nn.scan``-wrapped ``OptimizedLSTMCell`` so the sequence
+loop is an XLA ``while``/``scan``, not Python — one compiled kernel per layer
+regardless of sequence length.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _LSTMStack(nn.Module):
+    features: int
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (batch, seq, emb)
+        for i in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.features, name=f"lstm_{i}")
+            scan = nn.RNN(cell)
+            x = scan(x)
+        return x
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (batch, seq) int tokens → logits (batch, seq, vocab)
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = _LSTMStack(self.hidden_size, 2)(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = _LSTMStack(self.hidden_size, 1)(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

@@ -1,0 +1,47 @@
+"""FedMLRunner facade — parity with reference ``python/fedml/runner.py:19``:
+instantiates the right simulator / cross-silo client-server / cross-device
+server from ``args.training_type`` + ``args.backend``."""
+
+from __future__ import annotations
+
+from .constants import (
+    FEDML_TRAINING_PLATFORM_CROSS_DEVICE,
+    FEDML_TRAINING_PLATFORM_CROSS_SILO,
+    FEDML_TRAINING_PLATFORM_SIMULATION,
+)
+
+
+class FedMLRunner:
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        self.args = args
+        t = str(getattr(args, "training_type", FEDML_TRAINING_PLATFORM_SIMULATION))
+        if t == FEDML_TRAINING_PLATFORM_SIMULATION:
+            from .simulation.simulator import create_simulator
+            self.runner = create_simulator(args, device, dataset, model,
+                                           client_trainer, server_aggregator)
+        elif t == FEDML_TRAINING_PLATFORM_CROSS_SILO:
+            self.runner = self._init_cross_silo_runner(
+                args, device, dataset, model, client_trainer, server_aggregator)
+        elif t == FEDML_TRAINING_PLATFORM_CROSS_DEVICE:
+            self.runner = self._init_cross_device_runner(
+                args, device, dataset, model, server_aggregator)
+        else:
+            raise ValueError(f"unknown training_type {t!r}")
+
+    def _init_cross_silo_runner(self, args, device, dataset, model,
+                                client_trainer, server_aggregator):
+        role = str(getattr(args, "role", "client"))
+        if role == "server":
+            from .cross_silo.server import Server
+            return Server(args, device, dataset, model, server_aggregator)
+        from .cross_silo.client import Client
+        return Client(args, device, dataset, model, client_trainer)
+
+    def _init_cross_device_runner(self, args, device, dataset, model,
+                                  server_aggregator):
+        from .cross_device.server import ServerMNN
+        return ServerMNN(args, device, dataset, model, server_aggregator)
+
+    def run(self):
+        return self.runner.run()

@@ -1,0 +1,101 @@
+"""The federated round as ONE compiled program.
+
+The reference runs a round as Python orchestration: sample → per-client eager
+train loop → pickle/ship → per-key weighted sum (call stack in SURVEY §3.1).
+Here the whole round — every sampled client's full local-SGD pass plus the
+server merge — is a single jitted function over a *cohort tensor*:
+
+    x:(C, S, B, ...)  y:(C, S, ...)  mask:(C, S)  weights:(C,)
+
+- ``scan`` mode: clients run sequentially via ``lax.scan`` (constant memory —
+  the single-process "sp" backend, reference ``simulation/sp``).
+- ``vmap`` mode: clients run batched via ``jax.vmap`` (max MXU utilization on
+  one chip for small models; the moral successor of the reference's
+  ``SeqTrainScheduler`` many-clients-per-GPU packing, ``core/schedule/
+  seq_train_scheduler.py:9`` — the schedule disappears into vectorization).
+- the mesh engine (``simulation/mesh``) shard_maps this same per-client body
+  over the ``client`` axis and merges with ``psum`` — the TPU-native form of
+  the NCCL simulation's pre-scaled ``dist.reduce(SUM)``
+  (``simulation/nccl/base_framework/common.py:196-228``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tree as tree_util
+from ..ml.aggregator.agg_operator import ServerOptimizer, ServerState
+from ..ml.trainer.local_trainer import ClientOut, LocalTrainer, ServerCtx
+
+
+def _client_body(local_train, server_opt: ServerOptimizer):
+    """Per-client closure: returns stacked-friendly outputs."""
+
+    def body(global_params, ctx, xb, yb, mask, rng, c_client):
+        out: ClientOut = local_train(global_params, xb, yb, mask, rng, ctx,
+                                     c_client)
+        return out
+
+    return body
+
+
+def make_server_ctx(trainer: LocalTrainer, state: ServerState) -> ServerCtx:
+    return ServerCtx(
+        global_params=state.global_params,
+        c_server=state.c_server,
+        server_momentum=state.momentum,
+    )
+
+
+def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                  mode: str = "scan") -> Callable:
+    """Build round_fn(state, x, y, mask, weights, rngs, c_clients) ->
+    (new_state, metrics).  All client-axis inputs are stacked; ``c_clients``
+    is None unless the algorithm keeps per-client state (SCAFFOLD)."""
+    local_train = trainer.make_local_train()
+    body = _client_body(local_train, server_opt)
+    alg = server_opt.algorithm
+
+    def run_clients(state, x, y, mask, rngs, c_clients):
+        ctx = make_server_ctx(trainer, state)
+        fn = lambda xb, yb, mb, rng, cc: body(state.global_params, ctx, xb, yb,
+                                              mb, rng, cc)
+        if mode == "vmap":
+            return jax.vmap(fn)(x, y, mask, rngs, c_clients)
+        # scan mode: sequential over the client axis
+        def scan_body(carry, inp):
+            xb, yb, mb, rng, cc = inp
+            return carry, fn(xb, yb, mb, rng, cc)
+        _, outs = jax.lax.scan(scan_body, 0, (x, y, mask, rngs, c_clients))
+        return outs  # ClientOut with leading client axis
+
+    def round_fn(state: ServerState, x, y, mask, weights, rngs,
+                 c_clients=None):
+        outs: ClientOut = run_clients(state, x, y, mask, rngs, c_clients)
+        aux = {}
+        if alg == "scaffold":
+            aux["delta_c"] = outs.delta_c
+        if alg == "fednova":
+            aux["tau"] = outs.tau
+            aux["grad_sum"] = outs.grad_sum
+        if alg in ("mime", "fedsgd"):
+            aux["grad_sum"] = outs.grad_sum
+        new_state = server_opt.update(state, outs.params, weights, aux)
+        metrics = {
+            "train_loss": jnp.sum(outs.loss * weights) / jnp.sum(weights),
+            "total_steps": jnp.sum(outs.num_steps),
+        }
+        return new_state, metrics, outs
+
+    return round_fn
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

@@ -1,0 +1,51 @@
+"""Simulator facades (reference ``python/fedml/simulation/simulator.py``:
+``SimulatorSingleProcess`` / ``SimulatorMPI`` / ``SimulatorNCCL``).
+
+The TPU build keeps ``SimulatorSingleProcess`` (scan/vmap on one device) and
+maps both distributed simulators onto ``SimulatorMesh``; reference backend
+names "MPI"/"NCCL" are accepted as aliases so old configs run unchanged.
+"""
+
+from __future__ import annotations
+
+from ..constants import (
+    FEDML_SIMULATION_TYPE_MESH,
+    FEDML_SIMULATION_TYPE_MPI,
+    FEDML_SIMULATION_TYPE_NCCL,
+    FEDML_SIMULATION_TYPE_SP,
+)
+from .sp.fedavg_api import FedAvgAPI
+from .mesh.mesh_simulator import MeshFedAvgAPI
+
+
+class SimulatorSingleProcess:
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        mode = str(getattr(args, "sp_client_mode", "vmap"))
+        self.fl_trainer = FedAvgAPI(args, device, dataset, model,
+                                    client_mode=mode)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+class SimulatorMesh:
+    def __init__(self, args, device, dataset, model, client_trainer=None,
+                 server_aggregator=None):
+        self.fl_trainer = MeshFedAvgAPI(args, device, dataset, model)
+
+    def run(self):
+        return self.fl_trainer.train()
+
+
+def create_simulator(args, device, dataset, model, client_trainer=None,
+                     server_aggregator=None):
+    backend = str(getattr(args, "backend", FEDML_SIMULATION_TYPE_SP))
+    if backend == FEDML_SIMULATION_TYPE_SP:
+        return SimulatorSingleProcess(args, device, dataset, model,
+                                      client_trainer, server_aggregator)
+    if backend in (FEDML_SIMULATION_TYPE_MESH, FEDML_SIMULATION_TYPE_MPI,
+                   FEDML_SIMULATION_TYPE_NCCL, "mesh"):
+        return SimulatorMesh(args, device, dataset, model, client_trainer,
+                             server_aggregator)
+    raise ValueError(f"unknown simulation backend {backend!r}")

@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised hermetically (SURVEY §4 implication: deterministic
+in-memory federation as unit tests).
+
+Note: this environment auto-registers a TPU PJRT plugin that overrides
+``JAX_PLATFORMS`` at jax import time, so the env-var route doesn't stick; we
+update jax.config after import instead (wins as long as no backend has been
+initialized yet).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

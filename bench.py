@@ -1,0 +1,128 @@
+"""Benchmark driver: FedAvg wall-clock/round + samples/sec @ 256 simulated
+clients (the BASELINE.json primary metric).
+
+Runs the canonical workload shape (MNIST-LR, the reference's
+``config/simulation_sp/fedml_config.yaml`` scaled to 256 clients/round) on
+whatever accelerator jax exposes, then prints ONE json line.
+
+``vs_baseline``: the reference has no published numbers (BASELINE.md), so the
+ratio is measured against an in-process torch-CPU eager reimplementation of
+the reference's client loop (``my_model_trainer_classification.py``
+semantics: per-batch zero_grad/forward/backward/step + state_dict FedAvg) on
+a subsample, linearly extrapolated.  >1 means fedml_tpu is faster.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+CLIENTS_PER_ROUND = 256
+TOTAL_CLIENTS = 1000
+BATCH = 10
+STEPS_PER_CLIENT = 6  # 60 samples/client at batch 10, matching MNIST-LR scale
+ROUNDS_TIMED = 10
+IMG = (28, 28, 1)
+NUM_CLASSES = 10
+
+
+def bench_fedml_tpu():
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, device as device_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    args = load_arguments()
+    args.update(
+        dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+        train_size=TOTAL_CLIENTS * BATCH * STEPS_PER_CLIENT, test_size=1000,
+        model="lr", client_num_in_total=TOTAL_CLIENTS,
+        client_num_per_round=CLIENTS_PER_ROUND, comm_round=ROUNDS_TIMED,
+        epochs=1, batch_size=BATCH, learning_rate=0.03,
+        partition_method="homo", frequency_of_the_test=10 ** 9,
+        random_seed=0,
+    )
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dev = device_mod.get_device(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api = FedAvgAPI(args, dev, dataset, model, client_mode="vmap")
+
+    # warmup (compile)
+    api.train_one_round(0)
+    api.train_one_round(1)
+    import jax
+    jax.block_until_ready(api.state.global_params)
+
+    t0 = time.perf_counter()
+    for r in range(2, 2 + ROUNDS_TIMED):
+        api.train_one_round(r)
+    jax.block_until_ready(api.state.global_params)
+    dt = (time.perf_counter() - t0) / ROUNDS_TIMED
+    return dt
+
+
+def bench_torch_reference_style(n_clients: int = 8) -> float:
+    """Reference-style eager loop (torch CPU), per-round time extrapolated to
+    CLIENTS_PER_ROUND.  Mirrors the hot path of
+    ``ml/trainer/my_model_trainer_classification.py`` + per-key FedAvg
+    (``ml/aggregator/agg_operator.py:33``)."""
+    import torch
+    import torch.nn as nn
+
+    torch.set_num_threads(max(1, (torch.get_num_threads() or 4)))
+    dim = int(np.prod(IMG))
+    xs = torch.randn(n_clients, STEPS_PER_CLIENT, BATCH, dim)
+    ys = torch.randint(0, NUM_CLASSES, (n_clients, STEPS_PER_CLIENT, BATCH))
+
+    def one_round():
+        global_sd = nn.Linear(dim, NUM_CLASSES).state_dict()
+        locals_ = []
+        for c in range(n_clients):
+            m = nn.Linear(dim, NUM_CLASSES)
+            m.load_state_dict(global_sd)
+            opt = torch.optim.SGD(m.parameters(), lr=0.03, weight_decay=1e-3)
+            crit = nn.CrossEntropyLoss()
+            for s in range(STEPS_PER_CLIENT):
+                opt.zero_grad()
+                loss = crit(m(xs[c, s]), ys[c, s])
+                loss.backward()
+                opt.step()
+            locals_.append((BATCH * STEPS_PER_CLIENT, m.state_dict()))
+        # per-key weighted average (reference agg loop)
+        total = sum(n for n, _ in locals_)
+        avg = {k: sum(sd[k] * (n / total) for n, sd in locals_)
+               for k in locals_[0][1]}
+        return avg
+
+    one_round()  # warmup
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        one_round()
+    per_round = (time.perf_counter() - t0) / reps
+    return per_round * (CLIENTS_PER_ROUND / n_clients)
+
+
+def main():
+    tpu_dt = bench_fedml_tpu()
+    try:
+        ref_dt = bench_torch_reference_style()
+    except Exception:
+        ref_dt = None
+    samples_per_round = CLIENTS_PER_ROUND * BATCH * STEPS_PER_CLIENT
+    result = {
+        "metric": "fedavg_wall_clock_per_round_256clients_mnist_lr",
+        "value": round(tpu_dt, 5),
+        "unit": "s/round",
+        "vs_baseline": round(ref_dt / tpu_dt, 2) if ref_dt else None,
+        "samples_per_sec": round(samples_per_round / tpu_dt, 1),
+        "ref_torch_cpu_s_per_round": round(ref_dt, 4) if ref_dt else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,9 @@ _IMG32 = (32, 32, 3)
 
 
 def _img_shape(args) -> Tuple[int, ...]:
+    explicit = getattr(args, "input_shape", None)
+    if explicit:
+        return tuple(explicit)
     ds = str(getattr(args, "dataset", "")).lower()
     if "cifar" in ds or "cinic" in ds:
         return _IMG32

@@ -1,0 +1,84 @@
+"""Filesystem-backed control+data plane — the broker-less stand-in for the
+reference's MQTT+S3 split (``mqtt_s3_multi_clients_comm_manager.py:203-238``:
+MQTT topic carries the control message, S3 carries the model blob).
+
+Here a shared directory plays both roles: each message is written as a
+payload blob plus an atomically-renamed control file
+(``{seq}_{sender}_{receiver}.msg``); receivers poll their own suffix.  Works
+across processes/hosts on any shared filesystem (NFS/GCS-fuse), which is the
+cross-silo story for pods that share storage but no broker.  The MQTT backend
+(``../mqtt``) keeps the exact reference topology when a broker exists.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import threading
+from typing import List
+
+from ..base_com_manager import BaseCommunicationManager, Observer
+from ..message import Message, encode_tree, decode_tree
+
+
+class FileStoreCommManager(BaseCommunicationManager):
+    def __init__(self, root_dir: str, run_id: str, rank: int,
+                 poll_interval: float = 0.05):
+        self.dir = os.path.join(root_dir, f"fedml_run_{run_id}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.rank = int(rank)
+        self.poll = poll_interval
+        self._observers: List[Observer] = []
+        self._running = False
+        self._seq = 0
+        self._seen = set()
+
+    def send_message(self, msg: Message):
+        self._seq += 1
+        name = f"{time.time_ns()}_{self._seq:06d}_{msg.get_sender_id()}_to_{msg.get_receiver_id()}"
+        blob = encode_tree(msg.get_params())
+        tmp = os.path.join(self.dir, name + ".tmp")
+        final = os.path.join(self.dir, name + ".msg")
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.rename(tmp, final)  # atomic publish (the "MQTT notify" moment)
+
+    def add_observer(self, observer: Observer):
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer):
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def _poll_once(self):
+        suffix = f"_to_{self.rank}.msg"
+        try:
+            names = sorted(n for n in os.listdir(self.dir) if n.endswith(suffix))
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name in self._seen:
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path, "rb") as f:
+                    params = decode_tree(f.read())
+            except (OSError, ValueError):
+                continue  # partially-visible write; retry next poll
+            self._seen.add(name)
+            msg = Message()
+            msg.init(params)
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def handle_receive_message(self):
+        self._running = True
+        ready = Message(Message.MSG_TYPE_CONNECTION_IS_READY, self.rank, self.rank)
+        for obs in list(self._observers):
+            obs.receive_message(ready.get_type(), ready)
+        while self._running:
+            self._poll_once()
+            time.sleep(self.poll)
+
+    def stop_receive_message(self):
+        self._running = False

@@ -1,0 +1,131 @@
+"""gRPC communication backend (reference
+``core/distributed/communication/grpc/grpc_comm_manager.py:30``).
+
+Differences from the reference: no generated proto stubs — a generic
+bytes-in/bytes-out unary method carries the whole Message as one msgpack
+blob (control scalars + numpy tensor payloads in a single buffer), so there
+is no pickle on the wire and no codegen step.  An ip-table dict (rank →
+"host:port") replaces the reference's CSV (``ip_config_utils.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from ..base_com_manager import BaseCommunicationManager, Observer
+from ..message import Message, encode_tree, decode_tree
+
+log = logging.getLogger(__name__)
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "Send"
+_FULL_METHOD = f"/{_SERVICE}/{_METHOD}"
+
+_MAX_MSG = 1 << 30  # 1 GiB — model payloads ride inline
+
+
+def _serialize_message(msg: Message) -> bytes:
+    return encode_tree(msg.get_params())
+
+
+def _deserialize_message(data: bytes) -> Message:
+    msg = Message()
+    msg.init(decode_tree(data))
+    return msg
+
+
+class GRPCCommManager(BaseCommunicationManager):
+    def __init__(self, host: str, port: int, ip_config: Dict[int, str],
+                 client_id: int = 0, client_num: int = 0):
+        self.host = host
+        self.port = int(port)
+        self.client_id = int(client_id)
+        self.ip_config = {int(k): v for k, v in ip_config.items()}
+        self._observers: List[Observer] = []
+        self._running = False
+        self._inbox: "list[Message]" = []
+        self._cv = threading.Condition()
+        self._channels: Dict[int, grpc.Channel] = {}
+        self._server: Optional[grpc.Server] = None
+        self._start_server()
+
+    # -- server side -------------------------------------------------------
+    def _start_server(self):
+        def handle_send(request: bytes, context) -> bytes:
+            msg = _deserialize_message(request)
+            with self._cv:
+                self._inbox.append(msg)
+                self._cv.notify_all()
+            return b"ok"
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {_METHOD: grpc.unary_unary_rpc_method_handler(
+                handle_send,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)},
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.max_send_message_length", _MAX_MSG),
+                     ("grpc.max_receive_message_length", _MAX_MSG)])
+        self._server.add_generic_rpc_handlers((handler,))
+        bound = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if self.port == 0:
+            self.port = bound
+        self._server.start()
+
+    # -- client side -------------------------------------------------------
+    def _stub(self, receiver: int):
+        if receiver not in self._channels:
+            target = self.ip_config[receiver]
+            self._channels[receiver] = grpc.insecure_channel(
+                target,
+                options=[("grpc.max_send_message_length", _MAX_MSG),
+                         ("grpc.max_receive_message_length", _MAX_MSG)])
+        ch = self._channels[receiver]
+        return ch.unary_unary(_FULL_METHOD,
+                              request_serializer=lambda b: b,
+                              response_deserializer=lambda b: b)
+
+    def send_message(self, msg: Message):
+        data = _serialize_message(msg)
+        self._stub(msg.get_receiver_id())(data, wait_for_ready=True, timeout=300)
+
+    # -- loop --------------------------------------------------------------
+    def add_observer(self, observer: Observer):
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer):
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self):
+        self._running = True
+        ready = Message(Message.MSG_TYPE_CONNECTION_IS_READY,
+                        self.client_id, self.client_id)
+        for obs in list(self._observers):
+            obs.receive_message(ready.get_type(), ready)
+        while self._running:
+            with self._cv:
+                while not self._inbox and self._running:
+                    self._cv.wait(timeout=0.1)
+                if not self._running:
+                    break
+                msg = self._inbox.pop(0)
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self):
+        self._running = False
+        with self._cv:
+            self._cv.notify_all()
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+        for ch in self._channels.values():
+            ch.close()

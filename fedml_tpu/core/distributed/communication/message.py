@@ -1,0 +1,91 @@
+"""Message — the WAN-path unit of exchange (reference
+``python/fedml/core/distributed/communication/message.py:5``).
+
+Control plane: a small dict (msg_type / sender / receiver / scalars).
+Data plane: model pytrees serialized with flax msgpack
+(``flax.serialization``), replacing the reference's pickled torch
+state_dicts — smaller, language-neutral, and no arbitrary-code-execution
+surface on deserialize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.serialization
+import jax
+import numpy as np
+
+MSG_ARG_KEY_TYPE = "msg_type"
+MSG_ARG_KEY_OPERATION = "operation"
+MSG_ARG_KEY_SENDER = "sender"
+MSG_ARG_KEY_RECEIVER = "receiver"
+
+MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+MSG_ARG_KEY_CLIENT_OS = "client_os"
+MSG_ARG_KEY_EVENT_NAME = "event_name"
+
+
+class Message:
+    MSG_TYPE_CONNECTION_IS_READY = 0
+
+    def __init__(self, msg_type: int = 0, sender_id: int = 0,
+                 receiver_id: int = 0):
+        self.msg_params: Dict[str, Any] = {
+            MSG_ARG_KEY_TYPE: msg_type,
+            MSG_ARG_KEY_SENDER: sender_id,
+            MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    # -- reference surface (message.py) ------------------------------------
+    def init(self, msg_params):
+        self.msg_params = dict(msg_params)
+
+    def init_from_json_object(self, obj):
+        self.msg_params = dict(obj)
+
+    def get_sender_id(self) -> int:
+        return int(self.msg_params[MSG_ARG_KEY_SENDER])
+
+    def get_receiver_id(self) -> int:
+        return int(self.msg_params[MSG_ARG_KEY_RECEIVER])
+
+    def get_type(self) -> int:
+        return int(self.msg_params[MSG_ARG_KEY_TYPE])
+
+    def add_params(self, key: str, value: Any):
+        self.msg_params[key] = value
+
+    def add(self, key: str, value: Any):
+        self.msg_params[key] = value
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default=None):
+        return self.msg_params.get(key, default)
+
+    def __repr__(self):
+        keys = {k: type(v).__name__ for k, v in self.msg_params.items()}
+        return f"Message({keys})"
+
+
+# -- pytree payload codec --------------------------------------------------
+def encode_tree(tree: Any) -> bytes:
+    """Pytree → msgpack bytes.  Only device/numeric arrays are converted to
+    host numpy; strings/ints/floats pass through as native msgpack types."""
+    def to_host(x):
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return np.asarray(x)
+        return x
+
+    host = jax.tree_util.tree_map(to_host, tree)
+    return flax.serialization.msgpack_serialize(host)
+
+
+def decode_tree(data: bytes) -> Any:
+    return flax.serialization.msgpack_restore(data)

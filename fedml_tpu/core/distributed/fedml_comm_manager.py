@@ -1,0 +1,97 @@
+"""FedMLCommManager — the actor-style message-loop runtime (reference
+``python/fedml/core/distributed/fedml_comm_manager.py:11``).
+
+Surface parity: ``register_message_receive_handler(msg_type, fn)`` (ref
+``:63``), ``send_message``, ``run()``, ``finish()``; backend selection in
+``_init_manager`` (ref ``:131``) now covers the TPU-era backend set:
+``local`` (in-memory, tests), ``GRPC`` (cross-host), ``filestore``
+(broker-less WAN), ``MQTT_S3`` (broker, requires paho-mqtt).  The ICI data
+plane never goes through this layer — only WAN federation does (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict
+
+from .communication.base_com_manager import BaseCommunicationManager, Observer
+from .communication.message import Message
+
+log = logging.getLogger(__name__)
+
+
+class FedMLCommManager(Observer):
+    def __init__(self, args, comm=None, rank: int = 0, size: int = 0,
+                 backend: str = "local"):
+        self.args = args
+        self.size = int(size)
+        self.rank = int(rank)
+        self.backend = backend
+        self.comm = comm
+        self.com_manager: BaseCommunicationManager = None
+        self.message_handler_dict: Dict[int, Callable] = {}
+        self._init_manager()
+
+    def register_comm_manager(self, comm_manager: BaseCommunicationManager):
+        self.com_manager = comm_manager
+
+    def run(self):
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+        log.debug("rank %d comm loop done", self.rank)
+
+    def get_sender_id(self) -> int:
+        return self.rank
+
+    def receive_message(self, msg_type, msg_params) -> None:
+        handler = self.message_handler_dict.get(int(msg_type))
+        if handler is None:
+            if int(msg_type) != Message.MSG_TYPE_CONNECTION_IS_READY:
+                log.warning("rank %d: no handler for msg_type %s",
+                            self.rank, msg_type)
+            return
+        handler(msg_params)
+
+    def send_message(self, message: Message):
+        self.com_manager.send_message(message)
+
+    def register_message_receive_handler(self, msg_type: int,
+                                         handler_callback_func: Callable):
+        self.message_handler_dict[int(msg_type)] = handler_callback_func
+
+    def register_message_receive_handlers(self):
+        """Subclasses register their FSM handlers here."""
+
+    def finish(self):
+        log.debug("rank %d finishing comm", self.rank)
+        self.com_manager.stop_receive_message()
+
+    # -- backend selection (reference _init_manager :131) ------------------
+    def _init_manager(self):
+        backend = str(self.backend)
+        run_id = str(getattr(self.args, "run_id", "0"))
+        if backend in ("local", "LOCAL"):
+            from .communication.local.local_comm_manager import LocalCommManager
+            self.com_manager = LocalCommManager(run_id, self.rank, self.size)
+        elif backend == "GRPC":
+            from .communication.grpc.grpc_comm_manager import GRPCCommManager
+            ip_config = getattr(self.args, "grpc_ipconfig", None) or {}
+            if not ip_config:
+                base = int(getattr(self.args, "grpc_base_port", 8890))
+                ip_config = {r: f"127.0.0.1:{base + r}" for r in range(self.size)}
+            host, port = ip_config[self.rank].rsplit(":", 1)
+            self.com_manager = GRPCCommManager(
+                host, int(port), ip_config, client_id=self.rank,
+                client_num=self.size)
+        elif backend in ("filestore", "FILESTORE"):
+            from .communication.filestore.filestore_comm_manager import (
+                FileStoreCommManager)
+            root = str(getattr(self.args, "filestore_dir", "/tmp/fedml_tpu_fs"))
+            self.com_manager = FileStoreCommManager(root, run_id, self.rank)
+        elif backend == "MQTT_S3":
+            from .communication.mqtt.mqtt_s3_comm_manager import (
+                MqttS3CommManager)
+            self.com_manager = MqttS3CommManager(self.args, self.rank, self.size)
+        else:
+            raise ValueError(f"unknown comm backend {backend!r}")
+        self.com_manager.add_observer(self)

@@ -1,0 +1,31 @@
+"""Cross-device server (reference ``cross_device/mnn_server.py:6``
+``ServerMNN``): Python server only; edge clients are native (the reference's
+Android/MNN C++ SDK; here the C++ edge trainer in ``fedml_tpu/native``).
+
+Transport: the filestore backend's control/data split (equivalent to the
+reference's MQTT+S3-MNN pair).  The model travels as the portable edge
+bundle (msgpack'd flat arrays, see ``native/edge_bundle.py``) instead of an
+MNN graph file — the C ABI trainer consumes exactly that format.
+"""
+
+from __future__ import annotations
+
+from ..cross_silo.server import FedMLAggregator, FedMLServerManager
+
+
+class ServerMNN:
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        client_num = int(getattr(args, "client_num_per_round", 1))
+        size = client_num + 1
+        backend = str(getattr(args, "backend", "filestore"))
+        if backend in ("sp", "mesh", "MPI", "NCCL", "MQTT_S3_MNN"):
+            backend = "filestore"
+        self.aggregator = FedMLAggregator(args, model, dataset, client_num)
+        if server_aggregator is not None:
+            self.aggregator.user_aggregator = server_aggregator
+        self.server_manager = FedMLServerManager(
+            args, self.aggregator, rank=0, size=size, backend=backend)
+
+    def run(self):
+        self.server_manager.run()
+        return self.aggregator.get_global_model_params()

@@ -1,0 +1,27 @@
+"""Cross-silo client facade (reference ``cross_silo/fedml_client.py``)."""
+
+from __future__ import annotations
+
+from .fedml_client_master_manager import ClientMasterManager, TrainerDistAdapter
+
+
+class Client:
+    def __init__(self, args, device, dataset, model, client_trainer=None):
+        client_num = len(getattr(args, "client_id_list", []) or []) or int(
+            getattr(args, "client_num_per_round", 2))
+        size = client_num + 1
+        backend = str(getattr(args, "backend", "local"))
+        if backend in ("sp", "mesh", "MPI", "NCCL"):
+            backend = "local"
+        adapter = TrainerDistAdapter(args, model, dataset)
+        if client_trainer is not None:
+            adapter.user_trainer = client_trainer
+        rank = int(getattr(args, "rank", 1))
+        self.client_manager = ClientMasterManager(
+            args, adapter, rank=rank, size=size, backend=backend)
+
+    def run(self):
+        self.client_manager.run()
+
+
+__all__ = ["Client", "ClientMasterManager", "TrainerDistAdapter"]

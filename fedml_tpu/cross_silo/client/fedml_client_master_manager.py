@@ -1,0 +1,100 @@
+"""Cross-silo client FSM (reference
+``cross_silo/client/fedml_client_master_manager.py:22``): online handshake →
+receive global model → local training (the jitted LocalTrainer pass) → upload.
+
+The reference's master/slave split (master rank talks MQTT, slaves join a
+torch-DDP process group, ``sync_process_group:200``) maps to TPU as: the
+client process owns a whole host (all its chips); intra-silo data parallelism
+is the mesh ``data`` axis *inside* the jitted train step, so no slave
+processes exist — jax's runtime plays the role of the process group.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng as rng_util
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...ml.trainer.local_trainer import LocalTrainer, ServerCtx
+from ...mlops import log_training_status
+from ..message_define import MyMessage
+
+log = logging.getLogger(__name__)
+
+
+class ClientMasterManager(FedMLCommManager):
+    def __init__(self, args, trainer_adapter, comm=None, rank=0, size=0,
+                 backend="local"):
+        super().__init__(args, comm, rank, size, backend)
+        self.trainer_adapter = trainer_adapter
+        self.num_rounds = int(getattr(args, "comm_round", 10))
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_CONNECTION_IS_READY, self.handle_connection_ready)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            self.handle_message_receive_model_from_server)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self.handle_message_finish)
+
+    def handle_connection_ready(self, msg_params):
+        msg = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                       MyMessage.MSG_CLIENT_STATUS_ONLINE)
+        self.send_message(msg)
+
+    def _train_and_send(self, msg_params):
+        params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        data_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
+        log_training_status("TRAINING")
+        new_params, n = self.trainer_adapter.train(params, data_idx, round_idx)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, new_params)
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        self.send_message(msg)
+
+    def handle_message_init(self, msg_params):
+        self._train_and_send(msg_params)
+
+    def handle_message_receive_model_from_server(self, msg_params):
+        self._train_and_send(msg_params)
+
+    def handle_message_finish(self, msg_params):
+        log_training_status("FINISHED")
+        self.finish()
+
+
+class TrainerDistAdapter:
+    """Reference ``fedml_trainer_dist_adapter.py:10`` — binds a LocalTrainer
+    to this silo's data shard and runs the compiled local pass."""
+
+    def __init__(self, args, model, dataset):
+        self.args = args
+        self.model = model
+        self.dataset = dataset
+        self.trainer = LocalTrainer(model, args)
+        self.local_train = jax.jit(self.trainer.make_local_train())
+        self.seed = int(getattr(args, "random_seed", 0))
+        self.batch_size = int(getattr(args, "batch_size", 10))
+        self.epochs = int(getattr(args, "epochs", 1))
+
+    def train(self, global_params, data_idx: int, round_idx: int):
+        global_params = jax.tree_util.tree_map(jnp.asarray, global_params)
+        xb, yb = self.dataset.client_batches(
+            data_idx, self.batch_size, self.seed, round_idx, self.epochs)
+        mask = jnp.ones((xb.shape[0],), jnp.float32)
+        rng = rng_util.client_key(rng_util.root_key(self.seed), round_idx,
+                                  data_idx)
+        ctx = ServerCtx(global_params=global_params)
+        out = self.local_train(global_params, jnp.asarray(xb), jnp.asarray(yb),
+                               mask, rng, ctx, None)
+        n = len(self.dataset.client_idxs[data_idx])
+        return jax.device_get(out.params), n

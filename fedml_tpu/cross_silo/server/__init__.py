@@ -1,0 +1,28 @@
+"""Cross-silo server facade (reference ``cross_silo/fedml_server.py``)."""
+
+from __future__ import annotations
+
+from .fedml_aggregator import FedMLAggregator
+from .fedml_server_manager import FedMLServerManager
+
+
+class Server:
+    def __init__(self, args, device, dataset, model, server_aggregator=None):
+        client_num = len(getattr(args, "client_id_list", []) or []) or int(
+            getattr(args, "client_num_per_round", 2))
+        size = client_num + 1
+        backend = str(getattr(args, "backend", "local"))
+        if backend in ("sp", "mesh", "MPI", "NCCL"):
+            backend = "local"
+        self.aggregator = FedMLAggregator(args, model, dataset, client_num)
+        if server_aggregator is not None:
+            self.aggregator.user_aggregator = server_aggregator
+        self.server_manager = FedMLServerManager(
+            args, self.aggregator, rank=0, size=size, backend=backend)
+
+    def run(self):
+        self.server_manager.run()
+        return self.aggregator.get_global_model_params()
+
+
+__all__ = ["Server", "FedMLAggregator", "FedMLServerManager"]

@@ -1,0 +1,101 @@
+"""Cross-silo server FSM (reference
+``cross_silo/server/fedml_server_manager.py``: client-onboarding handshake →
+``send_init_msg:48`` → per-round collect/aggregate/sync →
+``handle_message_receive_model_from_client:174``)."""
+
+from __future__ import annotations
+
+import logging
+
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...mlops import log_round_info, log_aggregation_status
+from ..message_define import MyMessage
+
+log = logging.getLogger(__name__)
+
+
+class FedMLServerManager(FedMLCommManager):
+    def __init__(self, args, aggregator, comm=None, rank=0, size=0,
+                 backend="local"):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.round_num = int(getattr(args, "comm_round", 10))
+        self.args.round_idx = 0
+        self.client_num = size - 1
+        self.client_online_set = set()
+        self.client_real_ids = list(range(1, size))
+        self.client_finished_count = 0
+
+    # -- handshake ---------------------------------------------------------
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS,
+            self.handle_message_client_status_update)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client)
+
+    def handle_message_client_status_update(self, msg_params):
+        status = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
+        sender = msg_params.get_sender_id()
+        if status == MyMessage.MSG_CLIENT_STATUS_ONLINE:
+            self.client_online_set.add(sender)
+            log.info("server: client %d online (%d/%d)", sender,
+                     len(self.client_online_set), self.client_num)
+        if len(self.client_online_set) == self.client_num:
+            self.send_init_msg()
+
+    # -- round machinery ---------------------------------------------------
+    def _sampled_client_idxs(self, round_idx):
+        return self.aggregator.client_sampling(
+            round_idx,
+            int(getattr(self.args, "client_num_in_total", self.client_num)),
+            min(int(getattr(self.args, "client_num_per_round", self.client_num)),
+                self.client_num),
+        )
+
+    def send_init_msg(self):
+        """Reference send_init_msg:48 — S2C global model + assigned data idx."""
+        client_idxs = self._sampled_client_idxs(0)
+        global_params = self.aggregator.get_global_model_params()
+        for rank, data_idx in zip(self.client_real_ids, client_idxs):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(data_idx))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
+            self.send_message(msg)
+        log_aggregation_status("RUNNING")
+
+    def handle_message_receive_model_from_client(self, msg_params):
+        sender = msg_params.get_sender_id()
+        params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        n = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        self.aggregator.add_local_trained_result(
+            self.client_real_ids.index(sender), params, n)
+        if not self.aggregator.check_whether_all_receive():
+            return
+        round_idx = self.args.round_idx
+        self.aggregator.aggregate()
+        acc = self.aggregator.test_on_server_for_all_clients(round_idx)
+        log_round_info(round_idx, {"test_acc": acc})
+        self.args.round_idx = round_idx + 1
+        if self.args.round_idx >= self.round_num:
+            self.send_finish()
+            return
+        client_idxs = self._sampled_client_idxs(self.args.round_idx)
+        global_params = self.aggregator.get_global_model_params()
+        for rank, data_idx in zip(self.client_real_ids, client_idxs):
+            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                          self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(data_idx))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.args.round_idx)
+            self.send_message(msg)
+
+    def send_finish(self):
+        for rank in self.client_real_ids:
+            self.send_message(
+                Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
+        log_aggregation_status("FINISHED")
+        self.finish()

@@ -1,19 +1,78 @@
-"""DP deployment frames (reference ``python/fedml/core/dp/frames/``):
-local DP (noise on each client update), global/central DP (clip + noise on
-the aggregate), NbAFL (both sides)."""
+"""DP deployment frames (reference ``core/dp/frames/``): local DP (noise on
+each client update), global/central DP (clip + noise on the aggregate), NbAFL
+(both sides, Wei et al.)."""
 
 from __future__ import annotations
+
+from ...tree import tree_flatten_1d, tree_unflatten_1d
+from ..mechanisms import create_mechanism
+
+
+class _BaseFrame:
+    def __init__(self, args):
+        self.args = args
+        self.mechanism = create_mechanism(args)
+        self.clip_norm = float(getattr(args, "dp_clip_norm", 0.0))
+
+    def is_clipping(self) -> bool:
+        return self.clip_norm > 0
+
+    def _clip(self, params):
+        import jax.numpy as jnp
+        flat = tree_flatten_1d(params)
+        norm = jnp.linalg.norm(flat)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        return tree_unflatten_1d(flat * scale, params)
+
+    def global_clip(self, raw_client_list):
+        if not self.is_clipping():
+            return raw_client_list
+        return [(n, self._clip(p)) for n, p in raw_client_list]
+
+    def add_local_noise(self, local_grad, key):
+        return local_grad
+
+    def add_global_noise(self, global_model, key):
+        return global_model
+
+
+class LocalDP(_BaseFrame):
+    """LDP: every client perturbs its own update (reference
+    ``frames/local_dp.py``)."""
+
+    def add_local_noise(self, local_grad, key):
+        if self.is_clipping():
+            local_grad = self._clip(local_grad)
+        return self.mechanism.add_noise(local_grad, key)
+
+
+class GlobalDP(_BaseFrame):
+    """CDP: the server clips client updates and noises the aggregate
+    (reference ``frames/global_dp.py``)."""
+
+    def add_global_noise(self, global_model, key):
+        return self.mechanism.add_noise(global_model, key)
+
+
+class NbAFL(_BaseFrame):
+    """NbAFL: noise before (client-side) AND after (server-side) aggregation
+    (reference ``frames/nbafl.py``)."""
+
+    def add_local_noise(self, local_grad, key):
+        if self.is_clipping():
+            local_grad = self._clip(local_grad)
+        return self.mechanism.add_noise(local_grad, key)
+
+    def add_global_noise(self, global_model, key):
+        return self.mechanism.add_noise(global_model, key)
 
 
 def create_dp_frame(solution_type: str, args):
     t = solution_type.strip().lower()
     if t == "local_dp":
-        from .local_dp import LocalDP
         return LocalDP(args)
     if t == "global_dp":
-        from .global_dp import GlobalDP
         return GlobalDP(args)
     if t == "nbafl":
-        from .nbafl import NbAFL
         return NbAFL(args)
     raise ValueError(f"unknown dp_solution_type {solution_type!r}")

@@ -1,0 +1,57 @@
+"""Backdoor data-poisoning attacks.
+
+Reference: ``backdoor_attack.py`` (pixel-pattern trigger + target label) and
+``edge_case_attack.py`` (poison with rare edge-case examples).  The trigger
+is a corner patch stamped into a fraction of the poisoned client's samples,
+all relabeled to ``backdoor_target_label``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BackdoorAttack:
+    def __init__(self, args):
+        self.target_label = int(getattr(args, "backdoor_target_label", 0))
+        self.trigger_frac = float(getattr(args, "backdoor_trigger_frac", 0.3))
+        self.patch = int(getattr(args, "backdoor_patch_size", 3))
+
+    def active_this_round(self) -> bool:
+        return True
+
+    def _stamp(self, x):
+        x = np.array(x, copy=True)
+        p = self.patch
+        if x.ndim >= 3:           # (..., H, W, C) image batch
+            x[..., :p, :p, :] = 1.0
+        return x
+
+    def poison_data(self, dataset):
+        if isinstance(dataset, tuple) and len(dataset) == 2:
+            x, y = np.array(dataset[0], copy=True), np.array(dataset[1], copy=True)
+            n = len(x)
+            k = int(self.trigger_frac * n)
+            idx = np.arange(n)[:k]
+            x[idx] = self._stamp(x[idx])
+            y[idx] = self.target_label
+            return x, y
+        return dataset
+
+
+class EdgeCaseBackdoorAttack(BackdoorAttack):
+    """Edge-case variant (reference edge_case_attack.py): instead of a pixel
+    trigger, inject out-of-distribution samples labeled with the target.
+    Without the reference's ARDIS/Southwest downloads (no egress), edge cases
+    are synthesized as extreme-intensity versions of existing samples."""
+
+    def poison_data(self, dataset):
+        if isinstance(dataset, tuple) and len(dataset) == 2:
+            x, y = np.array(dataset[0], copy=True), np.array(dataset[1], copy=True)
+            n = len(x)
+            k = max(int(self.trigger_frac * n), 1)
+            edge = 1.0 - x[:k]          # inverted = off-manifold for digits
+            x[:k] = edge
+            y[:k] = self.target_label
+            return x, y
+        return dataset

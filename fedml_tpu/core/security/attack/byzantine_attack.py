@@ -1,0 +1,43 @@
+"""Byzantine attack (reference ``core/security/attack/byzantine_attack.py``):
+a fraction of clients submit corrupted updates — ``zero`` / ``random`` /
+``flip`` (negated) modes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tree import tree_scale, tree_zeros_like
+
+
+class ByzantineAttack:
+    def __init__(self, args):
+        self.byzantine_client_num = int(getattr(args, "byzantine_client_num", 1))
+        self.attack_mode = str(getattr(args, "attack_mode", "random")).lower()
+        self._key = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0)) ^ 0xB72)
+
+    def _corrupt(self, params):
+        if self.attack_mode == "zero":
+            return tree_zeros_like(params)
+        if self.attack_mode == "flip":
+            return tree_scale(params, -1.0)
+        # random: gaussian with matching per-leaf scale
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        self._key, *subs = jax.random.split(self._key, len(leaves) + 1)
+        noisy = [jax.random.normal(k, l.shape, l.dtype)
+                 * (jnp.std(l.astype(jnp.float32)) + 1e-3)
+                 for k, l in zip(subs, leaves)]
+        return jax.tree_util.tree_unflatten(treedef, noisy)
+
+    def attack_model(self, model_params, sample_num):
+        return self._corrupt(model_params)
+
+    def attack_model_list(self, model_list):
+        """Server-side simulation injection (first f clients turn byzantine,
+        matching the reference's deterministic choice)."""
+        out = list(model_list)
+        for i in range(min(self.byzantine_client_num, len(out))):
+            n, p = out[i]
+            out[i] = (n, self._corrupt(p))
+        return out

@@ -1,0 +1,38 @@
+"""Lazy worker (free-rider) attack (reference ``lazy_worker_attack.py``):
+the client skips training and echoes a perturbed copy of a previous global
+model instead of a real update."""
+
+from __future__ import annotations
+
+import jax
+
+from ...tree import tree_axpy
+
+
+class LazyWorkerAttack:
+    def __init__(self, args):
+        self.noise_scale = float(getattr(args, "lazy_noise_scale", 1e-3))
+        self._key = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0)) ^ 0x1A2)
+        self._last_global = None
+
+    def set_global_model(self, params):
+        self._last_global = params
+
+    def _noisy_echo(self, params):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        self._key, *subs = jax.random.split(self._key, len(leaves) + 1)
+        noisy = [l + self.noise_scale * jax.random.normal(k, l.shape, l.dtype)
+                 for k, l in zip(subs, leaves)]
+        return jax.tree_util.tree_unflatten(treedef, noisy)
+
+    def attack_model(self, model_params, sample_num):
+        base = self._last_global if self._last_global is not None else model_params
+        return self._noisy_echo(base)
+
+    def attack_model_list(self, model_list):
+        out = list(model_list)
+        if out:
+            n, p = out[0]
+            out[0] = (n, self.attack_model(p, n))
+        return out

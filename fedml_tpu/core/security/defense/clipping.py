@@ -1,0 +1,109 @@
+"""Norm-clipping defense family.
+
+Reference modules: ``norm_diff_clipping_defense.py`` (clip update deltas to a
+norm ball around the global model), ``cclip_defense.py`` (centered clipping
+around a momentum center), ``weak_dp_defense.py`` (clip + gaussian noise),
+``crfl_defense.py`` (certified robustness: clip + parameter noise each round).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tree import (tree_add, tree_flatten_1d, tree_scale, tree_sub,
+                     tree_unflatten_1d)
+from . import register
+from .common import BaseDefense, merge_list, stack_clients
+
+
+def _clip_to_ball(delta_vec, max_norm):
+    norm = jnp.linalg.norm(delta_vec)
+    return delta_vec * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+
+
+@register("norm_diff_clipping")
+class NormDiffClippingDefense(BaseDefense):
+    def __init__(self, args):
+        super().__init__(args)
+        self.norm_bound = float(getattr(args, "norm_bound", 5.0))
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        """extra = global model pytree (reference passes it the same way)."""
+        global_vec = tree_flatten_1d(extra) if extra is not None else 0.0
+        out = []
+        for n, p in raw_list:
+            v = tree_flatten_1d(p)
+            clipped = global_vec + _clip_to_ball(v - global_vec, self.norm_bound)
+            out.append((n, tree_unflatten_1d(clipped, p)))
+        return out
+
+
+@register("cclip")
+class CClipDefense(BaseDefense):
+    """Centered clipping (Karimireddy et al.); center = previous aggregate
+    kept across rounds."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.tau = float(getattr(args, "cclip_tau", 10.0))
+        self.iters = int(getattr(args, "cclip_iters", 3))
+        self._center = None
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        v = (tree_flatten_1d(self._center) if self._center is not None
+             else jnp.zeros(vecs.shape[1]))
+        alphas = w / jnp.sum(w)
+        for _ in range(self.iters):
+            delta = vecs - v[None, :]
+            norms = jnp.linalg.norm(delta, axis=1)
+            scale = jnp.minimum(1.0, self.tau / jnp.maximum(norms, 1e-12))
+            v = v + jnp.einsum("c,cd->d", alphas * scale, delta)
+        out = tree_unflatten_1d(v, template)
+        self._center = out
+        return out
+
+
+@register("weak_dp")
+class WeakDPDefense(BaseDefense):
+    """Clip each update then add small gaussian noise to the aggregate
+    (reference weak_dp_defense.py)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.norm_bound = float(getattr(args, "norm_bound", 5.0))
+        self.stddev = float(getattr(args, "weak_dp_stddev", 0.002))
+        self._key = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0)) ^ 0xDEF)
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        return NormDiffClippingDefense(self.args).defend_before_aggregation(
+            raw_list, extra)
+
+    def defend_after_aggregation(self, global_model):
+        self._key, sub = jax.random.split(self._key)
+        flat = tree_flatten_1d(global_model)
+        noisy = flat + self.stddev * jax.random.normal(sub, flat.shape)
+        return tree_unflatten_1d(noisy, global_model)
+
+
+@register("crfl")
+class CRFLDefense(BaseDefense):
+    """CRFL (reference crfl_defense.py): clip the aggregated model norm to a
+    (round-dependent) bound and perturb with gaussian noise — certified
+    robustness against backdoors."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.clip_threshold = float(getattr(args, "crfl_clip", 15.0))
+        self.stddev = float(getattr(args, "crfl_stddev", 0.01))
+        self._key = jax.random.PRNGKey(
+            int(getattr(args, "random_seed", 0)) ^ 0xC4F1)
+
+    def defend_after_aggregation(self, global_model):
+        flat = tree_flatten_1d(global_model)
+        flat = _clip_to_ball(flat, self.clip_threshold)
+        self._key, sub = jax.random.split(self._key)
+        flat = flat + self.stddev * jax.random.normal(sub, flat.shape)
+        return tree_unflatten_1d(flat, global_model)

@@ -1,0 +1,57 @@
+"""Shared defense machinery.
+
+Every reference defense (``core/security/defense/*.py``) starts by
+vectorizing client updates (their ``utils.vectorize_weight``) and loops in
+Python; here the client list is stacked once into a (C, D) matrix so
+pairwise distances, medians, norms etc. are single fused XLA ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...tree import tree_flatten_1d, tree_unflatten_1d, weighted_average
+
+
+def stack_clients(raw_list: List[Tuple[float, Any]]):
+    """(C, D) float32 matrix + (C,) weights + template pytree."""
+    vecs = jnp.stack([tree_flatten_1d(p) for _, p in raw_list])
+    w = jnp.asarray([n for n, _ in raw_list], jnp.float32)
+    template = raw_list[0][1]
+    return vecs, w, template
+
+
+def unstack_to_list(vecs, w, template) -> List[Tuple[float, Any]]:
+    return [(float(w[i]), tree_unflatten_1d(vecs[i], template))
+            for i in range(vecs.shape[0])]
+
+
+def pairwise_sq_dists(vecs: jnp.ndarray) -> jnp.ndarray:
+    """(C, C) squared euclidean distances — one matmul on the MXU."""
+    sq = jnp.sum(vecs * vecs, axis=1)
+    return sq[:, None] + sq[None, :] - 2.0 * (vecs @ vecs.T)
+
+
+def merge_list(raw_list: List[Tuple[float, Any]]):
+    return weighted_average([p for _, p in raw_list], [n for n, _ in raw_list])
+
+
+class BaseDefense:
+    """Defense plugin base; subclasses implement any of the three phases
+    (reference ``FedMLDefender.defend_before/on/after_aggregation``)."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def run(self, raw_list, base_agg=None, extra=None):
+        if hasattr(self, "defend_before_aggregation"):
+            raw_list = self.defend_before_aggregation(raw_list, extra)
+        if hasattr(self, "defend_on_aggregation"):
+            return self.defend_on_aggregation(raw_list, base_agg, extra)
+        out = (base_agg or merge_list)(raw_list)
+        if hasattr(self, "defend_after_aggregation"):
+            out = self.defend_after_aggregation(out)
+        return out

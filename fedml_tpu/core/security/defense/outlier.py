@@ -1,0 +1,102 @@
+"""Outlier-score defenses.
+
+Reference modules: ``three_sigma_defense.py`` / ``three_sigma_geomedian_
+defense.py`` / ``three_sigma_krum_defense.py`` (drop clients whose distance
+to a robust center exceeds μ+3σ of the score distribution),
+``outlier_detection.py``, ``cross_round_defense.py`` (flag clients whose
+update direction flips vs their own previous round).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import register
+from .common import BaseDefense, pairwise_sq_dists, stack_clients
+
+
+def _three_sigma_keep(scores):
+    """Robust 3σ rule: median/MAD instead of mean/std, so the outliers being
+    tested can't inflate the threshold that is supposed to catch them."""
+    med = jnp.median(scores)
+    mad = jnp.median(jnp.abs(scores - med))
+    sigma = 1.4826 * mad + 1e-8 * (1.0 + jnp.abs(med))
+    return scores <= med + 3.0 * sigma
+
+
+@register("three_sigma")
+@register("outlier_detection")
+class ThreeSigmaDefense(BaseDefense):
+    """Score = distance to the coordinate-wise median center."""
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        center = jnp.median(vecs, axis=0)
+        scores = jnp.linalg.norm(vecs - center[None, :], axis=1)
+        keep = _three_sigma_keep(scores)
+        kept = [raw_list[i] for i in range(len(raw_list)) if bool(keep[i])]
+        return kept or raw_list
+
+
+@register("three_sigma_geomedian")
+class ThreeSigmaGeoMedianDefense(BaseDefense):
+    """Score = distance to the geometric median (Weiszfeld, few iters)."""
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        v = jnp.mean(vecs, axis=0)
+        for _ in range(5):
+            d = jnp.linalg.norm(vecs - v[None, :], axis=1)
+            beta = 1.0 / jnp.maximum(d, 1e-6)
+            v = jnp.einsum("c,cd->d", beta / jnp.sum(beta), vecs)
+        scores = jnp.linalg.norm(vecs - v[None, :], axis=1)
+        keep = _three_sigma_keep(scores)
+        kept = [raw_list[i] for i in range(len(raw_list)) if bool(keep[i])]
+        return kept or raw_list
+
+
+@register("three_sigma_krum")
+class ThreeSigmaKrumDefense(BaseDefense):
+    """Score = krum score (sum of k nearest sq distances)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.f = int(getattr(args, "byzantine_client_num", 1))
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        c = len(raw_list)
+        vecs, w, template = stack_clients(raw_list)
+        d2 = pairwise_sq_dists(vecs)
+        d2 = d2.at[jnp.arange(c), jnp.arange(c)].set(jnp.inf)
+        k = max(c - self.f - 2, 1)
+        scores = jnp.sum(jnp.sort(d2, axis=1)[:, :k], axis=1)
+        keep = _three_sigma_keep(scores)
+        kept = [raw_list[i] for i in range(c) if bool(keep[i])]
+        return kept or raw_list
+
+
+@register("cross_round")
+class CrossRoundDefense(BaseDefense):
+    """Track each client's previous update; low cosine similarity with its
+    own history (sudden direction flip) marks it suspicious this round."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.threshold = float(getattr(args, "cross_round_threshold", -0.2))
+        self._prev = {}
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        keep = []
+        for i in range(len(raw_list)):
+            v = vecs[i]
+            prev = self._prev.get(i)
+            ok = True
+            if prev is not None:
+                cos = jnp.vdot(v, prev) / (
+                    jnp.linalg.norm(v) * jnp.linalg.norm(prev) + 1e-12)
+                ok = bool(cos >= self.threshold)
+            self._prev[i] = v
+            if ok:
+                keep.append(raw_list[i])
+        return keep or raw_list

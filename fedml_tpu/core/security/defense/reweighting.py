@@ -1,0 +1,136 @@
+"""Reweighting / sign-based defenses.
+
+Reference modules: ``foolsgold_defense.py`` (cosine-similarity history
+reweighting), ``residual_based_reweighting_defense.py`` (IRLS over
+per-coordinate regression residuals — simplified to repeated-median z-score
+reweighting with the same repeated-median backbone), ``robust_learning_rate_
+defense.py`` (sign-agreement learning-rate flipping), ``slsgd_defense.py``
+(trimmed-mean variant), ``wbc_defense.py`` (weight-based clustering keep-set).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tree import tree_unflatten_1d
+from . import register
+from .common import BaseDefense, stack_clients
+
+
+@register("foolsgold")
+class FoolsGoldDefense(BaseDefense):
+    """FoolsGold: sybils push similar updates; per-client learning rates are
+    derated by max pairwise cosine similarity of *historical* aggregate
+    updates (history kept across rounds)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self._history = None
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        hist = vecs if self._history is None else self._history + vecs
+        self._history = hist
+        normed = hist / jnp.maximum(
+            jnp.linalg.norm(hist, axis=1, keepdims=True), 1e-12)
+        cs = normed @ normed.T
+        cs = cs - jnp.eye(cs.shape[0])
+        maxcs = jnp.max(cs, axis=1)
+        # pardoning + logit rescale (FoolsGold paper / reference impl)
+        mc = jnp.clip(maxcs, 1e-6, 1 - 1e-6)
+        wv = 1.0 - mc
+        wv = wv / jnp.max(wv)
+        wv = jnp.clip(wv, 1e-6, 1 - 1e-6)
+        wv = jnp.clip(jnp.log(wv / (1 - wv)) / 4.0 + 0.5, 0.0, 1.0)
+        agg = jnp.einsum("c,cd->d", wv * w / jnp.sum(wv * w + 1e-12), vecs)
+        return tree_unflatten_1d(agg, template)
+
+
+@register("residual_based_reweighting")
+class ResidualBasedReweightingDefense(BaseDefense):
+    """Repeated-median residual reweighting: per coordinate, clients whose
+    value sits far from the median (in MAD units) get down-weighted; client
+    weight = mean of its per-coordinate weights."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.lmbd = float(getattr(args, "reweight_lambda", 2.0))
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        med = jnp.median(vecs, axis=0)
+        mad = jnp.median(jnp.abs(vecs - med[None, :]), axis=0) + 1e-12
+        z = jnp.abs(vecs - med[None, :]) / (1.4826 * mad[None, :])
+        per_coord_w = jnp.clip(1.0 - z / self.lmbd, 0.0, 1.0)
+        client_w = jnp.mean(per_coord_w, axis=1) * w
+        agg = jnp.einsum("c,cd->d", client_w / jnp.sum(client_w), vecs)
+        return tree_unflatten_1d(agg, template)
+
+
+@register("robust_learning_rate")
+class RobustLearningRateDefense(BaseDefense):
+    """RLR (reference robust_learning_rate_defense.py): coordinates where
+    fewer than θ clients agree on the update sign get their learning rate
+    flipped (server applies −Δ there)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.robust_threshold = int(getattr(args, "robust_threshold", 4))
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        if extra is None:
+            raise ValueError("robust_learning_rate needs the global model via extra")
+        vecs, w, template = stack_clients(raw_list)
+        from ...tree import tree_flatten_1d
+        g = tree_flatten_1d(extra)
+        deltas = vecs - g[None, :]
+        sign_agree = jnp.abs(jnp.sum(jnp.sign(deltas), axis=0))
+        lr_sign = jnp.where(sign_agree >= self.robust_threshold, 1.0, -1.0)
+        mean_delta = jnp.einsum("c,cd->d", w / jnp.sum(w), deltas)
+        return tree_unflatten_1d(g + lr_sign * mean_delta, template)
+
+
+@register("slsgd")
+class SLSGDDefense(BaseDefense):
+    """SLSGD (reference slsgd_defense.py): trimmed-mean merge then convex
+    combination with the current global model, x⁺ = (1−α)x + α·agg."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.alpha = float(getattr(args, "slsgd_alpha", 0.5))
+        self.b = int(getattr(args, "trim_param_b", 1))
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        c = vecs.shape[0]
+        b = min(self.b, (c - 1) // 2)
+        s = jnp.sort(vecs, axis=0)
+        agg = jnp.mean(s[b: c - b] if c - 2 * b > 0 else s, axis=0)
+        if extra is not None:
+            from ...tree import tree_flatten_1d
+            g = tree_flatten_1d(extra)
+            agg = (1 - self.alpha) * g + self.alpha * agg
+        return tree_unflatten_1d(agg, template)
+
+
+@register("wbc")
+class WBCDefense(BaseDefense):
+    """Weight-based clustering: 2-means over client vectors (distance to the
+    two farthest-apart clients as seeds); keep the larger cluster."""
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        v = np.asarray(vecs)
+        c = v.shape[0]
+        if c < 3:
+            return raw_list
+        d2 = ((v[:, None, :] - v[None, :, :]) ** 2).sum(-1)
+        i, j = np.unravel_index(np.argmax(d2), d2.shape)
+        assign = (d2[:, i] > d2[:, j]).astype(int)  # 0→cluster i, 1→cluster j
+        for _ in range(5):
+            mu0 = v[assign == 0].mean(0) if (assign == 0).any() else v[i]
+            mu1 = v[assign == 1].mean(0) if (assign == 1).any() else v[j]
+            assign = (((v - mu0) ** 2).sum(1) > ((v - mu1) ** 2).sum(1)).astype(int)
+        keep_cluster = 0 if (assign == 0).sum() >= (assign == 1).sum() else 1
+        return [raw_list[k] for k in range(c) if assign[k] == keep_cluster]

@@ -1,0 +1,124 @@
+"""Distance/statistics-based robust aggregation defenses.
+
+Reference modules rebuilt here (``core/security/defense/``):
+``krum_defense.py`` (krum + multi-krum), ``bulyan_defense.py``,
+``coordinate_wise_median_defense.py``, ``coordinate_wise_trimmed_mean_defense.py``,
+``RFA_defense.py`` (geometric median via smoothed Weiszfeld),
+``geometric_median_defense.py``.
+
+All math runs on the stacked (C, D) client matrix: pairwise distances are one
+MXU matmul; coordinate medians/sorts are single fused ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tree import tree_unflatten_1d
+from . import register
+from .common import BaseDefense, pairwise_sq_dists, stack_clients, unstack_to_list
+
+
+@register("krum")
+@register("multi_krum")
+class KrumDefense(BaseDefense):
+    """Krum/multi-Krum (reference krum_defense.py): score each client by the
+    sum of its k nearest squared distances; keep the best 1 (krum) or m
+    (multi-krum)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.byzantine_client_num = int(getattr(args, "byzantine_client_num", 1))
+        self.multi = str(getattr(args, "defense_type", "krum")).lower() == "multi_krum"
+        self.krum_param_m = int(getattr(args, "krum_param_m", 3)) if self.multi else 1
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        c = len(raw_list)
+        f = min(self.byzantine_client_num, max(c - 3, 0) // 2)
+        vecs, w, template = stack_clients(raw_list)
+        d2 = pairwise_sq_dists(vecs)
+        d2 = d2.at[jnp.arange(c), jnp.arange(c)].set(jnp.inf)
+        k = max(c - f - 2, 1)
+        nearest = jnp.sort(d2, axis=1)[:, :k]
+        scores = jnp.sum(nearest, axis=1)
+        m = min(self.krum_param_m, c)
+        keep = jnp.argsort(scores)[:m]
+        return [raw_list[int(i)] for i in keep]
+
+
+@register("bulyan")
+class BulyanDefense(BaseDefense):
+    """Bulyan (reference bulyan_defense.py): multi-krum selection of
+    θ = C − 2f clients, then per-coordinate trimmed mean of the β = θ − 2f
+    values closest to the coordinate median."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.f = int(getattr(args, "byzantine_client_num", 1))
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        c = len(raw_list)
+        f = min(self.f, max((c - 3) // 4, 0))
+        theta = c - 2 * f
+        vecs, w, template = stack_clients(raw_list)
+        d2 = pairwise_sq_dists(vecs)
+        d2 = d2.at[jnp.arange(c), jnp.arange(c)].set(jnp.inf)
+        k = max(c - f - 2, 1)
+        scores = jnp.sum(jnp.sort(d2, axis=1)[:, :k], axis=1)
+        sel = jnp.argsort(scores)[:theta]
+        sub = vecs[sel]                                  # (θ, D)
+        med = jnp.median(sub, axis=0)                    # (D,)
+        beta = max(theta - 2 * f, 1)
+        dist = jnp.abs(sub - med[None, :])
+        order = jnp.argsort(dist, axis=0)[:beta]         # (β, D)
+        gathered = jnp.take_along_axis(sub, order, axis=0)
+        out = jnp.mean(gathered, axis=0)
+        return tree_unflatten_1d(out, template)
+
+
+@register("coordinate_wise_median")
+@register("median")
+class CoordinateWiseMedianDefense(BaseDefense):
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, _, template = stack_clients(raw_list)
+        return tree_unflatten_1d(jnp.median(vecs, axis=0), template)
+
+
+@register("coordinate_wise_trimmed_mean")
+@register("trimmed_mean")
+class TrimmedMeanDefense(BaseDefense):
+    def __init__(self, args):
+        super().__init__(args)
+        self.beta = float(getattr(args, "trimmed_mean_beta",
+                                  getattr(args, "beta", 0.1)))
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, _, template = stack_clients(raw_list)
+        c = vecs.shape[0]
+        k = int(self.beta * c)
+        s = jnp.sort(vecs, axis=0)
+        kept = s[k: c - k] if c - 2 * k > 0 else s
+        return tree_unflatten_1d(jnp.mean(kept, axis=0), template)
+
+
+@register("rfa")
+@register("geometric_median")
+class RFADefense(BaseDefense):
+    """RFA (reference RFA_defense.py): weighted geometric median via the
+    smoothed Weiszfeld iteration — a fixed-count fori_loop, jit-stable."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.iters = int(getattr(args, "rfa_iters", 8))
+        self.eps = 1e-6
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        alphas = w / jnp.sum(w)
+        v = jnp.einsum("c,cd->d", alphas, vecs)
+        for _ in range(self.iters):
+            dist = jnp.sqrt(jnp.sum((vecs - v[None, :]) ** 2, axis=1))
+            beta = alphas / jnp.maximum(dist, self.eps)
+            beta = beta / jnp.sum(beta)
+            v = jnp.einsum("c,cd->d", beta, vecs)
+        return tree_unflatten_1d(v, template)

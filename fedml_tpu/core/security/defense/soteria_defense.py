@@ -1,0 +1,42 @@
+"""Soteria (reference ``soteria_defense.py``): defends against gradient-
+inversion reconstruction by perturbing the representation layer — the
+reference prunes the fraction of the fc-layer gradient with smallest
+sensitivity.  Here: zero the smallest-|g| fraction of the LAST dense kernel's
+update (the representation-revealing layer), leaving the rest intact."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+from .common import BaseDefense
+
+
+@register("soteria")
+class SoteriaDefense(BaseDefense):
+    def __init__(self, args):
+        super().__init__(args)
+        self.prune_ratio = float(getattr(args, "soteria_prune_ratio", 0.5))
+
+    def _prune_last_dense(self, params):
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+        # find the last 2-D kernel (output head) by path order
+        target_idx = None
+        for i, (path, leaf) in enumerate(leaves):
+            if leaf.ndim == 2:
+                target_idx = i
+        out = []
+        for i, (path, leaf) in enumerate(leaves):
+            if i == target_idx:
+                flat = jnp.ravel(leaf)
+                k = int(self.prune_ratio * flat.size)
+                if k > 0:
+                    thresh = jnp.sort(jnp.abs(flat))[k - 1]
+                    leaf = jnp.where(jnp.abs(leaf) <= thresh,
+                                     jnp.zeros_like(leaf), leaf)
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        return [(n, self._prune_last_dense(p)) for n, p in raw_list]

@@ -22,8 +22,23 @@ class SimulatorSingleProcess:
     def __init__(self, args, device, dataset, model, client_trainer=None,
                  server_aggregator=None):
         mode = str(getattr(args, "sp_client_mode", "vmap"))
-        self.fl_trainer = FedAvgAPI(args, device, dataset, model,
-                                    client_mode=mode)
+        alg = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        if alg in ("hierarchicalfl", "hierarchical_fl"):
+            from .sp.hierarchical_fl import HierarchicalFedAvgAPI
+            self.fl_trainer = HierarchicalFedAvgAPI(args, device, dataset,
+                                                    model, client_mode=mode)
+        elif alg in ("async_fedavg", "fedasync"):
+            from .sp.async_fedavg import AsyncFedAvgAPI
+            self.fl_trainer = AsyncFedAvgAPI(args, device, dataset, model,
+                                             client_mode=mode)
+        elif alg in ("decentralized_fl", "dsgd", "push_sum"):
+            from .sp.decentralized import DecentralizedFedAPI
+            self.fl_trainer = DecentralizedFedAPI(args, device, dataset, model)
+        else:
+            # FedAvg / FedProx / FedOpt / SCAFFOLD / FedNova / FedDyn / Mime /
+            # FedSGD — all branches of the jitted round engine
+            self.fl_trainer = FedAvgAPI(args, device, dataset, model,
+                                        client_mode=mode)
 
     def run(self):
         return self.fl_trainer.train()

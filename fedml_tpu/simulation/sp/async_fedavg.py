@@ -1,0 +1,74 @@
+"""Asynchronous FedAvg (reference ``simulation/mpi/async_fedavg/``): the
+server merges each client update on ARRIVAL instead of waiting for the
+cohort; stale updates are discounted by a staleness function — the only
+straggler-tolerant trainer in the reference (SURVEY §5).
+
+Simulation model: each sampled client draws a latency ~ staleness_rng; the
+server processes arrivals in latency order, mixing each into the global
+model with α·s(t−τ) where s is polynomial staleness discount
+(FedAsync, Xie et al.).  Client training itself reuses the jitted
+LocalTrainer pass, trained from the global model as of DISPATCH time τ.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import hostrng
+from ...core import rng as rng_util
+from ...core import tree as tree_util
+from ...ml.trainer.local_trainer import LocalTrainer, ServerCtx
+from .fedavg_api import FedAvgAPI
+
+
+class AsyncFedAvgAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model, client_mode="vmap"):
+        super().__init__(args, device, dataset, model, client_mode)
+        self.mix_alpha = float(getattr(args, "async_alpha", 0.6))
+        self.staleness_a = float(getattr(args, "async_staleness_a", 0.5))
+        self.max_latency = int(getattr(args, "async_max_latency", 4))
+        self._local_train = jax.jit(self.trainer.make_local_train())
+        self._version = 0
+        self._pending = []  # (arrival_time, dispatch_version, client, params, n)
+
+    def _staleness_weight(self, staleness: float) -> float:
+        # polynomial staleness: s(τ) = (1+τ)^(−a)
+        return float((1.0 + staleness) ** (-self.staleness_a))
+
+    def train_one_round(self, round_idx: int):
+        """One 'tick': dispatch sampled clients with the CURRENT model, then
+        merge every pending update whose latency has elapsed."""
+        clients = self._client_sampling(round_idx)
+        lat_rng = hostrng.gen(self.seed, 0xA51C, round_idx)
+        losses = []
+        for i, c in enumerate(clients):
+            xb, yb = self.dataset.client_batches(
+                int(c), self.batch_size, self.seed, round_idx, self.epochs)
+            mask = jnp.ones((xb.shape[0],), jnp.float32)
+            rng = rng_util.client_key(rng_util.root_key(self.seed), round_idx,
+                                      int(c))
+            ctx = ServerCtx(global_params=self.state.global_params)
+            out = self._local_train(self.state.global_params, jnp.asarray(xb),
+                                    jnp.asarray(yb), mask, rng, ctx, None)
+            latency = int(lat_rng.integers(0, self.max_latency + 1))
+            self._pending.append((round_idx + latency, self._version, int(c),
+                                  out.params,
+                                  len(self.dataset.client_idxs[int(c)])))
+            losses.append(float(out.loss))
+        # merge arrivals due this tick, in arrival order
+        due = sorted([p for p in self._pending if p[0] <= round_idx],
+                     key=lambda p: p[0])
+        self._pending = [p for p in self._pending if p[0] > round_idx]
+        for _, dispatch_v, c, params, n in due:
+            staleness = self._version - dispatch_v
+            alpha = self.mix_alpha * self._staleness_weight(staleness)
+            self.state = self.state.replace(
+                global_params=jax.tree_util.tree_map(
+                    lambda g, l: (1 - alpha) * g + alpha * l,
+                    self.state.global_params, params),
+                round_idx=self.state.round_idx + 1)
+            self._version += 1
+        return {"train_loss": jnp.asarray(np.mean(losses) if losses else np.nan),
+                "merged": len(due)}

@@ -1,0 +1,102 @@
+"""Decentralized FL — DSGD / push-sum (reference ``simulation/sp/
+decentralized/client_dsgd.py``, ``mpi/decentralized_framework/``, topology
+managers in ``core/distributed/topology/``).
+
+No server: every client keeps its own model; a round = local SGD on every
+client + neighbor gossip mixing x ← W x (W = topology mixing matrix).  On
+the stacked client tree the gossip step is ONE einsum per leaf — and on the
+mesh engine the same contraction rides ICI as a ``ppermute`` ring when W is
+a ring matrix.  Push-sum (asymmetric W) tracks the scalar weight ω alongside.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import rng as rng_util
+from ...core import tree as tree_util
+from ...core.distributed.topology.topology_manager import (
+    AsymmetricTopologyManager, SymmetricTopologyManager)
+from ...ml.trainer.local_trainer import LocalTrainer, ServerCtx
+from ..round_engine import next_pow2
+
+
+class DecentralizedFedAPI:
+    """All-client DSGD simulator; exposes evaluate() over the client-average
+    (the consensus estimate)."""
+
+    def __init__(self, args, device, dataset, model):
+        self.args = args
+        self.dataset = dataset
+        self.model = model
+        self.seed = int(getattr(args, "random_seed", 0))
+        self.batch_size = int(getattr(args, "batch_size", 10))
+        self.epochs = int(getattr(args, "epochs", 1))
+        self.comm_rounds = int(getattr(args, "comm_round", 10))
+        self.n = int(getattr(args, "client_num_in_total", 8))
+        topo = str(getattr(args, "topology", "symmetric")).lower()
+        nbrs = int(getattr(args, "topology_neighbors", 2))
+        mgr = (SymmetricTopologyManager(self.n, nbrs) if topo == "symmetric"
+               else AsymmetricTopologyManager(self.n, nbrs))
+        self.W = jnp.asarray(mgr.mixing_matrix())
+        self.push_sum = topo == "asymmetric"
+
+        self.trainer = LocalTrainer(model, args)
+        key = rng_util.root_key(self.seed)
+        params0 = model.init(rng_util.purpose_key(key, "init"))
+        # every client starts from the same init (reference does likewise)
+        self.params = tree_util.tree_stack([params0] * self.n)
+        self.omega = jnp.ones(self.n)
+        local_train = self.trainer.make_local_train()
+
+        def round_fn(stacked_params, omega, x, y, mask, rngs):
+            def per_client(p, xb, yb, mb, rng):
+                ctx = ServerCtx(global_params=p)
+                return local_train(p, xb, yb, mb, rng, ctx, None)
+            outs = jax.vmap(per_client)(stacked_params, x, y, mask, rngs)
+            # gossip: x ← W x  (one einsum per leaf, MXU-friendly)
+            mixed = jax.tree_util.tree_map(
+                lambda l: jnp.einsum("ij,j...->i...", self.W,
+                                     l.astype(jnp.float32)).astype(l.dtype),
+                outs.params)
+            new_omega = self.W @ omega
+            return mixed, new_omega, jnp.mean(outs.loss)
+
+        self.round_fn = jax.jit(round_fn)
+
+    def train_one_round(self, round_idx: int):
+        clients = np.arange(self.n)
+        x, y, mask, w = self.dataset.cohort_batches(
+            clients, self.batch_size, self.seed, round_idx, self.epochs)
+        steps = next_pow2(x.shape[1])
+        pad = steps - x.shape[1]
+        if pad:
+            x = np.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
+            y = np.pad(y, [(0, 0), (0, pad)] + [(0, 0)] * (y.ndim - 2))
+            mask = np.pad(mask, [(0, 0), (0, pad)])
+        key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
+        rngs = jax.random.split(key, self.n)
+        self.params, self.omega, loss = self.round_fn(
+            self.params, self.omega, jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(mask), rngs)
+        return {"train_loss": loss}
+
+    def consensus_params(self):
+        """De-biased average (push-sum divides by ω)."""
+        if self.push_sum:
+            ratio = jax.tree_util.tree_map(
+                lambda l: l / self.omega.reshape((-1,) + (1,) * (l.ndim - 1)),
+                self.params)
+            return tree_util.stacked_weighted_average(ratio, jnp.ones(self.n))
+        return tree_util.stacked_weighted_average(self.params, jnp.ones(self.n))
+
+    def evaluate(self):
+        xb, yb, mb = self.dataset.test_batches()
+        return self.trainer.evaluate(self.consensus_params(), xb, yb, mb)
+
+    def train(self):
+        for r in range(self.comm_rounds):
+            self.train_one_round(r)
+        return self.consensus_params()

@@ -1,0 +1,69 @@
+"""Hierarchical FL (reference ``simulation/sp/hierarchical_fl/trainer.py:10``:
+``Group``-wise FedAvg every ``group_comm_round`` rounds, then global merge;
+cross-silo flavor = silo-internal DDP then cross-silo FedAvg).
+
+TPU-native: groups are a reshape of the client axis.  A global round runs
+``group_comm_round`` inner rounds where each group merges only its own
+members (a masked segment-mean over the stacked client outputs), then one
+outer merge.  On a pod this maps to the two-level mesh (ICI within a slice =
+group, DCN across) by sharding the group axis.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ...core import tree as tree_util
+from .fedavg_api import FedAvgAPI
+
+
+class HierarchicalFedAvgAPI(FedAvgAPI):
+    def __init__(self, args, device, dataset, model, client_mode: str = "vmap"):
+        super().__init__(args, device, dataset, model, client_mode)
+        self.group_num = int(getattr(args, "group_num", 2))
+        self.group_comm_round = int(getattr(args, "group_comm_round", 2))
+
+    def _group_of(self, clients: np.ndarray) -> np.ndarray:
+        """Static client→group assignment (reference partitions clients into
+        Groups once at setup)."""
+        return np.asarray(clients) % self.group_num
+
+    def train_one_round(self, round_idx: int):
+        """One *global* round = group_comm_round inner rounds of group-local
+        FedAvg + a final global merge of group models."""
+        clients = self._client_sampling(round_idx)
+        groups = self._group_of(clients)
+        # group models start from the global model
+        group_params = [self.state.global_params for _ in range(self.group_num)]
+        group_weights = np.zeros(self.group_num, dtype=np.float32)
+        metrics = None
+        for inner in range(self.group_comm_round):
+            for g in range(self.group_num):
+                members = clients[groups == g]
+                if len(members) == 0:
+                    continue
+                x, y, mask, w = self.dataset.cohort_batches(
+                    members, self.batch_size, self.seed,
+                    round_idx * self.group_comm_round + inner, self.epochs)
+                import jax
+                import jax.numpy as jnp
+                from ...core import rng as rng_util
+                key = rng_util.round_key(
+                    rng_util.root_key(self.seed),
+                    (round_idx * self.group_comm_round + inner) * 131 + g)
+                rngs = jax.random.split(key, len(members))
+                state_g = self.state.replace(global_params=group_params[g])
+                state_g, metrics, outs = self.round_fn(
+                    state_g, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                    jnp.asarray(w), rngs, None)
+                group_params[g] = state_g.global_params
+                group_weights[g] = float(np.sum(w))
+        live = group_weights > 0
+        merged = tree_util.weighted_average(
+            [p for p, l in zip(group_params, live) if l],
+            group_weights[live])
+        self.state = self.state.replace(global_params=merged,
+                                        round_idx=self.state.round_idx + 1)
+        return metrics if metrics is not None else {"train_loss": float("nan")}

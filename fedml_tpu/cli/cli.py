@@ -1,0 +1,141 @@
+"""`fedml` CLI (reference ``python/fedml/cli/cli.py:18-77``: click command
+tree — login, launch, run, build, env, version, ...).
+
+The TPU build keeps the commands whose behavior is local (launch/run/build/
+env/version/simulate/analyze); cloud-account commands (login/logout/cluster)
+manage a local credentials file and are backend-agnostic — no vendor cloud
+is baked in (SURVEY §7 hard parts: broker/store endpoints are plain config).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import zipfile
+
+import click
+import yaml
+
+
+@click.group()
+def cli():
+    """fedml_tpu — TPU-native federated learning."""
+
+
+@cli.command()
+def version():
+    import fedml_tpu
+    click.echo(f"fedml_tpu {fedml_tpu.__version__}")
+
+
+@cli.command()
+def env():
+    """Device/runtime report (reference `fedml env`)."""
+    import jax
+    import fedml_tpu
+    click.echo(f"fedml_tpu {fedml_tpu.__version__}")
+    click.echo(f"jax {jax.__version__} backend={jax.default_backend()}")
+    for d in jax.devices():
+        click.echo(f"  device: {d}")
+
+
+@cli.command()
+@click.option("--api-key", "-k", default="", help="platform API key")
+@click.option("--endpoint", "-e", default="", help="control-plane endpoint")
+def login(api_key, endpoint):
+    """Bind this machine (reference `fedml login`); stores plain local
+    config instead of a vendor backend handshake."""
+    cfg_dir = os.path.expanduser("~/.fedml_tpu")
+    os.makedirs(cfg_dir, exist_ok=True)
+    with open(os.path.join(cfg_dir, "credentials.json"), "w") as f:
+        json.dump({"api_key": api_key, "endpoint": endpoint}, f)
+    click.echo("device bound (local credentials saved)")
+
+
+@cli.command()
+def logout():
+    path = os.path.expanduser("~/.fedml_tpu/credentials.json")
+    if os.path.exists(path):
+        os.remove(path)
+    click.echo("logged out")
+
+
+@cli.command()
+@click.argument("job_yaml", type=click.Path(exists=True))
+def launch(job_yaml):
+    """Run a job YAML (reference `fedml launch job.yaml`; schema:
+    workspace/job/bootstrap, examples/launch/hello_job.yaml).  Executes
+    locally: bootstrap then job script inside the workspace."""
+    with open(job_yaml) as f:
+        spec = yaml.safe_load(f) or {}
+    workspace = spec.get("workspace", ".")
+    base = os.path.dirname(os.path.abspath(job_yaml))
+    wdir = os.path.join(base, workspace)
+    for phase in ("bootstrap", "job"):
+        script = spec.get(phase)
+        if not script:
+            continue
+        click.echo(f"[{phase}] {script}")
+        proc = subprocess.run(["bash", "-c", script], cwd=wdir)
+        if proc.returncode != 0:
+            raise click.ClickException(
+                f"{phase} failed with exit {proc.returncode}")
+    click.echo("job finished")
+
+
+@cli.command()
+@click.option("--source", "-s", required=True, type=click.Path(exists=True))
+@click.option("--dest", "-d", default="./job_package.zip")
+def build(source, dest):
+    """Package a workspace (reference `fedml build`)."""
+    with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _, files in os.walk(source):
+            for name in files:
+                p = os.path.join(root, name)
+                z.write(p, os.path.relpath(p, source))
+    click.echo(f"built {dest}")
+
+
+@cli.command()
+@click.option("--cf", "config_file", default="", help="config yaml")
+@click.option("--backend", default="sp", type=click.Choice(
+    ["sp", "mesh", "MPI", "NCCL"]))
+def simulate(config_file, backend):
+    """Run a federated simulation (reference `fedml run` simulation path)."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+
+    args = load_arguments()
+    if config_file:
+        args.load_yaml_config(config_file)
+    fedml_tpu.init(args)
+    fedml_tpu.run_simulation(backend=backend, args=args)
+
+
+@cli.command()
+@click.option("--task", required=True)
+@click.option("--data-file", type=click.Path(exists=True), required=True,
+              help="json: {client_id: [values...]}")
+@click.option("--rounds", default=1)
+def analyze(task, data_file, rounds):
+    """Federated analytics (reference `fedml federate`/FA path)."""
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu.fa.runner import FARunner
+
+    with open(data_file) as f:
+        data = {int(k): v for k, v in json.load(f).items()}
+    args = load_arguments().update(fa_task=task, fa_round=rounds)
+    result = FARunner(args, data).run()
+    click.echo(json.dumps({"task": task, "result":
+                           sorted(result) if isinstance(result, set)
+                           else result}, default=str))
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

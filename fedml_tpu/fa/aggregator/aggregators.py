@@ -1,0 +1,97 @@
+"""FA server aggregators (reference ``python/fedml/fa/aggregator/*.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from ..base_frame import FAServerAggregator
+
+
+class AvgAggregator(FAServerAggregator):
+    def aggregate(self, local_submission_list: List[Tuple[float, Any]]):
+        total = sum(s for _, (s, n) in local_submission_list)
+        count = sum(n for _, (s, n) in local_submission_list)
+        self.set_server_data(total / max(count, 1))
+        return self.get_server_data()
+
+
+class UnionAggregator(FAServerAggregator):
+    def aggregate(self, local_submission_list):
+        out = set()
+        for _, s in local_submission_list:
+            out |= s
+        self.set_server_data(out)
+        return out
+
+
+class IntersectionAggregator(FAServerAggregator):
+    def aggregate(self, local_submission_list):
+        sets = [s for _, s in local_submission_list]
+        out = set.intersection(*sets) if sets else set()
+        self.set_server_data(out)
+        return out
+
+
+class KPercentileAggregator(FAServerAggregator):
+    """Distributed k-percentile by bisection over candidate values
+    (reference k_percentile_aggregator): each FA round refines [lo, hi]."""
+
+    def __init__(self, args=None):
+        super().__init__(args)
+        self.k = float(getattr(args, "fa_k_percentile", 50.0))
+        self.lo = self.hi = None
+        self.init_msg = None
+
+    def aggregate(self, local_submission_list):
+        subs = [s for _, s in local_submission_list]
+        if self.lo is None:  # first round returns (min, max) ranges
+            self.lo = min(s[0] for s in subs)
+            self.hi = max(s[1] for s in subs)
+        else:
+            below = sum(s[0] for s in subs)
+            total = sum(s[1] for s in subs)
+            mid = self.init_msg
+            if below / max(total, 1) * 100.0 < self.k:
+                self.lo = mid
+            else:
+                self.hi = mid
+        self.init_msg = 0.5 * (self.lo + self.hi)  # next candidate
+        self.set_server_data(self.init_msg)
+        return self.init_msg
+
+
+class FrequencyEstimationAggregator(FAServerAggregator):
+    def aggregate(self, local_submission_list):
+        hists = [np.asarray(s, dtype=np.float64)
+                 for _, s in local_submission_list]
+        total = np.sum(hists, axis=0)
+        freq = total / max(total.sum(), 1.0)
+        self.set_server_data(freq)
+        return freq
+
+
+class HeavyHitterTrieHHAggregator(FAServerAggregator):
+    """TrieHH (reference heavy_hitter_triehh_aggregator.py): votes above a
+    DP-calibrated threshold θ extend the trie one character per FA round."""
+
+    def __init__(self, args=None):
+        super().__init__(args)
+        self.theta = int(getattr(args, "fa_triehh_theta", 2))
+        self.max_len = int(getattr(args, "fa_heavy_hitter_max_len", 8))
+        self.depth = 1
+        self.trie = {""}
+        self.init_msg = (self.depth, self.trie)
+
+    def aggregate(self, local_submission_list):
+        votes: dict = {}
+        for _, sub in local_submission_list:
+            for prefix, c in sub.items():
+                votes[prefix] = votes.get(prefix, 0) + c
+        accepted = {p for p, c in votes.items() if c >= self.theta}
+        self.trie |= accepted
+        self.depth = min(self.depth + 1, self.max_len)
+        self.init_msg = (self.depth, self.trie)
+        self.set_server_data(sorted(accepted))
+        return sorted(accepted)

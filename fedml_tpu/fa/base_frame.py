@@ -1,0 +1,55 @@
+"""Federated analytics frame (reference ``python/fedml/fa/base_frame/``:
+``FAClientAnalyzer`` / ``FAServerAggregator`` — the FL-shaped pair for
+analytics instead of training)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Tuple
+
+
+class FAClientAnalyzer(abc.ABC):
+    def __init__(self, args=None):
+        self.args = args
+        self.client_submission = None
+        self.init_msg = None
+        self.id = 0
+
+    def set_id(self, analyzer_id):
+        self.id = analyzer_id
+
+    def get_client_submission(self):
+        return self.client_submission
+
+    def set_client_submission(self, value):
+        self.client_submission = value
+
+    def set_init_msg(self, init_msg):
+        self.init_msg = init_msg
+
+    def get_init_msg(self):
+        return self.init_msg
+
+    @abc.abstractmethod
+    def local_analyze(self, train_data, args):
+        ...
+
+
+class FAServerAggregator(abc.ABC):
+    def __init__(self, args=None):
+        self.args = args
+        self.server_data = None
+        self.init_msg = None
+
+    def get_server_data(self):
+        return self.server_data
+
+    def set_server_data(self, value):
+        self.server_data = value
+
+    def get_init_msg(self):
+        return self.init_msg
+
+    @abc.abstractmethod
+    def aggregate(self, local_submission_list: List[Tuple[float, Any]]):
+        ...

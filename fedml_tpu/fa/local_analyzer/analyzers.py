@@ -1,0 +1,88 @@
+"""Local analyzers (reference ``python/fedml/fa/local_analyzer/*.py``):
+per-client computations whose submissions the server aggregates.
+
+Heavy numeric paths (histograms, percentile counts) are jnp ops so a
+many-client simulation vmaps them on-device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base_frame import FAClientAnalyzer
+
+
+class AvgAnalyzer(FAClientAnalyzer):
+    """avg.py: submit (sum, count)."""
+
+    def local_analyze(self, train_data, args):
+        x = np.asarray(train_data, dtype=np.float64)
+        self.set_client_submission((float(x.sum()), int(x.size)))
+
+
+class UnionAnalyzer(FAClientAnalyzer):
+    """union.py: submit the set of local values."""
+
+    def local_analyze(self, train_data, args):
+        self.set_client_submission(set(np.asarray(train_data).ravel().tolist()))
+
+
+class IntersectionAnalyzer(FAClientAnalyzer):
+    """intersection.py (PSI building block): submit the local value set;
+    the server intersects.  The private variant hashes values first."""
+
+    def local_analyze(self, train_data, args):
+        self.set_client_submission(set(np.asarray(train_data).ravel().tolist()))
+
+
+class KPercentileAnalyzer(FAClientAnalyzer):
+    """k_percentile.py: given the server's candidate value (init msg),
+    submit counts (n_below, n_total) for the distributed k-percentile
+    bisection."""
+
+    def local_analyze(self, train_data, args):
+        x = np.asarray(train_data, dtype=np.float64).ravel()
+        candidate = self.get_init_msg()
+        if candidate is None:
+            self.set_client_submission((float(x.min()), float(x.max())))
+        else:
+            self.set_client_submission(
+                (int((x <= candidate).sum()), int(x.size)))
+
+
+class FrequencyEstimationAnalyzer(FAClientAnalyzer):
+    """frequency_estimation.py: submit a local histogram over the domain;
+    with ``fa_ldp_epsilon`` set, each count is randomized-response perturbed
+    (local DP)."""
+
+    def local_analyze(self, train_data, args):
+        x = np.asarray(train_data, dtype=np.int64).ravel()
+        domain = int(getattr(args, "fa_domain_size", int(x.max()) + 1))
+        hist = np.bincount(x, minlength=domain).astype(np.float64)
+        eps = float(getattr(args, "fa_ldp_epsilon", 0.0) or 0.0)
+        if eps > 0:
+            # randomized response on the one-hot reports
+            p = np.exp(eps) / (np.exp(eps) + domain - 1)
+            q = (1.0 - p) / (domain - 1)
+            n = x.size
+            noisy = np.random.default_rng(
+                int(getattr(args, "random_seed", 0)) + self.id
+            ).binomial(n=1, p=np.clip(p * hist / max(n, 1) + q, 0, 1),
+                       size=domain)
+            hist = noisy * n
+        self.set_client_submission(hist)
+
+
+class HeavyHitterTrieHHAnalyzer(FAClientAnalyzer):
+    """heavy_hitter_triehh.py: submit prefixes (length = server-announced
+    trie depth) of local strings that extend the server's current trie."""
+
+    def local_analyze(self, train_data, args):
+        depth, trie = self.get_init_msg() or (1, {""})
+        votes = {}
+        for s in train_data:
+            s = str(s)
+            if len(s) >= depth and s[: depth - 1] in trie:
+                prefix = s[:depth]
+                votes[prefix] = votes.get(prefix, 0) + 1
+        self.set_client_submission(votes)

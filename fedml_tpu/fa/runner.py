@@ -1,0 +1,62 @@
+"""FARunner — federated-analytics driver (reference ``fa/runner.py:5`` +
+``fa/simulation/sp/simulator.py:9`` ``FASimulatorSingleProcess``).
+
+Dispatches ``args.fa_task`` over the analyzer/aggregator zoo and loops
+FA rounds: server init-msg → client local_analyze over their shard →
+aggregate.  Data: any per-client list/array dict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .aggregator.aggregators import (AvgAggregator,
+                                     FrequencyEstimationAggregator,
+                                     HeavyHitterTrieHHAggregator,
+                                     IntersectionAggregator,
+                                     KPercentileAggregator, UnionAggregator)
+from .local_analyzer.analyzers import (AvgAnalyzer,
+                                       FrequencyEstimationAnalyzer,
+                                       HeavyHitterTrieHHAnalyzer,
+                                       IntersectionAnalyzer,
+                                       KPercentileAnalyzer, UnionAnalyzer)
+
+_TASKS = {
+    "avg": (AvgAnalyzer, AvgAggregator),
+    "union": (UnionAnalyzer, UnionAggregator),
+    "intersection": (IntersectionAnalyzer, IntersectionAggregator),
+    "k_percentile": (KPercentileAnalyzer, KPercentileAggregator),
+    "frequency_estimation": (FrequencyEstimationAnalyzer,
+                             FrequencyEstimationAggregator),
+    "heavy_hitter": (HeavyHitterTrieHHAnalyzer, HeavyHitterTrieHHAggregator),
+    "heavy_hitter_triehh": (HeavyHitterTrieHHAnalyzer,
+                            HeavyHitterTrieHHAggregator),
+}
+
+
+class FARunner:
+    def __init__(self, args, client_datasets: Dict[int, Sequence]):
+        task = str(getattr(args, "fa_task", "avg")).lower()
+        if task not in _TASKS:
+            raise ValueError(f"unknown fa_task {task!r}; have {sorted(_TASKS)}")
+        analyzer_cls, aggregator_cls = _TASKS[task]
+        self.args = args
+        self.client_datasets = client_datasets
+        self.analyzers = {c: analyzer_cls(args) for c in client_datasets}
+        for c, a in self.analyzers.items():
+            a.set_id(c)
+        self.aggregator = aggregator_cls(args)
+        self.rounds = int(getattr(args, "fa_round", getattr(args, "comm_round", 1)))
+
+    def run(self):
+        result = None
+        for r in range(self.rounds):
+            submissions = []
+            for c, analyzer in self.analyzers.items():
+                analyzer.set_init_msg(self.aggregator.get_init_msg())
+                analyzer.local_analyze(self.client_datasets[c], self.args)
+                submissions.append(
+                    (len(self.client_datasets[c]),
+                     analyzer.get_client_submission()))
+            result = self.aggregator.aggregate(submissions)
+        return result

@@ -1,0 +1,18 @@
+"""FedMLPredictor ABC — serving surface parity (reference
+``python/fedml/serving/fedml_predictor.py:4``)."""
+
+from __future__ import annotations
+
+import abc
+
+
+class FedMLPredictor(abc.ABC):
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def predict(self, *args, **kwargs):
+        ...
+
+    def ready(self) -> bool:
+        return True

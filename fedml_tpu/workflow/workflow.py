@@ -1,0 +1,120 @@
+"""Workflow DAG (reference ``python/fedml/workflow/workflow.py:42`` +
+``jobs.py:43``): toposorted Job graph with dependency-gated execution and an
+optional loop mode."""
+
+from __future__ import annotations
+
+import abc
+import enum
+import logging
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class JobStatus(enum.Enum):
+    PROVISIONING = "PROVISIONING"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    UNDETERMINED = "UNDETERMINED"
+
+
+class Job(abc.ABC):
+    def __init__(self, name: str):
+        self.name = name
+        self.status = JobStatus.PROVISIONING
+        self.output: Any = None
+        self.input: Dict[str, Any] = {}
+
+    @abc.abstractmethod
+    def run(self):
+        ...
+
+    def kill(self):
+        pass
+
+    def status_of(self) -> JobStatus:
+        return self.status
+
+    def append_input(self, dependency_output):
+        self.input[dependency_output[0]] = dependency_output[1]
+
+
+class PyJob(Job):
+    """Convenience job wrapping a python callable (the TPU build's
+    equivalent of the reference's customized_jobs/ for local pipelines)."""
+
+    def __init__(self, name: str, fn, **kwargs):
+        super().__init__(name)
+        self.fn = fn
+        self.kwargs = kwargs
+
+    def run(self):
+        self.status = JobStatus.RUNNING
+        try:
+            self.output = self.fn(self.input, **self.kwargs)
+            self.status = JobStatus.FINISHED
+        except Exception:
+            self.status = JobStatus.FAILED
+            raise
+
+
+class Workflow:
+    """Reference surface: ``add_job(job, dependencies=[...])`` + ``run()``."""
+
+    def __init__(self, name: str = "workflow", loop: bool = False):
+        self.name = name
+        self.loop = loop
+        self.jobs: Dict[str, Job] = {}
+        self.deps: Dict[str, List[str]] = {}
+
+    def add_job(self, job: Job, dependencies: Optional[List[Job]] = None):
+        if job.name in self.jobs:
+            raise ValueError(f"duplicate job name {job.name!r}")
+        self.jobs[job.name] = job
+        self.deps[job.name] = [d.name for d in (dependencies or [])]
+        for d in self.deps[job.name]:
+            if d not in self.jobs:
+                raise ValueError(f"dependency {d!r} added after/never")
+        return self
+
+    def topological_order(self) -> List[str]:
+        indeg = {n: len(ds) for n, ds in self.deps.items()}
+        children = defaultdict(list)
+        for n, ds in self.deps.items():
+            for d in ds:
+                children[d].append(n)
+        q = deque(sorted(n for n, k in indeg.items() if k == 0))
+        order = []
+        while q:
+            n = q.popleft()
+            order.append(n)
+            for c in children[n]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    q.append(c)
+        if len(order) != len(self.jobs):
+            raise ValueError("workflow has a dependency cycle")
+        return order
+
+    def run(self):
+        order = self.topological_order()
+        while True:
+            for name in order:
+                job = self.jobs[name]
+                for d in self.deps[name]:
+                    dep = self.jobs[d]
+                    if dep.status is not JobStatus.FINISHED:
+                        raise RuntimeError(
+                            f"job {name} dependency {d} not finished "
+                            f"({dep.status})")
+                    job.append_input((d, dep.output))
+                log.info("workflow %s: running job %s", self.name, name)
+                job.run()
+                if job.status is JobStatus.FAILED:
+                    raise RuntimeError(f"job {name} failed")
+            if not self.loop:
+                break
+        return {n: self.jobs[n].output for n in order}

@@ -1,0 +1,174 @@
+"""Aux planes: checkpoint/resume, FA engine, serving HTTP runner, workflow
+DAG, scheduler, CLI."""
+
+import json
+import os
+import tempfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+
+
+def test_checkpoint_resume_identical():
+    """Training 6 rounds straight == training 3, resuming from checkpoint,
+    training 3 more (bitwise server params)."""
+    import jax
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    def args_for(rounds, ckpt):
+        args = load_arguments()
+        args.update(dataset="synthetic", num_classes=10, input_shape=(14, 14, 1),
+                    train_size=512, test_size=64, model="lr",
+                    client_num_in_total=8, client_num_per_round=4,
+                    comm_round=rounds, batch_size=16, learning_rate=0.1,
+                    random_seed=21, frequency_of_the_test=100,
+                    checkpoint_dir=ckpt, checkpoint_freq=3)
+        return fedml_tpu.init(args)
+
+    def build(rounds, ckpt):
+        args = args_for(rounds, ckpt)
+        ds, out = data_mod.load(args)
+        model = model_mod.create(args, out)
+        return FedAvgAPI(args, None, ds, model)
+
+    straight = build(6, None)
+    straight.train()
+
+    ckpt_dir = tempfile.mkdtemp()
+    first = build(3, ckpt_dir)
+    first.train()
+    resumed = build(6, ckpt_dir)
+    start = resumed.maybe_resume()
+    assert start == 3
+    resumed2 = build(6, ckpt_dir)  # train() resumes internally
+    resumed2.train()
+    a = jax.tree_util.tree_leaves(straight.state.global_params)
+    b = jax.tree_util.tree_leaves(resumed2.state.global_params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
+def test_fa_tasks():
+    from fedml_tpu.fa.runner import FARunner
+
+    data = {0: [1.0, 2.0, 3.0], 1: [5.0], 2: [4.0, 6.0]}
+    args = load_arguments().update(fa_task="avg", fa_round=1)
+    assert abs(FARunner(args, data).run() - 3.5) < 1e-9
+
+    sets = {0: [1, 2, 3], 1: [2, 3, 4], 2: [3, 4, 5]}
+    args = load_arguments().update(fa_task="union", fa_round=1)
+    assert FARunner(args, sets).run() == {1, 2, 3, 4, 5}
+    args = load_arguments().update(fa_task="intersection", fa_round=1)
+    assert FARunner(args, sets).run() == {3}
+
+    rng = np.random.default_rng(0)
+    vals = {c: rng.normal(size=200).tolist() for c in range(5)}
+    args = load_arguments().update(fa_task="k_percentile", fa_k_percentile=50,
+                                   fa_round=25)
+    med = FARunner(args, vals).run()
+    allv = np.concatenate([np.asarray(v) for v in vals.values()])
+    assert abs(med - np.median(allv)) < 0.05
+
+    counts = {c: (rng.integers(0, 4, size=100).tolist()) for c in range(3)}
+    args = load_arguments().update(fa_task="frequency_estimation", fa_round=1,
+                                   fa_domain_size=4)
+    freq = FARunner(args, counts).run()
+    assert abs(freq.sum() - 1.0) < 1e-9 and len(freq) == 4
+
+    words = {0: ["apple", "apply", "angle"], 1: ["apple", "apply"],
+             2: ["apple", "bear"]}
+    args = load_arguments().update(fa_task="heavy_hitter", fa_round=6,
+                                   fa_triehh_theta=2)
+    FARunner(args, words).run()
+
+
+def test_serving_http_runner():
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+    from fedml_tpu.serving.fedml_inference_runner import FedMLInferenceRunner
+
+    class Echo(FedMLPredictor):
+        def predict(self, request):
+            return {"echo": request.get("text", ""), "n": len(request)}
+
+    runner = FedMLInferenceRunner(Echo(), host="127.0.0.1", port=0)
+    port = runner.start()
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/ready") as r:
+        assert json.load(r)["ready"] is True
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"text": "hi"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        out = json.load(r)
+    assert out["result"]["echo"] == "hi"
+    runner.stop()
+
+
+def test_workflow_dag():
+    from fedml_tpu.workflow.workflow import PyJob, Workflow
+
+    wf = Workflow("t")
+    a = PyJob("a", lambda inp: 2)
+    b = PyJob("b", lambda inp: inp["a"] + 3)
+    c = PyJob("c", lambda inp: inp["a"] * inp["b"])
+    wf.add_job(a)
+    wf.add_job(b, dependencies=[a])
+    wf.add_job(c, dependencies=[a, b])
+    out = wf.run()
+    assert out == {"a": 2, "b": 5, "c": 10}
+
+    # cycle detection
+    wf2 = Workflow("cyc")
+    x = PyJob("x", lambda inp: 0)
+    wf2.add_job(x)
+    wf2.deps["x"] = ["x"]
+    with pytest.raises(ValueError):
+        wf2.topological_order()
+
+
+def test_seq_train_scheduler():
+    from fedml_tpu.core.schedule.seq_train_scheduler import (
+        RuntimeEstimator, SeqTrainScheduler)
+
+    est = RuntimeEstimator()
+    rng = np.random.default_rng(1)
+    for c in range(10):
+        n = int(rng.integers(50, 500))
+        est.record(c, n, 0.01 * n + 0.5 + rng.normal() * 0.01)
+    a, b = est.fit()
+    assert abs(a - 0.01) < 0.002 and abs(b - 0.5) < 0.2
+
+    sizes = [100, 90, 80, 10, 10, 10, 10, 10]
+    sched = SeqTrainScheduler(sizes, 4, a=1.0, b=0.0)
+    assignment = sched.schedule()
+    assert sorted(c for dev in assignment for c in dev) == list(range(8))
+    assert sched.makespan(assignment) <= 110  # LPT bound ~ 100
+
+
+def test_cli_commands():
+    from click.testing import CliRunner
+    from fedml_tpu.cli.cli import cli
+
+    r = CliRunner().invoke(cli, ["version"])
+    assert r.exit_code == 0 and "fedml_tpu" in r.output
+
+    with tempfile.TemporaryDirectory() as d:
+        job = os.path.join(d, "job.yaml")
+        with open(job, "w") as f:
+            f.write("workspace: .\njob: echo hello_from_job > out.txt\n")
+        r = CliRunner().invoke(cli, ["launch", job])
+        assert r.exit_code == 0, r.output
+        assert open(os.path.join(d, "out.txt")).read().strip() == "hello_from_job"
+
+        data = os.path.join(d, "data.json")
+        with open(data, "w") as f:
+            json.dump({"0": [1, 2], "1": [2, 3]}, f)
+        r = CliRunner().invoke(cli, ["analyze", "--task", "union",
+                                     "--data-file", data])
+        assert r.exit_code == 0, r.output
+        assert json.loads(r.output)["result"] == [1, 2, 3]

@@ -1,0 +1,47 @@
+"""Edge bundle — the portable model/data format shared between the Python
+server and the C++ edge trainer (the role the MNN graph file plays in the
+reference: ``model/model_hub.py:81-88`` writes ``.mnn`` for phones).
+
+Binary layout (little-endian): magic "FTEB" u32, count u32, then per tensor:
+name_len u32, name bytes, ndim u32, dims i64[ndim], f32 data.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict
+
+import numpy as np
+
+MAGIC = 0x46544542
+
+
+def write_bundle(path: str, tensors: Dict[str, np.ndarray]):
+    with open(path, "wb") as f:
+        f.write(struct.pack("<II", MAGIC, len(tensors)))
+        for name, arr in tensors.items():
+            arr = np.ascontiguousarray(arr, dtype=np.float32)
+            nb = name.encode()
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<I", arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack("<q", d))
+            f.write(arr.tobytes())
+
+
+def read_bundle(path: str) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        magic, count = struct.unpack("<II", f.read(8))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not an edge bundle")
+        for _ in range(count):
+            (name_len,) = struct.unpack("<I", f.read(4))
+            name = f.read(name_len).decode()
+            (ndim,) = struct.unpack("<I", f.read(4))
+            dims = [struct.unpack("<q", f.read(8))[0] for _ in range(ndim)]
+            n = int(np.prod(dims)) if dims else 1
+            data = np.frombuffer(f.read(4 * n), dtype=np.float32).reshape(dims)
+            out[name] = data.copy()
+    return out

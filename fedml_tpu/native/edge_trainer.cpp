@@ -1,0 +1,323 @@
+// Edge trainer core — C++ equivalent of the reference's MobileNN SDK
+// (android/fedmlsdk/MobileNN: FedMLClientManager.h:6 ->
+//  FedMLBaseTrainer -> FedMLMNNTrainer / FedMLTorchTrainer,
+//  src/train/FedMLMNNTrainer.cpp:3-80), exposing the same manager surface
+// (init / train / getEpochAndLoss / stopTraining) over a C ABI consumed by
+// ctypes (no pybind11 in this image) and by mobile JNI alike.
+//
+// The on-device model is a 1-hidden-layer MLP (hidden=0 => logistic
+// regression — the reference's MNN lenet/LR class of edge models), trained
+// with minibatch SGD + cross-entropy on a binary "edge bundle"
+// (fedml_tpu/native/edge_bundle.py writes/reads the same format).
+// LightSecAgg masking (reference MobileNN/src/security/LightSecAgg.cpp) is
+// provided as field-arithmetic mask/unmask entry points.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <cstdlib>
+#include <string>
+#include <vector>
+#include <atomic>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x46544542;  // "FTEB" little-endian-ish tag
+constexpr long long kPrime = (1LL << 31) - 1;
+
+struct Tensor {
+  std::string name;
+  std::vector<int64_t> dims;
+  std::vector<float> data;
+  int64_t size() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+};
+
+struct Bundle {
+  std::vector<Tensor> tensors;
+  Tensor* find(const char* name) {
+    for (auto& t : tensors)
+      if (t.name == name) return &t;
+    return nullptr;
+  }
+};
+
+bool read_bundle(const char* path, Bundle* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  uint32_t magic = 0, count = 0;
+  if (std::fread(&magic, 4, 1, f) != 1 || magic != kMagic) { std::fclose(f); return false; }
+  if (std::fread(&count, 4, 1, f) != 1) { std::fclose(f); return false; }
+  out->tensors.resize(count);
+  for (auto& t : out->tensors) {
+    uint32_t name_len = 0, ndim = 0;
+    if (std::fread(&name_len, 4, 1, f) != 1) { std::fclose(f); return false; }
+    t.name.resize(name_len);
+    if (name_len && std::fread(&t.name[0], 1, name_len, f) != name_len) { std::fclose(f); return false; }
+    if (std::fread(&ndim, 4, 1, f) != 1) { std::fclose(f); return false; }
+    t.dims.resize(ndim);
+    for (auto& d : t.dims) {
+      int64_t v;
+      if (std::fread(&v, 8, 1, f) != 1) { std::fclose(f); return false; }
+      d = v;
+    }
+    t.data.resize(t.size());
+    if (t.size() && std::fread(t.data.data(), 4, t.size(), f) != (size_t)t.size()) {
+      std::fclose(f); return false;
+    }
+  }
+  std::fclose(f);
+  return true;
+}
+
+bool write_bundle(const char* path, const Bundle& b) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return false;
+  uint32_t count = (uint32_t)b.tensors.size();
+  std::fwrite(&kMagic, 4, 1, f);
+  std::fwrite(&count, 4, 1, f);
+  for (const auto& t : b.tensors) {
+    uint32_t name_len = (uint32_t)t.name.size(), ndim = (uint32_t)t.dims.size();
+    std::fwrite(&name_len, 4, 1, f);
+    std::fwrite(t.name.data(), 1, name_len, f);
+    std::fwrite(&ndim, 4, 1, f);
+    for (auto d : t.dims) { int64_t v = d; std::fwrite(&v, 8, 1, f); }
+    std::fwrite(t.data.data(), 4, t.size(), f);
+  }
+  std::fclose(f);
+  return true;
+}
+
+// xorshift PRNG for shuffling + masking (deterministic per seed)
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9E3779B97F4A7C15ULL) {}
+  uint64_t next() {
+    s ^= s << 13; s ^= s >> 7; s ^= s << 17;
+    return s;
+  }
+};
+
+class EdgeTrainer {
+ public:
+  bool init(const char* model_path, const char* data_path, int batch, float lr) {
+    batch_ = batch > 0 ? batch : 32;
+    lr_ = lr > 0 ? lr : 0.05f;
+    Bundle model;
+    if (!read_bundle(model_path, &model)) return false;
+    Tensor* w1 = model.find("w1");
+    Tensor* b1 = model.find("b1");
+    if (!w1 || !b1) return false;
+    w1_ = *w1; b1_ = *b1;
+    Tensor* w2 = model.find("w2");
+    Tensor* b2 = model.find("b2");
+    has_hidden_ = (w2 != nullptr);
+    if (has_hidden_) { w2_ = *w2; b2_ = *b2; }
+    Bundle data;
+    if (!read_bundle(data_path, &data)) return false;
+    Tensor* x = data.find("x");
+    Tensor* y = data.find("y");
+    if (!x || !y || x->dims.size() != 2) return false;
+    x_ = std::move(*x);
+    y_ = std::move(*y);
+    n_ = x_.dims[0];
+    d_ = x_.dims[1];
+    if (has_hidden_) {
+      hidden_ = w1_.dims[1];
+      classes_ = w2_.dims[1];
+    } else {
+      hidden_ = 0;
+      classes_ = w1_.dims[1];
+    }
+    epoch_ = 0; loss_ = 0.f; stop_ = false;
+    return true;
+  }
+
+  // one epoch of minibatch SGD; returns mean loss
+  float run_epoch(uint64_t seed) {
+    Rng rng(seed);
+    std::vector<int64_t> order(n_);
+    for (int64_t i = 0; i < n_; ++i) order[i] = i;
+    for (int64_t i = n_ - 1; i > 0; --i) {
+      int64_t j = (int64_t)(rng.next() % (uint64_t)(i + 1));
+      std::swap(order[i], order[j]);
+    }
+    double total_loss = 0.0;
+    int64_t steps = 0;
+    std::vector<float> h(batch_ * (hidden_ ? hidden_ : 1));
+    std::vector<float> logits(batch_ * classes_);
+    std::vector<float> dlogits(batch_ * classes_);
+    std::vector<float> dh(batch_ * (hidden_ ? hidden_ : 1));
+    for (int64_t start = 0; start + batch_ <= n_ && !stop_; start += batch_) {
+      int bs = batch_;
+      // forward
+      for (int i = 0; i < bs; ++i) {
+        const float* xi = &x_.data[order[start + i] * d_];
+        if (has_hidden_) {
+          for (int64_t k = 0; k < hidden_; ++k) {
+            float acc = b1_.data[k];
+            for (int64_t j = 0; j < d_; ++j) acc += xi[j] * w1_.data[j * hidden_ + k];
+            h[i * hidden_ + k] = acc > 0 ? acc : 0;  // relu
+          }
+          for (int64_t c = 0; c < classes_; ++c) {
+            float acc = b2_.data[c];
+            for (int64_t k = 0; k < hidden_; ++k)
+              acc += h[i * hidden_ + k] * w2_.data[k * classes_ + c];
+            logits[i * classes_ + c] = acc;
+          }
+        } else {
+          for (int64_t c = 0; c < classes_; ++c) {
+            float acc = b1_.data[c];
+            for (int64_t j = 0; j < d_; ++j) acc += xi[j] * w1_.data[j * classes_ + c];
+            logits[i * classes_ + c] = acc;
+          }
+        }
+      }
+      // softmax CE + dlogits
+      for (int i = 0; i < bs; ++i) {
+        float* li = &logits[i * classes_];
+        float mx = li[0];
+        for (int64_t c = 1; c < classes_; ++c) mx = li[c] > mx ? li[c] : mx;
+        double z = 0;
+        for (int64_t c = 0; c < classes_; ++c) z += std::exp((double)(li[c] - mx));
+        int label = (int)y_.data[order[start + i]];
+        total_loss += -(li[label] - mx - std::log(z));
+        for (int64_t c = 0; c < classes_; ++c) {
+          float p = (float)(std::exp((double)(li[c] - mx)) / z);
+          dlogits[i * classes_ + c] = (p - (c == label ? 1.f : 0.f)) / bs;
+        }
+      }
+      // backward + SGD update
+      if (has_hidden_) {
+        for (int i = 0; i < bs; ++i)
+          for (int64_t k = 0; k < hidden_; ++k) {
+            float acc = 0;
+            for (int64_t c = 0; c < classes_; ++c)
+              acc += dlogits[i * classes_ + c] * w2_.data[k * classes_ + c];
+            dh[i * hidden_ + k] = h[i * hidden_ + k] > 0 ? acc : 0;
+          }
+        for (int64_t k = 0; k < hidden_; ++k)
+          for (int64_t c = 0; c < classes_; ++c) {
+            float g = 0;
+            for (int i = 0; i < bs; ++i)
+              g += h[i * hidden_ + k] * dlogits[i * classes_ + c];
+            w2_.data[k * classes_ + c] -= lr_ * g;
+          }
+        for (int64_t c = 0; c < classes_; ++c) {
+          float g = 0;
+          for (int i = 0; i < bs; ++i) g += dlogits[i * classes_ + c];
+          b2_.data[c] -= lr_ * g;
+        }
+        for (int i = 0; i < bs; ++i) {
+          const float* xi = &x_.data[order[start + i] * d_];
+          for (int64_t j = 0; j < d_; ++j)
+            for (int64_t k = 0; k < hidden_; ++k)
+              w1_.data[j * hidden_ + k] -= lr_ * xi[j] * dh[i * hidden_ + k];
+        }
+        for (int64_t k = 0; k < hidden_; ++k) {
+          float g = 0;
+          for (int i = 0; i < bs; ++i) g += dh[i * hidden_ + k];
+          b1_.data[k] -= lr_ * g;
+        }
+      } else {
+        for (int i = 0; i < bs; ++i) {
+          const float* xi = &x_.data[order[start + i] * d_];
+          for (int64_t j = 0; j < d_; ++j)
+            for (int64_t c = 0; c < classes_; ++c)
+              w1_.data[j * classes_ + c] -= lr_ * xi[j] * dlogits[i * classes_ + c];
+        }
+        for (int64_t c = 0; c < classes_; ++c) {
+          float g = 0;
+          for (int i = 0; i < bs; ++i) g += dlogits[i * classes_ + c];
+          b1_.data[c] -= lr_ * g;
+        }
+      }
+      ++steps;
+    }
+    return steps ? (float)(total_loss / (steps * batch_)) : 0.f;
+  }
+
+  int train(int epochs, uint64_t seed) {
+    for (int e = 0; e < epochs && !stop_; ++e) {
+      loss_ = run_epoch(seed + (uint64_t)e * 1315423911ULL);
+      epoch_ = e + 1;
+    }
+    return 0;
+  }
+
+  bool save(const char* path) {
+    Bundle b;
+    b.tensors.push_back(w1_);
+    b.tensors.push_back(b1_);
+    if (has_hidden_) {
+      b.tensors.push_back(w2_);
+      b.tensors.push_back(b2_);
+    }
+    return write_bundle(path, b);
+  }
+
+  void stop() { stop_ = true; }
+  int epoch() const { return epoch_; }
+  float loss() const { return loss_; }
+
+ private:
+  Tensor w1_, b1_, w2_, b2_, x_, y_;
+  bool has_hidden_ = false;
+  int64_t n_ = 0, d_ = 0, hidden_ = 0, classes_ = 0;
+  int batch_ = 32;
+  float lr_ = 0.05f;
+  int epoch_ = 0;
+  float loss_ = 0.f;
+  std::atomic<bool> stop_{false};
+};
+
+}  // namespace
+
+extern "C" {
+
+void* fedml_edge_create(const char* model_path, const char* data_path,
+                        int batch, float lr) {
+  auto* t = new EdgeTrainer();
+  if (!t->init(model_path, data_path, batch, lr)) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+int fedml_edge_train(void* mgr, int epochs, long long seed) {
+  return static_cast<EdgeTrainer*>(mgr)->train(epochs, (uint64_t)seed);
+}
+
+void fedml_edge_get_epoch_and_loss(void* mgr, int* epoch, float* loss) {
+  auto* t = static_cast<EdgeTrainer*>(mgr);
+  *epoch = t->epoch();
+  *loss = t->loss();
+}
+
+int fedml_edge_save_model(void* mgr, const char* path) {
+  return static_cast<EdgeTrainer*>(mgr)->save(path) ? 0 : 1;
+}
+
+void fedml_edge_stop_training(void* mgr) {
+  static_cast<EdgeTrainer*>(mgr)->stop();
+}
+
+void fedml_edge_destroy(void* mgr) { delete static_cast<EdgeTrainer*>(mgr); }
+
+// LightSecAgg field masking (reference MobileNN LightSecAgg.cpp): adds a
+// PRG mask (mod p) in-place; unmask with sign=-1 and the same seed.
+void fedml_lsa_mask(long long* data, long long n, long long seed, int sign) {
+  Rng rng((uint64_t)seed * 2654435761ULL + 0x1B5AULL);
+  for (long long i = 0; i < n; ++i) {
+    long long m = (long long)(rng.next() % (uint64_t)kPrime);
+    long long v = (data[i] + (long long)sign * m) % kPrime;
+    data[i] = v < 0 ? v + kPrime : v;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,115 @@
+"""Python binding for the C++ edge trainer (ctypes over the C ABI — this
+image has no pybind11; same surface as the reference's
+``FedMLClientManager`` (``MobileNN/includes/FedMLClientManager.h:6-33``):
+init / train / getEpochAndLoss / stopTraining).
+
+The shared library is built on demand with g++ (cached beside the source);
+mobile builds reuse the same .cpp through their own toolchains.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .edge_bundle import read_bundle, write_bundle
+
+_SRC = os.path.join(os.path.dirname(__file__), "edge_trainer.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _build_lib() -> str:
+    out = os.path.join(os.path.dirname(__file__), "libedge_trainer.so")
+    if (not os.path.exists(out)
+            or os.path.getmtime(out) < os.path.getmtime(_SRC)):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", out],
+            check=True)
+    return out
+
+
+def load_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(_build_lib())
+        lib.fedml_edge_create.restype = ctypes.c_void_p
+        lib.fedml_edge_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                          ctypes.c_int, ctypes.c_float]
+        lib.fedml_edge_train.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_longlong]
+        lib.fedml_edge_get_epoch_and_loss.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_float)]
+        lib.fedml_edge_save_model.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.fedml_edge_stop_training.argtypes = [ctypes.c_void_p]
+        lib.fedml_edge_destroy.argtypes = [ctypes.c_void_p]
+        lib.fedml_lsa_mask.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_int]
+        _LIB = lib
+    return _LIB
+
+
+class FedMLClientManager:
+    """Reference surface (FedMLClientManager.h): init → train →
+    getEpochAndLoss / stopTraining; model io via edge bundles."""
+
+    def __init__(self):
+        self._lib = load_lib()
+        self._handle = None
+        self._tmp = tempfile.mkdtemp(prefix="fedml_edge_")
+
+    def init(self, model: Dict[str, np.ndarray], x: np.ndarray,
+             y: np.ndarray, batch_size: int = 32, lr: float = 0.05):
+        model_path = os.path.join(self._tmp, "model.fteb")
+        data_path = os.path.join(self._tmp, "data.fteb")
+        write_bundle(model_path, model)
+        write_bundle(data_path, {
+            "x": np.asarray(x, np.float32).reshape(len(y), -1),
+            "y": np.asarray(y, np.float32)})
+        self._handle = self._lib.fedml_edge_create(
+            model_path.encode(), data_path.encode(), batch_size,
+            ctypes.c_float(lr))
+        if not self._handle:
+            raise RuntimeError("edge trainer init failed")
+
+    def train(self, epochs: int = 1, seed: int = 0):
+        self._lib.fedml_edge_train(self._handle, epochs, seed)
+        return self
+
+    def get_epoch_and_loss(self) -> Tuple[int, float]:
+        epoch = ctypes.c_int()
+        loss = ctypes.c_float()
+        self._lib.fedml_edge_get_epoch_and_loss(
+            self._handle, ctypes.byref(epoch), ctypes.byref(loss))
+        return epoch.value, loss.value
+
+    def get_model(self) -> Dict[str, np.ndarray]:
+        out_path = os.path.join(self._tmp, "trained.fteb")
+        rc = self._lib.fedml_edge_save_model(self._handle, out_path.encode())
+        if rc != 0:
+            raise RuntimeError("edge trainer save failed")
+        return read_bundle(out_path)
+
+    def stop_training(self):
+        self._lib.fedml_edge_stop_training(self._handle)
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.fedml_edge_destroy(self._handle)
+            self._handle = None
+
+
+def lsa_mask(values: np.ndarray, seed: int, sign: int = 1) -> np.ndarray:
+    """LightSecAgg field masking via the native core (matches the Python
+    finite-field pipeline in core/mpc)."""
+    lib = load_lib()
+    arr = np.ascontiguousarray(values, dtype=np.int64)
+    ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    lib.fedml_lsa_mask(ptr, arr.size, seed, sign)
+    return arr

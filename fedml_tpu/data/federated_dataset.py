@@ -84,6 +84,46 @@ class FederatedDataset:
         yb = self.train_y[idx].reshape((total, batch_size) + self.train_y.shape[1:])
         return xb, yb
 
+    def client_index_batches(self, client: int, batch_size: int, seed: int,
+                             round_idx: int, epochs: int = 1) -> np.ndarray:
+        """Like client_batches but returns only the (steps, batch) index
+        array — the host-side cost is one permutation per client; the
+        feature gather happens ON DEVICE in the round engine."""
+        base = self.client_idxs[client]
+        all_idx = []
+        for e in range(epochs):
+            rng = hostrng.gen(seed, round_idx * 1031 + e, client, 1)
+            idx = rng.permutation(base)
+            if len(idx) < batch_size:
+                reps = int(np.ceil(batch_size / max(len(idx), 1)))
+                idx = np.tile(idx, reps)[:batch_size]
+            steps = len(idx) // batch_size
+            all_idx.append(idx[: steps * batch_size])
+        idx = np.concatenate(all_idx)
+        total = len(idx) // batch_size
+        return idx[: total * batch_size].reshape(total, batch_size)
+
+    def cohort_indices(self, clients, batch_size: int, seed: int,
+                       round_idx: int, epochs: int = 1,
+                       max_steps: Optional[int] = None):
+        """Padded cohort INDEX tensor (n_clients, steps, batch) int32 +
+        step mask + weights: the device-gather counterpart of
+        cohort_batches (padding indices point at row 0, masked out)."""
+        per = [self.client_index_batches(c, batch_size, seed, round_idx,
+                                         epochs) for c in clients]
+        steps = max(p.shape[0] for p in per)
+        if max_steps is not None:
+            steps = min(steps, max_steps)
+        n = len(clients)
+        idx = np.zeros((n, steps, batch_size), dtype=np.int32)
+        mask = np.zeros((n, steps), dtype=np.float32)
+        for i, p in enumerate(per):
+            s = min(p.shape[0], steps)
+            idx[i, :s], mask[i, :s] = p[:s], 1.0
+        w = np.array([len(self.client_idxs[c]) for c in clients],
+                     dtype=np.float32)
+        return idx, mask, w
+
     def cohort_batches(self, clients, batch_size: int, seed: int, round_idx: int,
                        epochs: int = 1, max_steps: Optional[int] = None):
         """Padded cohort tensor for the mesh engine.

@@ -55,15 +55,22 @@ def _psum_wavg(stacked, w, axis_name):
 
 
 def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                       mesh: Mesh):
-    """round_fn(state, x, y, mask, weights, rngs, c_clients) with the client
-    axis sharded over the mesh; state replicated in/out."""
+                       mesh: Mesh, gather: bool = False):
+    """round_fn(state, x|idx, y|·, mask, weights, rngs, c_clients) with the
+    client axis sharded over the mesh; state (and, in gather mode, the
+    dataset) replicated.  In gather mode the first data arg is the (C, S, B)
+    index tensor and ``y`` is the replicated dataset pair (train_x, train_y)
+    — each device gathers only its shard's samples from its local replica."""
     local_train = trainer.make_local_train()
     alg = server_opt.algorithm
     from ..round_engine import make_server_ctx
 
     def per_shard(state: ServerState, x, y, mask, w, rngs, c_clients):
         # shapes here are per-device shards: x (c_local, S, B, ...), w (c_local,)
+        if gather:
+            idx, (train_x, train_y) = x, y
+            x = jnp.take(train_x, idx, axis=0)
+            y = jnp.take(train_y, idx, axis=0)
         ctx = make_server_ctx(trainer, state)
         fn = lambda xb, yb, mb, rng, cc: local_train(
             state.global_params, xb, yb, mb, rng, ctx, cc)
@@ -98,9 +105,10 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
         return new_state, metrics, outs
 
     shard = P(CLIENT_AXIS)
+    data_spec = P() if gather else shard
     sharded = jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(), shard, shard, shard, shard, shard, shard),
+        in_specs=(P(), shard, data_spec, shard, shard, shard, shard),
         out_specs=(P(), P(), shard),
         check_vma=False,
     )
@@ -127,23 +135,41 @@ class MeshFedAvgAPI(FedAvgAPI):
         self.state = jax.device_put(self.state, self._repl_sharding)
 
     def _build_round_fn(self, client_mode: str):
-        return make_mesh_round_fn(self.trainer, self.server_opt, self.mesh)
+        self._gather = bool(getattr(self.args, "device_data", True))
+        if self._gather:
+            repl = NamedSharding(self.mesh, P())
+            self._dev_data = (
+                jax.device_put(jnp.asarray(self.dataset.train_x), repl),
+                jax.device_put(jnp.asarray(self.dataset.train_y), repl))
+        return make_mesh_round_fn(self.trainer, self.server_opt, self.mesh,
+                                  gather=self._gather)
 
     def train_one_round(self, round_idx: int):
         clients = self._client_sampling(round_idx)
-        x, y, mask, w = self.dataset.cohort_batches(
-            clients, self.batch_size, self.seed, round_idx, self.epochs)
-        # pad steps to pow2 AND cohort to a multiple of the client-axis size
-        steps = next_pow2(x.shape[1])
-        pad_s = steps - x.shape[1]
         n = len(clients)
         n_padded = -(-n // self.n_shards) * self.n_shards
         pad_c = n_padded - n
-        if pad_s or pad_c:
-            x = np.pad(x, [(0, pad_c), (0, pad_s)] + [(0, 0)] * (x.ndim - 2))
-            y = np.pad(y, [(0, pad_c), (0, pad_s)] + [(0, 0)] * (y.ndim - 2))
-            mask = np.pad(mask, [(0, pad_c), (0, pad_s)])
-            w = np.pad(w, (0, pad_c))  # dummy clients: weight 0, masked steps
+        if self._gather:
+            idx, mask, w = self.dataset.cohort_indices(
+                clients, self.batch_size, self.seed, round_idx, self.epochs)
+            steps = next_pow2(idx.shape[1])
+            pad_s = steps - idx.shape[1]
+            if pad_s or pad_c:
+                idx = np.pad(idx, [(0, pad_c), (0, pad_s), (0, 0)])
+                mask = np.pad(mask, [(0, pad_c), (0, pad_s)])
+                w = np.pad(w, (0, pad_c))
+            data_x, data_y = idx, self._dev_data
+        else:
+            x, y, mask, w = self.dataset.cohort_batches(
+                clients, self.batch_size, self.seed, round_idx, self.epochs)
+            steps = next_pow2(x.shape[1])
+            pad_s = steps - x.shape[1]
+            if pad_s or pad_c:
+                x = np.pad(x, [(0, pad_c), (0, pad_s)] + [(0, 0)] * (x.ndim - 2))
+                y = np.pad(y, [(0, pad_c), (0, pad_s)] + [(0, 0)] * (y.ndim - 2))
+                mask = np.pad(mask, [(0, pad_c), (0, pad_s)])
+                w = np.pad(w, (0, pad_c))
+            data_x, data_y = x, y
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
         rngs = jax.random.split(key, n_padded)
         c_stacked = None
@@ -153,8 +179,10 @@ class MeshFedAvgAPI(FedAvgAPI):
                 [self._c_clients.get(int(c), zeros) for c in clients]
                 + [zeros] * pad_c)
         put = lambda a: jax.device_put(jnp.asarray(a), self._data_sharding)
+        dy = data_y if self._gather else put(data_y)
         self.state, metrics, outs = self.round_fn(
-            self.state, put(x), put(y), put(mask), put(w), put(rngs), c_stacked)
+            self.state, put(data_x), dy, put(mask), put(w), put(rngs),
+            c_stacked)
         if self._c_clients is not None:
             self._scatter_c(clients, jax.device_get(
                 jax.tree_util.tree_map(lambda a: a[:n], outs.new_client_state)))

@@ -94,6 +94,23 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     return round_fn
 
 
+def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                         train_x, train_y, mode: str = "vmap") -> Callable:
+    """Device-gather variant: the dataset lives on device once; the round
+    takes only a (C, S, B) int32 index tensor from the host (KBs instead of
+    the reference's per-round sample shipping).  The gather is HBM→HBM and
+    fuses into the scanned step."""
+    inner = make_round_fn(trainer, server_opt, mode)
+
+    def round_fn(state: ServerState, idx, mask, weights, rngs,
+                 c_clients=None):
+        x = jnp.take(train_x, idx, axis=0)   # (C, S, B, ...)
+        y = jnp.take(train_y, idx, axis=0)
+        return inner(state, x, y, mask, weights, rngs, c_clients)
+
+    return round_fn
+
+
 def next_pow2(n: int) -> int:
     p = 1
     while p < n:

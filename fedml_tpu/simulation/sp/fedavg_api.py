@@ -66,6 +66,14 @@ class FedAvgAPI:
         self.metrics_history = []
 
     def _build_round_fn(self, client_mode: str):
+        if bool(getattr(self.args, "device_data", True)):
+            # dataset device-resident once; rounds ship only index tensors
+            self._dev_x = jnp.asarray(self.dataset.train_x)
+            self._dev_y = jnp.asarray(self.dataset.train_y)
+            from ..round_engine import make_gather_round_fn
+            return jax.jit(make_gather_round_fn(
+                self.trainer, self.server_opt, self._dev_x, self._dev_y,
+                mode=client_mode))
         return jax.jit(make_round_fn(self.trainer, self.server_opt,
                                      mode=client_mode))
 
@@ -90,21 +98,33 @@ class FedAvgAPI:
 
     def train_one_round(self, round_idx: int):
         clients = self._client_sampling(round_idx)
-        x, y, mask, w = self.dataset.cohort_batches(
-            clients, self.batch_size, self.seed, round_idx, self.epochs)
-        # pad steps to pow2 buckets → bounded recompile count across rounds
-        steps = next_pow2(x.shape[1])
-        if steps != x.shape[1]:
-            pad = steps - x.shape[1]
-            x = np.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
-            y = np.pad(y, [(0, 0), (0, pad)] + [(0, 0)] * (y.ndim - 2))
-            mask = np.pad(mask, [(0, 0), (0, pad)])
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
         rngs = jax.random.split(key, len(clients))
         c_stacked = self._gather_c(clients)
-        self.state, metrics, outs = self.round_fn(
-            self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-            jnp.asarray(w), rngs, c_stacked)
+        if hasattr(self, "_dev_x"):
+            idx, mask, w = self.dataset.cohort_indices(
+                clients, self.batch_size, self.seed, round_idx, self.epochs)
+            # pad steps to pow2 buckets → bounded recompile count
+            steps = next_pow2(idx.shape[1])
+            if steps != idx.shape[1]:
+                pad = steps - idx.shape[1]
+                idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
+                mask = np.pad(mask, [(0, 0), (0, pad)])
+            self.state, metrics, outs = self.round_fn(
+                self.state, jnp.asarray(idx), jnp.asarray(mask),
+                jnp.asarray(w), rngs, c_stacked)
+        else:
+            x, y, mask, w = self.dataset.cohort_batches(
+                clients, self.batch_size, self.seed, round_idx, self.epochs)
+            steps = next_pow2(x.shape[1])
+            if steps != x.shape[1]:
+                pad = steps - x.shape[1]
+                x = np.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
+                y = np.pad(y, [(0, 0), (0, pad)] + [(0, 0)] * (y.ndim - 2))
+                mask = np.pad(mask, [(0, 0), (0, pad)])
+            self.state, metrics, outs = self.round_fn(
+                self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
+                jnp.asarray(w), rngs, c_stacked)
         self._scatter_c(clients, outs.new_client_state)
         return metrics
 

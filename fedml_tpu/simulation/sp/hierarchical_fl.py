@@ -44,9 +44,6 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
                 members = clients[groups == g]
                 if len(members) == 0:
                     continue
-                x, y, mask, w = self.dataset.cohort_batches(
-                    members, self.batch_size, self.seed,
-                    round_idx * self.group_comm_round + inner, self.epochs)
                 import jax
                 import jax.numpy as jnp
                 from ...core import rng as rng_util
@@ -55,9 +52,21 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
                     (round_idx * self.group_comm_round + inner) * 131 + g)
                 rngs = jax.random.split(key, len(members))
                 state_g = self.state.replace(global_params=group_params[g])
-                state_g, metrics, outs = self.round_fn(
-                    state_g, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-                    jnp.asarray(w), rngs, None)
+                inner_round = round_idx * self.group_comm_round + inner
+                if hasattr(self, "_dev_x"):
+                    idx, mask, w = self.dataset.cohort_indices(
+                        members, self.batch_size, self.seed, inner_round,
+                        self.epochs)
+                    state_g, metrics, outs = self.round_fn(
+                        state_g, jnp.asarray(idx), jnp.asarray(mask),
+                        jnp.asarray(w), rngs, None)
+                else:
+                    x, y, mask, w = self.dataset.cohort_batches(
+                        members, self.batch_size, self.seed, inner_round,
+                        self.epochs)
+                    state_g, metrics, outs = self.round_fn(
+                        state_g, jnp.asarray(x), jnp.asarray(y),
+                        jnp.asarray(mask), jnp.asarray(w), rngs, None)
                 group_params[g] = state_g.global_params
                 group_weights[g] = float(np.sum(w))
         live = group_weights > 0

@@ -1,0 +1,173 @@
+"""``fedml_tpu.api`` — the Python API surface (reference
+``python/fedml/api/__init__.py:29,42``: fedml_login, launch_job, run_stop,
+run_status, run_logs, cluster/device listing, build).
+
+Everything operates through a process-local scheduler plane (master + one
+agent on this host over the in-memory comm backend) created lazily by
+``_ensure_plane``; multi-host deployments construct ``FedMLLaunchManager`` /
+``FedMLClientAgent`` directly on a gRPC or MQTT comm plane instead.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..core.distributed.communication.local import local_comm_manager
+from ..core.distributed.fedml_comm_manager import create_comm_backend
+from ..computing.scheduler.comm_utils.sys_utils import get_sys_runner_info
+from ..computing.scheduler.scheduler_entry.app_manager import (
+    build_job_package)
+from ..computing.scheduler.scheduler_entry.job_config import FedMLJobConfig
+from ..computing.scheduler.scheduler_entry.launch_manager import (
+    FedMLLaunchManager, LaunchedRun)
+from ..computing.scheduler.slave.client_agent import FedMLClientAgent
+
+_PLANE_LOCK = threading.Lock()
+_PLANE: Optional[Dict[str, Any]] = None
+_PLANE_IDS = itertools.count(1)
+
+
+class _Args:
+    """Minimal args namespace for comm backend selection."""
+
+    def __init__(self, run_id: str):
+        self.run_id = run_id
+
+
+def _scheduler_home() -> str:
+    """Persistent plane state (run DB shared across CLI invocations)."""
+    home = os.environ.get("FEDML_TPU_HOME",
+                          os.path.expanduser("~/.fedml_tpu"))
+    path = os.path.join(home, "scheduler")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _ensure_plane(min_agents: int = 1) -> Dict[str, Any]:
+    global _PLANE
+    with _PLANE_LOCK:
+        if _PLANE is not None and len(_PLANE["agents"]) >= min_agents:
+            return _PLANE
+        if _PLANE is not None:  # need a bigger plane — rebuild
+            _shutdown_locked()
+        work = _scheduler_home()
+        # unique per instantiation so a restarted plane never sees another
+        # plane's stale in-memory queues
+        plane_id = f"api-plane-{os.getpid()}-{next(_PLANE_IDS)}"
+        size = min_agents + 1
+        args = _Args(plane_id)
+        from ..computing.scheduler.scheduler_core.run_db import RunDB
+        manager = FedMLLaunchManager(
+            create_comm_backend(args, 0, size, "local"),
+            os.path.join(work, "store"),
+            run_db=RunDB(os.path.join(work, "master.db")))
+        agents = []
+        for i in range(1, size):
+            agents.append(FedMLClientAgent(
+                i, create_comm_backend(args, i, size, "local"),
+                os.path.join(work, f"agent{i}")))
+        manager.start()
+        for a in agents:
+            a.start()
+        if not manager.wait_for_agents(min_agents, timeout_s=10.0):
+            raise RuntimeError("scheduler agents failed to register")
+        _PLANE = {"manager": manager, "agents": agents, "work": work,
+                  "plane_id": plane_id}
+        return _PLANE
+
+
+def _shutdown_locked() -> None:
+    global _PLANE
+    if _PLANE is None:
+        return
+    for a in _PLANE["agents"]:
+        a.stop()
+    _PLANE["manager"].stop()
+    local_comm_manager.reset_run(_PLANE["plane_id"])
+    _PLANE = None
+
+
+def shutdown() -> None:
+    """Tear down the process-local plane (kills any still-running jobs)."""
+    with _PLANE_LOCK:
+        _shutdown_locked()
+
+
+# -- auth (reference fedml_login: binds the device to an account) ----------
+def fedml_login(api_key: str = "", endpoint: str = "") -> int:
+    cfg_dir = os.path.expanduser("~/.fedml_tpu")
+    os.makedirs(cfg_dir, exist_ok=True)
+    with open(os.path.join(cfg_dir, "credentials.json"), "w") as f:
+        json.dump({"api_key": api_key, "endpoint": endpoint}, f)
+    return 0
+
+
+def fedml_logout() -> None:
+    path = os.path.expanduser("~/.fedml_tpu/credentials.json")
+    if os.path.exists(path):
+        os.remove(path)
+
+
+# -- launch ----------------------------------------------------------------
+def launch_job(job_yaml_path: str, num_workers: int = 1,
+               wait: bool = True, timeout_s: float = 600.0) -> LaunchedRun:
+    """Reference ``api.launch_job``: parse → package → match → dispatch.
+    With ``wait``, a run still unfinished after ``timeout_s`` is stopped so
+    no job process outlives the plane unsupervised."""
+    plane = _ensure_plane(min_agents=num_workers)
+    job = FedMLJobConfig.load(job_yaml_path)
+    run = plane["manager"].launch_job(job, num_workers=num_workers)
+    if wait and not run.done.wait(timeout=timeout_s):
+        plane["manager"].stop_run(run.run_id)
+        run.done.wait(timeout=10.0)
+    return run
+
+
+def run_stop(run_id: str) -> None:
+    plane = _ensure_plane()
+    plane["manager"].stop_run(run_id)
+
+
+def run_status(run_id: str) -> Optional[str]:
+    plane = _ensure_plane()
+    return plane["manager"].run_status(run_id)
+
+
+def run_logs(run_id: str) -> List[str]:
+    """Tail the run's logs from the agent-side run DBs."""
+    plane = _ensure_plane()
+    lines: List[str] = []
+    for agent in plane["agents"]:
+        for row in agent.run_db.get_run(run_id):
+            lp = row.get("log_path")
+            if lp and os.path.exists(lp):
+                with open(lp) as f:
+                    lines.extend(f.read().splitlines())
+    return lines
+
+
+# -- cluster / device ------------------------------------------------------
+def cluster_list() -> List[Dict[str, Any]]:
+    plane = _ensure_plane()
+    return [vars(d) for d in plane["manager"].pool.devices()]
+
+
+def device_info() -> Dict[str, Any]:
+    return get_sys_runner_info()
+
+
+# -- build -----------------------------------------------------------------
+def build(source_dir: str, dest_dir: str = ".",
+          job_name: str = "job") -> str:
+    return build_job_package(source_dir, dest_dir, job_name)
+
+
+__all__ = [
+    "fedml_login", "fedml_logout", "launch_job", "run_stop", "run_status",
+    "run_logs", "cluster_list", "device_info", "build", "shutdown",
+]

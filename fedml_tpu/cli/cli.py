@@ -11,12 +11,10 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import zipfile
 
 import click
-import yaml
 
 
 @click.group()
@@ -64,25 +62,64 @@ def logout():
 
 @cli.command()
 @click.argument("job_yaml", type=click.Path(exists=True))
-def launch(job_yaml):
-    """Run a job YAML (reference `fedml launch job.yaml`; schema:
-    workspace/job/bootstrap, examples/launch/hello_job.yaml).  Executes
-    locally: bootstrap then job script inside the workspace."""
-    with open(job_yaml) as f:
-        spec = yaml.safe_load(f) or {}
-    workspace = spec.get("workspace", ".")
-    base = os.path.dirname(os.path.abspath(job_yaml))
-    wdir = os.path.join(base, workspace)
-    for phase in ("bootstrap", "job"):
-        script = spec.get(phase)
-        if not script:
-            continue
-        click.echo(f"[{phase}] {script}")
-        proc = subprocess.run(["bash", "-c", script], cwd=wdir)
-        if proc.returncode != 0:
-            raise click.ClickException(
-                f"{phase} failed with exit {proc.returncode}")
-    click.echo("job finished")
+@click.option("--workers", "-n", default=1, help="number of agent workers")
+def launch(job_yaml, workers):
+    """Run a job YAML through the scheduler plane (reference `fedml launch
+    job.yaml`, §3.4: parse → package → match resources → dispatch to
+    agents → stream statuses)."""
+    from fedml_tpu import api
+
+    try:
+        try:
+            run = api.launch_job(job_yaml, num_workers=workers, wait=True)
+        except RuntimeError as e:  # no matching resources etc.
+            raise click.ClickException(str(e))
+        status = api.run_status(run.run_id)
+        click.echo(f"run {run.run_id}: {status}")
+        for line in api.run_logs(run.run_id):
+            click.echo(f"  | {line}")
+        if status != "FINISHED":
+            raise click.ClickException(f"job ended {status}")
+    finally:
+        api.shutdown()
+
+
+@cli.group()
+def run():
+    """Inspect runs (reference `fedml run`)."""
+
+
+@run.command("status")
+@click.argument("run_id")
+def run_status(run_id):
+    from fedml_tpu import api
+    click.echo(api.run_status(run_id) or "UNKNOWN")
+
+
+@run.command("stop")
+@click.argument("run_id")
+def run_stop(run_id):
+    from fedml_tpu import api
+    api.run_stop(run_id)
+    click.echo(f"stop requested for {run_id}")
+
+
+@run.command("logs")
+@click.argument("run_id")
+def run_logs(run_id):
+    from fedml_tpu import api
+    for line in api.run_logs(run_id):
+        click.echo(line)
+
+
+@cli.command()
+def cluster():
+    """Show this host's schedulable resources (reference `fedml cluster`;
+    multi-host pools are listed via ``api.cluster_list()`` on a live
+    scheduler plane)."""
+    from fedml_tpu.computing.scheduler.comm_utils.sys_utils import (
+        get_sys_runner_info)
+    click.echo(json.dumps(get_sys_runner_info(), indent=2))
 
 
 @cli.command()

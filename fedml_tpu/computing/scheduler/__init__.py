@@ -1,0 +1,12 @@
+"""Scheduler / launch plane (reference ``python/fedml/computing/scheduler/``).
+
+The reference's "launch anywhere" stack is an MQTT-driven pair of device
+agents (``slave/client_runner.py:62``, ``master/server_runner.py:71``) plus a
+cloud launch manager (``scheduler_entry/launch_manager.py:25``).  The TPU
+rebuild keeps the same division of labor but runs over the pluggable comm
+layer (local queue for single-host, gRPC/MQTT for real deployments) and a
+local resource inventory built from ``jax.devices()`` instead of nvidia-smi.
+"""
+
+from .scheduler_entry.job_config import FedMLJobConfig  # noqa: F401
+from .scheduler_entry.launch_manager import FedMLLaunchManager  # noqa: F401

@@ -1,0 +1,93 @@
+"""JobMonitor — crash detection for spawned run processes (reference
+``comm_utils/job_monitor.py:48,337``: daemons that poll run processes and
+endpoints, mark crashed runs, and trigger recovery callbacks)."""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class JobMonitor:
+    """Polls registered subprocesses; on exit invokes the completion
+    callback with (run_id, returncode).  One monitor per agent."""
+
+    def __init__(self, poll_interval_s: float = 0.1):
+        self.poll_interval_s = float(poll_interval_s)
+        self._procs: Dict[str, Tuple[subprocess.Popen,
+                                     Callable[[str, int], None]]] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="job-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def watch(self, run_id: str, proc: subprocess.Popen,
+              on_exit: Callable[[str, int], None]) -> None:
+        with self._lock:
+            self._procs[str(run_id)] = (proc, on_exit)
+
+    def kill(self, run_id: str) -> bool:
+        """Terminate a run's process (reference stop_train path).  Returns
+        True if a process was found."""
+        with self._lock:
+            entry = self._procs.pop(str(run_id), None)
+        if entry is None:
+            return False
+        proc, _ = entry
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        return True
+
+    def watched_runs(self):
+        with self._lock:
+            return list(self._procs)
+
+    def kill_all(self) -> int:
+        """Terminate every watched process (agent shutdown — don't orphan
+        spawned jobs).  Returns the number killed."""
+        return sum(1 for rid in self.watched_runs() if self.kill(rid))
+
+    def running_count(self) -> int:
+        with self._lock:
+            return len(self._procs)
+
+    def _loop(self) -> None:
+        while self._running:
+            finished = []
+            with self._lock:
+                for run_id, (proc, cb) in list(self._procs.items()):
+                    rc = proc.poll()
+                    if rc is not None:
+                        finished.append((run_id, rc, cb))
+                        del self._procs[run_id]
+            for run_id, rc, cb in finished:
+                try:
+                    cb(run_id, rc)
+                except Exception:
+                    log.exception("on_exit callback for run %s raised", run_id)
+            time.sleep(self.poll_interval_s)
+
+
+__all__ = ["JobMonitor"]

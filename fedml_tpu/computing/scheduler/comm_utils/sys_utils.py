@@ -1,0 +1,78 @@
+"""Host/system introspection for agents (reference ``comm_utils/
+sys_utils.py`` — GPU inventory via nvidia-smi, versions, env collection).
+TPU-era: accelerator inventory from jax, cpu/mem from /proc.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+def _probe_accelerator(timeout_s: float) -> Tuple[str, int, Optional[str]]:
+    """Query jax devices in a side thread so a wedged accelerator runtime
+    (e.g. an unreachable TPU tunnel) degrades the inventory to CPU instead
+    of hanging the agent."""
+    result: Dict[str, Any] = {}
+
+    def probe():
+        try:
+            import jax
+            devs = jax.devices()
+            result["platform"] = devs[0].platform if devs else "none"
+            result["num_chips"] = len(devs)
+            result["jax_version"] = jax.__version__
+        except Exception:
+            result["platform"] = "none"
+            result["num_chips"] = 0
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if t.is_alive():  # runtime wedged — report no accelerator
+        return "none", 0, None
+    return (result.get("platform", "none"), result.get("num_chips", 0),
+            result.get("jax_version"))
+
+
+def get_sys_runner_info() -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "os": platform.system(),
+        "kernel": platform.release(),
+        "python": sys.version.split()[0],
+        "cpu_count": os.cpu_count() or 1,
+    }
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    info["mem_total_bytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    info["mem_available_bytes"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    timeout_s = float(os.environ.get("FEDML_TPU_DEVICE_PROBE_TIMEOUT", "15"))
+    platform_name, num_chips, jax_version = _probe_accelerator(timeout_s)
+    info["accelerator"] = platform_name
+    info["num_chips"] = num_chips
+    if jax_version:
+        info["jax_version"] = jax_version
+    try:
+        import fedml_tpu
+        info["fedml_tpu_version"] = fedml_tpu.__version__
+    except Exception:
+        pass
+    return info
+
+
+def cpu_load_1min() -> float:
+    try:
+        return os.getloadavg()[0]
+    except OSError:
+        return 0.0
+
+
+__all__ = ["get_sys_runner_info", "cpu_load_1min"]

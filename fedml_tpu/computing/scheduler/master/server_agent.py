@@ -1,0 +1,41 @@
+"""Master-side message-arg keys + the aggregation-server agent alias
+(reference ``master/server_runner.py:71`` FedMLServerRunner).
+
+In the reference the master agent is a near-copy of the slave agent with
+server-flavored topics; here the FSM is shared (``slave/client_agent.py``)
+and the master *scheduling* role lives in ``FedMLLaunchManager``.  The
+``FedMLServerAgent`` alias exists so deployments can name their aggregation
+host's agent distinctly.
+"""
+
+from __future__ import annotations
+
+from ..slave.client_agent import (
+    FedMLClientAgent,
+    MSG_ARG_DYNAMIC_ARGS,
+    MSG_ARG_ENTRY,
+    MSG_ARG_ENV,
+    MSG_ARG_INVENTORY,
+    MSG_ARG_PACKAGE,
+    MSG_ARG_RETURNCODE,
+    MSG_ARG_RUN_ID,
+    MSG_ARG_STATUS,
+)
+
+
+class MSG_ARGS:
+    RUN_ID = MSG_ARG_RUN_ID
+    PACKAGE = MSG_ARG_PACKAGE
+    ENTRY = MSG_ARG_ENTRY
+    ENV = MSG_ARG_ENV
+    DYNAMIC_ARGS = MSG_ARG_DYNAMIC_ARGS
+    STATUS = MSG_ARG_STATUS
+    RETURNCODE = MSG_ARG_RETURNCODE
+    INVENTORY = MSG_ARG_INVENTORY
+
+
+class FedMLServerAgent(FedMLClientAgent):
+    """Aggregation-server agent — same FSM, distinct name."""
+
+
+__all__ = ["FedMLServerAgent", "MSG_ARGS"]

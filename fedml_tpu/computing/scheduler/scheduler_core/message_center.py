@@ -1,0 +1,99 @@
+"""FedMLMessageCenter — queue-backed reliable send/listen over a comm
+backend (reference ``scheduler_core/message_center.py:16``: an outbound
+queue drained by a sender thread with resend, and listener dispatch of
+inbound messages).
+
+The reference binds this to MQTT; here it wraps any
+``BaseCommunicationManager`` so the scheduler plane is backend-agnostic
+(local queue in tests, gRPC/MQTT in deployments).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ....core.distributed.communication.base_com_manager import (
+    BaseCommunicationManager, Observer)
+from ....core.distributed.communication.message import Message
+
+log = logging.getLogger(__name__)
+
+
+class FedMLMessageCenter(Observer):
+    """Owns a comm manager: outbound messages go through a queue + sender
+    thread (retrying on transient failure), inbound messages dispatch to
+    per-type listeners on the receive loop thread."""
+
+    def __init__(self, com_manager: BaseCommunicationManager,
+                 resend_attempts: int = 3, resend_delay_s: float = 0.05):
+        self.com = com_manager
+        self.com.add_observer(self)
+        self.resend_attempts = int(resend_attempts)
+        self.resend_delay_s = float(resend_delay_s)
+        self._out: "queue.Queue[Optional[Message]]" = queue.Queue()
+        self._listeners: Dict[int, List[Callable[[Message], None]]] = {}
+        self._sender: Optional[threading.Thread] = None
+        self._receiver: Optional[threading.Thread] = None
+        self._running = False
+        self.sent_count = 0
+        self.failed_count = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._running = True
+        self._sender = threading.Thread(
+            target=self._sender_loop, name="msg-center-send", daemon=True)
+        self._sender.start()
+        self._receiver = threading.Thread(
+            target=self.com.handle_receive_message,
+            name="msg-center-recv", daemon=True)
+        self._receiver.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._out.put(None)
+        self.com.stop_receive_message()
+        for t in (self._sender, self._receiver):
+            if t is not None:
+                t.join(timeout=2.0)
+
+    # -- send path ---------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        self._out.put(msg)
+
+    def _sender_loop(self) -> None:
+        while True:
+            msg = self._out.get()
+            if msg is None:
+                return
+            for attempt in range(self.resend_attempts):
+                try:
+                    self.com.send_message(msg)
+                    self.sent_count += 1
+                    break
+                except Exception as e:  # transient backend failure
+                    log.warning("send attempt %d failed: %s", attempt + 1, e)
+                    time.sleep(self.resend_delay_s * (attempt + 1))
+            else:
+                self.failed_count += 1
+                log.error("dropping message after %d attempts: %r",
+                          self.resend_attempts, msg)
+
+    # -- receive path ------------------------------------------------------
+    def add_listener(self, msg_type: int,
+                     fn: Callable[[Message], None]) -> None:
+        self._listeners.setdefault(int(msg_type), []).append(fn)
+
+    def receive_message(self, msg_type, msg_params) -> None:
+        for fn in self._listeners.get(int(msg_type), []):
+            try:
+                fn(msg_params)
+            except Exception:
+                log.exception("listener for msg_type %s raised", msg_type)
+
+
+__all__ = ["FedMLMessageCenter"]

@@ -1,0 +1,89 @@
+"""SQLite run-state store (reference ``slave/client_data_interface.py`` /
+``master/server_data_interface.py`` — agents persist run state locally so a
+daemon restart can reconcile)."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    run_id      TEXT NOT NULL,
+    device_id   INTEGER NOT NULL,
+    status      TEXT NOT NULL,
+    returncode  INTEGER,
+    log_path    TEXT,
+    info        TEXT,
+    updated_at  REAL NOT NULL,
+    PRIMARY KEY (run_id, device_id)
+);
+"""
+
+
+class RunDB:
+    def __init__(self, path: str = ":memory:"):
+        # check_same_thread=False + our own lock: agents update from FSM and
+        # monitor threads.
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._db.executescript(_SCHEMA)
+            self._db.commit()
+
+    def set_status(self, run_id: str, device_id: int, status: str,
+                   returncode: Optional[int] = None,
+                   log_path: Optional[str] = None,
+                   info: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO runs (run_id, device_id, status, returncode,"
+                " log_path, info, updated_at) VALUES (?,?,?,?,?,?,?)"
+                " ON CONFLICT(run_id, device_id) DO UPDATE SET"
+                " status=excluded.status,"
+                " returncode=COALESCE(excluded.returncode, runs.returncode),"
+                " log_path=COALESCE(excluded.log_path, runs.log_path),"
+                " info=COALESCE(excluded.info, runs.info),"
+                " updated_at=excluded.updated_at",
+                (str(run_id), int(device_id), status, returncode, log_path,
+                 json.dumps(info) if info is not None else None, time.time()))
+            self._db.commit()
+
+    def get_status(self, run_id: str, device_id: int) -> Optional[str]:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT status FROM runs WHERE run_id=? AND device_id=?",
+                (str(run_id), int(device_id))).fetchone()
+        return row[0] if row else None
+
+    def get_run(self, run_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT run_id, device_id, status, returncode, log_path,"
+                " info, updated_at FROM runs WHERE run_id=?",
+                (str(run_id),)).fetchall()
+        return [self._row_to_dict(r) for r in rows]
+
+    def list_runs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT run_id, device_id, status, returncode, log_path,"
+                " info, updated_at FROM runs").fetchall()
+        return [self._row_to_dict(r) for r in rows]
+
+    @staticmethod
+    def _row_to_dict(r) -> Dict[str, Any]:
+        return {"run_id": r[0], "device_id": r[1], "status": r[2],
+                "returncode": r[3], "log_path": r[4],
+                "info": json.loads(r[5]) if r[5] else None,
+                "updated_at": r[6]}
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+__all__ = ["RunDB"]

@@ -1,0 +1,39 @@
+"""Run/device status constants (reference ``ClientConstants``/
+``ServerConstants`` status strings reported over the MLOps status topics).
+"""
+
+from __future__ import annotations
+
+
+class RunStatus:
+    IDLE = "IDLE"
+    QUEUED = "QUEUED"
+    PROVISIONING = "PROVISIONING"
+    INITIALIZING = "INITIALIZING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    KILLED = "KILLED"
+    FAILED = "FAILED"
+    FINISHED = "FINISHED"
+
+    TERMINAL = frozenset({KILLED, FAILED, FINISHED})
+
+    @classmethod
+    def is_terminal(cls, status: str) -> bool:
+        return status in cls.TERMINAL
+
+
+class SchedulerMsgType:
+    """Message types on the scheduler control plane (reference MQTT topics
+    flclient_agent/{id}/start_train etc., collapsed onto the comm layer)."""
+
+    REGISTER = 101          # agent -> master: inventory
+    START_RUN = 102         # master -> agent: package + dynamic args
+    STOP_RUN = 103          # master -> agent
+    STATUS_UPDATE = 104     # agent -> master
+    HEARTBEAT = 105         # agent -> master (liveness)
+    OTA_UPGRADE = 106       # master -> agent
+    DEREGISTER = 107        # agent -> master
+
+
+__all__ = ["RunStatus", "SchedulerMsgType"]

@@ -1,0 +1,52 @@
+"""Job package build/fetch (reference ``scheduler_entry/app_manager.py``:
+zip the workspace, upload; agents download + unzip).  Here the "store" is a
+pluggable directory (shared filesystem / object-store mount) so the same
+package flow works single-host and multi-host without a vendor backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import zipfile
+
+
+def build_job_package(workspace_dir: str, store_dir: str,
+                      job_name: str = "job") -> str:
+    """Zip ``workspace_dir`` into the package store; returns package path.
+    Package names are content-addressed so repeated launches dedupe."""
+    os.makedirs(store_dir, exist_ok=True)
+    digest = hashlib.sha256()
+    entries = []
+    for root, _, files in os.walk(workspace_dir):
+        for name in sorted(files):
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, workspace_dir)
+            entries.append((p, rel))
+            digest.update(rel.encode())
+            with open(p, "rb") as f:
+                digest.update(f.read())
+    pkg_path = os.path.join(
+        store_dir, f"{job_name}-{digest.hexdigest()[:16]}.zip")
+    if not os.path.exists(pkg_path):
+        tmp = pkg_path + ".tmp"
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            for p, rel in entries:
+                z.write(p, rel)
+        os.replace(tmp, pkg_path)
+    return pkg_path
+
+
+def fetch_job_package(pkg_path: str, dest_dir: str) -> str:
+    """Agent-side download+unzip (reference ``client_runner.py`` package
+    retrieval).  Returns the unpacked workspace directory."""
+    if os.path.isdir(dest_dir):
+        shutil.rmtree(dest_dir)
+    os.makedirs(dest_dir)
+    with zipfile.ZipFile(pkg_path) as z:
+        z.extractall(dest_dir)
+    return dest_dir
+
+
+__all__ = ["build_job_package", "fetch_job_package"]

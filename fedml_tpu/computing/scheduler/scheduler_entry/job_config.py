@@ -1,0 +1,102 @@
+"""Job YAML schema (reference ``scheduler_entry/launch_manager.py:417``
+``FedMLJobConfig``; example schema ``examples/launch/hello_job.yaml``:
+workspace / job / bootstrap / computing / server_job / framework_type).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import yaml
+
+
+@dataclass
+class ComputingRequirements:
+    """The ``computing:`` section — resource ask for the matcher.
+
+    Reference keys: minimum_num_gpus, maximum_cost_per_hour, resource_type.
+    On TPU the unit of accounting is a chip (one ``jax.Device``).
+    """
+
+    minimum_num_gpus: int = 0
+    maximum_cost_per_hour: str = ""
+    resource_type: str = ""
+    device_type: str = ""  # "GPU"/"TPU"/"CPU"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComputingRequirements":
+        return cls(
+            minimum_num_gpus=int(d.get("minimum_num_gpus", 0) or 0),
+            maximum_cost_per_hour=str(d.get("maximum_cost_per_hour", "") or ""),
+            resource_type=str(d.get("resource_type", "") or ""),
+            device_type=str(d.get("device_type", "") or ""),
+        )
+
+
+@dataclass
+class FedMLJobConfig:
+    """Parsed job YAML.  ``job`` is the entry shell script run inside the
+    workspace on each matched worker; ``server_job`` (optional) runs on the
+    aggregation master; ``bootstrap`` runs once before the job."""
+
+    job_yaml_path: str = ""
+    base_dir: str = "."
+    workspace: str = "."
+    job: str = ""
+    server_job: str = ""
+    bootstrap: str = ""
+    job_type: str = "train"  # train | deploy | federate
+    job_name: str = ""
+    framework_type: str = ""
+    computing: ComputingRequirements = field(default_factory=ComputingRequirements)
+    job_args: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, job_yaml_path: str) -> "FedMLJobConfig":
+        with open(job_yaml_path) as f:
+            spec = yaml.safe_load(f) or {}
+        base = os.path.dirname(os.path.abspath(job_yaml_path))
+        return cls(
+            job_yaml_path=os.path.abspath(job_yaml_path),
+            base_dir=base,
+            workspace=str(spec.get("workspace", ".")),
+            job=str(spec.get("job", "") or ""),
+            server_job=str(spec.get("server_job", "") or ""),
+            bootstrap=str(spec.get("bootstrap", "") or ""),
+            job_type=str(spec.get("task_type", spec.get("job_type", "train"))),
+            job_name=str(spec.get("job_name",
+                                  os.path.basename(base) or "job")),
+            framework_type=str(spec.get("framework_type", "") or ""),
+            computing=ComputingRequirements.from_dict(
+                spec.get("computing", {}) or {}),
+            job_args=dict(spec.get("job_args", {}) or {}),
+            env={str(k): str(v) for k, v in
+                 (spec.get("environment", {}) or {}).items()},
+        )
+
+    @property
+    def workspace_dir(self) -> str:
+        return os.path.normpath(os.path.join(self.base_dir, self.workspace))
+
+
+def rewrite_dynamic_args(config_path: str, overrides: Dict[str, Any]) -> None:
+    """Rewrite a job's fedml_config.yaml in place with run-time values —
+    the agent-side fixup the reference does at ``slave/client_runner.py:
+    327-380`` (run_id, edge ids, comm endpoints injected into the downloaded
+    package's config before spawning the process)."""
+    with open(config_path) as f:
+        cfg = yaml.safe_load(f) or {}
+    for dotted, value in overrides.items():
+        parts = dotted.split(".")
+        node = cfg
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    with open(config_path, "w") as f:
+        yaml.safe_dump(cfg, f)
+
+
+__all__ = ["FedMLJobConfig", "ComputingRequirements", "rewrite_dynamic_args"]

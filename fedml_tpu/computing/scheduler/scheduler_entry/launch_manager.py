@@ -1,0 +1,194 @@
+"""FedMLLaunchManager (reference ``scheduler_entry/launch_manager.py:25``)
+— the ``fedml launch job.yaml`` driver: parse job config, build the
+package, match resources, dispatch START_RUN to agents, track statuses.
+
+The reference delegates matching/dispatch to the TensorOpera cloud over
+HTTP+MQTT; here the master role is local (rank 0 on the scheduler comm
+plane) so the whole launch path runs without any vendor backend — the same
+agents can later be pointed at a gRPC/MQTT plane across hosts.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ....core.distributed.communication.message import Message
+from ..master.server_agent import MSG_ARGS  # re-exported arg keys
+from ..scheduler_core.message_center import FedMLMessageCenter
+from ..scheduler_core.run_db import RunDB
+from ..scheduler_core.status import RunStatus, SchedulerMsgType
+from .app_manager import build_job_package
+from .job_config import FedMLJobConfig
+from .resource_manager import DeviceResource, ResourcePool
+
+log = logging.getLogger(__name__)
+
+
+class LaunchedRun:
+    def __init__(self, run_id: str, device_ids: List[int], chips_each: int):
+        self.run_id = run_id
+        self.device_ids = list(device_ids)
+        self.chips_each = chips_each
+        self.statuses: Dict[int, str] = {d: RunStatus.QUEUED
+                                         for d in device_ids}
+        self.done = threading.Event()
+
+    def update(self, device_id: int, status: str) -> None:
+        self.statuses[device_id] = status
+        if all(RunStatus.is_terminal(s) for s in self.statuses.values()):
+            self.done.set()
+
+    @property
+    def status(self) -> str:
+        vals = set(self.statuses.values())
+        if vals <= RunStatus.TERMINAL:
+            if RunStatus.FAILED in vals:
+                return RunStatus.FAILED
+            if RunStatus.KILLED in vals:
+                return RunStatus.KILLED
+            return RunStatus.FINISHED
+        for s in (RunStatus.RUNNING, RunStatus.INITIALIZING,
+                  RunStatus.PROVISIONING):
+            if s in vals:
+                return s
+        return RunStatus.QUEUED
+
+
+class FedMLLaunchManager:
+    """Master of the scheduler plane: owns the resource pool + run registry
+    and the rank-0 message center."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, com_manager, store_dir: str,
+                 run_db: Optional[RunDB] = None):
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        self.run_db = run_db or RunDB(os.path.join(store_dir, "master.db"))
+        self.pool = ResourcePool()
+        self.runs: Dict[str, LaunchedRun] = {}
+        self._lock = threading.Lock()
+        self.center = FedMLMessageCenter(com_manager)
+        self.center.add_listener(SchedulerMsgType.REGISTER, self._on_register)
+        self.center.add_listener(SchedulerMsgType.DEREGISTER,
+                                 self._on_deregister)
+        self.center.add_listener(SchedulerMsgType.STATUS_UPDATE,
+                                 self._on_status)
+
+    def start(self) -> None:
+        self.center.start()
+
+    def stop(self) -> None:
+        self.center.stop()
+
+    # -- agent registry ----------------------------------------------------
+    def _on_register(self, msg: Message) -> None:
+        inv = dict(msg.get(MSG_ARGS.INVENTORY) or {})
+        accel = str(inv.get("accelerator", "cpu")).upper()
+        dev = DeviceResource(
+            device_id=msg.get_sender_id(),
+            num_chips=int(inv.get("num_chips", 0)),
+            device_type="CPU" if accel in ("NONE", "") else accel,
+            num_cpus=int(inv.get("cpu_count", 1)),
+            mem_bytes=int(inv.get("mem_total_bytes", 0)))
+        with self._lock:
+            self.pool.register(dev)
+        log.info("registered agent %d (%s x%d)", dev.device_id,
+                 dev.device_type, dev.num_chips)
+
+    def _on_deregister(self, msg: Message) -> None:
+        with self._lock:
+            self.pool.unregister(msg.get_sender_id())
+
+    def wait_for_agents(self, n: int, timeout_s: float = 10.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                if len(self.pool.devices()) >= n:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # -- launch ------------------------------------------------------------
+    def launch_job(self, job: FedMLJobConfig, num_workers: int = 1,
+                   run_id: Optional[str] = None) -> LaunchedRun:
+        """Match resources, dispatch, return the tracked run (non-blocking:
+        use run.done.wait())."""
+        run_id = run_id or f"run{next(self._ids)}-{os.getpid()}"
+        with self._lock:
+            matched = self.pool.match(job.computing, num_workers)
+        if matched is None:
+            raise RuntimeError(
+                f"no resources for {job.computing} x{num_workers}")
+        pkg = build_job_package(job.workspace_dir, self.store_dir,
+                                job.job_name)
+        run = LaunchedRun(run_id, [d.device_id for d in matched],
+                          job.computing.minimum_num_gpus)
+        with self._lock:
+            self.runs[run_id] = run
+        # worker 0 runs server_job when present (reference: master agent
+        # hosts the aggregation server), everyone runs the client job.
+        entry_script = (job.bootstrap + "\n" if job.bootstrap else "")
+        for i, dev in enumerate(matched):
+            entry = entry_script + (
+                job.server_job if (i == 0 and job.server_job) else job.job)
+            dynamic = {"common_args.run_id": run_id,
+                       "common_args.rank": i,
+                       "common_args.worker_num": len(matched)}
+            msg = Message(SchedulerMsgType.START_RUN, 0, dev.device_id)
+            msg.add(MSG_ARGS.RUN_ID, run_id)
+            msg.add(MSG_ARGS.PACKAGE, pkg)
+            msg.add(MSG_ARGS.ENTRY, entry)
+            msg.add(MSG_ARGS.ENV, dict(job.env))
+            msg.add(MSG_ARGS.DYNAMIC_ARGS, dynamic)
+            self.center.send_message(msg)
+            self.run_db.set_status(run_id, dev.device_id, RunStatus.QUEUED)
+        return run
+
+    def stop_run(self, run_id: str) -> None:
+        run = self.runs.get(run_id)
+        if run is not None:
+            device_ids = run.device_ids
+        else:  # cross-process stop via the persisted run DB
+            device_ids = [r["device_id"] for r in self.run_db.get_run(run_id)]
+        for did in device_ids:
+            msg = Message(SchedulerMsgType.STOP_RUN, 0, did)
+            msg.add(MSG_ARGS.RUN_ID, run_id)
+            self.center.send_message(msg)
+
+    # -- status ingest -----------------------------------------------------
+    def _on_status(self, msg: Message) -> None:
+        run_id = str(msg.get(MSG_ARGS.RUN_ID))
+        status = str(msg.get(MSG_ARGS.STATUS))
+        device_id = msg.get_sender_id()
+        self.run_db.set_status(run_id, device_id, status,
+                               returncode=msg.get(MSG_ARGS.RETURNCODE))
+        run = self.runs.get(run_id)
+        if run is not None:
+            run.update(device_id, status)
+            if RunStatus.is_terminal(run.status):
+                with self._lock:
+                    self.pool.release(run.device_ids, run.chips_each)
+
+    def run_status(self, run_id: str) -> Optional[str]:
+        run = self.runs.get(run_id)
+        if run is not None:
+            return run.status
+        # not launched by this process — fall back to the persisted run DB
+        # (agents' status stream is mirrored there), so `fedml run status`
+        # works across CLI invocations.
+        rows = self.run_db.get_run(run_id)
+        if not rows:
+            return None
+        statuses = {r["device_id"]: r["status"] for r in rows}
+        shadow = LaunchedRun(run_id, list(statuses), 0)
+        shadow.statuses = statuses
+        return shadow.status
+
+
+__all__ = ["FedMLLaunchManager", "LaunchedRun"]

@@ -1,0 +1,101 @@
+"""Resource inventory + matching (reference ``scheduler_entry/
+resource_manager.py`` + GPU discovery in ``comm_utils/sys_utils.py`` via
+nvidia-smi).  The TPU inventory comes from ``jax.devices()``; CPU/memory from
+/proc — no external tooling.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .job_config import ComputingRequirements
+
+
+@dataclass
+class DeviceResource:
+    """One schedulable device (an agent's host)."""
+
+    device_id: int
+    num_chips: int = 0          # accelerator chips (TPU/GPU)
+    device_type: str = "CPU"    # "TPU" | "GPU" | "CPU"
+    num_cpus: int = 1
+    mem_bytes: int = 0
+    tags: Dict[str, str] = field(default_factory=dict)
+    chips_in_use: int = 0
+
+    @property
+    def chips_free(self) -> int:
+        return max(0, self.num_chips - self.chips_in_use)
+
+
+def local_inventory(device_id: int = 0) -> DeviceResource:
+    """Inventory of this host (accelerator probe is timeout-guarded — see
+    ``comm_utils.sys_utils._probe_accelerator``)."""
+    import os as _os
+    from ..comm_utils.sys_utils import _probe_accelerator
+    timeout_s = float(_os.environ.get("FEDML_TPU_DEVICE_PROBE_TIMEOUT", "15"))
+    platform, num_chips, _ = _probe_accelerator(timeout_s)
+    platform = platform.upper() if platform != "none" else "CPU"
+    if platform == "CPU":
+        num_chips = 0
+    mem = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    mem = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
+    return DeviceResource(
+        device_id=device_id, num_chips=num_chips,
+        device_type=platform if platform != "CPU" else "CPU",
+        num_cpus=os.cpu_count() or 1, mem_bytes=mem)
+
+
+class ResourcePool:
+    """Registry of agent resources; greedy first-fit matcher (the reference
+    delegates matching to its cloud backend — here it is explicit)."""
+
+    def __init__(self):
+        self._devices: Dict[int, DeviceResource] = {}
+
+    def register(self, res: DeviceResource) -> None:
+        self._devices[res.device_id] = res
+
+    def unregister(self, device_id: int) -> None:
+        self._devices.pop(device_id, None)
+
+    def devices(self) -> List[DeviceResource]:
+        return list(self._devices.values())
+
+    def match(self, req: ComputingRequirements,
+              num_workers: int = 1) -> Optional[List[DeviceResource]]:
+        """Pick ``num_workers`` devices satisfying the ask, or None."""
+        want_type = req.device_type.upper()
+        picked: List[DeviceResource] = []
+        for res in sorted(self._devices.values(),
+                          key=lambda r: -r.chips_free):
+            if want_type and want_type != "CPU" and res.device_type != want_type:
+                continue
+            if res.chips_free < req.minimum_num_gpus:
+                continue
+            picked.append(res)
+            if len(picked) == num_workers:
+                break
+        if len(picked) < num_workers:
+            return None
+        for res in picked:
+            res.chips_in_use += req.minimum_num_gpus
+        return picked
+
+    def release(self, device_ids: List[int], chips_each: int) -> None:
+        for did in device_ids:
+            res = self._devices.get(did)
+            if res is not None:
+                res.chips_in_use = max(0, res.chips_in_use - chips_each)
+
+
+__all__ = ["DeviceResource", "ResourcePool", "local_inventory"]

@@ -1,0 +1,141 @@
+"""Device agent (reference ``slave/client_runner.py:62`` FedMLClientRunner +
+``client_daemon.py``): listens for start/stop-run control messages, fetches
+the job package, rewrites dynamic config args, spawns the job process, and
+streams status transitions back to the master.  The same agent class serves
+the aggregation-server role (reference ``master/server_runner.py:71``) by
+running ``server_job`` when the dispatch says so — the FSM is identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Any, Dict, Optional
+
+from ....core.distributed.communication.message import Message
+from ..comm_utils.job_monitor import JobMonitor
+from ..comm_utils.sys_utils import get_sys_runner_info
+from ..scheduler_core.message_center import FedMLMessageCenter
+from ..scheduler_core.run_db import RunDB
+from ..scheduler_core.status import RunStatus, SchedulerMsgType
+from ..scheduler_entry.app_manager import fetch_job_package
+from ..scheduler_entry.job_config import rewrite_dynamic_args
+
+log = logging.getLogger(__name__)
+
+MSG_ARG_RUN_ID = "run_id"
+MSG_ARG_PACKAGE = "package_path"
+MSG_ARG_ENTRY = "entry_script"
+MSG_ARG_ENV = "env"
+MSG_ARG_DYNAMIC_ARGS = "dynamic_args"
+MSG_ARG_STATUS = "status"
+MSG_ARG_RETURNCODE = "returncode"
+MSG_ARG_INVENTORY = "inventory"
+
+
+class FedMLClientAgent:
+    """One agent per host.  ``device_id`` is its rank on the scheduler comm
+    plane (master is rank 0)."""
+
+    def __init__(self, device_id: int, com_manager, work_dir: str,
+                 run_db: Optional[RunDB] = None):
+        self.device_id = int(device_id)
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self.run_db = run_db or RunDB(os.path.join(work_dir, "runs.db"))
+        self.center = FedMLMessageCenter(com_manager)
+        self.monitor = JobMonitor()
+        self.center.add_listener(SchedulerMsgType.START_RUN, self._on_start)
+        self.center.add_listener(SchedulerMsgType.STOP_RUN, self._on_stop)
+        self.center.add_listener(SchedulerMsgType.OTA_UPGRADE, self._on_ota)
+        self._run_env: Dict[str, Dict[str, str]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.monitor.start()
+        self.center.start()
+        self._register()
+
+    def stop(self) -> None:
+        for run_id in self.monitor.watched_runs():
+            if self.monitor.kill(run_id):
+                self._report(run_id, RunStatus.KILLED)
+        self.monitor.stop()
+        self.center.stop()
+
+    def _register(self) -> None:
+        msg = Message(SchedulerMsgType.REGISTER, self.device_id, 0)
+        msg.add(MSG_ARG_INVENTORY, get_sys_runner_info())
+        self.center.send_message(msg)
+
+    # -- control-plane handlers --------------------------------------------
+    def _on_start(self, msg: Message) -> None:
+        run_id = str(msg.get(MSG_ARG_RUN_ID))
+        pkg = str(msg.get(MSG_ARG_PACKAGE))
+        entry = str(msg.get(MSG_ARG_ENTRY) or "")
+        env = dict(msg.get(MSG_ARG_ENV) or {})
+        dynamic = dict(msg.get(MSG_ARG_DYNAMIC_ARGS) or {})
+        # spawn off the FSM thread so long bootstraps don't stall the loop
+        threading.Thread(target=self._start_run, name=f"run-{run_id}",
+                         args=(run_id, pkg, entry, env, dynamic),
+                         daemon=True).start()
+
+    def _start_run(self, run_id: str, pkg: str, entry: str,
+                   env: Dict[str, str], dynamic: Dict[str, Any]) -> None:
+        self._report(run_id, RunStatus.PROVISIONING)
+        try:
+            ws = fetch_job_package(
+                pkg, os.path.join(self.work_dir, f"run_{run_id}"))
+            cfg = os.path.join(ws, "fedml_config.yaml")
+            if dynamic and os.path.exists(cfg):
+                rewrite_dynamic_args(cfg, dynamic)
+            self._report(run_id, RunStatus.INITIALIZING)
+            log_path = os.path.join(ws, "run.log")
+            full_env = dict(os.environ)
+            full_env.update(env)
+            full_env["FEDML_RUN_ID"] = run_id
+            full_env["FEDML_DEVICE_ID"] = str(self.device_id)
+            with open(log_path, "ab") as logf:
+                proc = subprocess.Popen(
+                    ["bash", "-c", entry], cwd=ws, env=full_env,
+                    stdout=logf, stderr=subprocess.STDOUT)
+            self._report(run_id, RunStatus.RUNNING, log_path=log_path)
+            self.monitor.watch(run_id, proc, self._on_run_exit)
+        except Exception as e:
+            log.exception("start_run %s failed", run_id)
+            self._report(run_id, RunStatus.FAILED, info={"error": str(e)})
+
+    def _on_run_exit(self, run_id: str, returncode: int) -> None:
+        status = RunStatus.FINISHED if returncode == 0 else RunStatus.FAILED
+        self._report(run_id, status, returncode=returncode)
+
+    def _on_stop(self, msg: Message) -> None:
+        run_id = str(msg.get(MSG_ARG_RUN_ID))
+        if self.monitor.kill(run_id):
+            self._report(run_id, RunStatus.KILLED)
+
+    def _on_ota(self, msg: Message) -> None:
+        # reference ota_upgrade (client_runner.py:867) pip-upgrades and
+        # restarts the daemon; here we only acknowledge — package management
+        # is the operator's domain in a zero-egress environment.
+        log.info("agent %d: OTA request acknowledged (no-op)", self.device_id)
+
+    # -- status ------------------------------------------------------------
+    def _report(self, run_id: str, status: str,
+                returncode: Optional[int] = None,
+                log_path: Optional[str] = None,
+                info: Optional[Dict[str, Any]] = None) -> None:
+        self.run_db.set_status(run_id, self.device_id, status,
+                               returncode=returncode, log_path=log_path,
+                               info=info)
+        msg = Message(SchedulerMsgType.STATUS_UPDATE, self.device_id, 0)
+        msg.add(MSG_ARG_RUN_ID, run_id)
+        msg.add(MSG_ARG_STATUS, status)
+        if returncode is not None:
+            msg.add(MSG_ARG_RETURNCODE, returncode)
+        self.center.send_message(msg)
+
+
+__all__ = ["FedMLClientAgent"]

@@ -68,30 +68,36 @@ class FedMLCommManager(Observer):
 
     # -- backend selection (reference _init_manager :131) ------------------
     def _init_manager(self):
-        backend = str(self.backend)
-        run_id = str(getattr(self.args, "run_id", "0"))
-        if backend in ("local", "LOCAL"):
-            from .communication.local.local_comm_manager import LocalCommManager
-            self.com_manager = LocalCommManager(run_id, self.rank, self.size)
-        elif backend == "GRPC":
-            from .communication.grpc.grpc_comm_manager import GRPCCommManager
-            ip_config = getattr(self.args, "grpc_ipconfig", None) or {}
-            if not ip_config:
-                base = int(getattr(self.args, "grpc_base_port", 8890))
-                ip_config = {r: f"127.0.0.1:{base + r}" for r in range(self.size)}
-            host, port = ip_config[self.rank].rsplit(":", 1)
-            self.com_manager = GRPCCommManager(
-                host, int(port), ip_config, client_id=self.rank,
-                client_num=self.size)
-        elif backend in ("filestore", "FILESTORE"):
-            from .communication.filestore.filestore_comm_manager import (
-                FileStoreCommManager)
-            root = str(getattr(self.args, "filestore_dir", "/tmp/fedml_tpu_fs"))
-            self.com_manager = FileStoreCommManager(root, run_id, self.rank)
-        elif backend == "MQTT_S3":
-            from .communication.mqtt.mqtt_s3_comm_manager import (
-                MqttS3CommManager)
-            self.com_manager = MqttS3CommManager(self.args, self.rank, self.size)
-        else:
-            raise ValueError(f"unknown comm backend {backend!r}")
+        self.com_manager = create_comm_backend(
+            self.args, self.rank, self.size, self.backend)
         self.com_manager.add_observer(self)
+
+
+def create_comm_backend(args, rank: int, size: int,
+                        backend: str = "local") -> BaseCommunicationManager:
+    """Construct a bare communication backend (no observer attached) — used
+    by the FSM above and by the scheduler plane's message centers."""
+    backend = str(backend)
+    run_id = str(getattr(args, "run_id", "0"))
+    if backend in ("local", "LOCAL"):
+        from .communication.local.local_comm_manager import LocalCommManager
+        return LocalCommManager(run_id, rank, size)
+    if backend == "GRPC":
+        from .communication.grpc.grpc_comm_manager import GRPCCommManager
+        ip_config = getattr(args, "grpc_ipconfig", None) or {}
+        if not ip_config:
+            base = int(getattr(args, "grpc_base_port", 8890))
+            ip_config = {r: f"127.0.0.1:{base + r}" for r in range(size)}
+        host, port = ip_config[rank].rsplit(":", 1)
+        return GRPCCommManager(host, int(port), ip_config, client_id=rank,
+                               client_num=size)
+    if backend in ("filestore", "FILESTORE"):
+        from .communication.filestore.filestore_comm_manager import (
+            FileStoreCommManager)
+        root = str(getattr(args, "filestore_dir", "/tmp/fedml_tpu_fs"))
+        return FileStoreCommManager(root, run_id, rank)
+    if backend == "MQTT_S3":
+        from .communication.mqtt.mqtt_s3_comm_manager import (
+            MqttS3CommManager)
+        return MqttS3CommManager(args, rank, size)
+    raise ValueError(f"unknown comm backend {backend!r}")

@@ -160,10 +160,13 @@ def test_cli_commands():
     with tempfile.TemporaryDirectory() as d:
         job = os.path.join(d, "job.yaml")
         with open(job, "w") as f:
-            f.write("workspace: .\njob: echo hello_from_job > out.txt\n")
+            f.write("workspace: .\njob: echo hello_from_job\n")
+        # launch now routes through the scheduler plane: the job runs in an
+        # agent-fetched copy of the workspace; stdout lands in the run log
+        # which the CLI echoes back.
         r = CliRunner().invoke(cli, ["launch", job])
         assert r.exit_code == 0, r.output
-        assert open(os.path.join(d, "out.txt")).read().strip() == "hello_from_job"
+        assert "FINISHED" in r.output and "hello_from_job" in r.output
 
         data = os.path.join(d, "data.json")
         with open(data, "w") as f:

@@ -1,0 +1,274 @@
+"""Scheduler/launch-plane tests (reference behavior: ``fedml launch
+job.yaml`` end-to-end — SURVEY §2.5 "Launch/MLOps agents" + §3.4)."""
+
+from __future__ import annotations
+
+import os
+import textwrap
+import time
+
+import pytest
+import yaml
+
+from fedml_tpu.computing.scheduler.comm_utils.job_monitor import JobMonitor
+from fedml_tpu.computing.scheduler.scheduler_core.run_db import RunDB
+from fedml_tpu.computing.scheduler.scheduler_core.status import RunStatus
+from fedml_tpu.computing.scheduler.scheduler_entry.app_manager import (
+    build_job_package, fetch_job_package)
+from fedml_tpu.computing.scheduler.scheduler_entry.job_config import (
+    ComputingRequirements, FedMLJobConfig, rewrite_dynamic_args)
+from fedml_tpu.computing.scheduler.scheduler_entry.launch_manager import (
+    FedMLLaunchManager)
+from fedml_tpu.computing.scheduler.scheduler_entry.resource_manager import (
+    DeviceResource, ResourcePool, local_inventory)
+from fedml_tpu.computing.scheduler.slave.client_agent import FedMLClientAgent
+from fedml_tpu.core.distributed.fedml_comm_manager import create_comm_backend
+
+
+def _write_job(tmp_path, job_script, server_job="", bootstrap="",
+               computing=None):
+    ws = tmp_path / "workspace"
+    ws.mkdir(exist_ok=True)
+    (ws / "fedml_config.yaml").write_text(yaml.safe_dump(
+        {"common_args": {"run_id": "0"}}))
+    spec = {"workspace": "workspace", "job": job_script}
+    if server_job:
+        spec["server_job"] = server_job
+    if bootstrap:
+        spec["bootstrap"] = bootstrap
+    if computing:
+        spec["computing"] = computing
+    p = tmp_path / "job.yaml"
+    p.write_text(yaml.safe_dump(spec))
+    return str(p)
+
+
+class _Args:
+    def __init__(self, run_id):
+        self.run_id = run_id
+
+
+def _make_plane(tmp_path, n_agents=2, plane_id="sched-test"):
+    size = n_agents + 1
+    args = _Args(plane_id)
+    manager = FedMLLaunchManager(create_comm_backend(args, 0, size, "local"),
+                                 str(tmp_path / "store"))
+    manager.start()
+    agents = []
+    for i in range(1, size):
+        agent = FedMLClientAgent(i, create_comm_backend(args, i, size, "local"),
+                                 str(tmp_path / f"agent{i}"))
+        agent.start()
+        agents.append(agent)
+    assert manager.wait_for_agents(n_agents, timeout_s=5.0)
+    return manager, agents
+
+
+def test_job_config_parse(tmp_path):
+    path = _write_job(tmp_path, "echo hi", computing={
+        "minimum_num_gpus": 2, "device_type": "TPU"})
+    job = FedMLJobConfig.load(path)
+    assert job.job == "echo hi"
+    assert job.computing.minimum_num_gpus == 2
+    assert job.computing.device_type == "TPU"
+    assert os.path.isdir(job.workspace_dir)
+
+
+def test_rewrite_dynamic_args(tmp_path):
+    cfg = tmp_path / "fedml_config.yaml"
+    cfg.write_text(yaml.safe_dump({"common_args": {"run_id": "0"}}))
+    rewrite_dynamic_args(str(cfg), {"common_args.run_id": "r42",
+                                    "comm_args.backend": "GRPC"})
+    out = yaml.safe_load(cfg.read_text())
+    assert out["common_args"]["run_id"] == "r42"
+    assert out["comm_args"]["backend"] == "GRPC"
+
+
+def test_package_roundtrip_dedupe(tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "main.py").write_text("print('x')")
+    p1 = build_job_package(str(ws), str(tmp_path / "store"))
+    p2 = build_job_package(str(ws), str(tmp_path / "store"))
+    assert p1 == p2  # content-addressed
+    out = fetch_job_package(p1, str(tmp_path / "unpacked"))
+    assert (tmp_path / "unpacked" / "main.py").read_text() == "print('x')"
+    assert out == str(tmp_path / "unpacked")
+
+
+def test_resource_pool_match_release():
+    pool = ResourcePool()
+    pool.register(DeviceResource(1, num_chips=4, device_type="TPU"))
+    pool.register(DeviceResource(2, num_chips=1, device_type="TPU"))
+    req = ComputingRequirements(minimum_num_gpus=2, device_type="TPU")
+    got = pool.match(req, num_workers=1)
+    assert [d.device_id for d in got] == [1]
+    assert pool.match(req, num_workers=2) is None  # device 2 too small
+    pool.release([1], 2)
+    assert pool.devices()[0].chips_in_use == 0 or \
+        pool.devices()[1].chips_in_use == 0
+
+
+def test_local_inventory():
+    inv = local_inventory(7)
+    assert inv.device_id == 7
+    assert inv.num_cpus >= 1
+
+
+def test_job_monitor_detects_crash(tmp_path):
+    import subprocess
+    mon = JobMonitor(poll_interval_s=0.02)
+    mon.start()
+    seen = {}
+    proc = subprocess.Popen(["bash", "-c", "exit 3"])
+    mon.watch("r1", proc, lambda rid, rc: seen.setdefault(rid, rc))
+    deadline = time.time() + 5
+    while "r1" not in seen and time.time() < deadline:
+        time.sleep(0.02)
+    mon.stop()
+    assert seen.get("r1") == 3
+
+
+def test_run_db_upsert(tmp_path):
+    db = RunDB(str(tmp_path / "runs.db"))
+    db.set_status("r1", 1, RunStatus.RUNNING, log_path="/tmp/x.log")
+    db.set_status("r1", 1, RunStatus.FINISHED, returncode=0)
+    row = db.get_run("r1")[0]
+    assert row["status"] == RunStatus.FINISHED
+    assert row["returncode"] == 0
+    assert row["log_path"] == "/tmp/x.log"  # COALESCE keeps older value
+    db.close()
+
+
+def test_launch_end_to_end(tmp_path):
+    """Full path: job yaml → package → dispatch → agent spawns process →
+    statuses stream back → run terminal (reference §3.4 call stack)."""
+    manager, agents = _make_plane(tmp_path, n_agents=2)
+    try:
+        path = _write_job(
+            tmp_path,
+            job_script="cat fedml_config.yaml > out.txt; echo ran >> out.txt",
+            server_job="echo server > out.txt",
+            bootstrap="echo boot > boot.txt")
+        job = FedMLJobConfig.load(path)
+        run = manager.launch_job(job, num_workers=2)
+        assert run.done.wait(timeout=30), run.statuses
+        assert run.status == RunStatus.FINISHED
+        # worker 0 ran server_job, worker 1 the client job with rewritten
+        # dynamic args
+        ws0 = tmp_path / "agent1" / f"run_{run.run_id}"
+        ws1 = tmp_path / "agent2" / f"run_{run.run_id}"
+        assert (ws0 / "out.txt").read_text().strip() == "server"
+        out1 = (ws1 / "out.txt").read_text()
+        assert "ran" in out1
+        assert run.run_id in out1  # dynamic run_id injected into config
+        assert (ws0 / "boot.txt").read_text().strip() == "boot"
+    finally:
+        for a in agents:
+            a.stop()
+        manager.stop()
+
+
+def test_launch_failure_and_stop(tmp_path):
+    manager, agents = _make_plane(tmp_path, n_agents=1, plane_id="sched-f")
+    try:
+        path = _write_job(tmp_path, job_script="exit 9")
+        run = manager.launch_job(FedMLJobConfig.load(path), num_workers=1)
+        assert run.done.wait(timeout=30)
+        assert run.status == RunStatus.FAILED
+
+        path2 = _write_job(tmp_path, job_script="sleep 60")
+        run2 = manager.launch_job(FedMLJobConfig.load(path2), num_workers=1)
+        deadline = time.time() + 10
+        while run2.status != RunStatus.RUNNING and time.time() < deadline:
+            time.sleep(0.02)
+        manager.stop_run(run2.run_id)
+        assert run2.done.wait(timeout=10)
+        assert run2.status == RunStatus.KILLED
+    finally:
+        for a in agents:
+            a.stop()
+        manager.stop()
+
+
+def test_run_status_fallback_from_db(tmp_path):
+    """A fresh manager (new process in real life) answers run_status from
+    the persisted run DB."""
+    db_path = str(tmp_path / "master.db")
+    manager, agents = _make_plane_with_db(tmp_path, db_path, "sched-db")
+    try:
+        path = _write_job(tmp_path, job_script="echo done")
+        run = manager.launch_job(FedMLJobConfig.load(path), num_workers=1)
+        assert run.done.wait(timeout=30)
+    finally:
+        for a in agents:
+            a.stop()
+        manager.stop()
+    # "new process": fresh manager over the same DB, no in-memory run state
+    args = _Args("sched-db2")
+    fresh = FedMLLaunchManager(create_comm_backend(args, 0, 1, "local"),
+                               str(tmp_path / "store2"),
+                               run_db=RunDB(db_path))
+    assert fresh.run_status(run.run_id) == RunStatus.FINISHED
+    assert fresh.run_status("nonexistent") is None
+
+
+def _make_plane_with_db(tmp_path, db_path, plane_id):
+    args = _Args(plane_id)
+    manager = FedMLLaunchManager(create_comm_backend(args, 0, 2, "local"),
+                                 str(tmp_path / "store"),
+                                 run_db=RunDB(db_path))
+    manager.start()
+    agent = FedMLClientAgent(1, create_comm_backend(args, 1, 2, "local"),
+                             str(tmp_path / "agent1"))
+    agent.start()
+    assert manager.wait_for_agents(1, timeout_s=5.0)
+    return manager, [agent]
+
+
+def test_agent_stop_kills_running_jobs(tmp_path):
+    """Agent shutdown must not orphan spawned job processes."""
+    manager, agents = _make_plane(tmp_path, n_agents=1, plane_id="sched-k")
+    path = _write_job(tmp_path, job_script="sleep 300")
+    run = manager.launch_job(FedMLJobConfig.load(path), num_workers=1)
+    deadline = time.time() + 10
+    while run.status != RunStatus.RUNNING and time.time() < deadline:
+        time.sleep(0.02)
+    assert agents[0].monitor.running_count() == 1
+    for a in agents:
+        a.stop()
+    manager.stop()
+    assert agents[0].monitor.running_count() == 0
+    assert agents[0].run_db.get_status(run.run_id, 1) == RunStatus.KILLED
+
+
+def test_api_multi_worker(tmp_path, monkeypatch):
+    import fedml_tpu.api as api
+    monkeypatch.setenv("FEDML_TPU_HOME", str(tmp_path / "home"))
+    try:
+        path = _write_job(tmp_path, job_script="echo multi")
+        run = api.launch_job(path, num_workers=2, wait=True, timeout_s=30)
+        assert api.run_status(run.run_id) == RunStatus.FINISHED
+        assert len(run.device_ids) == 2
+    finally:
+        api.shutdown()
+
+
+def test_api_surface(tmp_path, monkeypatch):
+    """fedml_tpu.api mirrors reference fedml.api (launch_job/run_status/
+    run_logs/cluster_list/device_info)."""
+    import fedml_tpu.api as api
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setenv("FEDML_TPU_HOME", str(tmp_path / "home"))
+    try:
+        path = _write_job(tmp_path, job_script="echo api-ran")
+        run = api.launch_job(path, wait=True, timeout_s=30)
+        assert api.run_status(run.run_id) == RunStatus.FINISHED
+        assert any("api-ran" in ln for ln in api.run_logs(run.run_id))
+        assert len(api.cluster_list()) >= 1
+        assert api.device_info()["cpu_count"] >= 1
+        assert api.fedml_login("k") == 0
+        assert os.path.exists(tmp_path / ".fedml_tpu" / "credentials.json")
+        api.fedml_logout()
+    finally:
+        api.shutdown()

@@ -75,6 +75,12 @@ def _ensure_plane(min_agents: int = 1) -> Dict[str, Any]:
         for a in agents:
             a.start()
         if not manager.wait_for_agents(min_agents, timeout_s=10.0):
+            # tear down before raising — otherwise the started threads and
+            # the plane's comm-registry queues leak on every retry
+            for a in agents:
+                a.stop()
+            manager.stop()
+            local_comm_manager.reset_run(plane_id)
             raise RuntimeError("scheduler agents failed to register")
         _PLANE = {"manager": manager, "agents": agents, "work": work,
                   "plane_id": plane_id}

@@ -13,6 +13,7 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import signal
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -119,6 +120,8 @@ class FedMLLaunchManager:
                    run_id: Optional[str] = None) -> LaunchedRun:
         """Match resources, dispatch, return the tracked run (non-blocking:
         use run.done.wait())."""
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         run_id = run_id or f"run{next(self._ids)}-{os.getpid()}"
         with self._lock:
             matched = self.pool.match(job.computing, num_workers)
@@ -146,20 +149,37 @@ class FedMLLaunchManager:
             msg.add(MSG_ARGS.ENTRY, entry)
             msg.add(MSG_ARGS.ENV, dict(job.env))
             msg.add(MSG_ARGS.DYNAMIC_ARGS, dynamic)
-            self.center.send_message(msg)
+            # persist QUEUED before dispatch — the agent's status stream can
+            # land on the receive thread immediately, and a later QUEUED
+            # upsert would clobber a terminal status
             self.run_db.set_status(run_id, dev.device_id, RunStatus.QUEUED)
+            self.center.send_message(msg)
         return run
 
     def stop_run(self, run_id: str) -> None:
         run = self.runs.get(run_id)
         if run is not None:
             device_ids = run.device_ids
-        else:  # cross-process stop via the persisted run DB
-            device_ids = [r["device_id"] for r in self.run_db.get_run(run_id)]
-        for did in device_ids:
-            msg = Message(SchedulerMsgType.STOP_RUN, 0, did)
-            msg.add(MSG_ARGS.RUN_ID, run_id)
-            self.center.send_message(msg)
+            for did in device_ids:
+                msg = Message(SchedulerMsgType.STOP_RUN, 0, did)
+                msg.add(MSG_ARGS.RUN_ID, run_id)
+                self.center.send_message(msg)
+            return
+        # Cross-process stop: the agents holding the job live in another
+        # process, unreachable over this plane's in-memory backend.  Kill by
+        # the pid persisted in the shared run DB instead.
+        for row in self.run_db.get_run(run_id):
+            if RunStatus.is_terminal(row["status"]):
+                continue
+            pid = (row.get("info") or {}).get("pid")
+            if pid:
+                try:
+                    os.kill(int(pid), signal.SIGTERM)
+                    self.run_db.set_status(run_id, row["device_id"],
+                                           RunStatus.KILLED)
+                except (ProcessLookupError, PermissionError) as e:
+                    log.warning("cross-process stop of run %s pid %s: %s",
+                                run_id, pid, e)
 
     # -- status ingest -----------------------------------------------------
     def _on_status(self, msg: Message) -> None:
@@ -167,7 +187,8 @@ class FedMLLaunchManager:
         status = str(msg.get(MSG_ARGS.STATUS))
         device_id = msg.get_sender_id()
         self.run_db.set_status(run_id, device_id, status,
-                               returncode=msg.get(MSG_ARGS.RETURNCODE))
+                               returncode=msg.get(MSG_ARGS.RETURNCODE),
+                               info=msg.get("info"))
         run = self.runs.get(run_id)
         if run is not None:
             run.update(device_id, status)

@@ -31,28 +31,18 @@ class DeviceResource:
 
 
 def local_inventory(device_id: int = 0) -> DeviceResource:
-    """Inventory of this host (accelerator probe is timeout-guarded — see
-    ``comm_utils.sys_utils._probe_accelerator``)."""
-    import os as _os
-    from ..comm_utils.sys_utils import _probe_accelerator
-    timeout_s = float(_os.environ.get("FEDML_TPU_DEVICE_PROBE_TIMEOUT", "15"))
-    platform, num_chips, _ = _probe_accelerator(timeout_s)
+    """Inventory of this host, built from the same introspection the agents
+    report (``comm_utils.sys_utils.get_sys_runner_info`` — accelerator probe
+    timeout-guarded there)."""
+    from ..comm_utils.sys_utils import get_sys_runner_info
+    info = get_sys_runner_info()
+    platform = str(info.get("accelerator", "none"))
     platform = platform.upper() if platform != "none" else "CPU"
-    if platform == "CPU":
-        num_chips = 0
-    mem = 0
-    try:
-        with open("/proc/meminfo") as f:
-            for line in f:
-                if line.startswith("MemTotal:"):
-                    mem = int(line.split()[1]) * 1024
-                    break
-    except OSError:
-        pass
+    num_chips = int(info.get("num_chips", 0)) if platform != "CPU" else 0
     return DeviceResource(
-        device_id=device_id, num_chips=num_chips,
-        device_type=platform if platform != "CPU" else "CPU",
-        num_cpus=os.cpu_count() or 1, mem_bytes=mem)
+        device_id=device_id, num_chips=num_chips, device_type=platform,
+        num_cpus=int(info.get("cpu_count", 1)),
+        mem_bytes=int(info.get("mem_total_bytes", 0)))
 
 
 class ResourcePool:

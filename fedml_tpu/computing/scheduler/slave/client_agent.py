@@ -51,6 +51,11 @@ class FedMLClientAgent:
         self.center.add_listener(SchedulerMsgType.STOP_RUN, self._on_stop)
         self.center.add_listener(SchedulerMsgType.OTA_UPGRADE, self._on_ota)
         self._run_env: Dict[str, Dict[str, str]] = {}
+        # stop-before-start race guard: a STOP_RUN that lands while
+        # _start_run is still provisioning must suppress the spawn
+        self._stop_lock = threading.Lock()
+        self._stopped_runs: set = set()
+        self._draining = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -59,6 +64,8 @@ class FedMLClientAgent:
         self._register()
 
     def stop(self) -> None:
+        with self._stop_lock:
+            self._draining = True  # suppress any in-flight _start_run spawn
         for run_id in self.monitor.watched_runs():
             if self.monitor.kill(run_id):
                 self._report(run_id, RunStatus.KILLED)
@@ -82,8 +89,15 @@ class FedMLClientAgent:
                          args=(run_id, pkg, entry, env, dynamic),
                          daemon=True).start()
 
+    def _run_aborted(self, run_id: str) -> bool:
+        with self._stop_lock:
+            return self._draining or run_id in self._stopped_runs
+
     def _start_run(self, run_id: str, pkg: str, entry: str,
                    env: Dict[str, str], dynamic: Dict[str, Any]) -> None:
+        if self._run_aborted(run_id):
+            self._report(run_id, RunStatus.KILLED)
+            return
         self._report(run_id, RunStatus.PROVISIONING)
         try:
             ws = fetch_job_package(
@@ -97,12 +111,19 @@ class FedMLClientAgent:
             full_env.update(env)
             full_env["FEDML_RUN_ID"] = run_id
             full_env["FEDML_DEVICE_ID"] = str(self.device_id)
+            if self._run_aborted(run_id):
+                self._report(run_id, RunStatus.KILLED)
+                return
             with open(log_path, "ab") as logf:
                 proc = subprocess.Popen(
                     ["bash", "-c", entry], cwd=ws, env=full_env,
                     stdout=logf, stderr=subprocess.STDOUT)
-            self._report(run_id, RunStatus.RUNNING, log_path=log_path)
+            self._report(run_id, RunStatus.RUNNING, log_path=log_path,
+                         info={"pid": proc.pid})
             self.monitor.watch(run_id, proc, self._on_run_exit)
+            # re-check: a stop may have swept between Popen and watch()
+            if self._run_aborted(run_id) and self.monitor.kill(run_id):
+                self._report(run_id, RunStatus.KILLED)
         except Exception as e:
             log.exception("start_run %s failed", run_id)
             self._report(run_id, RunStatus.FAILED, info={"error": str(e)})
@@ -113,6 +134,8 @@ class FedMLClientAgent:
 
     def _on_stop(self, msg: Message) -> None:
         run_id = str(msg.get(MSG_ARG_RUN_ID))
+        with self._stop_lock:
+            self._stopped_runs.add(run_id)
         if self.monitor.kill(run_id):
             self._report(run_id, RunStatus.KILLED)
 
@@ -135,6 +158,9 @@ class FedMLClientAgent:
         msg.add(MSG_ARG_STATUS, status)
         if returncode is not None:
             msg.add(MSG_ARG_RETURNCODE, returncode)
+        if info is not None:
+            msg.add("info", info)  # e.g. pid — master persists it for
+            # cross-process stop_run
         self.center.send_message(msg)
 
 

@@ -1,0 +1,13 @@
+"""fedml_tpu.core — public core surface (reference ``python/fedml/core/__init__.py``
+exports the alg-frame ABCs, Params/Context, and the Flow DSL)."""
+
+from .alg_frame.client_trainer import ClientTrainer
+from .alg_frame.context import Context
+from .alg_frame.params import Params
+from .alg_frame.server_aggregator import ServerAggregator
+from .distributed.flow import FedMLAlgorithmFlow, FedMLExecutor
+
+__all__ = [
+    "ClientTrainer", "Context", "Params", "ServerAggregator",
+    "FedMLAlgorithmFlow", "FedMLExecutor",
+]

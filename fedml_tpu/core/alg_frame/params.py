@@ -8,6 +8,8 @@ from __future__ import annotations
 class Params:
     """Reference: ``python/fedml/core/alg_frame/params.py:8``."""
 
+    KEY_MODEL_PARAMS = "model_params"
+
     def __init__(self, **kwargs):
         self.__dict__.update(kwargs)
 

@@ -33,6 +33,19 @@ MSG_ARG_KEY_EVENT_NAME = "event_name"
 class Message:
     MSG_TYPE_CONNECTION_IS_READY = 0
 
+    # class-attr aliases (reference Message exposes these on the class)
+    MSG_ARG_KEY_TYPE = MSG_ARG_KEY_TYPE
+    MSG_ARG_KEY_OPERATION = MSG_ARG_KEY_OPERATION
+    MSG_ARG_KEY_SENDER = MSG_ARG_KEY_SENDER
+    MSG_ARG_KEY_RECEIVER = MSG_ARG_KEY_RECEIVER
+    MSG_ARG_KEY_NUM_SAMPLES = MSG_ARG_KEY_NUM_SAMPLES
+    MSG_ARG_KEY_MODEL_PARAMS = MSG_ARG_KEY_MODEL_PARAMS
+    MSG_ARG_KEY_MODEL_PARAMS_URL = MSG_ARG_KEY_MODEL_PARAMS_URL
+    MSG_ARG_KEY_CLIENT_INDEX = MSG_ARG_KEY_CLIENT_INDEX
+    MSG_ARG_KEY_CLIENT_STATUS = MSG_ARG_KEY_CLIENT_STATUS
+    MSG_ARG_KEY_CLIENT_OS = MSG_ARG_KEY_CLIENT_OS
+    MSG_ARG_KEY_EVENT_NAME = MSG_ARG_KEY_EVENT_NAME
+
     def __init__(self, msg_type: int = 0, sender_id: int = 0,
                  receiver_id: int = 0):
         self.msg_params: Dict[str, Any] = {
@@ -54,8 +67,14 @@ class Message:
     def get_receiver_id(self) -> int:
         return int(self.msg_params[MSG_ARG_KEY_RECEIVER])
 
-    def get_type(self) -> int:
-        return int(self.msg_params[MSG_ARG_KEY_TYPE])
+    def get_type(self):
+        # ints for FSM protocols; flow-name strings for the Flow DSL
+        # (reference fedml_flow.py:199 keys messages by flow name).
+        t = self.msg_params[MSG_ARG_KEY_TYPE]
+        try:
+            return int(t)
+        except (TypeError, ValueError):
+            return str(t)
 
     def add_params(self, key: str, value: Any):
         self.msg_params[key] = value

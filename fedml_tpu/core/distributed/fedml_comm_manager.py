@@ -20,6 +20,15 @@ from .communication.message import Message
 log = logging.getLogger(__name__)
 
 
+def _norm_msg_key(msg_type):
+    """FSM msg types are ints; the Flow DSL keys messages by flow-name
+    strings (reference ``fedml_flow.py:199`` sends ``Message(flow_name, ...)``)."""
+    try:
+        return int(msg_type)
+    except (TypeError, ValueError):
+        return str(msg_type)
+
+
 class FedMLCommManager(Observer):
     def __init__(self, args, comm=None, rank: int = 0, size: int = 0,
                  backend: str = "local"):
@@ -44,9 +53,9 @@ class FedMLCommManager(Observer):
         return self.rank
 
     def receive_message(self, msg_type, msg_params) -> None:
-        handler = self.message_handler_dict.get(int(msg_type))
+        handler = self.message_handler_dict.get(_norm_msg_key(msg_type))
         if handler is None:
-            if int(msg_type) != Message.MSG_TYPE_CONNECTION_IS_READY:
+            if _norm_msg_key(msg_type) != Message.MSG_TYPE_CONNECTION_IS_READY:
                 log.warning("rank %d: no handler for msg_type %s",
                             self.rank, msg_type)
             return
@@ -55,9 +64,9 @@ class FedMLCommManager(Observer):
     def send_message(self, message: Message):
         self.com_manager.send_message(message)
 
-    def register_message_receive_handler(self, msg_type: int,
+    def register_message_receive_handler(self, msg_type,
                                          handler_callback_func: Callable):
-        self.message_handler_dict[int(msg_type)] = handler_callback_func
+        self.message_handler_dict[_norm_msg_key(msg_type)] = handler_callback_func
 
     def register_message_receive_handlers(self):
         """Subclasses register their FSM handlers here."""

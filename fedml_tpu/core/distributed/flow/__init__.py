@@ -1,0 +1,4 @@
+from .fedml_executor import FedMLExecutor
+from .fedml_flow import FedMLAlgorithmFlow
+
+__all__ = ["FedMLExecutor", "FedMLAlgorithmFlow"]

@@ -1,0 +1,47 @@
+"""FedMLExecutor — the node-role base class of the Flow DSL (reference
+``python/fedml/core/distributed/flow/fedml_executor.py:4``).
+
+A flow program is written as plain methods on ``FedMLExecutor`` subclasses
+(one subclass per role, e.g. ``Server``/``Client``); the flow engine routes
+each step to the nodes whose executor is an instance of the class that
+defined the step.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional
+
+from ...alg_frame.params import Params
+
+
+class FedMLExecutor(abc.ABC):
+    def __init__(self, id: int, neighbor_id_list: List[int]):
+        self.id = int(id)
+        self.neighbor_id_list = list(neighbor_id_list)
+        self.context = None
+        self.params: Optional[Params] = None
+
+    def get_context(self):
+        return self.context
+
+    def set_context(self, context):
+        self.context = context
+
+    def get_params(self) -> Optional[Params]:
+        return self.params
+
+    def set_params(self, params: Optional[Params]):
+        self.params = params
+
+    def set_id(self, id: int):
+        self.id = int(id)
+
+    def set_neighbor_id_list(self, neighbor_id_list: List[int]):
+        self.neighbor_id_list = list(neighbor_id_list)
+
+    def get_id(self) -> int:
+        return self.id
+
+    def get_neighbor_id_list(self) -> List[int]:
+        return self.neighbor_id_list

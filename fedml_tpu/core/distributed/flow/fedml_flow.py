@@ -1,0 +1,197 @@
+"""FedMLAlgorithmFlow — declarative multi-step federation programs
+(reference ``python/fedml/core/distributed/flow/fedml_flow.py:20``).
+
+The DSL: ``add_flow(name, ExecutorClass.method)`` chains steps; ``build()``
+freezes the chain; ``run()`` starts a neighbor-liveness handshake and then
+drives the chain as a message-passing FSM over any comm backend.  Each step
+runs on the nodes whose executor is an instance of the class that defined
+the step's method; its returned ``Params`` are forwarded (as one Message per
+receiver) to the owners of the *next* step.  Returning ``None`` from a step
+terminates that propagation branch — the fan-in idiom the reference's
+``Server.server_aggregate`` uses to wait for all clients
+(``test_fedml_flow.py:66-77``).
+
+TPU-era notes: payloads ride the Message data plane (flax msgpack, not
+pickle); the engine is backend-agnostic so the same flow program runs over
+the in-memory ``local`` backend in unit tests and gRPC/MQTT cross-host.
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...alg_frame.params import Params
+from ..communication.message import Message
+from ..fedml_comm_manager import FedMLCommManager
+from .fedml_executor import FedMLExecutor
+from .fedml_flow_constants import (
+    MSG_TYPE_FLOW_FINISH,
+    MSG_TYPE_NEIGHBOR_CHECK_NODE_STATUS,
+    MSG_TYPE_NEIGHBOR_REPORT_NODE_STATUS,
+    PARAMS_KEY_RECEIVER_ID,
+    PARAMS_KEY_SENDER_ID,
+)
+
+log = logging.getLogger(__name__)
+
+_FlowEntry = Tuple[str, Callable, str, str]  # (name, task, owner_cls_name, tag)
+
+
+class FedMLAlgorithmFlow(FedMLCommManager):
+    ONCE = "FLOW_TAG_ONCE"
+    FINISH = "FLOW_TAG_FINISH"
+
+    def __init__(self, args, executor: FedMLExecutor, backend: str = None,
+                 size: int = None):
+        self.executor = executor
+        self.executor_cls_name = executor.__class__.__name__
+        self.flow_sequence: List[_FlowEntry] = []
+        self.flow_next_map: Dict[str, Optional[_FlowEntry]] = {}
+        self.flow_current_map: Dict[str, _FlowEntry] = {}
+        self.flow_sequence_executed: List[str] = []
+        self.neighbor_node_online_map: Dict[str, bool] = {}
+        self.is_all_neighbor_connected = False
+        self._built = False
+        size = int(size if size is not None
+                   else getattr(args, "worker_num", len(executor.get_neighbor_id_list()) + 1))
+        backend = backend or getattr(args, "backend", "local")
+        super().__init__(args, comm=getattr(args, "comm", None),
+                         rank=executor.get_id(), size=size, backend=backend)
+
+    # -- DSL surface (reference :66,:74,:77) -------------------------------
+    def add_flow(self, flow_name: str, executor_task: Callable,
+                 flow_tag: str = ONCE) -> "FedMLAlgorithmFlow":
+        owner = _class_that_defined_method(executor_task)
+        # Uniquify repeated names (reference appends per-round flows with the
+        # same name inside the comm_round loop).
+        unique = f"{flow_name}#{len(self.flow_sequence)}"
+        self.flow_sequence.append((unique, executor_task, owner, flow_tag))
+        return self
+
+    def build(self):
+        if not self.flow_sequence:
+            raise ValueError("empty flow: call add_flow() before build()")
+        # Force the last flow to carry the FINISH tag (reference build():96-113).
+        name, task, owner, _ = self.flow_sequence[-1]
+        self.flow_sequence[-1] = (name, task, owner, self.FINISH)
+        for i, entry in enumerate(self.flow_sequence):
+            self.flow_current_map[entry[0]] = entry
+            self.flow_next_map[entry[0]] = (
+                self.flow_sequence[i + 1] if i + 1 < len(self.flow_sequence) else None)
+        self._built = True
+        return self
+
+    def run(self):
+        if not self._built:
+            self.build()
+        super().run()
+
+    # -- FSM wiring --------------------------------------------------------
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_CONNECTION_IS_READY, self._handle_connection_ready)
+        self.register_message_receive_handler(
+            MSG_TYPE_NEIGHBOR_CHECK_NODE_STATUS, self._handle_neighbor_check_node_status)
+        self.register_message_receive_handler(
+            MSG_TYPE_NEIGHBOR_REPORT_NODE_STATUS, self._handle_neighbor_report_node_status)
+        self.register_message_receive_handler(
+            MSG_TYPE_FLOW_FINISH, self._handle_flow_finish)
+        for name, _, _, _ in self.flow_sequence:
+            self.register_message_receive_handler(name, self._handle_message_received)
+
+    # -- liveness handshake (reference :237-279) ---------------------------
+    def _handle_connection_ready(self, msg_params):
+        if self.is_all_neighbor_connected:
+            return
+        for receiver_id in self.executor.get_neighbor_id_list():
+            self._send_control(MSG_TYPE_NEIGHBOR_CHECK_NODE_STATUS, receiver_id)
+            self._send_control(MSG_TYPE_NEIGHBOR_REPORT_NODE_STATUS, receiver_id)
+
+    def _handle_neighbor_check_node_status(self, msg_params):
+        self._send_control(MSG_TYPE_NEIGHBOR_REPORT_NODE_STATUS,
+                           msg_params.get_sender_id())
+
+    def _handle_neighbor_report_node_status(self, msg_params):
+        self.neighbor_node_online_map[str(msg_params.get_sender_id())] = True
+        if all(self.neighbor_node_online_map.get(str(n), False)
+               for n in self.executor.get_neighbor_id_list()):
+            if not self.is_all_neighbor_connected:
+                self.is_all_neighbor_connected = True
+                self._on_ready_to_run_flow()
+
+    def _send_control(self, msg_type, receiver_id):
+        self.send_message(Message(msg_type, self.executor.get_id(), receiver_id))
+
+    # -- execution (reference :116-235) ------------------------------------
+    def _on_ready_to_run_flow(self):
+        first = self.flow_sequence[0]
+        if self.executor_cls_name == first[2]:
+            self._execute_flow(None, first)
+
+    def _handle_message_received(self, msg_params):
+        executed_name = msg_params.get_type()
+        flow_params = Params()
+        for key, value in msg_params.get_params().items():
+            flow_params.add(key, value)
+        nxt = self.flow_next_map[str(executed_name)]
+        if nxt is not None:
+            self._execute_flow(flow_params, nxt)
+
+    def _execute_flow(self, flow_params: Optional[Params], entry: _FlowEntry):
+        flow_name, executor_task, owner_cls, flow_tag = entry
+        if self.executor_cls_name != owner_cls:
+            raise RuntimeError(
+                f"flow {flow_name!r} belongs to executor {owner_cls}, not "
+                f"{self.executor_cls_name}; executed so far: {self.flow_sequence_executed}")
+        self.executor.set_params(flow_params)
+        params = executor_task(self.executor)
+        self.flow_sequence_executed.append(flow_name)
+        nxt = self.flow_next_map[flow_name]
+        if nxt is None or flow_tag == self.FINISH:
+            self._shutdown()
+            return
+        if params is None:
+            log.debug("flow %s returned None: propagation terminated here", flow_name)
+            return
+        params.add(PARAMS_KEY_SENDER_ID, self.executor.get_id())
+        if nxt[2] == self.executor_cls_name:
+            # Next step also runs here: short-circuit locally (reference :223).
+            params.add(PARAMS_KEY_RECEIVER_ID, [self.executor.get_id()])
+            msg = self._params_to_message(flow_name, params, self.executor.get_id())
+            self._handle_message_received(msg)
+        else:
+            receivers = self.executor.get_neighbor_id_list()
+            params.add(PARAMS_KEY_RECEIVER_ID, receivers)
+            for rid in receivers:
+                self.send_message(self._params_to_message(flow_name, params, rid))
+
+    def _params_to_message(self, flow_name: str, params: Params, receiver_id: int) -> Message:
+        msg = Message(flow_name, self.executor.get_id(), receiver_id)
+        for key in params.keys():
+            if key == Message.MSG_ARG_KEY_TYPE:
+                continue
+            msg.add_params(key, params.get(key))
+        return msg
+
+    def _handle_flow_finish(self, msg_params):
+        self.finish()
+
+    def _shutdown(self):
+        for rid in self.executor.get_neighbor_id_list():
+            self.send_message(Message(MSG_TYPE_FLOW_FINISH,
+                                      self.executor.get_id(), rid))
+        self.finish()
+
+
+def _class_that_defined_method(meth: Callable) -> str:
+    """Owner-class name of a (possibly unbound) method (reference :281)."""
+    if inspect.ismethod(meth):
+        for cls in inspect.getmro(meth.__self__.__class__):
+            if cls.__dict__.get(meth.__name__) is meth:
+                return cls.__name__
+        meth = meth.__func__
+    qual = getattr(meth, "__qualname__", "")
+    cls_name = qual.split(".<locals>", 1)[0].rsplit(".", 1)[0]
+    return cls_name or meth.__name__

@@ -1,0 +1,99 @@
+"""Flow DSL: 3-node FedAvg flow program over the in-memory backend
+(mirrors reference ``core/distributed/flow/test_fedml_flow.py``)."""
+
+import threading
+import types
+
+import numpy as np
+
+from fedml_tpu.core import FedMLAlgorithmFlow, FedMLExecutor, Params
+from fedml_tpu.core.distributed.communication.local.local_comm_manager import reset_run
+
+ROUNDS = 2
+
+
+class Client(FedMLExecutor):
+    def __init__(self, args):
+        super().__init__(args.rank, [0])
+        self.trained = 0
+
+    def handle_init_global_model(self):
+        received = self.get_params()
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, received.get(Params.KEY_MODEL_PARAMS))
+        return params
+
+    def local_training(self):
+        w = np.asarray(self.get_params().get(Params.KEY_MODEL_PARAMS))
+        self.trained += 1
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, w + self.get_id())
+        return params
+
+
+class Server(FedMLExecutor):
+    def __init__(self, args):
+        super().__init__(args.rank, [1, 2])
+        self.client_num = 2
+        self.buffer = []
+        self.history = []
+
+    def init_global_model(self):
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, np.zeros(3))
+        return params
+
+    def server_aggregate(self):
+        w = np.asarray(self.get_params().get(Params.KEY_MODEL_PARAMS))
+        self.buffer.append(w)
+        if len(self.buffer) < self.client_num:
+            return None  # fan-in: wait for the other client
+        avg = np.mean(self.buffer, axis=0)
+        self.buffer = []
+        self.history.append(avg)
+        params = Params()
+        params.add(Params.KEY_MODEL_PARAMS, avg)
+        return params
+
+    def final_eval(self):
+        return None
+
+
+def _build_flow(args, executor):
+    flow = FedMLAlgorithmFlow(args, executor, backend="local", size=3)
+    flow.add_flow("init_global_model", Server.init_global_model)
+    flow.add_flow("handle_init", Client.handle_init_global_model)
+    for _ in range(ROUNDS):
+        flow.add_flow("local_training", Client.local_training)
+        flow.add_flow("server_aggregate", Server.server_aggregate)
+    flow.add_flow("final_eval", Server.final_eval)
+    flow.build()
+    return flow
+
+
+def test_flow_fedavg_three_nodes():
+    reset_run("flowtest")
+    flows = []
+    threads = []
+    server = None
+    for rank in range(3):
+        args = types.SimpleNamespace(rank=rank, run_id="flowtest", worker_num=3)
+        executor = Server(args) if rank == 0 else Client(args)
+        if rank == 0:
+            server = executor
+        flow = _build_flow(args, executor)
+        flows.append(flow)
+    for flow in flows:
+        t = threading.Thread(target=flow.run, daemon=True)
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert all(not t.is_alive() for t in threads), "flow FSM did not terminate"
+    # Round 1: both clients receive zeros, return rank -> avg = 1.5.
+    # Round 2: each client receives 1.5 and adds its rank again; but the
+    # server's aggregate fan-out goes to BOTH clients, so round-2 inputs are
+    # avg(1.5+1, 1.5+2) = 3.0.
+    assert len(server.history) == ROUNDS
+    np.testing.assert_allclose(server.history[0], 1.5)
+    np.testing.assert_allclose(server.history[1], 3.0)

@@ -1,0 +1,8 @@
+"""LightSecAgg cross-silo federation (reference
+``python/fedml/cross_silo/lightsecagg/`` — ``lsa_fedml_api.py`` surface)."""
+
+from .lsa_fedml_client_manager import LSAClientManager
+from .lsa_fedml_server_manager import LSAServerManager
+from .lsa_message_define import MyMessage
+
+__all__ = ["LSAClientManager", "LSAServerManager", "MyMessage"]

@@ -1,0 +1,8 @@
+"""SecAgg cross-silo federation (reference
+``python/fedml/cross_silo/secagg/`` — ``sa_fedml_api.py`` surface)."""
+
+from .sa_fedml_client_manager import SAClientManager
+from .sa_fedml_server_manager import SAServerManager
+from .sa_message_define import MyMessage
+
+__all__ = ["SAClientManager", "SAServerManager", "MyMessage"]

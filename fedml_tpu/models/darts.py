@@ -1,0 +1,130 @@
+"""DARTS differentiable architecture search network (reference
+``python/fedml/model/cv/darts/`` — model_search.py MixedOp/Cell/Network,
+used by ``simulation/mpi/fednas/``).
+
+TPU-native design: the candidate-op outputs of a MixedOp are computed as a
+stacked tensor and contracted with softmax(alpha) in one einsum — no Python
+branching on architecture, so the whole supernet is a single XLA program and
+the alpha gradient flows through the contraction.  Architecture parameters
+live in the regular param tree under ``alphas_*`` so federated averaging of
+weights AND architecture (FedNAS) is ordinary tree averaging.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+PRIMITIVES = ("none", "skip_connect", "conv_3x3", "sep_conv_3x3",
+              "avg_pool_3x3", "max_pool_3x3")
+
+
+class _Op(nn.Module):
+    op_name: str
+    channels: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        s = (self.stride, self.stride)
+        if self.op_name == "none":
+            if self.stride > 1:
+                x = nn.avg_pool(x, (1, 1), strides=s)
+            return jnp.zeros_like(x)
+        if self.op_name == "skip_connect":
+            if self.stride == 1:
+                return x
+            return nn.Conv(self.channels, (1, 1), strides=s, use_bias=False)(x)
+        if self.op_name == "conv_3x3":
+            y = nn.relu(x)
+            y = nn.Conv(self.channels, (3, 3), strides=s, padding="SAME",
+                        use_bias=False)(y)
+            return nn.GroupNorm(num_groups=min(8, self.channels))(y)
+        if self.op_name == "sep_conv_3x3":
+            y = nn.relu(x)
+            y = nn.Conv(x.shape[-1], (3, 3), strides=s, padding="SAME",
+                        feature_group_count=x.shape[-1], use_bias=False)(y)
+            y = nn.Conv(self.channels, (1, 1), use_bias=False)(y)
+            return nn.GroupNorm(num_groups=min(8, self.channels))(y)
+        if self.op_name == "avg_pool_3x3":
+            return nn.avg_pool(x, (3, 3), strides=s, padding="SAME")
+        if self.op_name == "max_pool_3x3":
+            return nn.max_pool(x, (3, 3), strides=s, padding="SAME")
+        raise ValueError(self.op_name)
+
+
+class MixedOp(nn.Module):
+    channels: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, weights):
+        outs = [_Op(p, self.channels, self.stride)(x) for p in PRIMITIVES]
+        stacked = jnp.stack(outs, axis=0)          # (O, B, H, W, C)
+        return jnp.einsum("o,obhwc->bhwc", weights, stacked)
+
+
+class Cell(nn.Module):
+    """DARTS cell: ``steps`` intermediate nodes, each summing mixed-op edges
+    from all predecessors; output = concat of intermediate nodes."""
+
+    channels: int
+    steps: int = 3
+    reduction: bool = False
+
+    @nn.compact
+    def __call__(self, x, alphas):
+        # alphas: (num_edges, len(PRIMITIVES)) logits
+        weights = nn.softmax(alphas, axis=-1)
+        states = [nn.Conv(self.channels, (1, 1), use_bias=False)(x)]
+        offset = 0
+        for i in range(self.steps):
+            acc = 0.0
+            for j, h in enumerate(states):
+                stride = 2 if (self.reduction and j == 0) else 1
+                acc = acc + MixedOp(self.channels, stride)(h, weights[offset])
+                offset += 1
+            states.append(acc)
+        return jnp.concatenate(states[1:], axis=-1)
+
+    @staticmethod
+    def num_edges(steps: int = 3) -> int:
+        return sum(1 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Supernet: stem → normal cell → reduction cell → head (reference
+    ``model_search.Network``).  ``alphas_normal``/``alphas_reduce`` are
+    params, so `params["alphas_normal"]` is the architecture."""
+
+    num_classes: int = 10
+    channels: int = 16
+    steps: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        e = Cell.num_edges(self.steps)
+        a_n = self.param("alphas_normal", nn.initializers.normal(1e-3),
+                         (e, len(PRIMITIVES)))
+        a_r = self.param("alphas_reduce", nn.initializers.normal(1e-3),
+                         (e, len(PRIMITIVES)))
+        x = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=8)(x)
+        x = Cell(self.channels, self.steps, reduction=False)(x, a_n)
+        x = Cell(self.channels, self.steps, reduction=True)(x, a_r)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def derive_genotype(params) -> dict:
+    """Discrete architecture: per edge, the argmax non-``none`` primitive
+    (reference ``model_search.Network.genotype``)."""
+    out = {}
+    for key in ("alphas_normal", "alphas_reduce"):
+        a = jnp.asarray(params[key])
+        masked = a.at[:, PRIMITIVES.index("none")].set(-jnp.inf)
+        idx = jnp.argmax(masked, axis=-1)
+        out[key] = [PRIMITIVES[int(i)] for i in idx]
+    return out

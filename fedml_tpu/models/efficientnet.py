@@ -1,0 +1,71 @@
+"""EfficientNet-lite (reference ``python/fedml/model/cv/efficientnet*`` —
+the model_hub ``efficientnet`` entry).
+
+B0-shaped MBConv stack scaled down for federated vision sets; GroupNorm
+replaces BatchNorm (running statistics don't federate), swish activations,
+squeeze-excite.  1x1 expansions are MXU matmuls; depthwise convs ride the
+VPU."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MBConv(nn.Module):
+    filters: int
+    expand_ratio: int = 4
+    kernel: int = 3
+    strides: int = 1
+    se_reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        mid = inp * self.expand_ratio
+        y = x
+        if self.expand_ratio != 1:
+            y = nn.Conv(mid, (1, 1), use_bias=False)(y)
+            y = nn.swish(nn.GroupNorm(num_groups=min(8, mid))(y))
+        y = nn.Conv(mid, (self.kernel, self.kernel),
+                    strides=(self.strides, self.strides), padding="SAME",
+                    feature_group_count=mid, use_bias=False)(y)
+        y = nn.swish(nn.GroupNorm(num_groups=min(8, mid))(y))
+        # squeeze-excite
+        s = jnp.mean(y, axis=(1, 2), keepdims=True)
+        s = nn.swish(nn.Conv(max(inp // self.se_reduce, 4), (1, 1))(s))
+        s = nn.sigmoid(nn.Conv(mid, (1, 1))(s))
+        y = y * s
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.filters))(y)
+        if self.strides == 1 and inp == self.filters:
+            y = y + x
+        return y
+
+
+class EfficientNetLite(nn.Module):
+    num_classes: int = 10
+    #: (filters, expand, kernel, strides, repeats) per stage — B0-lite
+    stages: Sequence[Tuple[int, int, int, int, int]] = (
+        (16, 1, 3, 1, 1),
+        (24, 4, 3, 2, 2),
+        (40, 4, 5, 2, 2),
+        (80, 4, 3, 2, 2),
+        (112, 4, 5, 1, 1),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False)(x)
+        x = nn.swish(nn.GroupNorm(num_groups=8)(x))
+        for filters, expand, kernel, strides, repeats in self.stages:
+            for r in range(repeats):
+                x = MBConv(filters, expand, kernel,
+                           strides if r == 0 else 1)(x)
+        x = nn.Conv(192, (1, 1), use_bias=False)(x)
+        x = nn.swish(nn.GroupNorm(num_groups=8)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,52 @@
+"""GAN generator/discriminator pair (reference ``python/fedml/model/cv/``
+GAN models used by ``simulation/mpi/fedgan/``).
+
+DCGAN-shaped but GroupNorm'd (BatchNorm statistics don't federate) and
+sized for 28x28/32x32 federated vision sets.  Transposed convs and convs
+are MXU ops; the pair trains under one jitted alternating step in
+``simulation/sp/fedgan.py``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z (B, latent_dim) → image (B, H, W, C) in [-1, 1]."""
+
+    out_hw: int = 28
+    out_channels: int = 1
+    latent_dim: int = 64
+    base: int = 64
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        h0 = self.out_hw // 4
+        x = nn.Dense(h0 * h0 * self.base * 2)(z)
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        x = x.reshape((-1, h0, h0, self.base * 2))
+        x = nn.ConvTranspose(self.base, (4, 4), strides=(2, 2),
+                             padding="SAME")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        x = nn.ConvTranspose(self.out_channels, (4, 4), strides=(2, 2),
+                             padding="SAME")(x)
+        # crop for non-multiple-of-4 sizes (28 → 28, handled exactly)
+        x = x[:, :self.out_hw, :self.out_hw, :]
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image → real/fake logit (B,)."""
+
+    base: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.base, (4, 4), strides=(2, 2), padding="SAME")(x)
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(self.base * 2, (4, 4), strides=(2, 2), padding="SAME")(x)
+        x = nn.leaky_relu(nn.GroupNorm(num_groups=8)(x), 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1)(x)[:, 0]

@@ -66,9 +66,20 @@ def create(args, output_dim: int = 10) -> FlaxModel:
         seq = int(getattr(args, "seq_len", 20))
         return FlaxModel(RNNStackOverflow(vocab_size=output_dim or 10004),
                          (seq,), input_dtype=jnp.int32, task="lm")
-    if name in ("mobilenet", "mobilenet_v3", "efficientnet"):
+    if name in ("mobilenet", "mobilenet_v3"):
         from .mobilenet import mobilenet_v3_small
         return FlaxModel(mobilenet_v3_small(output_dim), _IMG32)
+    if name == "efficientnet":
+        from .efficientnet import EfficientNetLite
+        return FlaxModel(EfficientNetLite(num_classes=output_dim), _IMG32)
+    if name in ("darts", "darts_search"):
+        from .darts import DARTSNetwork
+        return FlaxModel(DARTSNetwork(num_classes=output_dim),
+                         _img_shape(args))
+    if name in ("unet", "unet_small", "deeplab"):
+        from .unet import UNetSmall
+        return FlaxModel(UNetSmall(num_classes=output_dim), _img_shape(args),
+                         task="segmentation")
     if name in ("transformer", "gpt", "llama", "tiny_llama"):
         from ..llm.model import build_causal_lm
         return build_causal_lm(args, output_dim)

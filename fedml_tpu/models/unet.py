@@ -1,0 +1,51 @@
+"""Small UNet for federated segmentation (reference ``python/fedml/model/cv/``
+DeepLab/UNet family behind ``simulation/mpi/fedseg/``).
+
+Two-level encoder/decoder with skip connections, GroupNorm (BatchNorm
+statistics don't federate).  Output is per-pixel class logits
+(B, H, W, num_classes)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _ConvBlock(nn.Module):
+    channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=min(8, self.channels))(x))
+        x = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False)(x)
+        return nn.relu(nn.GroupNorm(num_groups=min(8, self.channels))(x))
+
+
+class UNetSmall(nn.Module):
+    num_classes: int = 2
+    base: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d1 = _ConvBlock(self.base)(x)
+        p1 = nn.max_pool(d1, (2, 2), strides=(2, 2))
+        d2 = _ConvBlock(self.base * 2)(p1)
+        p2 = nn.max_pool(d2, (2, 2), strides=(2, 2))
+        mid = _ConvBlock(self.base * 4)(p2)
+        u2 = nn.ConvTranspose(self.base * 2, (2, 2), strides=(2, 2))(mid)
+        u2 = _ConvBlock(self.base * 2)(jnp.concatenate([u2, d2], axis=-1))
+        u1 = nn.ConvTranspose(self.base, (2, 2), strides=(2, 2))(u2)
+        u1 = _ConvBlock(self.base)(jnp.concatenate([u1, d1], axis=-1))
+        return nn.Conv(self.num_classes, (1, 1))(u1)
+
+
+def mean_iou(logits, labels, num_classes: int):
+    """mIoU over a batch: logits (B,H,W,C), labels (B,H,W) int."""
+    pred = jnp.argmax(logits, axis=-1)
+    ious = []
+    for c in range(num_classes):
+        inter = jnp.sum((pred == c) & (labels == c))
+        union = jnp.sum((pred == c) | (labels == c))
+        ious.append(jnp.where(union > 0, inter / union, 1.0))
+    return jnp.mean(jnp.stack(ious))

@@ -1,0 +1,138 @@
+"""Federated GAN training (reference ``simulation/mpi/fedgan/`` — clients
+train a local G/D pair on private images; the server federated-averages
+both networks).
+
+TPU-native: one jitted per-client scan alternates D and G steps over the
+client's batches; the cohort loop stays in Python (few clients/round) while
+all math is compiled.  Non-saturating GAN loss with logits
+(sigmoid-BCE), as the reference's torch BCEWithLogits training."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...core import rng as rng_util
+from ...core.tree import weighted_average
+from ...models.gan import Discriminator, Generator
+
+log = logging.getLogger(__name__)
+
+
+def _bce_logits(logits, target):
+    # sigmoid BCE: softplus(logits) - target*logits
+    return jnp.mean(jax.nn.softplus(logits) - target * logits)
+
+
+class FedGANAPI:
+    def __init__(self, args, images: np.ndarray, client_idxs: List[np.ndarray],
+                 generator: Generator = None, discriminator: Discriminator = None):
+        self.args = args
+        self.images = np.asarray(images, np.float32)
+        self.client_idxs = client_idxs
+        hw, ch = self.images.shape[1], self.images.shape[-1]
+        self.gen = generator or Generator(out_hw=hw, out_channels=ch)
+        self.disc = discriminator or Discriminator()
+        self.latent_dim = self.gen.latent_dim
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self.rounds = int(getattr(args, "comm_round", 5))
+        self.clients_per_round = int(getattr(args, "client_num_per_round",
+                                             min(4, len(client_idxs))))
+        self.seed = int(getattr(args, "random_seed", 0))
+        lr = float(getattr(args, "learning_rate", 2e-4))
+        self.tx_g = optax.adam(lr, b1=0.5)
+        self.tx_d = optax.adam(lr, b1=0.5)
+
+        key = rng_util.root_key(self.seed)
+        z0 = jnp.zeros((1, self.latent_dim))
+        x0 = jnp.zeros((1,) + self.images.shape[1:])
+        self.g_params = self.gen.init(rng_util.purpose_key(key, "g"), z0)["params"]
+        self.d_params = self.disc.init(rng_util.purpose_key(key, "d"), x0)["params"]
+
+        def client_train(g_params, d_params, batches, key):
+            """scan over (steps, B, H, W, C) real batches; one D + one G
+            update per batch."""
+            opt_g = self.tx_g.init(g_params)
+            opt_d = self.tx_d.init(d_params)
+
+            def body(carry, xb):
+                g_p, d_p, o_g, o_d, k = carry
+                k, kz1, kz2 = jax.random.split(k, 3)
+                z = jax.random.normal(kz1, (xb.shape[0], self.latent_dim))
+
+                def d_loss(dp):
+                    fake = self.gen.apply({"params": g_p}, z)
+                    lr_ = self.disc.apply({"params": dp}, xb)
+                    lf = self.disc.apply({"params": dp},
+                                         jax.lax.stop_gradient(fake))
+                    return _bce_logits(lr_, 1.0) + _bce_logits(lf, 0.0)
+
+                dl, gd = jax.value_and_grad(d_loss)(d_p)
+                upd, o_d = self.tx_d.update(gd, o_d, d_p)
+                d_p = optax.apply_updates(d_p, upd)
+
+                z2 = jax.random.normal(kz2, (xb.shape[0], self.latent_dim))
+
+                def g_loss(gp):
+                    fake = self.gen.apply({"params": gp}, z2)
+                    return _bce_logits(self.disc.apply({"params": d_p}, fake),
+                                       1.0)
+
+                gl, gg = jax.value_and_grad(g_loss)(g_p)
+                upd, o_g = self.tx_g.update(gg, o_g, g_p)
+                g_p = optax.apply_updates(g_p, upd)
+                return (g_p, d_p, o_g, o_d, k), (dl, gl)
+
+            (g_params, d_params, _, _, _), losses = jax.lax.scan(
+                body, (g_params, d_params, opt_g, opt_d, key), batches)
+            return g_params, d_params, losses
+
+        self._client_train = jax.jit(client_train)
+
+    def _client_batches(self, c: int, round_idx: int) -> np.ndarray:
+        idx = np.asarray(self.client_idxs[c])
+        rng = np.random.default_rng(self.seed * 1000003 + round_idx * 101 + c)
+        perm = rng.permutation(len(idx))
+        steps = max(1, len(idx) // self.batch_size)
+        take = idx[perm[:steps * self.batch_size]]
+        return self.images[take].reshape((steps, self.batch_size) +
+                                         self.images.shape[1:])
+
+    def train(self) -> dict:
+        key = rng_util.root_key(self.seed + 7)
+        history = []
+        for r in range(self.rounds):
+            rng = np.random.default_rng(self.seed + r)
+            cohort = rng.choice(len(self.client_idxs),
+                                size=min(self.clients_per_round,
+                                         len(self.client_idxs)),
+                                replace=False)
+            g_locals, d_locals, ws = [], [], []
+            d_loss = g_loss = 0.0
+            for c in cohort:
+                key, sub = jax.random.split(key)
+                batches = self._client_batches(int(c), r)
+                g_p, d_p, (dl, gl) = self._client_train(
+                    self.g_params, self.d_params, batches, sub)
+                g_locals.append(g_p)
+                d_locals.append(d_p)
+                ws.append(float(len(self.client_idxs[int(c)])))
+                d_loss += float(dl[-1])
+                g_loss += float(gl[-1])
+            self.g_params = weighted_average(g_locals, ws)
+            self.d_params = weighted_average(d_locals, ws)
+            history.append({"round": r, "d_loss": d_loss / len(cohort),
+                            "g_loss": g_loss / len(cohort)})
+            log.info("fedgan round %d: d_loss=%.4f g_loss=%.4f", r,
+                     history[-1]["d_loss"], history[-1]["g_loss"])
+        return {"history": history, "g_params": self.g_params,
+                "d_params": self.d_params}
+
+    def sample(self, n: int, seed: int = 0) -> np.ndarray:
+        z = jax.random.normal(jax.random.PRNGKey(seed), (n, self.latent_dim))
+        return np.asarray(self.gen.apply({"params": self.g_params}, z))

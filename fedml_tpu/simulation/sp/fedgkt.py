@@ -1,0 +1,224 @@
+"""FedGKT — group knowledge transfer / split training (reference
+``simulation/mpi/fedgkt/``: client ResNet-8 + server ResNet-49 exchange
+extracted features and logits, each distilling from the other).
+
+Protocol per round (reference GKTTrainer/GKTServerTrainer):
+  1. each client trains its small net (extractor+head) on private data with
+     CE + KL-to-server-logits,
+  2. uploads (features, labels, client_logits) for its samples,
+  3. the server trains the big head on the uploaded feature bank with
+     CE + KL-to-client-logits and returns per-client server logits.
+TPU-native: both sides are jitted scans; the feature bank transfer is the
+only host exchange, exactly the reference's message payload."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...core import rng as rng_util
+from ...ml.trainer.local_trainer import cross_entropy_loss
+
+log = logging.getLogger(__name__)
+
+
+class ClientExtractor(nn.Module):
+    """Small on-device net: conv stem → feature vector (reference's
+    client-side ResNet-8 trunk)."""
+    feature_dim: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(32, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.feature_dim)(x)
+
+
+class ClientHead(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, f, train: bool = False):
+        return nn.Dense(self.num_classes)(nn.relu(f))
+
+
+class ServerHead(nn.Module):
+    """Large server-side net on extracted features (reference's
+    ResNet-49 upper half)."""
+    num_classes: int = 10
+    width: int = 256
+    depth: int = 3
+
+    @nn.compact
+    def __call__(self, f, train: bool = False):
+        x = f
+        for _ in range(self.depth):
+            x = nn.relu(nn.Dense(self.width)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _kl_to(teacher_logits, student_logits, T: float = 1.0):
+    pt = jax.nn.softmax(teacher_logits / T)
+    ls = jax.nn.log_softmax(student_logits / T)
+    lt = jax.nn.log_softmax(teacher_logits / T)
+    return jnp.mean(jnp.sum(pt * (lt - ls), axis=-1))
+
+
+class FedGKTAPI:
+    def __init__(self, args, dataset):
+        self.args = args
+        self.dataset = dataset
+        nc = dataset.num_classes
+        self.extractor = ClientExtractor()
+        self.c_head = ClientHead(num_classes=nc)
+        self.s_head = ServerHead(num_classes=nc)
+        self.rounds = int(getattr(args, "comm_round", 3))
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self.seed = int(getattr(args, "random_seed", 0))
+        self.alpha_kd = float(getattr(args, "gkt_kd_weight", 1.0))
+        lr = float(getattr(args, "learning_rate", 0.03))
+        self.tx_c = optax.sgd(lr, momentum=0.9)
+        self.tx_s = optax.adam(1e-3)
+
+        key = rng_util.root_key(self.seed)
+        x0 = jnp.zeros((1,) + tuple(dataset.train_x.shape[1:]), jnp.float32)
+        self.c_params: Dict = {}  # per-client (extractor, head) params
+        k1, k2, k3 = (rng_util.purpose_key(key, p) for p in ("e", "h", "s"))
+        self._init_e = self.extractor.init(k1, x0)["params"]
+        f0 = self.extractor.apply({"params": self._init_e}, x0)
+        self._init_h = self.c_head.init(k2, f0)["params"]
+        self.s_params = self.s_head.init(k3, f0)["params"]
+        self.opt_s = self.tx_s.init(self.s_params)
+
+        def client_train(params, batches, server_logits):
+            e_p, h_p = params
+            opt = self.tx_c.init((e_p, h_p))
+
+            def body(carry, inp):
+                (ep, hp), o = carry
+                xb, yb, sl, has_sl = inp
+
+                def loss_fn(ps):
+                    f = self.extractor.apply({"params": ps[0]}, xb)
+                    logits = self.c_head.apply({"params": ps[1]}, f)
+                    ce = cross_entropy_loss(logits, yb)
+                    kd = _kl_to(sl, logits) * has_sl
+                    return ce + self.alpha_kd * kd
+
+                l, g = jax.value_and_grad(loss_fn)((ep, hp))
+                upd, o = self.tx_c.update(g, o, (ep, hp))
+                return (optax.apply_updates((ep, hp), upd), o), l
+
+            (params, _), losses = jax.lax.scan(
+                body, ((e_p, h_p), opt), (batches[0], batches[1],
+                                          server_logits[0], server_logits[1]))
+            return params, losses
+
+        def client_extract(e_params, h_params, x):
+            f = self.extractor.apply({"params": e_params}, x)
+            return f, self.c_head.apply({"params": h_params}, f)
+
+        def server_train(s_params, opt_s, feats, labels, c_logits):
+            def body(carry, inp):
+                sp, o = carry
+                f, y, cl = inp
+
+                def loss_fn(p):
+                    logits = self.s_head.apply({"params": p}, f)
+                    return (cross_entropy_loss(logits, y) +
+                            self.alpha_kd * _kl_to(cl, logits))
+
+                l, g = jax.value_and_grad(loss_fn)(sp)
+                upd, o = self.tx_s.update(g, o, sp)
+                return (optax.apply_updates(sp, upd), o), l
+
+            (s_params, opt_s), losses = jax.lax.scan(
+                body, (s_params, opt_s), (feats, labels, c_logits))
+            return s_params, opt_s, losses
+
+        self._client_train = jax.jit(client_train)
+        self._client_extract = jax.jit(client_extract)
+        self._server_train = jax.jit(server_train)
+        self._server_logits = jax.jit(
+            lambda sp, f: self.s_head.apply({"params": sp}, f))
+
+    def _batches(self, c: int, r: int):
+        idx = np.asarray(self.dataset.client_idxs[c])
+        rng = np.random.default_rng(self.seed * 104729 + r * 13 + c)
+        perm = rng.permutation(len(idx))
+        bs = min(self.batch_size, len(idx))
+        steps = max(1, len(idx) // bs)
+        t = idx[perm[:steps * bs]]
+        x = self.dataset.train_x[t].reshape(
+            (steps, bs) + self.dataset.train_x.shape[1:])
+        y = self.dataset.train_y[t].reshape((steps, bs))
+        return (x, y), t.reshape(steps * bs)
+
+    def train(self) -> dict:
+        nc = self.dataset.num_classes
+        server_logits: Dict[int, np.ndarray] = {}
+        history = []
+        for r in range(self.rounds):
+            feats_all, labels_all, clogits_all = [], [], []
+            keys = []
+            closs = 0.0
+            for c in range(self.dataset.num_clients):
+                if c not in self.c_params:
+                    self.c_params[c] = (self._init_e, self._init_h)
+                (xb, yb), flat_idx = self._batches(c, r)
+                if c in server_logits:
+                    sl = server_logits[c][:xb.shape[0] * xb.shape[1]].reshape(
+                        xb.shape[0], xb.shape[1], nc)
+                    has = jnp.ones((xb.shape[0],))
+                else:
+                    sl = jnp.zeros((xb.shape[0], xb.shape[1], nc))
+                    has = jnp.zeros((xb.shape[0],))
+                self.c_params[c], ls = self._client_train(
+                    self.c_params[c], (xb, yb), (sl, has))
+                closs += float(ls[-1])
+                f, cl = self._client_extract(
+                    self.c_params[c][0], self.c_params[c][1],
+                    xb.reshape((-1,) + xb.shape[2:]))
+                feats_all.append(f.reshape(xb.shape[0], xb.shape[1], -1))
+                labels_all.append(yb)
+                clogits_all.append(cl.reshape(xb.shape[0], xb.shape[1], nc))
+                keys.append(c)
+            # server: one pass over every client's uploaded bank
+            sloss = 0.0
+            for f, y, cl, c in zip(feats_all, labels_all, clogits_all, keys):
+                self.s_params, self.opt_s, ls = self._server_train(
+                    self.s_params, self.opt_s, f, jnp.asarray(y), cl)
+                sloss += float(ls[-1])
+                out = self._server_logits(self.s_params,
+                                          f.reshape((-1, f.shape[-1])))
+                server_logits[c] = np.asarray(out)
+            history.append({"round": r,
+                            "client_loss": closs / self.dataset.num_clients,
+                            "server_loss": sloss / self.dataset.num_clients})
+            log.info("fedgkt round %d: client_loss=%.4f server_loss=%.4f",
+                     r, history[-1]["client_loss"], history[-1]["server_loss"])
+        return {"history": history}
+
+    def evaluate(self) -> float:
+        """End-to-end accuracy: client-0 extractor → server head (the
+        deployment path in the reference: edge extractor + cloud head)."""
+        e_p, _ = self.c_params[0]
+        xb, yb, mask = self.dataset.test_batches(256)
+        correct = total = 0.0
+        for x, y, m in zip(xb, yb, mask):
+            f = self.extractor.apply({"params": e_p}, jnp.asarray(x))
+            logits = self._server_logits(self.s_params, f)
+            hit = (jnp.argmax(logits, -1) == jnp.asarray(y)) * jnp.asarray(m)
+            correct += float(jnp.sum(hit))
+            total += float(np.sum(m))
+        return correct / max(total, 1.0)

@@ -1,0 +1,144 @@
+"""FedNAS — federated differentiable architecture search (reference
+``simulation/mpi/fednas/`` FedNASAggregator/FedNASTrainer over the DARTS
+supernet).
+
+Each round, every sampled client runs the first-order DARTS alternation on
+its private split: a weight step on the train half, an architecture (alpha)
+step on the validation half; the server federated-averages BOTH weights and
+alphas (the reference aggregates ``model.arch_parameters()`` the same way).
+TPU-native: the alpha/weight partition is a pytree mask, both steps live in
+one jitted scan."""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...core import rng as rng_util
+from ...core.tree import weighted_average
+from ...ml.trainer.local_trainer import cross_entropy_loss
+from ...models.darts import derive_genotype
+
+log = logging.getLogger(__name__)
+
+
+def _is_alpha(path_key: str) -> bool:
+    return path_key.startswith("alphas_")
+
+
+def _partition_masks(params):
+    alpha_mask = {k: (jax.tree_util.tree_map(lambda _: _is_alpha(k), v)
+                      if isinstance(v, dict) else _is_alpha(k))
+                  for k, v in params.items()}
+    return alpha_mask
+
+
+class FedNASAPI:
+    def __init__(self, args, dataset, model):
+        """``model``: FlaxModel wrapping ``DARTSNetwork``; ``dataset``: a
+        FederatedDataset of images."""
+        self.args = args
+        self.dataset = dataset
+        self.model = model
+        self.rounds = int(getattr(args, "comm_round", 5))
+        self.clients_per_round = int(getattr(args, "client_num_per_round", 4))
+        self.batch_size = int(getattr(args, "batch_size", 16))
+        self.seed = int(getattr(args, "random_seed", 0))
+        w_lr = float(getattr(args, "learning_rate", 0.05))
+        a_lr = float(getattr(args, "arch_learning_rate", 3e-3))
+
+        key = rng_util.root_key(self.seed)
+        self.params = self.model.init(rng_util.purpose_key(key, "init"))
+
+        # masked optimizers: SGD touches weights, Adam touches alphas
+        def label_fn(params):
+            return jax.tree_util.tree_map_with_path(
+                lambda path, _: "alpha" if str(path[0].key).startswith(
+                    "alphas_") else "w", params)
+
+        self.tx = optax.multi_transform(
+            {"w": optax.sgd(w_lr, momentum=0.9), "alpha": optax.adam(a_lr)},
+            label_fn)
+
+        def local_search(params, train_b, val_b):
+            """scan over paired (train, val) batches: w step then alpha step
+            (first-order DARTS)."""
+            opt = self.tx.init(params)
+
+            def loss_fn(p, xb, yb):
+                logits = self.model.apply(p, xb, train=True)
+                return cross_entropy_loss(logits, yb)
+
+            def body(carry, inp):
+                p, o = carry
+                (xt, yt), (xv, yv) = inp
+                # weight step on train half
+                lw, g = jax.value_and_grad(loss_fn)(p, xt, yt)
+                g_w = jax.tree_util.tree_map_with_path(
+                    lambda path, gg: jnp.zeros_like(gg) if str(
+                        path[0].key).startswith("alphas_") else gg, g)
+                upd, o = self.tx.update(g_w, o, p)
+                p = optax.apply_updates(p, upd)
+                # alpha step on val half
+                la, g = jax.value_and_grad(loss_fn)(p, xv, yv)
+                g_a = jax.tree_util.tree_map_with_path(
+                    lambda path, gg: gg if str(
+                        path[0].key).startswith("alphas_") else
+                    jnp.zeros_like(gg), g)
+                upd, o = self.tx.update(g_a, o, p)
+                p = optax.apply_updates(p, upd)
+                return (p, o), (lw, la)
+
+            (params, _), losses = jax.lax.scan(
+                body, (params, opt), (train_b, val_b))
+            return params, losses
+
+        self._local_search = jax.jit(local_search)
+
+    def _paired_batches(self, c: int, round_idx: int):
+        """Split the client's data in half: train/val (reference
+        FedNASTrainer uses separate train/valid loaders)."""
+        idx = np.asarray(self.dataset.client_idxs[c])
+        rng = np.random.default_rng(self.seed * 7919 + round_idx * 31 + c)
+        perm = rng.permutation(len(idx))
+        half = len(idx) // 2
+        bs = min(self.batch_size, max(1, half))
+        steps = max(1, half // bs)
+
+        def take(sel):
+            t = sel[:steps * bs]
+            return (self.dataset.train_x[idx[t]].reshape(
+                        (steps, bs) + self.dataset.train_x.shape[1:]),
+                    self.dataset.train_y[idx[t]].reshape((steps, bs)))
+
+        return take(perm[:half]), take(perm[half:])
+
+    def train(self) -> dict:
+        history = []
+        for r in range(self.rounds):
+            rng = np.random.default_rng(self.seed + r)
+            cohort = rng.choice(self.dataset.num_clients,
+                                size=min(self.clients_per_round,
+                                         self.dataset.num_clients),
+                                replace=False)
+            locals_, ws = [], []
+            lw = la = 0.0
+            for c in cohort:
+                train_b, val_b = self._paired_batches(int(c), r)
+                p, (l_w, l_a) = self._local_search(self.params, train_b, val_b)
+                locals_.append(p)
+                ws.append(float(len(self.dataset.client_idxs[int(c)])))
+                lw += float(l_w[-1])
+                la += float(l_a[-1])
+            self.params = weighted_average(locals_, ws)
+            history.append({"round": r, "train_loss": lw / len(cohort),
+                            "val_loss": la / len(cohort)})
+            log.info("fednas round %d: w_loss=%.4f alpha_loss=%.4f", r,
+                     history[-1]["train_loss"], history[-1]["val_loss"])
+        return {"history": history, "params": self.params,
+                "genotype": derive_genotype(self.params)}

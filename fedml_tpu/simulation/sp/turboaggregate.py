@@ -1,0 +1,64 @@
+"""TurboAggregate (reference ``simulation/sp/turboaggregate/`` /
+``mpi/turboaggregate/``): multi-group circular secure aggregation — clients
+are arranged in L groups on a ring; each group adds its masked updates to
+the running partial sum and forwards it, additive masks cancelling
+telescopically so the server only ever sees group-level partial sums.
+
+TPU-era note: this is a host-side field-arithmetic protocol (like
+SecAgg/LightSecAgg); the model updates being aggregated come out of the
+jitted trainers as flat vectors."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Sequence
+
+import numpy as np
+
+from ...core.hostrng import gen as hostgen
+from ...core.mpc.secagg import P, dequantize, quantize
+
+log = logging.getLogger(__name__)
+
+
+def ring_groups(n_clients: int, n_groups: int) -> List[List[int]]:
+    """Round-robin assignment of clients to L ring groups."""
+    groups: List[List[int]] = [[] for _ in range(n_groups)]
+    for c in range(n_clients):
+        groups[c % n_groups].append(c)
+    return [g for g in groups if g]
+
+
+class TurboAggregateAPI:
+    """Aggregate ``updates`` (one flat float vector per client, pre-scaled
+    by its weight) through the ring protocol; ``aggregate`` returns the
+    exact weighted sum — the server only observes masked partials."""
+
+    def __init__(self, n_clients: int, n_groups: int = 3, seed: int = 0):
+        self.groups = ring_groups(n_clients, n_groups)
+        self.seed = seed
+
+    def aggregate(self, updates: Sequence[np.ndarray]) -> np.ndarray:
+        d = len(updates[0])
+        q = [quantize(np.asarray(u, np.float64)) for u in updates]
+        # Each client c in group l adds mask m_c when its group ingests the
+        # partial sum, and the SAME mask is subtracted by its "shadow" in
+        # group l+1 (additive shares handed along the ring) — telescoping
+        # to zero by the time the ring closes at the server.
+        partial = np.zeros(d, dtype=np.int64)
+        carry_masks = np.zeros(d, dtype=np.int64)
+        observed = []  # what the server/groups see: masked partials only
+        for l, group in enumerate(self.groups):
+            # remove masks handed over from the previous group
+            partial = (partial - carry_masks) % P
+            carry_masks = np.zeros(d, dtype=np.int64)
+            for c in group:
+                m = hostgen(self.seed, 0x7A6B, c).integers(
+                    0, P, size=d, dtype=np.int64)
+                partial = (partial + q[c] + m) % P
+                carry_masks = (carry_masks + m) % P
+            observed.append(partial.copy())
+        # ring closes: the final group's masks are surrendered to the server
+        total = (partial - carry_masks) % P
+        self.observed_partials = observed
+        return dequantize(total)

@@ -1,0 +1,9 @@
+"""Model-deploy plane (reference ``computing/scheduler/model_scheduler/`` —
+deployment, replica control, autoscaling, inference gateway, model cache)."""
+
+from .device_model_cache import FedMLModelCache
+from .device_model_inference import InferenceGateway
+from .device_replica_controller import ReplicaController, start_deployment
+
+__all__ = ["FedMLModelCache", "InferenceGateway", "ReplicaController",
+           "start_deployment"]

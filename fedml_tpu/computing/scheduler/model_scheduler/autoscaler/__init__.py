@@ -1,0 +1,6 @@
+from .autoscaler import Autoscaler
+from .policies import (AutoscalingPolicy, ConcurrentQueryPolicy, EWMPolicy,
+                       ReactivePolicy)
+
+__all__ = ["Autoscaler", "AutoscalingPolicy", "ConcurrentQueryPolicy",
+           "EWMPolicy", "ReactivePolicy"]

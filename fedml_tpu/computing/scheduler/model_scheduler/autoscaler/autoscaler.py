@@ -1,0 +1,105 @@
+"""Autoscaler (reference ``model_scheduler/autoscaler/autoscaler.py:20`` —
+``scale_operation_endpoint:279`` dispatching per policy type; reactive +
+predictive EWM policies over the request metrics in FedMLModelCache)."""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Optional
+
+from ..device_model_cache import FedMLModelCache
+from .policies import (AutoscalingPolicy, ConcurrentQueryPolicy, EWMPolicy,
+                       ReactivePolicy)
+
+log = logging.getLogger(__name__)
+
+
+class Autoscaler:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls, cache: Optional[FedMLModelCache] = None):
+        if cls._instance is None:
+            cls._instance = cls(cache)
+        return cls._instance
+
+    def __init__(self, cache: Optional[FedMLModelCache] = None):
+        self.cache = cache or FedMLModelCache.get_instance()
+        self._last_scaledown: dict = {}
+
+    # -- policy evaluators -------------------------------------------------
+    def _scale_concurrent(self, policy: ConcurrentQueryPolicy,
+                          endpoint: str) -> int:
+        now = time.time()
+        ts = [t for t in self.cache.request_timestamps(endpoint)
+              if now - t <= policy.window_size_secs]
+        queries = len(ts)
+        want = math.ceil(queries /
+                         max(policy.queries_per_replica, 1) /
+                         max(policy.window_size_secs, 1e-9))
+        return want
+
+    def _scale_ewm(self, policy: EWMPolicy, endpoint: str) -> int:
+        now = time.time()
+        window = policy.ewm_mins * 60.0
+        if policy.metric == "ewm_latency":
+            pts = [(t, l) for t, l in
+                   zip(self.cache.request_timestamps(endpoint),
+                       [l for _, l in self.cache._metrics[endpoint]])
+                   if now - t <= window]
+            values = [l for _, l in pts]
+        else:  # qps per 1s bucket
+            ts = [t for t in self.cache.request_timestamps(endpoint)
+                  if now - t <= window]
+            buckets: dict = {}
+            for t in ts:
+                buckets[int(t)] = buckets.get(int(t), 0) + 1
+            values = [buckets[k] for k in sorted(buckets)]
+        if len(values) < 2:
+            return policy.current_replicas
+        ewm = values[0]
+        for v in values[1:]:
+            ewm = policy.ewm_alpha * v + (1 - policy.ewm_alpha) * ewm
+        mean = sum(values) / len(values)
+        if ewm > mean * (1 + policy.ub_threshold):
+            return policy.current_replicas + 1
+        if ewm < mean * (1 - policy.lb_threshold):
+            return policy.current_replicas - 1
+        return policy.current_replicas
+
+    def _scale_reactive(self, policy: ReactivePolicy, endpoint: str) -> int:
+        value = (self.cache.avg_latency(endpoint) if policy.metric == "latency"
+                 else self.cache.qps(endpoint))
+        if policy.target_value <= 0:
+            return policy.current_replicas
+        return math.ceil(value / policy.target_value)
+
+    # -- entry point (reference scale_operation_endpoint:279) --------------
+    def scale_operation_endpoint(self, policy: AutoscalingPolicy,
+                                 endpoint: str) -> int:
+        """Returns the target replica count for the endpoint, clamped to
+        [min, max] with scale-down hysteresis."""
+        if isinstance(policy, ConcurrentQueryPolicy):
+            want = self._scale_concurrent(policy, endpoint)
+        elif isinstance(policy, EWMPolicy):
+            want = self._scale_ewm(policy, endpoint)
+        elif isinstance(policy, ReactivePolicy):
+            want = self._scale_reactive(policy, endpoint)
+        else:
+            return policy.current_replicas
+        want = max(policy.min_replicas, min(policy.max_replicas, want))
+        # idle release: no traffic for release_replica_after_idle_secs
+        ts = self.cache.request_timestamps(endpoint)
+        idle = (time.time() - max(ts)) if ts else float("inf")
+        if idle >= policy.release_replica_after_idle_secs:
+            want = policy.min_replicas
+        # scale-down hysteresis (reference scaledown_delay_secs)
+        if want < policy.current_replicas:
+            first = self._last_scaledown.setdefault(endpoint, time.time())
+            if time.time() - first < policy.scaledown_delay_secs:
+                return policy.current_replicas
+        else:
+            self._last_scaledown.pop(endpoint, None)
+        return want

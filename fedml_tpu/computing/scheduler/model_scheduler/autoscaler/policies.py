@@ -1,0 +1,44 @@
+"""Autoscaling policies (reference
+``model_scheduler/autoscaler/policies.py`` — ConcurrentQueryPolicy,
+EWMPolicy, ReactivePolicy dataclasses with the same knobs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingPolicy:
+    current_replicas: int = 1
+    min_replicas: int = 1
+    max_replicas: int = 8
+    scaledown_delay_secs: float = 60.0
+    scaleup_cost_secs: float = 0.0
+    release_replica_after_idle_secs: float = 300.0
+
+
+@dataclass
+class ConcurrentQueryPolicy(AutoscalingPolicy):
+    """Target a fixed number of in-flight/queued queries per replica
+    (reference ConcurrentQueryPolicy: queries_per_replica over window)."""
+    queries_per_replica: int = 1
+    window_size_secs: float = 60.0
+
+
+@dataclass
+class EWMPolicy(AutoscalingPolicy):
+    """Exponentially-weighted-moving metric policy (reference EWMPolicy:
+    ewm_mins/ewm_alpha/ub_threshold/lb_threshold over qps or latency)."""
+    metric: str = "ewm_qps"          # "ewm_qps" | "ewm_latency"
+    ewm_mins: float = 15.0
+    ewm_alpha: float = 0.5
+    ub_threshold: float = 0.5        # scale up when value > (1+ub)*mean
+    lb_threshold: float = 0.5        # scale down when value < (1-lb)*mean
+
+
+@dataclass
+class ReactivePolicy(AutoscalingPolicy):
+    """Threshold-reactive on the latest metric value (reference
+    ReactivePolicy)."""
+    metric: str = "qps"              # "qps" | "latency"
+    target_value: float = 10.0

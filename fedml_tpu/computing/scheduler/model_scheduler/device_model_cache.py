@@ -1,0 +1,109 @@
+"""FedMLModelCache — endpoint/replica registry + rolling request metrics
+(reference ``model_scheduler/device_model_cache.py:14``, Redis-backed there;
+here a process-local store with the same query surface, optionally persisted
+to SQLite so gateways and agents in other processes can read it)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class FedMLModelCache:
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_instance(cls) -> "FedMLModelCache":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self, db_path: Optional[str] = None):
+        self._replicas: Dict[str, Dict[str, Dict[str, Any]]] = defaultdict(dict)
+        self._rr: Dict[str, int] = defaultdict(int)
+        self._metrics: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=4096))
+        self._mtx = threading.Lock()
+        self._db = None
+        if db_path:
+            self._db = sqlite3.connect(db_path, check_same_thread=False)
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS replicas (endpoint TEXT, "
+                "replica_id TEXT, spec TEXT, PRIMARY KEY (endpoint, replica_id))")
+            self._db.commit()
+            for ep, rid, spec in self._db.execute(
+                    "SELECT endpoint, replica_id, spec FROM replicas"):
+                self._replicas[ep][rid] = json.loads(spec)
+
+    # -- replica registry (reference set_deployment_result/get_endpoint) ---
+    def add_replica(self, endpoint: str, replica_id: str, url: str,
+                    **extra) -> None:
+        spec = {"url": url, "added_at": time.time(), **extra}
+        with self._mtx:
+            self._replicas[endpoint][replica_id] = spec
+            if self._db:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO replicas VALUES (?,?,?)",
+                    (endpoint, replica_id, json.dumps(spec)))
+                self._db.commit()
+
+    def remove_replica(self, endpoint: str, replica_id: str) -> None:
+        with self._mtx:
+            self._replicas[endpoint].pop(replica_id, None)
+            if self._db:
+                self._db.execute(
+                    "DELETE FROM replicas WHERE endpoint=? AND replica_id=?",
+                    (endpoint, replica_id))
+                self._db.commit()
+
+    def get_replicas(self, endpoint: str) -> Dict[str, Dict[str, Any]]:
+        with self._mtx:
+            return dict(self._replicas.get(endpoint, {}))
+
+    def next_replica(self, endpoint: str) -> Optional[Tuple[str, str]]:
+        """Round-robin pick (reference gateway's idle-replica selection)."""
+        with self._mtx:
+            reps = sorted(self._replicas.get(endpoint, {}).items())
+            if not reps:
+                return None
+            i = self._rr[endpoint] % len(reps)
+            self._rr[endpoint] += 1
+            rid, spec = reps[i]
+            return rid, spec["url"]
+
+    # -- request metrics (feed the autoscaler) ----------------------------
+    def record_request(self, endpoint: str, latency_s: float,
+                       ts: Optional[float] = None) -> None:
+        self._metrics[endpoint].append((ts if ts is not None else time.time(),
+                                        float(latency_s)))
+
+    def qps(self, endpoint: str, window_s: float = 60.0) -> float:
+        now = time.time()
+        pts = [t for t, _ in self._metrics[endpoint] if now - t <= window_s]
+        return len(pts) / window_s
+
+    def avg_latency(self, endpoint: str, window_s: float = 60.0) -> float:
+        now = time.time()
+        ls = [l for t, l in self._metrics[endpoint] if now - t <= window_s]
+        return sum(ls) / len(ls) if ls else 0.0
+
+    def request_timestamps(self, endpoint: str) -> List[float]:
+        return [t for t, _ in self._metrics[endpoint]]
+
+    def clear(self, endpoint: Optional[str] = None) -> None:
+        with self._mtx:
+            if endpoint is None:
+                self._replicas.clear()
+                self._metrics.clear()
+                self._rr.clear()
+            else:
+                self._replicas.pop(endpoint, None)
+                self._metrics.pop(endpoint, None)
+                self._rr.pop(endpoint, None)

@@ -1,0 +1,91 @@
+"""Inference gateway (reference ``model_scheduler/device_model_inference.py``
+— FastAPI ``/api/v1/predict`` with Redis-backed replica pick + metrics; here
+a stdlib HTTP gateway doing round-robin over the FedMLModelCache registry
+and recording latency for the autoscaler)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .device_model_cache import FedMLModelCache
+
+log = logging.getLogger(__name__)
+
+
+class InferenceGateway:
+    def __init__(self, cache: Optional[FedMLModelCache] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_token: Optional[str] = None):
+        self.cache = cache or FedMLModelCache.get_instance()
+        self.host, self.port = host, port
+        self.auth_token = auth_token
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def _make_handler(self):
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                # path: /api/v1/predict/<endpoint>
+                parts = self.path.strip("/").split("/")
+                if len(parts) < 4 or parts[:3] != ["api", "v1", "predict"]:
+                    self._send(404, {"error": "not found"})
+                    return
+                endpoint = parts[3]
+                if gw.auth_token:
+                    tok = self.headers.get("Authorization", "")
+                    if tok != f"Bearer {gw.auth_token}":
+                        self._send(401, {"error": "unauthorized"})
+                        return
+                picked = gw.cache.next_replica(endpoint)
+                if picked is None:
+                    self._send(503, {"error": f"no replicas for {endpoint}"})
+                    return
+                _, url = picked
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                t0 = time.time()
+                try:
+                    req = urllib.request.Request(
+                        url + "/predict", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=30.0) as r:
+                        out = json.loads(r.read())
+                    gw.cache.record_request(endpoint, time.time() - t0)
+                    self._send(200, out)
+                except Exception as e:
+                    log.exception("gateway forward failed")
+                    self._send(502, {"error": str(e)})
+
+            def log_message(self, fmt, *args):
+                log.debug("gw: " + fmt, *args)
+
+        return Handler
+
+    def start(self) -> int:
+        self._server = ThreadingHTTPServer((self.host, self.port),
+                                           self._make_handler())
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        log.info("inference gateway on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

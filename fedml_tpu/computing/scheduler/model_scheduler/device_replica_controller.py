@@ -1,0 +1,98 @@
+"""Replica controller (reference
+``model_scheduler/device_replica_controller.py`` — diff desired vs actual
+replicas and reconcile) + deployment starter (reference
+``device_model_deployment.py:68`` ``start_deployment`` with its readiness
+probe loop at ``:539``).
+
+The reference launches Docker containers; here a replica is an in-process
+``FedMLInferenceRunner`` serving a ``FedMLPredictor`` on a local port —
+the right unit for a single-host TPU serving plane (one predictor process
+per chip share), with the same registry/probe lifecycle."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, Optional
+
+from ....serving.fedml_inference_runner import FedMLInferenceRunner
+from .device_model_cache import FedMLModelCache
+
+log = logging.getLogger(__name__)
+
+
+def probe_ready(url: str, timeout_s: float = 5.0,
+                interval_s: float = 0.05) -> bool:
+    """Readiness probe loop (reference
+    ``is_client_inference_container_ready:539``)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/ready", timeout=1.0) as r:
+                if r.status == 200 and json.loads(r.read()).get("ready"):
+                    return True
+        except Exception:
+            pass
+        time.sleep(interval_s)
+    return False
+
+
+def start_deployment(endpoint: str, replica_id: str,
+                     predictor_factory: Callable[[], object],
+                     cache: Optional[FedMLModelCache] = None,
+                     host: str = "127.0.0.1",
+                     readiness_timeout_s: float = 10.0) -> FedMLInferenceRunner:
+    """Launch one replica, wait for readiness, register it in the cache."""
+    cache = cache or FedMLModelCache.get_instance()
+    runner = FedMLInferenceRunner(predictor_factory(), host=host, port=0)
+    port = runner.start()
+    url = f"http://{host}:{port}"
+    if not probe_ready(url, readiness_timeout_s):
+        runner.stop()
+        raise RuntimeError(f"replica {endpoint}/{replica_id} never got ready")
+    cache.add_replica(endpoint, replica_id, url)
+    log.info("deployed %s/%s at %s", endpoint, replica_id, url)
+    return runner
+
+
+class ReplicaController:
+    """Reconcile desired replica count against running replicas
+    (reference ``device_replica_controller.py`` diff/rollback logic)."""
+
+    def __init__(self, endpoint: str,
+                 predictor_factory: Callable[[], object],
+                 cache: Optional[FedMLModelCache] = None):
+        self.endpoint = endpoint
+        self.predictor_factory = predictor_factory
+        self.cache = cache or FedMLModelCache.get_instance()
+        self._runners: Dict[str, FedMLInferenceRunner] = {}
+        self._next_id = 0
+        self._mtx = threading.Lock()
+
+    @property
+    def current_replicas(self) -> int:
+        with self._mtx:
+            return len(self._runners)
+
+    def reconcile(self, desired: int) -> int:
+        """Scale up/down to ``desired``; returns the actual count."""
+        desired = max(0, int(desired))
+        with self._mtx:
+            while len(self._runners) < desired:
+                rid = f"replica-{self._next_id}"
+                self._next_id += 1
+                self._runners[rid] = start_deployment(
+                    self.endpoint, rid, self.predictor_factory, self.cache)
+            while len(self._runners) > desired:
+                rid, runner = sorted(self._runners.items())[-1]
+                runner.stop()
+                del self._runners[rid]
+                self.cache.remove_replica(self.endpoint, rid)
+                log.info("scaled down %s/%s", self.endpoint, rid)
+            return len(self._runners)
+
+    def stop_all(self):
+        self.reconcile(0)

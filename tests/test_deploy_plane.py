@@ -1,0 +1,100 @@
+"""Model-deploy plane: replica controller + gateway round-robin + autoscaler
+policies over recorded metrics."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from fedml_tpu.computing.scheduler.model_scheduler import (
+    FedMLModelCache, InferenceGateway, ReplicaController)
+from fedml_tpu.computing.scheduler.model_scheduler.autoscaler import (
+    Autoscaler, ConcurrentQueryPolicy, EWMPolicy, ReactivePolicy)
+from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+
+
+class EchoPredictor(FedMLPredictor):
+    def __init__(self, tag):
+        super().__init__()
+        self.tag = tag
+
+    def predict(self, request):
+        return {"tag": self.tag, "x2": [2 * v for v in request.get("x", [])]}
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10.0) as r:
+        return json.loads(r.read())
+
+
+def test_replica_controller_and_gateway_roundrobin():
+    cache = FedMLModelCache()
+    tags = iter(range(100))
+    ctl = ReplicaController("ep1", lambda: EchoPredictor(next(tags)),
+                            cache=cache)
+    try:
+        assert ctl.reconcile(2) == 2
+        assert len(cache.get_replicas("ep1")) == 2
+        gw = InferenceGateway(cache=cache)
+        port = gw.start()
+        try:
+            outs = [_post(f"http://127.0.0.1:{port}/api/v1/predict/ep1",
+                          {"x": [1, 2]}) for _ in range(4)]
+            assert all(o["result"]["x2"] == [2, 4] for o in outs)
+            # round-robin across both replicas
+            assert len({o["result"]["tag"] for o in outs}) == 2
+            # metrics recorded for the autoscaler
+            assert cache.qps("ep1") > 0
+            # missing endpoint → 503
+            try:
+                _post(f"http://127.0.0.1:{port}/api/v1/predict/nope", {})
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            # scale down to 1, traffic still flows
+            assert ctl.reconcile(1) == 1
+            out = _post(f"http://127.0.0.1:{port}/api/v1/predict/ep1",
+                        {"x": [3]})
+            assert out["result"]["x2"] == [6]
+        finally:
+            gw.stop()
+    finally:
+        ctl.stop_all()
+
+
+def test_autoscaler_policies():
+    cache = FedMLModelCache()
+    scaler = Autoscaler(cache)
+    now = time.time()
+    # 120 requests in the last 10s → qps 2 over 60s window
+    for i in range(120):
+        cache.record_request("ep", 0.05, ts=now - (i % 10))
+
+    p = ReactivePolicy(current_replicas=1, min_replicas=1, max_replicas=8,
+                       metric="qps", target_value=0.5)
+    assert scaler.scale_operation_endpoint(p, "ep") >= 2
+
+    c = ConcurrentQueryPolicy(current_replicas=1, max_replicas=8,
+                              queries_per_replica=1, window_size_secs=60)
+    assert scaler.scale_operation_endpoint(c, "ep") >= 2
+
+    # idle endpoint → falls back to min replicas
+    cache2 = FedMLModelCache()
+    scaler2 = Autoscaler(cache2)
+    cache2.record_request("cold", 0.05, ts=now - 4000)
+    pr = ReactivePolicy(current_replicas=4, min_replicas=1,
+                        release_replica_after_idle_secs=300,
+                        scaledown_delay_secs=0.0, metric="qps",
+                        target_value=10.0)
+    assert scaler2.scale_operation_endpoint(pr, "cold") == 1
+
+    # scale-down hysteresis holds replicas during the delay window
+    pr2 = ReactivePolicy(current_replicas=4, min_replicas=1,
+                         scaledown_delay_secs=3600, metric="qps",
+                         target_value=1000.0)
+    cache2.record_request("warm", 0.05, ts=now)
+    assert scaler2.scale_operation_endpoint(pr2, "warm") == 4

@@ -1,0 +1,41 @@
+"""Cross-cloud federation — "Cheetah" (reference ``python/fedml/cross_cloud/``:
+the cross-silo client/server FSMs flavored for multi-cloud training,
+``cross_cloud/__init__.py:1-6``).
+
+The FSMs are identical to cross-silo (the reference's cross_cloud managers
+are near-copies of the cross_silo ones); what changes is transport flavor:
+cross-cloud hops ride DCN-grade backends (gRPC/filestore/MQTT), never the
+in-memory path, and each cloud's intra-pod work stays on its own ICI mesh.
+This module therefore re-exports the cross-silo managers under the
+cross-cloud surface and pins the backend default."""
+
+from __future__ import annotations
+
+from ..cross_silo.client.fedml_client_master_manager import (
+    ClientMasterManager, TrainerDistAdapter)
+from ..cross_silo.server.fedml_aggregator import FedMLAggregator
+from ..cross_silo.server.fedml_server_manager import FedMLServerManager
+
+DEFAULT_BACKEND = "GRPC"  # DCN transport — never the in-memory test path
+
+
+class CrossCloudServerManager(FedMLServerManager):
+    """Reference ``cross_cloud/server/fedml_server_manager.py``."""
+
+    def __init__(self, args, aggregator, comm=None, rank=0, size=0,
+                 backend=None):
+        super().__init__(args, aggregator, comm, rank, size,
+                         backend or getattr(args, "backend", DEFAULT_BACKEND))
+
+
+class CrossCloudClientManager(ClientMasterManager):
+    """Reference ``cross_cloud/client/fedml_client_master_manager.py``."""
+
+    def __init__(self, args, trainer_adapter, comm=None, rank=0, size=0,
+                 backend=None):
+        super().__init__(args, trainer_adapter, comm, rank, size,
+                         backend or getattr(args, "backend", DEFAULT_BACKEND))
+
+
+__all__ = ["CrossCloudServerManager", "CrossCloudClientManager",
+           "FedMLAggregator", "TrainerDistAdapter", "DEFAULT_BACKEND"]

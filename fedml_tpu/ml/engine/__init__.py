@@ -1,0 +1,11 @@
+from .ml_engine_adapter import (MLEngineBackend,
+                                convert_ml_engine_data_format_to_numpy,
+                                convert_numpy_to_ml_engine_data_format,
+                                get_device, model_to_device,
+                                pytree_to_torch_state_dict,
+                                torch_state_dict_to_pytree)
+
+__all__ = ["MLEngineBackend", "get_device", "model_to_device",
+           "convert_numpy_to_ml_engine_data_format",
+           "convert_ml_engine_data_format_to_numpy",
+           "torch_state_dict_to_pytree", "pytree_to_torch_state_dict"]

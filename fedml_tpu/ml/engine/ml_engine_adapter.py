@@ -1,0 +1,111 @@
+"""ML engine adapter (reference ``ml/engine/ml_engine_adapter.py`` —
+``get_device:198`` / ``model_to_device:257`` / ``model_ddp:302`` /
+``convert_numpy_to_ml_engine_data_format:64`` dispatching on
+``MLEngineBackend`` torch/tf/jax/mxnet).
+
+Here jax IS the engine; the adapter's remaining jobs are (a) device
+discovery/placement, (b) numpy↔jax conversion, and (c) torch interop —
+importing torch ``state_dict`` checkpoints into flax pytrees and exporting
+back, so reference-ecosystem models migrate without retraining.  ``model_ddp``
+has no equivalent: data parallelism is a mesh axis, not a wrapper
+(SURVEY §2.9 — DDP → pjit batch sharding)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class MLEngineBackend:
+    """Reference ``core/common/ml_engine_backend.py:1`` constants."""
+    ml_engine_backend_torch = "torch"
+    ml_engine_backend_tf = "tf"
+    ml_engine_backend_jax = "jax"
+    ml_engine_backend_mxnet = "mxnet"
+
+
+def get_device(args=None):
+    """First local accelerator device, CPU fallback (reference
+    ``get_device:198`` maps rank→cuda device; ranks map to mesh coords
+    here)."""
+    devs = jax.local_devices()
+    idx = int(getattr(args, "local_rank", 0) or 0) if args else 0
+    return devs[idx % len(devs)]
+
+
+def model_to_device(params, device=None):
+    """device_put the whole param pytree (reference ``model_to_device:257``)."""
+    return jax.device_put(params, device or get_device())
+
+
+def convert_numpy_to_ml_engine_data_format(batch):
+    """numpy → jax arrays, any pytree shape (reference
+    ``convert_numpy_to_jax_data_format:37``)."""
+    return jax.tree_util.tree_map(jnp.asarray, batch)
+
+
+def convert_ml_engine_data_format_to_numpy(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+# -- torch interop ---------------------------------------------------------
+def torch_state_dict_to_pytree(state_dict: Dict[str, Any],
+                               transpose_linear: bool = True) -> Dict[str, Any]:
+    """torch ``state_dict`` → nested flax-style pytree.
+
+    Key split on '.', torch Linear ``weight`` (out, in) transposed to flax
+    Dense ``kernel`` (in, out); conv weights (O, I, H, W) → (H, W, I, O)."""
+    out: Dict[str, Any] = {}
+    for key, tensor in state_dict.items():
+        arr = np.asarray(tensor.detach().cpu().numpy()
+                         if hasattr(tensor, "detach") else tensor)
+        parts = key.split(".")
+        leaf = parts[-1]
+        if leaf == "weight":
+            if arr.ndim == 2 and transpose_linear:
+                arr, leaf = arr.T, "kernel"
+            elif arr.ndim == 4:
+                arr, leaf = arr.transpose(2, 3, 1, 0), "kernel"
+            else:
+                leaf = "scale"  # norm-layer weight
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[leaf] = arr
+    return out
+
+
+def pytree_to_torch_state_dict(params, transpose_linear: bool = True):
+    """Inverse mapping; returns {dotted_key: torch.Tensor} (torch-cpu is in
+    the image; falls back to numpy arrays if torch is absent)."""
+    try:
+        import torch
+        to_t = lambda a: torch.from_numpy(np.ascontiguousarray(a))
+    except ImportError:  # pragma: no cover
+        to_t = lambda a: a
+    flat = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + [k])
+            return
+        arr = np.asarray(node)
+        leaf = prefix[-1]
+        if leaf == "kernel":
+            if arr.ndim == 2 and transpose_linear:
+                arr, leaf = arr.T, "weight"
+            elif arr.ndim == 4:
+                arr, leaf = arr.transpose(3, 2, 0, 1), "weight"
+        elif leaf == "scale":
+            leaf = "weight"
+        flat[".".join(prefix[:-1] + [leaf])] = to_t(arr)
+
+    walk(params, [])
+    return flat

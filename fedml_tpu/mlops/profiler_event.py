@@ -1,0 +1,83 @@
+"""MLOpsProfilerEvent — span profiling (reference
+``core/mlops/mlops_profiler_event.py:9``: singleton emitting
+started/ended span events onto the metrics bus, optionally mirrored to
+wandb).
+
+TPU-era addition: when ``sys_perf_profiling`` is on and a trace dir is
+configured, spans also drive ``jax.profiler`` start/stop_trace so XLA/TPU
+timelines line up with the framework's round phases."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from . import _emit
+
+EVENT_TYPE_STARTED = 0
+EVENT_TYPE_ENDED = 1
+
+
+class MLOpsProfilerEvent:
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_instance(cls) -> "MLOpsProfilerEvent":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self, trace_dir: Optional[str] = None):
+        self._open: Dict[str, float] = {}
+        self.trace_dir = trace_dir
+        self._tracing = False
+
+    def log_event_started(self, event_name: str,
+                          event_value: Optional[str] = None,
+                          event_edge_id: Optional[int] = None) -> None:
+        self._open[event_name] = time.time()
+        _emit({"kind": "span", "event_type": EVENT_TYPE_STARTED,
+               "name": event_name, "value": event_value,
+               "edge_id": event_edge_id})
+        if self.trace_dir and not self._tracing:
+            try:
+                import jax
+                jax.profiler.start_trace(self.trace_dir)
+                self._tracing = True
+            except Exception:
+                pass
+
+    def log_event_ended(self, event_name: str,
+                        event_value: Optional[str] = None,
+                        event_edge_id: Optional[int] = None) -> float:
+        t0 = self._open.pop(event_name, None)
+        dur = (time.time() - t0) if t0 is not None else 0.0
+        _emit({"kind": "span", "event_type": EVENT_TYPE_ENDED,
+               "name": event_name, "value": event_value,
+               "edge_id": event_edge_id, "duration_s": dur})
+        if self.trace_dir and self._tracing and not self._open:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+        return dur
+
+    def span(self, name: str):
+        """Context-manager sugar over started/ended."""
+        ev = self
+
+        class _Span:
+            def __enter__(self):
+                ev.log_event_started(name)
+                return self
+
+            def __exit__(self, *exc):
+                ev.log_event_ended(name)
+                return False
+
+        return _Span()

@@ -1,0 +1,121 @@
+"""Runtime log pipeline (reference ``core/mlops/mlops_runtime_log.py``
+MLOpsRuntimeLog + ``mlops_runtime_log_daemon.py:18,391``
+MLOpsRuntimeLogDaemon/Processor: hook Python logging into per-run files,
+tail them, and ship line batches to a sink).
+
+The reference uploads to its HTTP backend; here the shipper takes any
+callable sink (HTTP poster, exporter, test list) — endpoint config is plain
+config, not a hard-wired cloud (SURVEY §7 hard-parts note)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class MLOpsRuntimeLog:
+    """Attach a per-run file handler to the root logger (reference
+    MLOpsRuntimeLog.init_logs formatter semantics)."""
+
+    _instances = {}
+
+    def __init__(self, args):
+        self.run_id = str(getattr(args, "run_id", "0"))
+        self.edge_id = str(getattr(args, "edge_id",
+                                   getattr(args, "rank", 0)))
+        log_dir = str(getattr(args, "log_file_dir", "/tmp/fedml_tpu_logs"))
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_path = os.path.join(
+            log_dir, f"fedml-run-{self.run_id}-edge-{self.edge_id}.log")
+        self._handler: Optional[logging.Handler] = None
+
+    @classmethod
+    def get_instance(cls, args) -> "MLOpsRuntimeLog":
+        key = (str(getattr(args, "run_id", "0")),
+               str(getattr(args, "edge_id", getattr(args, "rank", 0))))
+        if key not in cls._instances:
+            cls._instances[key] = cls(args)
+        return cls._instances[key]
+
+    def init_logs(self, log_level=logging.INFO):
+        if self._handler is not None:
+            return
+        h = logging.FileHandler(self.log_path)
+        h.setLevel(log_level)
+        h.setFormatter(logging.Formatter(
+            "[FedML-TPU] [%(asctime)s] [%(levelname)s] "
+            "[%(filename)s:%(lineno)d] %(message)s"))
+        logging.getLogger().addHandler(h)
+        self._handler = h
+
+    def close(self):
+        if self._handler is not None:
+            logging.getLogger().removeHandler(self._handler)
+            self._handler.close()
+            self._handler = None
+
+
+class MLOpsRuntimeLogDaemon:
+    """Tail run log files and ship batches of lines (reference
+    ``mlops_runtime_log_daemon.py`` Processor.log_process loop)."""
+
+    def __init__(self, sink: Callable[[str, List[str]], None],
+                 batch_lines: int = 100, interval_s: float = 1.0):
+        self.sink = sink
+        self.batch_lines = batch_lines
+        self.interval_s = interval_s
+        self._files = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start_log_processor(self, run_id: str, log_path: str):
+        self._files[(str(run_id), log_path)] = 0  # byte offset
+
+    def stop_log_processor(self, run_id: str, log_path: str):
+        self._files.pop((str(run_id), log_path), None)
+
+    def _drain_one(self, key) -> bool:
+        run_id, path = key
+        off = self._files.get(key, 0)
+        if not os.path.exists(path):
+            return False
+        size = os.path.getsize(path)
+        if size <= off:
+            return False
+        with open(path, "r", errors="replace") as f:
+            f.seek(off)
+            chunk = f.read()
+            # only ship complete lines; remainder stays for next pass
+            last_nl = chunk.rfind("\n")
+            if last_nl < 0:
+                return False
+            lines = chunk[:last_nl].splitlines()
+            self._files[key] = off + len(chunk[:last_nl + 1].encode())
+        for i in range(0, len(lines), self.batch_lines):
+            self.sink(run_id, lines[i:i + self.batch_lines])
+        return True
+
+    def drain(self):
+        """One synchronous pass over all watched files (tests/shutdown)."""
+        for key in list(self._files):
+            self._drain_one(key)
+
+    def start(self):
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.drain()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        self.drain()

@@ -1,0 +1,106 @@
+"""System performance sampling (reference ``core/mlops/system_stats.py:139``
+SysStats + the MLOpsDevicePerfStats/MLOpsJobPerfStats reporting daemons in
+``mlops_device_perfs.py``/``mlops_job_perfs.py``).
+
+psutil-free: CPU utilization from /proc/stat deltas, memory from
+/proc/meminfo and /proc/self/status, accelerator memory from jax's
+device memory stats when a backend is live."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from . import _emit
+
+
+def _read_proc_stat():
+    with open("/proc/stat") as f:
+        parts = f.readline().split()[1:8]
+    vals = [int(v) for v in parts]
+    idle = vals[3] + vals[4]
+    return sum(vals), idle
+
+
+def _meminfo() -> Dict[str, int]:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, v = line.split(":", 1)
+                out[k] = int(v.split()[0]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def _process_rss() -> int:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+class SysStats:
+    """One-shot sampler (reference SysStats.produce_info)."""
+
+    def __init__(self):
+        self._last = _read_proc_stat()
+
+    def produce_info(self) -> Dict[str, Any]:
+        total, idle = _read_proc_stat()
+        lt, li = self._last
+        dt, di = total - lt, idle - li
+        self._last = (total, idle)
+        mem = _meminfo()
+        info: Dict[str, Any] = {
+            "cpu_utilization": (1.0 - di / dt) if dt > 0 else 0.0,
+            "mem_total_bytes": mem.get("MemTotal", 0),
+            "mem_available_bytes": mem.get("MemAvailable", 0),
+            "process_rss_bytes": _process_rss(),
+            "load_avg_1m": os.getloadavg()[0],
+        }
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                info["device_bytes_in_use"] = stats.get("bytes_in_use", 0)
+                info["device_bytes_limit"] = stats.get("bytes_limit", 0)
+        except Exception:
+            pass
+        return info
+
+
+class MLOpsDevicePerfStats:
+    """Periodic reporter daemon (reference ``mlops_device_perfs.py``) —
+    samples SysStats every ``interval_s`` and emits onto the mlops bus."""
+
+    def __init__(self, interval_s: float = 10.0):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stats = SysStats()
+
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            _emit({"kind": "sys_perf", **self._stats.produce_info()})
+
+    def report_once(self):
+        _emit({"kind": "sys_perf", **self._stats.produce_info()})
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,38 @@
+"""ScaleLLM client (reference ``python/fedml/scalellm/__init__.py`` — thin
+chat/completion client for hosted LLM inference endpoints).
+
+Endpoint/api-key are plain config (no hard-wired cloud); speaks the
+OpenAI-compatible JSON the serving plane's chatbot template exposes.  In a
+zero-egress environment, point it at a local ``FedMLInferenceRunner``."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class ScaleLLMChatCompletion:
+    def __init__(self, endpoint_url: str, api_key: str = "",
+                 model: str = "default", timeout_s: float = 60.0):
+        self.endpoint_url = endpoint_url.rstrip("/")
+        self.api_key = api_key
+        self.model = model
+        self.timeout_s = timeout_s
+
+    def create(self, messages: List[Dict[str, str]],
+               max_tokens: int = 256, temperature: float = 0.7,
+               **kw) -> Dict[str, Any]:
+        payload = {"model": self.model, "messages": messages,
+                   "max_tokens": max_tokens, "temperature": temperature, **kw}
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        req = urllib.request.Request(
+            self.endpoint_url + "/chat/completions",
+            data=json.dumps(payload).encode(), headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+
+__all__ = ["ScaleLLMChatCompletion"]

@@ -24,7 +24,9 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .federated_dataset import FederatedDataset, build_federated
-from .synthetic import synthetic_image_classification, synthetic_lm_tokens
+from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
+                        synthetic_tabular, synthetic_text_classification,
+                        synthetic_vertical_parties)
 
 # (classes, img shape, train_n, test_n) per image dataset, matching reference
 # dataset cardinalities (python/fedml/data/<name>/data_loader.py)
@@ -47,6 +49,23 @@ _LM_SPECS = {
     "stackoverflow_nwp": (10004, 20, 50000, 5000),
     "stackoverflow_lr": (10004, 20, 50000, 5000),
     "reddit": (10004, 20, 50000, 5000),
+}
+
+# tabular sets (reference ``data/UCI/``, ``data/lending_club_loan/``):
+# name -> (classes, n_features, train_n, test_n)
+_TABULAR_SPECS = {
+    "uci": (2, 14, 30000, 5000),
+    "uci_adult": (2, 14, 30000, 5000),
+    "lending_club": (2, 20, 40000, 8000),
+    "lending_club_loan": (2, 20, 40000, 8000),
+}
+
+# text-classification sets (reference ``data/fednlp/``, 20news/agnews):
+# name -> (classes, vocab, seq_len, train_n, test_n)
+_TEXTCLS_SPECS = {
+    "fednlp": (20, 30000, 128, 11000, 2000),
+    "20news": (20, 30000, 128, 11000, 2000),
+    "agnews": (4, 30000, 64, 12000, 2000),
 }
 
 
@@ -120,6 +139,31 @@ def load(args) -> Tuple[FederatedDataset, int]:
                              alpha=alpha, seed=seed)
         return ds, vocab
 
+    if name in _TABULAR_SPECS:
+        classes, n_features, train_n, test_n = _TABULAR_SPECS[name]
+        real = _try_load_npz(cache, name) if cache else None
+        if real is not None:
+            tx, ty, vx, vy = real
+        else:
+            tx, ty, vx, vy = synthetic_tabular(train_n, test_n, classes,
+                                               n_features, seed)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed)
+        return ds, classes
+
+    if name in _TEXTCLS_SPECS:
+        classes, vocab, seq_len, train_n, test_n = _TEXTCLS_SPECS[name]
+        seq_len = int(getattr(args, "seq_len", seq_len))
+        real = _try_load_npz(cache, name) if cache else None
+        if real is not None:
+            tx, ty, vx, vy = real
+        else:
+            tx, ty, vx, vy = synthetic_text_classification(
+                train_n, test_n, classes, vocab, seq_len, seed)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed)
+        return ds, classes
+
     if name.startswith("synthetic"):
         # synthetic_<classes>_<dim...> generic fallback
         classes = int(getattr(args, "num_classes", 10))
@@ -131,3 +175,22 @@ def load(args) -> Tuple[FederatedDataset, int]:
         return ds, classes
 
     raise ValueError(f"unknown dataset {name!r}")
+
+
+def load_vertical(args):
+    """Vertically-partitioned load (reference NUS-WIDE / classical VFL
+    examples): returns (party_feature_arrays, labels, classes)."""
+    name = str(getattr(args, "dataset", "nus_wide")).lower()
+    parties = int(getattr(args, "vfl_parties", 2))
+    seed = int(getattr(args, "random_seed", 0))
+    n = int(getattr(args, "train_size", 4000))
+    if name in ("nus_wide", "nuswide"):
+        # reference split: party A 634 image features, party B 1000 text tags
+        fpp = [634, 1000][:parties] if parties <= 2 else [634, 1000] + \
+            [128] * (parties - 2)
+        classes = int(getattr(args, "num_classes", 2))
+    else:
+        fpp = int(getattr(args, "features_per_party", 16))
+        classes = int(getattr(args, "num_classes", 2))
+    feats, labels = synthetic_vertical_parties(n, parties, fpp, classes, seed)
+    return feats, labels, classes

@@ -69,3 +69,57 @@ def synthetic_lm_tokens(
         seqs[:, t + 1] = np.where(use_noise, noise_tok, nxt)
     x, y = seqs[:, :-1], seqs[:, 1:]
     return x[:train_n], y[:train_n], x[train_n:], y[train_n:]
+
+
+def synthetic_tabular(train_n: int, test_n: int, classes: int,
+                      n_features: int, seed: int = 0, noise: float = 0.6):
+    """Class-conditional Gaussian tabular data (stand-in for UCI/lending
+    club when no ``data_cache_dir`` file is present — reference downloads
+    these; zero-egress builds generate)."""
+    rng = np.random.default_rng(seed)
+    means = rng.standard_normal((classes, n_features))
+    def gen(n):
+        y = rng.integers(0, classes, size=n)
+        x = means[y] + noise * rng.standard_normal((n, n_features))
+        return x.astype(np.float32), y.astype(np.int64)
+    tx, ty = gen(train_n)
+    vx, vy = gen(test_n)
+    return tx, ty, vx, vy
+
+
+def synthetic_text_classification(train_n: int, test_n: int, classes: int,
+                                  vocab: int, seq_len: int, seed: int = 0):
+    """Class-dependent unigram token sequences (fednlp/20news stand-in)."""
+    rng = np.random.default_rng(seed)
+    # each class favors its own slice of the vocabulary
+    def gen(n):
+        y = rng.integers(0, classes, size=n)
+        lo = (y * (vocab // classes))[:, None]
+        base = rng.integers(0, vocab // classes, size=(n, seq_len))
+        uniform = rng.integers(0, vocab, size=(n, seq_len))
+        use_class = rng.random((n, seq_len)) < 0.7
+        x = np.where(use_class, lo + base, uniform)
+        return x.astype(np.int32), y.astype(np.int64)
+    tx, ty = gen(train_n)
+    vx, vy = gen(test_n)
+    return tx, ty, vx, vy
+
+
+def synthetic_vertical_parties(n: int, parties: int, features_per_party,
+                               classes: int = 2, seed: int = 0,
+                               noise: float = 0.5):
+    """Vertically-partitioned features (NUS-WIDE-style: each party holds a
+    different feature block for the SAME samples; reference
+    ``data/NUS_WIDE/nus_wide_dataset.py`` two-party split)."""
+    rng = np.random.default_rng(seed)
+    if isinstance(features_per_party, int):
+        features_per_party = [features_per_party] * parties
+    total = sum(features_per_party)
+    means = rng.standard_normal((classes, total))
+    y = rng.integers(0, classes, size=n)
+    x = means[y] + noise * rng.standard_normal((n, total))
+    outs, off = [], 0
+    for f in features_per_party:
+        outs.append(x[:, off:off + f].astype(np.float32))
+        off += f
+    return outs, y.astype(np.int64)

@@ -34,6 +34,19 @@ class SimulatorSingleProcess:
         elif alg in ("decentralized_fl", "dsgd", "push_sum"):
             from .sp.decentralized import DecentralizedFedAPI
             self.fl_trainer = DecentralizedFedAPI(args, device, dataset, model)
+        elif alg == "fednas":
+            from .sp.fednas import FedNASAPI
+            self.fl_trainer = FedNASAPI(args, dataset, model)
+        elif alg == "fedseg":
+            from .sp.fedseg import FedSegAPI
+            self.fl_trainer = FedSegAPI(args, dataset, model)
+        elif alg == "fedgkt":
+            from .sp.fedgkt import FedGKTAPI
+            self.fl_trainer = FedGKTAPI(args, dataset)
+        elif alg == "fedgan":
+            from .sp.fedgan import FedGANAPI
+            idxs = [dataset.client_idxs[c] for c in range(dataset.num_clients)]
+            self.fl_trainer = FedGANAPI(args, dataset.train_x, idxs)
         else:
             # FedAvg / FedProx / FedOpt / SCAFFOLD / FedNova / FedDyn / Mime /
             # FedSGD — all branches of the jitted round engine

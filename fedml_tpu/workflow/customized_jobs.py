@@ -1,0 +1,79 @@
+"""Customized workflow jobs (reference ``workflow/customized_jobs/`` —
+``TrainJob`` dispatching a training run through the launch plane and
+``ModelDeployJob`` standing up a serving endpoint)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from .workflow import Job, JobStatus
+
+log = logging.getLogger(__name__)
+
+
+class TrainJob(Job):
+    """Run a federated training job through ``fedml_tpu.api.launch_job``
+    (reference ``customized_jobs/train_job.py``)."""
+
+    def __init__(self, name: str, job_yaml_path: str, num_workers: int = 1,
+                 timeout_s: float = 600.0):
+        super().__init__(name)
+        self.job_yaml_path = job_yaml_path
+        self.num_workers = num_workers
+        self.timeout_s = timeout_s
+        self.run_handle = None  # LaunchedRun after execution
+        self.status = JobStatus.PROVISIONING
+
+    def run(self):
+        from .. import api
+        self.status = JobStatus.RUNNING
+        launched = api.launch_job(self.job_yaml_path,
+                                  num_workers=self.num_workers,
+                                  wait=True, timeout_s=self.timeout_s)
+        self.run_handle = launched
+        final = launched.status
+        self.output = {"run_id": launched.run_id, "status": final}
+        self.status = (JobStatus.FINISHED if final == "FINISHED"
+                       else JobStatus.FAILED)
+
+    def kill(self):
+        from .. import api
+        if self.run_handle is not None:
+            api.run_stop(self.run_handle.run_id)
+            self.status = JobStatus.FAILED
+
+
+class ModelDeployJob(Job):
+    """Stand up a serving endpoint with N replicas behind the gateway
+    (reference ``customized_jobs/model_deploy_job.py`` → deploy plane)."""
+
+    def __init__(self, name: str, endpoint: str,
+                 predictor_factory: Callable[[], Any],
+                 num_replicas: int = 1):
+        super().__init__(name)
+        self.endpoint = endpoint
+        self.predictor_factory = predictor_factory
+        self.num_replicas = num_replicas
+        self.controller = None
+        self.gateway = None
+
+    def run(self):
+        from ..computing.scheduler.model_scheduler import (InferenceGateway,
+                                                           ReplicaController)
+        self.status = JobStatus.RUNNING
+        self.controller = ReplicaController(self.endpoint,
+                                            self.predictor_factory)
+        self.controller.reconcile(self.num_replicas)
+        self.gateway = InferenceGateway()
+        port = self.gateway.start()
+        self.output = {"endpoint": self.endpoint, "gateway_port": port,
+                       "replicas": self.controller.current_replicas}
+        self.status = JobStatus.FINISHED
+
+    def kill(self):
+        if self.gateway is not None:
+            self.gateway.stop()
+        if self.controller is not None:
+            self.controller.stop_all()
+        self.status = JobStatus.FAILED

@@ -175,3 +175,46 @@ def test_cli_commands():
                                      "--data-file", data])
         assert r.exit_code == 0, r.output
         assert json.loads(r.output)["result"] == [1, 2, 3]
+
+
+def test_tabular_and_textcls_datasets():
+    import types
+    from fedml_tpu.data import data_loader
+
+    args = types.SimpleNamespace(dataset="uci", client_num_in_total=8,
+                                 random_seed=0)
+    ds, classes = data_loader.load(args)
+    assert classes == 2 and ds.train_x.shape[1] == 14
+    assert ds.num_clients == 8
+
+    args = types.SimpleNamespace(dataset="agnews", client_num_in_total=6,
+                                 random_seed=0, seq_len=32)
+    ds, classes = data_loader.load(args)
+    assert classes == 4 and ds.train_x.shape[1] == 32
+    assert ds.train_x.dtype.kind == "i"
+
+    feats, labels, nc = data_loader.load_vertical(
+        types.SimpleNamespace(dataset="nus_wide", train_size=500,
+                              random_seed=0))
+    assert feats[0].shape == (500, 634) and feats[1].shape == (500, 1000)
+    assert len(labels) == 500 and nc == 2
+
+
+def test_workflow_customized_deploy_job():
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+    from fedml_tpu.workflow.customized_jobs import ModelDeployJob
+    from fedml_tpu.workflow.workflow import JobStatus, Workflow
+
+    class P(FedMLPredictor):
+        def predict(self, request):
+            return {"ok": True}
+
+    job = ModelDeployJob("deploy", "wftest-ep", lambda: P(), num_replicas=1)
+    wf = Workflow("wf")
+    wf.add_job(job)
+    try:
+        wf.run()
+        assert job.status_of() == JobStatus.FINISHED
+        assert job.output["replicas"] == 1
+    finally:
+        job.kill()

@@ -28,9 +28,13 @@ class TrainJob(Job):
     def run(self):
         from .. import api
         self.status = JobStatus.RUNNING
-        launched = api.launch_job(self.job_yaml_path,
-                                  num_workers=self.num_workers,
-                                  wait=True, timeout_s=self.timeout_s)
+        try:
+            launched = api.launch_job(self.job_yaml_path,
+                                      num_workers=self.num_workers,
+                                      wait=True, timeout_s=self.timeout_s)
+        except Exception:
+            self.status = JobStatus.FAILED
+            raise
         self.run_handle = launched
         final = launched.status
         self.output = {"run_id": launched.run_id, "status": final}
@@ -41,7 +45,8 @@ class TrainJob(Job):
         from .. import api
         if self.run_handle is not None:
             api.run_stop(self.run_handle.run_id)
-            self.status = JobStatus.FAILED
+            if self.status == JobStatus.RUNNING:
+                self.status = JobStatus.FAILED
 
 
 class ModelDeployJob(Job):
@@ -62,18 +67,36 @@ class ModelDeployJob(Job):
         from ..computing.scheduler.model_scheduler import (InferenceGateway,
                                                            ReplicaController)
         self.status = JobStatus.RUNNING
-        self.controller = ReplicaController(self.endpoint,
-                                            self.predictor_factory)
-        self.controller.reconcile(self.num_replicas)
-        self.gateway = InferenceGateway()
-        port = self.gateway.start()
+        try:
+            self.controller = ReplicaController(self.endpoint,
+                                                self.predictor_factory)
+            self.controller.reconcile(self.num_replicas)
+            self.gateway = InferenceGateway()
+            port = self.gateway.start()
+        except Exception:
+            self._teardown()
+            self.status = JobStatus.FAILED
+            raise
         self.output = {"endpoint": self.endpoint, "gateway_port": port,
                        "replicas": self.controller.current_replicas}
         self.status = JobStatus.FINISHED
 
-    def kill(self):
+    def _teardown(self):
         if self.gateway is not None:
-            self.gateway.stop()
+            try:
+                self.gateway.stop()
+            except Exception:
+                log.exception("gateway stop failed during teardown")
+            self.gateway = None
         if self.controller is not None:
-            self.controller.stop_all()
-        self.status = JobStatus.FAILED
+            try:
+                self.controller.stop_all()
+            except Exception:
+                log.exception("replica teardown failed")
+            self.controller = None
+
+    def kill(self):
+        was_finished = self.status == JobStatus.FINISHED
+        self._teardown()
+        if not was_finished:
+            self.status = JobStatus.FAILED

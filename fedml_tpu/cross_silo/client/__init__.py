@@ -1,8 +1,12 @@
-"""Cross-silo client facade (reference ``cross_silo/fedml_client.py``)."""
+"""Cross-silo client facade (reference ``cross_silo/fedml_client.py``:
+master rank talks to the server; in hierarchical silos, slave ranks join the
+intra-silo data-parallel group only)."""
 
 from __future__ import annotations
 
 from .fedml_client_master_manager import ClientMasterManager, TrainerDistAdapter
+from .fedml_client_slave_manager import ClientSlaveManager
+from .process_group_manager import ProcessGroupManager
 
 
 class Client:
@@ -17,11 +21,17 @@ class Client:
         if client_trainer is not None:
             adapter.user_trainer = client_trainer
         rank = int(getattr(args, "rank", 1))
-        self.client_manager = ClientMasterManager(
-            args, adapter, rank=rank, size=size, backend=backend)
+        proc_rank_in_silo = int(getattr(args, "proc_rank_in_silo", 0))
+        if proc_rank_in_silo > 0:
+            # Reference: slave ranks never open a WAN connection.
+            self.client_manager = ClientSlaveManager(args, adapter)
+        else:
+            self.client_manager = ClientMasterManager(
+                args, adapter, rank=rank, size=size, backend=backend)
 
     def run(self):
         self.client_manager.run()
 
 
-__all__ = ["Client", "ClientMasterManager", "TrainerDistAdapter"]
+__all__ = ["Client", "ClientMasterManager", "ClientSlaveManager",
+           "ProcessGroupManager", "TrainerDistAdapter"]

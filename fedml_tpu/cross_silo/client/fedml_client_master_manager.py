@@ -55,6 +55,7 @@ class ClientMasterManager(FedMLCommManager):
         data_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
         log_training_status("TRAINING")
+        self.trainer_adapter.announce_round(round_idx, params, data_idx)
         new_params, n = self.trainer_adapter.train(params, data_idx, round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, new_params)
@@ -69,12 +70,19 @@ class ClientMasterManager(FedMLCommManager):
 
     def handle_message_finish(self, msg_params):
         log_training_status("FINISHED")
+        self.trainer_adapter.announce_finish()
         self.finish()
 
 
 class TrainerDistAdapter:
     """Reference ``fedml_trainer_dist_adapter.py:10`` — binds a LocalTrainer
-    to this silo's data shard and runs the compiled local pass."""
+    to this silo's data shard and runs the compiled local pass.
+
+    ``scenario == "hierarchical"`` is the reference's intra-silo DDP (model
+    wrapped in ``torch DDP`` at ``fedml_trainer_dist_adapter.py:26``): here
+    the batch dimension of every local step is sharded over the silo's
+    ``data``-axis mesh (``ProcessGroupManager``) and GSPMD inserts the
+    gradient all-reduce — same math, collectives on ICI instead of NCCL."""
 
     def __init__(self, args, model, dataset):
         self.args = args
@@ -85,6 +93,39 @@ class TrainerDistAdapter:
         self.seed = int(getattr(args, "random_seed", 0))
         self.batch_size = int(getattr(args, "batch_size", 10))
         self.epochs = int(getattr(args, "epochs", 1))
+        self.process_group_manager = None
+        if str(getattr(args, "scenario", "horizontal")) == "hierarchical":
+            from .process_group_manager import ProcessGroupManager
+            self.process_group_manager = ProcessGroupManager(args)
+
+    def cleanup_pg(self):
+        if self.process_group_manager is not None:
+            self.process_group_manager.cleanup()
+
+    # -- multi-host silo round sync (reference sync_process_group:200) -----
+    def _sync_is_live(self) -> bool:
+        return (self.process_group_manager is not None
+                and jax.process_count() > 1)
+
+    def sync_template(self):
+        """The fixed pytree every silo process passes to the round
+        broadcast: [round_idx, params, client_index]. Structure must be
+        identical on master and slaves (multihost broadcast contract)."""
+        zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self.model.init_abstract())
+        return [jnp.zeros((), jnp.int32), zeros, jnp.zeros((), jnp.int32)]
+
+    def announce_round(self, round_idx: int, global_params, data_idx: int):
+        if self._sync_is_live():
+            self.process_group_manager.broadcast_object(
+                [jnp.asarray(round_idx, jnp.int32), global_params,
+                 jnp.asarray(data_idx, jnp.int32)])
+
+    def announce_finish(self):
+        if self._sync_is_live():
+            tmpl = self.sync_template()
+            tmpl[0] = jnp.asarray(-1, jnp.int32)
+            self.process_group_manager.broadcast_object(tmpl)
 
     def train(self, global_params, data_idx: int, round_idx: int):
         global_params = jax.tree_util.tree_map(jnp.asarray, global_params)
@@ -93,8 +134,15 @@ class TrainerDistAdapter:
         mask = jnp.ones((xb.shape[0],), jnp.float32)
         rng = rng_util.client_key(rng_util.root_key(self.seed), round_idx,
                                   data_idx)
+        xb, yb = jnp.asarray(xb), jnp.asarray(yb)
+        pg = self.process_group_manager
+        if pg is not None and pg.world_size > 1:
+            # Intra-silo data parallelism: (steps, batch, ...) sharded on
+            # the batch dim; params/rng replicated on the silo mesh.
+            xb = jax.device_put(xb, pg.batch_sharding)
+            yb = jax.device_put(yb, pg.batch_sharding)
+            global_params = jax.device_put(global_params, pg.replicated)
         ctx = ServerCtx(global_params=global_params)
-        out = self.local_train(global_params, jnp.asarray(xb), jnp.asarray(yb),
-                               mask, rng, ctx, None)
+        out = self.local_train(global_params, xb, yb, mask, rng, ctx, None)
         n = len(self.dataset.client_idxs[data_idx])
         return jax.device_get(out.params), n

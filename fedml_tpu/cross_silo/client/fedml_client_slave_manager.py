@@ -1,0 +1,68 @@
+"""Silo slave participant (reference
+``cross_silo/client/fedml_client_slave_manager.py`` — slave ranks that never
+touch the WAN: they block on ``dist.broadcast_object_list`` for
+(round, params, idx) from the master rank, run the DDP train pass, repeat).
+
+In the TPU runtime there is exactly one controller process per host and the
+data axis lives inside the compiled step, so slaves only exist for
+*multi-host* silos (one jax process per host, multi-controller SPMD). This
+manager is that participant: it loops on the master's round broadcast and
+joins the sharded train step; in a single-process silo it degenerates to an
+immediate no-op, matching how jax absorbs the reference's slave ranks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_FINISH = "finish"
+
+
+class ClientSlaveManager:
+    def __init__(self, args, trainer_adapter):
+        self.args = args
+        self.trainer_adapter = trainer_adapter
+        self.round_idx = 0
+        self.num_rounds = int(getattr(args, "comm_round", 10))
+        self.finished = False
+
+    def await_sync_process_group(self, src: int = 0):
+        """Block until the silo master announces the round; returns
+        [round_idx, params, client_index] (round_idx < 0 = finish). The
+        slave passes the same zero-filled pytree template the master's
+        ``announce_round`` fills (multihost broadcast requires identical
+        structure on every process)."""
+        pg = getattr(self.trainer_adapter, "process_group_manager", None)
+        if pg is None or jax.process_count() <= 1:
+            # Single-controller silo: jax's runtime already executed our
+            # shard inside the master's jitted step; nothing to wait for.
+            return [self.num_rounds, None, None]
+        msg = pg.broadcast_object(self.trainer_adapter.sync_template(),
+                                  src=src)
+        log.info("silo slave got round sync: round=%s", int(msg[0]))
+        return msg
+
+    def train(self):
+        rnd, params, idx = self.await_sync_process_group()
+        self.round_idx = int(rnd)
+        if params is None or self.round_idx < 0:
+            self.finish()
+            return
+        self.trainer_adapter.train(params, int(idx), self.round_idx)
+
+    def finish(self):
+        self.finished = True
+        cleanup = getattr(self.trainer_adapter, "cleanup_pg", None)
+        if cleanup is not None:
+            cleanup()
+        log.info("silo slave finished at round %d", self.round_idx)
+
+    def run(self):
+        while not self.finished:
+            self.train()
+            if self.round_idx >= self.num_rounds:
+                self.finish()

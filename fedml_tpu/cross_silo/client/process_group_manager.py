@@ -1,0 +1,71 @@
+"""Intra-silo process-group analog (reference
+``cross_silo/client/process_group_manager.py:8`` — torch
+``dist.init_process_group`` NCCL/Gloo; reference
+``fedml_client_slave_manager.py:104`` — ``dist.broadcast_object_list`` round
+sync).
+
+TPU-native inversion: a silo's "process group" is a named ``data`` axis over
+this host's local devices. Data parallelism is expressed by sharding the
+batch dimension over that axis inside the jitted local step — XLA/GSPMD
+inserts the gradient all-reduce that torch DDP does by hook, and it rides
+ICI. Multi-host silos use jax's multi-controller runtime (one process per
+host, same program), where `broadcast_object` maps onto
+``multihost_utils.broadcast_one_to_all`` rather than a torch broadcast.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.mesh import DATA_AXIS
+
+log = logging.getLogger(__name__)
+
+
+class ProcessGroupManager:
+    """Owns the silo-local data-parallel mesh.
+
+    ``n_proc_in_silo`` (the reference's torchrun world size) bounds how many
+    local devices join the data axis; the axis size is clipped to the
+    largest divisor of ``batch_size`` so the batch shards evenly (the
+    reference instead requires the user to pick matching world sizes).
+    """
+
+    def __init__(self, args, devices=None):
+        devices = list(devices if devices is not None else jax.local_devices())
+        requested = int(getattr(args, "n_proc_in_silo", 0) or 0)
+        n = min(len(devices), requested) if requested > 0 else len(devices)
+        batch = int(getattr(args, "batch_size", 10))
+        while n > 1 and batch % n:
+            n -= 1
+        self.mesh = Mesh(np.asarray(devices[:n]), (DATA_AXIS,))
+        self.batch_sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        self.replicated = NamedSharding(self.mesh, P())
+        log.info("silo process group: %d-way data parallelism over %s",
+                 n, [d.platform for d in devices[:n]])
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size
+
+    def get_process_group(self) -> Mesh:
+        return self.mesh
+
+    def broadcast_object(self, obj, src: int = 0):
+        """Round-sync broadcast (reference ``sync_process_group:200`` /
+        ``await_sync_process_group:104``). Single-controller: identity.
+        Multi-controller (one jax process per silo host): broadcast from the
+        silo master process over the jax runtime."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            return multihost_utils.broadcast_one_to_all(
+                obj, is_source=jax.process_index() == src)
+        return obj
+
+    def cleanup(self) -> None:
+        """Parity with the reference's ``destroy_process_group``; meshes are
+        plain objects, nothing to tear down."""

@@ -1,0 +1,11 @@
+"""Serving SDK (reference ``python/fedml/serving/``): predictor ABC, HTTP
+inference runner, federated serving client/server, OpenAI-compatible
+template."""
+
+from .fedml_client import FedMLModelServingClient
+from .fedml_inference_runner import FedMLInferenceRunner
+from .fedml_predictor import FedMLPredictor
+from .fedml_server import FedMLModelServingServer
+
+__all__ = ["FedMLInferenceRunner", "FedMLModelServingClient",
+           "FedMLModelServingServer", "FedMLPredictor"]

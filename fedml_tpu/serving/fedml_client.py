@@ -1,0 +1,25 @@
+"""Federated model-serving client (reference
+``python/fedml/serving/fedml_client.py:5`` ``FedMLModelServingClient`` — the
+silo-side participant of a serving federation; same FSM as the cross-silo
+trainer client)."""
+
+from __future__ import annotations
+
+from ..cross_silo.client import Client
+
+
+class FedMLModelServingClient:
+    def __init__(self, args, end_point_name, model_name, model_version="",
+                 inference_request=None, device=None, dataset=None,
+                 model=None, train_data_num=0, client_trainer=None):
+        self.end_point_name = end_point_name
+        self.model_name = model_name
+        self.model_version = model_version
+        self.inference_request = inference_request
+        args.update(end_point_name=end_point_name, model_name=model_name,
+                    model_version=model_version)
+        self._client = Client(args, device, dataset, model,
+                              client_trainer=client_trainer)
+
+    def run(self):
+        self._client.run()

@@ -1,0 +1,34 @@
+"""Federated model-serving server (reference
+``python/fedml/serving/fedml_server.py:4`` ``FedMLModelServingServer`` —
+binds endpoint metadata to the cross-silo server FSM so a trained federated
+model can keep being refined while it serves).
+
+Reuses the cross-silo ``Server`` (same aggregation FSM, same comm
+backends); endpoint identity travels in the args so the deploy plane can
+register the resulting model under ``{end_point_name}/{model_name}``.
+"""
+
+from __future__ import annotations
+
+from ..cross_silo.server import Server
+
+
+class FedMLModelServingServer:
+    def __init__(self, args, end_point_name, model_name, model_version="",
+                 inference_request=None, device=None, dataset=None,
+                 model=None, server_aggregator=None):
+        self.end_point_name = end_point_name
+        self.model_name = model_name
+        self.model_version = model_version
+        self.inference_request = inference_request
+        args.update(end_point_name=end_point_name, model_name=model_name,
+                    model_version=model_version)
+        self._server = Server(args, device, dataset, model,
+                              server_aggregator=server_aggregator)
+
+    @property
+    def aggregator(self):
+        return self._server.aggregator
+
+    def run(self):
+        return self._server.run()

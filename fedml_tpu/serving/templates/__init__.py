@@ -1,0 +1,6 @@
+"""Serving templates (reference ``python/fedml/serving/templates/`` — the HF
+chatbot template with its OpenAI-compatible ``main_openai.py``)."""
+
+from .openai_compat import ByteTokenizer, OpenAICompatServer, generate
+
+__all__ = ["ByteTokenizer", "OpenAICompatServer", "generate"]

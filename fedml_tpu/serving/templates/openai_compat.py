@@ -1,0 +1,258 @@
+"""OpenAI-compatible chat/completions endpoint over a flax causal LM
+(reference ``python/fedml/serving/templates/hf_template/main_openai.py`` —
+the HF chatbot template exposing ``/v1/chat/completions``).
+
+TPU-native serving decisions:
+
+- **Fixed-shape decode.** The token buffer is padded to a static length so
+  the per-token step compiles ONCE (no data-dependent shapes under jit);
+  decode is a jitted full-buffer forward + gather of the live position's
+  logits. For the small federated models this template targets, that is
+  simpler and faster than maintaining a KV cache in host Python.
+- **Deterministic sampling.** threefry key per request; temperature 0 ⇒
+  argmax.
+- **Zero extra deps.** stdlib HTTP server (FastAPI isn't in the image),
+  byte-level tokenizer fallback so no tokenizer download is needed; any
+  object with encode/decode can be plugged in instead.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 = bytes, 256 = BOS, 257 = EOS."""
+
+    vocab_size = 258
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] if add_bos else []) + ids
+
+    def decode(self, ids) -> str:
+        data = bytes(i for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def generate(apply_fn: Callable, params, prompt_ids: List[int],
+             max_new_tokens: int = 64, temperature: float = 0.0,
+             top_k: int = 0, seed: int = 0, buf_len: int = 256,
+             eos_id: Optional[int] = None,
+             on_token: Optional[Callable[[int], None]] = None) -> List[int]:
+    """Sample ``max_new_tokens`` continuations of ``prompt_ids``.
+
+    ``apply_fn(params, tokens)`` must return logits of shape (B, T, V).
+    The (1, buf_len) buffer shape is static, so the step function compiles
+    once per buffer size regardless of prompt/generation length.
+    """
+    prompt_ids = list(prompt_ids)[-(buf_len - 1):]
+
+    @jax.jit
+    def step(params, buf, pos, key, temp):
+        logits = apply_fn(params, buf)  # (1, L, V)
+        # logits at pos-1 predict token at pos
+        live = jax.lax.dynamic_index_in_dim(logits[0], pos - 1, axis=0,
+                                            keepdims=False)
+        if top_k and top_k > 0:
+            kth = jnp.sort(live)[-top_k]
+            live = jnp.where(live < kth, -jnp.inf, live)
+        greedy = jnp.argmax(live)
+        sampled = jax.random.categorical(key, live / jnp.maximum(temp, 1e-6))
+        return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+
+    buf = np.zeros((1, buf_len), np.int32)
+    n = len(prompt_ids)
+    buf[0, :n] = prompt_ids
+    buf_j = jnp.asarray(buf)
+    key = jax.random.PRNGKey(seed)
+    out: List[int] = []
+    pos = n
+    for _ in range(max_new_tokens):
+        if pos >= buf_len:
+            break
+        key, sub = jax.random.split(key)
+        tok = int(step(params, buf_j, pos, sub, float(temperature)))
+        if eos_id is not None and tok == eos_id:
+            break
+        out.append(tok)
+        if on_token is not None:
+            on_token(tok)
+        buf_j = buf_j.at[0, pos].set(tok)
+        pos += 1
+    return out
+
+
+def _render_chat(messages: List[dict]) -> str:
+    """Minimal chat template (the reference delegates to the HF tokenizer's
+    chat template; the byte tokenizer needs an explicit one)."""
+    parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}"
+             for m in messages]
+    return "\n".join(parts) + "\n<|assistant|>\n"
+
+
+class OpenAICompatServer:
+    """Serves /v1/models, /v1/completions, /v1/chat/completions (+ SSE
+    streaming) over a (model_apply, params) pair."""
+
+    def __init__(self, apply_fn: Callable, params, tokenizer=None,
+                 model_name: str = "fedml-tpu-llm", host: str = "0.0.0.0",
+                 port: int = 0, buf_len: int = 256):
+        self.apply_fn = apply_fn
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.model_name = model_name
+        self.host, self.port = host, port
+        self.buf_len = buf_len
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    # -- request handling --------------------------------------------------
+    def _complete(self, prompt: str, req: dict,
+                  on_text: Optional[Callable[[str], None]] = None) -> str:
+        """Run generation; ``on_text`` (if given) receives incremental text
+        deltas on UTF-8 boundaries — a raw per-token decode would shred
+        multi-byte characters with the byte tokenizer."""
+        tok = self.tokenizer
+        ids: List[int] = []
+        sent = 0
+
+        def emit(t: int):
+            nonlocal sent
+            ids.append(t)
+            text = tok.decode(ids)
+            # trailing replacement chars mark an incomplete UTF-8 sequence;
+            # hold those bytes back until the sequence completes
+            clean = text.rstrip("�")
+            if len(clean) > sent:
+                on_text(clean[sent:])
+                sent = len(clean)
+
+        out = generate(
+            self.apply_fn, self.params, tok.encode(prompt),
+            max_new_tokens=int(req.get("max_tokens", 64)),
+            temperature=float(req.get("temperature", 0.0)),
+            top_k=int(req.get("top_k", 0)),
+            seed=int(req.get("seed", 0)),
+            buf_len=self.buf_len,
+            eos_id=getattr(tok, "eos_id", None),
+            on_token=emit if on_text else None)
+        text = tok.decode(out)
+        if on_text and len(text) > sent:
+            on_text(text[sent:])  # flush any held-back tail
+        return text
+
+    def _make_handler(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/models":
+                    self._send_json(200, {"object": "list", "data": [
+                        {"id": outer.model_name, "object": "model",
+                         "owned_by": "fedml_tpu"}]})
+                elif self.path in ("/ready", "/health"):
+                    self._send_json(200, {"ready": True})
+                else:
+                    self._send_json(404, {"error": "not found"})
+
+            def _sse_stream(self, make_chunk, run):
+                """True streaming: chunks are flushed as generation emits
+                them (``run`` is called with the per-delta writer)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+
+                def write_piece(piece: str):
+                    data = json.dumps(make_chunk(piece))
+                    self.wfile.write(f"data: {data}\n\n".encode())
+                    self.wfile.flush()
+
+                run(write_piece)
+                self.wfile.write(b"data: [DONE]\n\n")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._send_json(400, {"error": "bad json"})
+                    return
+                rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+                now = int(time.time())
+                try:
+                    if self.path == "/v1/chat/completions":
+                        prompt = _render_chat(req.get("messages", []))
+                        if req.get("stream"):
+                            self._sse_stream(
+                                lambda p: {
+                                    "id": rid, "object":
+                                        "chat.completion.chunk",
+                                    "created": now, "model": outer.model_name,
+                                    "choices": [{"index": 0, "delta":
+                                                 {"content": p},
+                                                 "finish_reason": None}]},
+                                lambda writer: outer._complete(
+                                    prompt, req, on_text=writer))
+                            return
+                        text = outer._complete(prompt, req)
+                        self._send_json(200, {
+                            "id": rid, "object": "chat.completion",
+                            "created": now, "model": outer.model_name,
+                            "choices": [{"index": 0, "message":
+                                         {"role": "assistant",
+                                          "content": text},
+                                         "finish_reason": "stop"}]})
+                    elif self.path == "/v1/completions":
+                        text = outer._complete(str(req.get("prompt", "")), req)
+                        self._send_json(200, {
+                            "id": rid, "object": "text_completion",
+                            "created": now, "model": outer.model_name,
+                            "choices": [{"index": 0, "text": text,
+                                         "finish_reason": "stop"}]})
+                    else:
+                        self._send_json(404, {"error": "not found"})
+                except Exception as e:
+                    log.exception("generation failed")
+                    self._send_json(500, {"error": str(e)})
+
+            def log_message(self, fmt, *args):
+                log.debug("openai-compat: " + fmt, *args)
+
+        return Handler
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        self._server = ThreadingHTTPServer((self.host, self.port),
+                                           self._make_handler())
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        log.info("openai-compatible endpoint on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

@@ -1,0 +1,170 @@
+"""Serving plane: federated serving managers (reference
+``serving/fedml_server.py``/``fedml_client.py``) and the OpenAI-compatible
+template (reference ``serving/templates/hf_template/main_openai.py``)."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+
+
+def _args(backend, rank, run_id, **over):
+    args = load_arguments()
+    args.update(
+        training_type="cross_silo", backend=backend, rank=rank, run_id=run_id,
+        dataset="synthetic", num_classes=10, input_shape=(14, 14, 1),
+        train_size=256, test_size=64, model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        epochs=1, batch_size=16, learning_rate=0.1, random_seed=3,
+        client_id_list=[1, 2], frequency_of_the_test=1,
+    )
+    args.update(**over)
+    return args
+
+
+def test_federated_serving_managers():
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.serving import (FedMLModelServingClient,
+                                   FedMLModelServingServer)
+
+    result = {}
+
+    def server_thread():
+        args = _args("local", 0, "t_serve", role="server")
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        srv = FedMLModelServingServer(args, "ep1", "lr-mnist", "v1",
+                                      dataset=dataset, model=model)
+        result["params"] = srv.run()
+
+    def client_thread(rank):
+        args = _args("local", rank, "t_serve", role="client")
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        FedMLModelServingClient(args, "ep1", "lr-mnist", "v1",
+                                dataset=dataset, model=model).run()
+
+    threads = [threading.Thread(target=server_thread)] + [
+        threading.Thread(target=client_thread, args=(r,)) for r in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "serving federation deadlocked"
+    assert result["params"] is not None
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return resp.status, resp.read()
+
+
+def test_openai_compat_endpoint():
+    from fedml_tpu.llm.model import LlamaLM, TINY
+    from fedml_tpu.serving.templates import ByteTokenizer, OpenAICompatServer
+    import dataclasses
+
+    tok = ByteTokenizer()
+    cfg = dataclasses.replace(TINY, vocab_size=tok.vocab_size, n_layers=1,
+                              dim=32, n_heads=2, n_kv_heads=2, ffn_dim=64)
+    lm = LlamaLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0),
+                     np.zeros((1, 8), np.int32))["params"]
+    apply_fn = lambda p, toks: lm.apply({"params": p}, toks)
+
+    srv = OpenAICompatServer(apply_fn, params, tokenizer=tok, buf_len=64)
+    port = srv.start()
+    try:
+        # /v1/models
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models", timeout=30) as resp:
+            models = json.loads(resp.read())
+        assert models["data"][0]["id"] == "fedml-tpu-llm"
+
+        # /v1/completions — deterministic at temperature 0
+        st, body = _post(port, "/v1/completions",
+                         {"prompt": "hi", "max_tokens": 4})
+        out = json.loads(body)
+        assert st == 200 and out["object"] == "text_completion"
+        st2, body2 = _post(port, "/v1/completions",
+                           {"prompt": "hi", "max_tokens": 4})
+        assert json.loads(body2)["choices"][0]["text"] == \
+            out["choices"][0]["text"]
+
+        # /v1/chat/completions
+        st, body = _post(port, "/v1/chat/completions",
+                         {"messages": [{"role": "user", "content": "yo"}],
+                          "max_tokens": 4, "temperature": 0.7, "seed": 1})
+        out = json.loads(body)
+        assert st == 200 and out["choices"][0]["message"]["role"] == \
+            "assistant"
+
+        # streaming
+        st, body = _post(port, "/v1/chat/completions",
+                         {"messages": [{"role": "user", "content": "yo"}],
+                          "max_tokens": 3, "stream": True})
+        text = body.decode()
+        assert "data: [DONE]" in text
+        assert "chat.completion.chunk" in text
+    finally:
+        srv.stop()
+
+
+def test_generate_respects_eos():
+    from fedml_tpu.serving.templates import generate
+
+    vocab = 16
+
+    def apply_fn(params, toks):
+        # always predicts token 7
+        logits = np.zeros(toks.shape + (vocab,), np.float32)
+        logits[..., 7] = 10.0
+        return jax.numpy.asarray(logits)
+
+    out = generate(apply_fn, None, [1, 2], max_new_tokens=8, eos_id=7,
+                   buf_len=16)
+    assert out == []  # first sampled token is EOS
+    out = generate(apply_fn, None, [1, 2], max_new_tokens=3, buf_len=16)
+    assert out == [7, 7, 7]
+
+
+def test_streaming_preserves_multibyte_utf8():
+    """Per-token streaming must not shred multi-byte UTF-8 ("é" = C3 A9)."""
+    from fedml_tpu.serving.templates import ByteTokenizer, OpenAICompatServer
+
+    tok = ByteTokenizer()
+    vocab = tok.vocab_size
+
+    def apply_fn(params, toks):
+        # after 0xC3 predict 0xA9, otherwise 0xC3 → "ééé…" regardless of
+        # prompt length (jnp ops: runs under jit tracing)
+        jnp = jax.numpy
+        is_c3 = (toks == 0xC3)[..., None]
+        one_a9 = jnp.zeros((vocab,)).at[0xA9].set(10.0)
+        one_c3 = jnp.zeros((vocab,)).at[0xC3].set(10.0)
+        return jnp.where(is_c3, one_a9, one_c3)
+
+    srv = OpenAICompatServer(apply_fn, None, tokenizer=tok, buf_len=32)
+    port = srv.start()
+    try:
+        st, body = _post(port, "/v1/chat/completions",
+                         {"messages": [{"role": "user", "content": "x"}],
+                          "max_tokens": 6, "stream": True})
+        text = body.decode()
+        deltas = [json.loads(l[len("data: "):])
+                  for l in text.splitlines()
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+        joined = "".join(d["choices"][0]["delta"]["content"] for d in deltas)
+        assert "�" not in joined, joined
+        assert "é" in joined, joined
+    finally:
+        srv.stop()

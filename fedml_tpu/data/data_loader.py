@@ -23,9 +23,10 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .federated_dataset import FederatedDataset, build_federated
+from .federated_dataset import FederatedDataset, build_federated, partition
 from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
-                        synthetic_tabular, synthetic_text_classification,
+                        synthetic_segmentation, synthetic_tabular,
+                        synthetic_text_classification,
                         synthetic_vertical_parties)
 
 # (classes, img shape, train_n, test_n) per image dataset, matching reference
@@ -68,6 +69,30 @@ _TEXTCLS_SPECS = {
     "agnews": (4, 30000, 64, 12000, 2000),
 }
 
+# large-image sets (reference ``data/ImageNet/`` incl. hdf5 variant,
+# ``data/Landmarks/`` gld23k/gld160k): full reference cardinalities are kept
+# for the real-data path; the synthetic fallback honors
+# args.train_size/test_size so the no-egress path stays tractable.
+# name -> (classes, img shape, ref_train_n, ref_test_n)
+_BIG_IMAGE_SPECS = {
+    "imagenet": (1000, (224, 224, 3), 1281167, 50000),
+    "imagenet_hdf5": (1000, (224, 224, 3), 1281167, 50000),
+    "ilsvrc2012": (1000, (224, 224, 3), 1281167, 50000),
+    "landmarks": (203, (224, 224, 3), 23080, 1959),
+    "gld23k": (203, (224, 224, 3), 23080, 1959),
+    "gld160k": (2028, (224, 224, 3), 164172, 19526),
+}
+
+# dense-prediction sets (reference ``data/FeTS2021/`` — 4-modality MRI tumor
+# segmentation; ``data/AutonomousDriving/`` — driving-scene segmentation):
+# name -> (classes, (H, W, C), train_n, test_n)
+_SEG_SPECS = {
+    "fets2021": (4, (64, 64, 4), 2000, 400),
+    "fets": (4, (64, 64, 4), 2000, 400),
+    "autonomous_driving": (19, (64, 128, 3), 3000, 500),
+    "cityscapes": (19, (64, 128, 3), 3000, 500),
+}
+
 
 def _try_load_npz(cache_dir: str, name: str):
     path = os.path.join(cache_dir, f"{name}.npz")
@@ -103,6 +128,43 @@ def _try_load_mnist_idx(cache_dir: str):
     tx = (tx.astype(np.float32) / 255.0)[..., None]
     vx = (vx.astype(np.float32) / 255.0)[..., None]
     return tx, ty.astype(np.int64), vx, vy.astype(np.int64)
+
+
+def _try_load_hdf5(cache_dir: str, name: str):
+    """ImageNet-style hdf5 (reference ``data/ImageNet/.../imagenet_hdf5`` —
+    one file with train/val image+label datasets)."""
+    candidates = [f"{name}.h5", f"{name}.hdf5"]
+    if name.startswith(("imagenet", "ilsvrc")):
+        candidates.append("imagenet.hdf5")
+    for fname in candidates:
+        path = os.path.join(cache_dir, fname)
+        if not os.path.exists(path):
+            continue
+        import h5py
+        with h5py.File(path, "r") as f:
+            def pick(*keys):
+                for k in keys:
+                    if k in f:
+                        return np.asarray(f[k])
+                return None
+            tx = pick("train_x", "images_train", "train/images")
+            ty = pick("train_y", "labels_train", "train/labels")
+            vx = pick("test_x", "images_val", "val/images")
+            vy = pick("test_y", "labels_val", "val/labels")
+        if tx is None or ty is None:
+            continue
+        if vx is None or vy is None:
+            # no (complete) val split in the file: carve 5% off train
+            cut = int(len(tx) * 0.95)
+            tx, vx = tx[:cut], tx[cut:]
+            ty, vy = ty[:cut], ty[cut:]
+
+        def norm(x):
+            return x.astype(np.float32) / 255.0 if x.dtype == np.uint8 \
+                else x.astype(np.float32)
+        return (norm(tx), ty.astype(np.int64), norm(vx),
+                vy.astype(np.int64))
+    return None
 
 
 def load(args) -> Tuple[FederatedDataset, int]:
@@ -162,6 +224,71 @@ def load(args) -> Tuple[FederatedDataset, int]:
                 train_n, test_n, classes, vocab, seq_len, seed)
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
                              alpha, seed)
+        return ds, classes
+
+    if name in _BIG_IMAGE_SPECS:
+        classes, shape, ref_train_n, ref_test_n = _BIG_IMAGE_SPECS[name]
+        real = _try_load_npz(cache, name) if cache else None
+        if real is None and cache:
+            real = _try_load_hdf5(cache, name)
+        if real is not None:
+            tx, ty, vx, vy = real
+        else:
+            # synthetic fallback at a tractable scale (reference
+            # cardinalities would be ~770GB of pixels)
+            train_n = int(getattr(args, "train_size", 0) or
+                          min(ref_train_n, 20000))
+            test_n = int(getattr(args, "test_size", 0) or
+                         min(ref_test_n, 2000))
+            shape = tuple(getattr(args, "input_shape", None) or shape)
+            tx, ty, vx, vy = synthetic_image_classification(
+                train_n, test_n, classes, shape, seed)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed)
+        return ds, classes
+
+    if name in _SEG_SPECS:
+        classes, shape, train_n, test_n = _SEG_SPECS[name]
+        train_n = int(getattr(args, "train_size", 0) or train_n)
+        test_n = int(getattr(args, "test_size", 0) or test_n)
+        shape = tuple(getattr(args, "input_shape", None) or shape)
+        real = _try_load_npz(cache, name) if cache else None
+        if real is not None:
+            tx, ty, vx, vy = real
+        else:
+            tx, ty, vx, vy = synthetic_segmentation(
+                train_n, test_n, classes, shape, seed)
+        # Dirichlet partition needs ONE label per sample; use each image's
+        # dominant class (reference FeTS partitions by institution, which
+        # correlates with tumor morphology — dominant-class is the synthetic
+        # stand-in for that skew).
+        dominant = np.array([np.bincount(m.reshape(-1),
+                                         minlength=classes).argmax()
+                             for m in ty])
+        client_idxs = partition(dominant, client_num, method, alpha, seed)
+        ds = FederatedDataset(tx, ty, vx, vy, client_idxs, classes)
+        return ds, classes
+
+    if name in ("edge_case_examples", "edge_case"):
+        # Reference ``data/edge_case_examples/``: CIFAR-10 plus a pool of
+        # out-of-distribution "edge case" images (southwest airplanes etc.)
+        # used by the edge-case backdoor attack. The pool rides on the
+        # dataset object as ``edge_x``/``edge_y`` (attacker-chosen target).
+        classes = 10
+        shape = tuple(getattr(args, "input_shape", None) or (32, 32, 3))
+        train_n = int(getattr(args, "train_size", 0) or 10000)
+        test_n = int(getattr(args, "test_size", 0) or 2000)
+        edge_n = int(getattr(args, "edge_case_size", 512))
+        tx, ty, vx, vy = synthetic_image_classification(
+            train_n, test_n, classes, shape, seed)
+        ex, _, _, _ = synthetic_image_classification(
+            edge_n, 1, classes, shape, seed ^ 0xED6E, noise=0.9)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed)
+        ds.edge_x = ex
+        ds.edge_y = np.full((edge_n,),
+                            int(getattr(args, "edge_case_target", 9)),
+                            np.int64)
         return ds, classes
 
     if name.startswith("synthetic"):

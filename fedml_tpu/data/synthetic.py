@@ -123,3 +123,25 @@ def synthetic_vertical_parties(n: int, parties: int, features_per_party,
         outs.append(x[:, off:off + f].astype(np.float32))
         off += f
     return outs, y.astype(np.int64)
+
+
+def synthetic_segmentation(train_n: int, test_n: int, num_classes: int,
+                           shape, seed: int, noise: float = 0.1):
+    """Dense per-pixel labels (FeTS2021 / AutonomousDriving fallback;
+    reference ``data/FeTS2021/``, ``data/AutonomousDriving/``): blocky class
+    regions whose channel intensity encodes the class, so a segmentation net
+    can actually learn the mapping."""
+    rng = np.random.default_rng(seed ^ 0x5E6)
+    n = train_n + test_n
+    h, w = int(shape[0]), int(shape[1])
+    c = int(shape[2]) if len(shape) > 2 else 1
+    # piecewise-constant masks: random low-res label grids upsampled 4x
+    gh, gw = max(1, h // 4), max(1, w // 4)
+    grid = rng.integers(0, num_classes, size=(n, gh, gw))
+    y = np.repeat(np.repeat(grid, (h + gh - 1) // gh, axis=1),
+                  (w + gw - 1) // gw, axis=2)[:, :h, :w]
+    x = (y[..., None] / max(num_classes - 1, 1)).astype(np.float32)
+    x = np.broadcast_to(x, (n, h, w, c)).copy()
+    x += noise * rng.standard_normal(x.shape).astype(np.float32)
+    return (x[:train_n], y[:train_n].astype(np.int64),
+            x[train_n:], y[train_n:].astype(np.int64))

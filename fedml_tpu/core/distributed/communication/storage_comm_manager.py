@@ -1,0 +1,109 @@
+"""Control/data-split wrapper over any control-plane backend + any
+content-addressed store.
+
+This is the shape of four reference comm managers at once
+(``mqtt_s3_multi_clients_comm_manager.py`` / ``mqtt_s3_mnn`` /
+``mqtt_web3`` / ``mqtt_thetastore``): a small control message travels on the
+broker; the model payload goes to remote storage and the message carries its
+key. Here the broker role is played by any ``BaseCommunicationManager``
+(local queues, filestore, gRPC, MQTT) and the storage role by any
+``ContentAddressedStore`` (local CA dir, web3.storage, Theta EdgeStore).
+
+``codec="tree"`` ships pytrees as msgpack (the S3-pickle analog);
+``codec="edge_bundle"`` ships the flat-tensor bundle the C++ edge trainer
+consumes (the ``.mnn``-file analog for cross-device rounds).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List
+
+import numpy as np
+
+from ..distributed_storage import ContentAddressedStore
+from .base_com_manager import BaseCommunicationManager, Observer
+from .message import (Message, MSG_ARG_KEY_MODEL_PARAMS,
+                      MSG_ARG_KEY_MODEL_PARAMS_URL, decode_tree, encode_tree)
+
+
+def _flatten_for_bundle(params):
+    import jax
+    if isinstance(params, dict) and all(
+            np.ndim(v) >= 0 and not isinstance(v, dict)
+            for v in params.values()):
+        # already the flat {name: tensor} contract the edge trainer uses
+        return {str(k): np.asarray(v) for k, v in params.items()}
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return {jax.tree_util.keystr(path): np.asarray(leaf)
+            for path, leaf in flat}
+
+
+class StorageCommManager(BaseCommunicationManager, Observer):
+    def __init__(self, control: BaseCommunicationManager,
+                 store: ContentAddressedStore, codec: str = "tree"):
+        self.control = control
+        self.store = store
+        self.codec = codec
+        self._observers: List[Observer] = []
+        self.control.add_observer(self)
+
+    # -- send path: payload → store, cid → control message -----------------
+    def _encode(self, params) -> bytes:
+        if self.codec == "edge_bundle":
+            from ....native import edge_bundle
+            with tempfile.NamedTemporaryFile(suffix=".fteb",
+                                             delete=False) as f:
+                tmp = f.name
+            try:
+                edge_bundle.write_bundle(tmp, _flatten_for_bundle(params))
+                with open(tmp, "rb") as f:
+                    return f.read()
+            finally:
+                os.unlink(tmp)
+        return encode_tree(params)
+
+    def _decode(self, blob: bytes):
+        if self.codec == "edge_bundle":
+            from ....native import edge_bundle
+            with tempfile.NamedTemporaryFile(suffix=".fteb",
+                                             delete=False) as f:
+                f.write(blob)
+                tmp = f.name
+            try:
+                return edge_bundle.read_bundle(tmp)
+            finally:
+                os.unlink(tmp)
+        return decode_tree(blob)
+
+    def send_message(self, msg: Message):
+        params = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        if params is not None:
+            cid = self.store.put(self._encode(params))
+            msg.add_params(MSG_ARG_KEY_MODEL_PARAMS, None)
+            msg.add_params(MSG_ARG_KEY_MODEL_PARAMS_URL, cid)
+        self.control.send_message(msg)
+
+    # -- receive path: resolve cid before dispatching up -------------------
+    def receive_message(self, msg_type, msg_params) -> None:
+        cid = msg_params.get(MSG_ARG_KEY_MODEL_PARAMS_URL)
+        if cid and msg_params.get(MSG_ARG_KEY_MODEL_PARAMS) is None:
+            msg_params.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                                  self._decode(self.store.get(cid)))
+        for obs in list(self._observers):
+            obs.receive_message(msg_type, msg_params)
+
+    # -- plumbing ----------------------------------------------------------
+    def add_observer(self, observer: Observer):
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer):
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self):
+        self.control.handle_receive_message()
+
+    def stop_receive_message(self):
+        self.control.stop_receive_message()

@@ -1,0 +1,6 @@
+"""Tensor-direct RPC backend (reference TRPC,
+``core/distributed/communication/trpc/trpc_comm_manager.py:21``)."""
+
+from .trpc_comm_manager import TRPCCommManager
+
+__all__ = ["TRPCCommManager"]

@@ -1,0 +1,10 @@
+"""Decentralized/content-addressed storage (reference
+``core/distributed/distributed_storage/`` backing the MQTT+Web3 and
+MQTT+Theta comm managers — model blobs go to web3.storage / Theta EdgeStore
+and the control message carries the content id)."""
+
+from .store import (ContentAddressedStore, LocalCAStore, ThetaEdgeStore,
+                    Web3Store, create_store)
+
+__all__ = ["ContentAddressedStore", "LocalCAStore", "ThetaEdgeStore",
+           "Web3Store", "create_store"]

@@ -1,0 +1,126 @@
+"""Content-addressed stores.
+
+The reference ships two decentralized data planes (web3.storage IPFS pinning
+— ``mqtt_web3/web3_storage.py``; Theta EdgeStore —
+``mqtt_thetastore/thetastore_storage.py``), both with the same shape: put
+bytes → content id, get(content id) → bytes, with the id riding in the MQTT
+control message. That shape is captured here as ``ContentAddressedStore``;
+the HTTP gateways are thin urllib clients (endpoint/token are plain config —
+no vendor-backend coupling), and ``LocalCAStore`` provides the same
+semantics over a shared filesystem for hermetic tests and pod-local runs.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+import json
+import os
+import urllib.request
+from typing import Optional
+
+_DEFAULT_ROOT = "/tmp/fedml_tpu_castore"
+
+
+class ContentAddressedStore(abc.ABC):
+    @abc.abstractmethod
+    def put(self, data: bytes) -> str:
+        """Store bytes, return the content id."""
+
+    @abc.abstractmethod
+    def get(self, cid: str) -> bytes:
+        """Fetch bytes by content id."""
+
+
+class LocalCAStore(ContentAddressedStore):
+    """sha256-addressed blobs in a directory (NFS/GCS-fuse across hosts)."""
+
+    def __init__(self, root: str = _DEFAULT_ROOT):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def put(self, data: bytes) -> str:
+        cid = hashlib.sha256(data).hexdigest()
+        path = os.path.join(self.root, cid)
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic: readers never see partials
+        return cid
+
+    def get(self, cid: str) -> bytes:
+        with open(os.path.join(self.root, cid), "rb") as f:
+            return f.read()
+
+
+class Web3Store(ContentAddressedStore):
+    """web3.storage-style HTTP pinning client (reference
+    ``mqtt_web3/web3_storage.py``): POST /upload → {"cid"}, GET from an IPFS
+    gateway."""
+
+    def __init__(self, token: str, api: str = "https://api.web3.storage",
+                 gateway: str = "https://{cid}.ipfs.w3s.link"):
+        self.token = token
+        self.api = api.rstrip("/")
+        self.gateway = gateway
+
+    def put(self, data: bytes) -> str:
+        req = urllib.request.Request(
+            f"{self.api}/upload", data=data, method="POST",
+            headers={"Authorization": f"Bearer {self.token}",
+                     "Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())["cid"]
+
+    def get(self, cid: str) -> bytes:
+        url = self.gateway.format(cid=cid)
+        with urllib.request.urlopen(url, timeout=120) as resp:
+            return resp.read()
+
+
+class ThetaEdgeStore(ContentAddressedStore):
+    """Theta EdgeStore JSON-RPC client (reference
+    ``mqtt_thetastore/…``): edgestore.PutData / edgestore.GetData."""
+
+    def __init__(self, rpc: str = "http://localhost:17888/rpc"):
+        self.rpc = rpc
+        self._id = 0
+
+    def _call(self, method: str, params: dict) -> dict:
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method, "params": [params]}).encode()
+        req = urllib.request.Request(
+            self.rpc, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        if out.get("error"):
+            raise RuntimeError(f"edgestore rpc error: {out['error']}")
+        return out["result"]
+
+    def put(self, data: bytes) -> str:
+        return self._call("edgestore.PutData",
+                          {"val": data.hex()})["key"]
+
+    def get(self, cid: str) -> bytes:
+        return bytes.fromhex(self._call("edgestore.GetData",
+                                        {"key": cid})["val"])
+
+
+def create_store(args, kind: Optional[str] = None) -> ContentAddressedStore:
+    """Pick the store from plain config (``args.storage_backend``:
+    local | web3 | theta); ``kind`` overrides without mutating args."""
+    if kind is None:
+        kind = str(getattr(args, "storage_backend", "local"))
+    kind = str(kind).lower()
+    if kind in ("local", "castore", ""):
+        return LocalCAStore(str(getattr(args, "store_dir", _DEFAULT_ROOT)))
+    if kind == "web3":
+        return Web3Store(token=str(getattr(args, "web3_token", "")),
+                         api=str(getattr(args, "web3_api",
+                                         "https://api.web3.storage")))
+    if kind in ("theta", "thetastore"):
+        return ThetaEdgeStore(rpc=str(getattr(
+            args, "theta_rpc", "http://localhost:17888/rpc")))
+    raise ValueError(f"unknown storage_backend {kind!r}")

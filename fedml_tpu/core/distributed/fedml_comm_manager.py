@@ -109,4 +109,25 @@ def create_comm_backend(args, rank: int, size: int,
         from .communication.mqtt.mqtt_s3_comm_manager import (
             MqttS3CommManager)
         return MqttS3CommManager(args, rank, size)
+    if backend == "TRPC":
+        from .communication.trpc.trpc_comm_manager import TRPCCommManager
+        return TRPCCommManager(run_id, rank, size)
+    if backend in ("MQTT_WEB3", "MQTT_THETA", "MQTT_S3_MNN", "CASTORE"):
+        # control/data split: local-or-filestore control plane + a
+        # content-addressed store data plane (reference mqtt_web3 /
+        # mqtt_thetastore / mqtt_s3_mnn managers)
+        from .communication.storage_comm_manager import StorageCommManager
+        from .distributed_storage import create_store
+        store_kind = getattr(args, "storage_backend", None) or {
+            "MQTT_WEB3": "web3", "MQTT_THETA": "theta"}.get(backend, "local")
+        control_kind = str(getattr(args, "control_backend", "local"))
+        if control_kind in ("MQTT_WEB3", "MQTT_THETA", "MQTT_S3_MNN",
+                            "CASTORE"):
+            raise ValueError(
+                f"control_backend {control_kind!r} is itself a storage-split "
+                "backend; use a plain control plane (local/filestore/GRPC)")
+        control = create_comm_backend(args, rank, size, control_kind)
+        codec = "edge_bundle" if backend == "MQTT_S3_MNN" else "tree"
+        return StorageCommManager(control, create_store(args, kind=store_kind),
+                                  codec=codec)
     raise ValueError(f"unknown comm backend {backend!r}")

@@ -11,7 +11,10 @@ key. Here the broker role is played by any ``BaseCommunicationManager``
 
 ``codec="tree"`` ships pytrees as msgpack (the S3-pickle analog);
 ``codec="edge_bundle"`` ships the flat-tensor bundle the C++ edge trainer
-consumes (the ``.mnn``-file analog for cross-device rounds).
+consumes (the ``.mnn``-file analog for cross-device rounds). The bundle
+format is float32-only by contract (the edge trainer's tensor type), so
+non-float leaves are cast on encode; nested dict structure round-trips via
+the keystr naming.
 """
 
 from __future__ import annotations
@@ -28,16 +31,41 @@ from .message import (Message, MSG_ARG_KEY_MODEL_PARAMS,
                       MSG_ARG_KEY_MODEL_PARAMS_URL, decode_tree, encode_tree)
 
 
+_KEYSTR_RE = None
+
+
 def _flatten_for_bundle(params):
     import jax
     if isinstance(params, dict) and all(
-            np.ndim(v) >= 0 and not isinstance(v, dict)
+            hasattr(v, "dtype") or isinstance(v, (int, float))
             for v in params.values()):
         # already the flat {name: tensor} contract the edge trainer uses
         return {str(k): np.asarray(v) for k, v in params.items()}
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
     return {jax.tree_util.keystr(path): np.asarray(leaf)
             for path, leaf in flat}
+
+
+def _unflatten_from_bundle(flat):
+    """Rebuild nesting from jax keystr names ("['a']['b']" → {'a': {'b':
+    ...}}); names that aren't keystr paths stay flat keys. Makes the
+    edge-bundle codec a structural round-trip for (nested) dict pytrees —
+    the shape every flax params tree has."""
+    global _KEYSTR_RE
+    if _KEYSTR_RE is None:
+        import re
+        _KEYSTR_RE = re.compile(r"\['([^']*)'\]")
+    out = {}
+    for name, arr in flat.items():
+        parts = _KEYSTR_RE.findall(name)
+        if not parts or "".join(f"['{p}']" for p in parts) != name:
+            out[name] = arr
+            continue
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
 
 
 class StorageCommManager(BaseCommunicationManager, Observer):
@@ -72,7 +100,7 @@ class StorageCommManager(BaseCommunicationManager, Observer):
                 f.write(blob)
                 tmp = f.name
             try:
-                return edge_bundle.read_bundle(tmp)
+                return _unflatten_from_bundle(edge_bundle.read_bundle(tmp))
             finally:
                 os.unlink(tmp)
         return decode_tree(blob)

@@ -68,27 +68,23 @@ class FederatedDataset:
         permutation per epoch (reference DataLoader-with-shuffle semantics).
         Short clients are padded by repetition up to one full batch so every
         client takes >=1 step."""
-        base = self.client_idxs[client]
-        all_idx = []
-        for e in range(epochs):
-            rng = hostrng.gen(seed, round_idx * 1031 + e, client, 1)
-            idx = rng.permutation(base)
-            if len(idx) < batch_size:
-                reps = int(np.ceil(batch_size / max(len(idx), 1)))
-                idx = np.tile(idx, reps)[:batch_size]
-            steps = len(idx) // batch_size
-            all_idx.append(idx[: steps * batch_size])
-        idx = np.concatenate(all_idx)
-        total = len(idx) // batch_size
-        xb = self.train_x[idx].reshape((total, batch_size) + self.train_x.shape[1:])
-        yb = self.train_y[idx].reshape((total, batch_size) + self.train_y.shape[1:])
+        idx = self.client_index_batches(client, batch_size, seed, round_idx,
+                                        epochs)
+        total = idx.shape[0]
+        flat = idx.reshape(-1)
+        xb = self.train_x[flat].reshape(
+            (total, batch_size) + self.train_x.shape[1:])
+        yb = self.train_y[flat].reshape(
+            (total, batch_size) + self.train_y.shape[1:])
         return xb, yb
 
     def client_index_batches(self, client: int, batch_size: int, seed: int,
                              round_idx: int, epochs: int = 1) -> np.ndarray:
-        """Like client_batches but returns only the (steps, batch) index
-        array — the host-side cost is one permutation per client; the
-        feature gather happens ON DEVICE in the round engine."""
+        """The ONE per-client batch-schedule implementation: (steps, batch)
+        index array, per-(client, epoch) rng stream, so the device-gather
+        path (cohort_indices), the host path (client_batches/
+        cohort_batches) and the cross-silo trainer all see the same
+        schedule for a given client+round."""
         base = self.client_idxs[client]
         all_idx = []
         for e in range(epochs):

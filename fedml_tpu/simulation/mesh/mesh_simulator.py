@@ -56,7 +56,7 @@ def _psum_wavg(stacked, w, axis_name):
 
 def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        mesh: Mesh, gather: bool = False):
-    """round_fn(state, x|idx, y|·, mask, weights, rngs, c_clients) with the
+    """round_fn(state, x|idx, y|·, mask, weights, key, c_clients) with the
     client axis sharded over the mesh; state (and, in gather mode, the
     dataset) replicated.  In gather mode the first data arg is the (C, S, B)
     index tensor and ``y`` is the replicated dataset pair (train_x, train_y)
@@ -102,7 +102,9 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             "train_loss": jax.lax.psum(jnp.sum(outs.loss * w), CLIENT_AXIS) / wsum,
             "total_steps": jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS),
         }
-        return new_state, metrics, outs
+        # only per-client algorithm state leaves the shard (returning
+        # outs.params would materialize C × |model| for nothing)
+        return new_state, metrics, outs.new_client_state
 
     shard = P(CLIENT_AXIS)
     data_spec = P() if gather else shard
@@ -112,7 +114,14 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
         out_specs=(P(), P(), shard),
         check_vma=False,
     )
-    return jax.jit(sharded)
+
+    def round_fn(state, x, y, mask, w, key, c_clients):
+        # split inside the compiled program (host-side split costs a device
+        # roundtrip per round); GSPMD shards the keys per in_spec
+        rngs = jax.random.split(key, mask.shape[0])
+        return sharded(state, x, y, mask, w, rngs, c_clients)
+
+    return jax.jit(round_fn)
 
 
 class MeshFedAvgAPI(FedAvgAPI):
@@ -171,7 +180,6 @@ class MeshFedAvgAPI(FedAvgAPI):
                 w = np.pad(w, (0, pad_c))
             data_x, data_y = x, y
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
-        rngs = jax.random.split(key, n_padded)
         c_stacked = None
         if self._c_clients is not None:
             zeros = tree_util.tree_zeros_like(self.state.global_params)
@@ -180,10 +188,10 @@ class MeshFedAvgAPI(FedAvgAPI):
                 + [zeros] * pad_c)
         put = lambda a: jax.device_put(jnp.asarray(a), self._data_sharding)
         dy = data_y if self._gather else put(data_y)
-        self.state, metrics, outs = self.round_fn(
-            self.state, put(data_x), dy, put(mask), put(w), put(rngs),
+        self.state, metrics, new_c = self.round_fn(
+            self.state, put(data_x), dy, put(mask), put(w), key,
             c_stacked)
         if self._c_clients is not None:
             self._scatter_c(clients, jax.device_get(
-                jax.tree_util.tree_map(lambda a: a[:n], outs.new_client_state)))
+                jax.tree_util.tree_map(lambda a: a[:n], new_c)))
         return metrics

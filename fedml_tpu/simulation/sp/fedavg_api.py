@@ -65,7 +65,13 @@ class FedAvgAPI:
             {} if self.server_opt.algorithm in ("scaffold", "feddyn") else None)
         self.metrics_history = []
 
+    #: donate the ServerState buffers into the round (in-place update on
+    #: device). Subclasses that call round_fn with states sharing buffers
+    #: (hierarchical group loop) must turn this off.
+    DONATE_STATE = True
+
     def _build_round_fn(self, client_mode: str):
+        donate = (0,) if self.DONATE_STATE else ()
         if bool(getattr(self.args, "device_data", True)):
             # dataset device-resident once; rounds ship only index tensors
             self._dev_x = jnp.asarray(self.dataset.train_x)
@@ -73,9 +79,9 @@ class FedAvgAPI:
             from ..round_engine import make_gather_round_fn
             return jax.jit(make_gather_round_fn(
                 self.trainer, self.server_opt, self._dev_x, self._dev_y,
-                mode=client_mode))
+                mode=client_mode), donate_argnums=donate)
         return jax.jit(make_round_fn(self.trainer, self.server_opt,
-                                     mode=client_mode))
+                                     mode=client_mode), donate_argnums=donate)
 
     # -- round pieces ------------------------------------------------------
     def _client_sampling(self, round_idx: int) -> np.ndarray:
@@ -99,7 +105,6 @@ class FedAvgAPI:
     def train_one_round(self, round_idx: int):
         clients = self._client_sampling(round_idx)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
-        rngs = jax.random.split(key, len(clients))
         c_stacked = self._gather_c(clients)
         if hasattr(self, "_dev_x"):
             idx, mask, w = self.dataset.cohort_indices(
@@ -110,9 +115,9 @@ class FedAvgAPI:
                 pad = steps - idx.shape[1]
                 idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
                 mask = np.pad(mask, [(0, 0), (0, pad)])
-            self.state, metrics, outs = self.round_fn(
+            self.state, metrics, new_c = self.round_fn(
                 self.state, jnp.asarray(idx), jnp.asarray(mask),
-                jnp.asarray(w), rngs, c_stacked)
+                jnp.asarray(w), key, c_stacked)
         else:
             x, y, mask, w = self.dataset.cohort_batches(
                 clients, self.batch_size, self.seed, round_idx, self.epochs)
@@ -122,10 +127,10 @@ class FedAvgAPI:
                 x = np.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
                 y = np.pad(y, [(0, 0), (0, pad)] + [(0, 0)] * (y.ndim - 2))
                 mask = np.pad(mask, [(0, 0), (0, pad)])
-            self.state, metrics, outs = self.round_fn(
+            self.state, metrics, new_c = self.round_fn(
                 self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-                jnp.asarray(w), rngs, c_stacked)
-        self._scatter_c(clients, outs.new_client_state)
+                jnp.asarray(w), key, c_stacked)
+        self._scatter_c(clients, new_c)
         return metrics
 
     def evaluate(self):

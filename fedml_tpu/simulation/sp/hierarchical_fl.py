@@ -20,6 +20,10 @@ from .fedavg_api import FedAvgAPI
 
 
 class HierarchicalFedAvgAPI(FedAvgAPI):
+    # group loop calls round_fn with states sharing buffers (state.replace
+    # per group); donation would invalidate the shared leaves mid-loop
+    DONATE_STATE = False
+
     def __init__(self, args, device, dataset, model, client_mode: str = "vmap"):
         super().__init__(args, device, dataset, model, client_mode)
         self.group_num = int(getattr(args, "group_num", 2))
@@ -50,7 +54,6 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
                 key = rng_util.round_key(
                     rng_util.root_key(self.seed),
                     (round_idx * self.group_comm_round + inner) * 131 + g)
-                rngs = jax.random.split(key, len(members))
                 state_g = self.state.replace(global_params=group_params[g])
                 inner_round = round_idx * self.group_comm_round + inner
                 if hasattr(self, "_dev_x"):
@@ -59,14 +62,14 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
                         self.epochs)
                     state_g, metrics, outs = self.round_fn(
                         state_g, jnp.asarray(idx), jnp.asarray(mask),
-                        jnp.asarray(w), rngs, None)
+                        jnp.asarray(w), key, None)
                 else:
                     x, y, mask, w = self.dataset.cohort_batches(
                         members, self.batch_size, self.seed, inner_round,
                         self.epochs)
                     state_g, metrics, outs = self.round_fn(
                         state_g, jnp.asarray(x), jnp.asarray(y),
-                        jnp.asarray(mask), jnp.asarray(w), rngs, None)
+                        jnp.asarray(mask), jnp.asarray(w), key, None)
                 group_params[g] = state_g.global_params
                 group_weights[g] = float(np.sum(w))
         live = group_weights > 0

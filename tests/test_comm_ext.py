@@ -100,3 +100,18 @@ def test_cross_silo_over_trpc_backend():
 
     result = _run_federation("TRPC", "t_trpc_fed")
     assert result["acc"] is not None and result["acc"] > 0.5
+
+
+def test_mnn_bundle_nested_tree_roundtrip(tmp_path):
+    """Nested flax-style params must survive the edge-bundle codec
+    structurally (float32 cast is the bundle contract)."""
+    params = {"params": {"Dense_0": {
+        "kernel": np.arange(12.0).reshape(3, 4).astype(np.float32),
+        "bias": np.zeros(4, np.float32)}}}
+    got = _exchange("MQTT_S3_MNN", "t_mnn_nested", params,
+                    store_dir=str(tmp_path), storage_backend="local")
+    out = got.get(MSG_ARG_KEY_MODEL_PARAMS)
+    np.testing.assert_allclose(out["params"]["Dense_0"]["kernel"],
+                               params["params"]["Dense_0"]["kernel"])
+    np.testing.assert_allclose(out["params"]["Dense_0"]["bias"],
+                               params["params"]["Dense_0"]["bias"])

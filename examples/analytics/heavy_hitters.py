@@ -1,0 +1,11 @@
+"""Federated analytics: DP heavy hitters via TrieHH (reference
+``fa/aggregator/heavy_hitter_triehh_aggregator.py``)."""
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.fa.runner import FARunner
+
+if __name__ == "__main__":
+    words = ["sun", "sun", "moon", "sun", "star", "moon", "sun", "sky"]
+    data = {c: [words[(c + i) % len(words)] for i in range(6)]
+            for c in range(20)}
+    args = load_arguments().update(fa_task="heavy_hitter_triehh", fa_round=3)
+    print("heavy hitters:", FARunner(args, data).run())

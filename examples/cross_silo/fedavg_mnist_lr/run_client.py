@@ -1,0 +1,19 @@
+"""Cross-silo client; pass rank 1..N as argv[1]."""
+import sys
+
+import fedml_tpu
+from fedml_tpu import data as data_mod, model as model_mod
+from fedml_tpu.cross_silo.client import Client
+
+if __name__ == "__main__":
+    rank = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    args = fedml_tpu.load_arguments()
+    args.update(training_type="cross_silo", backend="GRPC", rank=rank,
+                role="client", run_id="demo1", dataset="mnist", model="lr",
+                client_num_in_total=2, client_num_per_round=2, comm_round=10,
+                batch_size=16, learning_rate=0.05, client_id_list=[1, 2],
+                grpc_base_port=8890)
+    args = fedml_tpu.init(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    Client(args, None, dataset, model).run()

@@ -1,0 +1,17 @@
+"""Cross-silo server (reference ``mqtt_s3_fedavg_mnist_lr_example`` server
+side, broker replaced by plain-config backends: GRPC here)."""
+import fedml_tpu
+from fedml_tpu import data as data_mod, model as model_mod
+from fedml_tpu.cross_silo.server import Server
+
+if __name__ == "__main__":
+    args = fedml_tpu.load_arguments()
+    args.update(training_type="cross_silo", backend="GRPC", rank=0,
+                role="server", run_id="demo1", dataset="mnist", model="lr",
+                client_num_in_total=2, client_num_per_round=2, comm_round=10,
+                batch_size=16, learning_rate=0.05, client_id_list=[1, 2],
+                grpc_base_port=8890)
+    args = fedml_tpu.init(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    Server(args, None, dataset, model).run()

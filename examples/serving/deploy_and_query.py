@@ -1,0 +1,33 @@
+"""Deploy plane quick start: model card → replicas → gateway → query
+(reference `fedml model deploy` + inference gateway path)."""
+import json
+import urllib.request
+
+from fedml_tpu import api
+from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+
+
+class EchoPredictor(FedMLPredictor):
+    def predict(self, request):
+        return {"echo": request}
+
+
+def make_predictor():
+    return EchoPredictor()
+
+
+if __name__ == "__main__":
+    # pass the factory directly so the script works run from anywhere
+    # (an entry string like "mypkg.predictors:make_predictor" is the
+    # CLI/daemon path)
+    api.model_create("echo")
+    info = api.model_deploy("echo", num_replicas=2,
+                            predictor_factory=make_predictor)
+    print("deployed:", info)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{info['gateway_port']}/api/v1/predict/echo",
+        data=json.dumps({"hello": "tpu"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        print("reply:", json.loads(resp.read()))
+    api.model_undeploy("echo")

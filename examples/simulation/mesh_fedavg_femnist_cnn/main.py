@@ -1,0 +1,14 @@
+"""Mesh-backend simulation: sampled clients sharded over every local device
+(`client` mesh axis), FedAvg merge as one psum over ICI."""
+import fedml_tpu
+
+
+if __name__ == "__main__":
+    args = fedml_tpu.load_arguments()
+    args.update(
+        dataset="femnist", model="cnn", partition_method="hetero",
+        partition_alpha=0.5, client_num_in_total=100,
+        client_num_per_round=16, comm_round=50, epochs=1, batch_size=20,
+        learning_rate=0.03, frequency_of_the_test=5,
+    )
+    fedml_tpu.run_simulation(backend="mesh", args=args)

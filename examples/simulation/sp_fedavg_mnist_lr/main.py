@@ -1,0 +1,11 @@
+"""Quick start — the reference's ``sp_fedavg_mnist_lr_example`` one-liner."""
+import os
+
+import fedml_tpu
+
+
+if __name__ == "__main__":
+    args = fedml_tpu.load_arguments()
+    args.load_yaml_config(os.path.join(os.path.dirname(__file__),
+                                       "fedml_config.yaml"))
+    fedml_tpu.run_simulation(backend="sp", args=args)

@@ -157,15 +157,43 @@ def run_mnn_server(args=None, server_aggregator=None):
     return FedMLRunner(args, dev, dataset, model, None, server_aggregator).run()
 
 
+def run_model_serving_server(args, end_point_name, model_name,
+                             model_version="", dataset=None, model=None,
+                             server_aggregator=None):
+    """Federated serving server (reference ``fedml.run_model_serving_server``,
+    ``__init__.py:520-546`` exports)."""
+    from .serving import FedMLModelServingServer
+    return FedMLModelServingServer(
+        args, end_point_name, model_name, model_version, dataset=dataset,
+        model=model, server_aggregator=server_aggregator).run()
+
+
+def run_model_serving_client(args, end_point_name, model_name,
+                             model_version="", dataset=None, model=None,
+                             client_trainer=None):
+    """Federated serving client (reference ``fedml.run_model_serving_client``)."""
+    from .serving import FedMLModelServingClient
+    return FedMLModelServingClient(
+        args, end_point_name, model_name, model_version, dataset=dataset,
+        model=model, client_trainer=client_trainer).run()
+
+
 # module namespaces mirroring `fedml.data` / `fedml.model` / `fedml.device`
 from . import data  # noqa: E402
 from . import device  # noqa: E402
 from . import mlops  # noqa: E402
 from . import model  # noqa: E402
 
+# user metric APIs re-exported at top level (reference __init__.py:547-566)
+from .mlops import (log, log_artifact, log_endpoint, log_llm_record,  # noqa: E402
+                    log_metric, log_model)
+
 __all__ = [
     "init", "run_simulation", "run_cross_silo_server", "run_cross_silo_client",
     "run_hierarchical_cross_silo_server", "run_hierarchical_cross_silo_client",
-    "run_mnn_server", "Arguments", "add_args", "load_arguments",
+    "run_mnn_server", "run_model_serving_server", "run_model_serving_client",
+    "Arguments", "add_args", "load_arguments",
+    "log", "log_metric", "log_artifact", "log_model", "log_llm_record",
+    "log_endpoint",
     "constants", "data", "device", "model", "mlops", "__version__",
 ]

@@ -173,7 +173,117 @@ def build(source_dir: str, dest_dir: str = ".",
     return build_job_package(source_dir, dest_dir, job_name)
 
 
+# -- model cards (reference fedml.api model_* / FedMLModelCards) -----------
+def model_create(name: str, predictor_entry: str = "",
+                 config: Optional[dict] = None) -> dict:
+    from ..computing.scheduler.model_scheduler.device_model_cards import (
+        FedMLModelCards)
+    return FedMLModelCards.get_instance().create_model(
+        name, predictor_entry, config)
+
+
+def model_list() -> List[dict]:
+    from ..computing.scheduler.model_scheduler.device_model_cards import (
+        FedMLModelCards)
+    return FedMLModelCards.get_instance().list_models()
+
+
+def model_delete(name: str) -> bool:
+    from ..computing.scheduler.model_scheduler.device_model_cards import (
+        FedMLModelCards)
+    return FedMLModelCards.get_instance().delete_model(name)
+
+
+def model_package(name: str, dest: Optional[str] = None) -> str:
+    from ..computing.scheduler.model_scheduler.device_model_cards import (
+        FedMLModelCards)
+    return FedMLModelCards.get_instance().package_model(name, dest)
+
+
+def model_deploy(name: str, num_replicas: int = 1,
+                 predictor_factory=None) -> dict:
+    from ..computing.scheduler.model_scheduler.device_model_cards import (
+        FedMLModelCards)
+    return FedMLModelCards.get_instance().deploy(
+        name, num_replicas, predictor_factory)
+
+
+def model_undeploy(name: str) -> bool:
+    from ..computing.scheduler.model_scheduler.device_model_cards import (
+        FedMLModelCards)
+    return FedMLModelCards.get_instance().undeploy(name)
+
+
+# -- storage (reference fedml storage CLI / api.storage) --------------------
+def storage_upload(path: str, args=None) -> str:
+    """Put a file into the content-addressed store; returns the cid."""
+    from ..core.distributed.distributed_storage import create_store
+    store = create_store(args or _Args("storage"))
+    with open(path, "rb") as f:
+        return store.put(f.read())
+
+
+def storage_download(cid: str, dest: str, args=None) -> str:
+    from ..core.distributed.distributed_storage import create_store
+    store = create_store(args or _Args("storage"))
+    data = store.get(cid)  # fetch BEFORE opening: failed get must not truncate dest
+    with open(dest, "wb") as f:
+        f.write(data)
+    return dest
+
+
+# -- diagnosis (reference slave/client_diagnosis.py: connectivity probes) ---
+def diagnosis(check_backend: bool = True) -> Dict[str, Any]:
+    """Echo tests over the comm + storage planes plus accelerator probe —
+    the hermetic analog of ClientDiagnosis's MQTT/S3 probes."""
+    out: Dict[str, Any] = {}
+    # comm plane echo
+    try:
+        from ..core.distributed.communication.message import Message
+        run_id = f"diag_{next(_PLANE_IDS)}"
+        args = _Args(run_id)
+        try:
+            m0 = create_comm_backend(args, 0, 2, "local")
+            got = {}
+            class _Obs:
+                def receive_message(self, t, m):
+                    got["msg"] = t
+            m0.add_observer(_Obs())
+            msg = Message(42, 0, 0)
+            m0.send_message(msg)
+            m0._dispatch(m0._q.get(timeout=5))
+            out["comm_plane"] = got.get("msg") == 42
+        finally:
+            local_comm_manager.reset_run(run_id)
+    except Exception as e:
+        out["comm_plane"] = False
+        out["comm_error"] = str(e)
+    # storage plane roundtrip
+    try:
+        from ..core.distributed.distributed_storage import LocalCAStore
+        import tempfile
+        store = LocalCAStore(tempfile.mkdtemp(prefix="fedml_diag_"))
+        cid = store.put(b"ping")
+        out["storage_plane"] = store.get(cid) == b"ping"
+    except Exception as e:
+        out["storage_plane"] = False
+        out["storage_error"] = str(e)
+    # accelerator
+    if check_backend:
+        try:
+            import jax
+            devs = jax.devices()
+            out["accelerator"] = {"platform": devs[0].platform,
+                                  "count": len(devs)}
+        except Exception as e:
+            out["accelerator"] = {"error": str(e)}
+    return out
+
+
 __all__ = [
     "fedml_login", "fedml_logout", "launch_job", "run_stop", "run_status",
     "run_logs", "cluster_list", "device_info", "build", "shutdown",
+    "model_create", "model_list", "model_delete", "model_package",
+    "model_deploy", "model_undeploy", "storage_upload",
+    "storage_download", "diagnosis",
 ]

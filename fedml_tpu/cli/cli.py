@@ -170,6 +170,103 @@ def analyze(task, data_file, rounds):
                            else result}, default=str))
 
 
+@cli.group()
+def model():
+    """Model-card registry + deploy (reference `fedml model ...`)."""
+
+
+@model.command("create")
+@click.argument("name")
+@click.option("--entry", default="", help="predictor factory 'module:attr'")
+def model_create(name, entry):
+    from fedml_tpu import api
+    click.echo(json.dumps(api.model_create(name, entry)))
+
+
+@model.command("list")
+def model_list():
+    from fedml_tpu import api
+    click.echo(json.dumps(api.model_list(), indent=1))
+
+
+@model.command("delete")
+@click.argument("name")
+def model_delete(name):
+    from fedml_tpu import api
+    click.echo("deleted" if api.model_delete(name) else "not found")
+
+
+@model.command("package")
+@click.argument("name")
+@click.option("--dest", default=None)
+def model_package(name, dest):
+    from fedml_tpu import api
+    click.echo(api.model_package(name, dest))
+
+
+@model.command("deploy")
+@click.argument("name")
+@click.option("--replicas", "-r", default=1)
+@click.option("--detach", is_flag=True,
+              help="return immediately (endpoint dies with this process); "
+                   "default serves in the foreground until Ctrl-C")
+def model_deploy(name, replicas, detach):
+    from fedml_tpu import api
+    info = api.model_deploy(name, replicas)
+    click.echo(json.dumps(info))
+    if detach:
+        return
+    # the gateway/replicas are threads of THIS process — stay alive to serve
+    click.echo("serving; Ctrl-C to stop", err=True)
+    import threading
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        api.model_undeploy(name)
+        click.echo("stopped", err=True)
+
+
+@model.command("undeploy")
+@click.argument("name")
+def model_undeploy(name):
+    from fedml_tpu import api
+    click.echo("stopped" if api.model_undeploy(name) else "not deployed")
+
+
+@cli.group()
+def storage():
+    """Content-addressed artifact storage (reference `fedml storage`)."""
+
+
+@storage.command("upload")
+@click.argument("path", type=click.Path(exists=True))
+def storage_upload(path):
+    from fedml_tpu import api
+    click.echo(api.storage_upload(path))
+
+
+@storage.command("download")
+@click.argument("cid")
+@click.argument("dest")
+def storage_download(cid, dest):
+    from fedml_tpu import api
+    click.echo(api.storage_download(cid, dest))
+
+
+@cli.command()
+def diagnosis():
+    """Connectivity/self-test probes (reference `fedml diagnosis`)."""
+    from fedml_tpu import api
+    click.echo(json.dumps(api.diagnosis(), indent=1))
+
+
+@cli.command()
+def device():
+    """This device's runner inventory (reference `fedml device`)."""
+    from fedml_tpu import api
+    click.echo(json.dumps(api.device_info(), indent=2))
+
+
 def main():
     cli()
 

@@ -1,0 +1,161 @@
+"""Model cards — the local model registry behind ``fedml model ...``
+(reference ``computing/scheduler/model_scheduler/device_model_cards.py``:
+create/list/delete/package/deploy of named model cards).
+
+A card is a directory under ``~/.fedml_tpu/models/<name>/`` holding
+``card.json`` (metadata + the python entry ``module:attr`` that yields a
+``FedMLPredictor`` factory) and any packaged artifacts. Deploy resolves the
+entry and stands replicas up behind the inference gateway — the in-process
+analog of the reference's docker-per-replica path.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import shutil
+import time
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_HOME = os.path.join(os.path.expanduser("~"), ".fedml_tpu", "models")
+
+
+class FedMLModelCards:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLModelCards":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self, home: Optional[str] = None):
+        self.home = home or os.environ.get("FEDML_TPU_MODEL_HOME",
+                                           _DEFAULT_HOME)
+        os.makedirs(self.home, exist_ok=True)
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+
+    # -- registry ----------------------------------------------------------
+    def _card_dir(self, name: str) -> str:
+        safe = "".join(c for c in name if c.isalnum() or c in "-_.")
+        # require at least one non-dot char: "." / ".." would resolve to the
+        # model home itself / its parent and delete_model would rmtree them
+        if not safe or safe != name or not name.strip("."):
+            raise ValueError(f"invalid model card name {name!r}")
+        path = os.path.join(self.home, safe)
+        if os.path.dirname(os.path.normpath(path)) != \
+                os.path.normpath(self.home):
+            raise ValueError(f"invalid model card name {name!r}")
+        return path
+
+    def create_model(self, name: str, predictor_entry: str = "",
+                     config: Optional[dict] = None) -> dict:
+        """``predictor_entry``: "pkg.module:factory" resolving to a callable
+        returning a FedMLPredictor."""
+        d = self._card_dir(name)
+        os.makedirs(d, exist_ok=True)
+        card = {"name": name, "predictor_entry": predictor_entry,
+                "config": config or {}, "created_at": time.time(),
+                "version": 1}
+        existing = self.get_model(name)
+        if existing:
+            card["version"] = int(existing.get("version", 0)) + 1
+            card["created_at"] = existing["created_at"]
+        with open(os.path.join(d, "card.json"), "w") as f:
+            json.dump(card, f, indent=1)
+        return card
+
+    def get_model(self, name: str) -> Optional[dict]:
+        path = os.path.join(self._card_dir(name), "card.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+    def list_models(self) -> List[dict]:
+        out = []
+        for entry in sorted(os.listdir(self.home)):
+            path = os.path.join(self.home, entry, "card.json")
+            if os.path.exists(path):
+                with open(path) as f:
+                    out.append(json.load(f))
+        return out
+
+    def delete_model(self, name: str) -> bool:
+        d = self._card_dir(name)
+        if not os.path.isdir(d):
+            return False
+        self.undeploy(name)
+        shutil.rmtree(d)
+        return True
+
+    def add_model_files(self, name: str, src_path: str) -> str:
+        """Attach an artifact (weights file, bundle, …) to the card."""
+        d = self._card_dir(name)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"no model card {name!r}")
+        dst = os.path.join(d, os.path.basename(src_path))
+        shutil.copy2(src_path, dst)
+        return dst
+
+    def package_model(self, name: str, dest: Optional[str] = None) -> str:
+        """Zip the card directory (the reference's model package upload)."""
+        d = self._card_dir(name)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"no model card {name!r}")
+        dest = dest or os.path.join(self.home, f"{name}.zip")
+        with zipfile.ZipFile(dest, "w", zipfile.ZIP_DEFLATED) as z:
+            for root, _, files in os.walk(d):
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    z.write(full, os.path.relpath(full, d))
+        return dest
+
+    # -- deploy ------------------------------------------------------------
+    def _resolve_factory(self, card: dict):
+        entry = card.get("predictor_entry") or ""
+        if ":" not in entry:
+            raise ValueError(
+                f"model card {card['name']!r} has no predictor_entry "
+                "('module:attr') to deploy")
+        mod_name, attr = entry.split(":", 1)
+        mod = importlib.import_module(mod_name)
+        factory = getattr(mod, attr)
+        return factory
+
+    def deploy(self, name: str, num_replicas: int = 1,
+               predictor_factory=None) -> dict:
+        """Stand up replicas + gateway; returns endpoint info."""
+        from .device_model_inference import InferenceGateway
+        from .device_replica_controller import ReplicaController
+
+        card = self.get_model(name)
+        if card is None:
+            raise FileNotFoundError(f"no model card {name!r}")
+        if predictor_factory is None:
+            predictor_factory = self._resolve_factory(card)
+        # redeploy = replace: stop the old gateway/replicas first so they
+        # don't leak with no remaining handle
+        self.undeploy(name)
+        controller = ReplicaController(name, predictor_factory)
+        controller.reconcile(num_replicas)
+        gateway = InferenceGateway()
+        port = gateway.start()
+        info = {"endpoint": name, "gateway_port": port,
+                "replicas": controller.current_replicas}
+        self._deployments[name] = {"controller": controller,
+                                   "gateway": gateway, "info": info}
+        return info
+
+    def undeploy(self, name: str) -> bool:
+        dep = self._deployments.pop(name, None)
+        if dep is None:
+            return False
+        dep["gateway"].stop()
+        dep["controller"].stop_all()
+        return True
+
+    def list_deployments(self) -> List[dict]:
+        return [d["info"] for d in self._deployments.values()]

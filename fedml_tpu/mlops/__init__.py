@@ -17,7 +17,7 @@ import os
 import time
 from typing import Any, Dict, Optional
 
-log = logging.getLogger("fedml_tpu.mlops")
+_logger = logging.getLogger("fedml_tpu.mlops")
 
 _state: Dict[str, Any] = {"enabled": False, "run_id": "0", "sink": None,
                           "exporters": [], "open_events": {}}
@@ -50,7 +50,7 @@ def _emit(record: Dict[str, Any]):
         try:
             fn(record)
         except Exception:  # exporters must not break training
-            log.exception("mlops exporter failed")
+            _logger.exception("mlops exporter failed")
 
 
 def event(name: str, started: bool = True, round_idx: Optional[int] = None,
@@ -98,3 +98,17 @@ def log_model(name: str, path: str, **kw):
 
 def log_llm_record(record: Dict[str, Any], **kw):
     _emit({"type": "llm_record", "record": record})
+
+
+def log(metrics: Dict[str, Any], step: Optional[int] = None, commit=True):
+    """Reference ``fedml.log`` (``core/mlops/__init__.py:172`` family) —
+    wandb-style user metric logging."""
+    _emit({"type": "log", "step": step, "metrics": metrics})
+
+
+def log_endpoint(endpoint_name: str, metrics: Optional[Dict[str, Any]] = None,
+                 **kw):
+    """Reference ``fedml.log_endpoint`` (``core/mlops/__init__.py:191``) —
+    serving-endpoint metric stream."""
+    _emit({"type": "endpoint", "endpoint": endpoint_name,
+           "metrics": metrics or {}})

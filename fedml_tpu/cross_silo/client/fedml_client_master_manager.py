@@ -60,6 +60,7 @@ class ClientMasterManager(FedMLCommManager):
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, new_params)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_idx)
         self.send_message(msg)
 
     def handle_message_init(self, msg_params):

@@ -57,9 +57,16 @@ class FedMLAggregator:
     def check_whether_all_receive(self) -> bool:
         if not all(self.flag_client_model_uploaded_dict.values()):
             return False
+        self.reset_receive_flags()
+        return True
+
+    @property
+    def received_count(self) -> int:
+        return sum(self.flag_client_model_uploaded_dict.values())
+
+    def reset_receive_flags(self):
         for i in range(self.client_num):
             self.flag_client_model_uploaded_dict[i] = False
-        return True
 
     def aggregate(self):
         idxs = sorted(self.model_dict.keys())

@@ -6,6 +6,7 @@
 from __future__ import annotations
 
 import logging
+import threading
 
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
@@ -16,6 +17,14 @@ log = logging.getLogger(__name__)
 
 
 class FedMLServerManager(FedMLCommManager):
+    """Straggler tolerance (absent from the reference — SURVEY §5: a dead
+    client stalls ``check_whether_all_receive`` forever): when
+    ``aggregation_timeout_s`` > 0, a timer starts at each round's first
+    upload; on expiry the round aggregates the partial cohort if at least
+    ``min_clients_to_aggregate`` (default 1) results arrived. Uploads carry
+    their round index, so a straggler's late result for an already-closed
+    round is dropped instead of polluting the next one."""
+
     def __init__(self, args, aggregator, comm=None, rank=0, size=0,
                  backend="local"):
         super().__init__(args, comm, rank, size, backend)
@@ -26,6 +35,13 @@ class FedMLServerManager(FedMLCommManager):
         self.client_online_set = set()
         self.client_real_ids = list(range(1, size))
         self.client_finished_count = 0
+        self.agg_timeout = float(getattr(args, "aggregation_timeout_s", 0))
+        self.min_to_aggregate = max(1, int(getattr(
+            args, "min_clients_to_aggregate", 1)))
+        self._round_lock = threading.Lock()
+        self._timer = None
+        self._onboard_timer = None
+        self._started = False
 
     # -- handshake ---------------------------------------------------------
     def register_message_receive_handlers(self):
@@ -39,11 +55,39 @@ class FedMLServerManager(FedMLCommManager):
     def handle_message_client_status_update(self, msg_params):
         status = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS)
         sender = msg_params.get_sender_id()
-        if status == MyMessage.MSG_CLIENT_STATUS_ONLINE:
-            self.client_online_set.add(sender)
-            log.info("server: client %d online (%d/%d)", sender,
-                     len(self.client_online_set), self.client_num)
-        if len(self.client_online_set) == self.client_num:
+        with self._round_lock:
+            if status == MyMessage.MSG_CLIENT_STATUS_ONLINE:
+                self.client_online_set.add(sender)
+                log.info("server: client %d online (%d/%d)", sender,
+                         len(self.client_online_set), self.client_num)
+                if (self.agg_timeout > 0
+                        and len(self.client_online_set) < self.client_num):
+                    # straggler tolerance covers onboarding too: never-online
+                    # clients must not stall the federation forever. Re-armed
+                    # on every arrival, so it measures SILENCE — a slowly but
+                    # actively joining cohort is never cut off.
+                    self._cancel_onboard_timer()
+                    self._onboard_timer = threading.Timer(
+                        self.agg_timeout, self._on_onboarding_timeout)
+                    self._onboard_timer.daemon = True
+                    self._onboard_timer.start()
+            if len(self.client_online_set) == self.client_num:
+                self._cancel_onboard_timer()
+                self.send_init_msg()
+
+    def _cancel_onboard_timer(self):
+        if self._onboard_timer is not None:
+            self._onboard_timer.cancel()
+            self._onboard_timer = None
+
+    def _on_onboarding_timeout(self):
+        with self._round_lock:
+            self._onboard_timer = None
+            online = len(self.client_online_set)
+            if self._started or online < self.min_to_aggregate:
+                return
+            log.warning("server: onboarding timeout — starting with %d/%d "
+                        "clients online", online, self.client_num)
             self.send_init_msg()
 
     # -- round machinery ---------------------------------------------------
@@ -57,6 +101,9 @@ class FedMLServerManager(FedMLCommManager):
 
     def send_init_msg(self):
         """Reference send_init_msg:48 — S2C global model + assigned data idx."""
+        if self._started:
+            return
+        self._started = True
         client_idxs = self._sampled_client_idxs(0)
         global_params = self.aggregator.get_global_model_params()
         for rank, data_idx in zip(self.client_real_ids, client_idxs):
@@ -65,16 +112,62 @@ class FedMLServerManager(FedMLCommManager):
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(data_idx))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
             self.send_message(msg)
+        self._arm_round_timer()
         log_aggregation_status("RUNNING")
+
+    def _arm_round_timer(self):
+        """Caller holds _round_lock (or is in pre-concurrency startup). Armed
+        when a round OPENS, so a round with zero uploads still times out."""
+        if self.agg_timeout <= 0:
+            return
+        if self._timer is not None:
+            self._timer.cancel()
+        self._timer = threading.Timer(self.agg_timeout,
+                                      self._on_aggregation_timeout,
+                                      args=(self.args.round_idx,))
+        self._timer.daemon = True
+        self._timer.start()
 
     def handle_message_receive_model_from_client(self, msg_params):
         sender = msg_params.get_sender_id()
         params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         n = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        self.aggregator.add_local_trained_result(
-            self.client_real_ids.index(sender), params, n)
-        if not self.aggregator.check_whether_all_receive():
-            return
+        with self._round_lock:
+            msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+            if msg_round is not None and int(msg_round) != self.args.round_idx:
+                log.warning("server: dropping stale round-%s upload from "
+                            "client %d (now at round %d)", msg_round, sender,
+                            self.args.round_idx)
+                return
+            self.aggregator.add_local_trained_result(
+                self.client_real_ids.index(sender), params, n)
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._finish_round()
+
+    def _on_aggregation_timeout(self, armed_round: int):
+        with self._round_lock:
+            if armed_round != self.args.round_idx:
+                return  # stale callback: that round already closed
+            self._timer = None
+            received = self.aggregator.received_count
+            if received < self.min_to_aggregate:
+                log.warning("server: aggregation timeout with only %d/%d "
+                            "results; waiting another window", received,
+                            self.min_to_aggregate)
+                self._arm_round_timer()
+                return
+            log.warning("server: aggregation timeout — closing round %d "
+                        "with %d/%d clients", self.args.round_idx, received,
+                        self.client_num)
+            self.aggregator.reset_receive_flags()
+            self._finish_round()
+
+    def _finish_round(self):
+        """Caller holds _round_lock (handler thread or timeout thread)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
         round_idx = self.args.round_idx
         self.aggregator.aggregate()
         acc = self.aggregator.test_on_server_for_all_clients(round_idx)
@@ -92,6 +185,7 @@ class FedMLServerManager(FedMLCommManager):
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(data_idx))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.args.round_idx)
             self.send_message(msg)
+        self._arm_round_timer()
 
     def send_finish(self):
         for rank in self.client_real_ids:

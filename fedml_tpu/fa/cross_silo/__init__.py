@@ -1,0 +1,12 @@
+"""Cross-silo federated analytics (reference ``fa/cross_silo/`` —
+``fa_server_manager.py`` / ``fa_client_manager.py``: the FA pass run as a
+real federation over the comm plane instead of in-process).
+
+Same FSM skeleton as the training cross-silo managers; the payload is the
+analyzer submission (any msgpack-able value) instead of a model pytree.
+"""
+
+from .fa_managers import (FACrossSiloClient, FACrossSiloServer,
+                          FAMessage)
+
+__all__ = ["FACrossSiloClient", "FACrossSiloServer", "FAMessage"]

@@ -1,0 +1,118 @@
+"""FA cross-silo server/client FSMs (reference ``fa/cross_silo/``)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..runner import _TASKS
+
+log = logging.getLogger(__name__)
+
+
+class FAMessage:
+    MSG_TYPE_S2C_INIT = 101          # server → clients: init msg + round
+    MSG_TYPE_C2S_SUBMISSION = 102    # client → server: local submission
+    MSG_TYPE_S2C_FINISH = 103
+
+    ARG_INIT_MSG = "fa_init_msg"
+    ARG_ROUND = "fa_round_idx"
+    ARG_SUBMISSION = "fa_submission"
+    ARG_SAMPLE_NUM = "fa_sample_num"
+    ARG_RESULT = "fa_result"
+
+
+def _task_classes(args):
+    task = str(getattr(args, "fa_task", "avg")).lower()
+    if task not in _TASKS:
+        raise ValueError(f"unknown fa_task {task!r}; have {sorted(_TASKS)}")
+    return _TASKS[task]
+
+
+class FACrossSiloServer(FedMLCommManager):
+    """Rank 0: broadcast init, collect submissions, aggregate, loop."""
+
+    def __init__(self, args, comm=None, rank=0, size=0, backend="local"):
+        super().__init__(args, comm, rank, size, backend)
+        _, aggregator_cls = _task_classes(args)
+        self.aggregator = aggregator_cls(args)
+        self.rounds = int(getattr(args, "fa_round", 1))
+        self.round_idx = 0
+        self.client_num = size - 1
+        self._submissions: Dict[int, Any] = {}
+        self.result = None
+        self._online = set()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_CONNECTION_IS_READY, self._handle_ready)
+        self.register_message_receive_handler(
+            FAMessage.MSG_TYPE_C2S_SUBMISSION, self._handle_submission)
+
+    def _broadcast_round(self):
+        for rank in range(1, self.size):
+            msg = Message(FAMessage.MSG_TYPE_S2C_INIT, self.rank, rank)
+            msg.add_params(FAMessage.ARG_INIT_MSG,
+                           self.aggregator.get_init_msg())
+            msg.add_params(FAMessage.ARG_ROUND, self.round_idx)
+            self.send_message(msg)
+
+    def _handle_ready(self, msg_params):
+        sender = msg_params.get_sender_id() if hasattr(
+            msg_params, "get_sender_id") else None
+        # self-ready fires once per manager; first broadcast when all client
+        # channels exist (local backend: immediately)
+        if len(self._online) == 0:
+            self._online.add(sender)
+            self._broadcast_round()
+
+    def _handle_submission(self, msg_params):
+        sender = int(msg_params.get(Message.MSG_ARG_KEY_SENDER))
+        self._submissions[sender] = (
+            float(msg_params.get(FAMessage.ARG_SAMPLE_NUM, 1.0)),
+            msg_params.get(FAMessage.ARG_SUBMISSION))
+        if len(self._submissions) < self.client_num:
+            return
+        subs = [self._submissions[r] for r in sorted(self._submissions)]
+        self.result = self.aggregator.aggregate(subs)
+        self._submissions.clear()
+        self.round_idx += 1
+        if self.round_idx >= self.rounds:
+            for rank in range(1, self.size):
+                msg = Message(FAMessage.MSG_TYPE_S2C_FINISH, self.rank, rank)
+                msg.add_params(FAMessage.ARG_RESULT, None)
+                self.send_message(msg)
+            self.finish()
+        else:
+            self._broadcast_round()
+
+
+class FACrossSiloClient(FedMLCommManager):
+    def __init__(self, args, train_data, comm=None, rank=1, size=0,
+                 backend="local"):
+        super().__init__(args, comm, rank, size, backend)
+        analyzer_cls, _ = _task_classes(args)
+        self.analyzer = analyzer_cls(args)
+        self.analyzer.set_id(rank)
+        self.train_data = train_data
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            FAMessage.MSG_TYPE_S2C_INIT, self._handle_init)
+        self.register_message_receive_handler(
+            FAMessage.MSG_TYPE_S2C_FINISH, self._handle_finish)
+
+    def _handle_init(self, msg_params):
+        self.analyzer.set_init_msg(msg_params.get(FAMessage.ARG_INIT_MSG))
+        self.analyzer.local_analyze(self.train_data, self.args)
+        msg = Message(FAMessage.MSG_TYPE_C2S_SUBMISSION, self.rank, 0)
+        msg.add_params(FAMessage.ARG_SUBMISSION,
+                       self.analyzer.get_client_submission())
+        msg.add_params(FAMessage.ARG_SAMPLE_NUM, float(len(self.train_data)))
+        self.send_message(msg)
+
+    def _handle_finish(self, msg_params):
+        self.finish()

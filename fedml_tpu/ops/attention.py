@@ -145,7 +145,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
                                sm_scale: Optional[float] = None,
-                               block_q: int = 256, block_k: int = 256):
+                               block_q: int = 512, block_k: int = 512):
     """q, k, v: (B, H, S, D) → (B, H, S, D).  TPU-only."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu

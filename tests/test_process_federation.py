@@ -1,0 +1,56 @@
+"""REAL process-boundary federation: 1 server + 2 clients as separate OS
+processes rendezvousing over the filestore backend (the hermetic version of
+the reference's ``run_cross_silo.sh`` 3-process smoke test, and the
+integration-level complement of the in-thread tests)."""
+
+import textwrap
+
+
+def test_three_process_federation(tmp_path):
+    from fedml_tpu.cross_silo.client.client_launcher import CrossSiloLauncher
+
+    entry = tmp_path / "entry.py"
+    out_file = tmp_path / "final_acc.txt"
+    entry.write_text(textwrap.dedent(f"""
+        import os
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        import fedml_tpu
+        from fedml_tpu import data as data_mod, model as model_mod
+        from fedml_tpu.cross_silo.client.client_launcher import (
+            env_rank, env_role, env_run_id)
+
+        args = fedml_tpu.load_arguments()
+        args.update(
+            training_type="cross_silo", backend="filestore",
+            filestore_dir={str(tmp_path)!r}, rank=env_rank(),
+            role=env_role(), run_id=env_run_id(), dataset="synthetic",
+            num_classes=4, input_shape=(8, 8, 1), train_size=256,
+            test_size=64, model="lr", client_num_in_total=2,
+            client_num_per_round=2, comm_round=2, epochs=1, batch_size=16,
+            learning_rate=0.1, random_seed=3, client_id_list=[1, 2],
+            frequency_of_the_test=1,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        if env_role() == "server":
+            from fedml_tpu.cross_silo.server import Server
+            srv = Server(args, None, dataset, model)
+            srv.run()
+            acc = srv.aggregator.test_on_server_for_all_clients(1)
+            with open({str(out_file)!r}, "w") as f:
+                f.write(str(acc))
+        else:
+            from fedml_tpu.cross_silo.client import Client
+            Client(args, None, dataset, model).run()
+    """))
+
+    launcher = CrossSiloLauncher(str(entry), run_id="proc1",
+                                 client_ranks=[1, 2])
+    codes = launcher.run(timeout_s=300)
+    assert codes == [0, 0, 0]
+    assert out_file.exists()
+    acc = float(out_file.read_text())
+    assert acc > 0.4, acc

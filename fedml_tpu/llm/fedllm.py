@@ -79,6 +79,25 @@ class FedLLMAPI:
         self.global_lora = lora_init(rng_util.purpose_key(key, "lora"),
                                      variables["lora"])
         self.mesh = mesh
+        self._client_sharding = None
+        if mesh is not None:
+            # GSPMD mesh regime (the 512-client pod path): base params laid
+            # out by the TP/FSDP rules over ``model``, adapters + optimizer
+            # state replicated, the cohort axis of every round tensor sharded
+            # over ``client`` — XLA turns the weighted adapter merge into one
+            # psum over ICI.
+            from jax.sharding import NamedSharding
+            from ..core.mesh import client_sharded, replicated
+            from .model import param_sharding_rules
+
+            rules = param_sharding_rules(self.base_params, mesh)
+            self.base_params = jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)),
+                self.base_params, rules)
+            self.global_lora = jax.device_put(self.global_lora,
+                                              replicated(mesh))
+            self._client_sharding = client_sharded(mesh)
         self._round_fn = jax.jit(self._build_round_fn())
 
     # -- pure round --------------------------------------------------------
@@ -134,9 +153,24 @@ class FedLLMAPI:
         x, y, mask, w = self.dataset.cohort_batches(
             clients, self.batch_size, self.seed, round_idx, self.epochs,
             max_steps=self.max_steps)
+        if self._client_sharding is not None:
+            # host-pad then ONE sharded transfer — never stage the whole
+            # cohort on a single chip (the pattern mesh_simulator uses)
+            from ..core.mesh import CLIENT_AXIS, pad_to_multiple
+            n_shards = self.mesh.shape[CLIENT_AXIS]
+            pad_c = pad_to_multiple(len(clients), n_shards) - len(clients)
+            if pad_c:  # cohort must tile evenly over the client axis
+                padc = lambda a: np.pad(
+                    a, [(0, pad_c)] + [(0, 0)] * (a.ndim - 1))
+                x, y, mask, w = padc(x), padc(y), padc(mask), padc(w)
+            put = lambda a: jax.device_put(jnp.asarray(a),
+                                           self._client_sharding)
+            x, y, mask, w = put(x), put(y), put(mask), put(w)
+        else:
+            x, y = jnp.asarray(x), jnp.asarray(y)
+            mask, w = jnp.asarray(mask), jnp.asarray(w)
         self.global_lora, loss = self._round_fn(
-            self.base_params, self.global_lora, jnp.asarray(x),
-            jnp.asarray(y), jnp.asarray(mask), jnp.asarray(w))
+            self.base_params, self.global_lora, x, y, mask, w)
         return {"train_loss": float(loss)}
 
     def evaluate(self):

@@ -68,9 +68,15 @@ class FedMLAggregator:
         for i in range(self.client_num):
             self.flag_client_model_uploaded_dict[i] = False
 
+    #: user-supplied alg-frame ServerAggregator (hook pipeline); set by the
+    #: Server facade when the caller passes server_aggregator=...
+    user_aggregator = None
+
     def aggregate(self):
         idxs = sorted(self.model_dict.keys())
         raw_list = [(self.sample_num_dict[i], self.model_dict[i]) for i in idxs]
+        if self.user_aggregator is not None:
+            return self._aggregate_via_user_hooks(idxs, raw_list)
         defender = FedMLDefender.get_instance()
         dp = FedMLDifferentialPrivacy.get_instance()
         if defender.is_defense_enabled():
@@ -95,6 +101,38 @@ class FedMLAggregator:
         if dp.is_global_dp_enabled():
             new_params = dp.add_global_noise(new_params)
         self.state = self.state.replace(global_params=new_params)
+        self.model_dict.clear()
+        return new_params
+
+    def _aggregate_via_user_hooks(self, idxs, raw_list):
+        """Reference server flow when a user ServerAggregator is given:
+        ``on_before_aggregation`` → ``aggregate`` → ``on_after_aggregation``
+        → ``assess_contribution`` (``core/alg_frame/server_aggregator.py``)."""
+        ua = self.user_aggregator
+        ua.set_model_params(self.state.global_params)
+        n_before = len(raw_list)
+        raw_list, _ = ua.on_before_aggregation(raw_list)
+        new_params = ua.aggregate(raw_list)
+        new_params = ua.on_after_aggregation(new_params)
+        self.state = self.state.replace(
+            round_idx=self.state.round_idx + 1, global_params=new_params)
+        assessor_on = (getattr(ua, "contribution_assessor_mgr", None)
+                       is not None
+                       and ua.contribution_assessor_mgr.get_assessor()
+                       is not None)
+        if assessor_on and self.dataset is not None:
+            if len(raw_list) != n_before:
+                # a filtering defense changed the list; positional mapping to
+                # client ids is gone — crediting would be wrong
+                log.warning("skipping contribution assessment: defense "
+                            "filtered the cohort (%d -> %d)", n_before,
+                            len(raw_list))
+            else:
+                xb, yb, mb = self.dataset.test_batches()
+                val_fn = lambda params: float(self.trainer.evaluate(
+                    params, xb, yb, mb)[1])
+                ua.assess_contribution(idxs, [p for _, p in raw_list],
+                                       new_params, val_fn)
         self.model_dict.clear()
         return new_params
 

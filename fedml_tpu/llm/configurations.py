@@ -1,0 +1,155 @@
+"""LLM training configuration dataclasses (reference
+``train/llm/configurations.py:32,156,394`` — ``ExperimentArguments`` /
+``ModelArguments`` / ``DatasetArguments``, the typed config surface the HF
+path exposes).
+
+Typed views over the flat ``Arguments`` namespace: ``from_args`` pulls the
+fields it knows, ``apply_to`` writes them back, so YAML-config and
+dataclass-config users drive the same FedLLM/Trainer machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class ModelArguments:
+    """Reference ``configurations.py:156`` ModelArguments."""
+    model_name_or_path: str = "tiny_llama"
+    lora_rank: int = 8                # reference: lora_r (peft_utils.py)
+    lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
+    #: fused-attention selection, kept verbatim (auto | blockwise | flash |
+    #: ring — model.py:44); the reference's boolean use_flash_attention is
+    #: derived from it
+    attn_impl: str = "auto"
+    dim: Optional[int] = None
+    n_layers: Optional[int] = None
+    n_heads: Optional[int] = None
+    n_kv_heads: Optional[int] = None
+    ffn_dim: Optional[int] = None
+
+    @property
+    def use_flash_attention(self) -> bool:
+        return self.attn_impl in ("auto", "flash")
+
+    @classmethod
+    def from_args(cls, args) -> "ModelArguments":
+        return cls(
+            model_name_or_path=str(getattr(args, "model", "tiny_llama")),
+            lora_rank=int(getattr(args, "lora_rank", 8)),
+            lora_alpha=float(getattr(args, "lora_alpha", 16.0)),
+            lora_dropout=float(getattr(args, "lora_dropout", 0.0)),
+            attn_impl=str(getattr(args, "attn_impl", None) or "auto"),
+            dim=getattr(args, "llm_dim", None),
+            n_layers=getattr(args, "llm_n_layers", None),
+            n_heads=getattr(args, "llm_n_heads", None),
+            n_kv_heads=getattr(args, "llm_n_kv_heads", None),
+            ffn_dim=getattr(args, "llm_ffn_dim", None),
+        )
+
+    def apply_to(self, args):
+        args.update(model=self.model_name_or_path, lora_rank=self.lora_rank,
+                    lora_alpha=self.lora_alpha, lora_dropout=self.lora_dropout,
+                    attn_impl=self.attn_impl)
+        for f in ("dim", "n_layers", "n_heads", "n_kv_heads", "ffn_dim"):
+            v = getattr(self, f)
+            if v is not None:
+                args.update(**{f"llm_{f}": int(v)})
+        return args
+
+
+@dataclasses.dataclass
+class DatasetArguments:
+    """Reference ``configurations.py:394`` DatasetArguments."""
+    dataset_name: str = "shakespeare"
+    truncation_max_length: int = 512   # reference :598
+    test_dataset_ratio: float = 0.1
+    seed: int = 0
+
+    @classmethod
+    def from_args(cls, args) -> "DatasetArguments":
+        return cls(
+            dataset_name=str(getattr(args, "dataset", "shakespeare")),
+            truncation_max_length=int(getattr(args, "seq_len", 512)),
+            test_dataset_ratio=float(getattr(args, "test_dataset_ratio",
+                                             0.1)),
+            seed=int(getattr(args, "random_seed", 0)),
+        )
+
+    def apply_to(self, args):
+        args.update(dataset=self.dataset_name,
+                    seq_len=self.truncation_max_length,
+                    test_dataset_ratio=self.test_dataset_ratio,
+                    random_seed=self.seed)
+        return args
+
+
+@dataclasses.dataclass
+class ExperimentArguments:
+    """Reference ``configurations.py:32`` ExperimentArguments (the HF
+    TrainingArguments extension): federation + optimization knobs."""
+    output_dir: str = "./outputs"
+    learning_rate: float = 1e-3
+    per_device_train_batch_size: int = 4
+    num_train_epochs: int = 1
+    max_local_steps: int = 4
+    comm_round: int = 10
+    client_num_in_total: int = 16
+    client_num_per_round: int = 4
+    save_steps: int = 10               # checkpoint frequency (rounds)
+    resume_from_checkpoint: Optional[str] = None
+    seed: int = 0
+
+    @classmethod
+    def from_args(cls, args) -> "ExperimentArguments":
+        return cls(
+            output_dir=str(getattr(args, "output_dir", "./outputs")),
+            learning_rate=float(getattr(args, "learning_rate", 1e-3)),
+            per_device_train_batch_size=int(getattr(args, "batch_size", 4)),
+            num_train_epochs=int(getattr(args, "epochs", 1)),
+            max_local_steps=int(getattr(args, "llm_max_local_steps", 4)),
+            comm_round=int(getattr(args, "comm_round", 10)),
+            client_num_in_total=int(getattr(args, "client_num_in_total", 16)),
+            client_num_per_round=int(getattr(args, "client_num_per_round", 4)),
+            save_steps=int(getattr(args, "checkpoint_freq", 10)),
+            resume_from_checkpoint=getattr(args, "checkpoint_dir", None),
+            seed=int(getattr(args, "random_seed", 0)),
+        )
+
+    def apply_to(self, args):
+        args.update(
+            output_dir=self.output_dir, learning_rate=self.learning_rate,
+            batch_size=self.per_device_train_batch_size,
+            epochs=self.num_train_epochs,
+            llm_max_local_steps=self.max_local_steps,
+            comm_round=self.comm_round,
+            client_num_in_total=self.client_num_in_total,
+            client_num_per_round=self.client_num_per_round,
+            checkpoint_freq=self.save_steps, random_seed=self.seed)
+        if self.resume_from_checkpoint:
+            args.update(checkpoint_dir=self.resume_from_checkpoint)
+        return args
+
+
+def build_fedllm(args=None,
+                 model_args: Optional[ModelArguments] = None,
+                 dataset_args: Optional[DatasetArguments] = None,
+                 experiment_args: Optional[ExperimentArguments] = None,
+                 mesh=None):
+    """Dataclass-first entry: compose the three configs onto args and build
+    a ready FedLLMAPI (reference pattern: HF dataclass parser → trainer)."""
+    import fedml_tpu
+    from .. import data as data_mod
+    from .fedllm import FedLLMAPI
+
+    if args is None:
+        args = fedml_tpu.load_arguments()
+    for cfg in (model_args, dataset_args, experiment_args):
+        if cfg is not None:
+            cfg.apply_to(args)
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dataset, _ = data_mod.load(args)
+    return FedLLMAPI(args, dataset, mesh=mesh)

@@ -26,7 +26,8 @@ import optax
 from ..core import rng as rng_util
 from ..core import tree as tree_util
 from ..data.federated_dataset import FederatedDataset
-from .model import LlamaLM, config_from_args
+from .model import (LlamaLM, causal_nll, config_from_args,
+                    per_sequence_loglik)
 
 log = logging.getLogger(__name__)
 
@@ -107,9 +108,7 @@ class FedLLMAPI:
 
         def loss_fn(lora, base, x, y):
             logits = model.apply({"params": base, "lora": lora}, x)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            ll = jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
-            return -jnp.mean(ll)
+            return causal_nll(logits, y)
 
         def local_train(lora0, base, xb, yb, mask):
             opt0 = tx.init(lora0)
@@ -181,9 +180,7 @@ class FedLLMAPI:
             def body(carry, inp):
                 x, y, m = inp
                 logits = self.model.apply({"params": base, "lora": lora}, x)
-                logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-                ll = jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
-                mseq = jnp.mean(ll, axis=-1)
+                mseq = per_sequence_loglik(logits, y)
                 return (carry[0] - jnp.sum(mseq * m), carry[1] + jnp.sum(m)), None
             (nll, n), _ = jax.lax.scan(body, (0.0, 0.0), (xb, yb, mb))
             return nll / n

@@ -221,6 +221,22 @@ def build_causal_lm(args, vocab: Optional[int] = None) -> FlaxModel:
     return FlaxModel(LlamaLM(cfg), (seq,), input_dtype=jnp.int32, task="lm")
 
 
+def causal_nll(logits, targets):
+    """Mean token NLL — THE loss both the federated (fedllm.py) and
+    centralized (trainer.py) paths share; fp32 softmax regardless of compute
+    dtype."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def per_sequence_loglik(logits, targets):
+    """Mean per-sequence token log-likelihood (for masked eval sums)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(ll, axis=-1)
+
+
 def param_sharding_rules(params, mesh) -> Any:
     """PartitionSpec per parameter: embeddings/FFN tensor-sharded on
     ``model``; 2-D kernels FSDP-sharded on their largest divisible dim;

@@ -1,0 +1,188 @@
+"""Centralized causal-LM trainer (reference ``train/llm/hf_trainer.py:28``
+``HFTrainer`` — the non-federated fine-tune path with checkpoint copy logic
+``save_checkpoint:95`` and ``resume_from_checkpoint``).
+
+TPU-native: one jitted step scanned over the epoch, orbax round
+checkpointing, optional LoRA-only optimization (train the adapters, freeze
+the base — the PEFT path).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core import rng as rng_util
+from .model import (LlamaLM, causal_nll, config_from_args,
+                    per_sequence_loglik)
+
+log = logging.getLogger(__name__)
+
+
+class CausalLMTrainer:
+    def __init__(self, args, dataset, mesh=None):
+        self.args = args
+        self.dataset = dataset
+        self.seed = int(getattr(args, "random_seed", 0))
+        self.batch_size = int(getattr(args, "batch_size", 4))
+        self.epochs = int(getattr(args, "epochs", 1))
+        self.lora_only = int(getattr(args, "lora_rank", 0)) > 0
+        lr = float(getattr(args, "learning_rate", 1e-3))
+
+        cfg = config_from_args(args, dataset.num_classes)
+        if self.lora_only and cfg.lora_rank == 0:
+            import dataclasses
+            cfg = dataclasses.replace(
+                cfg, lora_rank=int(getattr(args, "lora_rank", 8)),
+                lora_alpha=float(getattr(args, "lora_alpha", 16.0)))
+        self.model = LlamaLM(cfg)
+        key = rng_util.root_key(self.seed)
+        seq = dataset.train_x.shape[1]
+        dummy = jnp.zeros((1, seq), jnp.int32)
+        variables = self.model.init(rng_util.purpose_key(key, "init"), dummy)
+        self.base_params = variables["params"]
+        self.lora = variables.get("lora")
+        if self.lora is not None:
+            from .fedllm import lora_init
+            self.lora = lora_init(rng_util.purpose_key(key, "lora"),
+                                  self.lora)
+        self.lora_only = self.lora_only and self.lora is not None
+        self.tx = optax.adamw(lr, weight_decay=float(
+            getattr(args, "weight_decay", 0.0)))
+        train_tree = self.lora if self.lora_only and self.lora is not None \
+            else self.base_params
+        self.opt_state = self.tx.init(train_tree)
+        self._step = jax.jit(self._build_step())
+        self._eval_fn = jax.jit(self._build_eval())
+        self.global_step = 0
+
+    def _build_step(self):
+        model, tx = self.model, self.tx
+        lora_only = self.lora_only
+
+        def loss_fn(train_tree, frozen, x, y):
+            if lora_only:
+                variables = {"params": frozen, "lora": train_tree}
+            else:
+                variables = ({"params": train_tree, "lora": frozen}
+                             if frozen is not None
+                             else {"params": train_tree})
+            logits = model.apply(variables, x)
+            return causal_nll(logits, y)
+
+        def step(train_tree, frozen, opt, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(train_tree, frozen,
+                                                      x, y)
+            updates, opt = tx.update(grads, opt, train_tree)
+            return optax.apply_updates(train_tree, updates), opt, loss
+
+        return step
+
+    def _trees(self):
+        if self.lora_only and self.lora is not None:
+            return self.lora, self.base_params
+        return self.base_params, self.lora
+
+    def _set_train_tree(self, tree):
+        if self.lora_only and self.lora is not None:
+            self.lora = tree
+        else:
+            self.base_params = tree
+
+    def train(self) -> Dict[str, Any]:
+        n = len(self.dataset.train_x)
+        steps = n // self.batch_size
+        history = []
+        for epoch in range(self.epochs):
+            rng = np.random.default_rng(self.seed * 1031 + epoch)
+            order = rng.permutation(n)[: steps * self.batch_size]
+            xb = self.dataset.train_x[order].reshape(
+                steps, self.batch_size, -1)
+            yb = self.dataset.train_y[order].reshape(
+                steps, self.batch_size, -1)
+            t0 = time.time()
+            losses = []
+            train_tree, frozen = self._trees()
+            for s in range(steps):
+                train_tree, self.opt_state, loss = self._step(
+                    train_tree, frozen, self.opt_state,
+                    jnp.asarray(xb[s]), jnp.asarray(yb[s]))
+                losses.append(loss)
+                self.global_step += 1
+            self._set_train_tree(train_tree)
+            mean_loss = float(jnp.mean(jnp.stack(losses)))
+            log.info("epoch %d: loss=%.4f (%.1fs)", epoch, mean_loss,
+                     time.time() - t0)
+            history.append({"epoch": epoch, "loss": mean_loss})
+            self.save_checkpoint()
+        return {"history": history}
+
+    def _build_eval(self):
+        model, lora_only = self.model, self.lora_only
+
+        def eval_fn(train_tree, frozen, xb, yb, mb):
+            def body(carry, inp):
+                x, y, m = inp
+                if lora_only:
+                    variables = {"params": frozen, "lora": train_tree}
+                else:
+                    variables = ({"params": train_tree, "lora": frozen}
+                                 if frozen is not None
+                                 else {"params": train_tree})
+                logits = model.apply(variables, x)
+                mseq = per_sequence_loglik(logits, y)
+                return (carry[0] - jnp.sum(mseq * m),
+                        carry[1] + jnp.sum(m)), None
+            (nll, cnt), _ = jax.lax.scan(body, (0.0, 0.0), (xb, yb, mb))
+            return nll / cnt
+
+        return eval_fn
+
+    def evaluate(self) -> float:
+        xb, yb, mb = self.dataset.test_batches(batch_size=self.batch_size)
+        train_tree, frozen = self._trees()
+        return float(self._eval_fn(train_tree, frozen, jnp.asarray(xb),
+                                   jnp.asarray(yb), jnp.asarray(mb)))
+
+    # -- checkpointing (reference save_checkpoint:95) ----------------------
+    def _checkpointer(self):
+        out = getattr(self.args, "output_dir", None) or \
+            getattr(self.args, "checkpoint_dir", None)
+        if not out:
+            return None
+        if not hasattr(self, "_ckpt"):
+            from ..core.checkpoint import RoundCheckpointer
+            self._ckpt = RoundCheckpointer(str(out))
+        return self._ckpt
+
+    def save_checkpoint(self):
+        ckpt = self._checkpointer()
+        if ckpt is None:
+            return
+        train_tree, _ = self._trees()
+        ckpt.save(self.global_step, (train_tree, self.opt_state), None)
+
+    def resume_from_checkpoint(self) -> bool:
+        ckpt = self._checkpointer()
+        if ckpt is None or ckpt.latest_round() is None:
+            return False
+        train_tree, _ = self._trees()
+        (tree, opt), _ = ckpt.restore(
+            template=((train_tree, self.opt_state), None))
+        self._set_train_tree(tree)
+        self.opt_state = opt
+        self.global_step = int(ckpt.latest_round())
+        log.info("resumed at step %d", self.global_step)
+        return True
+
+    def close(self):
+        """Release the orbax checkpoint manager's background resources."""
+        if hasattr(self, "_ckpt"):
+            self._ckpt.close()
+            del self._ckpt

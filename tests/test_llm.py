@@ -180,3 +180,55 @@ def test_fedllm_mesh_nondivisible_cohort():
     api = FedLLMAPI(args, dataset, mesh=mesh)
     out = api.train_one_round(0)
     assert np.isfinite(out["train_loss"])
+
+
+def test_llm_configuration_dataclasses_roundtrip():
+    from fedml_tpu.llm.configurations import (DatasetArguments,
+                                              ExperimentArguments,
+                                              ModelArguments)
+
+    args = _llm_args()
+    ma = ModelArguments.from_args(args)
+    assert ma.model_name_or_path == "tiny_llama" and ma.lora_rank == 4
+    da = DatasetArguments.from_args(args)
+    assert da.truncation_max_length == 32
+    ea = ExperimentArguments.from_args(args)
+    assert ea.client_num_per_round == 3
+
+    fresh = load_arguments()
+    ma.apply_to(fresh); da.apply_to(fresh); ea.apply_to(fresh)
+    assert fresh.model == "tiny_llama"
+    assert fresh.seq_len == 32
+    assert fresh.lora_rank == 4
+    assert fresh.client_num_per_round == 3
+
+
+def test_causal_lm_trainer_centralized(tmp_path):
+    """Reference hf_trainer.py path: centralized fine-tune + checkpoint +
+    resume; LoRA-only mode freezes the base weights."""
+    from fedml_tpu.llm.trainer import CausalLMTrainer
+
+    args = _llm_args(epochs=2, batch_size=4,
+                     output_dir=str(tmp_path / "out"))
+    dataset = _small_llm_dataset(args)
+    trainer = CausalLMTrainer(args, dataset)
+    base_before = np.asarray(
+        jax.tree_util.tree_leaves(trainer.base_params)[0]).copy()
+    nll0 = trainer.evaluate()
+    out = trainer.train()
+    nll1 = trainer.evaluate()
+    assert nll1 < nll0, (nll0, nll1)
+    assert len(out["history"]) == 2
+    # LoRA-only: base unchanged
+    np.testing.assert_array_equal(
+        base_before, np.asarray(jax.tree_util.tree_leaves(
+            trainer.base_params)[0]))
+
+    # resume restores step count and state
+    trainer.close()
+    trainer2 = CausalLMTrainer(args, dataset)
+    assert trainer2.resume_from_checkpoint()
+    assert trainer2.global_step == trainer.global_step
+    nll2 = trainer2.evaluate()
+    np.testing.assert_allclose(nll2, nll1, rtol=1e-5)
+    trainer2.close()

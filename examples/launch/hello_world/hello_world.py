@@ -1,0 +1,14 @@
+"""Workspace entry for the hello job — any script; here a one-round sanity
+simulation on whatever accelerator the worker exposes."""
+import jax
+
+import fedml_tpu
+
+print("devices:", jax.devices())
+args = fedml_tpu.load_arguments()
+args.update(dataset="synthetic", num_classes=4, input_shape=(8, 8, 1),
+            train_size=256, test_size=64, model="lr", client_num_in_total=4,
+            client_num_per_round=2, comm_round=2, batch_size=16,
+            frequency_of_the_test=1)
+fedml_tpu.run_simulation(backend="sp", args=args)
+print("hello_world job done")

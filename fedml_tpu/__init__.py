@@ -22,6 +22,24 @@ import numpy as np
 
 __version__ = "0.1.0"
 
+# Platform override that actually works on images whose TPU PJRT plugin
+# re-forces jax_platforms at import time (JAX_PLATFORMS env alone doesn't
+# stick there): FEDML_TPU_PLATFORM=cpu [FEDML_TPU_NUM_CPU_DEVICES=8] must be
+# applied through jax.config BEFORE any backend initialization.
+_plat = os.environ.get("FEDML_TPU_PLATFORM")
+if _plat:
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _plat)
+        _n = os.environ.get("FEDML_TPU_NUM_CPU_DEVICES")
+        if _n:
+            _jax.config.update("jax_num_cpu_devices", int(_n))
+    except Exception:  # backend already initialized: leave it alone
+        logging.getLogger(__name__).warning(
+            "FEDML_TPU_PLATFORM=%s ignored (jax backend already "
+            "initialized)", _plat)
+
 from . import constants  # noqa: E402
 from .arguments import Arguments, add_args, load_arguments  # noqa: E402
 from .constants import (  # noqa: E402

@@ -109,6 +109,13 @@ class FedMLClientAgent:
             log_path = os.path.join(ws, "run.log")
             full_env = dict(os.environ)
             full_env.update(env)
+            # job processes must resolve the same imports as the agent
+            # (agents often run from an uninstalled source tree)
+            import sys as _sys
+            full_env["PYTHONPATH"] = os.pathsep.join(
+                [p or os.getcwd() for p in _sys.path]
+                + [p for p in full_env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
             full_env["FEDML_RUN_ID"] = run_id
             full_env["FEDML_DEVICE_ID"] = str(self.device_id)
             if self._run_aborted(run_id):

@@ -43,7 +43,7 @@ class CrossSiloLauncher:
         # merged AFTER extra_env so a caller-supplied PYTHONPATH adds to,
         # not replaces, the sys.path injection
         env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in sys.path if p]
+            [p or os.getcwd() for p in sys.path]
             + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
         env["FEDML_TPU_RANK"] = str(rank)
         env["FEDML_TPU_ROLE"] = role

@@ -121,12 +121,17 @@ def fedml_logout() -> None:
 
 # -- launch ----------------------------------------------------------------
 def launch_job(job_yaml_path: str, num_workers: int = 1,
-               wait: bool = True, timeout_s: float = 600.0) -> LaunchedRun:
+               wait: bool = True, timeout_s: float = 600.0,
+               env: Optional[Dict[str, str]] = None) -> LaunchedRun:
     """Reference ``api.launch_job``: parse → package → match → dispatch.
     With ``wait``, a run still unfinished after ``timeout_s`` is stopped so
-    no job process outlives the plane unsupervised."""
+    no job process outlives the plane unsupervised.  ``env`` entries are
+    merged over the job YAML's ``environment`` section and land in the
+    spawned job process's environment."""
     plane = _ensure_plane(min_agents=num_workers)
     job = FedMLJobConfig.load(job_yaml_path)
+    if env:
+        job.env = {**dict(job.env), **dict(env)}
     run = plane["manager"].launch_job(job, num_workers=num_workers)
     if wait and not run.done.wait(timeout=timeout_s):
         plane["manager"].stop_run(run.run_id)

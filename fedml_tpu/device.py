@@ -6,17 +6,99 @@ The reference maps processes→GPUs from YAML ``gpu_util`` specs
 enumerates chips and the mesh (core/mesh.py) assigns work, so ``get_device``
 just returns the default device (or CPU when ``using_gpu``-equivalent
 ``using_tpu`` is false) and the mapping YAMLs become mesh-shape args
-(``mesh_client/mesh_data/mesh_model/mesh_seq``)."""
+(``mesh_client/mesh_data/mesh_model/mesh_seq``).
+
+Backend init is hardened here (not in each caller): TPU PJRT plugins can
+fail transiently with UNAVAILABLE at process start (observed with the
+tunnel-attached plugin in this image).  ``initialize_backend`` retries with
+backoff, honors ``FEDML_TPU_PLATFORM`` (applied via jax.config in
+``fedml_tpu/__init__`` before any backend init), and as a last resort drops
+to the CPU backend so batch jobs (bench.py, tests) degrade instead of die.
+"""
 
 from __future__ import annotations
 
+import logging
+import time
+
 import jax
+import jax.extend.backend  # for clear_backends (not exported via bare jax)
+
+log = logging.getLogger(__name__)
+
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "failed to connect",
+)
+
+# Populated by initialize_backend for callers (bench.py) that report which
+# platform actually served the run and why.
+BACKEND_NOTE: str = ""
+
+
+def _is_transient(err: BaseException) -> bool:
+    msg = str(err)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
+    """Return ``jax.devices()``, retrying transient plugin failures and
+    falling back to the CPU backend when the accelerator never comes up.
+
+    Remediation knobs (also logged on failure):
+      - ``FEDML_TPU_PLATFORM=cpu`` forces the CPU backend up front;
+      - ``FEDML_TPU_NUM_CPU_DEVICES=8`` sizes a virtual CPU mesh;
+      - ``JAX_PLATFORMS=''`` lets jax auto-pick (may not stick on images
+        whose PJRT plugin re-forces the platform at import time).
+    """
+    global BACKEND_NOTE
+    last: BaseException | None = None
+    for attempt in range(1, retries + 1):
+        try:
+            devices = jax.devices()
+            if attempt > 1:
+                BACKEND_NOTE = f"backend up after {attempt} attempts"
+            return devices
+        except RuntimeError as e:  # jax wraps plugin init errors in RuntimeError
+            last = e
+            if not _is_transient(e):
+                raise
+            log.warning(
+                "jax backend init failed (attempt %d/%d): %s",
+                attempt, retries, str(e).splitlines()[-1] if str(e) else e)
+            try:  # drop any half-initialized backend before retrying
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            if attempt < retries:
+                time.sleep(backoff_s * attempt)
+    # Accelerator never came up: degrade to CPU so the workload still runs.
+    log.error(
+        "accelerator backend unavailable after %d attempts; falling back to "
+        "CPU. Set FEDML_TPU_PLATFORM=cpu to skip the accelerator probe, or "
+        "retry once the TPU plugin/tunnel is healthy. Last error: %s",
+        retries, last)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        devices = jax.devices("cpu")
+        BACKEND_NOTE = f"cpu fallback (accelerator init failed: {str(last).splitlines()[-1] if last else last})"
+        return devices
+    except Exception as e:
+        raise RuntimeError(
+            "no jax backend available (accelerator init failed and CPU "
+            "fallback also failed). Set FEDML_TPU_PLATFORM=cpu before "
+            f"importing fedml_tpu. Accelerator error: {last}") from e
 
 
 def get_device(args=None):
     prefer_host = args is not None and not bool(
         getattr(args, "using_tpu", getattr(args, "using_gpu", True)))
-    devices = jax.devices()
+    devices = initialize_backend()
     if prefer_host:
         try:
             return jax.devices("cpu")[0]
@@ -26,4 +108,4 @@ def get_device(args=None):
 
 
 def device_count() -> int:
-    return jax.device_count()
+    return len(initialize_backend())

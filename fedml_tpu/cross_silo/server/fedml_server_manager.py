@@ -99,7 +99,20 @@ class FedMLServerManager(FedMLCommManager):
         with self._round_lock:
             self._onboard_timer = None
             online = len(self.client_online_set)
-            if self._started or online < self.min_to_aggregate:
+            if self._started:
+                return
+            if online < self.min_to_aggregate:
+                # not enough to start — re-arm so the configured timeout
+                # keeps producing progress or visible warnings instead of
+                # a silent permanent stall
+                log.warning("server: onboarding timeout with only %d/%d "
+                            "clients online (need %d); waiting another "
+                            "window", online, self.client_num,
+                            self.min_to_aggregate)
+                self._onboard_timer = threading.Timer(
+                    self.agg_timeout, self._on_onboarding_timeout)
+                self._onboard_timer.daemon = True
+                self._onboard_timer.start()
                 return
             log.warning("server: onboarding timeout — starting with %d/%d "
                         "clients online", online, self.client_num)

@@ -17,6 +17,7 @@ class FAMessage:
     MSG_TYPE_S2C_INIT = 101          # server → clients: init msg + round
     MSG_TYPE_C2S_SUBMISSION = 102    # client → server: local submission
     MSG_TYPE_S2C_FINISH = 103
+    MSG_TYPE_C2S_ONLINE = 104        # client → server: online handshake
 
     ARG_INIT_MSG = "fa_init_msg"
     ARG_ROUND = "fa_round_idx"
@@ -45,10 +46,15 @@ class FACrossSiloServer(FedMLCommManager):
         self._submissions: Dict[int, Any] = {}
         self.result = None
         self._online = set()
+        self._started = False
+        self._onboard_timer: Optional[threading.Timer] = None
+        self._start_lock = threading.Lock()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             Message.MSG_TYPE_CONNECTION_IS_READY, self._handle_ready)
+        self.register_message_receive_handler(
+            FAMessage.MSG_TYPE_C2S_ONLINE, self._handle_online)
         self.register_message_receive_handler(
             FAMessage.MSG_TYPE_C2S_SUBMISSION, self._handle_submission)
 
@@ -61,12 +67,37 @@ class FACrossSiloServer(FedMLCommManager):
             self.send_message(msg)
 
     def _handle_ready(self, msg_params):
-        sender = msg_params.get_sender_id() if hasattr(
-            msg_params, "get_sender_id") else None
-        # self-ready fires once per manager; first broadcast when all client
-        # channels exist (local backend: immediately)
-        if len(self._online) == 0:
+        # server's own channel is up; round 0 waits for the client-online
+        # handshake (mirrors the training FSM — on non-persistent backends
+        # a client connecting after the broadcast would miss the init and
+        # hang the federation). A timeout guards against lost ONLINEs.
+        if self._onboard_timer is None:
+            timeout = float(getattr(self.args, "fa_onboarding_timeout_s", 30))
+            self._onboard_timer = threading.Timer(
+                timeout, self._on_onboarding_timeout)
+            self._onboard_timer.daemon = True
+            self._onboard_timer.start()
+
+    def _handle_online(self, msg_params):
+        sender = msg_params.get_sender_id()
+        with self._start_lock:
             self._online.add(sender)
+            if len(self._online) >= self.client_num and not self._started:
+                self._started = True
+                if self._onboard_timer is not None:
+                    self._onboard_timer.cancel()
+                    self._onboard_timer = None
+                self._broadcast_round()
+
+    def _on_onboarding_timeout(self):
+        with self._start_lock:
+            self._onboard_timer = None
+            if self._started:
+                return
+            log.warning(
+                "fa server: onboarding timeout — broadcasting round 0 with "
+                "%d/%d clients online", len(self._online), self.client_num)
+            self._started = True
             self._broadcast_round()
 
     def _handle_submission(self, msg_params):
@@ -101,9 +132,15 @@ class FACrossSiloClient(FedMLCommManager):
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
+            Message.MSG_TYPE_CONNECTION_IS_READY, self._handle_ready)
+        self.register_message_receive_handler(
             FAMessage.MSG_TYPE_S2C_INIT, self._handle_init)
         self.register_message_receive_handler(
             FAMessage.MSG_TYPE_S2C_FINISH, self._handle_finish)
+
+    def _handle_ready(self, msg_params):
+        self.send_message(
+            Message(FAMessage.MSG_TYPE_C2S_ONLINE, self.rank, 0))
 
     def _handle_init(self, msg_params):
         self.analyzer.set_init_msg(msg_params.get(FAMessage.ARG_INIT_MSG))

@@ -113,7 +113,7 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         cfg = self.cfg
         head_dim = cfg.dim // cfg.n_heads
         if cfg.lora_rank > 0:
@@ -132,6 +132,11 @@ class Attention(nn.Module):
         v = v.reshape(b, s, cfg.n_kv_heads, head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+
+        if decode:
+            return self._decode_attend(q, k, v, positions, b, s, head_dim,
+                                       dense)
+
         if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
             rep = cfg.n_heads // cfg.n_kv_heads
             k = jnp.repeat(k, rep, axis=1)
@@ -149,6 +154,48 @@ class Attention(nn.Module):
         else:
             out = blockwise_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * head_dim)
+        return dense(cfg.dim, "wo")(out)
+
+    def _decode_attend(self, q, k, v, positions, b, s, head_dim, dense):
+        """KV-cached attention for autoregressive serving (the reference
+        streams from HF's incremental generator,
+        ``serving/templates/hf_template/main_openai.py``; here the cache is
+        a static ``max_seq_len`` buffer in the flax "cache" collection so
+        the single-token step jits once).
+
+        ``positions[0]`` is the sequence position of the first new token;
+        the new K/V are written into the cache at that offset and q attends
+        to every cache slot ``<= `` its own position (stale slots beyond
+        the live prefix are masked, so a full-buffer prefill that wrote
+        garbage past the prompt length is harmless).
+        """
+        cfg = self.cfg
+        cache_len = cfg.max_seq_len
+        ck = self.variable("cache", "k", jnp.zeros,
+                           (b, cfg.n_kv_heads, cache_len, head_dim),
+                           cfg.dtype)
+        cv = self.variable("cache", "v", jnp.zeros,
+                           (b, cfg.n_kv_heads, cache_len, head_dim),
+                           cfg.dtype)
+        start = positions[0].astype(jnp.int32)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, 0, start, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, 0, start, 0))
+        kf, vf = ck.value, cv.value
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            kf = jnp.repeat(kf, rep, axis=1)
+            vf = jnp.repeat(vf, rep, axis=1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kf).astype(jnp.float32)
+        scores = scores / (head_dim ** 0.5)
+        kv_pos = jnp.arange(cache_len)
+        mask = kv_pos[None, :] <= positions[:, None]      # (s, cache_len)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vf.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+        out = out.transpose(0, 2, 1, 3).reshape(
+            b, s, cfg.n_heads * head_dim)
         return dense(cfg.dim, "wo")(out)
 
 
@@ -169,9 +216,10 @@ class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         h = x + Attention(self.cfg, name="attention")(
-            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions)
+            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions,
+            decode=decode)
         return h + MLP(self.cfg, name="mlp")(
             RMSNorm(self.cfg.norm_eps, name="mlp_norm")(h))
 
@@ -180,15 +228,24 @@ class LlamaLM(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, decode: bool = False,
+                 start_pos=None):
+        """``decode=True`` switches attention to the KV-cached path: the
+        flax "cache" collection must be mutable in ``apply``, and
+        ``start_pos`` (scalar int array) gives the sequence position of
+        ``tokens[:, 0]`` — the caller owns position bookkeeping so the
+        jitted single-token step stays stateless."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      name="tok_embed")(tokens)
         positions = jnp.arange(tokens.shape[-1])
+        if start_pos is not None:
+            positions = positions + start_pos
         for i in range(cfg.n_layers):
             # remat: recompute block activations in backward — HBM for FLOPs
-            block = nn.remat(Block)(cfg, name=f"layer_{i}")
-            x = block(x, positions)
+            block = nn.remat(Block, static_argnums=(3,))(
+                cfg, name=f"layer_{i}")
+            x = block(x, positions, decode)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)

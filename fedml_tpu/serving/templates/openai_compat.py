@@ -4,11 +4,17 @@ the HF chatbot template exposing ``/v1/chat/completions``).
 
 TPU-native serving decisions:
 
-- **Fixed-shape decode.** The token buffer is padded to a static length so
-  the per-token step compiles ONCE (no data-dependent shapes under jit);
-  decode is a jitted full-buffer forward + gather of the live position's
-  logits. For the small federated models this template targets, that is
-  simpler and faster than maintaining a KV cache in host Python.
+- **KV-cached decode.** When the server is built from a model exposing the
+  flax "cache" collection (``LlamaLM(decode=True)``), generation is a
+  one-shot prefill over the padded prompt buffer followed by a jitted
+  single-token step against a static-length KV cache — O(S) per token
+  instead of the O(S²) full-buffer re-forward.  All shapes static, so both
+  programs compile once per (buffer length, batch) and are cached across
+  requests.
+- **Fixed-shape fallback.** Any bare ``apply_fn(params, tokens) -> logits``
+  still works: the token buffer is padded to a static length and each step
+  re-runs the full forward (the round-1 behavior, kept as the generic
+  path).
 - **Deterministic sampling.** threefry key per request; temperature 0 ⇒
   argmax.
 - **Zero extra deps.** stdlib HTTP server (FastAPI isn't in the image),
@@ -18,6 +24,7 @@ TPU-native serving decisions:
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import threading
@@ -49,18 +56,21 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
-def generate(apply_fn: Callable, params, prompt_ids: List[int],
-             max_new_tokens: int = 64, temperature: float = 0.0,
-             top_k: int = 0, seed: int = 0, buf_len: int = 256,
-             eos_id: Optional[int] = None,
-             on_token: Optional[Callable[[int], None]] = None) -> List[int]:
-    """Sample ``max_new_tokens`` continuations of ``prompt_ids``.
+def _sample_live(live, key, temp, top_k: int):
+    """live: (V,) logits → sampled token id (greedy at temp 0)."""
+    if top_k and top_k > 0:
+        kth = jnp.sort(live)[-top_k]
+        live = jnp.where(live < kth, -jnp.inf, live)
+    greedy = jnp.argmax(live)
+    sampled = jax.random.categorical(key, live / jnp.maximum(temp, 1e-6))
+    return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
 
-    ``apply_fn(params, tokens)`` must return logits of shape (B, T, V).
-    The (1, buf_len) buffer shape is static, so the step function compiles
-    once per buffer size regardless of prompt/generation length.
-    """
-    prompt_ids = list(prompt_ids)[-(buf_len - 1):]
+
+@functools.lru_cache(maxsize=32)
+def _build_plain_step(apply_fn: Callable, top_k: int):
+    """Jitted full-buffer step, cached across requests (a per-request
+    ``@jax.jit`` would re-trace every call — the jit cache is keyed on the
+    function object)."""
 
     @jax.jit
     def step(params, buf, pos, key, temp):
@@ -68,25 +78,86 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
         # logits at pos-1 predict token at pos
         live = jax.lax.dynamic_index_in_dim(logits[0], pos - 1, axis=0,
                                             keepdims=False)
-        if top_k and top_k > 0:
-            kth = jnp.sort(live)[-top_k]
-            live = jnp.where(live < kth, -jnp.inf, live)
-        greedy = jnp.argmax(live)
-        sampled = jax.random.categorical(key, live / jnp.maximum(temp, 1e-6))
-        return jnp.where(temp > 0, sampled, greedy).astype(jnp.int32)
+        return _sample_live(live, key, temp, top_k)
 
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _build_cached_decode(model, top_k: int):
+    """Jitted (prefill, step) pair for a flax model supporting
+    ``decode=True`` with a "cache" collection (``llm.model.LlamaLM``)."""
+
+    @jax.jit
+    def prefill(params, buf, n, key, temp):
+        logits, mut = model.apply(
+            {"params": params}, buf, decode=True,
+            start_pos=jnp.zeros((), jnp.int32), mutable=["cache"])
+        live = jax.lax.dynamic_index_in_dim(logits[0], n - 1, axis=0,
+                                            keepdims=False)
+        return _sample_live(live, key, temp, top_k), mut["cache"]
+
+    @jax.jit
+    def step(params, cache, tok, pos, key, temp):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[None, None],
+            decode=True, start_pos=pos, mutable=["cache"])
+        return _sample_live(logits[0, 0], key, temp, top_k), mut["cache"]
+
+    return prefill, step
+
+
+def generate(apply_fn: Callable, params, prompt_ids: List[int],
+             max_new_tokens: int = 64, temperature: float = 0.0,
+             top_k: int = 0, seed: int = 0, buf_len: int = 256,
+             eos_id: Optional[int] = None,
+             on_token: Optional[Callable[[int], None]] = None,
+             model=None) -> List[int]:
+    """Sample ``max_new_tokens`` continuations of ``prompt_ids``.
+
+    ``apply_fn(params, tokens)`` must return logits of shape (B, T, V).
+    With ``model`` given (a flax module supporting ``decode=True`` whose
+    ``cfg.max_seq_len >= buf_len``), decode uses the KV cache: prefill
+    once, then O(1)-context single-token steps.  All shapes are static, so
+    each program compiles once per buffer size regardless of
+    prompt/generation length.
+    """
+    prompt_ids = list(prompt_ids)[-(buf_len - 1):]
     buf = np.zeros((1, buf_len), np.int32)
     n = len(prompt_ids)
     buf[0, :n] = prompt_ids
     buf_j = jnp.asarray(buf)
     key = jax.random.PRNGKey(seed)
+    temp = float(temperature)
     out: List[int] = []
+
+    if model is not None:
+        prefill, step = _build_cached_decode(model, int(top_k))
+        raw_params = params.get("params", params) if isinstance(params, dict) \
+            else params
+        key, sub = jax.random.split(key)
+        tok, cache = prefill(raw_params, buf_j, n, sub, temp)
+        pos = n
+        while pos < buf_len and len(out) < max_new_tokens:
+            t = int(tok)
+            if eos_id is not None and t == eos_id:
+                break
+            out.append(t)
+            if on_token is not None:
+                on_token(t)
+            key, sub = jax.random.split(key)
+            tok, cache = step(raw_params, cache, jnp.int32(t),
+                              jnp.int32(pos), sub, temp)
+            pos += 1
+        return out
+
+    step = _build_plain_step(apply_fn, int(top_k))
     pos = n
     for _ in range(max_new_tokens):
         if pos >= buf_len:
             break
         key, sub = jax.random.split(key)
-        tok = int(step(params, buf_j, pos, sub, float(temperature)))
+        tok = int(step(params, buf_j, pos, sub, temp))
         if eos_id is not None and tok == eos_id:
             break
         out.append(tok)
@@ -110,14 +181,19 @@ class OpenAICompatServer:
     streaming) over a (model_apply, params) pair."""
 
     def __init__(self, apply_fn: Callable, params, tokenizer=None,
-                 model_name: str = "fedml-tpu-llm", host: str = "0.0.0.0",
-                 port: int = 0, buf_len: int = 256):
+                 model_name: str = "fedml-tpu-llm", host: str = "127.0.0.1",
+                 port: int = 0, buf_len: int = 256, model=None):
+        """``host`` defaults to loopback — the endpoint is unauthenticated,
+        so exposing it on all interfaces requires an explicit
+        ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
+        ``decode=True`` → KV-cached decode (see :func:`generate`)."""
         self.apply_fn = apply_fn
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
         self.model_name = model_name
         self.host, self.port = host, port
         self.buf_len = buf_len
+        self.model = model
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------
@@ -149,7 +225,8 @@ class OpenAICompatServer:
             seed=int(req.get("seed", 0)),
             buf_len=self.buf_len,
             eos_id=getattr(tok, "eos_id", None),
-            on_token=emit if on_text else None)
+            on_token=emit if on_text else None,
+            model=self.model)
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail

@@ -24,6 +24,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .federated_dataset import FederatedDataset, build_federated, partition
+from .leaf import find_leaf_root, load_leaf
 from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
                         synthetic_segmentation, synthetic_tabular,
                         synthetic_text_classification,
@@ -177,6 +178,17 @@ def load(args) -> Tuple[FederatedDataset, int]:
 
     if name in _IMAGE_SPECS:
         classes, shape, train_n, test_n = _IMAGE_SPECS[name]
+        if cache:
+            # LEAF layout keeps the NATURAL per-user partition (reference
+            # data/MNIST/data_loader.py read_data) — it wins over any
+            # partition_method re-split.
+            leaf_root = find_leaf_root(cache, name)
+            if leaf_root is not None:
+                tx, ty, vx, vy, cidx, tidx = load_leaf(
+                    leaf_root, input_shape=shape)
+                ds = FederatedDataset(tx, ty, vx, vy, cidx, classes,
+                                      test_client_idxs=tidx)
+                return ds, classes
         real = _try_load_npz(cache, name) if cache else None
         if real is None and name in ("mnist", "synthetic_mnist") and cache:
             real = _try_load_mnist_idx(cache)
@@ -192,6 +204,14 @@ def load(args) -> Tuple[FederatedDataset, int]:
     if name in _LM_SPECS:
         vocab, seq_len, train_n, test_n = _LM_SPECS[name]
         seq_len = int(getattr(args, "seq_len", seq_len))
+        if cache:
+            leaf_root = find_leaf_root(cache, name)
+            if leaf_root is not None:
+                tx, ty, vx, vy, cidx, tidx = load_leaf(
+                    leaf_root, seq_len=seq_len)
+                ds = FederatedDataset(tx, ty, vx, vy, cidx, vocab,
+                                      test_client_idxs=tidx)
+                return ds, vocab
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:
             tx, ty, vx, vy = real
@@ -290,6 +310,24 @@ def load(args) -> Tuple[FederatedDataset, int]:
                             int(getattr(args, "edge_case_target", 9)),
                             np.int64)
         return ds, classes
+
+    if name == "digits":
+        # REAL data available without egress: sklearn's handwritten-digits
+        # set (1797 8x8 grayscale images, 10 classes) — the in-image stand-in
+        # for MNIST accuracy-parity runs (MNIST pixels cannot be downloaded
+        # here; the idx/LEAF parsers above handle them when provided).
+        from sklearn.datasets import load_digits
+        d = load_digits()
+        x = (d.data.astype(np.float32) / 16.0).reshape(-1, 8, 8, 1)
+        y = d.target.astype(np.int64)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(len(x))
+        x, y = x[perm], y[perm]
+        cut = int(getattr(args, "train_size", 0)) or int(len(x) * 0.85)
+        tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]
+        ds = build_federated(tx, ty, vx, vy, 10, client_num, method, alpha,
+                             seed)
+        return ds, 10
 
     if name.startswith("synthetic"):
         # synthetic_<classes>_<dim...> generic fallback

@@ -1,0 +1,144 @@
+"""Edge federation driver — server side of the shared-directory protocol
+spoken by the native edge client binary (``native/edge_client_main.cpp``,
+the ``main_MNN_train.cpp`` analog).
+
+The reference drives Android clients over MQTT+S3-MNN
+(``cross_device/server_mnn/fedml_aggregator.py:17`` aggregates returned MNN
+model files; the protocol is exercised from Python by
+``python/tests/android_protocol_test/test_protocol.py``).  Here the control
+plane is task/done files and the data plane is edge bundles in a shared
+directory — same split, broker-less, NFS/GCS-fuse friendly.
+
+Per round R the server publishes ``round_R/global.fteb`` + ``task.txt``,
+waits for every client's ``client_C.fteb`` + ``client_C.done``, aggregates
+with sample-count weights (FedAvg semantics of
+``ml/aggregator/agg_operator.py``), and finally writes ``finish.txt``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..native.edge_bundle import read_bundle, write_bundle
+
+log = logging.getLogger(__name__)
+
+
+def export_client_data(path: str, x: np.ndarray, y: np.ndarray) -> None:
+    """Write one client's local dataset as an edge data bundle (features
+    flattened — the native MLP consumes (n, d))."""
+    write_bundle(path, {
+        "x": np.asarray(x, np.float32).reshape(len(y), -1),
+        "y": np.asarray(y, np.float32),
+    })
+
+
+class EdgeFederationServer:
+    """Aggregation server for native edge-client processes."""
+
+    def __init__(self, work_dir: str, model: Dict[str, np.ndarray],
+                 num_clients: int, rounds: int = 1, epochs: int = 1,
+                 batch_size: int = 32, lr: float = 0.05, seed: int = 0,
+                 round_timeout_s: float = 120.0):
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self.model = {k: np.asarray(v, np.float32) for k, v in model.items()}
+        self.num_clients = int(num_clients)
+        self.rounds = int(rounds)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.lr = float(lr)
+        self.seed = int(seed)
+        self.timeout = float(round_timeout_s)
+        self.history: List[Dict[str, float]] = []
+
+    # -- protocol steps ----------------------------------------------------
+    def _publish_round(self, r: int) -> str:
+        rdir = os.path.join(self.work_dir, f"round_{r}")
+        os.makedirs(rdir, exist_ok=True)
+        write_bundle(os.path.join(rdir, "global.fteb"), self.model)
+        task = (f"round={r}\nepochs={self.epochs}\nbatch={self.batch_size}\n"
+                f"lr={self.lr}\nseed={self.seed}\n")
+        tmp = os.path.join(rdir, "task.txt.tmp")
+        with open(tmp, "w") as f:
+            f.write(task)
+        os.rename(tmp, os.path.join(rdir, "task.txt"))  # atomic publish
+        return rdir
+
+    def _collect(self, rdir: str) -> Optional[List[Dict]]:
+        deadline = time.time() + self.timeout
+        results: Dict[int, Dict] = {}
+        while time.time() < deadline and len(results) < self.num_clients:
+            for c in range(self.num_clients):
+                if c in results:
+                    continue
+                done = os.path.join(rdir, f"client_{c}.done")
+                blob = os.path.join(rdir, f"client_{c}.fteb")
+                if not (os.path.exists(done) and os.path.exists(blob)):
+                    continue
+                meta = {}
+                with open(done) as f:
+                    for line in f:
+                        if "=" in line:
+                            k, v = line.strip().split("=", 1)
+                            meta[k] = float(v)
+                results[c] = {"meta": meta, "params": read_bundle(blob)}
+            if len(results) < self.num_clients:
+                time.sleep(0.02)
+        if len(results) < self.num_clients:
+            return None
+        return [results[c] for c in range(self.num_clients)]
+
+    def _aggregate(self, results: List[Dict]) -> None:
+        total = sum(r["meta"].get("n_samples", 1.0) for r in results)
+        agg = {k: np.zeros_like(v) for k, v in self.model.items()}
+        for r in results:
+            w = r["meta"].get("n_samples", 1.0) / max(total, 1.0)
+            for k in agg:
+                agg[k] += w * np.asarray(r["params"][k], np.float32)
+        self.model = agg
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> Dict[str, np.ndarray]:
+        for r in range(self.rounds):
+            rdir = self._publish_round(r)
+            results = self._collect(rdir)
+            if results is None:
+                raise TimeoutError(
+                    f"round {r}: not all {self.num_clients} edge clients "
+                    f"reported within {self.timeout}s")
+            self._aggregate(results)
+            mean_loss = float(np.mean(
+                [res["meta"].get("loss", np.nan) for res in results]))
+            self.history.append({"round": r, "loss": mean_loss})
+            log.info("edge federation round %d: mean client loss %.4f", r,
+                     mean_loss)
+        self.finish()
+        return self.model
+
+    def finish(self) -> None:
+        tmp = os.path.join(self.work_dir, "finish.txt.tmp")
+        with open(tmp, "w") as f:
+            f.write("done\n")
+        os.rename(tmp, os.path.join(self.work_dir, "finish.txt"))
+
+
+def build_client_binary() -> str:
+    """Compile the standalone edge client (cached beside the sources)."""
+    import subprocess
+    src_dir = os.path.dirname(os.path.abspath(__file__))
+    native = os.path.join(os.path.dirname(src_dir), "native")
+    out = os.path.join(native, "fedml_edge_client")
+    srcs = [os.path.join(native, "edge_client_main.cpp"),
+            os.path.join(native, "edge_trainer.cpp")]
+    if (not os.path.exists(out)
+            or any(os.path.getmtime(s) > os.path.getmtime(out)
+                   for s in srcs)):
+        subprocess.run(["g++", "-O2", "-std=c++17", *srcs, "-o", out],
+                       check=True)
+    return out

@@ -1,0 +1,135 @@
+// Standalone edge federation client — the reference's
+// android/fedmlsdk/MobileNN/src/main_MNN_train.cpp analog: a native binary
+// that participates in a federated run as its own PROCESS, speaking the
+// shared-directory edge protocol (the filestore control/data split that
+// stands in for the reference's MQTT+S3-MNN pair,
+// mqtt_s3_mnn/mqtt_s3_comm_manager.py).
+//
+// Protocol (work_dir is shared with the server —
+// fedml_tpu/cross_device/edge_federation.py):
+//   server:  round_R/global.fteb            global model bundle
+//            round_R/task.txt               key=value: round epochs batch lr seed
+//   client:  round_R/client_C.fteb          trained model (atomic rename)
+//            round_R/client_C.done          key=value: n_samples loss epoch
+//   server:  finish.txt                     terminates clients
+//
+// Build: g++ -O2 -std=c++17 edge_client_main.cpp edge_trainer.cpp -o
+// fedml_edge_client   (edge_trainer.cpp built with -DFEDML_EDGE_NO_MAIN_DEP
+// exposes the same C ABI the .so does).
+//
+// usage: fedml_edge_client <work_dir> <client_id> <data_bundle> [poll_ms]
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <chrono>
+
+#include <sys/stat.h>
+
+extern "C" {
+void* fedml_edge_create(const char* model_path, const char* data_path,
+                        int batch, float lr);
+int fedml_edge_train(void* mgr, int epochs, long long seed);
+void fedml_edge_get_epoch_and_loss(void* mgr, int* epoch, float* loss);
+int fedml_edge_save_model(void* mgr, const char* path);
+void fedml_edge_destroy(void* mgr);
+long long fedml_edge_num_samples(void* mgr);
+}
+
+namespace {
+
+bool exists(const std::string& p) {
+  struct stat st;
+  return ::stat(p.c_str(), &st) == 0;
+}
+
+struct Task {
+  int round = -1, epochs = 1, batch = 32;
+  float lr = 0.05f;
+  long long seed = 0;
+};
+
+bool read_task(const std::string& path, Task* t) {
+  FILE* f = std::fopen(path.c_str(), "r");
+  if (!f) return false;
+  char key[64];
+  double val;
+  while (std::fscanf(f, "%63[^=]=%lf\n", key, &val) == 2) {
+    if (!std::strcmp(key, "round")) t->round = (int)val;
+    else if (!std::strcmp(key, "epochs")) t->epochs = (int)val;
+    else if (!std::strcmp(key, "batch")) t->batch = (int)val;
+    else if (!std::strcmp(key, "lr")) t->lr = (float)val;
+    else if (!std::strcmp(key, "seed")) t->seed = (long long)val;
+  }
+  std::fclose(f);
+  return t->round >= 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <work_dir> <client_id> <data_bundle> [poll_ms]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string work_dir = argv[1];
+  const int client_id = std::atoi(argv[2]);
+  const std::string data_path = argv[3];
+  const int poll_ms = argc > 4 ? std::atoi(argv[4]) : 50;
+
+  int round = 0;
+  for (;;) {
+    if (exists(work_dir + "/finish.txt")) {
+      std::fprintf(stderr, "[edge %d] finish signal, exiting\n", client_id);
+      return 0;
+    }
+    const std::string rdir = work_dir + "/round_" + std::to_string(round);
+    const std::string task_path = rdir + "/task.txt";
+    const std::string model_path = rdir + "/global.fteb";
+    if (!exists(task_path) || !exists(model_path)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
+      continue;
+    }
+    Task task;
+    if (!read_task(task_path, &task)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
+      continue;
+    }
+    void* mgr = fedml_edge_create(model_path.c_str(), data_path.c_str(),
+                                  task.batch, task.lr);
+    if (!mgr) {
+      std::fprintf(stderr, "[edge %d] init failed (round %d)\n", client_id,
+                   round);
+      return 1;
+    }
+    // per-client, per-round deterministic stream
+    fedml_edge_train(mgr, task.epochs,
+                     task.seed + 1315423911LL * client_id + round);
+    int epoch = 0;
+    float loss = 0.f;
+    fedml_edge_get_epoch_and_loss(mgr, &epoch, &loss);
+    long long n = fedml_edge_num_samples(mgr);
+
+    const std::string out = rdir + "/client_" + std::to_string(client_id);
+    const std::string tmp = out + ".fteb.tmp";
+    if (fedml_edge_save_model(mgr, tmp.c_str()) != 0) {
+      std::fprintf(stderr, "[edge %d] save failed\n", client_id);
+      fedml_edge_destroy(mgr);
+      return 1;
+    }
+    std::rename(tmp.c_str(), (out + ".fteb").c_str());
+    FILE* d = std::fopen((out + ".done.tmp").c_str(), "w");
+    std::fprintf(d, "n_samples=%lld\nloss=%f\nepoch=%d\n", n, (double)loss,
+                 epoch);
+    std::fclose(d);
+    std::rename((out + ".done.tmp").c_str(), (out + ".done").c_str());
+    std::fprintf(stderr, "[edge %d] round %d done: n=%lld loss=%.4f\n",
+                 client_id, round, n, (double)loss);
+    fedml_edge_destroy(mgr);
+    ++round;
+  }
+}

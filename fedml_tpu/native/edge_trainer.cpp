@@ -263,6 +263,7 @@ class EdgeTrainer {
   void stop() { stop_ = true; }
   int epoch() const { return epoch_; }
   float loss() const { return loss_; }
+  int64_t num_samples() const { return n_; }
 
  private:
   Tensor w1_, b1_, w2_, b2_, x_, y_;
@@ -305,6 +306,10 @@ int fedml_edge_save_model(void* mgr, const char* path) {
 
 void fedml_edge_stop_training(void* mgr) {
   static_cast<EdgeTrainer*>(mgr)->stop();
+}
+
+long long fedml_edge_num_samples(void* mgr) {
+  return static_cast<EdgeTrainer*>(mgr)->num_samples();
 }
 
 void fedml_edge_destroy(void* mgr) { delete static_cast<EdgeTrainer*>(mgr); }

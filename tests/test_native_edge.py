@@ -80,3 +80,53 @@ def test_cross_device_federation_round():
     logits = vx.reshape(len(vy), -1) @ merged["w1"] + merged["b1"]
     acc = (logits.argmax(1) == vy).mean()
     assert acc > 0.7, acc
+
+
+def test_edge_client_process_federation(tmp_path):
+    """Full federated round-trip with the C++ binary as the CLIENT PROCESS
+    (reference main_MNN_train.cpp + android_protocol_test/test_protocol.py):
+    server publishes rounds into a shared dir, two native subprocesses poll,
+    train, upload; aggregated model must beat the initial one."""
+    import subprocess
+    import numpy as np
+    from fedml_tpu.cross_device.edge_federation import (
+        EdgeFederationServer, build_client_binary, export_client_data)
+
+    rng = np.random.default_rng(0)
+    d, classes, n_per = 16, 3, 120
+    # linearly separable-ish blobs so LR learns fast
+    centers = rng.normal(0, 2.0, (classes, d))
+    procs = []
+    try:
+        for c in range(2):
+            y = rng.integers(0, classes, n_per)
+            x = centers[y] + rng.normal(0, 0.5, (n_per, d))
+            export_client_data(str(tmp_path / f"data_{c}.fteb"),
+                               x.astype(np.float32), y)
+        model = {"w1": np.zeros((d, classes), np.float32),
+                 "b1": np.zeros((classes,), np.float32)}
+        binary = build_client_binary()
+        work = tmp_path / "fed"
+        work.mkdir()
+        for c in range(2):
+            procs.append(subprocess.Popen(
+                [binary, str(work), str(c), str(tmp_path / f"data_{c}.fteb"),
+                 "10"], stderr=subprocess.PIPE))
+        srv = EdgeFederationServer(str(work), model, num_clients=2, rounds=3,
+                                   epochs=2, batch_size=20, lr=0.1, seed=7,
+                                   round_timeout_s=60.0)
+        final = srv.run()
+        for p in procs:
+            assert p.wait(timeout=30) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert len(srv.history) == 3
+    losses = [h["loss"] for h in srv.history]
+    assert losses[-1] < losses[0], losses
+    # aggregated model classifies the generating distribution well
+    xs = centers + 0.0
+    logits = xs @ final["w1"] + final["b1"]
+    assert (logits.argmax(axis=1) == np.arange(classes)).all()

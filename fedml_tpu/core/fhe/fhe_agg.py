@@ -5,21 +5,31 @@ TenSEAL is not available in this environment (and FHE math cannot run on the
 TPU anyway), so the rebuild keeps the exact hook surface — encrypt client
 updates before upload, aggregate ciphertexts server-side, decrypt the merged
 model — implemented as a host-side callback at the round boundary, exactly
-where the reference places it.  The default backend is an additive-masking
-"mock CKKS" that preserves the protocol shape (server only ever sees
-ciphertext objects, addition happens in ciphertext space); a real CKKS backend
-can be slotted in by registering another codec.
+where the reference places it.
+
+Backends (``args.fhe_backend``, registry extensible via
+:func:`register_codec`):
+
+- ``"ckks"`` (default) — the vendored REAL RLWE/CKKS-style scheme in
+  :mod:`fedml_tpu.core.fhe.ckks` (NTT ring arithmetic, ternary-secret RLWE,
+  fixed-point coefficient packing).
+- ``"mock"`` — additive masking that only preserves the protocol *shape*
+  with zero cryptographic value.  Must be requested EXPLICITLY; selecting
+  it logs a warning (no silent mock crypto).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Tuple
+import logging
+from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 import numpy as np
 
 from ..tree import tree_flatten_1d, tree_unflatten_1d
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -55,6 +65,23 @@ class _AdditiveMaskCodec:
         return ct.payload - ct.n_addends * self._mask(ct.payload.size)
 
 
+def _make_ckks(seed: int):
+    from .ckks import CkksCodec
+    return CkksCodec(seed)
+
+
+_CODECS: Dict[str, Callable[[int], Any]] = {
+    "ckks": _make_ckks,
+    "mock": lambda seed: _AdditiveMaskCodec(seed),
+}
+
+
+def register_codec(name: str, factory: Callable[[int], Any]) -> None:
+    """Slot in another HE backend: ``factory(seed) -> codec`` with
+    encrypt/add/scale/decrypt."""
+    _CODECS[str(name).lower()] = factory
+
+
 class FedMLFHE:
     _instance = None
 
@@ -73,7 +100,17 @@ class FedMLFHE:
         if args is None or not getattr(args, "enable_fhe", False):
             return
         self.is_enabled = True
-        self.codec = _AdditiveMaskCodec(int(getattr(args, "random_seed", 0)) ^ 0xF4E)
+        backend = str(getattr(args, "fhe_backend", "ckks")).lower()
+        if backend not in _CODECS:
+            raise ValueError(
+                f"unknown fhe_backend {backend!r}; have {sorted(_CODECS)}")
+        if backend == "mock":
+            log.warning(
+                "fhe_backend='mock' provides NO cryptographic protection "
+                "(additive masking only) — use the default 'ckks' backend "
+                "for real lattice encryption")
+        seed = int(getattr(args, "random_seed", 0)) ^ 0xF4E
+        self.codec = _CODECS[backend](seed)
 
     def is_fhe_enabled(self) -> bool:
         return self.is_enabled
@@ -85,7 +122,8 @@ class FedMLFHE:
         return self.codec.encrypt(flat)
 
     def fhe_dec(self, dec_type: str, enc_model_params: Any) -> Any:
-        if not isinstance(enc_model_params, _Ciphertext):
+        from .ckks import RlweCiphertext
+        if not isinstance(enc_model_params, (_Ciphertext, RlweCiphertext)):
             return enc_model_params  # first round: plaintext global model
         flat = self.codec.decrypt(enc_model_params)
         return tree_unflatten_1d(np.asarray(flat, dtype=np.float32), self._template)

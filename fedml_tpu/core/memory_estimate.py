@@ -1,0 +1,104 @@
+"""Per-chip HBM estimates for federated-LLM mesh layouts.
+
+SURVEY §7 flags "7B LoRA × 512 clients memory" as a hard part: base params
+are sharded once (read-only) over the ``model`` axis while per-client state
+is adapters only, vmapped over the ``client`` axis.  This module prices that
+layout so configs can be validated BEFORE a pod run (the reference has no
+analog — DeepSpeed just OOMs; ``train/llm/distributed.py`` delegates).
+
+All numbers are bytes unless suffixed ``_gib``.  Estimates are intentionally
+simple closed forms (weights + adapters + optimizer + remat-boundary
+activations + collective scratch) and err high by a configurable safety
+factor; they are sanity bounds, not an allocator model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+GIB = 1024 ** 3
+
+#: usable HBM per chip (device_kind substring → bytes); ~0.75 of nominal to
+#: leave room for XLA scratch/fragmentation
+HBM_PER_CHIP = {
+    "v4": int(32 * 0.75 * GIB),
+    "v5p": int(95 * 0.75 * GIB),
+    "v5 lite": int(16 * 0.75 * GIB),
+    "v5e": int(16 * 0.75 * GIB),
+    "v6e": int(32 * 0.75 * GIB),
+}
+
+
+@dataclasses.dataclass
+class FedLLMLayout:
+    """Mesh layout for a LoRA federation round."""
+    n_params: float              # base model parameter count
+    n_lora_params: float         # adapter parameter count PER CLIENT
+    n_clients: int               # cohort size per round
+    n_chips: int                 # total chips in the mesh
+    model_shards: int            # tensor/FSDP shard count (model axis)
+    batch_per_client: int = 1
+    seq_len: int = 2048
+    dim: int = 4096
+    n_layers: int = 32
+    param_bytes: int = 2         # bf16 base weights
+    lora_bytes: int = 4          # fp32 adapters
+    optimizer_slots: int = 2     # adam m+v over adapters
+    safety: float = 1.25
+
+    @property
+    def client_shards(self) -> int:
+        return max(1, self.n_chips // self.model_shards)
+
+    @property
+    def clients_per_chip_group(self) -> int:
+        return -(-self.n_clients // self.client_shards)
+
+
+def estimate_fedllm_memory(layout: FedLLMLayout) -> Dict[str, float]:
+    """Per-chip HBM breakdown for one federated LoRA round."""
+    lo = layout
+    base = lo.n_params * lo.param_bytes / lo.model_shards
+    per_client_state = lo.n_lora_params * lo.lora_bytes * (
+        1 + 1 + lo.optimizer_slots)          # adapters + grads + opt slots
+    adapters = per_client_state * lo.clients_per_chip_group
+    # remat at block boundaries: one (B, S, dim) bf16 tensor per layer per
+    # resident client microbatch, plus ~4 working tensors for the live block
+    act_per_client = (lo.n_layers + 4) * (
+        lo.batch_per_client * lo.seq_len * lo.dim * 2) / lo.model_shards
+    activations = act_per_client  # clients run scanned, one live at a time
+    # psum/all-gather scratch: one adapter set + one activation buffer
+    scratch = lo.n_lora_params * lo.lora_bytes + act_per_client
+    total = (base + adapters + activations + scratch) * lo.safety
+    return {
+        "base_params": base,
+        "adapter_states": adapters,
+        "activations": activations,
+        "collective_scratch": scratch,
+        "total": total,
+        "total_gib": total / GIB,
+        "clients_per_chip_group": lo.clients_per_chip_group,
+        "client_shards": lo.client_shards,
+    }
+
+
+def fits(layout: FedLLMLayout, chip: str = "v4") -> bool:
+    budget = None
+    for marker, b in HBM_PER_CHIP.items():
+        if marker in chip.lower():
+            budget = b
+            break
+    if budget is None:
+        raise ValueError(f"unknown chip {chip!r}; have {list(HBM_PER_CHIP)}")
+    return estimate_fedllm_memory(layout)["total"] <= budget
+
+
+def northstar_llama2_7b_512clients(n_chips: int = 256,
+                                   model_shards: int = 8) -> Dict[str, float]:
+    """BASELINE.json north star: Llama-2-7B LoRA, 512 clients, v4-256."""
+    lora_per_client = 4 * 32 * 2 * 4096 * 16  # q/k/v/o proj, r=16, 32 layers
+    return estimate_fedllm_memory(FedLLMLayout(
+        n_params=6.74e9, n_lora_params=lora_per_client, n_clients=512,
+        n_chips=n_chips, model_shards=model_shards, batch_per_client=1,
+        seq_len=2048, dim=4096, n_layers=32))

@@ -51,3 +51,26 @@ def test_mesh_matches_sp():
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    atol=2e-5, rtol=1e-4)
+
+
+def test_mesh_sharded_data_parity():
+    """device_data='sharded' (dataset rows sharded over the client axis,
+    cohort gathered via XLA collectives) must reproduce the replicated-mode
+    curve exactly."""
+    from fedml_tpu import data as data_mod, device as device_mod, \
+        model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+    curves = {}
+    for mode in (True, "sharded", False):
+        args = fedml_tpu.init(args_for("mesh"))
+        args.update(device_data=mode)
+        dev = device_mod.get_device(args)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        api = MeshFedAvgAPI(args, dev, dataset, model)
+        losses = []
+        for r in range(4):
+            m = api.train_one_round(r)
+            losses.append(round(float(m["train_loss"]), 6))
+        curves[str(mode)] = losses
+    assert curves["True"] == curves["sharded"] == curves["False"], curves

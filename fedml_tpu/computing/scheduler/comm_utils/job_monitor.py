@@ -5,12 +5,56 @@ endpoints, mark crashed runs, and trigger recovery callbacks)."""
 from __future__ import annotations
 
 import logging
+import os
+import signal
 import subprocess
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+
+class PidHandle:
+    """Popen-shaped handle over a process we did not spawn (an orphaned run
+    re-adopted after an agent restart — we cannot waitpid it, only probe
+    and signal)."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self._rc: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._rc is not None:
+            return self._rc
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            self._rc = -1  # exit code unknowable across the reparent
+            return self._rc
+        except PermissionError:
+            return None  # alive, different uid
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired(f"pid:{self.pid}", timeout)
+            time.sleep(0.05)
+        return self._rc
 
 
 class JobMonitor:
@@ -42,6 +86,13 @@ class JobMonitor:
               on_exit: Callable[[str, int], None]) -> None:
         with self._lock:
             self._procs[str(run_id)] = (proc, on_exit)
+
+    def watch_pid(self, run_id: str, pid: int,
+                  on_exit: Callable[[str, int], None]) -> None:
+        """Adopt an already-running process by pid (orphan recovery after an
+        agent crash — reference JobMonitor re-attaches to run processes,
+        comm_utils/job_monitor.py:337)."""
+        self.watch(run_id, PidHandle(pid), on_exit)
 
     def kill(self, run_id: str) -> bool:
         """Terminate a run's process (reference stop_train path).  Returns
@@ -90,4 +141,4 @@ class JobMonitor:
             time.sleep(self.poll_interval_s)
 
 
-__all__ = ["JobMonitor"]
+__all__ = ["JobMonitor", "PidHandle"]

@@ -1,0 +1,66 @@
+"""Agent process entry (reference ``slave/client_login.py`` +
+``client_runner`` run loop): a device agent as its OWN process, talking to
+the master over the filestore control plane.  Started directly or — for
+respawn-on-death supervision — via :mod:`client_daemon`.
+
+    python -m fedml_tpu.computing.scheduler.slave.agent_main \
+        --device-id 1 --size 3 --plane-id myplane \
+        --filestore-dir /shared/ctl --work-dir /var/agent1
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import time
+import types
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device-id", type=int, required=True)
+    ap.add_argument("--size", type=int, required=True,
+                    help="plane size (master + agents)")
+    ap.add_argument("--plane-id", default="0")
+    ap.add_argument("--filestore-dir", required=True)
+    ap.add_argument("--work-dir", required=True)
+    opts = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s agent{opts.device_id} %(levelname)s %(message)s")
+
+    from ....core.distributed.fedml_comm_manager import create_comm_backend
+    from .client_agent import FedMLClientAgent
+
+    args = types.SimpleNamespace(run_id=opts.plane_id,
+                                 filestore_dir=opts.filestore_dir)
+    com = create_comm_backend(args, opts.device_id, opts.size, "filestore")
+    agent = FedMLClientAgent(opts.device_id, com, opts.work_dir)
+
+    stop = {"flag": False}
+
+    def _sig(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+    agent.start()
+    pid_path = os.path.join(opts.work_dir, "agent.pid")
+    with open(pid_path, "w") as f:
+        f.write(str(os.getpid()))
+    logging.info("agent %d up (pid %d)", opts.device_id, os.getpid())
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,79 @@ class FedMLClientAgent:
         self.monitor.start()
         self.center.start()
         self._register()
+        self.recover_runs()
+
+    def recover_runs(self) -> None:
+        """Crash recovery (reference JobMonitor re-attach +
+        client_daemon respawn): for every run this device last reported
+        RUNNING, either re-adopt the still-alive job process by pid or
+        respawn its entry script in the preserved workspace — a kill -9'd
+        agent must not strand its runs."""
+        for row in self.run_db.list_runs():
+            if (int(row.get("device_id", -1)) != self.device_id
+                    or row.get("status") != RunStatus.RUNNING):
+                continue
+            run_id = str(row["run_id"])
+            info = row.get("info") or {}
+            pid = info.get("pid")
+            alive = False
+            if pid:
+                try:
+                    os.kill(int(pid), 0)
+                    alive = True
+                except (ProcessLookupError, PermissionError, ValueError):
+                    alive = False
+            if alive:
+                log.info("agent %d: re-adopting run %s (pid %s)",
+                         self.device_id, run_id, pid)
+                ws = info.get("ws", "")
+
+                def on_exit(rid, rc, _ws=ws):
+                    # the reparented orphan's rc comes from its run.rc file
+                    rc_path = os.path.join(_ws, "run.rc")
+                    try:
+                        with open(rc_path) as f:
+                            rc = int(f.read().strip())
+                    except (OSError, ValueError):
+                        rc = -1  # killed before writing its exit code
+                    self._on_run_exit(rid, rc)
+
+                self.monitor.watch_pid(run_id, int(pid), on_exit)
+            elif info.get("entry") and info.get("ws"):
+                log.warning("agent %d: run %s died with the previous agent; "
+                            "respawning", self.device_id, run_id)
+                threading.Thread(
+                    target=self._respawn_run, name=f"respawn-{run_id}",
+                    args=(run_id, info), daemon=True).start()
+            else:
+                self._report(run_id, RunStatus.FAILED,
+                             info={"error": "lost across agent restart"})
+
+    def _spawn_entry(self, entry: str, ws: str, full_env: Dict[str, str],
+                     logf) -> subprocess.Popen:
+        """Run the entry script with its exit code mirrored to ``run.rc``
+        in the workspace — a pid-adopted orphan's true exit code is
+        unknowable across the reparent, so the job persists it itself."""
+        with open(os.path.join(ws, "entry.sh"), "w") as f:
+            f.write(entry if entry.endswith("\n") else entry + "\n")
+        cmd = "bash entry.sh; rc=$?; echo $rc > run.rc; exit $rc"
+        return subprocess.Popen(["bash", "-c", cmd], cwd=ws, env=full_env,
+                                stdout=logf, stderr=subprocess.STDOUT)
+
+    def _respawn_run(self, run_id: str, info: Dict[str, Any]) -> None:
+        try:
+            ws = info["ws"]
+            log_path = os.path.join(ws, "run.log")
+            full_env = dict(os.environ)
+            full_env.update(info.get("env") or {})
+            with open(log_path, "ab") as logf:
+                proc = self._spawn_entry(info["entry"], ws, full_env, logf)
+            self._report(run_id, RunStatus.RUNNING, log_path=log_path,
+                         info={**info, "pid": proc.pid, "respawned": True})
+            self.monitor.watch(run_id, proc, self._on_run_exit)
+        except Exception as e:
+            log.exception("respawn of run %s failed", run_id)
+            self._report(run_id, RunStatus.FAILED, info={"error": str(e)})
 
     def stop(self) -> None:
         with self._stop_lock:
@@ -80,6 +153,15 @@ class FedMLClientAgent:
     # -- control-plane handlers --------------------------------------------
     def _on_start(self, msg: Message) -> None:
         run_id = str(msg.get(MSG_ARG_RUN_ID))
+        # idempotency: a respawned agent's fresh comm channel replays old
+        # control files; a run this device has ALREADY acted on (any
+        # agent-side status in the run DB) belongs to recover_runs, and a
+        # duplicate spawn here would leave an unreaped child that pid
+        # adoption then mistakes for a live orphan
+        if self.run_db.get_status(run_id, self.device_id) is not None:
+            log.info("agent %d: ignoring duplicate START_RUN for %s "
+                     "(already tracked)", self.device_id, run_id)
+            return
         pkg = str(msg.get(MSG_ARG_PACKAGE))
         entry = str(msg.get(MSG_ARG_ENTRY) or "")
         env = dict(msg.get(MSG_ARG_ENV) or {})
@@ -122,11 +204,11 @@ class FedMLClientAgent:
                 self._report(run_id, RunStatus.KILLED)
                 return
             with open(log_path, "ab") as logf:
-                proc = subprocess.Popen(
-                    ["bash", "-c", entry], cwd=ws, env=full_env,
-                    stdout=logf, stderr=subprocess.STDOUT)
+                proc = self._spawn_entry(entry, ws, full_env, logf)
+            # entry/ws/env persist so a respawned agent can recover the run
             self._report(run_id, RunStatus.RUNNING, log_path=log_path,
-                         info={"pid": proc.pid})
+                         info={"pid": proc.pid, "entry": entry, "ws": ws,
+                               "env": env})
             self.monitor.watch(run_id, proc, self._on_run_exit)
             # re-check: a stop may have swept between Popen and watch()
             if self._run_aborted(run_id) and self.monitor.kill(run_id):
@@ -147,10 +229,45 @@ class FedMLClientAgent:
             self._report(run_id, RunStatus.KILLED)
 
     def _on_ota(self, msg: Message) -> None:
-        # reference ota_upgrade (client_runner.py:867) pip-upgrades and
-        # restarts the daemon; here we only acknowledge — package management
-        # is the operator's domain in a zero-egress environment.
-        log.info("agent %d: OTA request acknowledged (no-op)", self.device_id)
+        """OTA upgrade (reference ``client_runner.py:867`` pip-upgrades and
+        respawns the daemon).  Zero-egress version: the message carries an
+        agent-code package path; the agent unpacks it into a versioned dir,
+        flips the ``current`` marker, reports, and — when supervised by
+        ``client_daemon`` — exits so the daemon respawns it with the new
+        code on PYTHONPATH."""
+        pkg = msg.get(MSG_ARG_PACKAGE)
+        version = str(msg.get("version") or "0")
+        if not pkg:
+            log.info("agent %d: OTA ping (no package) acknowledged",
+                     self.device_id)
+            return
+        try:
+            dest = os.path.join(self.work_dir, "agent_upgrade", version)
+            ws = fetch_job_package(str(pkg), dest)
+            marker = os.path.join(self.work_dir, "agent_upgrade", "current")
+            tmp = marker + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{version}\n{ws}\n")
+            os.replace(tmp, marker)
+            log.info("agent %d: OTA %s staged at %s", self.device_id,
+                     version, ws)
+            self._report(f"ota_{version}", RunStatus.FINISHED,
+                         info={"ota_version": version, "path": ws})
+            if os.environ.get("FEDML_AGENT_SUPERVISED"):
+                # the daemon interprets OTA_EXIT_CODE as "respawn me with
+                # the staged code"; runs survive via recover_runs()
+                threading.Thread(target=self._ota_exit, daemon=True).start()
+        except Exception as e:
+            log.exception("OTA failed")
+            self._report(f"ota_{version}", RunStatus.FAILED,
+                         info={"error": str(e)})
+
+    OTA_EXIT_CODE = 75  # EX_TEMPFAIL: daemon respawns instead of giving up
+
+    def _ota_exit(self):
+        import time as _t
+        _t.sleep(0.2)  # let the status message flush
+        os._exit(self.OTA_EXIT_CODE)
 
     # -- status ------------------------------------------------------------
     def _report(self, run_id: str, status: str,

@@ -1,0 +1,140 @@
+"""Agent daemon — respawn-on-death supervision (reference
+``slave/client_daemon.py``: a login daemon that keeps the client agent
+process alive and restarts it after crashes or OTA upgrades).
+
+The daemon Popens :mod:`agent_main` with ``FEDML_AGENT_SUPERVISED=1`` and
+respawns it whenever it dies: crash (any rc) → respawn with backoff, up to
+``max_restarts`` within the rolling window; OTA exit (rc 75) → immediate
+respawn with the staged upgrade dir prepended to ``PYTHONPATH``.  Run
+recovery on the agent side (``FedMLClientAgent.recover_runs``) re-adopts or
+respawns the jobs the dead agent stranded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+OTA_EXIT_CODE = 75
+
+
+class AgentDaemon:
+    def __init__(self, agent_args: List[str], work_dir: str,
+                 max_restarts: int = 10, window_s: float = 60.0,
+                 backoff_s: float = 0.2):
+        self.agent_args = list(agent_args)
+        self.work_dir = work_dir
+        os.makedirs(work_dir, exist_ok=True)
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.backoff_s = float(backoff_s)
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts: List[float] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _spawn(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["FEDML_AGENT_SUPERVISED"] = "1"
+        # OTA: staged code dir (if any) leads PYTHONPATH on respawn
+        marker = os.path.join(self.work_dir, "agent_upgrade", "current")
+        if os.path.exists(marker):
+            with open(marker) as f:
+                lines = f.read().splitlines()
+            if len(lines) >= 2 and os.path.isdir(lines[1]):
+                env["PYTHONPATH"] = os.pathsep.join(
+                    [lines[1], env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+                log.info("daemon: respawning with OTA code %s (v%s)",
+                         lines[1], lines[0])
+        cmd = [sys.executable, "-m",
+               "fedml_tpu.computing.scheduler.slave.agent_main",
+               *self.agent_args, "--work-dir", self.work_dir]
+        log_path = os.path.join(self.work_dir, "agent_daemon.log")
+        logf = open(log_path, "ab")
+        return subprocess.Popen(cmd, env=env, stdout=logf,
+                                stderr=subprocess.STDOUT)
+
+    def _loop(self) -> None:
+        self.proc = self._spawn()
+        while not self._stop.is_set():
+            rc = self.proc.poll()
+            if rc is None:
+                time.sleep(0.1)
+                continue
+            now = time.time()
+            self.restarts = [t for t in self.restarts
+                             if now - t < self.window_s]
+            if rc == OTA_EXIT_CODE:
+                log.info("daemon: agent exited for OTA; respawning")
+            else:
+                log.warning("daemon: agent died rc=%s; respawning", rc)
+                if len(self.restarts) >= self.max_restarts:
+                    log.error("daemon: %d restarts in %.0fs — giving up",
+                              len(self.restarts), self.window_s)
+                    return
+                time.sleep(self.backoff_s * (1 + len(self.restarts)))
+            self.restarts.append(now)
+            if not self._stop.is_set():
+                self.proc = self._spawn()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, name="agent-daemon",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def agent_pid(self, timeout_s: float = 60.0) -> int:
+        """Pid of the CURRENT agent process (survives respawns via the
+        pidfile agent_main writes)."""
+        path = os.path.join(self.work_dir, "agent.pid")
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.proc is not None and self.proc.poll() is None \
+                    and os.path.exists(path):
+                with open(path) as f:
+                    txt = f.read().strip()
+                if txt and int(txt) == self.proc.pid:
+                    return int(txt)
+            time.sleep(0.05)
+        raise TimeoutError("agent pidfile never matched a live agent")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--work-dir", required=True)
+    ap.add_argument("agent_args", nargs=argparse.REMAINDER,
+                    help="arguments forwarded to agent_main (after --)")
+    opts = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    daemon = AgentDaemon([a for a in opts.agent_args if a != "--"],
+                         opts.work_dir)
+    daemon.start()
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

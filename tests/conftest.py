@@ -11,6 +11,10 @@ initialized yet).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# subprocesses spawned by tests (agents, daemons, edge clients) can't apply
+# jax.config themselves before the plugin overrides JAX_PLATFORMS — but
+# fedml_tpu/__init__ honors this env var via the config route at import
+os.environ.setdefault("FEDML_TPU_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

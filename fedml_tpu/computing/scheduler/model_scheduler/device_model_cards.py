@@ -126,33 +126,55 @@ class FedMLModelCards:
         return factory
 
     def deploy(self, name: str, num_replicas: int = 1,
-               predictor_factory=None) -> dict:
-        """Stand up replicas + gateway; returns endpoint info."""
+               predictor_factory=None, mode: str = "thread",
+               autoscale_policy=None,
+               autoscale_interval_s: float = 1.0) -> dict:
+        """Stand up replicas + gateway; returns endpoint info.
+
+        ``mode="thread"`` serves in-process runners (fast, test-friendly);
+        ``mode="process"`` spawns real worker processes over the PACKAGED
+        card (reference ``device_model_deployment.py:68`` container unit).
+        ``autoscale_policy`` (an ``autoscaler.policies`` instance) attaches
+        the background reconcile loop that scales replicas from live
+        gateway metrics."""
         from .device_model_inference import InferenceGateway
         from .device_replica_controller import ReplicaController
 
         card = self.get_model(name)
         if card is None:
             raise FileNotFoundError(f"no model card {name!r}")
-        if predictor_factory is None:
-            predictor_factory = self._resolve_factory(card)
         # redeploy = replace: stop the old gateway/replicas first so they
         # don't leak with no remaining handle
         self.undeploy(name)
-        controller = ReplicaController(name, predictor_factory)
+        if mode == "process":
+            from .device_model_deployment import ProcessReplicaController
+            controller = ProcessReplicaController(name, self._card_dir(name))
+        else:
+            if predictor_factory is None:
+                predictor_factory = self._resolve_factory(card)
+            controller = ReplicaController(name, predictor_factory)
         controller.reconcile(num_replicas)
         gateway = InferenceGateway()
         port = gateway.start()
-        info = {"endpoint": name, "gateway_port": port,
+        scaler = None
+        if autoscale_policy is not None:
+            from .device_model_deployment import AutoscaleReconciler
+            scaler = AutoscaleReconciler(name, controller, autoscale_policy,
+                                         interval_s=autoscale_interval_s)
+            scaler.start()
+        info = {"endpoint": name, "gateway_port": port, "mode": mode,
                 "replicas": controller.current_replicas}
         self._deployments[name] = {"controller": controller,
-                                   "gateway": gateway, "info": info}
+                                   "gateway": gateway, "info": info,
+                                   "scaler": scaler}
         return info
 
     def undeploy(self, name: str) -> bool:
         dep = self._deployments.pop(name, None)
         if dep is None:
             return False
+        if dep.get("scaler") is not None:
+            dep["scaler"].stop()
         dep["gateway"].stop()
         dep["controller"].stop_all()
         return True

@@ -1,0 +1,75 @@
+"""Inference worker process entry — the reference's deployed container
+(``device_model_deployment.py:68`` launches a Docker inference image; here a
+worker is a plain OS process serving a packaged predictor — the right unit
+for a single-host TPU serving plane, same lifecycle: unpack → import →
+serve → readiness-probed by the deployer).
+
+    python -m ...model_scheduler.worker_main \
+        --package model.zip --port-file /tmp/w0.port
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+import zipfile
+
+
+def load_predictor(package: str):
+    """Unpack (if zipped) and instantiate the packaged predictor."""
+    if os.path.isfile(package):
+        dest = tempfile.mkdtemp(prefix="fedml_worker_pkg_")
+        with zipfile.ZipFile(package) as z:
+            z.extractall(dest)
+        package = dest
+    card_path = os.path.join(package, "card.json")
+    with open(card_path) as f:
+        card = json.load(f)
+    entry = card.get("predictor_entry") or ""
+    if ":" not in entry:
+        raise ValueError(f"card {card.get('name')!r} has no predictor_entry")
+    sys.path.insert(0, package)  # packaged modules resolve first
+    mod_name, attr = entry.split(":", 1)
+    factory = getattr(importlib.import_module(mod_name), attr)
+    return factory(), card
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--package", required=True,
+                    help="model package zip or unpacked card dir")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--port-file", default="",
+                    help="write the bound port here once serving")
+    opts = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from ....serving.fedml_inference_runner import FedMLInferenceRunner
+
+    predictor, card = load_predictor(opts.package)
+    runner = FedMLInferenceRunner(predictor, host=opts.host, port=opts.port)
+    port = runner.start()
+    logging.info("worker serving %s on %s:%d (pid %d)",
+                 card.get("name"), opts.host, port, os.getpid())
+    if opts.port_file:
+        tmp = opts.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, opts.port_file)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        runner.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,8 +23,10 @@ log = logging.getLogger(__name__)
 
 
 class FedMLInferenceRunner:
-    def __init__(self, client_predictor: FedMLPredictor, host: str = "0.0.0.0",
-                 port: int = 2345):
+    def __init__(self, client_predictor: FedMLPredictor,
+                 host: str = "127.0.0.1", port: int = 2345):
+        # loopback by default: the endpoint is unauthenticated; external
+        # exposure requires an explicit host="0.0.0.0"
         self.client_predictor = client_predictor
         self.host = host
         self.port = port

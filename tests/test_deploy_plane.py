@@ -98,3 +98,69 @@ def test_autoscaler_policies():
                          target_value=1000.0)
     cache2.record_request("warm", 0.05, ts=now)
     assert scaler2.scale_operation_endpoint(pr2, "warm") == 4
+
+
+def test_process_worker_deploy_e2e(tmp_path):
+    """VERDICT r1 #8 'done' criterion: deploy REAL worker processes from a
+    packaged card -> query through the gateway -> autoscaler scales up
+    under synthetic load -> undeploy kills the workers."""
+    import os
+    import signal
+    import time
+    from fedml_tpu.computing.scheduler.model_scheduler.device_model_cards \
+        import FedMLModelCards
+
+    cards = FedMLModelCards(home=str(tmp_path / "cards"))
+    # the packaged predictor module travels INSIDE the card package
+    predictor_src = tmp_path / "my_predictor.py"
+    predictor_src.write_text(
+        "from fedml_tpu.serving.fedml_predictor import FedMLPredictor\n"
+        "class P(FedMLPredictor):\n"
+        "    def predict(self, request):\n"
+        "        return {'pid': __import__('os').getpid(),\n"
+        "                'y': [v + 1 for v in request.get('x', [])]}\n"
+        "def make():\n"
+        "    return P()\n")
+    cards.create_model("epproc", predictor_entry="my_predictor:make")
+    cards.add_model_files("epproc", str(predictor_src))
+
+    from fedml_tpu.computing.scheduler.model_scheduler.autoscaler.policies \
+        import ReactivePolicy
+    policy = ReactivePolicy(min_replicas=1, max_replicas=3, metric="qps",
+                            target_value=5.0, scaledown_delay_secs=1000.0,
+                            release_replica_after_idle_secs=1000.0)
+    info = cards.deploy("epproc", num_replicas=1, mode="process",
+                        autoscale_policy=policy, autoscale_interval_s=0.3)
+    try:
+        port = info["gateway_port"]
+        url = f"http://127.0.0.1:{port}/api/v1/predict/epproc"
+        out = _post(url, {"x": [1, 2, 3]})
+        assert out["result"]["y"] == [2, 3, 4]
+        worker_pid = out["result"]["pid"]
+        assert worker_pid != os.getpid()          # really another process
+        os.kill(worker_pid, 0)                    # and it is alive
+
+        # synthetic load: qps >> target -> autoscaler must scale up
+        dep = cards._deployments["epproc"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            for _ in range(10):
+                _post(url, {"x": [0]})
+            if dep["controller"].current_replicas >= 2:
+                break
+        assert dep["controller"].current_replicas >= 2, "never scaled up"
+        # traffic spreads across worker processes
+        pids = {_post(url, {"x": [0]})["result"]["pid"] for _ in range(8)}
+        assert len(pids) >= 2
+
+        all_pids = list(pids) + [worker_pid]
+    finally:
+        assert cards.undeploy("epproc")
+    # workers are gone after undeploy
+    time.sleep(0.3)
+    for pid in set(all_pids):
+        try:
+            os.kill(pid, 0)
+            assert False, f"worker {pid} survived undeploy"
+        except ProcessLookupError:
+            pass

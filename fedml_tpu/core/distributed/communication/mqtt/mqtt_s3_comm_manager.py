@@ -57,7 +57,13 @@ class MqttS3CommManager(BaseCommunicationManager):
         self._client.on_message = self._on_message
         self._client.connect(cfg.get("host", "127.0.0.1"),
                              int(cfg.get("port", 1883)), keepalive=60)
-        self._client.subscribe(self._topic("+", self.rank), qos=2)
+        # one explicit subscription per peer (reference
+        # mqtt_s3_multi_clients_comm_manager subscribes per sender): the
+        # underscore topic scheme has no '/' levels, so an MQTT '+' wildcard
+        # cannot match inside it
+        for sender in range(self.size):
+            if sender != self.rank:
+                self._client.subscribe(self._topic(sender, self.rank), qos=2)
 
     def _topic(self, sender, receiver) -> str:
         return f"fedml_{self.run_id}_{sender}_{receiver}"

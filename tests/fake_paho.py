@@ -1,0 +1,145 @@
+"""In-memory paho-mqtt stand-in so the REAL MqttS3CommManager code paths
+(topic naming, wildcard subscribe, qos flags, last-will, control/data
+split) execute in-image where no broker or paho exists.
+
+Implements the slice of ``paho.mqtt.client.Client`` the manager uses:
+connect/subscribe/publish/on_message/will_set/loop_start/loop_stop/
+disconnect, over a process-global broker with MQTT ``+`` wildcard matching.
+A client that drops without ``disconnect()`` (``kill()``) has its last-will
+published, matching broker behavior."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class _Broker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.subs: List[Tuple[str, "Client"]] = []
+        self.retained: Dict[str, bytes] = {}
+        self.messages: List[Tuple[str, bytes, int]] = []  # audit log
+
+    @staticmethod
+    def _matches(pattern: str, topic: str) -> bool:
+        pp, tp = pattern.split("/"), topic.split("/")
+        if len(pp) != len(tp) and "#" not in pattern:
+            return False
+        for p, t in zip(pp, tp):
+            if p == "#":
+                return True
+            if p != "+" and p != t:
+                return False
+        return len(pp) == len(tp)
+
+    def publish(self, topic: str, payload: bytes, qos: int,
+                retain: bool = False):
+        with self.lock:
+            self.messages.append((topic, payload, qos))
+            if retain:
+                self.retained[topic] = payload
+            targets = [c for pat, c in self.subs if self._matches(pat, topic)]
+        for c in targets:
+            c._deliver(topic, payload, qos)
+
+    def subscribe(self, pattern: str, client: "Client"):
+        with self.lock:
+            self.subs.append((pattern, client))
+            retained = [(t, p) for t, p in self.retained.items()
+                        if self._matches(pattern, t)]
+        for t, p in retained:
+            client._deliver(t, p, 0)
+
+    def drop(self, client: "Client", abnormal: bool):
+        with self.lock:
+            self.subs = [(pat, c) for pat, c in self.subs if c is not client]
+            will = client._will if abnormal else None
+        if will is not None:
+            self.publish(*will)
+
+
+BROKER = _Broker()
+
+
+class MQTTMessage:
+    def __init__(self, topic: str, payload: bytes, qos: int):
+        self.topic = topic
+        self.payload = payload
+        self.qos = qos
+
+
+class Client:
+    def __init__(self, client_id: str = "", clean_session: bool = True,
+                 **kw):
+        self.client_id = client_id
+        self.clean_session = clean_session
+        self.on_message = None
+        self.on_connect = None
+        self.on_disconnect = None
+        self._will: Optional[Tuple[str, bytes, int, bool]] = None
+        self.connected = False
+
+    # -- paho surface ------------------------------------------------------
+    def username_pw_set(self, user, password=""):
+        self._auth = (user, password)
+
+    def will_set(self, topic, payload=None, qos=0, retain=False):
+        data = payload.encode() if isinstance(payload, str) else payload
+        self._will = (topic, data, qos, retain)
+
+    def connect(self, host, port=1883, keepalive=60):
+        self.connected = True
+        if self.on_connect:
+            self.on_connect(self, None, {}, 0)
+        return 0
+
+    def subscribe(self, topic, qos=0):
+        BROKER.subscribe(topic, self)
+        return (0, 1)
+
+    def publish(self, topic, payload=None, qos=0, retain=False):
+        data = payload.encode() if isinstance(payload, str) else payload
+        BROKER.publish(topic, data, qos, retain)
+        return type("MI", (), {"rc": 0})()
+
+    def loop_start(self):
+        pass
+
+    def loop_stop(self):
+        pass
+
+    def disconnect(self):
+        self.connected = False
+        BROKER.drop(self, abnormal=False)
+        if self.on_disconnect:
+            self.on_disconnect(self, None, 0)
+
+    # -- test helpers ------------------------------------------------------
+    def kill(self):
+        """Abnormal drop: broker publishes the last-will."""
+        self.connected = False
+        BROKER.drop(self, abnormal=True)
+
+    def _deliver(self, topic, payload, qos):
+        if self.on_message is not None:
+            self.on_message(self, None, MQTTMessage(topic, payload, qos))
+
+
+def install(monkeypatch=None):
+    """Register this module as ``paho.mqtt.client`` in sys.modules."""
+    import sys
+    import types
+
+    paho = types.ModuleType("paho")
+    mqtt = types.ModuleType("paho.mqtt")
+    client_mod = sys.modules[__name__]
+    paho.mqtt = mqtt
+    mqtt.client = client_mod
+    mods = {"paho": paho, "paho.mqtt": mqtt, "paho.mqtt.client": client_mod}
+    if monkeypatch is not None:
+        for k, v in mods.items():
+            monkeypatch.setitem(sys.modules, k, v)
+    else:
+        sys.modules.update(mods)
+    return client_mod

@@ -130,3 +130,58 @@ def test_edge_client_process_federation(tmp_path):
     xs = centers + 0.0
     logits = xs @ final["w1"] + final["b1"]
     assert (logits.argmax(axis=1) == np.arange(classes)).all()
+
+
+def test_torch_model_edge_bundle_roundtrip(tmp_path):
+    """Reference model_hub.py:81-88 writes .mnn artifacts for edge clients;
+    here the artifact is the edge bundle: a torch-trained LR exports through
+    the engine adapter into a bundle, the C++ trainer fine-tunes it, and the
+    result imports back into torch with the loss actually improved."""
+    import numpy as np
+    import torch
+    import torch.nn as nn
+    from fedml_tpu.ml.engine.ml_engine_adapter import (
+        pytree_to_torch_state_dict, torch_state_dict_to_pytree)
+    from fedml_tpu.native.edge_bundle import read_bundle, write_bundle
+    from fedml_tpu.native.edge_trainer import FedMLClientManager
+
+    d, classes = 12, 4
+    rng = np.random.default_rng(0)
+    centers = rng.normal(0, 2.0, (classes, d)).astype(np.float32)
+    y = rng.integers(0, classes, 400)
+    x = (centers[y] + rng.normal(0, 0.4, (400, d))).astype(np.float32)
+
+    # torch side: brief pre-train
+    m = nn.Linear(d, classes)
+    opt = torch.optim.SGD(m.parameters(), lr=0.05)
+    crit = nn.CrossEntropyLoss()
+    xt, yt = torch.from_numpy(x), torch.from_numpy(y)
+    for _ in range(5):
+        opt.zero_grad()
+        loss = crit(m(xt), yt)
+        loss.backward()
+        opt.step()
+    loss_before = float(crit(m(xt), yt))
+
+    # export: torch state_dict -> pytree -> edge bundle (w1/b1 layout)
+    tree = torch_state_dict_to_pytree(m.state_dict())
+    w = np.asarray(tree["kernel"], np.float32)   # (in, out) after transpose
+    b = np.asarray(tree["bias"], np.float32)
+    bundle_path = tmp_path / "lr.fteb"
+    write_bundle(str(bundle_path), {"w1": w, "b1": b})
+
+    # edge side: native C++ fine-tune
+    mgr = FedMLClientManager()
+    mgr.init({"w1": w, "b1": b}, x, y, batch_size=32, lr=0.05)
+    mgr.train(epochs=8, seed=1)
+    trained = mgr.get_model()
+
+    # import back into torch
+    sd = pytree_to_torch_state_dict(
+        {"kernel": trained["w1"], "bias": trained["b1"]})
+    m2 = nn.Linear(d, classes)
+    m2.load_state_dict(sd)
+    loss_after = float(crit(m2(xt), yt))
+    assert loss_after < loss_before, (loss_before, loss_after)
+    acc = float((m2(xt).argmax(1) == yt).float().mean())
+    assert acc > 0.9, acc

@@ -1,0 +1,116 @@
+"""Bisect the TPU-only bf16 gradient NaN (round-2 finding: full-model
+grads are NaN on the tunnel TPU in bf16 for BOTH attention impls, while CPU
+bf16 and TPU f32 are clean — see BASELINE.md round-2 notes).
+
+Run on a healthy TPU:  python tools/tpu_nan_bisect.py
+
+Each ablation builds a 1-layer model variant and reports whether grads wrt
+params contain NaN.  The first ablation that flips clean → NaN names the op.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, ".")
+
+from fedml_tpu.llm.model import (Attention, LlamaConfig, MLP,  # noqa: E402
+                                 RMSNorm, _rope, causal_nll)
+
+CFG = LlamaConfig(vocab_size=8192, dim=512, n_layers=1, n_heads=8,
+                  n_kv_heads=4, ffn_dim=1408, max_seq_len=512,
+                  dtype=jnp.bfloat16, lora_rank=0, attn_impl="blockwise")
+B, S = 2, 512
+
+
+class BlockVariant(nn.Module):
+    cfg: LlamaConfig
+    use_attn: bool = True
+    use_mlp: bool = True
+    use_norm: bool = True
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        norm = (lambda name: RMSNorm(cfg.norm_eps, name=name)) if \
+            self.use_norm else (lambda name: (lambda v: v))
+        if self.use_attn:
+            x = x + Attention(cfg, name="attention")(norm("n1")(x), positions)
+        if self.use_mlp:
+            x = x + MLP(cfg, name="mlp")(norm("n2")(x))
+        return x
+
+
+class Variant(nn.Module):
+    cfg: LlamaConfig
+    use_attn: bool = True
+    use_mlp: bool = True
+    use_norm: bool = True
+    use_remat: bool = False
+    use_embed: bool = True
+    fp32_head: bool = True
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        if self.use_embed:
+            x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         name="tok_embed")(tokens)
+        else:
+            x = jax.nn.one_hot(tokens % cfg.dim, cfg.dim, dtype=cfg.dtype)
+        positions = jnp.arange(tokens.shape[-1])
+        block_cls = nn.remat(BlockVariant) if self.use_remat else BlockVariant
+        x = block_cls(cfg, self.use_attn, self.use_mlp, self.use_norm,
+                      name="block")(x, positions)
+        if self.use_norm:
+            x = RMSNorm(cfg.norm_eps, name="nf")(x)
+        head_dtype = jnp.float32 if self.fp32_head else cfg.dtype
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=head_dtype,
+                        name="lm_head")(x)
+
+
+def grads_nan(**kw) -> bool:
+    model = Variant(CFG, **kw)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (B, S), 0, CFG.vocab_size)
+    params = model.init(rng, tokens)["params"]
+
+    def loss_fn(p):
+        logits = model.apply({"params": p}, tokens)
+        return causal_nll(logits[:, :-1], tokens[:, 1:])
+
+    loss, g = jax.jit(jax.value_and_grad(loss_fn))(params)
+    gn = float(optax.global_norm(g))
+    return (not np.isfinite(gn)), float(loss), gn
+
+
+def main():
+    print("backend:", jax.default_backend())
+    cases = [
+        ("full (attn+mlp+norm+remat)", dict(use_remat=True)),
+        ("no remat", dict(use_remat=False)),
+        ("attn only", dict(use_mlp=False)),
+        ("mlp only", dict(use_attn=False)),
+        ("attn, no norm", dict(use_mlp=False, use_norm=False)),
+        ("mlp, no norm", dict(use_attn=False, use_norm=False)),
+        ("no embed (one-hot input)", dict(use_embed=False)),
+        ("bf16 head", dict(fp32_head=False)),
+        ("norm+head only", dict(use_attn=False, use_mlp=False)),
+    ]
+    for name, kw in cases:
+        try:
+            bad, loss, gn = grads_nan(**kw)
+            print(f"{name:34s} loss={loss:9.4f} gnorm={gn:12.4f} "
+                  f"{'*** NaN ***' if bad else 'ok'}")
+        except Exception as e:
+            print(f"{name:34s} ERROR {e}")
+
+
+if __name__ == "__main__":
+    main()

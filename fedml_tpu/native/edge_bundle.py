@@ -45,3 +45,54 @@ def read_bundle(path: str) -> Dict[str, np.ndarray]:
             data = np.frombuffer(f.read(4 * n), dtype=np.float32).reshape(dims)
             out[name] = data.copy()
     return out
+
+
+def flax_to_edge_model(params) -> Dict[str, np.ndarray]:
+    """Flatten a dense-stack flax param tree (LR / MLP — the edge model
+    class, reference mnn_lenet/LR) into the w1/b1[,w2/b2] bundle layout the
+    C++ trainer consumes.  Dense layers are taken in traversal order."""
+    import jax
+
+    kernels, biases = [], []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        names = [getattr(p, "key", str(p)) for p in path]
+        arr = np.asarray(leaf, np.float32)
+        if names[-1] == "kernel":
+            if arr.ndim != 2:
+                raise ValueError(
+                    f"edge export supports dense stacks only; {names} has "
+                    f"shape {arr.shape}")
+            kernels.append(arr)
+        elif names[-1] == "bias":
+            biases.append(arr)
+    if not kernels or len(kernels) != len(biases) or len(kernels) > 2:
+        raise ValueError(
+            f"edge export needs 1-2 dense layers, got {len(kernels)} "
+            f"kernels / {len(biases)} biases")
+    out: Dict[str, np.ndarray] = {}
+    for i, (k, b) in enumerate(zip(kernels, biases), start=1):
+        out[f"w{i}"] = k
+        out[f"b{i}"] = b
+    return out
+
+
+def edge_model_to_flax(bundle: Dict[str, np.ndarray], template):
+    """Inverse of :func:`flax_to_edge_model`: pour w/b arrays back into a
+    param tree with the template's structure."""
+    import jax
+
+    counters = {"kernel": 0, "bias": 0}
+
+    def fill(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        kind = names[-1]
+        if kind not in counters:
+            return leaf
+        counters[kind] += 1
+        key = ("w" if kind == "kernel" else "b") + str(counters[kind])
+        arr = np.asarray(bundle[key], np.float32)
+        if arr.shape != leaf.shape:
+            raise ValueError(f"{key} shape {arr.shape} != {leaf.shape}")
+        return arr
+
+    return jax.tree_util.tree_map_with_path(fill, template)

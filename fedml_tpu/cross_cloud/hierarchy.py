@@ -1,0 +1,188 @@
+"""Two-level cross-cloud federation ("Cheetah", reference
+``python/fedml/cross_cloud/``): each cloud runs an intra-cloud federation
+over its fast regional transport, and the clouds federate with a global
+coordinator over the DCN-grade plane.
+
+The reference's cross_cloud managers are near-copies of cross_silo; the
+real multi-cloud structure — regional partial aggregation, one summary per
+cloud over the WAN, global merge, fan-out back down — exists here as an
+explicit hierarchy (the message analog of the two-level ``psum`` the
+simulators use for hierarchical FL, SURVEY §2.9):
+
+- :class:`GlobalCoordinator` (global rank 0): collects one weighted partial
+  per cloud per round, merges, syncs the new global model down.
+- :class:`CloudBridgeManager` (global rank = cloud index; regional rank 0):
+  a cross-silo server toward its own clients whose round close forwards the
+  cloud's weighted partial upward INSTEAD of finishing locally; the global
+  sync resumes the regional round loop.
+
+Wire efficiency: per round, each cloud sends exactly one model-sized
+message over the DCN plane regardless of its client count.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..core import tree as tree_util
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import (FedMLCommManager,
+                                                   create_comm_backend)
+from ..cross_silo.server import FedMLAggregator, FedMLServerManager
+from ..cross_silo.message_define import MyMessage
+
+log = logging.getLogger(__name__)
+
+
+class CloudMsg:
+    """Global-plane message types (disjoint from MyMessage's range)."""
+    MSG_TYPE_CLOUD_PARTIAL = 501     # bridge -> coordinator
+    MSG_TYPE_GLOBAL_SYNC = 502       # coordinator -> bridges
+    MSG_TYPE_GLOBAL_FINISH = 503
+
+    ARG_PARTIAL = "cloud_partial_params"   # weighted SUM of client params
+    ARG_WEIGHT = "cloud_weight_sum"
+    ARG_ROUND = "cloud_round_idx"
+    ARG_MODEL = "global_model_params"
+
+
+class GlobalCoordinator(FedMLCommManager):
+    """Global rank 0: one partial per cloud per round → weighted merge →
+    sync down; ``comm_round`` rounds then FINISH."""
+
+    def __init__(self, args, init_params, n_clouds: int, comm=None,
+                 backend: str = "GRPC"):
+        super().__init__(args, comm, rank=0, size=n_clouds + 1,
+                         backend=backend)
+        self.params = init_params
+        self.n_clouds = int(n_clouds)
+        self.round_num = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+        self._partials = {}
+        self._lock = threading.Lock()
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            CloudMsg.MSG_TYPE_CLOUD_PARTIAL, self._on_partial)
+
+    def _on_partial(self, msg):
+        sender = msg.get_sender_id()
+        rnd = int(msg.get(CloudMsg.ARG_ROUND))
+        with self._lock:
+            if rnd != self.round_idx:
+                log.warning("coordinator: stale round-%d partial from "
+                            "cloud %d (now %d)", rnd, sender, self.round_idx)
+                return
+            self._partials[sender] = (
+                float(msg.get(CloudMsg.ARG_WEIGHT)),
+                msg.get(CloudMsg.ARG_PARTIAL))
+            if len(self._partials) < self.n_clouds:
+                return
+            partials = list(self._partials.values())
+            self._partials = {}
+        total = sum(w for w, _ in partials)
+        acc = None
+        for w, p in partials:
+            acc = p if acc is None else tree_util.tree_add(acc, p)
+        self.params = tree_util.tree_scale(acc, 1.0 / max(total, 1e-12))
+        self.round_idx += 1
+        log.info("coordinator: merged round %d from %d clouds "
+                 "(weight %.1f)", self.round_idx - 1, len(partials), total)
+        mtype = (CloudMsg.MSG_TYPE_GLOBAL_FINISH
+                 if self.round_idx >= self.round_num
+                 else CloudMsg.MSG_TYPE_GLOBAL_SYNC)
+        for cloud in range(1, self.n_clouds + 1):
+            out = Message(mtype, self.rank, cloud)
+            out.add_params(CloudMsg.ARG_MODEL, self.params)
+            out.add_params(CloudMsg.ARG_ROUND, self.round_idx)
+            self.send_message(out)
+        if mtype == CloudMsg.MSG_TYPE_GLOBAL_FINISH:
+            self.finish()
+
+
+class CloudBridgeManager(FedMLServerManager):
+    """Regional server whose round close escalates to the global plane.
+
+    Overrides ``_finish_round``: compute the cloud's weighted partial
+    (Σ wᵢ·paramsᵢ, Σ wᵢ) from the buffered client uploads and send it to
+    the coordinator; the GLOBAL_SYNC reply installs the merged model and
+    opens the next regional round.  Trust-stack hooks (defense/DP) still
+    run at the global merge semantics' edges via the regional aggregator's
+    hook pipeline on the buffered list.
+    """
+
+    def __init__(self, args, aggregator: FedMLAggregator, cloud_rank: int,
+                 n_clouds: int, regional_backend: str = "local",
+                 global_backend: str = "GRPC", global_args=None,
+                 comm=None, size: int = 0):
+        super().__init__(args, aggregator, comm=comm, rank=0, size=size,
+                         backend=regional_backend)
+        self.cloud_rank = int(cloud_rank)        # global-plane rank (1-based)
+        gargs = global_args if global_args is not None else args
+        self._global = create_comm_backend(gargs, self.cloud_rank,
+                                           n_clouds + 1, global_backend)
+
+        class _Obs:
+            def __init__(self, outer):
+                self.outer = outer
+
+            def receive_message(self, mtype, msg):
+                if mtype == CloudMsg.MSG_TYPE_GLOBAL_SYNC:
+                    self.outer._on_global_sync(msg, finish=False)
+                elif mtype == CloudMsg.MSG_TYPE_GLOBAL_FINISH:
+                    self.outer._on_global_sync(msg, finish=True)
+
+        self._global.add_observer(_Obs(self))
+        self._global_thread = threading.Thread(
+            target=self._global.handle_receive_message,
+            name=f"cloud{self.cloud_rank}-global", daemon=True)
+        self._global_thread.start()
+
+    # -- round close: escalate instead of finishing -------------------------
+    def _finish_round(self):
+        agg = self.aggregator
+        weights, partial = [], None
+        for i in sorted(agg.model_dict):
+            w = float(agg.sample_num_dict[i])
+            scaled = tree_util.tree_scale(agg.model_dict[i], w)
+            partial = scaled if partial is None else tree_util.tree_add(
+                partial, scaled)
+            weights.append(w)
+        agg.reset_receive_flags()
+        msg = Message(CloudMsg.MSG_TYPE_CLOUD_PARTIAL, self.cloud_rank, 0)
+        msg.add_params(CloudMsg.ARG_PARTIAL, partial)
+        msg.add_params(CloudMsg.ARG_WEIGHT, float(sum(weights)))
+        msg.add_params(CloudMsg.ARG_ROUND, self.args.round_idx)
+        self._global.send_message(msg)
+        log.info("cloud %d: escalated round %d partial (%d clients, "
+                 "weight %.1f)", self.cloud_rank, self.args.round_idx,
+                 len(weights), sum(weights))
+
+    def _on_global_sync(self, msg, finish: bool):
+        params = msg.get(CloudMsg.ARG_MODEL)
+        with self._round_lock:
+            self.aggregator.set_global_model_params(params)
+            self.args.round_idx = int(msg.get(CloudMsg.ARG_ROUND))
+            if finish:
+                self.send_finish()
+                try:
+                    self._global.stop_receive_message()
+                except Exception:
+                    pass
+                return
+            client_idxs = self._sampled_client_idxs(self.args.round_idx)
+            for rank, data_idx in zip(self.client_real_ids, client_idxs):
+                out = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              self.rank, rank)
+                out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, params)
+                out.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                               int(data_idx))
+                out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                               self.args.round_idx)
+                self.send_message(out)
+            self._arm_round_timer()
+
+
+__all__ = ["CloudMsg", "GlobalCoordinator", "CloudBridgeManager"]

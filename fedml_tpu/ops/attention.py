@@ -89,11 +89,13 @@ def blockwise_attention(q, k, v, causal: bool = True,
 
 
 # -- Pallas TPU forward kernel ------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_ref, l_ref, acc_ref, *,
                       block_q: int, block_k: int, sm_scale: float,
                       causal: bool, seq_k: int):
     """Grid: (batch*heads, q_blocks, k_blocks); k innermost ("arbitrary").
-    Scratch m/l/acc persist across the k dimension for one (bh, qi) pair."""
+    Scratch m/l/acc persist across the k dimension for one (bh, qi) pair.
+    Also emits the per-row logsumexp (m + log l) for the backward pass."""
     import jax.experimental.pallas as pl
 
     kj = pl.program_id(2)
@@ -116,8 +118,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(live)
     def _compute():
         q = q_ref[0]                                # (block_q, d)
-        k = k_ref[0]                                # (block_k, d)
-        v = v_ref[0]
+        # OOB rows of a partially-out-of-bounds block are undefined (NaN in
+        # interpret mode): zero them, else 0·NaN poisons the contractions
+        kv_rows = (kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < seq_k
+        k = jnp.where(kv_rows, k_ref[0], 0.0)       # (block_k, d)
+        v = jnp.where(kv_rows, v_ref[0], 0.0)
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -139,14 +145,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(kj == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:], 1e-30)[:, None]).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
 def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
                                sm_scale: Optional[float] = None,
-                               block_q: int = 512, block_k: int = 512):
-    """q, k, v: (B, H, S, D) → (B, H, S, D).  TPU-only."""
+                               block_q: int = 512, block_k: int = 512,
+                               return_lse: bool = False,
+                               interpret: bool = False):
+    """q, k, v: (B, H, S, D) → (B, H, S, D) [+ logsumexp (B, H, S)]."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -166,7 +175,7 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
         _flash_fwd_kernel, block_q=block_q, block_k=block_k,
         sm_scale=float(sm_scale), causal=causal, seq_k=s_k)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -174,8 +183,14 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -183,16 +198,203 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s_q, d)
+    out = out.reshape(b, h, s_q, d)
+    if return_lse:
+        return out, lse.reshape(b, h, s_q)
+    return out
+
+
+# -- Pallas TPU backward kernels ---------------------------------------------
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, block_q: int, block_k: int,
+                         sm_scale: float, causal: bool, seq_k: int):
+    """dQ pass.  Grid: (bh, q_blocks, k_blocks), k innermost; dq accumulates
+    in scratch across k for one (bh, qi)."""
+    import jax.experimental.pallas as pl
+
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        live = kj * block_k <= qi * block_q + block_q - 1
+    else:
+        live = kj >= 0
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        kv_rows = (kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < seq_k
+        k = jnp.where(kv_rows, k_ref[0], 0.0)
+        v = jnp.where(kv_rows, v_ref[0], 0.0)
+        do = do_ref[0]
+        lse = lse_ref[0]                            # (block_q,)
+        delta = delta_ref[0]                        # (block_q,)
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kv_pos < seq_k
+        if causal:
+            mask = mask & (kv_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(scores - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_acc[:] += jnp.dot(ds.astype(k.dtype), k,
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, sm_scale: float, causal: bool,
+                          seq_k: int, seq_q: int):
+    """dK/dV pass.  Grid: (bh, k_blocks, q_blocks), q innermost; dk/dv
+    accumulate in scratch across q for one (bh, kj)."""
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+    kj = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        # q blocks strictly above the diagonal band see none of this k block
+        live = qi * block_q + block_q - 1 >= kj * block_k
+    else:
+        live = qi >= 0
+
+    @pl.when(live)
+    def _compute():
+        q_rows = (qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)) < seq_q
+        q = jnp.where(q_rows, q_ref[0], 0.0)
+        do = jnp.where(q_rows, do_ref[0], 0.0)
+        lse = jnp.where(q_rows[:, 0], lse_ref[0], 0.0)
+        delta = jnp.where(q_rows[:, 0], delta_ref[0], 0.0)
+        kv_rows = (kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < seq_k
+        k = jnp.where(kv_rows, k_ref[0], 0.0)
+        v = jnp.where(kv_rows, v_ref[0], 0.0)
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kv_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # padded q rows (q_pos >= seq_q) would pollute the dk/dv sums with
+        # whatever the out-of-bounds q/do/lse blocks contain — mask them
+        mask = (kv_pos < seq_k) & (q_pos < seq_q)
+        if causal:
+            mask = mask & (kv_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(scores - lse[:, None]), 0.0)
+        dv_acc[:] += jnp.dot(p.astype(do.dtype).T, do,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc[:] += jnp.dot(ds.astype(q.dtype).T, q,
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
+                               sm_scale: Optional[float] = None,
+                               block_q: int = 512, block_k: int = 512,
+                               interpret: bool = False):
+    """Flash-attention backward: (dq, dk, dv), no S×S materialization and no
+    forward recompute beyond the score blocks (reference capability target:
+    the HF flash-attn patch at ``train/llm/models/attention.py:30``)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    qr = q.reshape(b * h, s_q, d)
+    kr = k.reshape(b * h, s_k, d)
+    vr = v.reshape(b * h, s_k, d)
+    dor = do.reshape(b * h, s_q, d)
+    lser = lse.reshape(b * h, s_q)
+    # delta = rowsum(dO * O) — cheap elementwise, stays in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, s_q)
+    nq = -(-s_q // block_q)
+    nk = -(-s_k // block_k)
+
+    common = dict(block_q=block_q, block_k=block_k, sm_scale=float(sm_scale),
+                  causal=causal, seq_k=s_k)
+    common_kv = dict(common, seq_q=s_q)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    r_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    # dkv pass: grid over k blocks, scan q
+    qs_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    ks_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    rs_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common_kv),
+        grid=(b * h, nk, nq),
+        in_specs=[qs_spec, ks_spec, ks_spec, qs_spec, rs_spec, rs_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+    shape = (b, h, s_q, d)
+    kshape = (b, h, s_k, d)
+    return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
 
 
 # -- public entry with custom vjp --------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None):
-    """Fused attention: Pallas forward on TPU, blockwise-scan semantics
-    everywhere, blockwise VJP backward (no S×S materialization)."""
+    """Fused attention: Pallas forward + Pallas flash backward on TPU
+    (logsumexp saved from the forward, no S×S materialization and no full
+    recompute), blockwise-scan semantics + blockwise VJP everywhere else."""
     return _fa_fwd(q, k, v, causal, sm_scale)[0]
 
 
@@ -205,14 +407,18 @@ def _on_tpu() -> bool:
 
 def _fa_fwd(q, k, v, causal, sm_scale):
     if _on_tpu():
-        out = flash_attention_fwd_pallas(q, k, v, causal, sm_scale)
-    else:
-        out = blockwise_attention(q, k, v, causal, sm_scale)
-    return out, (q, k, v)
+        out, lse = flash_attention_fwd_pallas(q, k, v, causal, sm_scale,
+                                              return_lse=True)
+        return out, (q, k, v, out, lse)
+    out = blockwise_attention(q, k, v, causal, sm_scale)
+    return out, (q, k, v, None, None)
 
 
 def _fa_bwd(causal, sm_scale, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        return flash_attention_bwd_pallas(q, k, v, out, lse, g, causal,
+                                          sm_scale)
     _, vjp = jax.vjp(
         lambda q, k, v: blockwise_attention(q, k, v, causal, sm_scale),
         q, k, v)

@@ -131,6 +131,86 @@ def _try_load_mnist_idx(cache_dir: str):
     return tx, ty.astype(np.int64), vx, vy.astype(np.int64)
 
 
+def _try_load_cifar(cache_dir: str, name: str):
+    """Real CIFAR-10/100 archives in either standard layout (reference
+    ``data/cifar10/data_loader.py`` consumes the python pickle batches):
+
+    - ``cifar-10-batches-py/``: pickled ``data_batch_1..5`` + ``test_batch``
+      dicts with ``data`` (N, 3072) uint8 and ``labels``;
+    - ``cifar-10-batches-bin/``: ``data_batch_*.bin`` rows of
+      ``1 label byte + 3072 pixel bytes`` (``cifar-100-binary``: 2 label
+      bytes, fine label second).
+    """
+    import pickle
+
+    is100 = "100" in name
+    py_dir = os.path.join(cache_dir,
+                          "cifar-100-python" if is100
+                          else "cifar-10-batches-py")
+    if os.path.isdir(py_dir):
+        label_key = b"fine_labels" if is100 else b"labels"
+
+        def read_batches(names):
+            xs, ys = [], []
+            for n in names:
+                p = os.path.join(py_dir, n)
+                if not os.path.exists(p):
+                    continue
+                with open(p, "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                xs.append(np.asarray(d[b"data"], np.uint8))
+                ys.append(np.asarray(d[label_key], np.int64))
+            if not xs:
+                return None, None
+            return np.concatenate(xs), np.concatenate(ys)
+
+        train_names = ["train"] if is100 else [f"data_batch_{i}"
+                                              for i in range(1, 6)]
+        tx, ty = read_batches(train_names)
+        vx, vy = read_batches(["test"] if is100 else ["test_batch"])
+        if tx is None or vx is None:
+            return None
+
+        def to_img(flat):
+            return (flat.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                    .astype(np.float32) / 255.0)
+
+        return to_img(tx), ty, to_img(vx), vy
+
+    bin_dir = os.path.join(cache_dir,
+                           "cifar-100-binary" if is100
+                           else "cifar-10-batches-bin")
+    if os.path.isdir(bin_dir):
+        label_bytes = 2 if is100 else 1
+        row = label_bytes + 3072
+
+        def read_bin(names):
+            xs, ys = [], []
+            for n in names:
+                p = os.path.join(bin_dir, n)
+                if not os.path.exists(p):
+                    continue
+                raw = np.fromfile(p, dtype=np.uint8)
+                raw = raw[: (len(raw) // row) * row].reshape(-1, row)
+                ys.append(raw[:, label_bytes - 1].astype(np.int64))
+                xs.append(raw[:, label_bytes:])
+            if not xs:
+                return None, None
+            x = np.concatenate(xs)
+            x = (x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                 .astype(np.float32) / 255.0)
+            return x, np.concatenate(ys)
+
+        train_names = ["train.bin"] if is100 else \
+            [f"data_batch_{i}.bin" for i in range(1, 6)]
+        tx, ty = read_bin(train_names)
+        vx, vy = read_bin(["test.bin"] if is100 else ["test_batch.bin"])
+        if tx is None or vx is None:
+            return None
+        return tx, ty, vx, vy
+    return None
+
+
 def _try_load_hdf5(cache_dir: str, name: str):
     """ImageNet-style hdf5 (reference ``data/ImageNet/.../imagenet_hdf5`` —
     one file with train/val image+label datasets)."""
@@ -192,6 +272,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         real = _try_load_npz(cache, name) if cache else None
         if real is None and name in ("mnist", "synthetic_mnist") and cache:
             real = _try_load_mnist_idx(cache)
+        if real is None and name.startswith(("cifar", "fed_cifar")) and cache:
+            real = _try_load_cifar(cache, name)
         if real is not None:
             tx, ty, vx, vy = real
         else:

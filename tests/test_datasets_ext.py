@@ -214,3 +214,61 @@ def test_digits_real_data_learns():
         api.train_one_round(r)
     _, acc1 = api.evaluate()
     assert acc1 > max(acc0 + 0.3, 0.7), (acc0, acc1)
+
+
+def test_cifar10_pickle_and_binary_ingestion(tmp_path):
+    """Round-trip both real CIFAR-10 archive layouts (reference
+    data/cifar10/data_loader.py consumes the python pickle batches)."""
+    import pickle
+    import numpy as np
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod
+
+    rng = np.random.default_rng(0)
+
+    def fake_batch(n):
+        return (rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                rng.integers(0, 10, (n,)).tolist())
+
+    # pickle layout
+    py = tmp_path / "py" / "cifar-10-batches-py"
+    py.mkdir(parents=True)
+    first_pixels = None
+    for i in range(1, 6):
+        data, labels = fake_batch(20)
+        if i == 1:
+            first_pixels = data[0]
+        with open(py / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+    data, labels = fake_batch(10)
+    with open(py / "test_batch", "wb") as f:
+        pickle.dump({b"data": data, b"labels": labels}, f)
+
+    args = load_arguments()
+    args.update(dataset="cifar10", data_cache_dir=str(tmp_path / "py"),
+                client_num_in_total=4, random_seed=0)
+    ds, classes = data_mod.load(args)
+    assert classes == 10
+    assert ds.train_x.shape == (100, 32, 32, 3)
+    assert ds.test_x.shape == (10, 32, 32, 3)
+    # channel-major 3072 -> HWC decode
+    np.testing.assert_allclose(
+        ds.train_x[0] * 255.0,
+        first_pixels.reshape(3, 32, 32).transpose(1, 2, 0), atol=1e-4)
+
+    # binary layout
+    bn = tmp_path / "bin" / "cifar-10-batches-bin"
+    bn.mkdir(parents=True)
+    for i in range(1, 6):
+        data, labels = fake_batch(15)
+        rows = np.concatenate(
+            [np.asarray(labels, np.uint8)[:, None], data], axis=1)
+        rows.tofile(bn / f"data_batch_{i}.bin")
+    data, labels = fake_batch(5)
+    np.concatenate([np.asarray(labels, np.uint8)[:, None], data],
+                   axis=1).tofile(bn / "test_batch.bin")
+    args.update(data_cache_dir=str(tmp_path / "bin"))
+    ds2, _ = data_mod.load(args)
+    assert ds2.train_x.shape == (75, 32, 32, 3)
+    assert ds2.test_x.shape == (5, 32, 32, 3)
+    assert ds2.train_y.dtype == np.int64

@@ -1,0 +1,105 @@
+package ai.fedml.edge;
+
+import java.io.File;
+import java.io.FileWriter;
+import java.io.IOException;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.Paths;
+import java.util.Locale;
+
+/**
+ * Edge federation client API (reference: android/fedmlsdk's FedEdgeApi over
+ * MQTT+S3-MNN).  Speaks the shared-directory edge protocol of
+ * fedml_tpu/cross_device/edge_federation.py — the same protocol the C++
+ * standalone client (edge_client_main.cpp) implements, so a Java device and
+ * a native binary are interchangeable cohort members:
+ *
+ *   server:  round_R/global.fteb + round_R/task.txt  (key=value)
+ *   client:  round_R/client_C.fteb + round_R/client_C.done
+ *   server:  finish.txt
+ */
+public final class FedEdgeApi {
+    private final Path workDir;
+    private final int clientId;
+    private final String dataBundle;
+    private final long pollMillis;
+    private volatile boolean stopped = false;
+
+    public FedEdgeApi(String workDir, int clientId, String dataBundle,
+                      long pollMillis) {
+        this.workDir = Paths.get(workDir);
+        this.clientId = clientId;
+        this.dataBundle = dataBundle;
+        this.pollMillis = pollMillis;
+    }
+
+    public void stop() { stopped = true; }
+
+    /** Blocking federation loop: poll rounds, train, upload, until finish. */
+    public void run() throws IOException, InterruptedException {
+        int round = 0;
+        while (!stopped) {
+            if (Files.exists(workDir.resolve("finish.txt"))) {
+                return;
+            }
+            Path rdir = workDir.resolve("round_" + round);
+            Path task = rdir.resolve("task.txt");
+            Path model = rdir.resolve("global.fteb");
+            if (!Files.exists(task) || !Files.exists(model)) {
+                Thread.sleep(pollMillis);
+                continue;
+            }
+            Task t = Task.parse(task);
+            try (NativeEdgeTrainer trainer = new NativeEdgeTrainer(
+                    model.toString(), dataBundle, t.batch, t.lr)) {
+                trainer.train(t.epochs,
+                              t.seed + 1315423911L * clientId + round);
+                Path out = rdir.resolve("client_" + clientId + ".fteb");
+                Path tmp = rdir.resolve("client_" + clientId + ".fteb.tmp");
+                trainer.saveModel(tmp.toString());
+                Files.move(tmp, out);
+                Path doneTmp = rdir.resolve("client_" + clientId
+                                            + ".done.tmp");
+                try (FileWriter w = new FileWriter(doneTmp.toFile())) {
+                    w.write(String.format(Locale.ROOT,
+                            "n_samples=%d%nloss=%f%nepoch=%d%n",
+                            trainer.numSamples(), trainer.loss(),
+                            trainer.epoch()));
+                }
+                Files.move(doneTmp,
+                           rdir.resolve("client_" + clientId + ".done"));
+            }
+            round++;
+        }
+    }
+
+    private static final class Task {
+        int round = -1, epochs = 1, batch = 32;
+        float lr = 0.05f;
+        long seed = 0;
+
+        static Task parse(Path path) throws IOException {
+            Task t = new Task();
+            for (String line : Files.readAllLines(path)) {
+                String[] kv = line.split("=", 2);
+                if (kv.length != 2) continue;
+                switch (kv[0]) {
+                    case "round": t.round = Integer.parseInt(kv[1].trim());
+                        break;
+                    case "epochs": t.epochs = Integer.parseInt(kv[1].trim());
+                        break;
+                    case "batch": t.batch = Integer.parseInt(kv[1].trim());
+                        break;
+                    case "lr": t.lr = Float.parseFloat(kv[1].trim());
+                        break;
+                    case "seed": t.seed = (long) Double.parseDouble(
+                            kv[1].trim());
+                        break;
+                    default: break;
+                }
+            }
+            return t;
+        }
+    }
+}

@@ -1,0 +1,60 @@
+package ai.fedml.edge;
+
+/**
+ * JNI binding over the edge-trainer C ABI (the MobileNN-equivalent core in
+ * fedml_tpu/native/edge_trainer.cpp — same surface the reference exposes
+ * through android/fedmlsdk's native layer).  The underlying ABI is
+ * exercised by the Python/ctypes tests; this class only marshals.
+ */
+public final class NativeEdgeTrainer implements AutoCloseable {
+    static {
+        System.loadLibrary("fedml_edge_jni");
+    }
+
+    private long handle;
+
+    public NativeEdgeTrainer(String modelBundle, String dataBundle,
+                             int batchSize, float lr) {
+        handle = create(modelBundle, dataBundle, batchSize, lr);
+        if (handle == 0) {
+            throw new IllegalStateException("edge trainer init failed");
+        }
+    }
+
+    public void train(int epochs, long seed) {
+        train(handle, epochs, seed);
+    }
+
+    public float loss() { return getLoss(handle); }
+    public int epoch() { return getEpoch(handle); }
+    public long numSamples() { return numSamples(handle); }
+
+    public void saveModel(String path) {
+        if (saveModel(handle, path) != 0) {
+            throw new IllegalStateException("save failed: " + path);
+        }
+    }
+
+    public void stopTraining() { stopTraining(handle); }
+
+    @Override
+    public void close() {
+        if (handle != 0) {
+            destroy(handle);
+            handle = 0;
+        }
+    }
+
+    /** LightSecAgg field masking in-place (sign=+1 mask, -1 unmask). */
+    public static native void lsaMask(long[] data, long seed, int sign);
+
+    private static native long create(String modelPath, String dataPath,
+                                      int batch, float lr);
+    private static native int train(long handle, int epochs, long seed);
+    private static native float getLoss(long handle);
+    private static native int getEpoch(long handle);
+    private static native long numSamples(long handle);
+    private static native int saveModel(long handle, String path);
+    private static native void stopTraining(long handle);
+    private static native void destroy(long handle);
+}

@@ -1,0 +1,100 @@
+// JNI glue for the edge-trainer C ABI (reference: the MobileNN JNI layer
+// behind android/fedmlsdk's Java FedEdgeApi; test coverage for the same ABI
+// comes from the ctypes binding in edge_trainer.py and the edge-client
+// process tests — this file only marshals JNI types onto those calls).
+//
+// Build (needs a JDK for jni.h; none ships in this CI image, so this file
+// is compiled by the Android/desktop toolchain, not tested here):
+//   gcc -shared -fPIC -I"$JAVA_HOME/include" -I"$JAVA_HOME/include/linux" \
+//       fedml_edge_jni.c ../edge_trainer.cpp -lstdc++ -o libfedml_edge_jni.so
+
+#include <jni.h>
+#include <stdlib.h>
+
+// C ABI from edge_trainer.cpp
+extern void* fedml_edge_create(const char* model_path, const char* data_path,
+                               int batch, float lr);
+extern int fedml_edge_train(void* mgr, int epochs, long long seed);
+extern void fedml_edge_get_epoch_and_loss(void* mgr, int* epoch, float* loss);
+extern int fedml_edge_save_model(void* mgr, const char* path);
+extern void fedml_edge_stop_training(void* mgr);
+extern void fedml_edge_destroy(void* mgr);
+extern long long fedml_edge_num_samples(void* mgr);
+extern void fedml_lsa_mask(long long* data, long long n, long long seed,
+                           int sign);
+
+JNIEXPORT jlong JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_create(JNIEnv* env, jclass cls,
+                                            jstring model_path,
+                                            jstring data_path, jint batch,
+                                            jfloat lr) {
+  const char* mp = (*env)->GetStringUTFChars(env, model_path, NULL);
+  const char* dp = (*env)->GetStringUTFChars(env, data_path, NULL);
+  void* mgr = fedml_edge_create(mp, dp, (int)batch, (float)lr);
+  (*env)->ReleaseStringUTFChars(env, model_path, mp);
+  (*env)->ReleaseStringUTFChars(env, data_path, dp);
+  return (jlong)(intptr_t)mgr;
+}
+
+JNIEXPORT jint JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_train(JNIEnv* env, jclass cls,
+                                           jlong handle, jint epochs,
+                                           jlong seed) {
+  return fedml_edge_train((void*)(intptr_t)handle, (int)epochs,
+                          (long long)seed);
+}
+
+JNIEXPORT jfloat JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_getLoss(JNIEnv* env, jclass cls,
+                                             jlong handle) {
+  int epoch = 0;
+  float loss = 0.f;
+  fedml_edge_get_epoch_and_loss((void*)(intptr_t)handle, &epoch, &loss);
+  return loss;
+}
+
+JNIEXPORT jint JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_getEpoch(JNIEnv* env, jclass cls,
+                                              jlong handle) {
+  int epoch = 0;
+  float loss = 0.f;
+  fedml_edge_get_epoch_and_loss((void*)(intptr_t)handle, &epoch, &loss);
+  return epoch;
+}
+
+JNIEXPORT jlong JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_numSamples(JNIEnv* env, jclass cls,
+                                                jlong handle) {
+  return (jlong)fedml_edge_num_samples((void*)(intptr_t)handle);
+}
+
+JNIEXPORT jint JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_saveModel(JNIEnv* env, jclass cls,
+                                               jlong handle, jstring path) {
+  const char* p = (*env)->GetStringUTFChars(env, path, NULL);
+  int rc = fedml_edge_save_model((void*)(intptr_t)handle, p);
+  (*env)->ReleaseStringUTFChars(env, path, p);
+  return rc;
+}
+
+JNIEXPORT void JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_stopTraining(JNIEnv* env, jclass cls,
+                                                  jlong handle) {
+  fedml_edge_stop_training((void*)(intptr_t)handle);
+}
+
+JNIEXPORT void JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_destroy(JNIEnv* env, jclass cls,
+                                             jlong handle) {
+  fedml_edge_destroy((void*)(intptr_t)handle);
+}
+
+JNIEXPORT void JNICALL
+Java_ai_fedml_edge_NativeEdgeTrainer_lsaMask(JNIEnv* env, jclass cls,
+                                             jlongArray data, jlong seed,
+                                             jint sign) {
+  jsize n = (*env)->GetArrayLength(env, data);
+  jlong* buf = (*env)->GetLongArrayElements(env, data, NULL);
+  fedml_lsa_mask((long long*)buf, (long long)n, (long long)seed, (int)sign);
+  (*env)->ReleaseLongArrayElements(env, data, buf, 0);
+}

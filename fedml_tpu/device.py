@@ -96,6 +96,12 @@ def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
 
 
 def get_device(args=None):
+    """Reference ``device/device.py:43`` maps processes→GPUs from YAML
+    ``gpu_util`` specs; here the simulation engines own placement through
+    the mesh, and only MULTI-PROCESS modes (cross-silo/cross-cloud workers
+    sharing one host) need a per-rank pick: rank r gets local device
+    ``r % n`` (round-robin, the reference's default mapping), overridable
+    with an explicit ``args.device_map`` list of device indices."""
     prefer_host = args is not None and not bool(
         getattr(args, "using_tpu", getattr(args, "using_gpu", True)))
     devices = initialize_backend()
@@ -104,6 +110,16 @@ def get_device(args=None):
             return jax.devices("cpu")[0]
         except RuntimeError:
             return devices[0]
+    if args is not None and len(devices) > 1:
+        dev_map = getattr(args, "device_map", None)
+        rank = int(getattr(args, "rank", 0) or 0)
+        if dev_map:
+            return devices[int(list(dev_map)[rank % len(list(dev_map))])
+                           % len(devices)]
+        multiproc = str(getattr(args, "training_type", "")) in (
+            "cross_silo", "cross_cloud", "cross_device")
+        if multiproc and rank > 0:
+            return devices[rank % len(devices)]
     return devices[0]
 
 

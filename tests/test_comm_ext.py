@@ -185,3 +185,27 @@ def test_mqtt_s3_manager_over_fake_broker(tmp_path, monkeypatch):
     WillWatcher()
     client._client.kill()
     assert wills.get("fedml_mq1/status/1", {}).get("status") == "OFFLINE"
+
+
+def test_device_mapping_per_rank():
+    """Reference gpu_mapping semantics: multi-process silo ranks round-robin
+    over local devices; explicit device_map wins; sp/mesh stay on device 0
+    (the mesh owns placement there)."""
+    import types
+    import jax
+    from fedml_tpu.device import get_device
+
+    devices = jax.devices()
+    assert len(devices) == 8  # conftest virtual mesh
+
+    silo = lambda r, **kw: types.SimpleNamespace(
+        training_type="cross_silo", rank=r, using_tpu=True, **kw)
+    assert get_device(silo(0)) == devices[0]
+    assert get_device(silo(3)) == devices[3]
+    assert get_device(silo(9)) == devices[1]
+    # explicit map
+    assert get_device(silo(1, device_map=[5, 6])) == devices[6]
+    # simulation modes keep the default device
+    sim = types.SimpleNamespace(training_type="simulation", rank=2,
+                                using_tpu=True)
+    assert get_device(sim) == devices[0]

@@ -1,0 +1,77 @@
+"""Portable serving artifacts — the TPU-native analog of the reference's
+model conversion step (``device_model_deployment.py:618``
+``convert_model_to_onnx`` and the ``.mnn`` files ``model_hub.py:81-88``
+writes for phones).
+
+An artifact is a single zip holding the model's forward as serialized
+StableHLO (``jax.export`` — version-stable, hardware-retargetable: the same
+artifact loads on CPU or TPU) plus the msgpack'd params.  Serving a model
+therefore needs NO Python model code at the endpoint, matching the
+container-ships-a-converted-model deployment story.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HLO_NAME = "forward.stablehlo"
+_PARAMS_NAME = "params.msgpack"
+_META_NAME = "meta.json"
+
+
+def save_model_artifact(path: str, model, params,
+                        batch_size: int = 1) -> str:
+    """Serialize ``model.apply(params, x)`` for a fixed batch shape.
+
+    ``model``: a :class:`~fedml_tpu.models.base.FlaxModel` (or anything
+    with ``.apply(params, x)`` and ``.input_shape``).
+    """
+    import flax.serialization
+
+    x_spec = jax.ShapeDtypeStruct(
+        (batch_size,) + tuple(model.input_shape),
+        getattr(model, "input_dtype", jnp.float32))
+    params_spec = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    exported = jax.export.export(jax.jit(model.apply))(params_spec, x_spec)
+    blob = exported.serialize()
+    host_params = jax.tree.map(np.asarray, params)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr(_HLO_NAME, blob)
+        z.writestr(_PARAMS_NAME,
+                   flax.serialization.msgpack_serialize(host_params))
+        z.writestr(_META_NAME, json.dumps({
+            "input_shape": list(model.input_shape),
+            "input_dtype": str(np.dtype(
+                getattr(model, "input_dtype", jnp.float32))),
+            "batch_size": batch_size,
+            "format": "stablehlo+msgpack/v1",
+        }))
+    return path
+
+
+def load_model_artifact(path: str) -> Tuple[Callable, dict]:
+    """Load an artifact → (predict_fn(x) -> logits, meta).  No model code
+    needed; the StableHLO is rehydrated by jax.export and jitted."""
+    import flax.serialization
+
+    with zipfile.ZipFile(path) as z:
+        exported = jax.export.deserialize(z.read(_HLO_NAME))
+        params = flax.serialization.msgpack_restore(z.read(_PARAMS_NAME))
+        meta = json.loads(z.read(_META_NAME))
+
+    def predict(x):
+        x = jnp.asarray(x, dtype=meta["input_dtype"])
+        return exported.call(params, x)
+
+    return predict, meta
+
+
+__all__ = ["save_model_artifact", "load_model_artifact"]

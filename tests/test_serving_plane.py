@@ -246,3 +246,32 @@ def test_kv_cache_decode_is_faster():
     speedup = t_plain / t_cached
     # CPU CI bar is conservative; BASELINE.md records the measured number.
     assert speedup > 2.0, f"cached decode only {speedup:.2f}x faster"
+
+
+def test_model_artifact_stablehlo_roundtrip(tmp_path):
+    """Serving artifact (StableHLO + params zip — the .mnn/ONNX conversion
+    analog): export a trained flax model, reload WITHOUT model code, get
+    identical logits."""
+    import jax
+    import numpy as np
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import model as model_mod
+    from fedml_tpu.serving.export import (load_model_artifact,
+                                          save_model_artifact)
+
+    args = load_arguments()
+    args.update(model="cnn")
+    model = model_mod.create(args, 7)
+    assert tuple(model.input_shape) == (28, 28, 1)
+    params = model.init(jax.random.PRNGKey(0))
+
+    path = str(tmp_path / "cnn.fedml_artifact")
+    save_model_artifact(path, model, params, batch_size=4)
+
+    predict, meta = load_model_artifact(path)
+    assert meta["batch_size"] == 4
+    x = np.random.default_rng(0).normal(0, 1, (4, 28, 28, 1)).astype(
+        np.float32)
+    got = np.asarray(predict(x))
+    want = np.asarray(model.apply(params, x))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

@@ -22,7 +22,12 @@ import zipfile
 
 
 def load_predictor(package: str):
-    """Unpack (if zipped) and instantiate the packaged predictor."""
+    """Unpack (if zipped) and instantiate the packaged predictor.
+
+    Two card flavors: ``predictor_entry`` ("module:factory", module shipped
+    inside the package) or a ``*.fedml_artifact`` StableHLO bundle
+    (``serving/export.py``) needing no Python model code — the converted-
+    model deployment path (reference ``convert_model_to_onnx``)."""
     if os.path.isfile(package):
         dest = tempfile.mkdtemp(prefix="fedml_worker_pkg_")
         with zipfile.ZipFile(package) as z:
@@ -32,12 +37,30 @@ def load_predictor(package: str):
     with open(card_path) as f:
         card = json.load(f)
     entry = card.get("predictor_entry") or ""
-    if ":" not in entry:
-        raise ValueError(f"card {card.get('name')!r} has no predictor_entry")
-    sys.path.insert(0, package)  # packaged modules resolve first
-    mod_name, attr = entry.split(":", 1)
-    factory = getattr(importlib.import_module(mod_name), attr)
-    return factory(), card
+    if ":" in entry:
+        sys.path.insert(0, package)  # packaged modules resolve first
+        mod_name, attr = entry.split(":", 1)
+        factory = getattr(importlib.import_module(mod_name), attr)
+        return factory(), card
+    artifacts = [f for f in sorted(os.listdir(package))
+                 if f.endswith(".fedml_artifact")]
+    if artifacts:
+        from ....serving.export import load_model_artifact
+        from ....serving.fedml_predictor import FedMLPredictor
+
+        predict, meta = load_model_artifact(
+            os.path.join(package, artifacts[0]))
+
+        class ArtifactPredictor(FedMLPredictor):
+            def predict(self, request):
+                import numpy as np
+                x = np.asarray(request["x"], dtype=meta["input_dtype"])
+                return {"logits": np.asarray(predict(x)).tolist()}
+
+        return ArtifactPredictor(), card
+    raise ValueError(
+        f"card {card.get('name')!r} has neither a predictor_entry nor a "
+        "*.fedml_artifact bundle")
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,110 @@
+"""Multi-host runtime entry — the scale-out story (SURVEY §5: the
+reference's NCCL/MPI backend spans hosts; here ICI carries intra-slice
+collectives and DCN spans slices through jax.distributed + hybrid meshes).
+
+One call wires a process into the pod job:
+
+    spec = MultiHostSpec(coordinator="10.0.0.1:8476", num_processes=4,
+                         process_id=int(os.environ["RANK"]))
+    mesh = init_multihost(spec, client=-1, model=8)
+
+`jax.distributed.initialize` handles the rendezvous; the mesh comes from
+``mesh_utils.create_hybrid_device_mesh`` so the ``client`` (outer, DCN)
+axis maps across slices and the ``model`` (inner, ICI) axis stays inside
+one slice — collectives ride the right fabric by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+from .mesh import ALL_AXES as AXES
+from .mesh import CLIENT_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class MultiHostSpec:
+    coordinator: str = ""        # "host:port" of process 0
+    num_processes: int = 1
+    process_id: int = 0
+    local_device_ids: Optional[list] = None
+
+    @classmethod
+    def from_env(cls) -> "MultiHostSpec":
+        """Reference reads torchrun env (``__init__.py:353-361``); the jax
+        job equivalent: FEDML_COORDINATOR / WORLD_SIZE / RANK."""
+        return cls(
+            coordinator=os.environ.get("FEDML_COORDINATOR", ""),
+            num_processes=int(os.environ.get("WORLD_SIZE", "1")),
+            process_id=int(os.environ.get("RANK", "0")))
+
+
+def init_multihost(spec: Optional[MultiHostSpec] = None, *,
+                   client: int = 1, data: int = 1, model: int = 1,
+                   seq: int = 1):
+    """Join the distributed job (no-op for a single process) and build the
+    canonical mesh over ALL processes' devices.
+
+    Axis sizes of ``-1`` absorb the remaining device count (at most one).
+    The ``client`` axis is laid out across slices/hosts (DCN-adjacent),
+    inner axes across each host's own chips (ICI) via
+    ``create_hybrid_device_mesh`` when more than one process is present.
+    """
+    spec = spec or MultiHostSpec.from_env()
+    if spec.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=spec.coordinator,
+            num_processes=spec.num_processes,
+            process_id=spec.process_id,
+            local_device_ids=spec.local_device_ids)
+        log.info("joined distributed job: process %d/%d, %d global devices",
+                 spec.process_id, spec.num_processes, jax.device_count())
+
+    sizes = {CLIENT_AXIS: client, DATA_AXIS: data, MODEL_AXIS: model,
+             SEQ_AXIS: seq}
+    n = jax.device_count()
+    fixed = 1
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    for a, s in sizes.items():
+        if s != -1:
+            fixed *= s
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes "
+                             f"product {fixed}")
+        sizes[wild[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"mesh axes product {fixed} != {n} devices")
+
+    shape = tuple(sizes[a] for a in AXES)
+    if spec.num_processes > 1:
+        # hybrid layout: the client (outer) axis spans processes over DCN,
+        # every inner axis stays within one process's ICI domain — so the
+        # outer axis size must be a multiple of the process count
+        if sizes[CLIENT_AXIS] % spec.num_processes:
+            raise ValueError(
+                f"client axis ({sizes[CLIENT_AXIS]}) must divide evenly "
+                f"over {spec.num_processes} processes")
+        from jax.experimental import mesh_utils
+        ici_shape = (sizes[CLIENT_AXIS] // spec.num_processes,) + shape[1:]
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=ici_shape,
+            dcn_mesh_shape=(spec.num_processes,) + (1,) * (len(shape) - 1))
+        return jax.sharding.Mesh(devices, AXES)
+    from .mesh import make_mesh
+    return make_mesh(**{CLIENT_AXIS: sizes[CLIENT_AXIS],
+                        DATA_AXIS: sizes[DATA_AXIS],
+                        MODEL_AXIS: sizes[MODEL_AXIS],
+                        SEQ_AXIS: sizes[SEQ_AXIS]})
+
+
+__all__ = ["MultiHostSpec", "init_multihost"]

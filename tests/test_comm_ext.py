@@ -209,3 +209,21 @@ def test_device_mapping_per_rank():
     sim = types.SimpleNamespace(training_type="simulation", rank=2,
                                 using_tpu=True)
     assert get_device(sim) == devices[0]
+
+
+def test_multihost_spec_and_single_process_mesh():
+    """init_multihost: env parsing + single-process mesh construction with
+    one wildcard axis; bad shapes raise."""
+    import pytest
+    from fedml_tpu.core.multihost import MultiHostSpec, init_multihost
+
+    spec = MultiHostSpec.from_env()
+    assert spec.num_processes == 1  # no env set in tests
+
+    mesh = init_multihost(spec, client=-1, model=2)
+    assert mesh.shape["client"] == 4 and mesh.shape["model"] == 2
+
+    with pytest.raises(ValueError):
+        init_multihost(spec, client=-1, model=-1)
+    with pytest.raises(ValueError):
+        init_multihost(spec, client=3, model=2)  # 6 != 8 devices

@@ -71,4 +71,8 @@ def make_client_optimizer(args) -> optax.GradientTransformation:
     else:
         tx = make_sgd(lr, momentum=float(getattr(args, "momentum", 0.0)),
                       weight_decay=wd)
+    clip = float(getattr(args, "clip_grad_norm", 0.0) or 0.0)
+    if clip > 0:
+        # transformer-class models diverge under plain SGD without it
+        tx = optax.chain(optax.clip_by_global_norm(clip), tx)
     return tx

@@ -318,6 +318,11 @@ def load(args) -> Tuple[FederatedDataset, int]:
     if name in _TEXTCLS_SPECS:
         classes, vocab, seq_len, train_n, test_n = _TEXTCLS_SPECS[name]
         seq_len = int(getattr(args, "seq_len", seq_len))
+        # model/data must agree on the token space: honor overrides so a
+        # small-vocab model can train on a matching synthetic set
+        vocab = int(getattr(args, "vocab_size", 0) or vocab)
+        train_n = int(getattr(args, "train_size", 0) or train_n)
+        test_n = int(getattr(args, "test_size", 0) or test_n)
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:
             tx, ty, vx, vy = real

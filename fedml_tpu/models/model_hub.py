@@ -83,4 +83,21 @@ def create(args, output_dim: int = 10) -> FlaxModel:
     if name in ("transformer", "gpt", "llama", "tiny_llama"):
         from ..llm.model import build_causal_lm
         return build_causal_lm(args, output_dim)
+    if name in ("distilbert", "bert", "transformer_cls", "text_transformer"):
+        # the FedNLP text-classification workload (reference fednlp app
+        # zoo fine-tunes HF DistilBERT; this is the in-repo TPU-first
+        # encoder built on the fused attention ops)
+        from .text_transformer import TextTransformerClassifier
+        seq_len = int(getattr(args, "seq_len", 128))
+        vocab = int(getattr(args, "vocab_size", 30000))
+        m = TextTransformerClassifier(
+            vocab_size=vocab, num_classes=output_dim,
+            dim=int(getattr(args, "model_dim", 256)),
+            n_layers=int(getattr(args, "model_layers", 4)),
+            n_heads=int(getattr(args, "model_heads", 8)),
+            ffn_dim=int(getattr(args, "model_ffn_dim", 512)),
+            max_len=max(seq_len, 16))
+        import jax.numpy as jnp
+        return FlaxModel(m, (seq_len,), input_dtype=jnp.int32,
+                         task="classification")
     raise ValueError(f"unknown model {name!r}")

@@ -1,0 +1,120 @@
+"""Communication-backend benchmark (reference
+``python/tests/grpc_benchmark/``: gRPC vs torch-RPC throughput harness with
+PDF plots; here a table over this repo's backends, runnable anywhere).
+
+Measures round-trip request/response latency and bulk-tensor throughput for
+each backend between two endpoints in one host:
+
+    python tools/bench_comm.py [--backends local,grpc,filestore]
+                               [--payload-mb 4] [--reps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+import types
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from fedml_tpu.core.distributed.communication.message import Message  # noqa: E402
+from fedml_tpu.core.distributed.fedml_comm_manager import (  # noqa: E402
+    create_comm_backend)
+
+MSG_PING = 901
+MSG_PONG = 902
+
+
+def bench_backend(backend: str, payload_mb: float, reps: int) -> dict:
+    args = types.SimpleNamespace(
+        run_id=f"bench-{backend}-{time.time_ns()}",
+        filestore_dir=tempfile.mkdtemp(prefix="fedml_bench_fs_"),
+        grpc_base_port=18890)
+    m0 = create_comm_backend(args, 0, 2, backend)
+    m1 = create_comm_backend(args, 1, 2, backend)
+
+    done = threading.Event()
+    latencies = []
+
+    class Echo:  # rank 1: bounce every ping back
+        def receive_message(self, mtype, msg):
+            if mtype == MSG_PING:
+                out = Message(MSG_PONG, 1, 0)
+                out.add_params("payload", msg.get("payload"))
+                m1.send_message(out)
+
+    class Timer:  # rank 0: record round trips
+        def receive_message(self, mtype, msg):
+            if mtype == MSG_PONG:
+                latencies.append(time.perf_counter() - t_send[0])
+                done.set()
+
+    m1.add_observer(Echo())
+    m0.add_observer(Timer())
+    threads = [threading.Thread(target=m.handle_receive_message, daemon=True)
+               for m in (m0, m1)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # channels up
+
+    payload = np.random.default_rng(0).random(
+        int(payload_mb * 1024 * 1024 / 8))
+    t_send = [0.0]
+    # warmup
+    done.clear()
+    t_send[0] = time.perf_counter()
+    msg = Message(MSG_PING, 0, 1)
+    msg.add_params("payload", payload)
+    m0.send_message(msg)
+    done.wait(30)
+    latencies.clear()
+
+    for _ in range(reps):
+        done.clear()
+        t_send[0] = time.perf_counter()
+        msg = Message(MSG_PING, 0, 1)
+        msg.add_params("payload", payload)
+        m0.send_message(msg)
+        if not done.wait(60):
+            raise TimeoutError(f"{backend}: echo never returned")
+    for m in (m0, m1):
+        try:
+            m.stop_receive_message()
+        except Exception:
+            pass
+
+    lat = np.array(latencies)
+    mb_roundtrip = 2 * payload.nbytes / 1e6
+    return {
+        "backend": backend,
+        "payload_mb": round(payload.nbytes / 1e6, 2),
+        "rtt_ms_p50": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "rtt_ms_p95": round(float(np.percentile(lat, 95)) * 1e3, 2),
+        "throughput_MBps": round(mb_roundtrip / float(np.mean(lat)), 1),
+        "msgs_per_sec": round(1.0 / float(np.mean(lat)), 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backends", default="local,GRPC,filestore")
+    ap.add_argument("--payload-mb", type=float, default=4.0)
+    ap.add_argument("--reps", type=int, default=20)
+    opts = ap.parse_args()
+    rows = []
+    for b in opts.backends.split(","):
+        try:
+            rows.append(bench_backend(b.strip(), opts.payload_mb, opts.reps))
+        except Exception as e:
+            rows.append({"backend": b, "error": repr(e)})
+    print(json.dumps(rows, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -271,11 +271,15 @@ def bench_llm_lora(on_accelerator: bool, peak: float | None) -> dict:
 
     tokens_per_step = batch * seq
     flops = 6.0 * n_params * tokens_per_step  # fwd+bwd dense approx
+    final_loss = float(np.asarray(state[0][2]))
     out = {
         "step_time_s": round(dt, 5),
         "tokens_per_sec": round(tokens_per_step / dt, 1),
         "n_params": n_params,
         "n_lora_params": n_lora,
+        # timing is dtype-valid regardless; a non-finite loss flags the
+        # open TPU-bf16 gradient issue (tools/tpu_nan_bisect.py)
+        "loss_finite": bool(np.isfinite(final_loss)),
         "mfu": round(flops / dt / peak, 4) if peak else None,
         "config": {"dim": cfg.dim, "layers": cfg.n_layers, "seq": seq,
                    "batch": batch, "lora_rank": cfg.lora_rank,

@@ -1,0 +1,41 @@
+"""FedNLP text classification (BASELINE `fednlp_20news` row; reference app
+zoo fine-tunes DistilBERT): federated training of the in-repo transformer
+encoder on a 20-class text workload with adam clients + gradient clipping.
+
+Run:  python examples/nlp/fednlp_20news.py
+"""
+
+import fedml_tpu
+from fedml_tpu import data as data_mod, device as device_mod, model as model_mod
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+
+def main():
+    args = load_arguments()
+    args.update(dataset="20news", model="distilbert", seq_len=64,
+                vocab_size=4096, model_dim=128, model_layers=4,
+                model_heads=8, model_ffn_dim=256,
+                train_size=4000, test_size=800,
+                client_num_in_total=20, client_num_per_round=5,
+                comm_round=20, epochs=1, batch_size=32, learning_rate=1e-3,
+                client_optimizer="adam", clip_grad_norm=1.0,
+                partition_method="hetero", partition_alpha=0.5,
+                frequency_of_the_test=5, random_seed=0)
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dev = device_mod.get_device(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api = FedAvgAPI(args, dev, dataset, model)
+    _, acc0 = api.evaluate()
+    for r in range(int(args.comm_round)):
+        m = api.train_one_round(r)
+        if (r + 1) % 5 == 0:
+            loss, acc = api.evaluate()
+            print(f"round {r + 1}: train_loss={float(m['train_loss']):.3f} "
+                  f"test_acc={acc:.3f}")
+    print(f"accuracy {acc0:.3f} -> {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from .async_server_manager import AsyncFedMLServerManager
 from .fedml_aggregator import FedMLAggregator
 from .fedml_server_manager import FedMLServerManager
 
@@ -25,4 +26,5 @@ class Server:
         return self.aggregator.get_global_model_params()
 
 
-__all__ = ["Server", "FedMLAggregator", "FedMLServerManager"]
+__all__ = ["Server", "FedMLAggregator", "FedMLServerManager",
+           "AsyncFedMLServerManager"]

@@ -1,0 +1,111 @@
+"""Asynchronous cross-silo server (the WAN counterpart of
+``simulation/sp/async_fedavg``; the reference has async FL only as an MPI
+simulation, ``simulation/mpi/async_fedavg/`` — its cross-silo server always
+barriers on the full cohort).
+
+No round barrier: every client upload is mixed into the global model
+IMMEDIATELY with a staleness-discounted weight
+``α · s(now − τ)``, ``s(t) = (1 + t)^(−a)`` (polynomial discount, same
+family as the sp engine), and the fresh global model goes straight back to
+that client.  Stragglers therefore never block fast silos; their late
+updates still contribute, just discounted.
+
+Termination: after ``comm_round`` total mixed updates, FINISH fans out.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ...core import tree as tree_util
+from ...core.distributed.communication.message import Message
+from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ..message_define import MyMessage
+
+log = logging.getLogger(__name__)
+
+
+class AsyncFedMLServerManager(FedMLCommManager):
+    """Server FSM: onboarding handshake → per-upload mix → per-client
+    immediate re-dispatch → finish after N updates."""
+
+    def __init__(self, args, aggregator, comm=None, rank=0, size=0,
+                 backend="local"):
+        super().__init__(args, comm, rank, size, backend)
+        self.aggregator = aggregator
+        self.total_updates = int(getattr(args, "comm_round", 10))
+        self.mix_alpha = float(getattr(args, "async_alpha", 0.6))
+        self.staleness_a = float(getattr(args, "async_staleness_a", 0.5))
+        self.client_num = size - 1
+        self.updates_done = 0
+        #: model version each client last received (for staleness)
+        self._dispatched_version = {}
+        self._version = 0
+        self._online = set()
+        self._started = False
+        self._lock = threading.Lock()
+
+    # -- handshake (same shape as the sync server) -------------------------
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self._on_status)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_upload)
+
+    def _on_status(self, msg):
+        if msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS) != \
+                MyMessage.MSG_CLIENT_STATUS_ONLINE:
+            return
+        with self._lock:
+            self._online.add(msg.get_sender_id())
+            if len(self._online) < self.client_num or self._started:
+                return
+            self._started = True
+        for rank in range(1, self.client_num + 1):
+            self._dispatch(rank, MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
+
+    def _dispatch(self, rank: int, mtype) -> None:
+        msg = Message(mtype, self.rank, rank)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                       self.aggregator.get_global_model_params())
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, rank - 1)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._version)
+        self._dispatched_version[rank] = self._version
+        self.send_message(msg)
+
+    # -- async mix ---------------------------------------------------------
+    def _staleness_weight(self, staleness: float) -> float:
+        return self.mix_alpha * (1.0 + max(staleness, 0.0)) ** \
+            (-self.staleness_a)
+
+    def _on_upload(self, msg):
+        sender = msg.get_sender_id()
+        params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        with self._lock:
+            base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or
+                               self._dispatched_version.get(sender, 0))
+            staleness = self._version - base_version
+            w = self._staleness_weight(float(staleness))
+            mixed = tree_util.tree_add(
+                tree_util.tree_scale(
+                    self.aggregator.get_global_model_params(), 1.0 - w),
+                tree_util.tree_scale(params, w))
+            self.aggregator.set_global_model_params(mixed)
+            self._version += 1
+            self.updates_done += 1
+            done = self.updates_done >= self.total_updates
+        log.info("async server: mixed update %d from client %d "
+                 "(staleness %d, weight %.3f)", self.updates_done, sender,
+                 staleness, w)
+        if done:
+            for rank in range(1, self.client_num + 1):
+                self.send_message(Message(
+                    MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
+            self.finish()
+        else:
+            # only the uploader gets fresh work — no cohort barrier
+            self._dispatch(sender, MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT)
+
+
+__all__ = ["AsyncFedMLServerManager"]

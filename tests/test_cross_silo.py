@@ -164,3 +164,48 @@ def test_cross_silo_user_aggregator_hooks():
     assert calls[:3] == ["before", "aggregate", "after"]
     assert len(calls) == 3 * 3  # three rounds
     assert result["acc"] > 0.5
+
+
+def test_async_cross_silo_no_barrier():
+    """Async cross-silo: every upload mixes immediately with a staleness
+    discount and only the uploader is re-dispatched — no cohort barrier
+    (cross-silo counterpart of simulation/sp/async_fedavg; the reference
+    has async FL only as an MPI simulation)."""
+    import threading as th
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.cross_silo.server import (AsyncFedMLServerManager,
+                                             FedMLAggregator)
+    from fedml_tpu.cross_silo.client import Client
+
+    run_id = "async-xs"
+    total_updates = 9
+    result = {}
+
+    def server_thread():
+        args = make_args("local", 0, run_id, role="server",
+                         comm_round=total_updates, async_alpha=0.5)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        agg = FedMLAggregator(args, model, dataset, 2)
+        mgr = AsyncFedMLServerManager(args, agg, rank=0, size=3,
+                                      backend="local")
+        mgr.run()
+        result["updates"] = mgr.updates_done
+        result["acc"] = agg.test_on_server_for_all_clients(total_updates)
+
+    def client_thread(rank):
+        args = make_args("local", rank, run_id, role="client",
+                         comm_round=total_updates)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        Client(args, None, dataset, model).run()
+
+    threads = [th.Thread(target=server_thread)] + [
+        th.Thread(target=client_thread, args=(r,)) for r in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive(), "async federation deadlocked"
+    assert result["updates"] == total_updates
+    assert result["acc"] > 0.5, result["acc"]

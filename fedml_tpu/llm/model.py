@@ -137,19 +137,20 @@ class Attention(nn.Module):
             return self._decode_attend(q, k, v, positions, b, s, head_dim,
                                        dense)
 
-        if cfg.n_kv_heads != cfg.n_heads:  # GQA: repeat kv heads
-            rep = cfg.n_heads // cfg.n_kv_heads
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-
         impl = cfg.attn_impl
         if impl == "auto":
             impl = "flash" if jax.default_backend() in ("tpu", "axon") \
                 else "blockwise"
         if impl == "ring":
             from ..ops.ring_attention import ring_attention
+            if cfg.n_kv_heads != cfg.n_heads:  # ring path still repeats
+                rep = cfg.n_heads // cfg.n_kv_heads
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
             out = ring_attention(q, k, v, axis_name=SEQ_AXIS, causal=True)
         elif impl == "flash":
+            # flash + blockwise consume grouped KV natively (index-mapped
+            # heads — no h/h_kv × HBM blow-up from jnp.repeat)
             out = flash_attention(q, k, v, True, None)
         else:
             out = blockwise_attention(q, k, v, causal=True)
@@ -182,18 +183,18 @@ class Attention(nn.Module):
             ck.value, k.astype(cfg.dtype), (0, 0, start, 0))
         cv.value = jax.lax.dynamic_update_slice(
             cv.value, v.astype(cfg.dtype), (0, 0, start, 0))
-        kf, vf = ck.value, cv.value
-        if cfg.n_kv_heads != cfg.n_heads:
-            rep = cfg.n_heads // cfg.n_kv_heads
-            kf = jnp.repeat(kf, rep, axis=1)
-            vf = jnp.repeat(vf, rep, axis=1)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kf).astype(jnp.float32)
+        kf, vf = ck.value, cv.value                 # (b, h_kv, L, d)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(b, cfg.n_kv_heads, rep, s, head_dim)
+        scores = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
+                            kf).astype(jnp.float32)  # grouped, no KV repeat
         scores = scores / (head_dim ** 0.5)
         kv_pos = jnp.arange(cache_len)
         mask = kv_pos[None, :] <= positions[:, None]      # (s, cache_len)
-        scores = jnp.where(mask[None, None], scores, -1e30)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(vf.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, vf)
+        out = out.reshape(b, cfg.n_heads, s, head_dim)
         out = out.transpose(0, 2, 1, 3).reshape(
             b, s, cfg.n_heads * head_dim)
         return dense(cfg.dim, "wo")(out)

@@ -39,7 +39,20 @@ def blockwise_attention(q, k, v, causal: bool = True,
     q, k, v: (..., S, D).  Scans KV in blocks of ``block_k``, carrying the
     running max m, normalizer l, and unnormalized accumulator — the flash
     attention recurrence expressed in XLA.
+
+    GQA: 4-D inputs where k/v carry fewer heads than q are handled by
+    broadcasting a grouped view — no repeated-KV materialization.
     """
+    if (q.ndim == 4 and k.ndim == 4 and k.shape[1] != q.shape[1]):
+        b, h, s_q_, d_ = q.shape
+        h_kv = k.shape[1]
+        assert h % h_kv == 0, (h, h_kv)
+        rep = h // h_kv
+        qg = q.reshape(b, h_kv, rep, s_q_, d_)
+        out = blockwise_attention(qg, k[:, :, None], v[:, :, None],
+                                  causal=causal, sm_scale=sm_scale,
+                                  block_k=block_k)
+        return out.reshape(b, h, s_q_, d_)
     *lead, s_q, d = q.shape
     s_k = k.shape[-2]
     if sm_scale is None:
@@ -52,8 +65,11 @@ def blockwise_attention(q, k, v, causal: bool = True,
         vp = jnp.pad(v, [(0, 0)] * (v.ndim - 2) + [(0, pad), (0, 0)])
     else:
         kp, vp = k, v
-    kb = kp.reshape(*lead, n_blocks, block_k, d)
-    vb = vp.reshape(*lead, n_blocks, block_k, d)
+    # reshape by K's OWN leading dims (grouped-query calls pass a size-1
+    # group axis that broadcasts against q's rep axis)
+    klead = kp.shape[:-2]
+    kb = kp.reshape(*klead, n_blocks, block_k, d)
+    vb = vp.reshape(*klead, n_blocks, block_k, d)
     # move block axis to front for scan
     perm = (len(lead),) + tuple(range(len(lead))) + (len(lead) + 1, len(lead) + 2)
     kb = jnp.transpose(kb, perm)
@@ -150,26 +166,43 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
+def _kv_head_map(b: int, h: int, h_kv: int):
+    """Program-id → KV-row mapping for grouped-query attention: q head
+    ``h_q`` reads kv head ``h_q // (h // h_kv)`` — the kernel never
+    materializes repeated KV (the ``jnp.repeat`` the naive path needs
+    costs h/h_kv × KV HBM traffic)."""
+    rep = h // h_kv
+
+    def kv_row(bh):
+        return (bh // h) * h_kv + (bh % h) // rep
+
+    return kv_row
+
+
 def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
                                sm_scale: Optional[float] = None,
                                block_q: int = 512, block_k: int = 512,
                                return_lse: bool = False,
                                interpret: bool = False):
-    """q, k, v: (B, H, S, D) → (B, H, S, D) [+ logsumexp (B, H, S)]."""
+    """q: (B, H, S, D); k, v: (B, H_kv, S, D) with H_kv | H (GQA served by
+    index-mapping, no KV repeat) → (B, H, S, D) [+ logsumexp (B, H, S)]."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s_q, d = q.shape
+    h_kv = k.shape[1]
+    assert h % h_kv == 0, (h, h_kv)
     s_k = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
     qr = q.reshape(b * h, s_q, d)
-    kr = k.reshape(b * h, s_k, d)
-    vr = v.reshape(b * h, s_k, d)
+    kr = k.reshape(b * h_kv, s_k, d)
+    vr = v.reshape(b * h_kv, s_k, d)
     nq = -(-s_q // block_q)
     nk = -(-s_k // block_k)
+    kv_row = _kv_head_map(b, h, h_kv)
 
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k,
@@ -180,8 +213,10 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kj: (kv_row(bh), kj, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
@@ -321,19 +356,24 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
                                interpret: bool = False):
     """Flash-attention backward: (dq, dk, dv), no S×S materialization and no
     forward recompute beyond the score blocks (reference capability target:
-    the HF flash-attn patch at ``train/llm/models/attention.py:30``)."""
+    the HF flash-attn patch at ``train/llm/models/attention.py:30``).
+
+    GQA: k/v may carry H_kv < H heads (read via index mapping, never
+    repeated); dk/dv are computed per q-head then group-summed."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s_q, d = q.shape
+    h_kv = k.shape[1]
+    assert h % h_kv == 0, (h, h_kv)
     s_k = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
     qr = q.reshape(b * h, s_q, d)
-    kr = k.reshape(b * h, s_k, d)
-    vr = v.reshape(b * h, s_k, d)
+    kr = k.reshape(b * h_kv, s_k, d)
+    vr = v.reshape(b * h_kv, s_k, d)
     dor = do.reshape(b * h, s_q, d)
     lser = lse.reshape(b * h, s_q)
     # delta = rowsum(dO * O) — cheap elementwise, stays in XLA
@@ -341,12 +381,14 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
                     axis=-1).reshape(b * h, s_q)
     nq = -(-s_q // block_q)
     nk = -(-s_k // block_k)
+    kv_row = _kv_head_map(b, h, h_kv)
 
     common = dict(block_q=block_q, block_k=block_k, sm_scale=float(sm_scale),
                   causal=causal, seq_k=s_k)
     common_kv = dict(common, seq_q=s_q)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d),
+                          lambda bh, i, j: (kv_row(bh), j, 0))
     r_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
 
     dq = pl.pallas_call(
@@ -363,7 +405,8 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
 
     # dkv pass: grid over k blocks, scan q
     qs_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
-    ks_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    ks_spec = pl.BlockSpec((1, block_k, d),
+                           lambda bh, j, i: (kv_row(bh), j, 0))
     rs_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common_kv),
@@ -383,9 +426,14 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qr, kr, vr, dor, lser, delta)
-    shape = (b, h, s_q, d)
-    kshape = (b, h, s_k, d)
-    return dq.reshape(shape), dk.reshape(kshape), dv.reshape(kshape)
+    dq = dq.reshape(b, h, s_q, d)
+    dk = dk.reshape(b, h, s_k, d)
+    dv = dv.reshape(b, h, s_k, d)
+    if h_kv != h:
+        rep = h // h_kv
+        dk = dk.reshape(b, h_kv, rep, s_k, d).sum(2)
+        dv = dv.reshape(b, h_kv, rep, s_k, d).sum(2)
+    return dq, dk, dv
 
 
 # -- public entry with custom vjp --------------------------------------------

@@ -1,0 +1,129 @@
+"""Decentralized (serverless) cross-silo federation — gossip averaging over
+a peer topology with NO coordinator.
+
+The reference has decentralized FL only as simulations
+(``simulation/sp/decentralized`` DSGD/push-sum and the MPI
+``decentralized_framework``); its cross-silo mode is always server-centric.
+Here every silo is a peer: per round it trains locally, sends its model to
+its out-neighbors (topology from ``core/distributed/topology``), waits for
+its in-neighbors, and applies the mixing-matrix weighted average (DSGD /
+gossip averaging).  Rounds are tagged so a slow peer's stale gossip can't
+corrupt the next round.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict
+
+from ..core import rng as rng_util
+from ..core import tree as tree_util
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..core.distributed.topology.topology_manager import (
+    SymmetricTopologyManager)
+from ..ml.trainer.local_trainer import LocalTrainer
+
+log = logging.getLogger(__name__)
+
+MSG_TYPE_P2P_MODEL = 601
+ARG_MODEL = "p2p_model_params"
+ARG_ROUND = "p2p_round_idx"
+
+
+class DecentralizedWorkerManager(FedMLCommManager):
+    """One peer.  ``rank`` ∈ [0, size): ALL ranks are workers (no rank-0
+    server).  Topology indices == comm ranks."""
+
+    def __init__(self, args, dataset, model, comm=None, rank=0, size=0,
+                 backend="local", topology=None):
+        super().__init__(args, comm, rank, size, backend)
+        self.topology = topology or SymmetricTopologyManager(
+            size, int(getattr(args, "topology_neighbor_num", 2)))
+        if getattr(self.topology, "topology", None) is None:
+            self.topology.generate_topology()
+        self.dataset = dataset
+        self.model = model
+        self.trainer = LocalTrainer(model, args)
+        self.rounds = int(getattr(args, "comm_round", 5))
+        self.seed = int(getattr(args, "random_seed", 0))
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        self.epochs = int(getattr(args, "epochs", 1))
+        key = rng_util.root_key(self.seed)
+        self.params = model.init(rng_util.purpose_key(key, "init"))
+        self.round_idx = 0
+        self._inbox: Dict[int, Dict[int, Any]] = {}
+        self._lock = threading.Lock()
+        self._local_train = None
+
+    # -- FSM ----------------------------------------------------------------
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_CONNECTION_IS_READY, self._on_ready)
+        self.register_message_receive_handler(
+            MSG_TYPE_P2P_MODEL, self._on_peer_model)
+
+    def _on_ready(self, _msg):
+        self._step_round()
+
+    def _train_local(self):
+        clients = [self.rank % self.dataset.num_clients]
+        xb, yb, mask, _w = self.dataset.cohort_batches(
+            clients, self.batch_size, self.seed, self.round_idx, self.epochs)
+        rng = rng_util.client_key(rng_util.root_key(self.seed),
+                                  self.round_idx, self.rank)
+        if self._local_train is None:
+            self._local_train = self.trainer.make_local_train()
+        from ..simulation.round_engine import make_server_ctx
+        from ..ml.aggregator.agg_operator import ServerOptimizer
+        ctx = make_server_ctx(self.trainer,
+                              ServerOptimizer(self.args).init(self.params))
+        out = self._local_train(self.params, xb[0], yb[0], mask[0], rng,
+                                ctx, None)
+        self.params = out.params
+
+    def _step_round(self):
+        """Train, gossip to out-neighbors, then wait for in-neighbors."""
+        self._train_local()
+        for peer in self.topology.get_out_neighbor_idx_list(self.rank):
+            if peer == self.rank:
+                continue
+            msg = Message(MSG_TYPE_P2P_MODEL, self.rank, int(peer))
+            msg.add_params(ARG_MODEL, self.params)
+            msg.add_params(ARG_ROUND, self.round_idx)
+            self.send_message(msg)
+        self._maybe_mix()
+
+    def _on_peer_model(self, msg):
+        sender = msg.get_sender_id()
+        rnd = int(msg.get(ARG_ROUND))
+        with self._lock:
+            self._inbox.setdefault(rnd, {})[sender] = msg.get(ARG_MODEL)
+        self._maybe_mix()
+
+    def _maybe_mix(self):
+        with self._lock:
+            expected = [int(p) for p in
+                        self.topology.get_in_neighbor_idx_list(self.rank)
+                        if int(p) != self.rank]
+            box = self._inbox.get(self.round_idx, {})
+            if not all(p in box for p in expected):
+                return
+            weights = self.topology.get_in_neighbor_weights(self.rank)
+            mixed = tree_util.tree_scale(self.params,
+                                         float(weights[self.rank]))
+            for p in expected:
+                mixed = tree_util.tree_add(
+                    mixed, tree_util.tree_scale(box[p], float(weights[p])))
+            self.params = mixed
+            self._inbox.pop(self.round_idx, None)
+            self.round_idx += 1
+            done = self.round_idx >= self.rounds
+        if done:
+            self.finish()
+        else:
+            self._step_round()
+
+
+__all__ = ["DecentralizedWorkerManager", "MSG_TYPE_P2P_MODEL"]

@@ -335,6 +335,41 @@ def _attn_speedup(b, h, s, d, dtype, causal: bool = True,
     return round(t_bw / t_fl, 2)
 
 
+def _gqa_grouped_speedup(b, h, kvh, s, d, dtype, causal, reps: int = 10):
+    """Index-mapped grouped KV vs materialized jnp.repeat, forward only."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.ops.attention import flash_attention_fwd_pallas
+
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), dtype)
+    k = jax.random.normal(ks[1], (b, kvh, s, d), dtype)
+    v = jax.random.normal(ks[2], (b, kvh, s, d), dtype)
+
+    def chained(fn):
+        def many(q, k, v):
+            def body(c, _):
+                return fn(c, k, v), ()
+            out, _ = jax.lax.scan(body, q, None, length=reps)
+            return jnp.sum(out.astype(jnp.float32))
+        return jax.jit(many)
+
+    grouped = chained(
+        lambda q, k, v: flash_attention_fwd_pallas(q, k, v, causal))
+    rep = h // kvh
+    repeated = chained(
+        lambda q, k, v: flash_attention_fwd_pallas(
+            q, jnp.repeat(k, rep, 1), jnp.repeat(v, rep, 1), causal))
+    rtt = measure_rtt()
+    times = []
+    for f in (grouped, repeated):
+        _readback(f(q, k, v))
+        t0 = time.perf_counter()
+        _readback(f(q, k, v))
+        times.append(max(time.perf_counter() - t0 - rtt, 1e-9))
+    return round(times[1] / times[0], 2)
+
+
 # -- attention parity + timing sweep (--attn) --------------------------------
 def attn_sweep() -> dict:
     """Flash(Pallas) vs blockwise: numerics + timing across S, causal, dtype,
@@ -355,21 +390,23 @@ def attn_sweep() -> dict:
                     q = jax.random.normal(ks[0], (b, h, s, d), dtype)
                     k = jax.random.normal(ks[1], (b, kvh, s, d), dtype)
                     v = jax.random.normal(ks[2], (b, kvh, s, d), dtype)
-                    if kvh != h:
-                        k = jnp.repeat(k, h // kvh, axis=1)
-                        v = jnp.repeat(v, h // kvh, axis=1)
                     case = {"S": s, "causal": causal,
                             "dtype": dtype.__name__, "heads": f"{h}q/{kvh}kv"}
                     if on_tpu:
+                        # grouped KV consumed natively (no repeat)
                         ref = blockwise_attention(q, k, v, causal=causal)
                         out = flash_attention_fwd_pallas(q, k, v, causal)
                         err = float(jnp.max(jnp.abs(
                             out.astype(jnp.float32) - ref.astype(jnp.float32))))
                         case["max_abs_err"] = err
                         case["pass"] = bool(err < tol)
-                        if kvh == h:  # GQA repeats reuse the same kernel shape
+                        if kvh == h:
                             case["speedup"] = _attn_speedup(
                                 b, h, s, d, dtype, causal=causal, reps=10)
+                        else:
+                            case["gqa_grouped_vs_repeat"] = \
+                                _gqa_grouped_speedup(b, h, kvh, s, d, dtype,
+                                                     causal)
                     else:
                         case["max_abs_err"] = None
                         case["pass"] = None

@@ -48,6 +48,11 @@ class LlamaConfig:
     #: (the memory key to 512-client 7B federation, SURVEY §7 hard parts).
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    #: Mixture-of-Experts: >0 replaces the dense FFN with n_experts SwiGLU
+    #: experts, top-k routed, expert-parallel over the ``model`` mesh axis
+    #: (llm/moe.py — EP has no reference counterpart, SURVEY §2.9).
+    n_experts: int = 0
+    moe_top_k: int = 2
 
 
 TINY = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
@@ -221,8 +226,15 @@ class Block(nn.Module):
         h = x + Attention(self.cfg, name="attention")(
             RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions,
             decode=decode)
-        return h + MLP(self.cfg, name="mlp")(
-            RMSNorm(self.cfg.norm_eps, name="mlp_norm")(h))
+        if self.cfg.n_experts > 0:
+            from .moe import MoEMLP
+            ffn = MoEMLP(dim=self.cfg.dim, ffn_dim=self.cfg.ffn_dim,
+                         n_experts=self.cfg.n_experts,
+                         top_k=self.cfg.moe_top_k, dtype=self.cfg.dtype,
+                         name="moe_mlp")
+        else:
+            ffn = MLP(self.cfg, name="mlp")
+        return h + ffn(RMSNorm(self.cfg.norm_eps, name="mlp_norm")(h))
 
 
 class LlamaLM(nn.Module):

@@ -1,0 +1,117 @@
+"""Mixture-of-Experts with expert parallelism — the EP entry in the
+parallelism inventory (SURVEY §2.9 lists EP as absent from the reference;
+it exists here because a TPU-native LLM stack should scale FFN capacity
+without scaling per-token FLOPs).
+
+Design (XLA-first, static shapes throughout):
+
+- **Router**: top-k softmax gating with a load-balancing auxiliary loss
+  (mean(token-fraction · prob-fraction) · E², the standard switch loss).
+- **Dispatch**: capacity-limited one-hot dispatch/combine einsums — the
+  dense-mask formulation XLA turns into all-to-alls when the expert axis
+  is sharded.  Tokens over capacity are dropped (their combine weight is
+  zero), which keeps every shape static.
+- **EP sharding**: expert-indexed tensors carry a
+  ``with_sharding_constraint`` over the ``model`` mesh axis, so under jit
+  each device holds ``E / ep`` experts and the dispatch einsum lowers to
+  an ICI all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..core.mesh import MODEL_AXIS
+
+
+def _ep_constraint(x, mesh):
+    """Shard axis 0 (experts) over the model axis when a mesh is active."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(MODEL_AXIS) if x.ndim == 1 else \
+        P(*((MODEL_AXIS,) + (None,) * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+class MoEMLP(nn.Module):
+    """Drop-in SwiGLU FFN replacement with E experts, top-k routing."""
+
+    dim: int
+    ffn_dim: int
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, dim = x.shape
+        n_tok = b * s
+        e, k = self.n_experts, self.top_k
+        cap = max(1, int(self.capacity_factor * k * n_tok / e))
+
+        xt = x.reshape(n_tok, dim)
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          name="router")(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)             # (N, E)
+
+        # top-k selection, positions assigned per expert by prefix count
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)       # (N, k)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # load-balancing aux loss (store for the trainer to read)
+        me = probs.mean(0)                                  # prob fraction
+        ce = jnp.zeros((e,), jnp.float32).at[gate_idx.reshape(-1)].add(
+            1.0) / (n_tok * k)                              # token fraction
+        self.sow("losses", "moe_aux", jnp.sum(me * ce) * e * e)
+
+        # dispatch tensor (N, E, C): token n → slot (e, position) if within
+        # capacity; everything one-hot/static so GSPMD can all-to-all it
+        disp = jnp.zeros((n_tok, e, cap), jnp.float32)
+        comb = jnp.zeros((n_tok, e, cap), jnp.float32)
+        base = jnp.zeros((e,), jnp.float32)  # queue depth is SHARED across
+        # the k branches — independent counters would collide two tokens
+        # into one (expert, slot) and jumble their outputs
+        for j in range(k):                                  # k is tiny (2)
+            ej = gate_idx[:, j]                             # (N,)
+            onehot = jax.nn.one_hot(ej, e, dtype=jnp.float32)
+            pos = jnp.cumsum(onehot, axis=0) - onehot + base[None, :]
+            posj = jnp.take_along_axis(pos, ej[:, None], 1)[:, 0]
+            keep = posj < cap
+            slot = jax.nn.one_hot(posj.astype(jnp.int32), cap,
+                                  dtype=jnp.float32) * keep[:, None]
+            contrib = onehot[:, :, None] * slot[:, None, :]
+            disp = disp + contrib
+            comb = comb + contrib * gate_vals[:, j][:, None, None]
+            base = base + onehot.sum(0)
+
+        expert_in = jnp.einsum("nec,nd->ecd", disp,
+                               xt.astype(jnp.float32)).astype(self.dtype)
+        expert_in = _ep_constraint(expert_in, self.mesh)
+
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
+                            (e, dim, self.ffn_dim))
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (e, dim, self.ffn_dim))
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (e, self.ffn_dim, dim))
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       _ep_constraint(w_gate.astype(self.dtype), self.mesh))
+        u = jnp.einsum("ecd,edf->ecf", expert_in,
+                       _ep_constraint(w_up.astype(self.dtype), self.mesh))
+        y = jnp.einsum("ecf,efd->ecd", nn.silu(h) * u,
+                       _ep_constraint(w_down.astype(self.dtype), self.mesh))
+        y = _ep_constraint(y, self.mesh)
+
+        out = jnp.einsum("nec,ecd->nd", comb, y.astype(jnp.float32))
+        return out.reshape(b, s, dim).astype(x.dtype)
+
+
+__all__ = ["MoEMLP"]

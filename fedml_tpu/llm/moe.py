@@ -28,9 +28,21 @@ import jax.numpy as jnp
 from ..core.mesh import MODEL_AXIS
 
 
+def _active_mesh(explicit):
+    """Explicit mesh if given, else the ambient ``with mesh:`` context (so
+    EP engages through LlamaLM/Block without threading a mesh handle)."""
+    if explicit is not None:
+        return explicit
+    from jax._src.mesh import thread_resources
+    ctx = thread_resources.env.physical_mesh
+    return None if ctx.empty else ctx
+
+
 def _ep_constraint(x, mesh):
     """Shard axis 0 (experts) over the model axis when a mesh is active."""
-    if mesh is None:
+    mesh = _active_mesh(mesh)
+    if mesh is None or MODEL_AXIS not in mesh.shape \
+            or mesh.shape[MODEL_AXIS] == 1:
         return x
     from jax.sharding import NamedSharding, PartitionSpec as P
     spec = P(MODEL_AXIS) if x.ndim == 1 else \

@@ -124,3 +124,41 @@ def test_llama_with_moe_trains():
     # router + expert params exist per layer
     assert "moe_mlp" in params["layer_0"]
     assert params["layer_0"]["moe_mlp"]["w_gate"].shape == (4, 32, 64)
+
+
+def test_llama_moe_ep_engages_under_context_mesh():
+    """EP through the MODEL path: under `with mesh:` the ambient-mesh
+    constraint inside Block->MoEMLP must fire (not silently no-op) and the
+    sharded result must match the unsharded one."""
+    from fedml_tpu.core.mesh import make_mesh
+    from fedml_tpu.llm import moe as moe_mod
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+
+    cfg = LlamaConfig(vocab_size=64, dim=16, n_layers=1, n_heads=2,
+                      n_kv_heads=2, ffn_dim=32, max_seq_len=16,
+                      dtype=jnp.float32, attn_impl="blockwise",
+                      n_experts=4, moe_top_k=2)
+    model = LlamaLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    ref = model.apply({"params": params}, tokens)
+
+    mesh = make_mesh(client=1, data=1, model=4, seq=1)
+    seen = []
+    orig = moe_mod._ep_constraint
+
+    def spy(x, m):
+        out = orig(x, m)
+        seen.append(out is not x)
+        return out
+
+    moe_mod._ep_constraint = spy
+    try:
+        with mesh:
+            got = jax.jit(
+                lambda p, t: model.apply({"params": p}, t))(params, tokens)
+    finally:
+        moe_mod._ep_constraint = orig
+    assert any(seen), "EP constraint never engaged through LlamaLM"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5,
+                               rtol=1e-4)

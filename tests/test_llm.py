@@ -269,3 +269,37 @@ def test_ring_attention_gradients_match_dense():
     for a, bb in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    atol=5e-5, rtol=1e-3)
+
+
+def test_hf_tokenizer_adapter_offline(tmp_path):
+    """HF tokenizer parity without egress: build a BPE tokenizer locally
+    (tokenizers lib), save, reload via load_tokenizer, round-trip text, and
+    serve generation through it."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import BPE
+    from tokenizers.trainers import BpeTrainer
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    trainer = BpeTrainer(special_tokens=["<unk>", "<s>", "</s>"],
+                         vocab_size=200)
+    tok.train_from_iterator(
+        ["the quick brown fox jumps over the lazy dog",
+         "federated learning on tpu pods", "hello world"] * 20, trainer)
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok, unk_token="<unk>",
+                                   bos_token="<s>", eos_token="</s>")
+    path = tmp_path / "tok"
+    fast.save_pretrained(str(path))
+
+    from fedml_tpu.llm.tokenization import HFTokenizerAdapter, load_tokenizer
+    loaded = load_tokenizer(str(path))
+    assert isinstance(loaded, HFTokenizerAdapter)
+    ids = loaded.encode("hello world")
+    assert ids[0] == loaded.bos_id
+    assert "hello world" in loaded.decode(ids)
+
+    # unresolvable path -> byte tokenizer fallback, never a download
+    fallback = load_tokenizer("/does/not/exist")
+    assert fallback.vocab_size == 258

@@ -19,6 +19,7 @@ to the CPU backend so batch jobs (bench.py, tests) degrade instead of die.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import jax
@@ -43,18 +44,66 @@ def _is_transient(err: BaseException) -> bool:
     return any(m in msg for m in _TRANSIENT_MARKERS)
 
 
+def _probe_backend_subprocess(timeout_s: float) -> bool:
+    """Probe accelerator init in a THROWAWAY process: the tunnel-attached
+    TPU plugin can HANG (not error) in ``jax.devices()`` for hours
+    (observed round 2), and a hang inside this process would poison the
+    backend-init lock — so the liveness check must be external.  Returns
+    True when the accelerator initialized within the timeout."""
+    import subprocess
+    import sys
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            timeout=timeout_s, capture_output=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+    except Exception:
+        return True  # probe infrastructure failed: fall through to direct
+
+
+def _backend_already_up() -> bool:
+    try:
+        from jax._src import xla_bridge
+        return xla_bridge.backends_are_initialized()
+    except Exception:
+        return False
+
+
 def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
     """Return ``jax.devices()``, retrying transient plugin failures and
-    falling back to the CPU backend when the accelerator never comes up.
+    falling back to the CPU backend when the accelerator never comes up
+    (including a HUNG plugin, probed out-of-process).
 
     Remediation knobs (also logged on failure):
       - ``FEDML_TPU_PLATFORM=cpu`` forces the CPU backend up front;
       - ``FEDML_TPU_NUM_CPU_DEVICES=8`` sizes a virtual CPU mesh;
+      - ``FEDML_TPU_DEVICE_PROBE_TIMEOUT`` (s, default 120) bounds the
+        out-of-process liveness probe;
       - ``JAX_PLATFORMS=''`` lets jax auto-pick (may not stick on images
         whose PJRT plugin re-forces the platform at import time).
     """
     global BACKEND_NOTE
     last: BaseException | None = None
+    forced = os.environ.get("FEDML_TPU_PLATFORM", "")
+    if not _backend_already_up() and forced.lower() not in ("cpu",):
+        timeout_s = float(os.environ.get(
+            "FEDML_TPU_DEVICE_PROBE_TIMEOUT", "120") or 120)
+        if timeout_s > 0 and not _probe_backend_subprocess(timeout_s):
+            log.error(
+                "accelerator init HUNG >%ss in the liveness probe "
+                "(wedged tunnel?); forcing the CPU backend for this "
+                "process", timeout_s)
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            devices = jax.devices("cpu")
+            BACKEND_NOTE = (f"cpu fallback (accelerator init hung "
+                            f">{timeout_s:.0f}s)")
+            return devices
     for attempt in range(1, retries + 1):
         try:
             devices = jax.devices()

@@ -12,7 +12,7 @@ def test_hello_job_launch():
 
     job = os.path.join(os.path.dirname(__file__), "..", "examples", "launch",
                        "hello_job.yaml")
-    run = api.launch_job(job, wait=True, timeout_s=300,
+    run = api.launch_job(job, wait=True, timeout_s=600,
                          env={"FEDML_TPU_PLATFORM": "cpu"})
     try:
         assert run.status == "FINISHED", (

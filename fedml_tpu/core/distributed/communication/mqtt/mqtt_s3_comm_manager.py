@@ -127,3 +127,29 @@ class MqttS3CommManager(BaseCommunicationManager):
             self._client.disconnect()
         except Exception:
             pass
+
+
+class MqttS3MnnCommManager(MqttS3CommManager):
+    """Mobile-edge variant (reference
+    ``mqtt_s3_mnn/mqtt_s3_comm_manager.py``): same broker control plane,
+    but model payloads travel as EDGE BUNDLES — the portable file format
+    the native C++/Java clients consume (``native/edge_bundle.py``, the
+    ``.mnn`` analog) — instead of pickled pytrees."""
+
+    def _put_blob(self, payload) -> str:
+        import numpy as np
+        from ....native.edge_bundle import write_bundle
+
+        if isinstance(payload, dict) and payload and all(
+                hasattr(v, "shape") for v in payload.values()):
+            key = f"{self.run_id}_{uuid.uuid4().hex}.fteb"
+            write_bundle(os.path.join(self.store_dir, key),
+                         {k: np.asarray(v) for k, v in payload.items()})
+            return key
+        return super()._put_blob(payload)
+
+    def _get_blob(self, key: str):
+        if key.endswith(".fteb"):
+            from ....native.edge_bundle import read_bundle
+            return read_bundle(os.path.join(self.store_dir, key))
+        return super()._get_blob(key)

@@ -138,7 +138,7 @@ class MqttS3MnnCommManager(MqttS3CommManager):
 
     def _put_blob(self, payload) -> str:
         import numpy as np
-        from ....native.edge_bundle import write_bundle
+        from .....native.edge_bundle import write_bundle
 
         if isinstance(payload, dict) and payload and all(
                 hasattr(v, "shape") for v in payload.values()):
@@ -150,6 +150,6 @@ class MqttS3MnnCommManager(MqttS3CommManager):
 
     def _get_blob(self, key: str):
         if key.endswith(".fteb"):
-            from ....native.edge_bundle import read_bundle
+            from .....native.edge_bundle import read_bundle
             return read_bundle(os.path.join(self.store_dir, key))
         return super()._get_blob(key)

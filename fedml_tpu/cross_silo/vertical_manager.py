@@ -1,0 +1,165 @@
+"""Cross-silo vertical FL (split learning across REAL parties) — the
+reference runs vertical FL only inside simulations
+(``simulation/sp/classical_vertical_fl``, ``simulation/mpi/``); its
+cross-silo mode is horizontal-only.  Here the guest (rank 0: labels + its
+feature slice) and host parties (ranks ≥ 1: feature slices only) exchange
+ACTIVATIONS and logit-gradients over the message plane — raw features and
+labels never leave their owners (the VFL privacy contract).
+
+Per batch: guest announces the (deterministic, seed-derived) batch →
+hosts forward their towers and upload partial logits → guest sums, takes
+the softmax-CE gradient, broadcasts it → every party updates its own
+tower.  SURVEY §2.9 "split learning" row: activations over DCN, same
+message protocol as the horizontal FSMs.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import hostrng, rng as rng_util
+from ..core.distributed.communication.message import Message
+from ..core.distributed.fedml_comm_manager import FedMLCommManager
+from ..simulation.sp.vertical_fl import VerticalPartyModel
+
+log = logging.getLogger(__name__)
+
+MSG_BATCH = 701          # guest -> hosts: round + batch index list
+MSG_PARTIAL = 702        # host -> guest: partial logits
+MSG_GRAD = 703           # guest -> hosts: d loss / d logits
+MSG_DONE = 704
+
+ARG_ROUND = "vfl_round"
+ARG_BATCH = "vfl_batch_idx"
+ARG_LOGITS = "vfl_partial_logits"
+ARG_GRAD = "vfl_glogit"
+
+
+class VflGuestManager(FedMLCommManager):
+    """Rank 0: label owner + aggregator."""
+
+    def __init__(self, args, features: np.ndarray, labels: np.ndarray,
+                 num_classes: int, comm=None, size: int = 0,
+                 backend: str = "local"):
+        super().__init__(args, comm, 0, size, backend)
+        self.x = np.asarray(features, np.float32).reshape(len(labels), -1)
+        self.y = np.asarray(labels)
+        self.num_classes = int(num_classes)
+        self.batch_size = int(getattr(args, "batch_size", 64))
+        self.rounds = int(getattr(args, "comm_round", 5))
+        self.seed = int(getattr(args, "random_seed", 0))
+        lr = float(getattr(args, "learning_rate", 0.1))
+        self.model = VerticalPartyModel(
+            self.x.shape[1], self.num_classes, lr,
+            rng_util.purpose_key(rng_util.root_key(self.seed), "vfl0"))
+        self.losses = []
+        self._round = 0
+        self._batch_i = 0
+        self._order = None
+        self._partials: Dict[int, np.ndarray] = {}
+        self._cur_idx = None
+        self._lock = threading.Lock()
+
+        import jax
+
+        @jax.jit
+        def guest_grad(logits, y):
+            onehot = jax.nn.one_hot(y, logits.shape[-1])
+            loss = -jnp.mean(jnp.sum(
+                onehot * jax.nn.log_softmax(logits), -1))
+            return loss, (jax.nn.softmax(logits) - onehot)
+
+        self._guest_grad = guest_grad
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_CONNECTION_IS_READY, self._on_ready)
+        self.register_message_receive_handler(MSG_PARTIAL, self._on_partial)
+
+    def _on_ready(self, _msg):
+        self._announce_batch()
+
+    def _announce_batch(self):
+        n = len(self.y)
+        if self._order is None or self._batch_i + self.batch_size > n:
+            if self._order is not None:
+                self._round += 1
+                if self._round >= self.rounds:
+                    for rank in range(1, self.size):
+                        self.send_message(Message(MSG_DONE, 0, rank))
+                    self.finish()
+                    return
+            self._order = hostrng.gen(self.seed, 0x7F1,
+                                      self._round).permutation(n)
+            self._batch_i = 0
+        idx = self._order[self._batch_i: self._batch_i + self.batch_size]
+        self._batch_i += self.batch_size
+        self._cur_idx = idx
+        self._partials = {}
+        for rank in range(1, self.size):
+            msg = Message(MSG_BATCH, 0, rank)
+            msg.add_params(ARG_ROUND, self._round)
+            msg.add_params(ARG_BATCH, np.asarray(idx, np.int64))
+            self.send_message(msg)
+
+    def _on_partial(self, msg):
+        sender = msg.get_sender_id()
+        with self._lock:
+            self._partials[sender] = np.asarray(msg.get(ARG_LOGITS))
+            if len(self._partials) < self.size - 1:
+                return
+            partials = list(self._partials.values())
+        idx = self._cur_idx
+        own = self.model.forward(jnp.asarray(self.x[idx]))
+        logits = own + sum(jnp.asarray(p) for p in partials)
+        loss, glogit = self._guest_grad(logits, jnp.asarray(self.y[idx]))
+        self.losses.append(float(loss))
+        self.model.backward(jnp.asarray(self.x[idx]), glogit)
+        for rank in range(1, self.size):
+            out = Message(MSG_GRAD, 0, rank)
+            out.add_params(ARG_GRAD, np.asarray(glogit))
+            self.send_message(out)
+        self._announce_batch()
+
+
+class VflHostManager(FedMLCommManager):
+    """Rank ≥ 1: feature-slice owner, no labels ever."""
+
+    def __init__(self, args, features: np.ndarray, num_classes: int,
+                 comm=None, rank: int = 1, size: int = 0,
+                 backend: str = "local"):
+        super().__init__(args, comm, rank, size, backend)
+        self.x = np.asarray(features, np.float32).reshape(
+            features.shape[0], -1)
+        lr = float(getattr(args, "learning_rate", 0.1))
+        seed = int(getattr(args, "random_seed", 0))
+        self.model = VerticalPartyModel(
+            self.x.shape[1], int(num_classes), lr,
+            rng_util.purpose_key(rng_util.root_key(seed), f"vfl{rank}"))
+        self._cur_idx = None
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_BATCH, self._on_batch)
+        self.register_message_receive_handler(MSG_GRAD, self._on_grad)
+        self.register_message_receive_handler(MSG_DONE,
+                                              lambda m: self.finish())
+
+    def _on_batch(self, msg):
+        idx = np.asarray(msg.get(ARG_BATCH), np.int64)
+        self._cur_idx = idx
+        logits = self.model.forward(jnp.asarray(self.x[idx]))
+        out = Message(MSG_PARTIAL, self.rank, 0)
+        out.add_params(ARG_LOGITS, np.asarray(logits))
+        self.send_message(out)
+
+    def _on_grad(self, msg):
+        glogit = jnp.asarray(np.asarray(msg.get(ARG_GRAD)))
+        self.model.backward(jnp.asarray(self.x[self._cur_idx]), glogit)
+
+
+__all__ = ["VflGuestManager", "VflHostManager"]

@@ -1,0 +1,89 @@
+"""Graph convolutional network for federated graph classification — the
+FedGraphNN app-zoo model family (reference
+``python/examples/federate/prebuilt_jobs/fedgraphnn`` trains GNNs over
+MoleculeNet-style datasets; the core repo ships no graph model).
+
+TPU-first formulation: graphs are padded to a fixed node count and fed as
+dense normalized adjacency + node-feature tensors, so a GCN layer is two
+batched matmuls (Â·X·W) on the MXU — no scatter/gather, no ragged shapes,
+one compiled step for any batch of graphs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def normalize_adjacency(adj: np.ndarray, node_mask: np.ndarray) -> np.ndarray:
+    """Â = D^{-1/2} (A + I) D^{-1/2}, masked to live nodes.  adj:
+    (..., N, N) 0/1, node_mask: (..., N)."""
+    eye = np.eye(adj.shape[-1], dtype=np.float32)
+    a = (adj + eye) * node_mask[..., None, :] * node_mask[..., :, None]
+    deg = a.sum(-1)
+    dinv = np.where(deg > 0, deg ** -0.5, 0.0)
+    return a * dinv[..., None, :] * dinv[..., :, None]
+
+
+class GCNLayer(nn.Module):
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, adj_norm):
+        h = nn.Dense(self.features, use_bias=True, dtype=self.dtype)(x)
+        return jnp.einsum("...ij,...jf->...if", adj_norm, h)
+
+
+class GCNGraphClassifier(nn.Module):
+    """(node_feats (B,N,F), adj_norm (B,N,N), node_mask (B,N)) → (B, C).
+
+    Mean-pool over live nodes after ``n_layers`` GCN+ReLU layers."""
+
+    num_classes: int
+    hidden: int = 64
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs, train: bool = False):
+        x, adj_norm, node_mask = inputs
+        for i in range(self.n_layers):
+            x = nn.relu(GCNLayer(self.hidden, self.dtype,
+                                 name=f"gcn_{i}")(x, adj_norm))
+        x = x * node_mask[..., None]
+        denom = jnp.maximum(node_mask.sum(-1, keepdims=True), 1.0)
+        pooled = x.sum(-2) / denom
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="readout")(pooled)
+
+
+def synthetic_graph_classification(n_graphs: int, n_nodes: int,
+                                   n_feats: int, classes: int,
+                                   seed: int = 0):
+    """Class-separable synthetic graphs: each class has a distinct edge
+    density and feature mean (the MoleculeNet stand-in for zero-egress
+    runs)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, classes, n_graphs)
+    dens = 0.15 + 0.5 * (y / max(classes - 1, 1))
+    sizes = rng.integers(max(3, n_nodes // 2), n_nodes + 1, n_graphs)
+    x = np.zeros((n_graphs, n_nodes, n_feats), np.float32)
+    adj = np.zeros((n_graphs, n_nodes, n_nodes), np.float32)
+    mask = np.zeros((n_graphs, n_nodes), np.float32)
+    for g in range(n_graphs):
+        m = sizes[g]
+        mask[g, :m] = 1.0
+        x[g, :m] = rng.normal(0.5 * y[g], 1.0, (m, n_feats))
+        upper = rng.random((m, m)) < dens[g]
+        a = np.triu(upper, 1)
+        adj[g, :m, :m] = a + a.T
+    adj_norm = normalize_adjacency(adj, mask)
+    return x, adj_norm, mask, y.astype(np.int64)
+
+
+__all__ = ["GCNGraphClassifier", "GCNLayer", "normalize_adjacency",
+           "synthetic_graph_classification"]

@@ -181,3 +181,55 @@ def test_text_transformer_fednlp_learns():
         api.train_one_round(r)
     _, acc1 = api.evaluate()
     assert acc1 > acc0 + 0.1, (acc0, acc1)
+
+
+def test_gcn_federated_graph_classification():
+    """FedGraphNN family: federated GCN graph classification — manual
+    FedAvg over per-client graph shards (dense padded adjacency, one
+    compiled step), accuracy clearly above chance."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from fedml_tpu.models.gcn import (GCNGraphClassifier,
+                                      synthetic_graph_classification)
+
+    classes, n_nodes, n_feats = 3, 12, 8
+    x, adj, mask, y = synthetic_graph_classification(360, n_nodes, n_feats,
+                                                     classes, seed=0)
+    tx_, vx_ = (x[:300], adj[:300], mask[:300], y[:300]), \
+               (x[300:], adj[300:], mask[300:], y[300:])
+
+    model = GCNGraphClassifier(num_classes=classes, hidden=32)
+    params = model.init(jax.random.PRNGKey(0),
+                        (jnp.asarray(tx_[0][:2]), jnp.asarray(tx_[1][:2]),
+                         jnp.asarray(tx_[2][:2])))
+    opt = optax.adam(5e-3)
+
+    def loss_fn(p, batch):
+        xb, ab, mb, yb = batch
+        logits = model.apply(p, (xb, ab, mb))
+        oh = jax.nn.one_hot(yb, classes)
+        return -jnp.mean(jnp.sum(oh * jax.nn.log_softmax(logits), -1))
+
+    @jax.jit
+    def local_steps(p, batch):
+        st = opt.init(p)
+        def body(carry, _):
+            p, st = carry
+            g = jax.grad(loss_fn)(p, batch)
+            up, st = opt.update(g, st)
+            return (optax.apply_updates(p, up), st), ()
+        (p, _), _ = jax.lax.scan(body, (p, st), None, length=8)
+        return p
+
+    # 3 clients, 5 FedAvg rounds
+    shards = [tuple(jnp.asarray(a[i::3]) for a in tx_) for i in range(3)]
+    for _ in range(5):
+        locals_ = [local_steps(params, s + ()) for s in shards]
+        params = jax.tree_util.tree_map(
+            lambda *ws: sum(ws) / len(ws), *locals_)
+
+    logits = model.apply(params, (jnp.asarray(vx_[0]), jnp.asarray(vx_[1]),
+                                  jnp.asarray(vx_[2])))
+    acc = float((np.asarray(logits).argmax(-1) == vx_[3]).mean())
+    assert acc > 0.6, acc

@@ -1,0 +1,83 @@
+"""Pipeline parallelism (GPipe-style) over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.9: "No — nothing
+in-repo"); this completes the parallelism matrix (DP / FSDP / TP / SP /
+EP / PP) the TPU stack offers.
+
+Formulation: stages are sharded over a mesh axis; microbatches circulate
+around the ICI ring via ``ppermute`` while a ``lax.scan`` steps the
+schedule — at step t, stage s computes on the activation it received at
+t−1 and forwards the result.  The classic pipeline bubble of
+``n_stages − 1`` steps falls out of the schedule; everything is static
+shapes and fully differentiable (scan + ppermute compose with autodiff),
+so ``jax.grad`` through :func:`pipeline_apply` IS pipelined backward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name: str):
+    """Run ``n_micro`` microbatches through an ``n_stages``-deep pipeline.
+
+    Must be called INSIDE ``shard_map`` over ``axis_name``:
+
+    - ``stage_params``: THIS device's stage parameters (pytree);
+    - ``microbatches``: (n_micro, mb, ...) — replicated input schedule
+      (only stage 0 reads it);
+    - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape`` (equal
+      inter-stage widths — the usual transformer-block contract).
+
+    Returns (n_micro, mb, ...) outputs of the LAST stage, replicated to
+    every stage via a masked psum so callers can compute the loss anywhere.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    my_stage = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    total_steps = n_micro + n_stages - 1
+    perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+    def step(carry, t):
+        state = carry                       # activation received last step
+        # stage 0 injects microbatch t (zeros once the schedule drains)
+        mb_idx = jnp.minimum(t, n_micro - 1)
+        fresh = jax.lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+                                             keepdims=False)
+        fresh = jnp.where(t < n_micro, fresh, jnp.zeros_like(fresh))
+        x = jnp.where(my_stage == 0, fresh, state)
+        y = stage_fn(stage_params, x)
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return nxt, y
+
+    state0 = jnp.zeros_like(microbatches[0])
+    _, ys = jax.lax.scan(step, state0, jnp.arange(total_steps))
+
+    # last stage's outputs at steps [n_stages-1, total) are the results;
+    # masked psum replicates them everywhere
+    out = ys[n_stages - 1:]
+    mask = (my_stage == n_stages - 1).astype(out.dtype)
+    return jax.lax.psum(out * mask, axis_name)
+
+
+def make_pipelined_forward(stage_fn, mesh, axis_name: str):
+    """jit-ready wrapper: (stacked_stage_params, microbatches) → outputs,
+    with stage params sharded over ``axis_name`` and inputs replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def fwd(stacked_params, microbatches):
+        def inner(params_shard, mb):
+            local = jax.tree_util.tree_map(lambda a: a[0], params_shard)
+            return pipeline_apply(stage_fn, local, mb, axis_name)
+
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(axis_name), P()),
+            out_specs=P(),
+            check_vma=False)(stacked_params, microbatches)
+
+    return jax.jit(fwd)
+
+
+__all__ = ["pipeline_apply", "make_pipelined_forward"]

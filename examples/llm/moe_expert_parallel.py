@@ -1,0 +1,51 @@
+"""Mixture-of-Experts Llama with expert parallelism — scale FFN capacity
+without scaling per-token FLOPs (no reference equivalent; SURVEY §2.9
+lists EP as absent there).
+
+Run:  python examples/llm/moe_expert_parallel.py
+(uses the virtual CPU mesh when no pod is attached)
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.core.mesh import make_mesh
+from fedml_tpu.llm.model import LlamaConfig, LlamaLM, causal_nll
+
+
+def main():
+    n_model = min(4, jax.device_count())
+    mesh = make_mesh(client=1, data=1, model=n_model, seq=1)
+    cfg = LlamaConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=128, max_seq_len=64,
+                      dtype=jnp.float32, attn_impl="blockwise",
+                      n_experts=4, moe_top_k=2)
+    model = LlamaLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 64), 0, 512)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    def loss_fn(p):
+        logits, state = model.apply({"params": p}, tokens,
+                                    mutable=["losses"])
+        aux = sum(jnp.asarray(v).sum()
+                  for v in jax.tree_util.tree_leaves(state["losses"]))
+        return causal_nll(logits[:, :-1], tokens[:, 1:]) + 0.01 * aux
+
+    @jax.jit
+    def step(p, opt):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        upd, opt = tx.update(g, opt)
+        return optax.apply_updates(p, upd), opt, loss
+
+    with mesh:  # experts shard over the `model` axis inside the jit
+        for i in range(20):
+            params, opt, loss = step(params, opt)
+            if (i + 1) % 5 == 0:
+                print(f"step {i + 1}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -96,9 +96,16 @@ def init_multihost(spec: Optional[MultiHostSpec] = None, *,
                 f"over {spec.num_processes} processes")
         from jax.experimental import mesh_utils
         ici_shape = (sizes[CLIENT_AXIS] // spec.num_processes,) + shape[1:]
-        devices = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=ici_shape,
-            dcn_mesh_shape=(spec.num_processes,) + (1,) * (len(shape) - 1))
+        try:
+            devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=ici_shape,
+                dcn_mesh_shape=(spec.num_processes,) + (1,) * (len(shape) - 1))
+        except ValueError:
+            # no slice topology (CPU multi-process, single-slice pods):
+            # global devices are already ordered process-major, which puts
+            # the client axis across processes as intended
+            import numpy as np
+            devices = np.asarray(jax.devices()).reshape(shape)
         return jax.sharding.Mesh(devices, AXES)
     from .mesh import make_mesh
     return make_mesh(**{CLIENT_AXIS: sizes[CLIENT_AXIS],

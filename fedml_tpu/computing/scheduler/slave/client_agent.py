@@ -84,6 +84,20 @@ class FedMLClientAgent:
                     alive = True
                 except (ProcessLookupError, PermissionError, ValueError):
                     alive = False
+            ws_done = info.get("ws", "")
+            rc_path = os.path.join(ws_done, "run.rc") if ws_done else ""
+            if not alive and rc_path and os.path.exists(rc_path):
+                # the job FINISHED while the agent was down and persisted
+                # its exit code — report it, never re-run completed work
+                try:
+                    with open(rc_path) as f:
+                        rc = int(f.read().strip())
+                except (OSError, ValueError):
+                    rc = -1
+                log.info("agent %d: run %s completed during downtime "
+                         "(rc=%d)", self.device_id, run_id, rc)
+                self._on_run_exit(run_id, rc)
+                continue
             if alive:
                 log.info("agent %d: re-adopting run %s (pid %s)",
                          self.device_id, run_id, pid)
@@ -154,13 +168,15 @@ class FedMLClientAgent:
     def _on_start(self, msg: Message) -> None:
         run_id = str(msg.get(MSG_ARG_RUN_ID))
         # idempotency: a respawned agent's fresh comm channel replays old
-        # control files; a run this device has ALREADY acted on (any
-        # agent-side status in the run DB) belongs to recover_runs, and a
-        # duplicate spawn here would leave an unreaped child that pid
-        # adoption then mistakes for a live orphan
-        if self.run_db.get_status(run_id, self.device_id) is not None:
+        # control files; a run this device is still ACTIVELY tracking
+        # belongs to recover_runs, and a duplicate spawn would leave an
+        # unreaped child that pid adoption mistakes for a live orphan.
+        # Terminal statuses do NOT block: a re-dispatch of a FAILED/KILLED
+        # run is a legitimate new attempt.
+        existing = self.run_db.get_status(run_id, self.device_id)
+        if existing is not None and not RunStatus.is_terminal(existing):
             log.info("agent %d: ignoring duplicate START_RUN for %s "
-                     "(already tracked)", self.device_id, run_id)
+                     "(active, status %s)", self.device_id, run_id, existing)
             return
         pkg = str(msg.get(MSG_ARG_PACKAGE))
         entry = str(msg.get(MSG_ARG_ENTRY) or "")

@@ -37,6 +37,7 @@ class AgentDaemon:
         self.window_s = float(window_s)
         self.backoff_s = float(backoff_s)
         self.proc: Optional[subprocess.Popen] = None
+        self._logf = None
         self.restarts: List[float] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -58,8 +59,10 @@ class AgentDaemon:
                "fedml_tpu.computing.scheduler.slave.agent_main",
                *self.agent_args, "--work-dir", self.work_dir]
         log_path = os.path.join(self.work_dir, "agent_daemon.log")
-        logf = open(log_path, "ab")
-        return subprocess.Popen(cmd, env=env, stdout=logf,
+        if self._logf is None:  # one handle for the daemon's lifetime —
+            # per-respawn opens leaked an fd per OTA/crash cycle
+            self._logf = open(log_path, "ab")
+        return subprocess.Popen(cmd, env=env, stdout=self._logf,
                                 stderr=subprocess.STDOUT)
 
     def _loop(self) -> None:
@@ -101,6 +104,9 @@ class AgentDaemon:
                 self.proc.wait()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+        if self._logf is not None:
+            self._logf.close()
+            self._logf = None
 
     def agent_pid(self, timeout_s: float = 60.0) -> int:
         """Pid of the CURRENT agent process (survives respawns via the

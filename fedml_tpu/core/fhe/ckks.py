@@ -184,10 +184,15 @@ class CkksCodec:
     is_cryptographic = True
 
     def __init__(self, seed: int):
-        rng = np.random.default_rng(seed ^ 0xC1C5)
-        s = rng.integers(-1, 2, N).astype(np.int64)       # ternary secret
+        # ONLY the secret derives from the shared seed (all key holders
+        # must agree on s).  Per-encryption randomness (a, e) comes from OS
+        # entropy: if clients shared a deterministic stream, two ciphertexts
+        # would reuse (a, e) and the server could read plaintext
+        # differences by subtraction.
+        key_rng = np.random.default_rng(seed ^ 0xC1C5)
+        s = key_rng.integers(-1, 2, N).astype(np.int64)   # ternary secret
         self._s_hat = np.stack([t.fwd(s % t.p) for t in _NTT])
-        self._rng = rng
+        self._rng = np.random.default_rng()               # OS-entropy seeded
 
     # -- helpers -----------------------------------------------------------
     def _poly_mul_s(self, c1: np.ndarray) -> np.ndarray:

@@ -91,7 +91,21 @@ def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
     if not _backend_already_up() and forced.lower() not in ("cpu",):
         timeout_s = float(os.environ.get(
             "FEDML_TPU_DEVICE_PROBE_TIMEOUT", "120") or 120)
-        if timeout_s > 0 and not _probe_backend_subprocess(timeout_s):
+        # a machine-local success marker skips the subprocess probe on
+        # healthy machines (it costs a full extra plugin init); stale
+        # markers expire so a later wedge is still caught
+        marker = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"fedml_tpu_probe_ok_uid{os.getuid()}")
+        marker_fresh = False
+        try:
+            import time as _time
+            marker_fresh = (os.path.exists(marker) and
+                            _time.time() - os.path.getmtime(marker) < 3600)
+        except OSError:
+            pass
+        if timeout_s > 0 and not marker_fresh \
+                and not _probe_backend_subprocess(timeout_s):
             log.error(
                 "accelerator init HUNG >%ss in the liveness probe "
                 "(wedged tunnel?); forcing the CPU backend for this "
@@ -104,6 +118,12 @@ def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
             BACKEND_NOTE = (f"cpu fallback (accelerator init hung "
                             f">{timeout_s:.0f}s)")
             return devices
+        if not marker_fresh:
+            try:  # probe succeeded (or was skipped): refresh the marker
+                with open(marker, "w") as f:
+                    f.write("ok\n")
+            except OSError:
+                pass
     for attempt in range(1, retries + 1):
         try:
             devices = jax.devices()

@@ -49,6 +49,9 @@ class FACrossSiloServer(FedMLCommManager):
         self._started = False
         self._onboard_timer: Optional[threading.Timer] = None
         self._start_lock = threading.Lock()
+        #: submissions needed to close a round (shrinks to the live cohort
+        #: on onboarding timeout)
+        self._expected = self.client_num
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -94,6 +97,10 @@ class FACrossSiloServer(FedMLCommManager):
             self._onboard_timer = None
             if self._started:
                 return
+            # quorum shrinks to the live cohort: without this, starting
+            # with a partial cohort converts the visible onboarding stall
+            # into a silent mid-round stall in _handle_submission
+            self._expected = max(1, len(self._online))
             log.warning(
                 "fa server: onboarding timeout — broadcasting round 0 with "
                 "%d/%d clients online", len(self._online), self.client_num)
@@ -105,7 +112,7 @@ class FACrossSiloServer(FedMLCommManager):
         self._submissions[sender] = (
             float(msg_params.get(FAMessage.ARG_SAMPLE_NUM, 1.0)),
             msg_params.get(FAMessage.ARG_SUBMISSION))
-        if len(self._submissions) < self.client_num:
+        if len(self._submissions) < self._expected:
             return
         subs = [self._submissions[r] for r in sorted(self._submissions)]
         self.result = self.aggregator.aggregate(subs)

@@ -308,3 +308,27 @@ def test_fhe_mock_requires_explicit_optin(caplog):
         fhe_backend = "nope"
     with pytest.raises(ValueError):
         FedMLFHE().init(B())
+
+
+def test_fhe_ckks_no_randomness_reuse_across_clients():
+    """Two codecs with the SAME shared seed (two clients) must produce
+    ciphertexts with different (a, e): c0_A - c0_B must NOT equal
+    Delta*(m_A - m_B) — otherwise an honest-but-curious server reads
+    plaintext differences by subtraction."""
+    import numpy as np
+    from fedml_tpu.core.fhe.ckks import CkksCodec, N, DELTA_BITS, _PRIMES
+
+    a = CkksCodec(seed=7)
+    b = CkksCodec(seed=7)
+    xa = np.zeros(N); xa[0] = 1.0
+    xb = np.zeros(N); xb[0] = 2.0
+    ca, cb = a.encrypt(xa), b.encrypt(xb)
+    # identical randomness would make c1s equal
+    assert not np.array_equal(ca.c1, cb.c1)
+    # and the c0 difference would be exactly the plaintext difference
+    p1 = _PRIMES[0]
+    diff = (ca.c0[0, 0] - cb.c0[0, 0]) % p1
+    expected_leak = (int(round(-1.0 * (1 << DELTA_BITS)))) % p1
+    assert diff[0] != expected_leak
+    # same-key decryption still works across instances (shared secret)
+    np.testing.assert_allclose(b.decrypt(ca)[:4], xa[:4], atol=1e-6)

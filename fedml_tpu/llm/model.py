@@ -282,6 +282,10 @@ def config_from_args(args, vocab: Optional[int] = None) -> LlamaConfig:
     impl = getattr(args, "attn_impl", None)
     if impl:
         overrides["attn_impl"] = str(impl)
+    n_experts = getattr(args, "n_experts", None)
+    if n_experts is not None:
+        overrides["n_experts"] = int(n_experts)
+        overrides["moe_top_k"] = int(getattr(args, "moe_top_k", 2))
     return dataclasses.replace(base, **overrides)
 
 

@@ -162,3 +162,26 @@ def test_llama_moe_ep_engages_under_context_mesh():
     assert any(seen), "EP constraint never engaged through LlamaLM"
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5,
                                rtol=1e-4)
+
+
+def test_moe_via_args_in_causal_lm_trainer():
+    """args.n_experts plumbs MoE into the standard LLM surface
+    (config_from_args -> build_causal_lm); a centralized trainer step runs
+    and the aux-loss sow is a safe no-op when the collection isn't
+    mutable."""
+    import types
+    from fedml_tpu.llm.model import config_from_args, build_causal_lm
+
+    args = types.SimpleNamespace(model="tiny_llama", n_experts=4,
+                                 moe_top_k=2, seq_len=16, llm_dim=32,
+                                 llm_n_layers=1, llm_n_heads=2,
+                                 llm_n_kv_heads=2, llm_ffn_dim=64,
+                                 attn_impl="blockwise")
+    cfg = config_from_args(args, vocab=64)
+    assert cfg.n_experts == 4 and cfg.moe_top_k == 2
+    fm = build_causal_lm(args, vocab=64)
+    params = fm.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    logits = fm.apply(params, toks)   # no mutable collections: sow no-ops
+    assert logits.shape == (2, 16, 64)
+    assert np.isfinite(np.asarray(logits)).all()

@@ -37,5 +37,9 @@ class CrossCloudClientManager(ClientMasterManager):
                          backend or getattr(args, "backend", DEFAULT_BACKEND))
 
 
+from .hierarchy import (CloudBridgeManager, CloudMsg,  # noqa: E402
+                        GlobalCoordinator)
+
 __all__ = ["CrossCloudServerManager", "CrossCloudClientManager",
-           "FedMLAggregator", "TrainerDistAdapter", "DEFAULT_BACKEND"]
+           "FedMLAggregator", "TrainerDistAdapter", "DEFAULT_BACKEND",
+           "CloudBridgeManager", "GlobalCoordinator", "CloudMsg"]

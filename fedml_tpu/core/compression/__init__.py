@@ -1,0 +1,16 @@
+"""Communication-efficiency compression (reference
+``python/fedml/utils/compression.py`` rebuilt as pure pytree transforms —
+see ``compressors.py``)."""
+
+from .compressors import (EFTopKCompressor, NoneCompressor, QSGDCompressor,
+                          QuantizationCompressor, TopKCompressor,
+                          create_compressor, is_compressed_payload,
+                          payload_nbytes, tree_nbytes)
+from .fedml_compression import FedMLCompression
+
+__all__ = [
+    "NoneCompressor", "TopKCompressor", "EFTopKCompressor",
+    "QuantizationCompressor", "QSGDCompressor", "create_compressor",
+    "is_compressed_payload", "payload_nbytes", "tree_nbytes",
+    "FedMLCompression",
+]

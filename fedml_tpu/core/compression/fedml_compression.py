@@ -1,0 +1,108 @@
+"""Config-gated compression orchestrator, wired the same way as the DP /
+defense singletons (reference keeps its compressors as a bare utils module,
+``python/fedml/utils/compression.py``, used ad-hoc from FedGKT; here
+compression is a first-class trust-stack-style plugin on the WAN upload
+path).
+
+YAML surface::
+
+    comm_args:
+      enable_compression: true
+      compression_type: eftopk        # none|topk|eftopk|quantize|qsgd
+      compression_ratio: 0.05         # topk/eftopk
+      compression_bits: 8             # quantize (1..16) / qsgd (1..7)
+      compression_is_biased: false    # quantize rounding mode
+
+Client side compresses the model upload (``compress_upload``), server side
+transparently decompresses (``maybe_decompress``); payloads are
+self-describing so the server needs no config agreement beyond having the
+package installed.  Error-feedback residual state is keyed per client id
+(and lock-protected) because the in-memory ``local`` backend runs several
+client threads inside one process.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .compressors import (create_compressor, is_compressed_payload,
+                          payload_nbytes, tree_nbytes)
+
+
+class FedMLCompression:
+    _instance = None
+
+    @classmethod
+    def get_instance(cls) -> "FedMLCompression":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.is_enabled = False
+        self.compressor = None
+        self._ef_states = {}
+        self._decoders = {}
+        self._lock = threading.Lock()
+        self.last_ratio = None  # wire bytes / dense bytes, for observability
+
+    def init(self, args):
+        # full reset so a later federation without compression in the same
+        # process doesn't inherit the previous run's compressor/residuals
+        with self._lock:
+            self.is_enabled = False
+            self.compressor = None
+            self._ef_states = {}
+            self.last_ratio = None
+        if args is None or not getattr(args, "enable_compression", False):
+            return
+        name = str(getattr(args, "compression_type", "topk"))
+        kw = {}
+        lname = name.strip().lower()
+        if lname in ("topk", "eftopk"):
+            kw["ratio"] = float(getattr(args, "compression_ratio", 0.05))
+        if lname in ("quantize", "qsgd"):
+            kw["bits"] = int(getattr(args, "compression_bits",
+                                     8 if lname == "quantize" else 4))
+            kw["seed"] = int(getattr(args, "random_seed", 0))
+        if lname == "quantize":
+            kw["is_biased"] = bool(getattr(args, "compression_is_biased",
+                                           True))
+        compressor = create_compressor(name, **kw)  # raises on bad config
+        with self._lock:
+            self.compressor = compressor
+            self.is_enabled = True
+
+    def is_compression_enabled(self) -> bool:
+        return self.is_enabled
+
+    def compress_upload(self, tree, client_id=0):
+        """Client upload path: returns the wire payload (or the tree
+        unchanged when disabled).  ``client_id`` keys the error-feedback
+        residual so co-resident client threads don't cross-contaminate."""
+        if not self.is_enabled:
+            return tree
+        with self._lock:
+            state = self._ef_states.get(client_id)
+            payload, new_state = self.compressor.compress(tree, state)
+            if new_state is not None:
+                self._ef_states[client_id] = new_state
+            dense = tree_nbytes(tree)
+            if dense:
+                self.last_ratio = payload_nbytes(payload) / dense
+        return payload
+
+    def maybe_decompress(self, obj):
+        """Server receive path: payloads are self-describing, so this is
+        safe to call unconditionally on any incoming model blob.  Decoders
+        are cached per kind (servers typically never call ``init``)."""
+        if not is_compressed_payload(obj):
+            return obj
+        kind = obj["__compressed__"]
+        if self.compressor is not None and self.compressor.name == kind:
+            return self.compressor.decompress(obj)
+        with self._lock:
+            dec = self._decoders.get(kind)
+            if dec is None:
+                dec = self._decoders[kind] = create_compressor(kind)
+        return dec.decompress(obj)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import rng as rng_util
+from ...core.compression import FedMLCompression
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...ml.trainer.local_trainer import LocalTrainer, ServerCtx
@@ -32,6 +33,7 @@ class ClientMasterManager(FedMLCommManager):
         super().__init__(args, comm, rank, size, backend)
         self.trainer_adapter = trainer_adapter
         self.num_rounds = int(getattr(args, "comm_round", 10))
+        FedMLCompression.get_instance().init(args)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -57,6 +59,13 @@ class ClientMasterManager(FedMLCommManager):
         log_training_status("TRAINING")
         self.trainer_adapter.announce_round(round_idx, params, data_idx)
         new_params, n = self.trainer_adapter.train(params, data_idx, round_idx)
+        comp = FedMLCompression.get_instance()
+        if comp.is_compression_enabled():
+            new_params = comp.compress_upload(new_params,
+                                              client_id=self.rank)
+            if comp.last_ratio is not None:
+                log.info("client %d upload compressed to %.1f%% of dense",
+                         self.rank, 100.0 * comp.last_ratio)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, new_params)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))

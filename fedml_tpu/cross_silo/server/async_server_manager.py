@@ -19,6 +19,7 @@ import logging
 import threading
 
 from ...core import tree as tree_util
+from ...core.compression import FedMLCompression
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ..message_define import MyMessage
@@ -81,7 +82,8 @@ class AsyncFedMLServerManager(FedMLCommManager):
 
     def _on_upload(self, msg):
         sender = msg.get_sender_id()
-        params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        params = FedMLCompression.get_instance().maybe_decompress(
+            msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         with self._lock:
             base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or
                                self._dispatched_version.get(sender, 0))

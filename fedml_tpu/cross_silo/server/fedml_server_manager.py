@@ -8,6 +8,7 @@ from __future__ import annotations
 import logging
 import threading
 
+from ...core.compression import FedMLCompression
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
 from ...mlops import log_round_info, log_aggregation_status
@@ -162,7 +163,8 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg_params):
         sender = msg_params.get_sender_id()
-        params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        params = FedMLCompression.get_instance().maybe_decompress(
+            msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         n = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         with self._round_lock:
             msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)

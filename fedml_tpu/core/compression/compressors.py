@@ -65,7 +65,14 @@ def payload_nbytes(payload) -> int:
 
 
 def tree_nbytes(tree) -> int:
-    return sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(tree))
+    """Dense byte size from shape/dtype metadata only — no device→host
+    transfer (the leaves may live in accelerator HBM)."""
+    total = 0
+    for l in jax.tree_util.tree_leaves(tree):
+        size = int(np.prod(l.shape)) if getattr(l, "shape", ()) else 1
+        itemsize = np.dtype(getattr(l, "dtype", np.float32)).itemsize
+        total += size * itemsize
+    return total
 
 
 class NoneCompressor:

@@ -25,6 +25,9 @@ from __future__ import annotations
 
 import threading
 
+import jax
+import jax.numpy as jnp
+
 from .compressors import (create_compressor, is_compressed_payload,
                           payload_nbytes, tree_nbytes)
 
@@ -76,33 +79,59 @@ class FedMLCompression:
     def is_compression_enabled(self) -> bool:
         return self.is_enabled
 
-    def compress_upload(self, tree, client_id=0):
+    def compress_upload(self, tree, base=None, client_id=0):
         """Client upload path: returns the wire payload (or the tree
-        unchanged when disabled).  ``client_id`` keys the error-feedback
-        residual so co-resident client threads don't cross-contaminate."""
+        unchanged when disabled).
+
+        When ``base`` (the global params this round started from) is given,
+        the DELTA ``tree - base`` is compressed and the payload is tagged so
+        the server adds the base back — sparsifying absolute parameters
+        would zero most of the model, while round deltas are exactly what
+        top-k/QSGD theory assumes (and what error feedback accumulates).
+        ``client_id`` keys the EF residual so co-resident client threads
+        don't cross-contaminate."""
         if not self.is_enabled:
             return tree
+        to_send = tree
+        if base is not None:
+            to_send = jax.tree_util.tree_map(
+                lambda a, b: jnp.asarray(a) - jnp.asarray(b), tree, base)
         with self._lock:
             state = self._ef_states.get(client_id)
-            payload, new_state = self.compressor.compress(tree, state)
+        payload, new_state = self.compressor.compress(to_send, state)
+        with self._lock:
             if new_state is not None:
                 self._ef_states[client_id] = new_state
-            dense = tree_nbytes(tree)
-            if dense:
-                self.last_ratio = payload_nbytes(payload) / dense
+        if base is not None:
+            payload["__delta__"] = True
+        dense = tree_nbytes(tree)
+        if dense:
+            self.last_ratio = payload_nbytes(payload) / dense
         return payload
 
-    def maybe_decompress(self, obj):
+    def maybe_decompress(self, obj, base=None):
         """Server receive path: payloads are self-describing, so this is
         safe to call unconditionally on any incoming model blob.  Decoders
-        are cached per kind (servers typically never call ``init``)."""
+        are cached per kind (servers typically never call ``init``).
+        Delta-tagged payloads are reconstructed against ``base`` — the
+        global params the server dispatched to that client."""
         if not is_compressed_payload(obj):
             return obj
         kind = obj["__compressed__"]
         if self.compressor is not None and self.compressor.name == kind:
-            return self.compressor.decompress(obj)
-        with self._lock:
-            dec = self._decoders.get(kind)
-            if dec is None:
-                dec = self._decoders[kind] = create_compressor(kind)
-        return dec.decompress(obj)
+            dec = self.compressor
+        else:
+            with self._lock:
+                dec = self._decoders.get(kind)
+                if dec is None:
+                    dec = self._decoders[kind] = create_compressor(kind)
+        tree = dec.decompress(obj)
+        if obj.get("__delta__"):
+            if base is None:
+                raise ValueError(
+                    "compressed payload is a delta but no base params were "
+                    "provided for reconstruction")
+            tree = jax.tree_util.tree_map(
+                lambda d, b: jnp.asarray(b) + jnp.asarray(d, b.dtype)
+                if hasattr(b, "dtype") else b + d, tree, base)
+        return tree

@@ -61,7 +61,9 @@ class ClientMasterManager(FedMLCommManager):
         new_params, n = self.trainer_adapter.train(params, data_idx, round_idx)
         comp = FedMLCompression.get_instance()
         if comp.is_compression_enabled():
-            new_params = comp.compress_upload(new_params,
+            # compress the round DELTA against the global params we were
+            # sent — sparsifying absolute weights would zero the model
+            new_params = comp.compress_upload(new_params, base=params,
                                               client_id=self.rank)
             if comp.last_ratio is not None:
                 log.info("client %d upload compressed to %.1f%% of dense",

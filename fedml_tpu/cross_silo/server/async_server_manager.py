@@ -42,6 +42,7 @@ class AsyncFedMLServerManager(FedMLCommManager):
         self.updates_done = 0
         #: model version each client last received (for staleness)
         self._dispatched_version = {}
+        self._dispatched_params = {}
         self._version = 0
         self._online = set()
         self._started = False
@@ -67,12 +68,16 @@ class AsyncFedMLServerManager(FedMLCommManager):
             self._dispatch(rank, MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
 
     def _dispatch(self, rank: int, mtype) -> None:
+        dispatched = self.aggregator.get_global_model_params()
         msg = Message(mtype, self.rank, rank)
-        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                       self.aggregator.get_global_model_params())
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, dispatched)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, rank - 1)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._version)
         self._dispatched_version[rank] = self._version
+        # kept so a compressed (delta) upload reconstructs against the exact
+        # params this client trained from, not the since-advanced global —
+        # one model copy per in-flight client (cross-silo scale)
+        self._dispatched_params[rank] = dispatched
         self.send_message(msg)
 
     # -- async mix ---------------------------------------------------------
@@ -83,7 +88,8 @@ class AsyncFedMLServerManager(FedMLCommManager):
     def _on_upload(self, msg):
         sender = msg.get_sender_id()
         params = FedMLCompression.get_instance().maybe_decompress(
-            msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+            msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS),
+            base=self._dispatched_params.get(sender))
         with self._lock:
             base_version = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or
                                self._dispatched_version.get(sender, 0))

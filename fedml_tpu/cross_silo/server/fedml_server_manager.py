@@ -163,8 +163,7 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg_params):
         sender = msg_params.get_sender_id()
-        params = FedMLCompression.get_instance().maybe_decompress(
-            msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        raw = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         n = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         with self._round_lock:
             msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
@@ -173,6 +172,10 @@ class FedMLServerManager(FedMLCommManager):
                             "client %d (now at round %d)", msg_round, sender,
                             self.args.round_idx)
                 return
+            # decompress AFTER the stale check (delta payloads reconstruct
+            # against this round's still-unchanged global params)
+            params = FedMLCompression.get_instance().maybe_decompress(
+                raw, base=self.aggregator.get_global_model_params())
             self.aggregator.add_local_trained_result(
                 self.client_real_ids.index(sender), params, n)
             if not self.aggregator.check_whether_all_receive():

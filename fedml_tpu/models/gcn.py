@@ -61,6 +61,35 @@ class GCNGraphClassifier(nn.Module):
                         name="readout")(pooled)
 
 
+class GCNPacked(nn.Module):
+    """`model_hub.create` adapter: one dense input of shape
+    ``(B, N, N + F + 1)`` packing ``[adj_norm | node_feats | node_mask]``
+    column-blocks per node, so the graph model rides the standard
+    single-tensor trainer/dataset plumbing (``pack_graph_batch`` builds it).
+    """
+
+    num_classes: int
+    n_nodes: int
+    hidden: int = 64
+    n_layers: int = 2
+
+    @nn.compact
+    def __call__(self, packed, train: bool = False):
+        n = self.n_nodes
+        adj_norm = packed[..., :n]
+        x = packed[..., n:-1]
+        node_mask = packed[..., -1]
+        return GCNGraphClassifier(self.num_classes, self.hidden,
+                                  self.n_layers, name="gcn")(
+            (x, adj_norm, node_mask), train=train)
+
+
+def pack_graph_batch(x, adj_norm, mask):
+    """Pack (B,N,F), (B,N,N), (B,N) into the (B,N,N+F+1) GCNPacked input."""
+    return np.concatenate(
+        [adj_norm, x, mask[..., None]], axis=-1).astype(np.float32)
+
+
 def synthetic_graph_classification(n_graphs: int, n_nodes: int,
                                    n_feats: int, classes: int,
                                    seed: int = 0):
@@ -85,5 +114,6 @@ def synthetic_graph_classification(n_graphs: int, n_nodes: int,
     return x, adj_norm, mask, y.astype(np.int64)
 
 
-__all__ = ["GCNGraphClassifier", "GCNLayer", "normalize_adjacency",
+__all__ = ["GCNGraphClassifier", "GCNLayer", "GCNPacked",
+           "normalize_adjacency", "pack_graph_batch",
            "synthetic_graph_classification"]

@@ -83,6 +83,27 @@ def create(args, output_dim: int = 10) -> FlaxModel:
     if name in ("transformer", "gpt", "llama", "tiny_llama"):
         from ..llm.model import build_causal_lm
         return build_causal_lm(args, output_dim)
+    if name.startswith("vgg"):
+        # reference python/fedml/model/cv/vgg.py (GroupNorm'd here —
+        # BatchNorm statistics don't federate; see models/vgg.py)
+        from .vgg import vgg11, vgg13, vgg16, vgg19
+        builders = {"vgg": vgg11, "vgg11": vgg11, "vgg13": vgg13,
+                    "vgg16": vgg16, "vgg19": vgg19}
+        if name not in builders:
+            raise ValueError(f"unknown model {name!r}; "
+                             f"vgg variants: {sorted(builders)}")
+        return FlaxModel(builders[name](output_dim), _img_shape(args))
+    if name in ("gcn", "graph", "fedgraphnn"):
+        # FedGraphNN graph-classification family (models/gcn.py); input =
+        # (N, N+F+1) dense pack of [adj_norm | feats | mask] per graph
+        from .gcn import GCNPacked
+        n_nodes = int(getattr(args, "max_nodes", 32))
+        feat = int(getattr(args, "node_feature_dim", 16))
+        m = GCNPacked(num_classes=output_dim, n_nodes=n_nodes,
+                      hidden=int(getattr(args, "model_dim", 64)),
+                      n_layers=int(getattr(args, "model_layers", 2)))
+        return FlaxModel(m, (n_nodes, n_nodes + feat + 1),
+                         task="classification")
     if name in ("distilbert", "bert", "transformer_cls", "text_transformer"):
         # the FedNLP text-classification workload (reference fednlp app
         # zoo fine-tunes HF DistilBERT; this is the in-repo TPU-first

@@ -1,0 +1,109 @@
+"""Vertical-FL (finance) party models (reference
+``python/fedml/model/finance/vfl_models_standalone.py`` — ``DenseModel`` /
+``LocalModel`` with explicit ``forward(x)`` / ``backward(x, grads)``
+surfaces, and ``vfl_classifier.py`` / ``vfl_feature_extractor.py``).
+
+The split-learning protocol needs exactly two primitives per party: run the
+local sub-model forward to an activation, and later push the upstream
+gradient back through it (updating local weights and returning the input
+gradient for the next party down).  The reference implements that with
+torch autograd + an embedded SGD optimizer per model; here each party is a
+functional jax module whose ``forward``/``backward`` pair comes from one
+``jax.vjp`` — backward replays the linearization, applies the optimizer
+update, and hands back ``dL/dx``, all jitted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _dense_init(key, in_dim, out_dim, bias=True):
+    k1, _ = jax.random.split(key)
+    scale = 1.0 / np.sqrt(in_dim)
+    params = {"kernel": jax.random.uniform(k1, (in_dim, out_dim),
+                                           jnp.float32, -scale, scale)}
+    if bias:
+        params["bias"] = jnp.zeros((out_dim,), jnp.float32)
+    return params
+
+
+class _SplitPartyModule:
+    """Shared machinery: holds params + optimizer, exposes the reference's
+    forward/backward split surface."""
+
+    def __init__(self, in_dim: int, out_dim: int, learning_rate: float,
+                 seed: int = 0, bias: bool = True):
+        self.in_dim = int(in_dim)
+        self.output_dim = int(out_dim)
+        self.params = _dense_init(jax.random.PRNGKey(seed), in_dim, out_dim,
+                                  bias)
+        # reference embeds SGD(momentum=0.9, weight_decay=0.01) in the model
+        self.tx = optax.chain(
+            optax.add_decayed_weights(0.01),
+            optax.sgd(float(learning_rate), momentum=0.9))
+        self.opt_state = self.tx.init(self.params)
+
+        def fwd(params, x):
+            return self._apply(params, x)
+
+        def bwd(params, opt_state, x, grads):
+            _, vjp = jax.vjp(fwd, params, x)
+            pgrads, xgrad = vjp(grads)
+            updates, opt_state = self.tx.update(pgrads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, xgrad
+
+        self._fwd = jax.jit(fwd)
+        self._bwd = jax.jit(bwd)
+
+    def _apply(self, params, x):
+        raise NotImplementedError
+
+    def forward(self, x):
+        """Reference ``DenseModel.forward`` — activation for the upstream
+        party, returned as host numpy (it crosses a party boundary)."""
+        return np.asarray(self._fwd(self.params, jnp.asarray(x, jnp.float32)))
+
+    def backward(self, x, grads):
+        """Reference ``DenseModel.backward`` — applies the local update and
+        returns dL/dx for the party below."""
+        self.params, self.opt_state, xgrad = self._bwd(
+            self.params, self.opt_state, jnp.asarray(x, jnp.float32),
+            jnp.asarray(grads, jnp.float32))
+        return np.asarray(xgrad)
+
+
+class VFLClassifier(_SplitPartyModule):
+    """Guest-side top model: one linear layer over concatenated party
+    activations (reference ``vfl_classifier.py`` / ``DenseModel``)."""
+
+    def _apply(self, params, x):
+        y = x @ params["kernel"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+
+class VFLFeatureExtractor(_SplitPartyModule):
+    """Host-side bottom model: linear + LeakyReLU (reference
+    ``vfl_feature_extractor.py`` / ``LocalModel``)."""
+
+    def _apply(self, params, x):
+        y = x @ params["kernel"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return jax.nn.leaky_relu(y)
+
+    def get_output_dim(self) -> int:
+        return self.output_dim
+
+
+# reference vfl_models_standalone.py aliases
+DenseModel = VFLClassifier
+LocalModel = VFLFeatureExtractor

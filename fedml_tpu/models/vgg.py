@@ -1,0 +1,65 @@
+"""VGG with GroupNorm (reference ``python/fedml/model/cv/vgg.py`` —
+VGG-11/13/16/19 with optional BatchNorm).
+
+FL/TPU adaptation mirrors the ResNet treatment (``models/resnet.py``):
+GroupNorm replaces BatchNorm so client statistics federate correctly and
+the model stays a pure function of params (no mutable batch_stats under
+jit).  NHWC layout; convs stay 3x3 so XLA tiles them onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# reference vgg.py cfg dicts: number = conv filters, "M" = maxpool
+_CFGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    13: (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int
+    groups: int = 8
+    dense_dim: int = 512
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for v in self.cfg:
+            if v == "M":
+                # shapes are static under jit: skip pools that would collapse
+                # a small input (e.g. 16x16 federated images) to zero size
+                if min(x.shape[1], x.shape[2]) >= 2:
+                    x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding="SAME", use_bias=False)(x)
+                x = nn.GroupNorm(num_groups=min(self.groups, int(v)))(x)
+                x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool (any input size)
+        x = nn.relu(nn.Dense(self.dense_dim)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def vgg11(num_classes: int) -> VGG:
+    return VGG(_CFGS[11], num_classes)
+
+
+def vgg13(num_classes: int) -> VGG:
+    return VGG(_CFGS[13], num_classes)
+
+
+def vgg16(num_classes: int) -> VGG:
+    return VGG(_CFGS[16], num_classes)
+
+
+def vgg19(num_classes: int) -> VGG:
+    return VGG(_CFGS[19], num_classes)

@@ -24,6 +24,7 @@ compressor keeping ``self.residuals``.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 import jax
@@ -170,6 +171,7 @@ class QuantizationCompressor:
         self.bits = int(bits)
         self.is_biased = bool(is_biased)
         self._key = jax.random.PRNGKey(seed ^ 0xC0)
+        self._key_lock = threading.Lock()
 
     def compress(self, tree, state=None):
         levels = (1 << self.bits) - 1
@@ -183,7 +185,8 @@ class QuantizationCompressor:
             if self.is_biased:
                 q = jnp.round(q)
             else:
-                self._key, sub = jax.random.split(self._key)
+                with self._key_lock:  # co-resident client threads
+                    self._key, sub = jax.random.split(self._key)
                 q = jnp.floor(q + jax.random.uniform(sub, q.shape))
             return {
                 _CLEAF: 1,
@@ -216,6 +219,7 @@ class QSGDCompressor:
                 f"qsgd compression_bits must be in [1, 7], got {bits}")
         self.bits = int(bits)
         self._key = jax.random.PRNGKey(seed ^ 0x95)
+        self._key_lock = threading.Lock()
 
     def compress(self, tree, state=None):
         s = (1 << self.bits) - 1
@@ -224,7 +228,8 @@ class QSGDCompressor:
             x = jnp.asarray(leaf, jnp.float32)
             norm = jnp.maximum(jnp.linalg.norm(x.reshape(-1)), 1e-12)
             level = jnp.abs(x) / norm * s
-            self._key, sub = jax.random.split(self._key)
+            with self._key_lock:  # co-resident client threads
+                self._key, sub = jax.random.split(self._key)
             level = jnp.floor(level + jax.random.uniform(sub, x.shape))
             return {
                 _CLEAF: 1,

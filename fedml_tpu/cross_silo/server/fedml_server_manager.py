@@ -161,21 +161,32 @@ class FedMLServerManager(FedMLCommManager):
         self._timer.daemon = True
         self._timer.start()
 
+    def _upload_is_stale(self, msg_params, sender) -> bool:
+        msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if msg_round is not None and int(msg_round) != self.args.round_idx:
+            log.warning("server: dropping stale round-%s upload from "
+                        "client %d (now at round %d)", msg_round, sender,
+                        self.args.round_idx)
+            return True
+        return False
+
     def handle_message_receive_model_from_client(self, msg_params):
         sender = msg_params.get_sender_id()
         raw = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         n = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        # stale-check + base snapshot under the lock, but run the (per-leaf
+        # scatter/reshape) decompression OUTSIDE it so concurrent uploads
+        # don't serialize and the timeout handler isn't blocked
         with self._round_lock:
-            msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
-            if msg_round is not None and int(msg_round) != self.args.round_idx:
-                log.warning("server: dropping stale round-%s upload from "
-                            "client %d (now at round %d)", msg_round, sender,
-                            self.args.round_idx)
+            if self._upload_is_stale(msg_params, sender):
                 return
-            # decompress AFTER the stale check (delta payloads reconstruct
-            # against this round's still-unchanged global params)
-            params = FedMLCompression.get_instance().maybe_decompress(
-                raw, base=self.aggregator.get_global_model_params())
+            base = self.aggregator.get_global_model_params()
+        params = FedMLCompression.get_instance().maybe_decompress(raw,
+                                                                  base=base)
+        with self._round_lock:
+            # re-verify: the round may have advanced (timeout) mid-decompress
+            if self._upload_is_stale(msg_params, sender):
+                return
             self.aggregator.add_local_trained_result(
                 self.client_real_ids.index(sender), params, n)
             if not self.aggregator.check_whether_all_receive():

@@ -1,0 +1,246 @@
+"""Continuous-batching decode engine for the serving plane.
+
+The reference's serving stack handles concurrency by running one request per
+FastAPI worker against an HF ``generate`` call (``serving/templates/
+hf_template/main_openai.py``) — concurrent requests time-share the
+accelerator, each paying a full decode pass.  TPU-natively the accelerator
+wants one BATCHED program: this engine keeps a fixed pool of decode slots,
+runs a single jitted ``vmap``-ed KV-cache step for all live slots per tick,
+and admits waiting requests into freed slots between ticks ("continuous
+batching" — requests join/leave the batch at token granularity, so short
+requests aren't held hostage by long ones and the MXU sees batch-B matmuls
+instead of B sequential batch-1 passes).
+
+Engine states are static-shaped throughout (slot count, buffer length), so
+exactly two programs compile: the per-slot prefill and the batched step.
+Per-slot KV caches live stacked on a leading slot axis and are inserted at
+admission with a donated ``.at[slot].set``.
+
+Greedy (temp=0) output is bit-identical to the single-request
+:func:`fedml_tpu.serving.templates.openai_compat.generate` path (tested);
+at temp>0 the RNG stream differs from single-request decode because keys
+split inside the batched step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .templates.openai_compat import _build_cached_decode, _sample_live
+
+
+class _Slot:
+    __slots__ = ("live", "q", "pos", "remaining", "eos_id", "cur_tok")
+
+    def __init__(self):
+        self.live = False
+        self.q: Optional[queue.Queue] = None
+        self.pos = 0
+        self.remaining = 0
+        self.eos_id: Optional[int] = None
+        self.cur_tok = 0
+
+
+class ContinuousBatchingEngine:
+    """``submit()`` returns a queue that yields generated token ids and then
+    ``None``; a daemon thread drives the batched decode loop."""
+
+    def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
+                 top_k: int = 0):
+        self.model = model
+        self.raw_params = params.get("params", params) \
+            if isinstance(params, dict) else params
+        self.n_slots = int(slots)
+        self.buf_len = int(buf_len)
+        self.top_k = int(top_k)
+
+        self._prefill, _ = _build_cached_decode(model, self.top_k)
+
+        @jax.jit
+        def batched_step(params, caches, toks, poss, keys, temps):
+            def one(cache, tok, pos, key, temp):
+                logits, mut = model.apply(
+                    {"params": params, "cache": cache}, tok[None, None],
+                    decode=True, start_pos=pos, mutable=["cache"])
+                key, sub = jax.random.split(key)
+                nxt = _sample_live(logits[0, 0], sub, temp, self.top_k)
+                return nxt, mut["cache"], key
+            return jax.vmap(one)(caches, toks, poss, keys, temps)
+
+        self._step = batched_step
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def insert_cache(caches, cache, slot):
+            return jax.tree_util.tree_map(
+                lambda all_c, c: all_c.at[slot].set(c), caches, cache)
+
+        self._insert = insert_cache
+
+        # materialize the stacked cache template from one dummy prefill
+        dummy = jnp.zeros((1, self.buf_len), jnp.int32)
+        _, cache0 = self._prefill(self.raw_params, dummy, jnp.int32(1),
+                                  jax.random.PRNGKey(0), jnp.float32(0.0))
+        self._caches = jax.tree_util.tree_map(
+            lambda c: jnp.zeros((self.n_slots,) + c.shape, c.dtype), cache0)
+
+        self._slots = [_Slot() for _ in range(self.n_slots)]
+        self._toks = np.zeros(self.n_slots, np.int32)
+        self._poss = np.zeros(self.n_slots, np.int32)
+        self._temps = np.zeros(self.n_slots, np.float32)
+        self._keys = np.stack(
+            [np.asarray(jax.random.PRNGKey(i)) for i in range(self.n_slots)])
+        self._waiting: "queue.Queue[dict]" = queue.Queue()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._ticks = 0  # batched steps executed (observability)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- public api --------------------------------------------------------
+    def submit(self, prompt_ids: List[int], max_new_tokens: int = 64,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> "queue.Queue":
+        """Enqueue a request; returns a queue yielding token ids then
+        ``None``."""
+        if self._stopped or not self._thread.is_alive():
+            raise RuntimeError("engine stopped")
+        out: "queue.Queue" = queue.Queue()
+        self._waiting.put({
+            "prompt_ids": list(prompt_ids)[-(self.buf_len - 1):],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "seed": int(seed),
+            "eos_id": eos_id,
+            "q": out,
+        })
+        with self._cond:
+            self._cond.notify()
+        return out
+
+    def generate(self, prompt_ids: List[int], **kw) -> List[int]:
+        """Blocking convenience wrapper over :meth:`submit`."""
+        q = self.submit(prompt_ids, **kw)
+        out: List[int] = []
+        while True:
+            t = q.get()
+            if t is None:
+                return out
+            out.append(t)
+
+    def stop(self):
+        self._stopped = True
+        with self._cond:
+            self._cond.notify()
+        self._thread.join(timeout=10)
+
+    # -- engine loop -------------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if not s.live:
+                return i
+        return None
+
+    def _finish(self, i: int):
+        s = self._slots[i]
+        s.live = False
+        if s.q is not None:
+            s.q.put(None)
+        s.q = None
+
+    def _emit(self, i: int, tok: int) -> bool:
+        """Deliver one sampled token; returns False when the slot is done
+        (eos / budget / buffer end).  Delivery rules mirror ``generate()``
+        exactly: eos is not delivered, nor is a token whose successor
+        position would fall outside the buffer window."""
+        s = self._slots[i]
+        if s.remaining <= 0 or s.pos >= self.buf_len:
+            return False
+        if s.eos_id is not None and tok == s.eos_id:
+            return False
+        s.q.put(tok)
+        s.remaining -= 1
+        s.cur_tok = tok
+        return s.remaining > 0 and s.pos < self.buf_len
+
+    def _admit(self, req: dict, slot: int):
+        ids = req["prompt_ids"]
+        n = len(ids)
+        buf = np.zeros((1, self.buf_len), np.int32)
+        buf[0, :n] = ids
+        key = jax.random.PRNGKey(req["seed"])
+        key, sub = jax.random.split(key)
+        tok, cache = self._prefill(self.raw_params, jnp.asarray(buf),
+                                   jnp.int32(n), sub,
+                                   jnp.float32(req["temperature"]))
+        self._caches = self._insert(self._caches, cache, jnp.int32(slot))
+        s = self._slots[slot]
+        s.live = True
+        s.q = req["q"]
+        s.pos = n
+        s.remaining = req["max_new_tokens"]
+        s.eos_id = req["eos_id"]
+        self._temps[slot] = req["temperature"]
+        self._keys[slot] = np.asarray(key)
+        if not self._emit(slot, int(tok)):
+            self._finish(slot)
+
+    def _run(self):
+        try:
+            self._run_loop()
+        except Exception:  # noqa: BLE001 — a dead engine must not hang HTTP
+            import logging
+            logging.getLogger(__name__).exception(
+                "continuous-batching engine crashed; failing open")
+            self._stopped = True
+            for i, s in enumerate(self._slots):
+                if s.live:
+                    self._finish(i)
+            while not self._waiting.empty():
+                self._waiting.get()["q"].put(None)
+
+    def _run_loop(self):
+        while True:
+            with self._cond:
+                while (not self._stopped and self._waiting.empty()
+                       and not any(s.live for s in self._slots)):
+                    self._cond.wait(timeout=0.5)
+                if self._stopped:
+                    for i, s in enumerate(self._slots):
+                        if s.live:
+                            self._finish(i)
+                    while not self._waiting.empty():
+                        self._waiting.get()["q"].put(None)
+                    return
+
+            # admit waiting requests into free slots (token-granularity join)
+            while not self._waiting.empty():
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                self._admit(self._waiting.get(), slot)
+
+            live = [i for i, s in enumerate(self._slots) if s.live]
+            if not live:
+                continue
+
+            for i in live:
+                self._toks[i] = self._slots[i].cur_tok
+                self._poss[i] = self._slots[i].pos
+            toks, self._caches, keys = self._step(
+                self.raw_params, self._caches, jnp.asarray(self._toks),
+                jnp.asarray(self._poss), jnp.asarray(self._keys),
+                jnp.asarray(self._temps))
+            toks_host = np.asarray(toks)
+            self._keys = np.array(keys)  # writable copy (admit mutates rows)
+            self._ticks += 1
+            for i in live:
+                self._slots[i].pos += 1
+                if not self._emit(i, int(toks_host[i])):
+                    self._finish(i)

@@ -27,6 +27,7 @@ from __future__ import annotations
 import functools
 import json
 import logging
+import queue
 import threading
 import time
 import uuid
@@ -182,11 +183,17 @@ class OpenAICompatServer:
 
     def __init__(self, apply_fn: Callable, params, tokenizer=None,
                  model_name: str = "fedml-tpu-llm", host: str = "127.0.0.1",
-                 port: int = 0, buf_len: int = 256, model=None):
+                 port: int = 0, buf_len: int = 256, model=None,
+                 batch_slots: int = 0):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
-        ``decode=True`` → KV-cached decode (see :func:`generate`)."""
+        ``decode=True`` → KV-cached decode (see :func:`generate`).
+        ``batch_slots`` > 0 (requires ``model``) routes requests through the
+        :class:`~fedml_tpu.serving.batching.ContinuousBatchingEngine` so
+        concurrent requests share one batched decode program; per-request
+        ``top_k`` is ignored in that mode (the engine's sampler is compiled
+        once)."""
         self.apply_fn = apply_fn
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
@@ -194,6 +201,11 @@ class OpenAICompatServer:
         self.host, self.port = host, port
         self.buf_len = buf_len
         self.model = model
+        self._engine = None
+        if batch_slots and model is not None:
+            from ..batching import ContinuousBatchingEngine
+            self._engine = ContinuousBatchingEngine(
+                model, params, slots=int(batch_slots), buf_len=buf_len)
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------
@@ -217,16 +229,35 @@ class OpenAICompatServer:
                 on_text(clean[sent:])
                 sent = len(clean)
 
-        out = generate(
-            self.apply_fn, self.params, tok.encode(prompt),
-            max_new_tokens=int(req.get("max_tokens", 64)),
-            temperature=float(req.get("temperature", 0.0)),
-            top_k=int(req.get("top_k", 0)),
-            seed=int(req.get("seed", 0)),
-            buf_len=self.buf_len,
-            eos_id=getattr(tok, "eos_id", None),
-            on_token=emit if on_text else None,
-            model=self.model)
+        if self._engine is not None:
+            q = self._engine.submit(
+                tok.encode(prompt),
+                max_new_tokens=int(req.get("max_tokens", 64)),
+                temperature=float(req.get("temperature", 0.0)),
+                seed=int(req.get("seed", 0)),
+                eos_id=getattr(tok, "eos_id", None))
+            out = []
+            while True:
+                try:
+                    t = q.get(timeout=300)
+                except queue.Empty:
+                    break  # engine wedged/crashed — fail the request open
+                if t is None:
+                    break
+                out.append(t)
+                if on_text:
+                    emit(t)
+        else:
+            out = generate(
+                self.apply_fn, self.params, tok.encode(prompt),
+                max_new_tokens=int(req.get("max_tokens", 64)),
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=int(req.get("top_k", 0)),
+                seed=int(req.get("seed", 0)),
+                buf_len=self.buf_len,
+                eos_id=getattr(tok, "eos_id", None),
+                on_token=emit if on_text else None,
+                model=self.model)
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail
@@ -333,3 +364,6 @@ class OpenAICompatServer:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        if self._engine is not None:
+            self._engine.stop()
+            self._engine = None

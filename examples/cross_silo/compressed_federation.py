@@ -1,0 +1,58 @@
+"""Cross-silo federation with delta compression on the wire: clients upload
+top-k sparsified round deltas (5% of dense bytes), the server reconstructs
+against the dispatched global params.
+
+YAML surface (comm_args): enable_compression / compression_type
+(topk|eftopk|quantize|qsgd) / compression_ratio / compression_bits.
+
+Run: python examples/cross_silo/compressed_federation.py
+"""
+import threading
+
+from fedml_tpu import data as data_mod, model as model_mod
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.cross_silo.client import Client
+from fedml_tpu.cross_silo.server import Server
+
+
+def make_args(rank, role):
+    args = load_arguments()
+    args.update(
+        training_type="cross_silo", backend="local", rank=rank,
+        run_id="compressed_demo", role=role,
+        dataset="synthetic", num_classes=10, input_shape=(14, 14, 1),
+        train_size=512, test_size=128, model="lr",
+        client_num_in_total=2, client_num_per_round=2, comm_round=5,
+        epochs=1, batch_size=16, learning_rate=0.1, random_seed=7,
+        client_id_list=[1, 2], frequency_of_the_test=1,
+        enable_compression=True, compression_type="eftopk",
+        compression_ratio=0.05,
+    )
+    return args
+
+
+def run_server(result):
+    args = make_args(0, "server")
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    srv = Server(args, None, dataset, model)
+    srv.run()
+    result["acc"] = srv.aggregator.test_on_server_for_all_clients(4)
+
+
+def run_client(rank):
+    args = make_args(rank, "client")
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    Client(args, None, dataset, model).run()
+
+
+if __name__ == "__main__":
+    result = {}
+    threads = [threading.Thread(target=run_server, args=(result,))] + [
+        threading.Thread(target=run_client, args=(r,)) for r in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print(f"final server accuracy with 5% eftopk uploads: {result['acc']:.3f}")

@@ -181,11 +181,15 @@ class FedMLServerManager(FedMLCommManager):
             if self._upload_is_stale(msg_params, sender):
                 return
             base = self.aggregator.get_global_model_params()
+            snap_round = self.args.round_idx
         params = FedMLCompression.get_instance().maybe_decompress(raw,
                                                                   base=base)
         with self._round_lock:
-            # re-verify: the round may have advanced (timeout) mid-decompress
-            if self._upload_is_stale(msg_params, sender):
+            # re-verify against the SNAPSHOT round — the round may have
+            # advanced (timeout) mid-decompress, and uploads without a
+            # ROUND_IDX field would pass _upload_is_stale vacuously
+            if (self.args.round_idx != snap_round
+                    or self._upload_is_stale(msg_params, sender)):
                 return
             self.aggregator.add_local_trained_result(
                 self.client_real_ids.index(sender), params, n)

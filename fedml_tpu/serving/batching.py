@@ -18,8 +18,8 @@ admission with a donated ``.at[slot].set``.
 
 Greedy (temp=0) output is bit-identical to the single-request
 :func:`fedml_tpu.serving.templates.openai_compat.generate` path (tested);
-at temp>0 the RNG stream differs from single-request decode because keys
-split inside the batched step.
+the per-request threefry key splits follow the same sequence as that path,
+so sampling streams match it too.
 """
 
 from __future__ import annotations
@@ -109,18 +109,22 @@ class ContinuousBatchingEngine:
                eos_id: Optional[int] = None) -> "queue.Queue":
         """Enqueue a request; returns a queue yielding token ids then
         ``None``."""
-        if self._stopped or not self._thread.is_alive():
-            raise RuntimeError("engine stopped")
         out: "queue.Queue" = queue.Queue()
-        self._waiting.put({
-            "prompt_ids": list(prompt_ids)[-(self.buf_len - 1):],
-            "max_new_tokens": int(max_new_tokens),
-            "temperature": float(temperature),
-            "seed": int(seed),
-            "eos_id": eos_id,
-            "q": out,
-        })
+        # the put happens under _cond so it cannot interleave with the
+        # shutdown/crash drain (which also holds _cond): either the request
+        # lands before the drain and receives its sentinel, or the stopped
+        # flag is already visible here and we raise
         with self._cond:
+            if self._stopped or not self._thread.is_alive():
+                raise RuntimeError("engine stopped")
+            self._waiting.put({
+                "prompt_ids": list(prompt_ids)[-(self.buf_len - 1):],
+                "max_new_tokens": int(max_new_tokens),
+                "temperature": float(temperature),
+                "seed": int(seed),
+                "eos_id": eos_id,
+                "q": out,
+            })
             self._cond.notify()
         return out
 
@@ -198,12 +202,13 @@ class ContinuousBatchingEngine:
             import logging
             logging.getLogger(__name__).exception(
                 "continuous-batching engine crashed; failing open")
-            self._stopped = True
-            for i, s in enumerate(self._slots):
-                if s.live:
-                    self._finish(i)
-            while not self._waiting.empty():
-                self._waiting.get()["q"].put(None)
+            with self._cond:  # excludes concurrent submit() puts
+                self._stopped = True
+                for i, s in enumerate(self._slots):
+                    if s.live:
+                        self._finish(i)
+                while not self._waiting.empty():
+                    self._waiting.get()["q"].put(None)
 
     def _run_loop(self):
         while True:

@@ -202,7 +202,11 @@ class OpenAICompatServer:
         self.buf_len = buf_len
         self.model = model
         self._engine = None
-        if batch_slots and model is not None:
+        if batch_slots:
+            if model is None:
+                raise ValueError(
+                    "batch_slots requires `model` (a flax module supporting "
+                    "decode=True) — the batching engine is KV-cache based")
             from ..batching import ContinuousBatchingEngine
             self._engine = ContinuousBatchingEngine(
                 model, params, slots=int(batch_slots), buf_len=buf_len)

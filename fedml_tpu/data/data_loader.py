@@ -27,6 +27,7 @@ from .federated_dataset import FederatedDataset, build_federated, partition
 from .leaf import find_leaf_root, load_leaf
 from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
                         synthetic_segmentation, synthetic_tabular,
+                        synthetic_tag_prediction,
                         synthetic_text_classification,
                         synthetic_vertical_parties)
 
@@ -49,8 +50,15 @@ _LM_SPECS = {
     "shakespeare": (90, 80, 16000, 2000),
     "fed_shakespeare": (90, 80, 16000, 2000),
     "stackoverflow_nwp": (10004, 20, 50000, 5000),
-    "stackoverflow_lr": (10004, 20, 50000, 5000),
     "reddit": (10004, 20, 50000, 5000),
+}
+
+# multi-label tag prediction (reference ``data/stackoverflow/`` LR task:
+# 10,000 bag-of-words features → 500 tags, trained by
+# ``ml/trainer/my_model_trainer_tag_prediction.py`` with BCE loss).
+# name -> (n_tags, n_features, ref_train_n, ref_test_n)
+_TAGPRED_SPECS = {
+    "stackoverflow_lr": (500, 10000, 50000, 5000),
 }
 
 # tabular sets (reference ``data/UCI/``, ``data/lending_club_loan/``):
@@ -302,6 +310,40 @@ def load(args) -> Tuple[FederatedDataset, int]:
         ds = build_federated(tx, ty, vx, vy, vocab, client_num, method="homo",
                              alpha=alpha, seed=seed)
         return ds, vocab
+
+    if name in _TAGPRED_SPECS:
+        ref_tags, ref_feats, ref_train_n, ref_test_n = _TAGPRED_SPECS[name]
+        real = _try_load_npz(cache, name) if cache else None
+        if real is not None:
+            tx, ty, vx, vy = real
+            if ty.ndim != 2 or not np.isin(np.unique(ty), (0, 1)).all():
+                raise ValueError(
+                    f"{name}.npz labels must be multi-hot (N, n_tags) 0/1 "
+                    f"matrices (tag-prediction task), got shape {ty.shape} "
+                    f"dtype {ty.dtype} — old LM-format caches are invalid")
+            ty, vy = ty.astype(np.float32), vy.astype(np.float32)
+            n_tags, n_feats = ty.shape[1], tx.shape[1]
+        else:
+            # synthetic fallback at a tractable scale (the reference-scale
+            # dense matrix would be 50k x 10k floats); overrides restore
+            # full cardinality when wanted
+            n_tags = int(getattr(args, "tag_count", 0) or min(ref_tags, 100))
+            n_feats = int(getattr(args, "feature_dim", 0) or
+                          min(ref_feats, 1000))
+            train_n = int(getattr(args, "train_size", 0) or
+                          min(ref_train_n, 5000))
+            test_n = int(getattr(args, "test_size", 0) or
+                         min(ref_test_n, 500))
+            tx, ty, vx, vy = synthetic_tag_prediction(
+                train_n, test_n, n_tags, n_feats, seed)
+        # Dirichlet partition needs scalar labels: use each example's
+        # first (lowest-index) set tag as its partition class
+        primary = np.argmax(ty, axis=1).astype(np.int64)
+        client_idxs = partition(primary, client_num, method, alpha, seed)
+        ds = FederatedDataset(tx, ty, vx, vy, client_idxs, n_tags)
+        if not getattr(args, "input_shape", None):
+            args.input_shape = (n_feats,)  # model hub reads this for lr
+        return ds, n_tags
 
     if name in _TABULAR_SPECS:
         classes, n_features, train_n, test_n = _TABULAR_SPECS[name]

@@ -145,3 +145,37 @@ def synthetic_segmentation(train_n: int, test_n: int, num_classes: int,
     x += noise * rng.standard_normal(x.shape).astype(np.float32)
     return (x[:train_n], y[:train_n].astype(np.int64),
             x[train_n:], y[train_n:].astype(np.int64))
+
+
+def synthetic_tag_prediction(train_n: int, test_n: int, n_tags: int,
+                             n_features: int, seed: int = 0,
+                             avg_tags: int = 3):
+    """Multi-label tag-prediction data — the stackoverflow_lr stand-in
+    (reference ``data/stackoverflow/`` LR task: sparse bag-of-words
+    features → multi-hot tag vector, consumed by
+    ``ml/trainer/my_model_trainer_tag_prediction.py``).  Labels are the
+    ``avg_tags`` highest-scoring tags under a fixed random linear map, so
+    the task is learnable by the LR model."""
+    rng = np.random.default_rng(seed)
+    avg_tags = max(1, min(int(avg_tags), n_tags - 1)) if n_tags > 1 else 1
+    w = rng.standard_normal((n_features, n_tags)) / np.sqrt(n_features)
+
+    def features(n):
+        return ((rng.random((n, n_features)) < 0.05)
+                * rng.exponential(1.0, (n, n_features))).astype(np.float32)
+
+    # ABSOLUTE per-tag thresholds (calibrated so each tag fires on
+    # ~avg_tags/n_tags of examples) keep every tag independently linearly
+    # separable — a per-row top-k rule would make tag membership depend on
+    # the other tags' scores, which no per-tag sigmoid can express
+    calib = features(2048) @ w
+    thresh = np.quantile(calib, 1.0 - avg_tags / n_tags, axis=0)
+
+    def gen(n):
+        x = features(n)
+        y = ((x @ w) >= thresh[None, :]).astype(np.float32)
+        return x, y
+
+    tx, ty = gen(train_n)
+    vx, vy = gen(test_n)
+    return tx, ty, vx, vy

@@ -69,6 +69,32 @@ def cross_entropy_loss(logits, labels):
     return -jnp.mean(ll)
 
 
+def bce_elements(logits, targets):
+    """Stable element-wise binary cross-entropy (multi-hot targets)."""
+    l = logits.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    return jnp.maximum(l, 0.0) - l * t + jnp.log1p(jnp.exp(-jnp.abs(l)))
+
+
+def bce_with_logits(logits, targets):
+    """Mean BCE — the tag-prediction loss (reference
+    ``ml/trainer/my_model_trainer_tag_prediction.py`` uses
+    ``BCEWithLogitsLoss``)."""
+    return jnp.mean(bce_elements(logits, targets))
+
+
+def exact_match_hits(logits, targets):
+    """Per-example 0/1: the full predicted tag set matches exactly
+    (reference tag-prediction ``test_correct`` semantics)."""
+    pred = (logits > 0).astype(jnp.float32)
+    return jnp.all(pred == targets.astype(jnp.float32),
+                   axis=-1).astype(jnp.float32)
+
+
+def exact_match(logits, targets):
+    return jnp.mean(exact_match_hits(logits, targets))
+
+
 def accuracy(logits, labels):
     pred = jnp.argmax(logits, axis=-1)
     return jnp.mean((pred == labels).astype(jnp.float32))
@@ -94,8 +120,12 @@ class LocalTrainer:
         ∇̂_i (used in the linear loss term)."""
         x, y = batch
         logits = self.model.apply(params, x, train=True, rng=rng)
-        loss = cross_entropy_loss(logits, y)
-        acc = accuracy(logits, y)
+        if getattr(self.model, "task", "") == "tag_prediction":
+            loss = bce_with_logits(logits, y)
+            acc = exact_match(logits, y)
+        else:
+            loss = cross_entropy_loss(logits, y)
+            acc = accuracy(logits, y)
         if self.algorithm == "fedprox" and ctx.global_params is not None:
             diff = tree_util.tree_sub(params, ctx.global_params)
             loss = loss + 0.5 * self.prox_mu * tree_util.tree_sq_norm(diff)
@@ -192,10 +222,16 @@ class LocalTrainer:
 
     # -- evaluation --------------------------------------------------------
     def make_eval_step(self):
+        tagpred = getattr(self.model, "task", "") == "tag_prediction"
+
         def eval_step(params, x, y, m):
             """m: per-example validity mask (padding of the ragged tail
             batch contributes nothing)."""
             logits = self.model.apply(params, x, train=False)
+            if tagpred:
+                per = jnp.mean(bce_elements(logits, y), axis=-1)
+                hit = exact_match_hits(logits, y)
+                return (jnp.sum(per * m), jnp.sum(hit * m), jnp.sum(m))
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             ll = jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
             extra = tuple(range(m.ndim, ll.ndim))  # LM: sequence positions

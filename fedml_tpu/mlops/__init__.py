@@ -112,3 +112,66 @@ def log_endpoint(endpoint_name: str, metrics: Optional[Dict[str, Any]] = None,
     serving-endpoint metric stream."""
     _emit({"type": "endpoint", "endpoint": endpoint_name,
            "metrics": metrics or {}})
+
+
+# -- status-variant wrappers (reference ``core/mlops/__init__.py:318-499``) --
+def log_training_finished_status(run_id=None, **kw):
+    log_training_status("FINISHED", run_id)
+
+
+def log_training_failed_status(run_id=None, **kw):
+    log_training_status("FAILED", run_id)
+
+
+def log_aggregation_finished_status(run_id=None, **kw):
+    log_aggregation_status("FINISHED", run_id)
+
+
+def log_aggregation_failed_status(run_id=None, **kw):
+    log_aggregation_status("FAILED", run_id)
+
+
+def log_aggregation_exception_status(run_id=None, **kw):
+    log_aggregation_status("EXCEPTION", run_id)
+
+
+def send_exit_train_msg(run_id=None):
+    """Reference ``core/mlops/__init__.py:348`` — exit signal on the status
+    stream (agents listening on the bus treat it as a stop request)."""
+    _emit({"type": "exit_train", "run_id": run_id or _state["run_id"]})
+
+
+# -- model-info loggers (reference ``core/mlops/__init__.py:532,624``) -------
+def log_aggregated_model_info(round_index: int, model_url: str = "", **kw):
+    _emit({"type": "aggregated_model", "round": round_index,
+           "url": model_url})
+
+
+def log_client_model_info(round_index: int, total_rounds: int = 0,
+                          model_url: str = "", **kw):
+    _emit({"type": "client_model", "round": round_index,
+           "total_rounds": total_rounds, "url": model_url})
+
+
+# -- system perf sampling (reference ``log_sys_perf``/``stop_sys_perf``,
+#    ``core/mlops/__init__.py:653,665``) -------------------------------------
+_sys_perf_daemon = None
+
+
+def log_sys_perf(sys_args=None):
+    """Start the CPU/mem sampler daemon emitting onto this bus."""
+    global _sys_perf_daemon
+    if _sys_perf_daemon is None:
+        from .system_stats import MLOpsDevicePerfStats
+        _sys_perf_daemon = MLOpsDevicePerfStats()
+        _sys_perf_daemon.start()
+    return _sys_perf_daemon
+
+
+def stop_sys_perf():
+    global _sys_perf_daemon
+    if _sys_perf_daemon is not None:
+        stop = getattr(_sys_perf_daemon, "stop", None)
+        if stop:
+            stop()
+        _sys_perf_daemon = None

@@ -39,7 +39,12 @@ def create(args, output_dim: int = 10) -> FlaxModel:
     ds = str(getattr(args, "dataset", "")).lower()
 
     if name in ("lr", "logistic_regression"):
-        return FlaxModel(LogisticRegression(output_dim), _img_shape(args))
+        # stackoverflow_lr is the reference's multi-LABEL tag-prediction
+        # task (my_model_trainer_tag_prediction.py: BCE over 500 tags)
+        task = ("tag_prediction" if ds == "stackoverflow_lr"
+                else "classification")
+        return FlaxModel(LogisticRegression(output_dim), _img_shape(args),
+                         task=task)
     if name == "mlp":
         return FlaxModel(MLP(hidden=128, output_dim=output_dim), _img_shape(args))
     if name == "cnn":

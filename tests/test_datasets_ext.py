@@ -272,3 +272,41 @@ def test_cifar10_pickle_and_binary_ingestion(tmp_path):
     assert ds2.train_x.shape == (75, 32, 32, 3)
     assert ds2.test_x.shape == (5, 32, 32, 3)
     assert ds2.train_y.dtype == np.int64
+
+
+def test_stackoverflow_lr_tag_prediction_learns():
+    """stackoverflow_lr is the multi-LABEL tag-prediction task (reference
+    my_model_trainer_tag_prediction.py: BCE over tags, exact-match
+    metric) — the federated LR must climb well above the all-zeros
+    baseline."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    args = load_arguments()
+    args.update(dataset="stackoverflow_lr", train_size=3000, test_size=300,
+                tag_count=10, feature_dim=100,
+                client_num_in_total=10, client_num_per_round=10,
+                comm_round=20, epochs=2, batch_size=20, learning_rate=1.0,
+                federated_optimizer="FedOpt", server_optimizer="adam",
+                server_lr=0.05,
+                partition_method="hetero", partition_alpha=0.5,
+                frequency_of_the_test=100, random_seed=0)
+    ds, out_dim = data_mod.load(args)
+    assert out_dim == 10
+    assert ds.train_y.shape == (3000, 10)       # multi-hot labels
+    assert ds.train_y.dtype == np.float32
+    # all-zeros exact-matches only the empty-label examples (~7%)
+    empty_frac = float((ds.test_y.sum(1) == 0).mean())
+    assert empty_frac < 0.12
+    model = model_mod.create(args, out_dim)
+    assert model.task == "tag_prediction"
+
+    api = FedAvgAPI(args, None, ds, model)
+    loss0, em0 = api.evaluate()
+    for r in range(args.comm_round):
+        api.train_one_round(r)
+    loss1, em1 = api.evaluate()
+    assert loss1 < loss0 * 0.7
+    assert em1 > max(2 * empty_frac, 0.2), (em0, em1)

@@ -316,11 +316,17 @@ def load(args) -> Tuple[FederatedDataset, int]:
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:
             tx, ty, vx, vy = real
-            if ty.ndim != 2 or not np.isin(np.unique(ty), (0, 1)).all():
+            for part, lab in (("train", ty), ("test", vy)):
+                if lab.ndim != 2 or not np.isin(np.unique(lab), (0, 1)).all():
+                    raise ValueError(
+                        f"{name}.npz {part} labels must be multi-hot "
+                        f"(N, n_tags) 0/1 matrices (tag-prediction task), "
+                        f"got shape {lab.shape} dtype {lab.dtype} — old "
+                        f"LM-format caches are invalid")
+            if ty.shape[1] != vy.shape[1]:
                 raise ValueError(
-                    f"{name}.npz labels must be multi-hot (N, n_tags) 0/1 "
-                    f"matrices (tag-prediction task), got shape {ty.shape} "
-                    f"dtype {ty.dtype} — old LM-format caches are invalid")
+                    f"{name}.npz train/test tag counts differ: "
+                    f"{ty.shape[1]} vs {vy.shape[1]}")
             ty, vy = ty.astype(np.float32), vy.astype(np.float32)
             n_tags, n_feats = ty.shape[1], tx.shape[1]
         else:
@@ -343,6 +349,10 @@ def load(args) -> Tuple[FederatedDataset, int]:
         ds = FederatedDataset(tx, ty, vx, vy, client_idxs, n_tags)
         if not getattr(args, "input_shape", None):
             args.input_shape = (n_feats,)  # model hub reads this for lr
+        # single source of truth for the loss/eval branch: the loader knows
+        # the task, the model hub reads it (name fallback kept for callers
+        # that build the model before loading data)
+        args.task_type = "tag_prediction"
         return ds, n_tags
 
     if name in _TABULAR_SPECS:

@@ -39,9 +39,13 @@ def create(args, output_dim: int = 10) -> FlaxModel:
     ds = str(getattr(args, "dataset", "")).lower()
 
     if name in ("lr", "logistic_regression"):
-        # stackoverflow_lr is the reference's multi-LABEL tag-prediction
-        # task (my_model_trainer_tag_prediction.py: BCE over 500 tags)
-        task = ("tag_prediction" if ds == "stackoverflow_lr"
+        # multi-LABEL tag prediction (reference
+        # my_model_trainer_tag_prediction.py: BCE over 500 tags) — the data
+        # loader sets args.task_type for any _TAGPRED_SPECS dataset; the
+        # name check covers model-before-data construction order
+        task = ("tag_prediction"
+                if (getattr(args, "task_type", "") == "tag_prediction"
+                    or ds == "stackoverflow_lr")
                 else "classification")
         return FlaxModel(LogisticRegression(output_dim), _img_shape(args),
                          task=task)

@@ -1,0 +1,7 @@
+"""Workflow DAG (reference ``python/fedml/workflow/``)."""
+
+from .customized_jobs import ModelDeployJob, ModelInferenceJob, TrainJob
+from .workflow import Job, JobStatus, PyJob, Workflow
+
+__all__ = ["Workflow", "Job", "JobStatus", "PyJob", "TrainJob",
+           "ModelDeployJob", "ModelInferenceJob"]

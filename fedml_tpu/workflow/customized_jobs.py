@@ -100,3 +100,65 @@ class ModelDeployJob(Job):
         self._teardown()
         if not was_finished:
             self.status = JobStatus.FAILED
+
+
+class ModelInferenceJob(Job):
+    """Query a deployed endpoint as a DAG step (reference
+    ``customized_jobs/model_inference_job.py``: resolves the endpoint,
+    POSTs the request body, exposes the response json as job output).
+
+    Endpoint resolution, in priority order: explicit ``endpoint``/
+    ``gateway_port`` args → the ``deploy_job`` object's output → any
+    dependency output delivered by the Workflow DAG (``self.input``, so
+    ``wf.add_job(infer, dependencies=[deploy])`` works with no extra
+    wiring)."""
+
+    def __init__(self, name: str, deploy_job: "ModelDeployJob" = None,
+                 endpoint: Optional[str] = None,
+                 gateway_port: Optional[int] = None,
+                 request_body: Optional[Dict[str, Any]] = None,
+                 timeout_s: float = 30.0):
+        super().__init__(name)
+        self.deploy_job = deploy_job
+        self.endpoint = endpoint
+        self.gateway_port = gateway_port
+        self.request_body = request_body or {}
+        self.timeout_s = timeout_s
+        self.status = JobStatus.PROVISIONING
+
+    def run(self):
+        import json
+        import urllib.request
+
+        self.status = JobStatus.RUNNING
+        endpoint = self.endpoint
+        port = self.gateway_port
+        candidates = []
+        if self.deploy_job is not None and self.deploy_job.output:
+            candidates.append(self.deploy_job.output)
+        # DAG-delivered dependency outputs (Workflow.run → append_input)
+        candidates.extend(v for v in self.input.values()
+                          if isinstance(v, dict))
+        for out in candidates:
+            endpoint = endpoint or out.get("endpoint")
+            port = port or out.get("gateway_port")
+        if not endpoint or not port:
+            self.status = JobStatus.FAILED
+            raise ValueError(
+                f"inference job {self.name!r}: no endpoint/gateway to "
+                f"query (deploy job not run or no endpoint given)")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/predict/{endpoint}",
+            data=json.dumps(self.request_body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                self.output = json.loads(resp.read())
+        except Exception:
+            self.status = JobStatus.FAILED
+            raise
+        self.status = JobStatus.FINISHED
+
+    def kill(self):
+        if self.status == JobStatus.RUNNING:
+            self.status = JobStatus.FAILED

@@ -218,3 +218,32 @@ def test_workflow_customized_deploy_job():
         assert job.output["replicas"] == 1
     finally:
         job.kill()
+
+
+def test_workflow_deploy_then_inference_chain():
+    """Reference customized_jobs/model_inference_job.py analog: a deploy
+    job feeds an inference job in one DAG; the inference output carries the
+    predictor's response."""
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+    from fedml_tpu.workflow import (JobStatus, ModelDeployJob,
+                                    ModelInferenceJob, Workflow)
+
+    class P(FedMLPredictor):
+        def predict(self, request):
+            return {"doubled": request.get("x", 0) * 2}
+
+    deploy = ModelDeployJob("deploy", "wfchain-ep", lambda: P(),
+                            num_replicas=1)
+    # no deploy_job= wiring: endpoint/port must arrive via the DAG's
+    # dependency-output delivery alone
+    infer = ModelInferenceJob("infer", request_body={"x": 21})
+    wf = Workflow("chain")
+    wf.add_job(deploy)
+    wf.add_job(infer, dependencies=[deploy])
+    try:
+        wf.run()
+        assert infer.status_of() == JobStatus.FINISHED
+        # gateway envelope: {"result": <predictor response>}
+        assert infer.output["result"]["doubled"] == 42
+    finally:
+        deploy.kill()

@@ -66,6 +66,11 @@ class DecentralizedFedAPI:
 
         self.round_fn = jax.jit(round_fn)
 
+    def _prep(self, arr):
+        """Input-placement hook — the mesh subclass shards round inputs over
+        the client axis here."""
+        return jnp.asarray(arr)
+
     def train_one_round(self, round_idx: int):
         clients = np.arange(self.n)
         x, y, mask, w = self.dataset.cohort_batches(
@@ -79,8 +84,8 @@ class DecentralizedFedAPI:
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
         rngs = jax.random.split(key, self.n)
         self.params, self.omega, loss = self.round_fn(
-            self.params, self.omega, jnp.asarray(x), jnp.asarray(y),
-            jnp.asarray(mask), rngs)
+            self.params, self.omega, self._prep(x), self._prep(y),
+            self._prep(mask), self._prep(rngs))
         return {"train_loss": loss}
 
     def consensus_params(self):

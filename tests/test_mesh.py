@@ -74,3 +74,45 @@ def test_mesh_sharded_data_parity():
             losses.append(round(float(m["train_loss"]), 6))
         curves[str(mode)] = losses
     assert curves["True"] == curves["sharded"] == curves["False"], curves
+
+
+def test_mesh_decentralized_ring_matches_sp_einsum():
+    """Ring-DSGD via per-edge ppermute (SURVEY §2.9's TPU counterpart for
+    decentralized topologies) must reproduce the sp engine's dense-einsum
+    gossip, and reject non-ring configs."""
+    import pytest
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.decentralized import DecentralizedFedAPI
+    from fedml_tpu.simulation.mesh.decentralized_mesh import (
+        MeshDecentralizedAPI)
+
+    def make(n_clients):
+        args = load_arguments()
+        args.update(dataset="synthetic", num_classes=4, input_shape=(10,),
+                    train_size=320, test_size=64, model="lr",
+                    client_num_in_total=n_clients, comm_round=3, epochs=1,
+                    batch_size=8, learning_rate=0.2, topology="symmetric",
+                    topology_neighbors=2, partition_method="homo",
+                    random_seed=3)
+        ds, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        return args, ds, model
+
+    for n in (8, 16):  # 1 and 2 clients per shard on the 8-device mesh
+        args, ds, model = make(n)
+        sp = DecentralizedFedAPI(args, None, ds, model)
+        mesh_api = MeshDecentralizedAPI(args, None, ds, model)
+        for r in range(3):
+            sp.train_one_round(r)
+            mesh_api.train_one_round(r)
+        sp_loss, sp_acc = sp.evaluate()
+        m_loss, m_acc = mesh_api.evaluate()
+        assert abs(sp_loss - m_loss) < 1e-4, (n, sp_loss, m_loss)
+        assert abs(sp_acc - m_acc) < 1e-6, (n, sp_acc, m_acc)
+
+    # non-ring topologies must be rejected loudly
+    args, ds, model = make(8)
+    args.update(topology_neighbors=4)
+    with pytest.raises(ValueError):
+        MeshDecentralizedAPI(args, None, ds, model)

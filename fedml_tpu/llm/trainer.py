@@ -25,6 +25,30 @@ from .model import (LlamaLM, causal_nll, config_from_args,
 log = logging.getLogger(__name__)
 
 
+def make_lr_schedule(lr: float, kind: str, warmup_steps: int,
+                     total_steps: int):
+    """HF-style LR schedule (reference ``ExperimentArguments.
+    lr_scheduler_type`` / ``warmup_steps``): linear warmup to ``lr`` then
+    constant / linear-to-zero / cosine decay over ``total_steps``."""
+    kind = str(kind).strip().lower()
+    decay_steps = max(total_steps - warmup_steps, 1)
+    if kind in ("constant", "constant_with_warmup", ""):
+        body = optax.constant_schedule(lr)
+    elif kind == "linear":
+        body = optax.linear_schedule(lr, 0.0, decay_steps)
+    elif kind == "cosine":
+        body = optax.cosine_decay_schedule(lr, decay_steps)
+    else:
+        raise ValueError(
+            f"unknown lr_scheduler_type {kind!r}; "
+            "one of constant|linear|cosine")
+    if warmup_steps > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup_steps), body],
+            [warmup_steps])
+    return body
+
+
 class CausalLMTrainer:
     def __init__(self, args, dataset, mesh=None):
         self.args = args
@@ -53,8 +77,31 @@ class CausalLMTrainer:
             self.lora = lora_init(rng_util.purpose_key(key, "lora"),
                                   self.lora)
         self.lora_only = self.lora_only and self.lora is not None
-        self.tx = optax.adamw(lr, weight_decay=float(
+
+        # training-control parity with the reference ExperimentArguments
+        # (train/llm/configurations.py: warmup_steps / lr_scheduler_type /
+        # gradient_accumulation_steps / max_grad_norm, executed there by the
+        # HF Trainer; here they compose as optax transforms around adamw)
+        self.accum_steps = max(1, int(getattr(
+            args, "gradient_accumulation_steps", 1)))
+        micro_per_epoch = max(1, len(dataset.train_x) // self.batch_size)
+        # MultiSteps carries partial accumulation across epoch boundaries,
+        # so the update count floors over the WHOLE run, not per epoch
+        run_updates = (self.epochs * micro_per_epoch) // self.accum_steps
+        self.max_updates = int(getattr(args, "max_steps", 0) or 0)
+        total_updates = max(self.max_updates or run_updates, 1)
+        warmup = int(getattr(args, "warmup_steps", 0))
+        sched_kind = str(getattr(args, "lr_scheduler_type", "constant"))
+        self.lr_schedule = make_lr_schedule(lr, sched_kind, warmup,
+                                            total_updates)
+        tx = optax.adamw(self.lr_schedule, weight_decay=float(
             getattr(args, "weight_decay", 0.0)))
+        max_grad_norm = float(getattr(args, "max_grad_norm", 0.0) or 0.0)
+        if max_grad_norm > 0:
+            tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+        if self.accum_steps > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=self.accum_steps)
+        self.tx = tx
         train_tree = self.lora if self.lora_only and self.lora is not None \
             else self.base_params
         self.opt_state = self.tx.init(train_tree)
@@ -109,18 +156,29 @@ class CausalLMTrainer:
             t0 = time.time()
             losses = []
             train_tree, frozen = self._trees()
+            budget_hit = False
             for s in range(steps):
+                if (self.max_updates and
+                        self.global_step // self.accum_steps
+                        >= self.max_updates):
+                    budget_hit = True
+                    break
                 train_tree, self.opt_state, loss = self._step(
                     train_tree, frozen, self.opt_state,
                     jnp.asarray(xb[s]), jnp.asarray(yb[s]))
                 losses.append(loss)
                 self.global_step += 1
             self._set_train_tree(train_tree)
-            mean_loss = float(jnp.mean(jnp.stack(losses)))
-            log.info("epoch %d: loss=%.4f (%.1fs)", epoch, mean_loss,
-                     time.time() - t0)
-            history.append({"epoch": epoch, "loss": mean_loss})
+            if losses:
+                mean_loss = float(jnp.mean(jnp.stack(losses)))
+                log.info("epoch %d: loss=%.4f (%.1fs)", epoch, mean_loss,
+                         time.time() - t0)
+                history.append({"epoch": epoch, "loss": mean_loss})
             self.save_checkpoint()
+            if budget_hit:
+                log.info("max_steps=%d update budget reached at epoch %d",
+                         self.max_updates, epoch)
+                break
         return {"history": history}
 
     def _build_eval(self):

@@ -60,7 +60,15 @@ class SimulatorSingleProcess:
 class SimulatorMesh:
     def __init__(self, args, device, dataset, model, client_trainer=None,
                  server_aggregator=None):
-        self.fl_trainer = MeshFedAvgAPI(args, device, dataset, model)
+        alg = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        if alg in ("decentralized_fl", "dsgd", "push_sum"):
+            # ring gossip as per-edge ppermute (push_sum's asymmetric W has
+            # no ring-collective form — the guard inside raises clearly)
+            from .mesh.decentralized_mesh import MeshDecentralizedAPI
+            self.fl_trainer = MeshDecentralizedAPI(args, device, dataset,
+                                                   model)
+        else:
+            self.fl_trainer = MeshFedAvgAPI(args, device, dataset, model)
 
     def run(self):
         return self.fl_trainer.train()

@@ -303,3 +303,86 @@ def test_hf_tokenizer_adapter_offline(tmp_path):
     # unresolvable path -> byte tokenizer fallback, never a download
     fallback = load_tokenizer("/does/not/exist")
     assert fallback.vocab_size == 258
+
+
+def test_lr_schedule_shapes():
+    """HF-style schedules (reference ExperimentArguments.lr_scheduler_type):
+    linear warmup then constant / linear / cosine decay."""
+    from fedml_tpu.llm.trainer import make_lr_schedule
+
+    s = make_lr_schedule(1e-3, "cosine", warmup_steps=10, total_steps=110)
+    assert float(s(0)) == 0.0
+    assert abs(float(s(10)) - 1e-3) < 1e-9       # warmup peak
+    assert float(s(5)) == pytest.approx(5e-4)    # mid-warmup
+    assert float(s(60)) < 1e-3                   # decaying
+    assert float(s(110)) == pytest.approx(0.0, abs=1e-9)
+
+    lin = make_lr_schedule(2e-3, "linear", warmup_steps=0, total_steps=100)
+    assert float(lin(0)) == pytest.approx(2e-3)
+    assert float(lin(50)) == pytest.approx(1e-3)
+
+    const = make_lr_schedule(1e-3, "constant", warmup_steps=4,
+                             total_steps=100)
+    assert float(const(50)) == pytest.approx(1e-3)
+
+    with pytest.raises(ValueError):
+        make_lr_schedule(1e-3, "polynomial", 0, 10)
+
+
+def test_gradient_accumulation_matches_large_batch(tmp_path):
+    """accum=2 at half batch must produce the same trained params as one
+    full-batch step stream (MultiSteps averages micro-grads; the epoch
+    permutation is seed-deterministic so micro-batch pairs tile the full
+    batches exactly)."""
+    from fedml_tpu.llm.trainer import CausalLMTrainer
+
+    base = dict(epochs=1, learning_rate=1e-3, lora_rank=4, random_seed=9)
+    args_full = _llm_args(batch_size=8, **base)
+    ds = _small_llm_dataset(args_full)
+    t_full = CausalLMTrainer(args_full, ds)
+    t_full.train()
+
+    args_acc = _llm_args(batch_size=4, gradient_accumulation_steps=2,
+                         **base)
+    t_acc = CausalLMTrainer(args_acc, ds)
+    t_acc.train()
+
+    for a, b in zip(jax.tree_util.tree_leaves(t_full.lora),
+                    jax.tree_util.tree_leaves(t_acc.lora)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_trainer_with_warmup_clip_trains(tmp_path):
+    """Full training-control stack (cosine schedule + warmup + grad
+    clipping + accumulation) still reduces eval NLL."""
+    from fedml_tpu.llm.trainer import CausalLMTrainer
+
+    args = _llm_args(epochs=2, batch_size=4, learning_rate=3e-3,
+                     lr_scheduler_type="cosine", warmup_steps=5,
+                     max_grad_norm=1.0, gradient_accumulation_steps=2,
+                     output_dir=str(tmp_path / "out"))
+    ds = _small_llm_dataset(args)
+    trainer = CausalLMTrainer(args, ds)
+    nll0 = trainer.evaluate()
+    trainer.train()
+    nll1 = trainer.evaluate()
+    assert nll1 < nll0, (nll0, nll1)
+    trainer.close()
+
+
+def test_max_steps_budget_enforced(tmp_path):
+    """max_steps caps optimizer updates (reference ExperimentArguments
+    semantics), not just the LR horizon."""
+    from fedml_tpu.llm.trainer import CausalLMTrainer
+
+    args = _llm_args(epochs=5, batch_size=4, max_steps=7,
+                     gradient_accumulation_steps=2,
+                     output_dir=str(tmp_path / "out"))
+    ds = _small_llm_dataset(args)
+    trainer = CausalLMTrainer(args, ds)
+    out = trainer.train()
+    # 7 updates x 2 micro-steps = 14 micro-steps, regardless of epochs
+    assert trainer.global_step == 14
+    assert len(out["history"]) < 5  # stopped early
+    trainer.close()

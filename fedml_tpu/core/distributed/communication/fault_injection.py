@@ -1,0 +1,151 @@
+"""Message-layer fault injection — chaos testing for the WAN federation
+FSMs.
+
+SURVEY §5 records that the reference has NO infra-fault injection anywhere
+(its only "failure testing" is adversarial attacks); its FSMs were never
+exercised under duplicated, delayed, or dropped messages.  This wrapper
+decorates any ``BaseCommunicationManager`` with seeded, reproducible chaos
+on the SEND side:
+
+- **duplicate**: the message is delivered twice (broker QoS-1 semantics,
+  retry storms);
+- **delay**: delivery is deferred by a random interval on a timer thread,
+  which also *reorders* messages relative to later sends (WAN jitter);
+- **drop**: the message is silently discarded (connection loss) — gated by
+  a ``droppable`` predicate so tests can protect messages whose loss is
+  designed to be survivable only via timeouts.
+
+Enable on any federation with flat args (read in ``create_comm_backend``)::
+
+    chaos_seed: 7
+    chaos_dup_prob: 0.3
+    chaos_delay_prob: 0.5
+    chaos_max_delay_s: 0.05
+    chaos_drop_prob: 0.0
+
+The cross-silo FSM is expected to survive dup+delay chaos unmodified
+(stale-round guards + idempotent aggregation) — ``tests/test_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from .base_com_manager import BaseCommunicationManager, Observer
+from .message import Message
+
+log = logging.getLogger(__name__)
+
+
+class FaultInjectingCommManager(BaseCommunicationManager):
+    def __init__(self, inner: BaseCommunicationManager, seed: int = 0,
+                 dup_prob: float = 0.0, delay_prob: float = 0.0,
+                 max_delay_s: float = 0.05, drop_prob: float = 0.0,
+                 droppable: Optional[Callable[[Message], bool]] = None):
+        self.inner = inner
+        self._rng = np.random.default_rng(seed)
+        self._rng_lock = threading.Lock()
+        self.dup_prob = float(dup_prob)
+        self.delay_prob = float(delay_prob)
+        self.max_delay_s = float(max_delay_s)
+        self.drop_prob = float(drop_prob)
+        self.droppable = droppable or (lambda msg: True)
+        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0, "delayed": 0}
+        self._timers: list = []  # (timer, msg, entry) triples
+        self._pending_lock = threading.Lock()
+
+    def _draw(self):
+        with self._rng_lock:
+            return self._rng.random(3)
+
+    def send_message(self, msg: Message):
+        p_drop, p_dup, p_delay = self._draw()
+        self.stats["sent"] += 1
+        if p_drop < self.drop_prob and self.droppable(msg):
+            self.stats["dropped"] += 1
+            log.info("chaos: DROPPING msg type=%s %s->%s",
+                     msg.get_type(), msg.get_sender_id(),
+                     msg.get_receiver_id())
+            return
+        copies = 1
+        if p_dup < self.dup_prob:
+            copies = 2
+            self.stats["duplicated"] += 1
+        for _ in range(copies):
+            if p_delay < self.delay_prob and self.max_delay_s > 0:
+                with self._rng_lock:
+                    delay = float(self._rng.random()) * self.max_delay_s
+                self.stats["delayed"] += 1
+                entry = {"done": False}
+                t = threading.Timer(delay, self._deliver_once, (msg, entry))
+                t.daemon = True
+                t.start()
+                with self._pending_lock:
+                    # prune delivered entries so long soaks don't pin every
+                    # delayed payload (model weights) for the manager's life
+                    self._timers = [e for e in self._timers
+                                    if not e[2]["done"]]
+                    self._timers.append((t, msg, entry))
+            else:
+                self.inner.send_message(msg)
+
+    def _deliver_once(self, msg: Message, entry: dict):
+        with self._pending_lock:
+            if entry["done"]:
+                return
+            entry["done"] = True
+        self.inner.send_message(msg)
+
+    # -- pure delegation ---------------------------------------------------
+    def add_observer(self, observer: Observer):
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer: Observer):
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self):
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self):
+        # FLUSH (not cancel) in-flight delayed messages: a sender that
+        # stops right after its final send (the server's FINISH broadcast)
+        # must not un-send what chaos merely deferred
+        with self._pending_lock:
+            pending = list(self._timers)
+            self._timers = []
+        for t, msg, entry in pending:
+            t.cancel()
+            self._deliver_once(msg, entry)
+        self.inner.stop_receive_message()
+
+
+def maybe_wrap_with_chaos(manager: BaseCommunicationManager, args, rank: int
+                          ) -> BaseCommunicationManager:
+    """args-gated decoration (called from ``create_comm_backend``)."""
+    dup = float(getattr(args, "chaos_dup_prob", 0.0) or 0.0)
+    delay = float(getattr(args, "chaos_delay_prob", 0.0) or 0.0)
+    drop = float(getattr(args, "chaos_drop_prob", 0.0) or 0.0)
+    if not (dup or delay or drop):
+        return manager
+    seed = int(getattr(args, "chaos_seed", 0)) * 1000 + rank
+    droppable = None
+    types = getattr(args, "chaos_droppable_types", None)
+    if types:
+        # str-normalized: Message.get_type() is an int for the FSM
+        # protocols but a flow-name string under the Flow DSL.  Only these
+        # types may be dropped — losing an INIT/FINISH control message
+        # deadlocks by design (no retry path exists for them in the
+        # reference protocol either)
+        allowed = {str(t) for t in types}
+        droppable = lambda m: str(m.get_type()) in allowed  # noqa: E731
+    return FaultInjectingCommManager(
+        manager, seed=seed, dup_prob=dup, delay_prob=delay,
+        max_delay_s=float(getattr(args, "chaos_max_delay_s", 0.05)),
+        drop_prob=drop, droppable=droppable)
+
+
+__all__ = ["FaultInjectingCommManager", "maybe_wrap_with_chaos"]

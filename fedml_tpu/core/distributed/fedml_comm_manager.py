@@ -85,7 +85,16 @@ class FedMLCommManager(Observer):
 def create_comm_backend(args, rank: int, size: int,
                         backend: str = "local") -> BaseCommunicationManager:
     """Construct a bare communication backend (no observer attached) — used
-    by the FSM above and by the scheduler plane's message centers."""
+    by the FSM above and by the scheduler plane's message centers.
+    ``chaos_*`` args decorate the result with seeded fault injection
+    (``communication/fault_injection.py``)."""
+    from .communication.fault_injection import maybe_wrap_with_chaos
+    return maybe_wrap_with_chaos(
+        _create_raw_backend(args, rank, size, backend), args, rank)
+
+
+def _create_raw_backend(args, rank: int, size: int,
+                        backend: str = "local") -> BaseCommunicationManager:
     backend = str(backend)
     run_id = str(getattr(args, "run_id", "0"))
     if backend in ("local", "LOCAL"):
@@ -126,7 +135,8 @@ def create_comm_backend(args, rank: int, size: int,
             raise ValueError(
                 f"control_backend {control_kind!r} is itself a storage-split "
                 "backend; use a plain control plane (local/filestore/GRPC)")
-        control = create_comm_backend(args, rank, size, control_kind)
+        # raw: the outer StorageCommManager is already chaos-wrapped once
+        control = _create_raw_backend(args, rank, size, control_kind)
         codec = "edge_bundle" if backend == "MQTT_S3_MNN" else "tree"
         return StorageCommManager(control, create_store(args, kind=store_kind),
                                   codec=codec)

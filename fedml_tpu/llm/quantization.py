@@ -1,0 +1,128 @@
+"""Weight-only int8 quantization for serving.
+
+The reference's deploy plane converts models for cheaper inference via
+ONNX/Triton (``model_scheduler/device_model_deployment.py:618``).  The
+TPU-native equivalent of that "conversion for serving" step is weight-only
+int8: autoregressive decode is HBM-bandwidth-bound (every generated token
+re-reads all weights), so storing matmul weights as int8 + per-channel
+float scales halves the bytes streamed per token vs bf16 (4× vs f32) —
+the dequantize happens in VMEM tiles where XLA fuses it into the matmul,
+and on v5e-class chips the MXU's native int8 path can go further.
+
+Usage::
+
+    qparams, stats = quantize_params_int8(params)
+    apply_fn = make_quantized_apply(model)       # apply_fn(qparams, tokens)
+    logits = apply_fn(qparams, tokens)
+
+The quantized tree keeps the original pytree structure with each eligible
+leaf replaced by a ``{"q": int8, "scale": f32 per-channel}`` dict, so it
+rides msgpack serialization / the model-card store unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_QLEAF = "__q8__"
+
+
+def _is_qleaf(obj) -> bool:
+    return isinstance(obj, dict) and _QLEAF in obj
+
+
+def quantize_params_int8(params, min_size: int = 1024,
+                         channel_axis: int = -1):
+    """Per-channel symmetric int8 quantization of every float leaf with
+    ``ndim >= 2`` and at least ``min_size`` elements (matmul weights);
+    embeddings qualify too.  Small leaves (norm scales, biases) stay in
+    full precision — they are a negligible share of bytes and the most
+    precision-sensitive.
+
+    Returns ``(qtree, stats)`` with ``stats`` reporting the byte shrink.
+    """
+    dense_bytes = [0]
+    q_bytes = [0]
+
+    def quant(leaf):
+        x = np.asarray(leaf)
+        dense_bytes[0] += x.nbytes
+        if x.ndim < 2 or x.size < min_size or not np.issubdtype(
+                x.dtype, np.floating):
+            q_bytes[0] += x.nbytes
+            return leaf
+        xf = x.astype(np.float32)
+        amax = np.max(np.abs(xf), axis=channel_axis, keepdims=True)
+        scale = np.maximum(amax, 1e-12) / 127.0
+        q = np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
+        q_bytes[0] += q.nbytes + scale.nbytes
+        # arrays only (the marker int is hashable aux-safe): the payload
+        # must be a valid jit argument so dequant can run inside the trace
+        return {_QLEAF: 1, "q": q, "scale": scale.astype(np.float32)}
+
+    qtree = jax.tree_util.tree_map(quant, params)
+    stats = {"dense_bytes": dense_bytes[0], "quantized_bytes": q_bytes[0],
+             "ratio": q_bytes[0] / max(dense_bytes[0], 1)}
+    return qtree, stats
+
+
+def dequantize_params(qtree, dtype=jnp.float32):
+    """int8 tree → float tree in ``dtype`` (static at trace time).  Under
+    jit the dequantize of each weight folds into its consuming matmul, so
+    int8 stays the HBM-resident form."""
+
+    def dequant(d):
+        if not _is_qleaf(d):
+            return d
+        return (jnp.asarray(d["q"], jnp.float32)
+                * jnp.asarray(d["scale"])).astype(dtype)
+
+    return jax.tree_util.tree_map(dequant, qtree, is_leaf=_is_qleaf)
+
+
+def weight_dtype(model):
+    """The compute dtype a model's weights dequantize to (its configured
+    dtype, falling back to f32) — the one resolution rule for every
+    decode/serving call site."""
+    return getattr(getattr(model, "cfg", None), "dtype", None) or jnp.float32
+
+
+def make_quantized_apply(model, dtype=None) -> Callable:
+    """Returns ``apply_fn(qparams, tokens, **kw)`` that dequantizes inside
+    the traced computation (weights enter the program as int8)."""
+    if dtype is None:
+        dtype = weight_dtype(model)
+
+    def apply_fn(qparams, tokens, **kw):
+        return model.apply(
+            {"params": dequantize_params(qparams, dtype)}, tokens, **kw)
+
+    return apply_fn
+
+
+def quantization_error(params, qtree) -> Dict[str, float]:
+    """Max relative per-leaf reconstruction error (diagnostics)."""
+    errs = []
+
+    def walk(orig, q):
+        o = np.asarray(orig, np.float32)
+        if _is_qleaf(q):
+            r = np.asarray(q["q"], np.float32) * np.asarray(q["scale"])
+        else:
+            r = np.asarray(q, np.float32)
+        denom = np.maximum(np.max(np.abs(o)), 1e-12)
+        errs.append(float(np.max(np.abs(o - r)) / denom))
+        return orig
+
+    # tree_map flattens up-to params' leaves, so each qleaf dict arrives
+    # whole as the second argument
+    jax.tree_util.tree_map(walk, params, qtree)
+    return {"max_rel_err": max(errs), "mean_rel_err": float(np.mean(errs))}
+
+
+__all__ = ["quantize_params_int8", "dequantize_params",
+           "make_quantized_apply", "quantization_error", "weight_dtype"]

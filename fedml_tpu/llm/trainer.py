@@ -169,10 +169,13 @@ class CausalLMTrainer:
                 losses.append(loss)
                 self.global_step += 1
             self._set_train_tree(train_tree)
-            if budget_hit and not losses:
-                # budget exhausted on the epoch boundary: nothing ran, and
-                # re-saving the same global_step would collide in orbax
-                break
+            if not losses:
+                # nothing ran this epoch (budget hit the boundary, or the
+                # dataset is smaller than one batch): re-saving the same
+                # global_step would collide in orbax
+                if budget_hit:
+                    break
+                continue
             if losses:
                 mean_loss = float(jnp.mean(jnp.stack(losses)))
                 log.info("epoch %d: loss=%.4f (%.1fs)", epoch, mean_loss,

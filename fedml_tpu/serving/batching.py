@@ -63,8 +63,15 @@ class ContinuousBatchingEngine:
 
         self._prefill, _ = _build_cached_decode(model, self.top_k)
 
+        from ..llm.quantization import dequantize_params, weight_dtype
+        wdtype = weight_dtype(model)
+
         @jax.jit
         def batched_step(params, caches, toks, poss, keys, temps):
+            # int8-quantized trees dequantize inside the trace (stays int8
+            # in HBM; per-matmul dequant fuses) — no-op for plain trees
+            params = dequantize_params(params, wdtype)
+
             def one(cache, tok, pos, key, temp):
                 logits, mut = model.apply(
                     {"params": params, "cache": cache}, tok[None, None],

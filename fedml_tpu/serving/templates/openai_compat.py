@@ -87,12 +87,18 @@ def _build_plain_step(apply_fn: Callable, top_k: int):
 @functools.lru_cache(maxsize=32)
 def _build_cached_decode(model, top_k: int):
     """Jitted (prefill, step) pair for a flax model supporting
-    ``decode=True`` with a "cache" collection (``llm.model.LlamaLM``)."""
+    ``decode=True`` with a "cache" collection (``llm.model.LlamaLM``).
+
+    int8-quantized param trees (``llm/quantization.py``) pass through
+    transparently: the dequantize runs inside the traced program, so the
+    weights stay int8 in HBM and the per-matmul dequant fuses."""
+    from ...llm.quantization import dequantize_params, weight_dtype
+    wdtype = weight_dtype(model)
 
     @jax.jit
     def prefill(params, buf, n, key, temp):
         logits, mut = model.apply(
-            {"params": params}, buf, decode=True,
+            {"params": dequantize_params(params, wdtype)}, buf, decode=True,
             start_pos=jnp.zeros((), jnp.int32), mutable=["cache"])
         live = jax.lax.dynamic_index_in_dim(logits[0], n - 1, axis=0,
                                             keepdims=False)
@@ -101,7 +107,8 @@ def _build_cached_decode(model, top_k: int):
     @jax.jit
     def step(params, cache, tok, pos, key, temp):
         logits, mut = model.apply(
-            {"params": params, "cache": cache}, tok[None, None],
+            {"params": dequantize_params(params, wdtype), "cache": cache},
+            tok[None, None],
             decode=True, start_pos=pos, mutable=["cache"])
         return _sample_live(logits[0, 0], key, temp, top_k), mut["cache"]
 

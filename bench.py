@@ -10,6 +10,10 @@ flash-vs-blockwise attention ratio), then prints ONE json line.
 parity + timing sweep (S in {512, 2048, 4096}, causal x dtype x GQA) and
 prints that as one json line.
 
+``python bench.py --serve`` benchmarks the serving plane: KV-cached vs
+full-buffer decode, continuous batching vs sequential, and int8 vs full
+precision, printing one json line of tokens/sec numbers.
+
 ``vs_baseline``: the reference has no published numbers (BASELINE.md), so the
 ratio is measured against an in-process torch-CPU eager reimplementation of
 the reference's client loop (``my_model_trainer_classification.py``
@@ -423,7 +427,86 @@ def attn_sweep() -> dict:
     }
 
 
+# -- serving-plane benchmark (--serve) ---------------------------------------
+def serve_bench(on_accelerator: bool) -> dict:
+    """tokens/sec for the serving decode paths on one chip: plain
+    full-buffer, KV-cached, continuous batching (4 slots), and int8
+    weight-only quantized variants of the cached paths."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.llm.quantization import quantize_params_int8
+    from fedml_tpu.serving.batching import ContinuousBatchingEngine
+    from fedml_tpu.serving.templates.openai_compat import generate
+
+    if on_accelerator:
+        cfg = LlamaConfig(vocab_size=8192, dim=512, n_layers=8, n_heads=8,
+                          n_kv_heads=4, ffn_dim=1408, max_seq_len=512,
+                          dtype=jnp.bfloat16, lora_rank=0)
+        buf, n_new, slots = 512, 64, 4
+    else:
+        cfg = LlamaConfig(vocab_size=258, dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=4, ffn_dim=128, max_seq_len=256,
+                          dtype=jnp.float32, lora_rank=0)
+        buf, n_new, slots = 256, 48, 4
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    qtree, qstats = quantize_params_int8(params)
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+    prompt = [5, 17, 42]
+
+    def timed_generate(p, use_model, reps=1):
+        generate(apply_fn, p, prompt, max_new_tokens=4, buf_len=buf,
+                 model=model if use_model else None)  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = generate(apply_fn, p, prompt, max_new_tokens=n_new,
+                           buf_len=buf, model=model if use_model else None)
+        dt = (time.perf_counter() - t0) / reps
+        return round(len(out) / dt, 1)
+
+    result = {
+        "plain_tok_s": timed_generate(params, False),
+        "kv_cached_tok_s": timed_generate(params, True, reps=3),
+        "kv_cached_int8_tok_s": timed_generate(qtree, True, reps=3),
+        "int8_weight_bytes_ratio": round(qstats["ratio"], 3),
+    }
+
+    for name, p in (("batched_tok_s", params), ("batched_int8_tok_s", qtree)):
+        engine = ContinuousBatchingEngine(model, p, slots=slots, buf_len=buf)
+        try:
+            engine.generate(prompt, max_new_tokens=2)  # compile
+            t0 = time.perf_counter()
+            qs = [engine.submit([i + 1, i + 2, i + 3], max_new_tokens=n_new)
+                  for i in range(slots)]
+            total = 0
+            for q in qs:
+                while q.get() is not None:
+                    total += 1
+            result[name] = round(total / (time.perf_counter() - t0), 1)
+        finally:
+            engine.stop()
+    return result
+
+
 def main():
+    if "--serve" in sys.argv:
+        info = _platform_info()
+        result = serve_bench(info["platform"] not in ("cpu",))
+        result.update({
+            "metric": "serving_decode_tokens_per_sec",
+            "value": result["batched_tok_s"],
+            "unit": "tok/s_aggregate_4slots",
+            "vs_baseline": (round(result["batched_tok_s"]
+                                  / result["plain_tok_s"], 2)
+                            if result.get("plain_tok_s") else None),
+            **{k: info[k] for k in ("platform", "device_kind",
+                                    "backend_note")},
+        })
+        print(json.dumps(result))
+        return
+
     if "--attn" in sys.argv:
         info = _platform_info()
         result = attn_sweep()

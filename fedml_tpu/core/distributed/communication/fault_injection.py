@@ -75,10 +75,11 @@ class FaultInjectingCommManager(BaseCommunicationManager):
         if p_dup < self.dup_prob:
             copies = 2
             self.stats["duplicated"] += 1
-        if p_delay < self.delay_prob and self.max_delay_s > 0:
+        delayed = p_delay < self.delay_prob and self.max_delay_s > 0
+        if delayed:
             self.stats["delayed"] += 1  # per message, like the other stats
         for _ in range(copies):
-            if p_delay < self.delay_prob and self.max_delay_s > 0:
+            if delayed:
                 with self._rng_lock:
                     delay = float(self._rng.random()) * self.max_delay_s
                 entry = {"done": False}

@@ -51,8 +51,11 @@ def quantize_params_int8(params, min_size: int = 1024,
     def quant(leaf):
         x = np.asarray(leaf)
         dense_bytes[0] += x.nbytes
-        if x.ndim < 2 or x.size < min_size or not np.issubdtype(
-                x.dtype, np.floating):
+        # jnp.issubdtype, NOT np.issubdtype: bfloat16 is an ml_dtypes
+        # extension type (numpy kind 'V') that np.floating rejects — and
+        # bf16 is exactly the dtype TPU weight trees arrive in
+        if x.ndim < 2 or x.size < min_size or not jnp.issubdtype(
+                x.dtype, jnp.floating):
             q_bytes[0] += x.nbytes
             return leaf
         xf = x.astype(np.float32)

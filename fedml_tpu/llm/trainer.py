@@ -176,11 +176,10 @@ class CausalLMTrainer:
                 if budget_hit:
                     break
                 continue
-            if losses:
-                mean_loss = float(jnp.mean(jnp.stack(losses)))
-                log.info("epoch %d: loss=%.4f (%.1fs)", epoch, mean_loss,
-                         time.time() - t0)
-                history.append({"epoch": epoch, "loss": mean_loss})
+            mean_loss = float(jnp.mean(jnp.stack(losses)))
+            log.info("epoch %d: loss=%.4f (%.1fs)", epoch, mean_loss,
+                     time.time() - t0)
+            history.append({"epoch": epoch, "loss": mean_loss})
             self.save_checkpoint()
             if budget_hit:
                 log.info("max_steps=%d update budget reached at epoch %d",

@@ -191,7 +191,7 @@ class OpenAICompatServer:
     def __init__(self, apply_fn: Callable, params, tokenizer=None,
                  model_name: str = "fedml-tpu-llm", host: str = "127.0.0.1",
                  port: int = 0, buf_len: int = 256, model=None,
-                 batch_slots: int = 0):
+                 batch_slots: int = 0, draft_model=None, draft_params=None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -208,6 +208,15 @@ class OpenAICompatServer:
         self.host, self.port = host, port
         self.buf_len = buf_len
         self.model = model
+        # speculative decode (requires model + a draft; greedy requests
+        # only — sampled requests fall back to the plain paths)
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        if draft_model is not None and model is None:
+            raise ValueError("draft_model requires `model` (KV-cached "
+                             "target) — speculative decode is cache-based")
+        if draft_model is not None and draft_params is None:
+            raise ValueError("draft_model requires draft_params")
         self._engine = None
         if batch_slots:
             if model is None:
@@ -258,6 +267,16 @@ class OpenAICompatServer:
                 out.append(t)
                 if on_text:
                     emit(t)
+        elif (self.draft_model is not None
+              and float(req.get("temperature", 0.0)) == 0.0):
+            from ..speculative import speculative_generate
+            out, _spec_stats = speculative_generate(
+                self.model, self.params, self.draft_model,
+                self.draft_params, tok.encode(prompt),
+                max_new_tokens=int(req.get("max_tokens", 64)),
+                buf_len=self.buf_len,
+                eos_id=getattr(tok, "eos_id", None),
+                on_token=emit if on_text else None)
         else:
             out = generate(
                 self.apply_fn, self.params, tok.encode(prompt),

@@ -35,7 +35,9 @@ from ..llm.quantization import dequantize_params, weight_dtype
 
 
 @functools.lru_cache(maxsize=16)
-def _build_spec_fns(model, k: int):
+def _build_spec_fns(model):
+    # not k-specialized: verify_block handles any block length via jit
+    # retracing, so the cache keys on the model alone
     wdtype = weight_dtype(model)
 
     @jax.jit
@@ -81,8 +83,8 @@ def speculative_generate(model, params, draft_model, draft_params,
     raw = params.get("params", params) if isinstance(params, dict) else params
     draw = draft_params.get("params", draft_params) \
         if isinstance(draft_params, dict) else draft_params
-    t_prefill, _, t_verify = _build_spec_fns(model, k)
-    d_prefill, d_step, d_verify = _build_spec_fns(draft_model, k)
+    t_prefill, _, t_verify = _build_spec_fns(model)
+    d_prefill, d_step, d_verify = _build_spec_fns(draft_model)
 
     prompt_ids = list(prompt_ids)[-(buf_len - 1):]
     n = len(prompt_ids)

@@ -303,6 +303,18 @@ def load(args) -> Tuple[FederatedDataset, int]:
                                       test_client_idxs=tidx)
                 return ds, vocab
         real = _try_load_npz(cache, name) if cache else None
+        if real is None and cache and "shakespeare" in name:
+            # raw corpus file (what the reference's download step fetches
+            # before LEAF processing); searched under the same cache/<name>/
+            # convention the LEAF path uses, for either dataset alias
+            for cand in (os.path.join(cache, "shakespeare.txt"),
+                         os.path.join(cache, name, "shakespeare.txt"),
+                         os.path.join(cache, "shakespeare",
+                                      "shakespeare.txt")):
+                if os.path.exists(cand):
+                    from .leaf import load_shakespeare_raw
+                    real = load_shakespeare_raw(cand, seq_len)
+                    break
         if real is not None:
             tx, ty, vx, vy = real
         else:

@@ -138,3 +138,30 @@ def find_leaf_root(cache_dir: str, name: str) -> Optional[str]:
             if any(f.endswith(".json") for f in os.listdir(train)):
                 return root
     return None
+
+
+def load_shakespeare_raw(path: str, seq_len: int, max_windows: int = 60000,
+                         test_frac: float = 0.1, stride: int = None):
+    """Raw-text Shakespeare ingestion (the file the reference's
+    ``data/shakespeare`` download step fetches before LEAF processing):
+    char-encode the whole corpus with the LEAF alphabet, cut it into
+    ``seq_len + 1`` windows, and split train/test by position.
+
+    Returns ``(train_x, train_y, test_x, test_y)`` with x = chars[:-1],
+    y = chars[1:] next-char targets (same layout as the synthetic LM
+    generator and the LEAF loader)."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    ids = np.asarray(encode_chars(text), np.int64)
+    stride = int(stride or seq_len)
+    if len(ids) < 2 * (seq_len + 1):
+        raise ValueError(
+            f"{path}: corpus too short for a train AND a test "
+            f"{seq_len + 1}-char window ({len(ids)} chars)")
+    n_win = min(max(2, (len(ids) - seq_len - 1) // stride), max_windows)
+    windows = np.lib.stride_tricks.sliding_window_view(
+        ids, seq_len + 1)[::stride][:n_win]
+    n_win = len(windows)
+    x, y = windows[:, :-1], windows[:, 1:]
+    n_test = min(max(1, int(n_win * test_frac)), n_win - 1)
+    return x[:-n_test], y[:-n_test], x[-n_test:], y[-n_test:]

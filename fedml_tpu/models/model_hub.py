@@ -127,7 +127,6 @@ def create(args, output_dim: int = 10) -> FlaxModel:
             n_heads=int(getattr(args, "model_heads", 8)),
             ffn_dim=int(getattr(args, "model_ffn_dim", 512)),
             max_len=max(seq_len, 16))
-        import jax.numpy as jnp
         return FlaxModel(m, (seq_len,), input_dtype=jnp.int32,
                          task="classification")
     raise ValueError(f"unknown model {name!r}")

@@ -310,3 +310,32 @@ def test_stackoverflow_lr_tag_prediction_learns():
     loss1, em1 = api.evaluate()
     assert loss1 < loss0 * 0.7
     assert em1 > max(2 * empty_frac, 0.2), (em0, em1)
+
+
+def test_shakespeare_raw_text_ingestion(tmp_path):
+    """data_cache_dir/shakespeare.txt (the raw corpus the reference's
+    download step fetches) becomes char-LM windows with LEAF encoding."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod
+    from fedml_tpu.data.leaf import _CHAR_TO_ID
+
+    corpus = ("To be, or not to be, that is the question:\n"
+              "Whether 'tis nobler in the mind to suffer\n" * 120)
+    (tmp_path / "shakespeare.txt").write_text(corpus)
+
+    args = load_arguments()
+    args.update(dataset="shakespeare", data_cache_dir=str(tmp_path),
+                seq_len=20, client_num_in_total=4, random_seed=0)
+    ds, vocab = data_mod.load(args)
+    assert vocab == 90
+    assert ds.train_x.shape[1] == 20
+    assert ds.train_y.shape == ds.train_x.shape
+    # y is x shifted by one (next-char targets over a contiguous window)
+    np.testing.assert_array_equal(ds.train_x[0, 1:], ds.train_y[0, :-1])
+    # round-trips the actual corpus characters, not synthetic tokens
+    first = "".join(
+        {v: k for k, v in _CHAR_TO_ID.items()}.get(int(t), "?")
+        for t in ds.train_x[0][:8])
+    assert first == corpus[:8]
+    assert ds.num_clients == 4

@@ -1,0 +1,93 @@
+"""TPU-tunnel watchdog: probe until the wedged tunnel revives, then run
+the full benchmark battery once and exit.
+
+The tunnel-attached TPU in this image wedges for hours at a time
+(BASELINE.md round-2 notes): ``jax.devices()`` blocks indefinitely and
+only an out-of-process probe can tell.  This tool polls cheaply and, the
+moment a probe succeeds, captures every TPU-side artifact in one pass:
+
+- ``TPU_BENCH_LIVE.json``   — bench.py default mode (FedAvg + LLM LoRA)
+- ``TPU_ATTN_SWEEP.json``   — bench.py --attn (flash vs blockwise parity+timing)
+- ``TPU_SERVE_BENCH.json``  — bench.py --serve (decode stack tokens/sec)
+- ``TPU_NAN_BISECT.out``    — tools/tpu_nan_bisect.py (bf16 gradient issue)
+
+Run detached:  nohup python tools/tpu_watchdog.py > tools/watchdog.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_TIMEOUT_S = 120
+POLL_INTERVAL_S = 300
+JOB_TIMEOUT_S = 2400
+
+
+def _probe_worker(q):
+    import jax  # noqa: PLC0415
+
+    q.put([str(d) for d in jax.devices()])
+
+
+def tpu_alive() -> bool:
+    q = mp.Queue()
+    p = mp.Process(target=_probe_worker, args=(q,))
+    p.start()
+    p.join(PROBE_TIMEOUT_S)
+    if p.is_alive():
+        p.terminate()
+        p.join(5)
+        return False
+    if q.empty():
+        return False
+    devs = q.get()
+    alive = any("TPU" in d or "tpu" in d for d in devs)
+    print(f"[watchdog] probe: {devs} alive={alive}", flush=True)
+    return alive
+
+
+def run_job(cmd, out_path, timeout_s=JOB_TIMEOUT_S) -> bool:
+    print(f"[watchdog] running: {' '.join(cmd)}", flush=True)
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"[watchdog] TIMEOUT: {cmd}", flush=True)
+        return False
+    with open(os.path.join(REPO, out_path), "w") as f:
+        f.write(r.stdout)
+        if r.returncode != 0:
+            f.write(f"\n[stderr tail]\n{r.stderr[-4000:]}\n[rc={r.returncode}]")
+    print(f"[watchdog] {out_path}: rc={r.returncode} "
+          f"({len(r.stdout)} bytes)", flush=True)
+    return r.returncode == 0
+
+
+def main():
+    t0 = time.time()
+    while True:
+        if tpu_alive():
+            break
+        print(f"[watchdog] tunnel wedged ({(time.time() - t0) / 60:.0f} min "
+              f"elapsed); retrying in {POLL_INTERVAL_S}s", flush=True)
+        time.sleep(POLL_INTERVAL_S)
+
+    py = sys.executable
+    # serialize: one TPU client at a time (concurrent clients wedge it)
+    run_job([py, "bench.py"], "TPU_BENCH_LIVE.json")
+    run_job([py, "bench.py", "--serve"], "TPU_SERVE_BENCH.json")
+    run_job([py, "bench.py", "--attn"], "TPU_ATTN_SWEEP.json",
+            timeout_s=3600)
+    run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
+            timeout_s=3600)
+    print("[watchdog] battery complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .federated_dataset import FederatedDataset, build_federated, partition
-from .leaf import find_leaf_root, load_leaf
+from .leaf import find_leaf_root, load_leaf, load_shakespeare_raw
 from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
                         synthetic_segmentation, synthetic_tabular,
                         synthetic_tag_prediction,
@@ -312,7 +312,6 @@ def load(args) -> Tuple[FederatedDataset, int]:
                          os.path.join(cache, "shakespeare",
                                       "shakespeare.txt")):
                 if os.path.exists(cand):
-                    from .leaf import load_shakespeare_raw
                     real = load_shakespeare_raw(cand, seq_len)
                     break
         if real is not None:

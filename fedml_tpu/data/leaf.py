@@ -164,4 +164,9 @@ def load_shakespeare_raw(path: str, seq_len: int, max_windows: int = 60000,
     n_win = len(windows)
     x, y = windows[:, :-1], windows[:, 1:]
     n_test = min(max(1, int(n_win * test_frac)), n_win - 1)
-    return x[:-n_test], y[:-n_test], x[-n_test:], y[-n_test:]
+    # materialize: sliding views are read-only/non-contiguous, unlike every
+    # other loader's owned arrays
+    return (np.ascontiguousarray(x[:-n_test]),
+            np.ascontiguousarray(y[:-n_test]),
+            np.ascontiguousarray(x[-n_test:]),
+            np.ascontiguousarray(y[-n_test:]))

@@ -349,3 +349,42 @@ def test_vfl_split_models_learn_xor_of_parties():
         hb.backward(xb, g_fused[:, 8:])
     acc1 = float((logits_np(xa, xb).argmax(1) == y).mean())
     assert acc1 > max(acc0, 0.8)
+
+
+def test_model_hub_every_name_creates_and_forwards():
+    """Safety net: every name the hub dispatches must create, init, and
+    forward (a latent UnboundLocal in one branch once broke model=rnn for
+    every caller while all other branches' tests stayed green)."""
+    from fedml_tpu.models import model_hub
+
+    img = dict(input_shape=(16, 16, 3))
+    small_img = dict(input_shape=(8, 8, 1))
+    tok = dict(seq_len=12, vocab_size=64)
+    cases = [
+        ("lr", 4, img), ("logistic_regression", 4, img), ("mlp", 4, img),
+        ("cnn", 62, {}), ("cnn_web", 4, img), ("cnn_cifar", 10, {}),
+        ("resnet18_gn", 10, {}), ("resnet56", 10, {}), ("resnet20", 10, {}),
+        ("rnn", 90, tok), ("rnn_shakespeare", 90, tok),
+        ("rnn_stackoverflow", 64, tok), ("rnn_nwp", 64, tok),
+        ("mobilenet", 10, {}), ("mobilenet_v3", 10, {}),
+        ("efficientnet", 10, {}), ("darts", 5, small_img),
+        ("unet", 3, small_img), ("vgg11", 4, img), ("vgg16", 4, img),
+        ("gcn", 3, dict(max_nodes=8, node_feature_dim=4)),
+        ("tiny_llama", 64, tok), ("text_transformer", 4, tok),
+        ("distilbert", 4, tok),
+    ]
+    for name, out_dim, extra in cases:
+        args = types.SimpleNamespace(model=name, dataset="x", **extra)
+        m = model_hub.create(args, out_dim)
+        p = m.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((2,) + tuple(m.input_shape), m.input_dtype)
+        out = m.apply(p, x)
+        assert np.all(np.isfinite(np.asarray(out, np.float32))), name
+        assert out.shape[0] == 2, (name, out.shape)
+
+    # unknown names fail loudly
+    import pytest
+    with pytest.raises(ValueError):
+        model_hub.create(types.SimpleNamespace(model="nope", dataset="x"), 2)
+    with pytest.raises(ValueError):
+        model_hub.create(types.SimpleNamespace(model="vgg99", dataset="x"), 2)

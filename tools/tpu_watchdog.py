@@ -57,8 +57,18 @@ def run_job(cmd, out_path, timeout_s=JOB_TIMEOUT_S) -> bool:
     try:
         r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
                            timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         print(f"[watchdog] TIMEOUT: {cmd}", flush=True)
+        # overwrite the artifact so a stale previous result can't
+        # masquerade as this run's output
+        with open(os.path.join(REPO, out_path), "w") as f:
+            partial = (e.stdout or b"")
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            f.write(json.dumps({"metric": "watchdog_timeout", "value": None,
+                                "unit": None, "vs_baseline": None,
+                                "cmd": cmd, "timeout_s": timeout_s}))
+            f.write("\n[partial stdout]\n" + partial[-4000:])
         return False
     with open(os.path.join(REPO, out_path), "w") as f:
         f.write(r.stdout)

@@ -286,6 +286,10 @@ def load(args) -> Tuple[FederatedDataset, int]:
             tx, ty, vx, vy = real
         else:
             noise = float(getattr(args, "synthetic_noise", 0.35))
+            # synthetic fallback honors size overrides (full reference
+            # cardinality only when none given)
+            train_n = int(getattr(args, "train_size", 0) or train_n)
+            test_n = int(getattr(args, "test_size", 0) or test_n)
             tx, ty, vx, vy = synthetic_image_classification(
                 train_n, test_n, classes, shape, seed, noise)
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method, alpha, seed)
@@ -317,6 +321,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         if real is not None:
             tx, ty, vx, vy = real
         else:
+            train_n = int(getattr(args, "train_size", 0) or train_n)
+            test_n = int(getattr(args, "test_size", 0) or test_n)
             tx, ty, vx, vy = synthetic_lm_tokens(train_n, test_n, vocab, seq_len, seed)
         ds = build_federated(tx, ty, vx, vy, vocab, client_num, method="homo",
                              alpha=alpha, seed=seed)

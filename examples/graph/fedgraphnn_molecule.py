@@ -1,0 +1,45 @@
+"""FedGraphNN-style federated graph classification (reference app zoo
+``examples/federate/prebuilt_jobs/fedgraphnn``): a GCN over dense
+normalized adjacencies, trained with FedAvg over non-IID graph clients.
+
+Run: python examples/graph/fedgraphnn_molecule.py
+"""
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.data.federated_dataset import FederatedDataset
+from fedml_tpu.models.gcn import (pack_graph_batch,
+                                  synthetic_graph_classification)
+from fedml_tpu import model as model_mod
+from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+if __name__ == "__main__":
+    n_nodes, feat, classes = 16, 8, 3
+    x, adj, mask, y = synthetic_graph_classification(480, n_nodes, feat,
+                                                     classes, seed=0)
+    packed = pack_graph_batch(x, adj, mask)
+    xt, adjt, maskt, yt = synthetic_graph_classification(
+        96, n_nodes, feat, classes, seed=1)
+    packed_t = pack_graph_batch(xt, adjt, maskt)
+
+    # non-IID: clients specialize in graph classes (Dirichlet on labels)
+    from fedml_tpu.core.data.noniid_partition import partition
+    idxs = partition(y, 6, "hetero", 0.5, 0)
+    ds = FederatedDataset(packed, y, packed_t, yt, idxs, classes)
+
+    args = load_arguments()
+    args.update(model="gcn", dataset="fedgraphnn", max_nodes=n_nodes,
+                node_feature_dim=feat, client_num_in_total=6,
+                client_num_per_round=6, comm_round=12, epochs=2,
+                batch_size=16, learning_rate=0.05, client_optimizer="adam",
+                frequency_of_the_test=100, random_seed=0)
+    model = model_mod.create(args, classes)
+    api = FedAvgAPI(args, None, ds, model)
+    loss0, acc0 = api.evaluate()
+    for r in range(args.comm_round):
+        api.train_one_round(r)
+    loss1, acc1 = api.evaluate()
+    rep = api.evaluate_per_client()
+    print(f"graph-classification acc {acc0:.3f} -> {acc1:.3f}; "
+          f"per-client mean={rep['acc_mean']:.3f} min={rep['acc_min']:.3f}")

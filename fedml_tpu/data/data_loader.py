@@ -256,6 +256,17 @@ def _try_load_hdf5(cache_dir: str, name: str):
     return None
 
 
+def _sizes(args, train_n: int, test_n: int,
+           cap: Optional[Tuple[int, int]] = None) -> Tuple[int, int]:
+    """train/test sample counts for a synthetic fallback: explicit
+    ``args.train_size``/``test_size`` win, else the branch default
+    (optionally capped for reference-scale cardinalities)."""
+    if cap is not None:
+        train_n, test_n = min(train_n, cap[0]), min(test_n, cap[1])
+    return (int(getattr(args, "train_size", 0) or train_n),
+            int(getattr(args, "test_size", 0) or test_n))
+
+
 def load(args) -> Tuple[FederatedDataset, int]:
     name = str(getattr(args, "dataset", "synthetic_mnist")).lower()
     cache = str(getattr(args, "data_cache_dir", "") or "")
@@ -288,8 +299,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
             noise = float(getattr(args, "synthetic_noise", 0.35))
             # synthetic fallback honors size overrides (full reference
             # cardinality only when none given)
-            train_n = int(getattr(args, "train_size", 0) or train_n)
-            test_n = int(getattr(args, "test_size", 0) or test_n)
+            train_n, test_n = _sizes(args, train_n, test_n)
             tx, ty, vx, vy = synthetic_image_classification(
                 train_n, test_n, classes, shape, seed, noise)
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method, alpha, seed)
@@ -321,8 +331,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
         if real is not None:
             tx, ty, vx, vy = real
         else:
-            train_n = int(getattr(args, "train_size", 0) or train_n)
-            test_n = int(getattr(args, "test_size", 0) or test_n)
+            train_n, test_n = _sizes(args, train_n, test_n)
             tx, ty, vx, vy = synthetic_lm_tokens(train_n, test_n, vocab, seq_len, seed)
         ds = build_federated(tx, ty, vx, vy, vocab, client_num, method="homo",
                              alpha=alpha, seed=seed)
@@ -353,10 +362,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
             n_tags = int(getattr(args, "tag_count", 0) or min(ref_tags, 100))
             n_feats = int(getattr(args, "feature_dim", 0) or
                           min(ref_feats, 1000))
-            train_n = int(getattr(args, "train_size", 0) or
-                          min(ref_train_n, 5000))
-            test_n = int(getattr(args, "test_size", 0) or
-                         min(ref_test_n, 500))
+            train_n, test_n = _sizes(args, ref_train_n, ref_test_n,
+                                     cap=(5000, 500))
             tx, ty, vx, vy = synthetic_tag_prediction(
                 train_n, test_n, n_tags, n_feats, seed)
         # Dirichlet partition needs scalar labels: use each example's
@@ -378,6 +385,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
         if real is not None:
             tx, ty, vx, vy = real
         else:
+            train_n, test_n = _sizes(args, train_n, test_n)
             tx, ty, vx, vy = synthetic_tabular(train_n, test_n, classes,
                                                n_features, seed)
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
@@ -390,8 +398,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
         # model/data must agree on the token space: honor overrides so a
         # small-vocab model can train on a matching synthetic set
         vocab = int(getattr(args, "vocab_size", 0) or vocab)
-        train_n = int(getattr(args, "train_size", 0) or train_n)
-        test_n = int(getattr(args, "test_size", 0) or test_n)
+        train_n, test_n = _sizes(args, train_n, test_n)
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:
             tx, ty, vx, vy = real
@@ -412,10 +419,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         else:
             # synthetic fallback at a tractable scale (reference
             # cardinalities would be ~770GB of pixels)
-            train_n = int(getattr(args, "train_size", 0) or
-                          min(ref_train_n, 20000))
-            test_n = int(getattr(args, "test_size", 0) or
-                         min(ref_test_n, 2000))
+            train_n, test_n = _sizes(args, ref_train_n, ref_test_n,
+                                     cap=(20000, 2000))
             shape = tuple(getattr(args, "input_shape", None) or shape)
             tx, ty, vx, vy = synthetic_image_classification(
                 train_n, test_n, classes, shape, seed)
@@ -425,8 +430,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
 
     if name in _SEG_SPECS:
         classes, shape, train_n, test_n = _SEG_SPECS[name]
-        train_n = int(getattr(args, "train_size", 0) or train_n)
-        test_n = int(getattr(args, "test_size", 0) or test_n)
+        train_n, test_n = _sizes(args, train_n, test_n)
         shape = tuple(getattr(args, "input_shape", None) or shape)
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:

@@ -220,7 +220,10 @@ def test_dataset_loader_every_name():
                     data_cache_dir="")  # hermetic: synthetic fallback only
         ds, out_dim = data_mod.load(args)
         assert out_dim > 0, name
-        assert len(ds.train_x) > 0, name
+        # the size overrides must actually bite (keeps the sweep small and
+        # pins the override plumbing in every synthetic branch)
+        assert len(ds.train_x) == 64, (name, len(ds.train_x))
+        assert len(ds.test_x) == 16, (name, len(ds.test_x))
         assert ds.num_clients == 4, name
         total = sum(len(v) for v in ds.client_idxs.values())
         assert total <= len(ds.train_x), name

@@ -33,6 +33,12 @@ class FedMLDifferentialPrivacy:
         self._key = None
 
     def init(self, args):
+        # full reset first, so a later run without the flag in the same
+        # process doesn't inherit the previous run's frame/noise config
+        self.is_enabled = False
+        self.solution = None
+        self.frame = None
+        self._key = None
         if args is None or not getattr(args, "enable_dp", False):
             return
         self.is_enabled = True

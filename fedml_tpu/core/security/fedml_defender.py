@@ -30,6 +30,11 @@ class FedMLDefender:
         self.defender = None
 
     def init(self, args):
+        # full reset first, so a later run without the flag in the same
+        # process doesn't inherit the previous run's defender
+        self.is_enabled = False
+        self.defense_type = None
+        self.defender = None
         if args is None or not getattr(args, "enable_defense", False):
             return
         self.is_enabled = True

@@ -87,3 +87,51 @@ def test_cross_silo_survives_dropped_upload_via_timeout():
         chaos_droppable_types=[3],  # C2S model uploads only
         aggregation_timeout_s=3.0)
     assert result["params"] is not None
+
+
+def test_kitchen_sink_federation(tmp_path):
+    """Feature-interaction soak: ONE federation with delta compression,
+    global DP noise, norm-clipping defense, round checkpointing, AND
+    dup+delay message chaos — every plugin must compose (decompression
+    precedes defense/DP hooks; chaos never corrupts the FSM)."""
+    import os
+    from tests.test_cross_silo import _run_federation
+    from fedml_tpu.core.compression import FedMLCompression
+    from fedml_tpu.core.dp.fedml_differential_privacy import (
+        FedMLDifferentialPrivacy)
+    from fedml_tpu.core.security.fedml_defender import FedMLDefender
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    try:
+        result = _run_federation(
+            "local", "sink1",
+            comm_round=4,
+            # compression (delta topk)
+            enable_compression=True, compression_type="topk",
+            compression_ratio=0.2,
+            # global DP
+            enable_dp=True, dp_solution_type="global_dp",
+            dp_mechanism_type="gaussian", dp_epsilon=50.0, dp_delta=1e-4,
+            dp_sensitivity=0.5,
+            # robust aggregation
+            enable_defense=True, defense_type="norm_diff_clipping",
+            norm_bound=5.0,
+            # round checkpoints
+            checkpoint_dir=ckpt_dir, checkpoint_freq=2,
+            # message chaos
+            chaos_seed=5, chaos_dup_prob=0.25, chaos_delay_prob=0.4,
+            chaos_max_delay_s=0.02,
+        )
+        assert result["params"] is not None
+        # DP noise at eps=50 is mild: the federation still learns
+        assert result["acc"] > 0.5, result["acc"]
+        assert any(os.scandir(ckpt_dir)), "no round checkpoint written"
+    finally:
+        # plugin init() now fully resets on flag-less args (tested here):
+        # later federation tests must not inherit this test's plugins
+        class A: pass
+        FedMLCompression.get_instance().init(A())
+        FedMLDifferentialPrivacy.get_instance().init(A())
+        FedMLDefender.get_instance().init(A())
+        assert not FedMLDifferentialPrivacy.get_instance().is_dp_enabled()
+        assert not FedMLDefender.get_instance().is_defense_enabled()

@@ -120,6 +120,25 @@ class ServerOptimizer:
                 aux["grad_sum"], weights)
         return agg
 
+    def merge_aggregates(self, aggs, total_ws) -> dict:
+        """Combine per-bucket aggregates (see
+        ``round_engine.make_bucket_agg_fn``) into one cohort aggregate.
+        Every entry is a weighted average, so the merge is the
+        weight-weighted average of bucket averages — exact up to float
+        reassociation."""
+        tw = sum(total_ws)
+
+        def wavg(key):
+            return jax.tree_util.tree_map(
+                lambda *leaves: sum(w * l for w, l in zip(total_ws, leaves))
+                / tw,
+                *[a[key] for a in aggs])
+
+        # only the stateless wavg family reaches this merge
+        # (round_engine.BUCKETABLE_ALGS) — no aux keys to combine
+        return {"avg_params": wavg("avg_params"),
+                "n_sampled": sum(a["n_sampled"] for a in aggs)}
+
     # -- stage 2: server state transition (replicated) --------------------
     def update_from_aggregates(self, state: ServerState, agg: dict
                                ) -> ServerState:

@@ -51,16 +51,12 @@ def make_server_ctx(trainer: LocalTrainer, state: ServerState) -> ServerCtx:
     )
 
 
-def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                  mode: str = "scan") -> Callable:
-    """Build round_fn(state, x, y, mask, weights, key, c_clients) ->
-    (new_state, metrics, new_client_state).  All client-axis inputs are
-    stacked; ``key`` is the single round key (split per client inside the
-    jit); ``c_clients`` is None unless the algorithm keeps per-client state
-    (SCAFFOLD/FedDyn)."""
+def make_run_clients(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                     mode: str = "scan") -> Callable:
+    """Shared cohort executor: (state, x, y, mask, rngs, c_clients) →
+    stacked ClientOut (vmap or scan over the client axis)."""
     local_train = trainer.make_local_train()
     body = _client_body(local_train, server_opt)
-    alg = server_opt.algorithm
 
     def run_clients(state, x, y, mask, rngs, c_clients):
         ctx = make_server_ctx(trainer, state)
@@ -74,6 +70,19 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             return carry, fn(xb, yb, mb, rng, cc)
         _, outs = jax.lax.scan(scan_body, 0, (x, y, mask, rngs, c_clients))
         return outs  # ClientOut with leading client axis
+
+    return run_clients
+
+
+def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                  mode: str = "scan") -> Callable:
+    """Build round_fn(state, x, y, mask, weights, key, c_clients) ->
+    (new_state, metrics, new_client_state).  All client-axis inputs are
+    stacked; ``key`` is the single round key (split per client inside the
+    jit); ``c_clients`` is None unless the algorithm keeps per-client state
+    (SCAFFOLD/FedDyn)."""
+    alg = server_opt.algorithm
+    run_clients = make_run_clients(trainer, server_opt, mode)
 
     def round_fn(state: ServerState, x, y, mask, weights, key,
                  c_clients=None):
@@ -124,3 +133,46 @@ def next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+#: server-optimizer families whose round aggregates are plain weighted
+#: averages and carry no per-client state, so bucket partials merge exactly
+#: (SCAFFOLD/FedDyn keep per-client trees, FedNova/Mime aux terms don't
+#: merge across padded buckets — those stay on the single-cohort path)
+BUCKETABLE_ALGS = ("fedavg", "fedavg_seq", "fedprox", "fedopt", "fedopt_seq")
+
+
+def make_bucket_agg_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                       mode: str = "vmap") -> Callable:
+    """Partial-round program for BUCKETED cohorts (ragged client sizes).
+
+    The single-cohort round pads every client to the cohort's max step
+    count, so under a skewed Dirichlet split most of the cohort burns
+    masked compute.  Bucketing groups clients by pow2 step class and runs
+    this program once per bucket; because ``compute_aggregates`` is a
+    weighted average, bucket partials merge EXACTLY
+    (``ServerOptimizer.merge_aggregates``) before one
+    ``update_from_aggregates`` — same math, less padding.
+
+    Returns ``bucket_fn(state, x, y, mask, weights, rngs) ->
+    (agg, total_w, loss_w, total_steps)``.  Padded client rows must carry
+    weight 0 (excluded from every average).
+    """
+    if server_opt.algorithm not in BUCKETABLE_ALGS:
+        raise ValueError(
+            f"cohort bucketing supports {BUCKETABLE_ALGS}; "
+            f"{server_opt.algorithm!r} keeps aux state whose aggregates "
+            "don't merge across padded buckets")
+    run_clients = make_run_clients(trainer, server_opt, mode)
+
+    def bucket_fn(state: ServerState, x, y, mask, weights, rngs):
+        outs: ClientOut = run_clients(state, x, y, mask, rngs, None)
+        agg = server_opt.compute_aggregates(state, outs.params, weights, {})
+        # padded rows (weight 0) must not count as sampled clients
+        # (FedDyn's frac = n_sampled / total_clients reads this)
+        agg["n_sampled"] = jnp.sum((weights > 0).astype(jnp.float32))
+        total_w = jnp.sum(weights)
+        loss_w = jnp.sum(outs.loss * weights)
+        return agg, total_w, loss_w, jnp.sum(outs.num_steps)
+
+    return bucket_fn

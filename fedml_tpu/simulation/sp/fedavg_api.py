@@ -55,6 +55,22 @@ class FedAvgAPI:
 
         self.trainer = LocalTrainer(model, args)
         self.server_opt = ServerOptimizer(args)
+        # ragged-cohort bucketing (stateless wavg algorithms only)
+        from ..round_engine import BUCKETABLE_ALGS
+        self._bucketing = bool(getattr(args, "cohort_bucketing", False))
+        if self._bucketing and self.server_opt.algorithm not in \
+                BUCKETABLE_ALGS:
+            raise ValueError(
+                f"cohort_bucketing supports {BUCKETABLE_ALGS}, not "
+                f"{self.server_opt.algorithm!r}")
+        if self._bucketing and \
+                type(self).train_one_round is not FedAvgAPI.train_one_round:
+            # a subclass with its own round loop would silently ignore the
+            # flag and report unbucketed numbers as bucketed
+            raise ValueError(
+                f"{type(self).__name__} does not implement cohort_bucketing")
+        self._bucket_fn = None
+        self._update_from_agg = None
         key = rng_util.root_key(self.seed)
         params = model.init(rng_util.purpose_key(key, "init"))
         self.state = self.server_opt.init(params)
@@ -72,6 +88,10 @@ class FedAvgAPI:
 
     def _build_round_fn(self, client_mode: str):
         donate = (0,) if self.DONATE_STATE else ()
+        if self._bucketing:
+            # the bucketed round host-stages per-bucket cohorts; don't
+            # upload a device-resident dataset copy nothing will read
+            return None
         if bool(getattr(self.args, "device_data", True)):
             # dataset device-resident once; rounds ship only index tensors
             self._dev_x = jnp.asarray(self.dataset.train_x)
@@ -102,7 +122,72 @@ class FedAvgAPI:
         for i, c in enumerate(clients):
             self._c_clients[int(c)] = tree_util.tree_index(new_state_stacked, i)
 
+    def _train_one_round_bucketed(self, round_idx: int):
+        """Ragged-cohort round: clients grouped into pow2 step-count
+        buckets, one partial program per bucket, aggregates merged exactly
+        (``round_engine.make_bucket_agg_fn``).  Cuts the masked-padding
+        compute a single max-steps cohort burns under skewed Dirichlet
+        splits; gated to the stateless weighted-average algorithms."""
+        from ..round_engine import make_bucket_agg_fn
+
+        clients = self._client_sampling(round_idx)
+        key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
+        per = [self.dataset.client_batches(int(c), self.batch_size, self.seed,
+                                           round_idx, self.epochs)
+               for c in clients]
+        if self._bucket_fn is None:
+            self._bucket_fn = jax.jit(make_bucket_agg_fn(
+                self.trainer, self.server_opt, mode="vmap"))
+            self._update_from_agg = jax.jit(
+                self.server_opt.update_from_aggregates)
+        # same per-position rng stream as the unbucketed round; one host
+        # materialization (per-position np.asarray would be ~C tiny
+        # blocking transfers per round)
+        rngs_all = np.asarray(jax.random.split(key, len(clients)))
+        weights_all = self.dataset.client_sample_counts()[clients].astype(
+            np.float32)
+
+        buckets = {}
+        for pos, (xb, _) in enumerate(per):
+            buckets.setdefault(next_pow2(xb.shape[0]), []).append(pos)
+
+        partials, total_ws, loss_ws, step_sums = [], [], [], []
+        for steps, positions in sorted(buckets.items()):
+            cb = next_pow2(len(positions))
+            x = np.zeros((cb, steps) + per[0][0].shape[1:],
+                         self.dataset.train_x.dtype)
+            y = np.zeros((cb, steps) + per[0][1].shape[1:],
+                         self.dataset.train_y.dtype)
+            mask = np.zeros((cb, steps), np.float32)
+            w = np.zeros((cb,), np.float32)
+            rngs = np.zeros((cb,) + rngs_all[0].shape, rngs_all.dtype)
+            for i, pos in enumerate(positions):
+                xb, yb = per[pos]
+                s = xb.shape[0]
+                x[i, :s], y[i, :s], mask[i, :s] = xb, yb, 1.0
+                w[i] = weights_all[pos]
+                rngs[i] = rngs_all[pos]
+            agg, tw, lw, ts = self._bucket_fn(
+                self.state, jnp.asarray(x), jnp.asarray(y),
+                jnp.asarray(mask), jnp.asarray(w), jnp.asarray(rngs))
+            partials.append(agg)
+            total_ws.append(tw)
+            loss_ws.append(lw)
+            step_sums.append(ts)
+
+        merged = self.server_opt.merge_aggregates(partials, total_ws)
+        self.state = self._update_from_agg(self.state, merged)
+        tw = sum(jnp.asarray(t) for t in total_ws)
+        allocated = sum(next_pow2(len(p)) * s for s, p in buckets.items())
+        return {"train_loss": sum(loss_ws) / tw,
+                "total_steps": sum(step_sums),
+                # compiled client-lane slots this round actually allocated
+                # (the padding-waste metric bucketing exists to shrink)
+                "allocated_steps": allocated}
+
     def train_one_round(self, round_idx: int):
+        if self._bucketing:
+            return self._train_one_round_bucketed(round_idx)
         clients = self._client_sampling(round_idx)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
         c_stacked = self._gather_c(clients)
@@ -131,6 +216,8 @@ class FedAvgAPI:
                 self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
                 jnp.asarray(w), key, c_stacked)
         self._scatter_c(clients, new_c)
+        metrics = dict(metrics)
+        metrics["allocated_steps"] = len(clients) * steps
         return metrics
 
     def evaluate(self):

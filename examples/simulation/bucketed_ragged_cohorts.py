@@ -1,0 +1,51 @@
+"""Ragged-cohort bucketing demo: under a skewed non-IID split
+(Dirichlet α=0.1) client data sizes vary ~5-6× around the mean, so a
+single padded cohort wastes most of its compute on masked steps.
+``cohort_bucketing=true`` groups clients into pow2 step classes and
+merges the bucket aggregates exactly — same curves, fewer allocated
+lanes.
+
+Run: python examples/simulation/bucketed_ragged_cohorts.py
+"""
+import time
+
+import numpy as np
+
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu import data as data_mod, model as model_mod
+from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+
+def build(bucketing: bool) -> FedAvgAPI:
+    args = load_arguments()
+    args.update(dataset="synthetic", num_classes=10, input_shape=(28, 28, 1),
+                train_size=24000, test_size=500, model="lr",
+                client_num_in_total=256, client_num_per_round=128,
+                comm_round=6, epochs=1, batch_size=10, learning_rate=0.1,
+                partition_method="hetero", partition_alpha=0.1,
+                frequency_of_the_test=1000, random_seed=5,
+                cohort_bucketing=bucketing, device_data=False)
+    ds, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    return FedAvgAPI(args, None, ds, model)
+
+
+if __name__ == "__main__":
+    sizes = build(False).dataset.client_sample_counts()
+    print(f"client sizes: min={sizes.min()} median={int(np.median(sizes))} "
+          f"max={sizes.max()} (max/mean {sizes.max() / sizes.mean():.1f}x)")
+
+    for bucketing in (False, True):
+        api = build(bucketing)
+        api.train_one_round(0)  # compile
+        m = api.train_one_round(1)
+        t0 = time.perf_counter()
+        for r in range(2, 6):
+            m = api.train_one_round(r)
+        import jax
+        jax.block_until_ready(api.state.global_params)
+        dt = (time.perf_counter() - t0) / 4
+        _, acc = api.evaluate()
+        print(f"bucketing={bucketing}: {dt * 1000:.0f} ms/round, "
+              f"allocated lanes/round={int(m['allocated_steps'])}, "
+              f"acc={acc:.3f}")

@@ -9,6 +9,7 @@ Run: python examples/simulation/bucketed_ragged_cohorts.py
 """
 import time
 
+import jax
 import numpy as np
 
 from fedml_tpu.arguments import load_arguments
@@ -42,7 +43,6 @@ if __name__ == "__main__":
         t0 = time.perf_counter()
         for r in range(2, 6):
             m = api.train_one_round(r)
-        import jax
         jax.block_until_ready(api.state.global_params)
         dt = (time.perf_counter() - t0) / 4
         _, acc = api.evaluate()

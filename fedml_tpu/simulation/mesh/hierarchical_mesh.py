@@ -1,0 +1,163 @@
+"""Hierarchical FL as a TWO-LEVEL mesh program (SURVEY §2.9: "two-level
+mesh axes — ICI within pod-slice = silo, DCN across").
+
+The sp engine (``simulation/sp/hierarchical_fl.py``) runs a Python loop:
+``group_comm_round x group_num`` separate round dispatches per global
+round.  Here a global round is ONE ``jit(shard_map)`` program: groups are
+sharded over the ``group`` mesh axis, each shard scans its
+``group_comm_round`` inner rounds locally (group-local FedAvg — zero
+cross-chip traffic), and only the final global merge crosses shards with
+a single ``psum`` pair.  On a pod the inner rounds ride a slice's ICI and
+the one global merge is the only DCN-bound collective — the exact comm
+structure hierarchical FL exists to create.
+
+Numerics match the sp engine leaf-for-leaf (same per-(inner, group) key
+derivation, same member batches, weighted-average group/global merges) —
+parity-tested in ``tests/test_mesh.py``.  Gated to the weighted-average
+group update (FedAvg/FedProx).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core import rng as rng_util
+from ...core import tree as tree_util
+from ...ml.trainer.local_trainer import ServerCtx
+from ..round_engine import next_pow2
+from ..sp.hierarchical_fl import HierarchicalFedAvgAPI
+
+GROUP_AXIS = "group"
+
+
+class MeshHierarchicalAPI(HierarchicalFedAvgAPI):
+    """Two-level hierarchical FedAvg with one compiled program per global
+    round.  Requires ``group_num`` groups == the mesh's ``group`` axis size
+    and a weighted-average group update (FedAvg/FedProx)."""
+
+    def __init__(self, args, device, dataset, model, mesh: Mesh = None):
+        if str(getattr(args, "federated_optimizer", "FedAvg")).lower() not in \
+                ("fedavg", "fedprox"):
+            raise ValueError(
+                "MeshHierarchicalAPI implements the weighted-average group "
+                "update (FedAvg/FedProx); other optimizers keep server "
+                "state per group — use the sp hierarchical engine")
+        super().__init__(args, device, dataset, model)
+        if mesh is None:
+            devices = np.array(jax.devices()[: self.group_num])
+            mesh = Mesh(devices, (GROUP_AXIS,))
+        if mesh.shape[GROUP_AXIS] != self.group_num:
+            raise ValueError(
+                f"group_num={self.group_num} must equal the mesh "
+                f"{GROUP_AXIS!r} axis size {mesh.shape[GROUP_AXIS]}")
+        self.mesh = mesh
+        self._hier_fn = None
+
+    def _build_hier_fn(self):
+        local_train = self.trainer.make_local_train()
+
+        def per_shard(global_params, x, y, mask, w, rngs):
+            # per-shard block: one group → squeeze the sharded axis
+            x, y, mask, w, rngs = (a[0] for a in (x, y, mask, w, rngs))
+
+            def inner(group_params, inp):
+                xb, yb, mb, rb = inp   # (M, S, B, ...) one inner round
+                ctx = ServerCtx(global_params=group_params)
+
+                def per_client(xx, yy, mm, rr):
+                    return local_train(group_params, xx, yy, mm, rr, ctx,
+                                       None)
+
+                outs = jax.vmap(per_client)(xb, yb, mb, rb)
+                # group-local merge: NO cross-chip traffic.  Safe weights:
+                # an EMPTY group (every w zero — the sp engine's `live`
+                # filter case) must yield zeros, not 0/0 NaNs that would
+                # survive the psum as NaN * 0
+                wn = w / jnp.maximum(jnp.sum(w), 1e-12)
+                avg = jax.tree_util.tree_map(
+                    lambda l: jnp.tensordot(
+                        wn, l.astype(jnp.float32),
+                        axes=([0], [0])).astype(l.dtype),
+                    outs.params)
+                return avg, jnp.sum(outs.loss * w)
+
+            group_final, loss_ws = jax.lax.scan(inner, global_params,
+                                                (x, y, mask, rngs))
+            # the ONLY cross-shard collectives: one weighted psum pair
+            w_group = jnp.sum(w)
+            total = jnp.maximum(jax.lax.psum(w_group, GROUP_AXIS), 1e-12)
+            merged = jax.tree_util.tree_map(
+                lambda l: jax.lax.psum(l * w_group, GROUP_AXIS) / total,
+                group_final)
+            loss = jax.lax.psum(loss_ws[-1], GROUP_AXIS) / total
+            return merged, loss
+
+        shard = P(GROUP_AXIS)
+        return jax.jit(jax.shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=(P(), shard, shard, shard, shard, shard),
+            out_specs=(P(), P()),
+            check_vma=False,
+        ))
+
+    def train_one_round(self, round_idx: int):
+        clients = self._client_sampling(round_idx)
+        groups = self._group_of(clients)
+        R, G = self.group_comm_round, self.group_num
+        members = [clients[groups == g] for g in range(G)]
+        M = next_pow2(max(1, max(len(m) for m in members)))
+
+        # assemble (G, R, M, S, ...) cohort tensors with the sp engine's
+        # exact per-(inner, group) batches and keys
+        per = {}
+        steps_max = 1
+        for g in range(G):
+            for inner in range(R):
+                inner_round = round_idx * R + inner
+                if len(members[g]) == 0:
+                    continue
+                x, y, mask, w = self.dataset.cohort_batches(
+                    members[g], self.batch_size, self.seed, inner_round,
+                    self.epochs)
+                key = rng_util.round_key(
+                    rng_util.root_key(self.seed), inner_round * 131 + g)
+                rngs = np.asarray(jax.random.split(key, len(members[g])))
+                per[(g, inner)] = (x, y, mask, w, rngs)
+                steps_max = max(steps_max, x.shape[1])
+        S = next_pow2(steps_max)
+
+        B = self.batch_size
+        xs = np.zeros((G, R, M, S, B) + self.dataset.train_x.shape[1:],
+                      self.dataset.train_x.dtype)
+        ys = np.zeros((G, R, M, S, B) + self.dataset.train_y.shape[1:],
+                      self.dataset.train_y.dtype)
+        masks = np.zeros((G, R, M, S), np.float32)
+        ws = np.zeros((G, M), np.float32)
+        rngs = np.zeros((G, R, M, 2), np.uint32)
+        for (g, inner), (x, y, mask, w, r) in per.items():
+            n, s = x.shape[0], x.shape[1]
+            xs[g, inner, :n, :s] = x
+            ys[g, inner, :n, :s] = y
+            masks[g, inner, :n, :s] = mask
+            ws[g, :n] = w
+            rngs[g, inner, :n] = r.astype(np.uint32)
+
+        if self._hier_fn is None:
+            self._hier_fn = self._build_hier_fn()
+
+        def place(a):
+            return jax.device_put(jnp.asarray(a), NamedSharding(
+                self.mesh, P(GROUP_AXIS, *([None] * (a.ndim - 1)))))
+
+        merged, loss = self._hier_fn(
+            self.state.global_params, place(xs), place(ys), place(masks),
+            place(ws), place(rngs))
+        self.state = self.state.replace(global_params=merged,
+                                        round_idx=self.state.round_idx + 1)
+        return {"train_loss": loss}
+
+
+__all__ = ["MeshHierarchicalAPI"]

@@ -116,3 +116,46 @@ def test_mesh_decentralized_ring_matches_sp_einsum():
     args.update(topology_neighbors=4)
     with pytest.raises(ValueError):
         MeshDecentralizedAPI(args, None, ds, model)
+
+
+def test_mesh_hierarchical_matches_sp():
+    """Two-level hierarchical FedAvg as ONE shard_map program (groups
+    sharded, inner rounds group-local, one psum pair for the global merge)
+    must reproduce the sp engine's Python group loop."""
+    import pytest
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.hierarchical_fl import HierarchicalFedAvgAPI
+    from fedml_tpu.simulation.mesh.hierarchical_mesh import (
+        MeshHierarchicalAPI)
+
+    def make(cls, **kw):
+        args = load_arguments()
+        args.update(dataset="synthetic", num_classes=4, input_shape=(10,),
+                    train_size=640, test_size=96, model="lr",
+                    client_num_in_total=16, client_num_per_round=12,
+                    comm_round=3, epochs=1, batch_size=8, learning_rate=0.2,
+                    group_num=4, group_comm_round=2,
+                    partition_method="hetero", partition_alpha=0.4,
+                    frequency_of_the_test=100, random_seed=7,
+                    device_data=False)
+        args.update(**kw)
+        ds, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        return cls(args, None, ds, model)
+
+    for over in ({}, {"client_num_per_round": 5}):  # 5-of-16 can empty a group
+        sp = make(HierarchicalFedAvgAPI, **over)
+        mesh_api = make(MeshHierarchicalAPI, **over)
+        for r in range(3):
+            sp.train_one_round(r)
+            mesh_api.train_one_round(r)
+        sp_loss, sp_acc = sp.evaluate()
+        m_loss, m_acc = mesh_api.evaluate()
+        assert np.isfinite(m_loss), over
+        assert abs(sp_loss - m_loss) < 1e-4, (over, sp_loss, m_loss)
+        assert abs(sp_acc - m_acc) < 1e-6, (over, sp_acc, m_acc)
+
+    # optimizers with per-group server state are rejected loudly
+    with pytest.raises(ValueError):
+        make(MeshHierarchicalAPI, federated_optimizer="FedOpt")

@@ -1,0 +1,149 @@
+"""MQTT inference fallback protocol (reference
+``model_scheduler/device_mqtt_inference_protocol.py``): when a worker's
+HTTP port is unreachable (NAT, firewalled edge device), inference requests
+ride the broker instead — the same control plane the federation already
+holds open.
+
+Topics::
+
+    fedml_infer/{endpoint}/request/{req_id}    caller → worker (JSON body)
+    fedml_infer/{endpoint}/response/{req_id}   worker → caller (JSON reply)
+
+The worker side (:class:`MqttInferenceServer`) subscribes the request
+wildcard, runs the local predictor, and publishes the reply (or a
+structured error).  The caller side (:class:`MqttInferenceClient`)
+publishes a uuid-tagged request and waits on its response topic.
+
+``client_factory`` injects the MQTT client implementation — paho when
+installed, the in-memory broker (``tests/fake_paho.py``) in-image, same
+substitution the comm-backend tests use.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+REQUEST_TOPIC = "fedml_infer/{endpoint}/request/{req_id}"
+RESPONSE_TOPIC = "fedml_infer/{endpoint}/response/{req_id}"
+
+
+def _default_client_factory(client_id: str):
+    try:
+        import paho.mqtt.client as mqtt
+    except ImportError as e:
+        raise ImportError(
+            "MQTT inference needs paho-mqtt (not installed in this image); "
+            "pass client_factory= (tests use tests.fake_paho.Client) or use "
+            "the HTTP gateway") from e
+    return mqtt.Client(client_id=client_id)
+
+
+def _connect(client, mqtt_config: Optional[dict]):
+    """Same mqtt_config surface as MqttS3CommManager: host/port plus
+    optional user/password credentials."""
+    cfg = mqtt_config or {}
+    if cfg.get("user") and hasattr(client, "username_pw_set"):
+        client.username_pw_set(cfg["user"], cfg.get("password", ""))
+    client.connect(cfg.get("host", "127.0.0.1"),
+                   int(cfg.get("port", 1883)), keepalive=60)
+
+
+class MqttInferenceServer:
+    """Worker-side responder: predictor served over the broker."""
+
+    def __init__(self, endpoint: str, predictor,
+                 mqtt_config: Optional[dict] = None,
+                 client_factory: Callable = None):
+        self.endpoint = str(endpoint)
+        self.predictor = predictor
+        factory = client_factory or _default_client_factory
+        self._client = factory(f"infer_srv_{endpoint}_{uuid.uuid4().hex[:6]}")
+        self._client.on_message = self._on_message
+        _connect(self._client, mqtt_config)
+        self._started = False
+
+    def start(self):
+        self._client.subscribe(
+            REQUEST_TOPIC.format(endpoint=self.endpoint, req_id="+"), qos=1)
+        self._client.loop_start()
+        self._started = True
+
+    def _on_message(self, client, userdata, msg):
+        req_id = msg.topic.rsplit("/", 1)[-1]
+        try:
+            request = json.loads(msg.payload)
+            reply = {"result": self.predictor.predict(request)}
+        except Exception as e:  # structured error instead of silence
+            reply = {"error": f"{type(e).__name__}: {e}"}
+        self._client.publish(
+            RESPONSE_TOPIC.format(endpoint=self.endpoint, req_id=req_id),
+            json.dumps(reply, default=str), qos=1)
+
+    def stop(self):
+        if self._started:
+            self._client.loop_stop()
+        self._client.disconnect()
+
+
+class MqttInferenceClient:
+    """Caller-side requester with per-request response topics."""
+
+    def __init__(self, endpoint: str, mqtt_config: Optional[dict] = None,
+                 client_factory: Callable = None):
+        self.endpoint = str(endpoint)
+        factory = client_factory or _default_client_factory
+        self._client = factory(f"infer_cli_{endpoint}_{uuid.uuid4().hex[:6]}")
+        self._pending: Dict[str, dict] = {}
+        self._events: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._client.on_message = self._on_message
+        _connect(self._client, mqtt_config)
+        self._client.subscribe(
+            RESPONSE_TOPIC.format(endpoint=self.endpoint, req_id="+"), qos=1)
+        self._client.loop_start()
+
+    def _on_message(self, client, userdata, msg):
+        req_id = msg.topic.rsplit("/", 1)[-1]
+        with self._lock:
+            ev = self._events.get(req_id)
+            if ev is None:
+                return  # response for a request we never made / timed out
+            self._pending[req_id] = json.loads(msg.payload)
+            ev.set()
+
+    def predict(self, request: Dict[str, Any],
+                timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Publish one request; block for its reply.  Raises TimeoutError
+        when no worker answers and RuntimeError on a worker-side error."""
+        req_id = uuid.uuid4().hex
+        ev = threading.Event()
+        with self._lock:
+            self._events[req_id] = ev
+        try:
+            self._client.publish(
+                REQUEST_TOPIC.format(endpoint=self.endpoint, req_id=req_id),
+                json.dumps(request, default=str), qos=1)
+            if not ev.wait(timeout_s):
+                raise TimeoutError(
+                    f"no MQTT inference reply for {self.endpoint!r} "
+                    f"within {timeout_s}s")
+            with self._lock:
+                reply = self._pending.pop(req_id)
+        finally:
+            with self._lock:
+                self._events.pop(req_id, None)
+                self._pending.pop(req_id, None)
+        if "error" in reply:
+            raise RuntimeError(f"worker error: {reply['error']}")
+        return reply["result"]
+
+    def stop(self):
+        self._client.loop_stop()
+        self._client.disconnect()
+
+
+__all__ = ["MqttInferenceServer", "MqttInferenceClient",
+           "REQUEST_TOPIC", "RESPONSE_TOPIC"]

@@ -164,3 +164,47 @@ def test_process_worker_deploy_e2e(tmp_path):
             assert False, f"worker {pid} survived undeploy"
         except ProcessLookupError:
             pass
+
+
+def test_mqtt_inference_protocol_roundtrip():
+    """Reference device_mqtt_inference_protocol analog: predict over the
+    broker (request/response topics), worker errors surface as structured
+    failures, unanswered requests time out."""
+    import pytest
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_mqtt_inference_protocol import (MqttInferenceClient,
+                                               MqttInferenceServer)
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+    from tests.fake_paho import Client as FakeClient
+
+    class P(FedMLPredictor):
+        def predict(self, request):
+            if request.get("boom"):
+                raise ValueError("kaboom")
+            return {"sum": sum(request.get("xs", []))}
+
+    factory = lambda cid: FakeClient(client_id=cid)
+    srv = MqttInferenceServer("mq-ep", P(), client_factory=factory)
+    srv.start()
+    cli = MqttInferenceClient("mq-ep", client_factory=factory)
+    try:
+        out = cli.predict({"xs": [1, 2, 3]}, timeout_s=10)
+        assert out == {"sum": 6}
+        # concurrent requests resolve to their own callers
+        import threading
+        results = {}
+        def ask(i):
+            results[i] = cli.predict({"xs": [i, i]}, timeout_s=10)
+        ts = [threading.Thread(target=ask, args=(i,)) for i in range(5)]
+        for t in ts: t.start()
+        for t in ts: t.join(20)
+        assert results == {i: {"sum": 2 * i} for i in range(5)}
+        # worker-side exception -> structured RuntimeError
+        with pytest.raises(RuntimeError, match="kaboom"):
+            cli.predict({"boom": True}, timeout_s=10)
+    finally:
+        srv.stop()
+    # server gone: requests time out instead of hanging
+    with pytest.raises(TimeoutError):
+        cli.predict({"xs": [1]}, timeout_s=0.3)
+    cli.stop()

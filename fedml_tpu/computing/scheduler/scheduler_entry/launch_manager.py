@@ -16,7 +16,7 @@ import os
 import signal
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ....core.distributed.communication.message import Message
 from ..master.server_agent import MSG_ARGS  # re-exported arg keys

@@ -6,7 +6,6 @@ nvidia-smi).  The TPU inventory comes from ``jax.devices()``; CPU/memory from
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 

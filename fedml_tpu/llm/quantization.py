@@ -22,7 +22,7 @@ rides msgpack serialization / the model-card store unchanged.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,6 @@ container-ships-a-converted-model deployment story.
 
 from __future__ import annotations
 
-import io
 import json
 import zipfile
 from typing import Callable, Tuple

@@ -25,7 +25,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core import rng as rng_util
-from ...core import tree as tree_util
 from ...ml.trainer.local_trainer import ServerCtx
 from ..round_engine import next_pow2
 from ..sp.hierarchical_fl import HierarchicalFedAvgAPI

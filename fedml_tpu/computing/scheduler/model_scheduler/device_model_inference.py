@@ -21,11 +21,44 @@ log = logging.getLogger(__name__)
 class InferenceGateway:
     def __init__(self, cache: Optional[FedMLModelCache] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 mqtt_fallback: Optional[dict] = None):
+        """``mqtt_fallback`` (optional): kwargs for
+        :class:`~.device_mqtt_inference_protocol.MqttInferenceClient`
+        (``mqtt_config`` / ``client_factory``).  When given, a request
+        whose HTTP forward fails is retried over the broker (reference
+        ``device_mqtt_inference_protocol.py`` failover semantics) before
+        returning 502."""
         self.cache = cache or FedMLModelCache.get_instance()
         self.host, self.port = host, port
         self.auth_token = auth_token
+        self.mqtt_fallback = mqtt_fallback
+        self._mqtt_clients: dict = {}
+        self._mqtt_lock = threading.Lock()
+        self._mqtt_stopped = False
         self._server: Optional[ThreadingHTTPServer] = None
+
+    def _mqtt_client_for(self, endpoint: str):
+        with self._mqtt_lock:
+            if self._mqtt_stopped:
+                raise RuntimeError("gateway stopped")
+            cli = self._mqtt_clients.get(endpoint)
+        if cli is not None:
+            return cli
+        # connect OUTSIDE the lock (a blocking broker connect must not
+        # serialize every endpoint's fallback path), then double-check
+        from .device_mqtt_inference_protocol import MqttInferenceClient
+        fresh = MqttInferenceClient(endpoint, **self.mqtt_fallback)
+        with self._mqtt_lock:
+            if self._mqtt_stopped:
+                cur = None
+            else:
+                cur = self._mqtt_clients.setdefault(endpoint, fresh)
+        if cur is not fresh:  # lost the race, or gateway stopped
+            fresh.stop()
+            if cur is None:
+                raise RuntimeError("gateway stopped")
+        return cur
 
     def _make_handler(self):
         gw = self
@@ -59,17 +92,46 @@ class InferenceGateway:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 t0 = time.time()
+                out = None
+                transport_err = None
                 try:
                     req = urllib.request.Request(
                         url + "/predict", data=body,
                         headers={"Content-Type": "application/json"})
                     with urllib.request.urlopen(req, timeout=30.0) as r:
                         out = json.loads(r.read())
-                    gw.cache.record_request(endpoint, time.time() - t0)
-                    self._send(200, out)
-                except Exception as e:
-                    log.exception("gateway forward failed")
+                except urllib.error.HTTPError as e:
+                    # application-level error from a REACHABLE worker:
+                    # retrying it over the broker would just repeat the
+                    # same deterministic failure
+                    log.warning("worker returned HTTP %s for %s",
+                                e.code, endpoint)
                     self._send(502, {"error": str(e)})
+                    return
+                except Exception as e:  # transport failure → fallback
+                    transport_err = e
+                if out is not None:
+                    gw.cache.record_request(endpoint, time.time() - t0)
+                    # response write OUTSIDE the fallback try: a client
+                    # disconnect must not re-run the predictor over MQTT
+                    self._send(200, out)
+                    return
+                if gw.mqtt_fallback is not None:
+                    try:
+                        t1 = time.time()
+                        result = gw._mqtt_client_for(endpoint).predict(
+                            json.loads(body or b"{}"), timeout_s=30.0)
+                        # record only the MQTT leg — including the dead
+                        # HTTP wait would feed the autoscaler a phantom
+                        # latency spike per failover
+                        gw.cache.record_request(endpoint,
+                                                time.time() - t1)
+                        self._send(200, {"result": result, "via": "mqtt"})
+                        return
+                    except Exception:
+                        log.exception("mqtt fallback failed too")
+                log.error("gateway forward failed: %s", transport_err)
+                self._send(502, {"error": str(transport_err)})
 
             def log_message(self, fmt, *args):
                 log.debug("gw: " + fmt, *args)
@@ -89,3 +151,12 @@ class InferenceGateway:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        with self._mqtt_lock:
+            self._mqtt_stopped = True
+            clients = list(self._mqtt_clients.values())
+            self._mqtt_clients.clear()
+        for cli in clients:
+            try:
+                cli.stop()
+            except Exception:
+                log.exception("mqtt fallback client stop failed")

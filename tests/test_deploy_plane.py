@@ -208,3 +208,104 @@ def test_mqtt_inference_protocol_roundtrip():
     with pytest.raises(TimeoutError):
         cli.predict({"xs": [1]}, timeout_s=0.3)
     cli.stop()
+
+
+def test_gateway_mqtt_failover():
+    """Gateway failover: a replica whose HTTP URL is dead gets its request
+    served over the broker instead of a 502 (reference
+    device_mqtt_inference_protocol failover)."""
+    import json
+    import urllib.request
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_model_cache import FedMLModelCache
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_model_inference import InferenceGateway
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_mqtt_inference_protocol import MqttInferenceServer
+    from fedml_tpu.serving.fedml_predictor import FedMLPredictor
+    from tests.fake_paho import Client as FakeClient
+
+    class P(FedMLPredictor):
+        def predict(self, request):
+            return {"negated": -request.get("x", 0)}
+
+    factory = lambda cid: FakeClient(client_id=cid)
+    mq_srv = MqttInferenceServer("dead-ep", P(), client_factory=factory)
+    mq_srv.start()
+
+    cache = FedMLModelCache()
+    # register a replica whose HTTP port is closed
+    cache.add_replica("dead-ep", "r0", "http://127.0.0.1:9")
+    gw = InferenceGateway(cache=cache,
+                          mqtt_fallback={"client_factory": factory})
+    port = gw.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/predict/dead-ep",
+            data=json.dumps({"x": 7}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert out["result"] == {"negated": -7}
+        assert out["via"] == "mqtt"
+    finally:
+        gw.stop()
+        mq_srv.stop()
+
+
+def test_gateway_no_mqtt_retry_on_application_error():
+    """A REACHABLE worker returning HTTP 500 must not be retried over the
+    broker (deterministic predictor failures would just repeat, 30s
+    slower)."""
+    import json
+    import threading
+    import urllib.request
+    import urllib.error
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_model_cache import FedMLModelCache
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_model_inference import InferenceGateway
+    from tests.fake_paho import Client as FakeClient
+
+    calls = {"mqtt": 0}
+
+    class CountingFake(FakeClient):
+        def publish(self, topic, payload=None, qos=0, retain=False):
+            if "/request/" in topic:
+                calls["mqtt"] += 1
+            super().publish(topic, payload, qos, retain)
+
+    class Failing(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(b'{"error": "predictor exploded"}')
+
+        def log_message(self, fmt, *args):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Failing)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    cache = FedMLModelCache()
+    cache.add_replica("err-ep", "r0",
+                      f"http://127.0.0.1:{srv.server_address[1]}")
+    gw = InferenceGateway(
+        cache=cache,
+        mqtt_fallback={"client_factory":
+                       lambda cid: CountingFake(client_id=cid)})
+    port = gw.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/predict/err-ep",
+            data=json.dumps({"x": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 502"
+        except urllib.error.HTTPError as e:
+            assert e.code == 502
+        assert calls["mqtt"] == 0, "application error was retried over MQTT"
+    finally:
+        gw.stop()
+        srv.shutdown()

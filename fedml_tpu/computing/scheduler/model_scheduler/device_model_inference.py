@@ -139,6 +139,8 @@ class InferenceGateway:
         return Handler
 
     def start(self) -> int:
+        with self._mqtt_lock:
+            self._mqtt_stopped = False  # a restarted gateway regains fallback
         self._server = ThreadingHTTPServer((self.host, self.port),
                                            self._make_handler())
         self.port = self._server.server_address[1]

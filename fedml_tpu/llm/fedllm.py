@@ -48,6 +48,24 @@ def lora_init(key, lora_zeros):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def rank_mask_tree(lora_template, mask_vec):
+    """Per-leaf masks that zero every rank component ≥ a client's rank:
+    'A' leaves (in, R) mask the last axis, 'B' leaves (R, out) the first.
+    ``mask_vec`` is the (R,) 0/1 vector for one client."""
+    flat = jax.tree_util.tree_flatten_with_path(lora_template)[0]
+    treedef = jax.tree_util.tree_structure(lora_template)
+    masks = []
+    for path, leaf in flat:
+        names = [getattr(p, "key", "") for p in path]
+        if "A" in names:
+            masks.append(mask_vec[None, :].astype(leaf.dtype))
+        elif "B" in names:
+            masks.append(mask_vec[:, None].astype(leaf.dtype))
+        else:
+            masks.append(jnp.ones((1,) * leaf.ndim, leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
 class FedLLMAPI:
     """FedAvg over LoRA adapters of a causal LM."""
 
@@ -71,6 +89,22 @@ class FedLLMAPI:
         self.cfg = cfg
         self.model = LlamaLM(cfg)
         self.tx = optax.adamw(lr, weight_decay=0.0)
+
+        # heterogeneous adapter capacity (HetLoRA-style): device classes
+        # train different ranks of the same global adapters
+        ranks = getattr(args, "lora_rank_per_client", None)
+        self.client_ranks = None
+        if ranks is not None:
+            ranks = np.asarray(ranks, np.int32)
+            if len(ranks) != dataset.num_clients:
+                raise ValueError(
+                    f"lora_rank_per_client has {len(ranks)} entries for "
+                    f"{dataset.num_clients} clients")
+            if ranks.min() < 1 or ranks.max() > cfg.lora_rank:
+                raise ValueError(
+                    f"per-client ranks must be in [1, {cfg.lora_rank}], "
+                    f"got [{ranks.min()}, {ranks.max()}]")
+            self.client_ranks = ranks
 
         key = rng_util.root_key(self.seed)
         seq = dataset.train_x.shape[1]
@@ -110,7 +144,12 @@ class FedLLMAPI:
             logits = model.apply({"params": base, "lora": lora}, x)
             return causal_nll(logits, y)
 
-        def local_train(lora0, base, xb, yb, mask):
+        def local_train(lora0, base, xb, yb, mask, rank_vec):
+            # heterogeneous ranks (HetLoRA-style): a rank-r client receives
+            # and trains only the first r rank components; the rest stay
+            # exactly zero through init AND gradient masking
+            mtree = rank_mask_tree(lora0, rank_vec)
+            lora0 = jax.tree_util.tree_map(jnp.multiply, lora0, mtree)
             opt0 = tx.init(lora0)
 
             def step(carry, inp):
@@ -118,6 +157,7 @@ class FedLLMAPI:
                 (x, y), m = inp
                 loss, grads = jax.value_and_grad(loss_fn)(lora, base, x, y)
                 grads = tree_util.tree_scale(grads, m)
+                grads = jax.tree_util.tree_map(jnp.multiply, grads, mtree)
                 updates, opt_new = tx.update(grads, opt, lora)
                 lora_new = optax.apply_updates(lora, updates)
                 keep = m > 0
@@ -131,24 +171,52 @@ class FedLLMAPI:
             n = jnp.maximum(jnp.sum(mask), 1.0)
             return lora, jnp.sum(losses) / n
 
-        def round_fn(base, global_lora, x, y, mask, weights):
+        def round_fn(base, global_lora, x, y, mask, weights, rank_masks):
             # every client starts from the global adapters; base broadcast
             loras0 = jax.tree_util.tree_map(
                 lambda l: jnp.broadcast_to(l, (x.shape[0],) + l.shape),
                 global_lora)
             loras, losses = jax.vmap(
-                lambda l0, xb, yb, mb: local_train(l0, base, xb, yb, mb)
-            )(loras0, x, y, mask)
-            merged = tree_util.stacked_weighted_average(loras, weights)
+                lambda l0, xb, yb, mb, rv: local_train(l0, base, xb, yb,
+                                                       mb, rv)
+            )(loras0, x, y, mask, rank_masks)
+            # component-wise merge: each rank component averages only over
+            # the clients that HOLD it (homogeneous masks reduce exactly to
+            # the plain weighted average)
+            stacked_masks = jax.vmap(
+                lambda rv: rank_mask_tree(global_lora, rv))(rank_masks)
+
+            def merge_leaf(stacked, m, g):
+                wm = weights.reshape((-1,) + (1,) * (stacked.ndim - 1)) \
+                    * jnp.broadcast_to(m, stacked.shape)
+                tot = jnp.sum(wm, axis=0)
+                avg = jnp.sum(stacked * wm, axis=0) / jnp.maximum(tot, 1e-12)
+                # a component held by NOBODY in this cohort keeps its global
+                # value — zeroing it would be irreversible (zero A column +
+                # zero B row is a dead saddle: gradients identically zero)
+                return jnp.where(tot > 0, avg, g)
+
+            merged = jax.tree_util.tree_map(merge_leaf, loras, stacked_masks,
+                                            global_lora)
             round_loss = jnp.sum(losses * weights) / jnp.sum(weights)
             return merged, round_loss
 
         return round_fn
 
+    def _cohort_rank_masks(self, clients) -> np.ndarray:
+        """(C, R) 0/1 masks: which rank components each sampled client
+        holds (all ones when ranks are homogeneous)."""
+        R = self.cfg.lora_rank
+        if self.client_ranks is None:
+            return np.ones((len(clients), R), np.float32)
+        ranks = self.client_ranks[np.asarray(clients)]
+        return (np.arange(R)[None, :] < ranks[:, None]).astype(np.float32)
+
     def train_one_round(self, round_idx: int):
         clients = rng_util.sample_clients(self.seed, round_idx,
                                           self.dataset.num_clients,
                                           self.clients_per_round)
+        rank_masks = self._cohort_rank_masks(clients)
         x, y, mask, w = self.dataset.cohort_batches(
             clients, self.batch_size, self.seed, round_idx, self.epochs,
             max_steps=self.max_steps)
@@ -162,14 +230,17 @@ class FedLLMAPI:
                 padc = lambda a: np.pad(
                     a, [(0, pad_c)] + [(0, 0)] * (a.ndim - 1))
                 x, y, mask, w = padc(x), padc(y), padc(mask), padc(w)
+                rank_masks = padc(rank_masks)
             put = lambda a: jax.device_put(jnp.asarray(a),
                                            self._client_sharding)
             x, y, mask, w = put(x), put(y), put(mask), put(w)
+            rank_masks = put(rank_masks)
         else:
             x, y = jnp.asarray(x), jnp.asarray(y)
             mask, w = jnp.asarray(mask), jnp.asarray(w)
+            rank_masks = jnp.asarray(rank_masks)
         self.global_lora, loss = self._round_fn(
-            self.base_params, self.global_lora, x, y, mask, w)
+            self.base_params, self.global_lora, x, y, mask, w, rank_masks)
         return {"train_loss": float(loss)}
 
     def evaluate(self):

@@ -386,3 +386,68 @@ def test_max_steps_budget_enforced(tmp_path):
     assert trainer.global_step == 14
     assert len(out["history"]) < 5  # stopped early
     trainer.close()
+
+
+def test_hetlora_rank_heterogeneity():
+    """Per-client LoRA ranks (HetLoRA-style): homogeneous masks reproduce
+    the plain path exactly; truncated clients never touch rank components
+    they don't hold; components nobody holds collapse to zero."""
+    import fedml_tpu
+    from fedml_tpu import data as data_mod
+    from fedml_tpu.llm.fedllm import FedLLMAPI
+
+    def api_with(ranks):
+        args = _llm_args(lora_rank=4, comm_round=2)
+        if ranks is not None:
+            args.update(lora_rank_per_client=ranks)
+        ds = _small_llm_dataset(args)
+        return FedLLMAPI(args, ds)
+
+    # (a) homogeneous full-rank list ≡ no list at all
+    a = api_with(None)
+    b = api_with([4] * 6)
+    for r in range(2):
+        a.train_one_round(r)
+        b.train_one_round(r)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.global_lora),
+                      jax.tree_util.tree_leaves(b.global_lora)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   atol=1e-6)
+
+    # (b) all clients rank 2 of 4: nobody holds components 2..3, so those
+    # keep their INITIAL global values (zeroing them would be an
+    # irreversible dead saddle) while components 0..1 train
+    c = api_with([2] * 6)
+    init = jax.tree_util.tree_map(lambda l: np.asarray(l).copy(),
+                                  c.global_lora)
+    c.train_one_round(0)
+    nll0 = c.evaluate()
+    c.train_one_round(1)
+    flat = jax.tree_util.tree_flatten_with_path(c.global_lora)[0]
+    init_flat = jax.tree_util.tree_flatten_with_path(init)[0]
+    saw_a = False
+    for (path, leaf), (_, leaf0) in zip(flat, init_flat):
+        names = [getattr(p, "key", "") for p in path]
+        arr, arr0 = np.asarray(leaf), np.asarray(leaf0)
+        if "A" in names:
+            saw_a = True
+            np.testing.assert_array_equal(arr[:, 2:], arr0[:, 2:])
+            assert np.any(arr[:, :2] != arr0[:, :2])  # held ranks trained
+        elif "B" in names:
+            np.testing.assert_array_equal(arr[2:, :], arr0[2:, :])
+    assert saw_a
+    assert c.evaluate() < nll0  # rank-2 federation still learns
+
+    # (c) mixed ranks run and learn
+    d = api_with([2, 2, 2, 4, 4, 4])
+    n0 = d.evaluate()
+    for r in range(2):
+        d.train_one_round(r)
+    assert d.evaluate() < n0
+
+    # validation
+    import pytest
+    with pytest.raises(ValueError):
+        api_with([5] * 6)       # above the global rank
+    with pytest.raises(ValueError):
+        api_with([4, 4])        # wrong length

@@ -72,13 +72,21 @@ def speculative_generate(model, params, draft_model, draft_params,
                          prompt_ids: List[int], max_new_tokens: int = 64,
                          buf_len: int = 256, k: int = 4,
                          eos_id: Optional[int] = None,
-                         on_token=None
+                         on_token=None, adaptive_k: bool = True
                          ) -> Tuple[List[int], Dict[str, float]]:
     """Greedy decode of ``max_new_tokens`` with draft-model speculation.
 
     Returns ``(tokens, stats)``; ``stats['target_forwards']`` counts the
     expensive model's invocations and ``stats['acceptance_rate']`` the
     fraction of draft proposals the target agreed with.
+
+    ``adaptive_k`` (default on, the HF assisted-generation heuristic):
+    the verify-block size starts at 2 (= 1 draft proposal + the current
+    token), doubles toward ``k`` (= up to ``k - 1`` proposals) after a
+    fully-accepted round, and halves after a rejection — a misaligned
+    draft stops burning draft forwards while an aligned one still reaches
+    the full depth.  Output is unaffected (verified: any depth schedule
+    yields the target-greedy stream).
     """
     raw = params.get("params", params) if isinstance(params, dict) else params
     draw = draft_params.get("params", draft_params) \
@@ -117,9 +125,10 @@ def speculative_generate(model, params, draft_model, draft_params,
     if not emit(cur):
         return out, _finalize(stats)
 
+    cur_k = min(2, k) if adaptive_k else k
     while True:
         pos = pos_holder[0]
-        block_k = min(k, buf_len - pos)
+        block_k = min(cur_k, buf_len - pos)
         if block_k < 1:
             break
         # draft catch-up + first proposal: ONE block writes every canonical
@@ -155,10 +164,12 @@ def speculative_generate(model, params, draft_model, draft_params,
         greedy_host = np.asarray(greedy)
 
         done = False
+        rejected = False
         for i, d in enumerate(d_tokens):
             g = int(greedy_host[i])
             if d != g:
                 # first disagreement: the target's own token replaces it
+                rejected = True
                 pos_holder[0] = pos + i + 1
                 cur = g
                 done = not emit(g)
@@ -178,6 +189,8 @@ def speculative_generate(model, params, draft_model, draft_params,
             done = not emit(g)
         if done:
             break
+        if adaptive_k:
+            cur_k = max(2, cur_k // 2) if rejected else min(k, cur_k * 2)
     return out, _finalize(stats)
 
 

@@ -55,7 +55,7 @@ def test_aligned_draft_cuts_target_forwards():
     n_new, k = 24, 4
     got, stats = speculative_generate(
         target, tparams, target, tparams, [5, 17, 42],
-        max_new_tokens=n_new, buf_len=64, k=k)
+        max_new_tokens=n_new, buf_len=64, k=k, adaptive_k=False)
     want = generate(None, tparams, [5, 17, 42], max_new_tokens=n_new,
                     buf_len=64, model=target)
     assert got == want
@@ -93,3 +93,34 @@ def test_openai_server_speculative_matches_plain():
     finally:
         srv_s.stop()
         srv_p.stop()
+
+
+def test_adaptive_k_preserves_output_and_cuts_draft_work():
+    """Adaptive speculation depth never changes the emitted stream (any
+    depth schedule yields target greedy), shrinks draft work under a
+    misaligned draft, and still reaches full depth with an aligned one."""
+    target, tparams = _model(0)
+    draft, dparams = _model(1, dim=32, layers=1)
+    prompt, n_new, k = [5, 17, 42], 30, 8
+
+    want = generate(None, tparams, prompt, max_new_tokens=n_new,
+                    buf_len=64, model=target)
+
+    got_fixed, s_fixed = speculative_generate(
+        target, tparams, draft, dparams, prompt, max_new_tokens=n_new,
+        buf_len=64, k=k, adaptive_k=False)
+    got_adapt, s_adapt = speculative_generate(
+        target, tparams, draft, dparams, prompt, max_new_tokens=n_new,
+        buf_len=64, k=k, adaptive_k=True)
+    assert got_fixed == want and got_adapt == want
+    # misaligned draft: adaptive proposes far less per emitted token
+    assert s_adapt["draft_forwards"] < s_fixed["draft_forwards"], (
+        s_adapt, s_fixed)
+
+    # aligned draft: adaptive ramps to full depth and keeps the k-fold cut
+    got_a, s_a = speculative_generate(
+        target, tparams, target, tparams, prompt, max_new_tokens=n_new,
+        buf_len=64, k=4, adaptive_k=True)
+    assert got_a == want
+    assert s_a["acceptance_rate"] == 1.0
+    assert s_a["target_forwards"] <= 2 + (n_new - 1 + 1) // 2 + 1, s_a

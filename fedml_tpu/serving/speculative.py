@@ -125,10 +125,13 @@ def speculative_generate(model, params, draft_model, draft_params,
     if not emit(cur):
         return out, _finalize(stats)
 
-    cur_k = min(2, k) if adaptive_k else k
+    # adaptive depth stays a power of two (capped by k), so the verify
+    # block only ever takes ~log2(k) distinct shapes — each novel shape is
+    # a fresh XLA compile mid-request, which the schedule must not amplify
+    depth = min(2, k) if adaptive_k else k
     while True:
         pos = pos_holder[0]
-        block_k = min(cur_k, buf_len - pos)
+        block_k = min(depth, k, buf_len - pos)
         if block_k < 1:
             break
         # draft catch-up + first proposal: ONE block writes every canonical
@@ -190,7 +193,8 @@ def speculative_generate(model, params, draft_model, draft_params,
         if done:
             break
         if adaptive_k:
-            cur_k = max(2, cur_k // 2) if rejected else min(k, cur_k * 2)
+            depth = max(2, depth // 2) if rejected else \
+                (depth * 2 if depth < k else depth)
     return out, _finalize(stats)
 
 

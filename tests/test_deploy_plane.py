@@ -309,3 +309,42 @@ def test_gateway_no_mqtt_retry_on_application_error():
     finally:
         gw.stop()
         srv.shutdown()
+
+
+def test_gateway_auth_token():
+    """Bearer-token auth (reference gateway checks a Redis-backed token):
+    wrong/missing tokens get 401 before any replica is touched."""
+    import json
+    import urllib.request
+    import urllib.error
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_model_cache import FedMLModelCache
+    from fedml_tpu.computing.scheduler.model_scheduler. \
+        device_model_inference import InferenceGateway
+
+    cache = FedMLModelCache()
+    cache.add_replica("auth-ep", "r0", "http://127.0.0.1:9")  # never reached
+    gw = InferenceGateway(cache=cache, auth_token="s3cret")
+    port = gw.start()
+    try:
+        def ask(headers):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/predict/auth-ep",
+                data=json.dumps({}).encode(),
+                headers={"Content-Type": "application/json", **headers})
+            return urllib.request.urlopen(req, timeout=10)
+
+        for hdrs in ({}, {"Authorization": "Bearer wrong"}):
+            try:
+                ask(hdrs)
+                assert False, "expected 401"
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+        # correct token reaches the (dead) replica → 502, not 401
+        try:
+            ask({"Authorization": "Bearer s3cret"})
+            assert False, "expected 502"
+        except urllib.error.HTTPError as e:
+            assert e.code == 502
+    finally:
+        gw.stop()

@@ -159,3 +159,12 @@ def test_mesh_hierarchical_matches_sp():
     # optimizers with per-group server state are rejected loudly
     with pytest.raises(ValueError):
         make(MeshHierarchicalAPI, federated_optimizer="FedOpt")
+
+
+def test_mesh_engine_per_client_eval():
+    """evaluate_per_client (inherited from the sp API) works on the mesh
+    engine: replicated global params scored per client shard."""
+    api = _run("mesh")
+    rep = api.evaluate_per_client()
+    assert rep["per_client_acc"].shape[0] == 16
+    assert 0.0 <= rep["acc_min"] <= rep["acc_mean"] <= 1.0

@@ -163,6 +163,42 @@ class FederatedDataset:
         yb = yp.reshape((steps, batch_size) + self.test_y.shape[1:])
         return xb, yb, m.reshape(steps, batch_size)
 
+    def pack_per_client(self, batch_size: int, split: str = "train"):
+        """Pad every client's local split to one common (C, steps, B, ...)
+        batch stack with validity masks — the shape per-client evaluation
+        programs scan (used by ``FedAvgAPI.evaluate_per_client`` and
+        ``FedLLMAPI.evaluate_per_client``).
+
+        Clients with no data in the split are EXCLUDED (LEAF gives
+        train-only users empty test lists); raises when nobody has data.
+        Returns ``(clients, X, Y, M)`` with X/Y shaped
+        ``(C, steps, batch_size, ...)`` and M ``(C, steps, batch_size)``.
+        """
+        if split == "test" and self.test_client_idxs:
+            idxs, data_x, data_y = (self.test_client_idxs, self.test_x,
+                                    self.test_y)
+        else:
+            idxs, data_x, data_y = (self.client_idxs, self.train_x,
+                                    self.train_y)
+        clients = sorted(c for c in idxs if len(idxs[c]) > 0)
+        if not clients:
+            raise ValueError(f"no client has data in the {split!r} split")
+        counts = [len(idxs[c]) for c in clients]
+        steps = max(1, -(-max(counts) // batch_size))
+        slot = steps * batch_size
+        C = len(clients)
+        X = np.zeros((C, slot) + data_x.shape[1:], data_x.dtype)
+        Y = np.zeros((C, slot) + data_y.shape[1:], data_y.dtype)
+        M = np.zeros((C, slot), np.float32)
+        for i, c in enumerate(clients):
+            rows = idxs[c]
+            X[i, : len(rows)] = data_x[rows]
+            Y[i, : len(rows)] = data_y[rows]
+            M[i, : len(rows)] = 1.0
+        shape = (C, steps, batch_size)
+        return (np.asarray(clients), X.reshape(shape + data_x.shape[1:]),
+                Y.reshape(shape + data_y.shape[1:]), M.reshape(shape))
+
     # -- legacy parity -----------------------------------------------------
     def as_reference_tuple(self, batch_size: int):
         """Reproduce the reference 8-tuple (data_loader.py:234 return shape),

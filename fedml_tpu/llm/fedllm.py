@@ -260,6 +260,56 @@ class FedLLMAPI:
                             jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(mb)))
         return nll
 
+    def _per_client_eval_fn(self):
+        """Compiled all-clients NLL program, built once per API instance
+        (a per-call ``@jax.jit`` closure would re-trace every call — the
+        jit cache is keyed on the function object)."""
+        if getattr(self, "_pc_eval", None) is not None:
+            return self._pc_eval
+
+        @jax.jit
+        def run(base, lora, X, Y, M):
+            def per_client(_, inp):
+                xb, yb, mb = inp
+
+                def body(carry, b):
+                    x, y, m = b
+                    logits = self.model.apply(
+                        {"params": base, "lora": lora}, x)
+                    ll = per_sequence_loglik(logits, y)
+                    return (carry[0] - jnp.sum(ll * m),
+                            carry[1] + jnp.sum(m)), None
+
+                (nll, n), _ = jax.lax.scan(body, (0.0, 0.0), (xb, yb, mb))
+                return None, nll / jnp.maximum(n, 1.0)
+
+            _, nlls = jax.lax.scan(per_client, None, (X, Y, M))
+            return nlls
+
+        self._pc_eval = run
+        return run
+
+    def evaluate_per_client(self, batch_size: Optional[int] = None):
+        """Global adapters scored on every client's LOCAL sequences (the
+        LLM flavor of ``FedAvgAPI.evaluate_per_client`` /
+        ``_local_test_on_all_clients``): per-client mean NLL plus the
+        fairness aggregates — the signal heterogeneous-rank federations
+        need to show no device class is left behind."""
+        bs = int(batch_size or self.batch_size)
+        clients, X, Y, M = self.dataset.pack_per_client(bs)
+        run = self._per_client_eval_fn()
+        nlls = np.asarray(run(self.base_params, self.global_lora,
+                              jnp.asarray(X), jnp.asarray(Y),
+                              jnp.asarray(M)))
+        return {
+            "clients": clients,
+            "per_client_nll": nlls,
+            "nll_mean": float(nlls.mean()),
+            "nll_std": float(nlls.std()),
+            "nll_max": float(nlls.max()),       # worst-served client
+            "nll_p90": float(np.percentile(nlls, 90)),
+        }
+
     def train(self):
         for r in range(self.comm_rounds):
             t0 = time.time()

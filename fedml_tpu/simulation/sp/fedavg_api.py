@@ -263,35 +263,10 @@ class FedAvgAPI:
 
         ``split="test"`` uses the natural per-client test partition when the
         dataset has one (LEAF), else falls back to the train split."""
-        idxs = self.dataset.client_idxs
-        if split == "test" and self.dataset.test_client_idxs:
-            idxs = self.dataset.test_client_idxs
-            data_x, data_y = self.dataset.test_x, self.dataset.test_y
-        else:
-            data_x, data_y = self.dataset.train_x, self.dataset.train_y
-        # clients with no data in this split (LEAF gives train-only users
-        # empty test lists) are excluded, not scored as phantom zeros
-        clients = sorted(c for c in idxs if len(idxs[c]) > 0)
-        if not clients:
-            raise ValueError(f"no client has data in the {split!r} split")
-        counts = [len(idxs[c]) for c in clients]
-        steps = max(1, -(-max(counts) // batch_size))
-        slot = steps * batch_size
-        C = len(clients)
-        X = np.zeros((C, slot) + data_x.shape[1:], data_x.dtype)
-        Y = np.zeros((C, slot) + data_y.shape[1:], data_y.dtype)
-        M = np.zeros((C, slot), np.float32)
-        for i, c in enumerate(clients):
-            rows = idxs[c]
-            X[i, : len(rows)] = data_x[rows]
-            Y[i, : len(rows)] = data_y[rows]
-            M[i, : len(rows)] = 1.0
-        shape = (C, steps, batch_size)
+        clients, X, Y, M = self.dataset.pack_per_client(batch_size, split)
         run = self._per_client_eval_fn()
-        losses, accs = run(self.state.global_params,
-                           jnp.asarray(X.reshape(shape + X.shape[2:])),
-                           jnp.asarray(Y.reshape(shape + Y.shape[2:])),
-                           jnp.asarray(M.reshape(shape)))
+        losses, accs = run(self.state.global_params, jnp.asarray(X),
+                           jnp.asarray(Y), jnp.asarray(M))
         accs = np.asarray(accs)
         return {
             "per_client_acc": accs,

@@ -451,3 +451,25 @@ def test_hetlora_rank_heterogeneity():
         api_with([5] * 6)       # above the global rank
     with pytest.raises(ValueError):
         api_with([4, 4])        # wrong length
+
+
+def test_fedllm_per_client_eval_fairness():
+    """Per-client NLL fairness view for the LLM federation: training must
+    improve the mean AND the worst-served client; aggregates agree with
+    the raw vector (the device-class signal HetLoRA deployments read)."""
+    from fedml_tpu.llm.fedllm import FedLLMAPI
+
+    args = _llm_args(comm_round=3, lora_rank=4,
+                     lora_rank_per_client=[2, 2, 2, 4, 4, 4])
+    ds = _small_llm_dataset(args)
+    api = FedLLMAPI(args, ds)
+    rep0 = api.evaluate_per_client()
+    assert rep0["per_client_nll"].shape == (6,)
+    for r in range(3):
+        api.train_one_round(r)
+    rep1 = api.evaluate_per_client()
+    assert rep1["nll_mean"] < rep0["nll_mean"]
+    assert rep1["nll_max"] < rep0["nll_max"]  # worst client improves too
+    np.testing.assert_allclose(rep1["nll_mean"],
+                               rep1["per_client_nll"].mean(), rtol=1e-6)
+    assert rep1["nll_mean"] <= rep1["nll_p90"] <= rep1["nll_max"] + 1e-9

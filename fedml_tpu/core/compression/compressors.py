@@ -177,7 +177,7 @@ class QuantizationCompressor:
         levels = (1 << self.bits) - 1
         store = np.uint8 if self.bits <= 8 else np.uint16
 
-        def enc(leaf):
+        def enc_dev(leaf):
             x = jnp.asarray(leaf, jnp.float32)
             lo = jnp.min(x)
             scale = jnp.maximum(jnp.max(x) - lo, 1e-12) / levels
@@ -188,15 +188,22 @@ class QuantizationCompressor:
                 with self._key_lock:  # co-resident client threads
                     self._key, sub = jax.random.split(self._key)
                 q = jnp.floor(q + jax.random.uniform(sub, q.shape))
-            return {
-                _CLEAF: 1,
-                "q": np.asarray(jnp.clip(q, 0, levels), store),
-                "lo": float(lo),
-                "scale": float(scale),
-                "dtype": str(np.asarray(leaf).dtype),
-            }
+            return {_CLEAF: 1, "q": jnp.clip(q, 0, levels), "lo": lo,
+                    "scale": scale}
 
-        return {_KIND: self.name, "tree": _map_leaves(enc, tree)}, state
+        # every leaf's q/lo/scale lands in ONE batched host transfer
+        # (device_get async-copies all leaves before blocking) instead of a
+        # per-leaf float() sync that would serialize device round-trips
+        host = jax.device_get(_map_leaves(enc_dev, tree))
+
+        def finish(d, leaf):
+            return {_CLEAF: 1, "q": np.asarray(d["q"], store),
+                    "lo": float(d["lo"]), "scale": float(d["scale"]),
+                    "dtype": (str(leaf.dtype) if hasattr(leaf, "dtype")
+                              else str(np.asarray(leaf).dtype))}
+
+        out = jax.tree_util.tree_map(finish, host, tree, is_leaf=_is_cleaf)
+        return {_KIND: self.name, "tree": out}, state
 
     def decompress(self, payload):
         def dec(d):
@@ -224,22 +231,26 @@ class QSGDCompressor:
     def compress(self, tree, state=None):
         s = (1 << self.bits) - 1
 
-        def enc(leaf):
+        def enc_dev(leaf):
             x = jnp.asarray(leaf, jnp.float32)
             norm = jnp.maximum(jnp.linalg.norm(x.reshape(-1)), 1e-12)
             level = jnp.abs(x) / norm * s
             with self._key_lock:  # co-resident client threads
                 self._key, sub = jax.random.split(self._key)
             level = jnp.floor(level + jax.random.uniform(sub, x.shape))
-            return {
-                _CLEAF: 1,
-                "q": np.asarray(jnp.sign(x) * level, np.int8),
-                "norm": float(norm),
-                "dtype": str(np.asarray(leaf).dtype),
-            }
+            return {_CLEAF: 1, "q": jnp.sign(x) * level, "norm": norm}
 
-        payload = {_KIND: self.name, "s": float(s),
-                   "tree": _map_leaves(enc, tree)}
+        # one batched host transfer for all leaves (see QuantizationCompressor)
+        host = jax.device_get(_map_leaves(enc_dev, tree))
+
+        def finish(d, leaf):
+            return {_CLEAF: 1, "q": np.asarray(d["q"], np.int8),
+                    "norm": float(d["norm"]),
+                    "dtype": (str(leaf.dtype) if hasattr(leaf, "dtype")
+                              else str(np.asarray(leaf).dtype))}
+
+        out = jax.tree_util.tree_map(finish, host, tree, is_leaf=_is_cleaf)
+        payload = {_KIND: self.name, "s": float(s), "tree": out}
         return payload, state
 
     def decompress(self, payload):

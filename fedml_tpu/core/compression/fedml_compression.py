@@ -47,7 +47,9 @@ class FedMLCompression:
         self._ef_states = {}
         self._decoders = {}
         self._lock = threading.Lock()
-        self.last_ratio = None  # wire bytes / dense bytes, for observability
+        # wire bytes / dense bytes per client_id, for observability; keyed
+        # so co-resident client threads don't read each other's ratio
+        self._ratios = {}
 
     def init(self, args):
         # full reset so a later federation without compression in the same
@@ -56,7 +58,7 @@ class FedMLCompression:
             self.is_enabled = False
             self.compressor = None
             self._ef_states = {}
-            self.last_ratio = None
+            self._ratios = {}
         if args is None or not getattr(args, "enable_compression", False):
             return
         name = str(getattr(args, "compression_type", "topk"))
@@ -106,8 +108,25 @@ class FedMLCompression:
             payload["__delta__"] = True
         dense = tree_nbytes(tree)
         if dense:
-            self.last_ratio = payload_nbytes(payload) / dense
+            with self._lock:
+                # pop-then-set so dict insertion order tracks upload
+                # recency (last_ratio reads the most recent upload)
+                self._ratios.pop(client_id, None)
+                self._ratios[client_id] = payload_nbytes(payload) / dense
         return payload
+
+    def ratio_for(self, client_id=0):
+        """Wire/dense byte ratio of this client's most recent upload."""
+        with self._lock:
+            return self._ratios.get(client_id)
+
+    @property
+    def last_ratio(self):
+        """Most recent upload ratio across all clients (single-client
+        observability convenience; prefer :meth:`ratio_for` per client)."""
+        with self._lock:
+            vals = list(self._ratios.values())
+        return vals[-1] if vals else None
 
     def maybe_decompress(self, obj, base=None):
         """Server receive path: payloads are self-describing, so this is

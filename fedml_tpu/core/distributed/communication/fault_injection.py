@@ -62,11 +62,15 @@ class FaultInjectingCommManager(BaseCommunicationManager):
         with self._rng_lock:
             return self._rng.random(3)
 
+    def _bump(self, key: str):
+        with self._rng_lock:  # stats share the rng lock (both are send-path)
+            self.stats[key] += 1
+
     def send_message(self, msg: Message):
         p_drop, p_dup, p_delay = self._draw()
-        self.stats["sent"] += 1
+        self._bump("sent")
         if p_drop < self.drop_prob and self.droppable(msg):
-            self.stats["dropped"] += 1
+            self._bump("dropped")
             log.info("chaos: DROPPING msg type=%s %s->%s",
                      msg.get_type(), msg.get_sender_id(),
                      msg.get_receiver_id())
@@ -74,10 +78,10 @@ class FaultInjectingCommManager(BaseCommunicationManager):
         copies = 1
         if p_dup < self.dup_prob:
             copies = 2
-            self.stats["duplicated"] += 1
+            self._bump("duplicated")
         delayed = p_delay < self.delay_prob and self.max_delay_s > 0
         if delayed:
-            self.stats["delayed"] += 1  # per message, like the other stats
+            self._bump("delayed")  # per message, like the other stats
         for _ in range(copies):
             if delayed:
                 with self._rng_lock:

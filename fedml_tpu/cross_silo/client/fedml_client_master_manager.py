@@ -65,9 +65,10 @@ class ClientMasterManager(FedMLCommManager):
             # sent — sparsifying absolute weights would zero the model
             new_params = comp.compress_upload(new_params, base=params,
                                               client_id=self.rank)
-            if comp.last_ratio is not None:
+            ratio = comp.ratio_for(self.rank)
+            if ratio is not None:
                 log.info("client %d upload compressed to %.1f%% of dense",
-                         self.rank, 100.0 * comp.last_ratio)
+                         self.rank, 100.0 * ratio)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, new_params)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))

@@ -188,8 +188,12 @@ class QuantizationCompressor:
                 with self._key_lock:  # co-resident client threads
                     self._key, sub = jax.random.split(self._key)
                 q = jnp.floor(q + jax.random.uniform(sub, q.shape))
-            return {_CLEAF: 1, "q": jnp.clip(q, 0, levels), "lo": lo,
-                    "scale": scale}
+            # cast to the wire dtype ON DEVICE so the batched host
+            # transfer ships 1-2 bytes/element, not f32 width
+            return {_CLEAF: 1,
+                    "q": jnp.clip(q, 0, levels).astype(
+                        jnp.uint8 if self.bits <= 8 else jnp.uint16),
+                    "lo": lo, "scale": scale}
 
         # every leaf's q/lo/scale lands in ONE batched host transfer
         # (device_get async-copies all leaves before blocking) instead of a
@@ -238,7 +242,10 @@ class QSGDCompressor:
             with self._key_lock:  # co-resident client threads
                 self._key, sub = jax.random.split(self._key)
             level = jnp.floor(level + jax.random.uniform(sub, x.shape))
-            return {_CLEAF: 1, "q": jnp.sign(x) * level, "norm": norm}
+            # int8 on device: the batched host transfer ships wire width
+            return {_CLEAF: 1,
+                    "q": (jnp.sign(x) * level).astype(jnp.int8),
+                    "norm": norm}
 
         # one batched host transfer for all leaves (see QuantizationCompressor)
         host = jax.device_get(_map_leaves(enc_dev, tree))

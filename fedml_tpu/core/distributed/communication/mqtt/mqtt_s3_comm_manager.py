@@ -8,9 +8,10 @@ Broker/store endpoints are plain config (``mqtt_config`` / ``store_dir``) —
 NOT fetched from a vendor backend (SURVEY §7 hard-parts: decouple from the
 TensorOpera cloud).
 
-Requires ``paho-mqtt``, which this image does not ship; constructing without
-it raises with a pointer to the ``filestore`` backend, which implements the
-same control/data split over a shared filesystem.
+Client library: ``paho-mqtt`` when installed, else the vendored MQTT 3.1.1
+wire-protocol client (:mod:`.mini_mqtt`) — same API slice, real sockets —
+so this backend works against any real broker (mosquitto, EMQX, or the
+in-process :class:`.mini_broker.MiniMqttBroker`) in-image.
 """
 
 from __future__ import annotations
@@ -28,14 +29,16 @@ from ..message import Message, encode_tree, decode_tree, MSG_ARG_KEY_MODEL_PARAM
 class MqttS3CommManager(BaseCommunicationManager):
     def __init__(self, args, rank: int, size: int):
         try:
-            import paho.mqtt.client as mqtt  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "MQTT_S3 backend needs paho-mqtt (not installed in this "
-                "image). Use backend='filestore' for the same control/data "
-                "split without a broker, or install paho-mqtt."
-            ) from e
-        import paho.mqtt.client as mqtt
+            import paho.mqtt.client as mqtt
+        except ImportError:
+            from . import mini_mqtt as mqtt
+
+        def make_client(**kw):
+            # paho >= 2.0 requires a leading CallbackAPIVersion argument
+            api_ver = getattr(mqtt, "CallbackAPIVersion", None)
+            if api_ver is not None:
+                return mqtt.Client(api_ver.VERSION1, **kw)
+            return mqtt.Client(**kw)
 
         cfg = getattr(args, "mqtt_config", {}) or {}
         self.rank = int(rank)
@@ -46,8 +49,10 @@ class MqttS3CommManager(BaseCommunicationManager):
         self._observers: List[Observer] = []
         self._running = False
 
-        self._client = mqtt.Client(client_id=f"fedml_{self.run_id}_{self.rank}_{uuid.uuid4().hex[:6]}",
-                                   clean_session=False)
+        self._client = make_client(
+            client_id=f"fedml_{self.run_id}_{self.rank}_"
+                      f"{uuid.uuid4().hex[:6]}",
+            clean_session=False)
         if cfg.get("user"):
             self._client.username_pw_set(cfg["user"], cfg.get("password", ""))
         # last-will OFFLINE (reference mqtt_manager.py:68-74)

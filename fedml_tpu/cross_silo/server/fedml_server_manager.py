@@ -223,7 +223,11 @@ class FedMLServerManager(FedMLCommManager):
         round_idx = self.args.round_idx
         self.aggregator.aggregate()
         acc = self.aggregator.test_on_server_for_all_clients(round_idx)
-        log_round_info(round_idx, {"test_acc": acc})
+        log_round_info(round_idx, {
+            "test_acc": acc,
+            "dataset_provenance": getattr(
+                getattr(self.aggregator, "dataset", None), "provenance",
+                "unknown")})
         if self._ckpt is not None:
             freq = int(getattr(self.args, "checkpoint_freq", 10))
             if round_idx % freq == 0 or round_idx == self.round_num - 1:

@@ -103,6 +103,35 @@ _SEG_SPECS = {
 }
 
 
+def _cache_provenance(root: str, default: str,
+                      name: Optional[str] = None) -> str:
+    """Lineage for cache-resident files.  Generators that write
+    format-faithful but synthetic-content files (``tools/
+    make_format_datasets.py``) drop a ``PROVENANCE`` marker file next to
+    them; an absent marker means driver-provided real bytes, so ``default``
+    (a ``real:*`` tag) applies.
+
+    Shared cache roots can host several datasets, so a bare ``PROVENANCE``
+    marker only applies when its tag mentions ``name`` (a marker written
+    for generated cifar files must not mislabel a real mnist.npz dropped
+    beside them); ``PROVENANCE.<name>`` markers are always dataset-scoped.
+    """
+    candidates = [f"PROVENANCE.{name}"] if name else []
+    candidates.append("PROVENANCE")
+    for fname in candidates:
+        try:
+            with open(os.path.join(root, fname)) as f:
+                tag = f.read().strip()
+        except OSError:
+            continue
+        if not tag:
+            continue
+        if fname == "PROVENANCE" and name and name not in tag:
+            continue  # marker belongs to a different dataset in this cache
+        return tag
+    return default
+
+
 def _try_load_npz(cache_dir: str, name: str):
     path = os.path.join(cache_dir, f"{name}.npz")
     if os.path.exists(path):
@@ -286,7 +315,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
                 tx, ty, vx, vy, cidx, tidx = load_leaf(
                     leaf_root, input_shape=shape)
                 ds = FederatedDataset(tx, ty, vx, vy, cidx, classes,
-                                      test_client_idxs=tidx)
+                                      test_client_idxs=tidx,
+                                      provenance=_cache_provenance(leaf_root, "real:leaf", name))
                 return ds, classes
         real = _try_load_npz(cache, name) if cache else None
         if real is None and name in ("mnist", "synthetic_mnist") and cache:
@@ -295,6 +325,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
             real = _try_load_cifar(cache, name)
         if real is not None:
             tx, ty, vx, vy = real
+            prov = _cache_provenance(cache, "real:cache", name)
         else:
             noise = float(getattr(args, "synthetic_noise", 0.35))
             # synthetic fallback honors size overrides (full reference
@@ -302,7 +333,9 @@ def load(args) -> Tuple[FederatedDataset, int]:
             train_n, test_n = _sizes(args, train_n, test_n)
             tx, ty, vx, vy = synthetic_image_classification(
                 train_n, test_n, classes, shape, seed, noise)
-        ds = build_federated(tx, ty, vx, vy, classes, client_num, method, alpha, seed)
+            prov = "synthetic"
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed, provenance=prov)
         return ds, classes
 
     if name in _LM_SPECS:
@@ -314,7 +347,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
                 tx, ty, vx, vy, cidx, tidx = load_leaf(
                     leaf_root, seq_len=seq_len)
                 ds = FederatedDataset(tx, ty, vx, vy, cidx, vocab,
-                                      test_client_idxs=tidx)
+                                      test_client_idxs=tidx,
+                                      provenance=_cache_provenance(leaf_root, "real:leaf", name))
                 return ds, vocab
         real = _try_load_npz(cache, name) if cache else None
         if real is None and cache and "shakespeare" in name:
@@ -330,11 +364,13 @@ def load(args) -> Tuple[FederatedDataset, int]:
                     break
         if real is not None:
             tx, ty, vx, vy = real
+            prov = _cache_provenance(cache, "real:cache", name)
         else:
             train_n, test_n = _sizes(args, train_n, test_n)
             tx, ty, vx, vy = synthetic_lm_tokens(train_n, test_n, vocab, seq_len, seed)
+            prov = "synthetic"
         ds = build_federated(tx, ty, vx, vy, vocab, client_num, method="homo",
-                             alpha=alpha, seed=seed)
+                             alpha=alpha, seed=seed, provenance=prov)
         return ds, vocab
 
     if name in _TAGPRED_SPECS:
@@ -370,7 +406,9 @@ def load(args) -> Tuple[FederatedDataset, int]:
         # first (lowest-index) set tag as its partition class
         primary = np.argmax(ty, axis=1).astype(np.int64)
         client_idxs = partition(primary, client_num, method, alpha, seed)
-        ds = FederatedDataset(tx, ty, vx, vy, client_idxs, n_tags)
+        ds = FederatedDataset(tx, ty, vx, vy, client_idxs, n_tags,
+                              provenance=_cache_provenance(cache, "real:npz", name) if real is not None
+                              else "synthetic")
         if not getattr(args, "input_shape", None):
             args.input_shape = (n_feats,)  # model hub reads this for lr
         # single source of truth for the loss/eval branch: the loader knows
@@ -384,12 +422,14 @@ def load(args) -> Tuple[FederatedDataset, int]:
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:
             tx, ty, vx, vy = real
+            prov = _cache_provenance(cache, "real:npz", name)
         else:
             train_n, test_n = _sizes(args, train_n, test_n)
             tx, ty, vx, vy = synthetic_tabular(train_n, test_n, classes,
                                                n_features, seed)
+            prov = "synthetic"
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
-                             alpha, seed)
+                             alpha, seed, provenance=prov)
         return ds, classes
 
     if name in _TEXTCLS_SPECS:
@@ -402,11 +442,13 @@ def load(args) -> Tuple[FederatedDataset, int]:
         real = _try_load_npz(cache, name) if cache else None
         if real is not None:
             tx, ty, vx, vy = real
+            prov = _cache_provenance(cache, "real:npz", name)
         else:
             tx, ty, vx, vy = synthetic_text_classification(
                 train_n, test_n, classes, vocab, seq_len, seed)
+            prov = "synthetic"
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
-                             alpha, seed)
+                             alpha, seed, provenance=prov)
         return ds, classes
 
     if name in _BIG_IMAGE_SPECS:
@@ -425,7 +467,9 @@ def load(args) -> Tuple[FederatedDataset, int]:
             tx, ty, vx, vy = synthetic_image_classification(
                 train_n, test_n, classes, shape, seed)
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
-                             alpha, seed)
+                             alpha, seed,
+                             provenance=_cache_provenance(cache, "real:cache", name) if real is not None
+                             else "synthetic")
         return ds, classes
 
     if name in _SEG_SPECS:
@@ -446,7 +490,9 @@ def load(args) -> Tuple[FederatedDataset, int]:
                                          minlength=classes).argmax()
                              for m in ty])
         client_idxs = partition(dominant, client_num, method, alpha, seed)
-        ds = FederatedDataset(tx, ty, vx, vy, client_idxs, classes)
+        ds = FederatedDataset(tx, ty, vx, vy, client_idxs, classes,
+                              provenance=_cache_provenance(cache, "real:npz", name) if real is not None
+                              else "synthetic")
         return ds, classes
 
     if name in ("edge_case_examples", "edge_case"):
@@ -464,7 +510,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
         ex, _, _, _ = synthetic_image_classification(
             edge_n, 1, classes, shape, seed ^ 0xED6E, noise=0.9)
         ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
-                             alpha, seed)
+                             alpha, seed, provenance="synthetic")
         ds.edge_x = ex
         ds.edge_y = np.full((edge_n,),
                             int(getattr(args, "edge_case_target", 9)),
@@ -486,7 +532,7 @@ def load(args) -> Tuple[FederatedDataset, int]:
         cut = int(getattr(args, "train_size", 0)) or int(len(x) * 0.85)
         tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]
         ds = build_federated(tx, ty, vx, vy, 10, client_num, method, alpha,
-                             seed)
+                             seed, provenance="real:sklearn-digits")
         return ds, 10
 
     if name.startswith("synthetic"):
@@ -496,7 +542,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         tx, ty, vx, vy = synthetic_image_classification(
             int(getattr(args, "train_size", 10000)),
             int(getattr(args, "test_size", 2000)), classes, shape, seed)
-        ds = build_federated(tx, ty, vx, vy, classes, client_num, method, alpha, seed)
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed, provenance="synthetic")
         return ds, classes
 
     raise ValueError(f"unknown dataset {name!r}")

@@ -1,0 +1,25 @@
+package ai.fedml.edge;
+
+/**
+ * Edge client state machine constants (reference android/fedmlsdk
+ * EdgeMessageDefine: the MQTT status codes the binding service reports to
+ * the MLOps plane; here they label the shared-directory protocol states).
+ */
+public final class EdgeMessageDefine {
+    private EdgeMessageDefine() {}
+
+    public static final int STATUS_IDLE = 0;
+    public static final int STATUS_QUEUED = 1;
+    public static final int STATUS_TRAINING = 2;
+    public static final int STATUS_UPLOADING = 3;
+    public static final int STATUS_FINISHED = 4;
+    public static final int STATUS_STOPPED = 5;
+    public static final int STATUS_ERROR = 6;
+
+    /** key=value keys of the round task file (server side writes these). */
+    public static final String KEY_ROUND = "round";
+    public static final String KEY_EPOCHS = "epochs";
+    public static final String KEY_BATCH = "batch";
+    public static final String KEY_LR = "lr";
+    public static final String KEY_SEED = "seed";
+}

@@ -1,0 +1,49 @@
+package ai.fedml.edge;
+
+/**
+ * Edge binding-service interface — the surface parity target of the
+ * reference's {@code android/fedmlsdk/.../FedEdgeApi.java} interface
+ * (init / account binding / train control / status + progress listeners /
+ * hyper-parameters / private data path / unInit), minus the Android
+ * {@code Context} (this SDK runs on any JVM; transport is the
+ * shared-directory edge protocol instead of the vendor MQTT backend).
+ *
+ * Obtain the singleton via {@link FedEdgeManager#getFedEdgeApi()}.
+ */
+public interface FedEdge {
+    /** Initialize against a federation work directory (server-managed). */
+    void init(String workDir, int clientId, String dataBundlePath);
+
+    // -- account binding (MLOps plane stand-in: persisted locally) --------
+    void bindingAccount(String accountId, String deviceId);
+
+    void unboundAccount();
+
+    String getBoundEdgeId();
+
+    void bindEdge(String bindId);
+
+    // -- training control --------------------------------------------------
+    /** Start the asynchronous federation loop (non-blocking). */
+    void train();
+
+    int getTrainingStatus();
+
+    /** Latest (round, epoch, loss) snapshot encoded as "round,epoch,loss". */
+    String getEpochAndLoss();
+
+    void setTrainingStatusListener(OnTrainingStatusListener listener);
+
+    void setEpochLossListener(OnTrainProgressListener listener);
+
+    /** The current round's task file contents (key=value lines). */
+    String getHyperParameters();
+
+    // -- private data ------------------------------------------------------
+    void setPrivatePath(String path);
+
+    String getPrivatePath();
+
+    /** Stop the loop and release native resources. */
+    void unInit();
+}

@@ -20,11 +20,21 @@ import java.util.Locale;
  *   server:  finish.txt
  */
 public final class FedEdgeApi {
+    /** Progress hook (FedEdgeImpl relays this to the app listeners). */
+    public interface ProgressSink {
+        void report(int round, int epoch, float loss, float percent);
+    }
+
     private final Path workDir;
     private final int clientId;
     private final String dataBundle;
     private final long pollMillis;
     private volatile boolean stopped = false;
+    private volatile ProgressSink progressSink;
+
+    public void setProgressSink(ProgressSink sink) {
+        this.progressSink = sink;
+    }
 
     public FedEdgeApi(String workDir, int clientId, String dataBundle,
                       long pollMillis) {
@@ -55,6 +65,11 @@ public final class FedEdgeApi {
                     model.toString(), dataBundle, t.batch, t.lr)) {
                 trainer.train(t.epochs,
                               t.seed + 1315423911L * clientId + round);
+                ProgressSink sink = progressSink;
+                if (sink != null) {
+                    sink.report(round, trainer.epoch(), trainer.loss(),
+                                100.0f);
+                }
                 Path out = rdir.resolve("client_" + clientId + ".fteb");
                 Path tmp = rdir.resolve("client_" + clientId + ".fteb.tmp");
                 trainer.saveModel(tmp.toString());

@@ -1,0 +1,206 @@
+package ai.fedml.edge;
+
+import java.io.File;
+import java.io.FileInputStream;
+import java.io.FileOutputStream;
+import java.io.IOException;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.nio.file.Paths;
+import java.util.Properties;
+
+/**
+ * Default {@link FedEdge} implementation (reference android/fedmlsdk
+ * FedEdgeImpl: binds the app to the edge service, relays train control and
+ * status).  The Android original delegates to a bound Service over AIDL and
+ * an MQTT edge communicator; here the federation transport is the
+ * shared-directory protocol driven by {@link FedEdgeApi} on a worker
+ * thread, and account binding persists to a local properties file (the
+ * in-image stand-in for the MLOps binding backend).
+ */
+public final class FedEdgeImpl implements FedEdge {
+    private Path workDir;
+    private int clientId;
+    private String dataBundle;
+    private String privatePath = "";
+    private FedEdgeApi loop;
+    private Thread worker;
+    private volatile int status = EdgeMessageDefine.STATUS_IDLE;
+    private volatile int lastRound = -1;
+    private volatile int lastEpoch = -1;
+    private volatile float lastLoss = Float.NaN;
+    private OnTrainingStatusListener statusListener;
+    private OnTrainProgressListener progressListener;
+
+    @Override
+    public synchronized void init(String workDir, int clientId,
+                                  String dataBundlePath) {
+        this.workDir = Paths.get(workDir);
+        this.clientId = clientId;
+        this.dataBundle = dataBundlePath;
+        setStatus(EdgeMessageDefine.STATUS_IDLE);
+    }
+
+    // -- binding ----------------------------------------------------------
+    private Path bindingFile() {
+        return workDir.resolve("binding_" + clientId + ".properties");
+    }
+
+    @Override
+    public synchronized void bindingAccount(String accountId,
+                                            String deviceId) {
+        Properties p = new Properties();
+        p.setProperty("account_id", accountId);
+        p.setProperty("device_id", deviceId);
+        p.setProperty("edge_id", accountId + "." + deviceId);
+        try (FileOutputStream out = new FileOutputStream(
+                bindingFile().toFile())) {
+            p.store(out, "fedml edge binding");
+        } catch (IOException e) {
+            throw new IllegalStateException("binding persist failed", e);
+        }
+    }
+
+    @Override
+    public synchronized void unboundAccount() {
+        try {
+            Files.deleteIfExists(bindingFile());
+        } catch (IOException ignored) {
+        }
+    }
+
+    @Override
+    public synchronized String getBoundEdgeId() {
+        File f = bindingFile().toFile();
+        if (!f.exists()) {
+            return "";
+        }
+        Properties p = new Properties();
+        try (FileInputStream in = new FileInputStream(f)) {
+            p.load(in);
+        } catch (IOException e) {
+            return "";
+        }
+        return p.getProperty("edge_id", "");
+    }
+
+    @Override
+    public synchronized void bindEdge(String bindId) {
+        Properties p = new Properties();
+        p.setProperty("edge_id", bindId);
+        try (FileOutputStream out = new FileOutputStream(
+                bindingFile().toFile())) {
+            p.store(out, "fedml edge binding");
+        } catch (IOException e) {
+            throw new IllegalStateException("binding persist failed", e);
+        }
+    }
+
+    // -- training ----------------------------------------------------------
+    @Override
+    public synchronized void train() {
+        if (worker != null && worker.isAlive()) {
+            return;
+        }
+        loop = new FedEdgeApi(workDir.toString(), clientId, dataBundle, 100);
+        loop.setProgressSink((round, epoch, loss, percent) ->
+                reportProgress(round, epoch, loss, percent));
+        setStatus(EdgeMessageDefine.STATUS_QUEUED);
+        worker = new Thread(() -> {
+            try {
+                setStatus(EdgeMessageDefine.STATUS_TRAINING);
+                loop.run();
+                setStatus(EdgeMessageDefine.STATUS_FINISHED);
+            } catch (Exception e) {
+                setStatus(EdgeMessageDefine.STATUS_ERROR);
+            }
+        }, "fedml-edge-loop");
+        worker.setDaemon(true);
+        worker.start();
+    }
+
+    @Override
+    public int getTrainingStatus() {
+        return status;
+    }
+
+    @Override
+    public String getEpochAndLoss() {
+        return lastRound + "," + lastEpoch + "," + lastLoss;
+    }
+
+    @Override
+    public void setTrainingStatusListener(OnTrainingStatusListener l) {
+        this.statusListener = l;
+    }
+
+    @Override
+    public void setEpochLossListener(OnTrainProgressListener l) {
+        this.progressListener = l;
+    }
+
+    /** Invoked by the loop after each local epoch (package-private). */
+    void reportProgress(int round, int epoch, float loss, float percent) {
+        lastRound = round;
+        lastEpoch = epoch;
+        lastLoss = loss;
+        OnTrainProgressListener l = progressListener;
+        if (l != null) {
+            l.onEpochLoss(round, epoch, loss);
+            l.onProgressChanged(round, percent);
+        }
+    }
+
+    private void setStatus(int s) {
+        status = s;
+        OnTrainingStatusListener l = statusListener;
+        if (l != null) {
+            l.onStatusChanged(s);
+        }
+    }
+
+    @Override
+    public synchronized String getHyperParameters() {
+        if (workDir == null) {
+            return "";
+        }
+        // latest round's task file, matching the server's key=value schema
+        for (int r = 10_000; r >= 0; r--) {
+            Path task = workDir.resolve("round_" + r).resolve("task.txt");
+            if (Files.exists(task)) {
+                try {
+                    return new String(Files.readAllBytes(task));
+                } catch (IOException e) {
+                    return "";
+                }
+            }
+        }
+        return "";
+    }
+
+    // -- data --------------------------------------------------------------
+    @Override
+    public void setPrivatePath(String path) {
+        this.privatePath = path;
+    }
+
+    @Override
+    public String getPrivatePath() {
+        return privatePath;
+    }
+
+    @Override
+    public synchronized void unInit() {
+        if (loop != null) {
+            loop.stop();
+        }
+        if (worker != null) {
+            try {
+                worker.join(2000);
+            } catch (InterruptedException e) {
+                Thread.currentThread().interrupt();
+            }
+        }
+        setStatus(EdgeMessageDefine.STATUS_STOPPED);
+    }
+}

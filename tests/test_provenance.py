@@ -1,0 +1,90 @@
+"""Dataset provenance stamping (VERDICT r2 weak item 5: a synthetic
+fallback accuracy must be distinguishable from a real-data number in every
+downstream record)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu import data as data_mod
+from fedml_tpu.arguments import load_arguments
+
+
+def _args(**kw):
+    a = load_arguments()
+    a.update(random_seed=0, client_num_in_total=4, **kw)
+    return a
+
+
+def test_synthetic_fallback_stamped():
+    ds, _ = data_mod.load(_args(dataset="femnist", train_size=256,
+                                test_size=64))
+    assert ds.provenance == "synthetic"
+
+
+def test_digits_is_real():
+    ds, _ = data_mod.load(_args(dataset="digits"))
+    assert ds.provenance == "real:sklearn-digits"
+
+
+def test_npz_cache_is_real(tmp_path):
+    rng = np.random.default_rng(0)
+    np.savez(tmp_path / "uci.npz",
+             train_x=rng.random((64, 14), np.float32),
+             train_y=rng.integers(0, 2, 64),
+             test_x=rng.random((16, 14), np.float32),
+             test_y=rng.integers(0, 2, 16))
+    ds, _ = data_mod.load(_args(dataset="uci",
+                                data_cache_dir=str(tmp_path)))
+    assert ds.provenance == "real:npz"
+
+
+def test_generated_leaf_is_marked_synthetic(tmp_path):
+    """tools/make_format_datasets writes LEAF files + PROVENANCE marker:
+    the parser path must NOT claim real."""
+    from tools.make_format_datasets import make_femnist_leaf
+
+    make_femnist_leaf(str(tmp_path), n_users=6, min_samples=10,
+                      max_samples=20, shards=2)
+    ds, classes = data_mod.load(_args(dataset="femnist",
+                                      data_cache_dir=str(tmp_path)))
+    assert classes == 62
+    assert ds.provenance.startswith("synthetic:leaf-format")
+    assert ds.num_clients == 6  # natural per-user partition preserved
+
+
+def test_unmarked_leaf_is_real(tmp_path):
+    """A LEAF layout without a marker (driver-provided real bytes) keeps
+    its real tag."""
+    root = tmp_path / "femnist"
+    for split in ("train", "test"):
+        d = root / split
+        d.mkdir(parents=True)
+        blob = {"users": ["u0"], "num_samples": [4],
+                "user_data": {"u0": {
+                    "x": [[0.1] * 784] * 4, "y": [1, 2, 3, 4]}}}
+        (d / "data.json").write_text(json.dumps(blob))
+    ds, _ = data_mod.load(_args(dataset="femnist",
+                                data_cache_dir=str(tmp_path)))
+    assert ds.provenance == "real:leaf"
+
+
+def test_round_record_carries_provenance():
+    import fedml_tpu
+    from fedml_tpu import device as device_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    args = _args(dataset="synthetic", num_classes=4, input_shape=(6, 6, 1),
+                 train_size=128, test_size=32, model="lr",
+                 client_num_per_round=2, comm_round=2, batch_size=8,
+                 learning_rate=0.1, frequency_of_the_test=1)
+    args = fedml_tpu.init(args, should_init_logs=False)
+    ds, out_dim = data_mod.load(args)
+    api = FedAvgAPI(args, device_mod.get_device(args), ds,
+                    model_mod.create(args, out_dim), client_mode="vmap")
+    api.train()
+    assert api.metrics_history, "no round records"
+    for rec in api.metrics_history:
+        assert rec["dataset_provenance"] == "synthetic"

@@ -56,14 +56,16 @@ class _Connection:
         self.alive = True
         self.clean_disconnect = False
         self._pid = 0
+        self._qos2_seen: set = set()
 
     def send(self, data: bytes):
         with self.wlock:
             self.sock.sendall(data)
 
     def next_pid(self) -> int:
-        self._pid = self._pid % 65535 + 1
-        return self._pid
+        with self.wlock:  # deliver() runs on many publisher threads
+            self._pid = self._pid % 65535 + 1
+            return self._pid
 
     def deliver(self, topic: str, payload: bytes, qos: int,
                 retain: bool = False):
@@ -129,15 +131,13 @@ class _Connection:
                 self.send(make_pid_packet(PUBACK, pid))
             elif qos == 2:
                 self.send(make_pid_packet(PUBREC, pid))
-                if pid in self.broker._qos2_seen.setdefault(
-                        self.session.client_id, set()):
+                if pid in self._qos2_seen:
                     return
-                self.broker._qos2_seen[self.session.client_id].add(pid)
+                self._qos2_seen.add(pid)
             self.broker.route(topic, payload, qos, retain)
         elif ptype == PUBREL:
             pid, = struct.unpack(">H", body)
-            self.broker._qos2_seen.get(self.session.client_id,
-                                       set()).discard(pid)
+            self._qos2_seen.discard(pid)
             self.send(make_pid_packet(PUBCOMP, pid))
         elif ptype in (PUBACK, PUBCOMP):
             pass  # client acks for broker-initiated qos>0 deliveries
@@ -184,7 +184,6 @@ class MiniMqttBroker:
         self._lock = threading.Lock()
         self._sessions: Dict[str, _Session] = {}
         self._retained: Dict[str, Tuple[bytes, int]] = {}
-        self._qos2_seen: Dict[str, set] = {}
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._running = False

@@ -49,9 +49,11 @@ class MqttS3CommManager(BaseCommunicationManager):
         self._observers: List[Observer] = []
         self._running = False
 
+        # STABLE client id: a persistent (clean_session=False) session is
+        # only useful if a reconnect can resume it; a random suffix would
+        # strand dead sessions (and their queued QoS traffic) on the broker
         self._client = make_client(
-            client_id=f"fedml_{self.run_id}_{self.rank}_"
-                      f"{uuid.uuid4().hex[:6]}",
+            client_id=f"fedml_{self.run_id}_{self.rank}",
             clean_session=False)
         if cfg.get("user"):
             self._client.username_pw_set(cfg["user"], cfg.get("password", ""))

@@ -3,8 +3,8 @@
 MQTT+Theta comm managers — model blobs go to web3.storage / Theta EdgeStore
 and the control message carries the content id)."""
 
-from .store import (ContentAddressedStore, LocalCAStore, ThetaEdgeStore,
-                    Web3Store, create_store)
+from .store import (ChunkedCAStore, ContentAddressedStore, LocalCAStore,
+                    ThetaEdgeStore, Web3Store, create_store)
 
-__all__ = ["ContentAddressedStore", "LocalCAStore", "ThetaEdgeStore",
-           "Web3Store", "create_store"]
+__all__ = ["ChunkedCAStore", "ContentAddressedStore", "LocalCAStore",
+           "ThetaEdgeStore", "Web3Store", "create_store"]

@@ -123,4 +123,124 @@ def create_store(args, kind: Optional[str] = None) -> ContentAddressedStore:
     if kind in ("theta", "thetastore"):
         return ThetaEdgeStore(rpc=str(getattr(
             args, "theta_rpc", "http://localhost:17888/rpc")))
+    if kind in ("chunked", "ipfs_like"):
+        return ChunkedCAStore(
+            LocalCAStore(str(getattr(args, "store_dir", _DEFAULT_ROOT))),
+            chunk_size=int(getattr(args, "storage_chunk_bytes", 1 << 20)))
     raise ValueError(f"unknown storage_backend {kind!r}")
+
+
+class ChunkedCAStore(ContentAddressedStore):
+    """IPFS-like chunking + pinning + gateway fallback over any inner store.
+
+    The reference's decentralized planes inherit these semantics from IPFS
+    itself (web3.storage pins uploads; retrieval goes through any public
+    gateway).  This wrapper reproduces them store-agnostically:
+
+    - **chunking**: ``put`` splits payloads into ``chunk_size`` blocks and
+      stores a json manifest (block cid list + size); the manifest's cid is
+      the returned content id — identical blocks across models dedup for
+      free under content addressing (LoRA federation uploads share most
+      bytes round-over-round);
+    - **pinning**: ``pin``/``unpin`` manage a root set; ``gc`` deletes any
+      LOCAL blob not reachable from a pinned root (manifest children are
+      reachable), mirroring ``ipfs pin`` + ``ipfs repo gc``;
+    - **gateway retrieval**: ``get`` falls back to read-only ``gateways``
+      (other stores) when the primary misses, and re-hosts fetched bytes
+      locally (gateway → local cache, like an IPFS node pulling a block).
+    """
+
+    _MAGIC = b"fteb-manifest:"
+    _RAW = b"fteb-raw:"
+
+    def __init__(self, inner: Optional[ContentAddressedStore] = None,
+                 chunk_size: int = 1 << 20, gateways=()):
+        self.inner = inner or LocalCAStore()
+        self.chunk_size = int(chunk_size)
+        self.gateways = list(gateways)
+        self._pins = set()
+
+    # -- chunking ----------------------------------------------------------
+    def put(self, data: bytes) -> str:
+        if len(data) <= self.chunk_size:
+            if data.startswith((self._MAGIC, self._RAW)):
+                # escape payloads that collide with the manifest magic
+                return self.inner.put(self._RAW + data)
+            return self.inner.put(data)
+        chunks = [self.inner.put(data[i:i + self.chunk_size])
+                  for i in range(0, len(data), self.chunk_size)]
+        manifest = self._MAGIC + json.dumps(
+            {"size": len(data), "chunks": chunks}).encode()
+        return self.inner.put(manifest)
+
+    def _get_raw(self, cid: str) -> bytes:
+        try:
+            return self.inner.get(cid)
+        except Exception:
+            for gw in self.gateways:
+                try:
+                    data = gw.get(cid)
+                except Exception:
+                    continue
+                self.inner.put(data)  # re-host locally (gateway pull)
+                return data
+            raise
+
+    def get(self, cid: str) -> bytes:
+        blob = self._get_raw(cid)
+        if blob.startswith(self._RAW):
+            return blob[len(self._RAW):]
+        if not blob.startswith(self._MAGIC):
+            return blob
+        meta = json.loads(blob[len(self._MAGIC):])
+        out = b"".join(self._get_raw(c) for c in meta["chunks"])
+        if len(out) != int(meta["size"]):
+            raise IOError(f"cid {cid}: reassembled {len(out)} bytes, "
+                          f"manifest says {meta['size']}")
+        return out
+
+    # -- pinning -----------------------------------------------------------
+    def pin(self, cid: str):
+        self._pins.add(cid)
+
+    def unpin(self, cid: str):
+        self._pins.discard(cid)
+
+    def pins(self):
+        return set(self._pins)
+
+    def _reachable(self) -> set:
+        seen = set()
+        frontier = list(self._pins)
+        while frontier:
+            cid = frontier.pop()
+            if cid in seen:
+                continue
+            seen.add(cid)
+            try:
+                blob = self.inner.get(cid)
+            except Exception:
+                continue
+            if blob.startswith(self._MAGIC):
+                frontier.extend(
+                    json.loads(blob[len(self._MAGIC):])["chunks"])
+        return seen
+
+    def gc(self) -> int:
+        """Delete unpinned local blobs; returns the number removed.  Only
+        meaningful over a LocalCAStore inner (remote stores garbage-collect
+        server-side)."""
+        root = getattr(self.inner, "root", None)
+        if root is None:
+            return 0
+        keep = self._reachable()
+        removed = 0
+        for name in os.listdir(root):
+            if name.endswith(".tmp") or name in keep:
+                continue
+            try:
+                os.remove(os.path.join(root, name))
+                removed += 1
+            except OSError:
+                pass
+        return removed

@@ -42,16 +42,36 @@ class BackdoorAttack:
 class EdgeCaseBackdoorAttack(BackdoorAttack):
     """Edge-case variant (reference edge_case_attack.py): instead of a pixel
     trigger, inject out-of-distribution samples labeled with the target.
-    Without the reference's ARDIS/Southwest downloads (no egress), edge cases
-    are synthesized as extreme-intensity versions of existing samples."""
+
+    When an edge-example pool is available — the ``edge_case_examples``
+    dataset carries one as ``edge_x``/``edge_y`` (the reference ships
+    ARDIS/Southwest pools in ``data/edge_case_examples/``) — poisoned
+    samples are drawn from it; otherwise edge cases are synthesized as
+    intensity-inverted versions of the client's own samples (off-manifold
+    for normalized image data, no egress needed)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.edge_pool = None  # (x, y) arrays; set via set_edge_pool
+
+    def set_edge_pool(self, edge_x, edge_y=None):
+        self.edge_pool = (np.asarray(edge_x),
+                          None if edge_y is None else np.asarray(edge_y))
 
     def poison_data(self, dataset):
         if isinstance(dataset, tuple) and len(dataset) == 2:
-            x, y = np.array(dataset[0], copy=True), np.array(dataset[1], copy=True)
+            x, y = (np.array(dataset[0], copy=True),
+                    np.array(dataset[1], copy=True))
             n = len(x)
             k = max(int(self.trigger_frac * n), 1)
-            edge = 1.0 - x[:k]          # inverted = off-manifold for digits
-            x[:k] = edge
-            y[:k] = self.target_label
+            if self.edge_pool is not None:
+                ex, ey = self.edge_pool
+                take = np.resize(np.arange(len(ex)), k)
+                x[:k] = ex[take]
+                y[:k] = (self.target_label if ey is None
+                         else ey[take])
+            else:
+                x[:k] = 1.0 - x[:k]  # inverted = off-manifold for digits
+                y[:k] = self.target_label
             return x, y
         return dataset

@@ -25,7 +25,6 @@ def _three_sigma_keep(scores):
 
 
 @register("three_sigma")
-@register("outlier_detection")
 class ThreeSigmaDefense(BaseDefense):
     """Score = distance to the coordinate-wise median center."""
 
@@ -73,6 +72,44 @@ class ThreeSigmaKrumDefense(BaseDefense):
         keep = _three_sigma_keep(scores)
         kept = [raw_list[i] for i in range(c) if bool(keep[i])]
         return kept or raw_list
+
+
+@register("three_sigma_foolsgold")
+class ThreeSigmaFoolsGoldDefense(BaseDefense):
+    """Score = FoolsGold-style max pairwise cosine similarity (reference
+    ``three_sigma_defense_foolsgold.py``): sybil coalitions pushing aligned
+    updates score high together and fall past the 3σ gate, while the
+    distance-based variants can miss colluders who sit near the center."""
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        normed = vecs / jnp.maximum(
+            jnp.linalg.norm(vecs, axis=1, keepdims=True), 1e-12)
+        cs = normed @ normed.T - jnp.eye(vecs.shape[0])
+        scores = jnp.max(cs, axis=1)
+        keep = _three_sigma_keep(scores)
+        kept = [raw_list[i] for i in range(len(raw_list)) if bool(keep[i])]
+        return kept or raw_list
+
+
+@register("outlier_detection")
+class OutlierDetectionDefense(BaseDefense):
+    """Two-phase composition (reference ``outlier_detection.py``): the
+    cross-round direction check runs every round as a cheap tripwire; the
+    3σ filter only engages when the tripwire actually flagged somebody —
+    steady-state rounds pay one cosine per client, not a cohort scrub."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.cross_round = CrossRoundDefense(args)
+        self.three_sigma = ThreeSigmaDefense(args)
+
+    def defend_before_aggregation(self, raw_list, extra=None):
+        screened = self.cross_round.defend_before_aggregation(raw_list,
+                                                              extra)
+        if len(screened) == len(raw_list):
+            return raw_list  # tripwire silent: no second phase
+        return self.three_sigma.defend_before_aggregation(raw_list, extra)
 
 
 @register("cross_round")

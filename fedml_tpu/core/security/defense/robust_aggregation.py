@@ -101,6 +101,51 @@ class TrimmedMeanDefense(BaseDefense):
         return tree_unflatten_1d(jnp.mean(kept, axis=0), template)
 
 
+@register("geometric_median_bucket")
+class GeometricMedianBucketDefense(BaseDefense):
+    """Byzantine gradient descent (reference
+    ``geometric_median_defense.py``, Chen et al. 2017): clients are grouped
+    into ``batch_num`` buckets, each bucket is averaged, and the geometric
+    median of the bucket means is the aggregate.  Bucketing dilutes
+    Byzantine updates (each bucket mean is mostly honest) so the median
+    needs to resist only ``batch_num``-scale corruption.
+
+    One reshape + mean turns the bucketing into a (k, D) matrix; the
+    Weiszfeld loop then matches RFA's.
+    """
+
+    def __init__(self, args):
+        super().__init__(args)
+        f = int(getattr(args, "byzantine_client_num", 0))
+        per_round = int(getattr(args, "client_num_per_round", 0))
+        default = 1 if f == 0 else max(2 * f + 1, 3)
+        self.batch_num = int(getattr(args, "batch_num", 0) or default)
+        if per_round:
+            self.batch_num = min(self.batch_num, per_round)
+        self.iters = int(getattr(args, "rfa_iters", 8))
+
+    def defend_on_aggregation(self, raw_list, base_agg=None, extra=None):
+        vecs, w, template = stack_clients(raw_list)
+        c, d = vecs.shape
+        k = max(1, min(self.batch_num, c))
+        size = -(-c // k)
+        pad = k * size - c
+        # zero-weight padding keeps the reshape static; bucket means are
+        # weighted so pad rows contribute nothing
+        vp = jnp.concatenate([vecs, jnp.zeros((pad, d), vecs.dtype)])
+        wp = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+        vb = vp.reshape(k, size, d)
+        wb = wp.reshape(k, size)
+        wsum = jnp.maximum(jnp.sum(wb, axis=1, keepdims=True), 1e-12)
+        means = jnp.sum(vb * (wb / wsum)[..., None], axis=1)  # (k, D)
+        v = jnp.mean(means, axis=0)
+        for _ in range(self.iters):
+            dist = jnp.sqrt(jnp.sum((means - v[None, :]) ** 2, axis=1))
+            beta = 1.0 / jnp.maximum(dist, 1e-6)
+            v = jnp.einsum("k,kd->d", beta / jnp.sum(beta), means)
+        return tree_unflatten_1d(v, template)
+
+
 @register("rfa")
 @register("geometric_median")
 class RFADefense(BaseDefense):

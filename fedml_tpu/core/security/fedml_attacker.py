@@ -42,6 +42,17 @@ class FedMLAttacker:
 
         self.attacker = create_attacker(self.attack_type, args)
 
+    def provide_edge_pool(self, dataset):
+        """Hand the attacker the dataset's edge-example pool when both
+        exist (``edge_case_examples`` loader sets ``edge_x``/``edge_y``;
+        reference ships ARDIS/Southwest pools for the edge-case
+        backdoor)."""
+        if (self.is_enabled and self.attacker is not None
+                and hasattr(self.attacker, "set_edge_pool")
+                and getattr(dataset, "edge_x", None) is not None):
+            self.attacker.set_edge_pool(dataset.edge_x,
+                                        getattr(dataset, "edge_y", None))
+
     # -- predicates (reference fedml_attacker.py:41-77) --------------------
     def is_data_poisoning_attack(self) -> bool:
         return self.is_enabled and self.attack_type in _DATA_POISONING

@@ -20,6 +20,7 @@ from ...core import rng as rng_util
 from ...core.compression import FedMLCompression
 from ...core.distributed.communication.message import Message
 from ...core.distributed.fedml_comm_manager import FedMLCommManager
+from ...core.security.fedml_attacker import FedMLAttacker
 from ...ml.trainer.local_trainer import LocalTrainer, ServerCtx
 from ...mlops import log_training_status
 from ..message_define import MyMessage
@@ -102,6 +103,9 @@ class TrainerDistAdapter:
         self.model = model
         self.dataset = dataset
         self.trainer = LocalTrainer(model, args)
+        # red-team wiring: hand the dataset's edge-example pool (if any) to
+        # an edge-case backdoor attacker at startup
+        FedMLAttacker.get_instance().provide_edge_pool(dataset)
         self.local_train = jax.jit(self.trainer.make_local_train())
         self.seed = int(getattr(args, "random_seed", 0))
         self.batch_size = int(getattr(args, "batch_size", 10))

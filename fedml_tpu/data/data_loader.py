@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import gzip
 import os
+import re
 import struct
 from typing import Optional, Tuple
 
@@ -126,8 +127,11 @@ def _cache_provenance(root: str, default: str,
             continue
         if not tag:
             continue
-        if fname == "PROVENANCE" and name and name not in tag:
-            continue  # marker belongs to a different dataset in this cache
+        if fname == "PROVENANCE" and name and \
+                name not in re.split(r"[^a-z0-9_]+", tag.lower()):
+            # token match, not substring: a cifar100 marker must not
+            # relabel a real cifar10 archive dropped in the same cache
+            continue
         return tag
     return default
 

@@ -1,0 +1,138 @@
+"""HuggingFace Llama checkpoint import (reference
+``python/fedml/train/llm/hf_trainer.py:28`` fine-tunes HF checkpoints via
+AutoModelForCausalLM; here the torch weights are mapped into the flax
+:class:`~fedml_tpu.llm.model.LlamaLM` tree so FedLLM can start from a real
+pretrained model).
+
+Key mapping (HF ``LlamaForCausalLM`` → :mod:`fedml_tpu.llm.model`):
+
+======================================================  =======================
+``model.embed_tokens.weight``                           ``tok_embed/embedding``
+``model.layers.{i}.self_attn.{q,k,v,o}_proj.weight``    ``layer_{i}/attention/w{q,k,v,o}[/base]/kernel`` (transposed)
+``model.layers.{i}.mlp.{gate,up,down}_proj.weight``     ``layer_{i}/mlp/w_{gate,up,down}/kernel`` (transposed)
+``model.layers.{i}.input_layernorm.weight``             ``layer_{i}/attn_norm/scale``
+``model.layers.{i}.post_attention_layernorm.weight``    ``layer_{i}/mlp_norm/scale``
+``model.norm.weight``                                   ``final_norm/scale``
+``lm_head.weight``                                      ``lm_head/kernel`` (transposed)
+======================================================  =======================
+
+RoPE convention: HF stores q/k projections permuted for its rotate-half
+rotary layout; this model rotates interleaved even/odd pairs (the Meta
+layout), so q/k output dims are inverse-permuted per head on import.  The
+whole mapping is verified numerically against ``transformers``' reference
+forward in ``tests/test_hf_import.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ml.engine.ml_engine_adapter import torch_state_dict_to_pytree
+from .model import LlamaConfig
+
+
+def _unpermute_rope_cols(kernel: np.ndarray, n_heads: int) -> np.ndarray:
+    """Invert the Meta→HF per-head permutation on a flax-layout
+    ``(in, out)`` q/k kernel: HF groups each head's output rows as
+    ``(2, head_dim/2)`` (rotate-half halves); the interleaved-pair RoPE here
+    wants ``(head_dim/2, 2)`` (even/odd pairs)."""
+    in_dim, out_dim = kernel.shape
+    head_dim = out_dim // n_heads
+    k = kernel.reshape(in_dim, n_heads, 2, head_dim // 2)
+    return k.transpose(0, 1, 3, 2).reshape(in_dim, out_dim)
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` to :class:`LlamaConfig`."""
+    import jax.numpy as jnp
+
+    return LlamaConfig(
+        vocab_size=int(hf_config.vocab_size),
+        dim=int(hf_config.hidden_size),
+        n_layers=int(hf_config.num_hidden_layers),
+        n_heads=int(hf_config.num_attention_heads),
+        n_kv_heads=int(getattr(hf_config, "num_key_value_heads", None)
+                       or hf_config.num_attention_heads),
+        ffn_dim=int(hf_config.intermediate_size),
+        max_seq_len=int(getattr(hf_config, "max_position_embeddings", 4096)),
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        dtype=jnp.bfloat16,
+    )
+
+
+def hf_llama_state_dict_to_flax(state_dict: Dict[str, Any],
+                                cfg: LlamaConfig,
+                                lora: bool = False,
+                                dtype=np.float32) -> Dict[str, Any]:
+    """HF ``LlamaForCausalLM.state_dict()`` → flax ``params`` tree.
+
+    Tensor conversion (detach/cpu/numpy, 2-D ``weight``→transposed
+    ``kernel``, 1-D ``weight``→``scale``) rides the shared engine adapter
+    (:func:`torch_state_dict_to_pytree`); this function only renames and
+    fixes the RoPE head permutation.  ``lora=True`` targets the
+    :class:`LoRADense` layout (base kernels under ``w*/base/kernel``).
+    """
+    g = torch_state_dict_to_pytree(state_dict, transpose_linear=True)
+    model = g["model"]
+
+    def cast(a):
+        return np.asarray(a, dtype)
+
+    def wrap(kernel):
+        node = {"kernel": cast(kernel)}
+        return {"base": node} if lora else node
+
+    params: Dict[str, Any] = {
+        # embedding came through as a transposed (dim, vocab) kernel;
+        # flax nn.Embed wants (vocab, dim)
+        "tok_embed": {"embedding": cast(
+            model["embed_tokens"]["kernel"].T)},
+        "final_norm": {"scale": cast(model["norm"]["scale"])},
+        "lm_head": {"kernel": cast(g["lm_head"]["kernel"])},
+    }
+    for i in range(cfg.n_layers):
+        li = model["layers"][str(i)]
+        sa = li["self_attn"]
+        params[f"layer_{i}"] = {
+            "attention": {
+                "wq": wrap(_unpermute_rope_cols(sa["q_proj"]["kernel"],
+                                                cfg.n_heads)),
+                "wk": wrap(_unpermute_rope_cols(sa["k_proj"]["kernel"],
+                                                cfg.n_kv_heads)),
+                "wv": wrap(sa["v_proj"]["kernel"]),
+                "wo": wrap(sa["o_proj"]["kernel"]),
+            },
+            "attn_norm": {"scale": cast(li["input_layernorm"]["scale"])},
+            "mlp_norm": {"scale": cast(
+                li["post_attention_layernorm"]["scale"])},
+            "mlp": {
+                "w_gate": {"kernel": cast(li["mlp"]["gate_proj"]["kernel"])},
+                "w_up": {"kernel": cast(li["mlp"]["up_proj"]["kernel"])},
+                "w_down": {"kernel": cast(li["mlp"]["down_proj"]["kernel"])},
+            },
+        }
+    return params
+
+
+def load_hf_llama(model_or_path, lora_rank: int = 0):
+    """One-call import: an in-memory ``transformers`` Llama model (or a
+    local checkpoint dir) → ``(LlamaLM, params)``."""
+    from .model import LlamaLM
+
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+    cfg = config_from_hf(model_or_path.config)
+    if lora_rank:
+        cfg = dataclasses.replace(cfg, lora_rank=lora_rank)
+    params = hf_llama_state_dict_to_flax(model_or_path.state_dict(), cfg,
+                                         lora=lora_rank > 0)
+    return LlamaLM(cfg), params
+
+
+__all__ = ["config_from_hf", "hf_llama_state_dict_to_flax",
+           "load_hf_llama"]

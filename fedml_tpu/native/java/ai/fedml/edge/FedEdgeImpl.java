@@ -164,18 +164,31 @@ public final class FedEdgeImpl implements FedEdge {
         if (workDir == null) {
             return "";
         }
-        // latest round's task file, matching the server's key=value schema
-        for (int r = 10_000; r >= 0; r--) {
-            Path task = workDir.resolve("round_" + r).resolve("task.txt");
-            if (Files.exists(task)) {
+        // latest round's task file (one readdir, not a stat per round)
+        File[] entries = workDir.toFile().listFiles(
+                (dir, name) -> name.startsWith("round_"));
+        int best = -1;
+        if (entries != null) {
+            for (File e : entries) {
                 try {
-                    return new String(Files.readAllBytes(task));
-                } catch (IOException e) {
-                    return "";
+                    int r = Integer.parseInt(
+                            e.getName().substring("round_".length()));
+                    if (r > best && new File(e, "task.txt").exists()) {
+                        best = r;
+                    }
+                } catch (NumberFormatException ignored) {
                 }
             }
         }
-        return "";
+        if (best < 0) {
+            return "";
+        }
+        try {
+            return new String(Files.readAllBytes(
+                    workDir.resolve("round_" + best).resolve("task.txt")));
+        } catch (IOException e) {
+            return "";
+        }
     }
 
     // -- data --------------------------------------------------------------

@@ -1,0 +1,169 @@
+"""Billion-parameter FedLLM execution probe (VERDICT r2 item 3: the
+flagship had never executed above ~3.4M params).
+
+Runs REAL federated LoRA rounds through the shipped ``FedLLMAPI`` on a
+>=1B-parameter Llama config (bf16 base, fp32 adapters), measuring:
+
+- wall-clock per federated round + tokens/sec + analytic MFU
+  (6 * n_params * tokens / step, over the device peak — nominal for TPU,
+  measured-matmul for CPU);
+- live array bytes (``jax.live_arrays``) vs the closed-form prediction in
+  ``core/memory_estimate.py`` — the estimator must be an UPPER bound that
+  is not wildly loose (checked: actual <= estimate <= 4x actual).
+
+Default config ~1.08B params (dim 2048, 20 layers, GQA 16q/8kv, ffn 5632,
+vocab 32000).  On one CPU core a round is minutes — run detached; on a TPU
+chip it is seconds.  ``--dim``/``--layers``/... override; ``--fast`` is a
+CI-scale smoke (still >1B lookup-bound? no: fast drops to ~120M params).
+
+Usage: python tools/llm_scale_run.py [--rounds 2] [--seq 256] [--fast]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("FEDML_TPU_PLATFORM") is None \
+        and os.environ.get("LLM_SCALE_TPU") is None:
+    # default CPU: the TPU tunnel wedges for hours; set LLM_SCALE_TPU=1 to
+    # let the normal backend probe run (tools/tpu_watchdog.py does)
+    os.environ["FEDML_TPU_PLATFORM"] = "cpu"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=20)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=8)
+    ap.add_argument("--ffn", type=int, default=5632)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--clients-per-round", type=int, default=2)
+    ap.add_argument("--local-steps", type=int, default=2)
+    ap.add_argument("--lora-rank", type=int, default=16)
+    ap.add_argument("--fast", action="store_true",
+                    help="~120M-param smoke for CI")
+    args_cli = ap.parse_args()
+    if args_cli.fast:
+        args_cli.dim, args_cli.layers, args_cli.ffn, args_cli.vocab = \
+            512, 8, 1408, 16000
+        args_cli.seq, args_cli.rounds = 128, 1
+
+    import numpy as np
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod
+    from fedml_tpu.llm.fedllm import FedLLMAPI
+    from fedml_tpu.core.memory_estimate import (FedLLMLayout,
+                                                estimate_fedllm_memory)
+
+    args = load_arguments()
+    args.update(
+        dataset="shakespeare", train_size=args_cli.clients_per_round * 64,
+        test_size=32, seq_len=args_cli.seq, model="llama",
+        llm_dim=args_cli.dim, llm_n_layers=args_cli.layers,
+        llm_n_heads=args_cli.heads, llm_n_kv_heads=args_cli.kv_heads,
+        llm_ffn_dim=args_cli.ffn, llm_max_seq_len=args_cli.seq,
+        client_num_in_total=max(4, args_cli.clients_per_round),
+        client_num_per_round=args_cli.clients_per_round,
+        comm_round=args_cli.rounds, batch_size=1,
+        llm_max_local_steps=args_cli.local_steps,
+        lora_rank=args_cli.lora_rank, learning_rate=1e-4, random_seed=0,
+    )
+    args = fedml_tpu.init(args, should_init_logs=False)
+    # the LM loader caps vocab at the spec; force the big-vocab synthetic
+    args.update(dataset="stackoverflow_nwp")
+    dataset, vocab = data_mod.load(args)
+    # overwrite vocab to the requested size (tokens stay in range: the
+    # synthetic generator draws < spec vocab; clip for safety)
+    dataset.train_x = np.minimum(dataset.train_x, args_cli.vocab - 1)
+    dataset.train_y = np.minimum(dataset.train_y, args_cli.vocab - 1)
+    dataset.test_x = np.minimum(dataset.test_x, args_cli.vocab - 1)
+    dataset.test_y = np.minimum(dataset.test_y, args_cli.vocab - 1)
+    dataset.num_classes = args_cli.vocab
+
+    t0 = time.time()
+    api = FedLLMAPI(args, dataset)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(api.base_params))
+    n_lora = sum(int(np.prod(p.shape))
+                 for p in jax.tree_util.tree_leaves(api.global_lora))
+    init_s = time.time() - t0
+    print(f"# init: {n_params / 1e9:.3f}B base params, {n_lora / 1e6:.2f}M "
+          f"adapter params, {init_s:.1f}s", file=sys.stderr, flush=True)
+
+    # -- run rounds (first includes compile) -------------------------------
+    t0 = time.time()
+    m0 = api.train_one_round(0)
+    jax.tree_util.tree_map(
+        lambda a: np.asarray(a) if hasattr(a, "shape") else a, m0)
+    compile_round_s = time.time() - t0
+    timed = []
+    for r in range(1, args_cli.rounds):
+        t0 = time.time()
+        m = api.train_one_round(r)
+        loss = float(np.asarray(m["train_loss"]))
+        timed.append(time.time() - t0)
+    round_s = min(timed) if timed else compile_round_s
+    tokens_per_round = (args_cli.clients_per_round * args_cli.local_steps
+                        * 1 * args_cli.seq)
+    flops_per_round = 6.0 * n_params * tokens_per_round
+
+    # -- live memory vs estimator ------------------------------------------
+    live = sum(a.nbytes for a in jax.live_arrays())
+    layout = FedLLMLayout(
+        n_params=n_params, n_lora_params=n_lora,
+        n_clients=args_cli.clients_per_round, n_chips=1, model_shards=1,
+        batch_per_client=1, seq_len=args_cli.seq, dim=args_cli.dim,
+        n_layers=args_cli.layers)
+    est = estimate_fedllm_memory(layout)
+
+    from bench import _measured_matmul_peak, _peak_flops
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev) or _measured_matmul_peak()
+
+    result = {
+        "metric": "fedllm_round_wall_clock",
+        "value": round(round_s, 3),
+        "unit": "s/round",
+        "vs_baseline": None,
+        "n_params": n_params,
+        "n_params_b": round(n_params / 1e9, 3),
+        "n_lora_params": n_lora,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "tokens_per_sec": round(tokens_per_round / round_s, 1),
+        "mfu": round(flops_per_round / round_s / peak, 4),
+        "compile_round_s": round(compile_round_s, 1),
+        "init_s": round(init_s, 1),
+        "train_loss": loss if timed else float(np.asarray(m0["train_loss"])),
+        "live_bytes_gib": round(live / 2 ** 30, 3),
+        "estimator_gib": round(est["total_gib"], 3),
+        "estimator_is_upper_bound": bool(est["total"] >= live),
+        "estimator_tightness": round(est["total"] / max(live, 1), 2),
+        "config": {"dim": args_cli.dim, "layers": args_cli.layers,
+                   "heads": args_cli.heads, "kv_heads": args_cli.kv_heads,
+                   "ffn": args_cli.ffn, "vocab": args_cli.vocab,
+                   "seq": args_cli.seq, "lora_rank": args_cli.lora_rank,
+                   "clients_per_round": args_cli.clients_per_round,
+                   "local_steps": args_cli.local_steps, "dtype": "bfloat16"},
+    }
+    print(json.dumps(result))
+    out = os.path.join(REPO, "LLM_SCALE_RUN.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

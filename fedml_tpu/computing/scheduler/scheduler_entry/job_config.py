@@ -24,6 +24,12 @@ class ComputingRequirements:
     maximum_cost_per_hour: str = ""
     resource_type: str = ""
     device_type: str = ""  # "GPU"/"TPU"/"CPU"
+    minimum_num_cpus: int = 0
+    minimum_memory_gb: float = 0.0
+    #: key=value constraints every matched host must carry in its inventory
+    #: tags (region/zone/owner — the reference expresses these through its
+    #: cloud resource_type catalog)
+    tags: Dict[str, str] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ComputingRequirements":
@@ -32,6 +38,10 @@ class ComputingRequirements:
             maximum_cost_per_hour=str(d.get("maximum_cost_per_hour", "") or ""),
             resource_type=str(d.get("resource_type", "") or ""),
             device_type=str(d.get("device_type", "") or ""),
+            minimum_num_cpus=int(d.get("minimum_num_cpus", 0) or 0),
+            minimum_memory_gb=float(d.get("minimum_memory_gb", 0) or 0),
+            tags={str(k): str(v)
+                  for k, v in (d.get("tags", {}) or {}).items()},
         )
 
 

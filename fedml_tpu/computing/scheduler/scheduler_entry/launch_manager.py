@@ -96,7 +96,9 @@ class FedMLLaunchManager:
             num_chips=int(inv.get("num_chips", 0)),
             device_type="CPU" if accel in ("NONE", "") else accel,
             num_cpus=int(inv.get("cpu_count", 1)),
-            mem_bytes=int(inv.get("mem_total_bytes", 0)))
+            mem_bytes=int(inv.get("mem_total_bytes", 0)),
+            tags={str(k): str(v)
+                  for k, v in (inv.get("tags", {}) or {}).items()})
         with self._lock:
             self.pool.register(dev)
         log.info("registered agent %d (%s x%d)", dev.device_id,

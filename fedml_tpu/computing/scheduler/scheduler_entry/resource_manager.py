@@ -62,14 +62,26 @@ class ResourcePool:
 
     def match(self, req: ComputingRequirements,
               num_workers: int = 1) -> Optional[List[DeviceResource]]:
-        """Pick ``num_workers`` devices satisfying the ask, or None."""
+        """Pick ``num_workers`` devices (across every registered host)
+        satisfying the full ask — chips, CPUs, memory, and tag
+        constraints — or None.  The reference delegates this multi-host
+        matching to its cloud backend GPU catalog
+        (``launch_manager.py:417``); here it is explicit over the agents'
+        reported inventories."""
         want_type = req.device_type.upper()
+        min_mem = int(req.minimum_memory_gb * (1 << 30))
         picked: List[DeviceResource] = []
         for res in sorted(self._devices.values(),
                           key=lambda r: -r.chips_free):
             if want_type and want_type != "CPU" and res.device_type != want_type:
                 continue
             if res.chips_free < req.minimum_num_gpus:
+                continue
+            if res.num_cpus < req.minimum_num_cpus:
+                continue
+            if min_mem and res.mem_bytes < min_mem:
+                continue
+            if any(res.tags.get(k) != v for k, v in req.tags.items()):
                 continue
             picked.append(res)
             if len(picked) == num_workers:

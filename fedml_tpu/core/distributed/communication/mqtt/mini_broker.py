@@ -41,6 +41,7 @@ class _Session:
         self.client_id = client_id
         self.subs: List[Tuple[str, int]] = []
         self.queue: List[Tuple[str, bytes, int]] = []  # offline store
+        self.qos2_seen: set = set()  # inbound QoS2 pids mid-handshake
         self.conn: Optional["_Connection"] = None
         self.persistent = False
 
@@ -56,7 +57,6 @@ class _Connection:
         self.alive = True
         self.clean_disconnect = False
         self._pid = 0
-        self._qos2_seen: set = set()
 
     def send(self, data: bytes):
         with self.wlock:
@@ -131,13 +131,15 @@ class _Connection:
                 self.send(make_pid_packet(PUBACK, pid))
             elif qos == 2:
                 self.send(make_pid_packet(PUBREC, pid))
-                if pid in self._qos2_seen:
+                # dedup on the SESSION: a persistent client that reconnects
+                # mid-handshake and retransmits (DUP) must not double-route
+                if pid in self.session.qos2_seen:
                     return
-                self._qos2_seen.add(pid)
+                self.session.qos2_seen.add(pid)
             self.broker.route(topic, payload, qos, retain)
         elif ptype == PUBREL:
             pid, = struct.unpack(">H", body)
-            self._qos2_seen.discard(pid)
+            self.session.qos2_seen.discard(pid)
             self.send(make_pid_packet(PUBCOMP, pid))
         elif ptype in (PUBACK, PUBCOMP):
             pass  # client acks for broker-initiated qos>0 deliveries

@@ -154,20 +154,29 @@ class ChunkedCAStore(ContentAddressedStore):
     _RAW = b"fteb-raw:"
 
     def __init__(self, inner: Optional[ContentAddressedStore] = None,
-                 chunk_size: int = 1 << 20, gateways=()):
+                 chunk_size: int = 1 << 20, gateways=(),
+                 gc_grace_s: float = 600.0):
         self.inner = inner or LocalCAStore()
         self.chunk_size = int(chunk_size)
         self.gateways = list(gateways)
+        self.gc_grace_s = float(gc_grace_s)
         self._pins = set()
+
+    def _leaf_put(self, data: bytes) -> str:
+        if data.startswith((self._MAGIC, self._RAW)):
+            # escape payload bytes that collide with the framing prefixes
+            return self.inner.put(self._RAW + data)
+        return self.inner.put(data)
+
+    @classmethod
+    def _unescape(cls, blob: bytes) -> bytes:
+        return blob[len(cls._RAW):] if blob.startswith(cls._RAW) else blob
 
     # -- chunking ----------------------------------------------------------
     def put(self, data: bytes) -> str:
         if len(data) <= self.chunk_size:
-            if data.startswith((self._MAGIC, self._RAW)):
-                # escape payloads that collide with the manifest magic
-                return self.inner.put(self._RAW + data)
-            return self.inner.put(data)
-        chunks = [self.inner.put(data[i:i + self.chunk_size])
+            return self._leaf_put(data)
+        chunks = [self._leaf_put(data[i:i + self.chunk_size])
                   for i in range(0, len(data), self.chunk_size)]
         manifest = self._MAGIC + json.dumps(
             {"size": len(data), "chunks": chunks}).encode()
@@ -193,25 +202,47 @@ class ChunkedCAStore(ContentAddressedStore):
         if not blob.startswith(self._MAGIC):
             return blob
         meta = json.loads(blob[len(self._MAGIC):])
-        out = b"".join(self._get_raw(c) for c in meta["chunks"])
+        out = b"".join(self._unescape(self._get_raw(c))
+                       for c in meta["chunks"])
         if len(out) != int(meta["size"]):
             raise IOError(f"cid {cid}: reassembled {len(out)} bytes, "
                           f"manifest says {meta['size']}")
         return out
 
     # -- pinning -----------------------------------------------------------
+    def _pin_dir(self) -> Optional[str]:
+        root = getattr(self.inner, "root", None)
+        if root is None:
+            return None
+        d = os.path.join(root, ".pins")
+        os.makedirs(d, exist_ok=True)
+        return d
+
     def pin(self, cid: str):
         self._pins.add(cid)
+        d = self._pin_dir()
+        if d is not None:  # durable: other instances/processes honor it
+            open(os.path.join(d, cid), "w").close()
 
     def unpin(self, cid: str):
         self._pins.discard(cid)
+        d = self._pin_dir()
+        if d is not None:
+            try:
+                os.remove(os.path.join(d, cid))
+            except OSError:
+                pass
 
     def pins(self):
-        return set(self._pins)
+        out = set(self._pins)
+        d = self._pin_dir()
+        if d is not None:
+            out.update(os.listdir(d))
+        return out
 
     def _reachable(self) -> set:
         seen = set()
-        frontier = list(self._pins)
+        frontier = list(self.pins())
         while frontier:
             cid = frontier.pop()
             if cid in seen:
@@ -219,27 +250,40 @@ class ChunkedCAStore(ContentAddressedStore):
             seen.add(cid)
             try:
                 blob = self.inner.get(cid)
+                if blob.startswith(self._MAGIC):
+                    frontier.extend(
+                        json.loads(blob[len(self._MAGIC):])["chunks"])
             except Exception:
-                continue
-            if blob.startswith(self._MAGIC):
-                frontier.extend(
-                    json.loads(blob[len(self._MAGIC):])["chunks"])
+                continue  # missing or non-manifest blob: nothing to walk
         return seen
 
-    def gc(self) -> int:
-        """Delete unpinned local blobs; returns the number removed.  Only
-        meaningful over a LocalCAStore inner (remote stores garbage-collect
-        server-side)."""
+    def gc(self, grace_s: Optional[float] = None) -> int:
+        """Delete unpinned local blobs older than the grace window; returns
+        the number removed.  Only meaningful over a LocalCAStore inner
+        (remote stores garbage-collect server-side).
+
+        Pins are read from the durable ``.pins/`` markers, so every
+        instance sharing the root sees them; the mtime grace window
+        (default ``gc_grace_s``, 10 min) protects blobs another writer put
+        moments ago and has not pinned yet (in-flight federation
+        uploads)."""
+        import time as _time
+
         root = getattr(self.inner, "root", None)
         if root is None:
             return 0
+        grace = self.gc_grace_s if grace_s is None else float(grace_s)
         keep = self._reachable()
+        now = _time.time()
         removed = 0
         for name in os.listdir(root):
-            if name.endswith(".tmp") or name in keep:
+            if name.endswith(".tmp") or name in keep or name == ".pins":
                 continue
+            path = os.path.join(root, name)
             try:
-                os.remove(os.path.join(root, name))
+                if now - os.path.getmtime(path) < grace:
+                    continue
+                os.remove(path)
                 removed += 1
             except OSError:
                 pass

@@ -105,9 +105,11 @@ class OutlierDetectionDefense(BaseDefense):
         self.three_sigma = ThreeSigmaDefense(args)
 
     def defend_before_aggregation(self, raw_list, extra=None):
-        screened = self.cross_round.defend_before_aggregation(raw_list,
-                                                              extra)
-        if len(screened) == len(raw_list):
+        self.cross_round.defend_before_aggregation(raw_list, extra)
+        # the explicit flag list, NOT the returned length: when EVERY
+        # client is flagged the cross-round pass falls back to the full
+        # list, which must still trigger the second phase
+        if not self.cross_round.last_flagged:
             return raw_list  # tripwire silent: no second phase
         return self.three_sigma.defend_before_aggregation(raw_list, extra)
 
@@ -121,10 +123,12 @@ class CrossRoundDefense(BaseDefense):
         super().__init__(args)
         self.threshold = float(getattr(args, "cross_round_threshold", -0.2))
         self._prev = {}
+        self.last_flagged: list = []  # indices flagged in the last call
 
     def defend_before_aggregation(self, raw_list, extra=None):
         vecs, w, template = stack_clients(raw_list)
         keep = []
+        self.last_flagged = []
         for i in range(len(raw_list)):
             v = vecs[i]
             prev = self._prev.get(i)
@@ -136,4 +140,6 @@ class CrossRoundDefense(BaseDefense):
             self._prev[i] = v
             if ok:
                 keep.append(raw_list[i])
+            else:
+                self.last_flagged.append(i)
         return keep or raw_list

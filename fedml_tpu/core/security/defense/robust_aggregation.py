@@ -136,12 +136,16 @@ class GeometricMedianBucketDefense(BaseDefense):
         wp = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
         vb = vp.reshape(k, size, d)
         wb = wp.reshape(k, size)
-        wsum = jnp.maximum(jnp.sum(wb, axis=1, keepdims=True), 1e-12)
+        wtot = jnp.sum(wb, axis=1)                       # (k,)
+        wsum = jnp.maximum(wtot, 1e-12)[:, None]
         means = jnp.sum(vb * (wb / wsum)[..., None], axis=1)  # (k, D)
-        v = jnp.mean(means, axis=0)
+        # a bucket that is ALL padding has zero weight; it must not enter
+        # the median as a phantom client at the origin
+        valid = (wtot > 0).astype(vecs.dtype)            # (k,)
+        v = jnp.einsum("k,kd->d", valid / jnp.sum(valid), means)
         for _ in range(self.iters):
             dist = jnp.sqrt(jnp.sum((means - v[None, :]) ** 2, axis=1))
-            beta = 1.0 / jnp.maximum(dist, 1e-6)
+            beta = valid / jnp.maximum(dist, 1e-6)
             v = jnp.einsum("k,kd->d", beta / jnp.sum(beta), means)
         return tree_unflatten_1d(v, template)
 

@@ -3,6 +3,7 @@ backend (the hermetic version of the reference's run_cross_silo.sh 3-process
 smoke test), plus the same FSM over real gRPC sockets."""
 
 import threading
+import pytest
 
 import numpy as np
 
@@ -211,6 +212,7 @@ def test_async_cross_silo_no_barrier():
     assert result["acc"] > 0.5, result["acc"]
 
 
+@pytest.mark.slow
 def test_decentralized_cross_silo_gossip():
     """Serverless P2P federation: 4 peers, symmetric ring topology, gossip
     averaging — all peers converge toward a consensus model and learn

@@ -2,6 +2,7 @@
 FeTS2021, AutonomousDriving, edge_case_examples)."""
 
 import os
+import pytest
 
 import numpy as np
 
@@ -60,6 +61,7 @@ def test_fets2021_segmentation_masks():
     assert int(ds.train_y.max()) < 4
 
 
+@pytest.mark.slow
 def test_autonomous_driving_trains_with_fedseg():
     import types
     from fedml_tpu.models.base import FlaxModel

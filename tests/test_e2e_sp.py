@@ -102,6 +102,7 @@ def test_per_client_evaluation_fairness():
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_cohort_bucketing_matches_unbucketed():
     """Ragged-cohort bucketing (pow2 step classes, exact aggregate merge)
     must reproduce the single-cohort round: same rng-per-position stream,

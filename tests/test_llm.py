@@ -103,6 +103,7 @@ def test_llama_forward_shapes():
     assert "lora" not in variables  # rank 0 → no adapter collection
 
 
+@pytest.mark.slow
 def test_fedllm_lora_federation():
     import fedml_tpu
     from fedml_tpu import data as data_mod
@@ -148,6 +149,7 @@ def _small_llm_dataset(args):
     return dataset
 
 
+@pytest.mark.slow
 def test_fedllm_mesh_matches_single_device():
     """Mesh regime (client-axis sharded cohort, TP-ruled base) must
     reproduce the single-device LoRA federation numerics."""
@@ -329,6 +331,7 @@ def test_lr_schedule_shapes():
         make_lr_schedule(1e-3, "polynomial", 0, 10)
 
 
+@pytest.mark.slow
 def test_gradient_accumulation_matches_large_batch(tmp_path):
     """accum=2 at half batch must produce the same trained params as one
     full-batch step stream (MultiSteps averages micro-grads; the epoch
@@ -388,6 +391,7 @@ def test_max_steps_budget_enforced(tmp_path):
     trainer.close()
 
 
+@pytest.mark.slow
 def test_hetlora_rank_heterogeneity():
     """Per-client LoRA ranks (HetLoRA-style): homogeneous masks reproduce
     the plain path exactly; truncated clients never touch rank components

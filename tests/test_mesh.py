@@ -2,6 +2,7 @@
 Exit criterion from SURVEY §7: mesh backend produces the same curve as sp."""
 
 import jax
+import pytest
 import numpy as np
 
 import fedml_tpu
@@ -118,6 +119,7 @@ def test_mesh_decentralized_ring_matches_sp_einsum():
         MeshDecentralizedAPI(args, None, ds, model)
 
 
+@pytest.mark.slow
 def test_mesh_hierarchical_matches_sp():
     """Two-level hierarchical FedAvg as ONE shard_map program (groups
     sharded, inner rounds group-local, one psum pair for the global merge)

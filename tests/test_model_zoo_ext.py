@@ -2,6 +2,7 @@
 TurboAggregate, FedSeg/UNet, EfficientNet."""
 
 import types
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,7 @@ def _img_dataset(n=96, hw=8, n_clients=4, n_classes=3, seed=0):
                             client_idxs=idxs, num_classes=n_classes)
 
 
+@pytest.mark.slow
 def test_efficientnet_and_model_hub_entries():
     from fedml_tpu.models import model_hub
     args = types.SimpleNamespace(model="efficientnet", dataset="cifar10")
@@ -57,6 +59,7 @@ def test_efficientnet_and_model_hub_entries():
     assert m.apply(p, jnp.zeros((2, 16, 16, 1))).shape == (2, 16, 16, 3)
 
 
+@pytest.mark.slow
 def test_fedgan_trains():
     from fedml_tpu.simulation.sp.fedgan import FedGANAPI
     rng = np.random.default_rng(0)
@@ -74,6 +77,7 @@ def test_fedgan_trains():
     assert np.all(np.abs(samples) <= 1.0)
 
 
+@pytest.mark.slow
 def test_fednas_search_reports_genotype():
     from fedml_tpu.models.base import FlaxModel
     from fedml_tpu.models.darts import DARTSNetwork, PRIMITIVES
@@ -139,6 +143,7 @@ def test_fedseg_miou_improves():
     assert out["history"][-1]["miou"] > out["history"][0]["miou"]
 
 
+@pytest.mark.slow
 def test_text_transformer_fednlp_learns():
     """The FedNLP 20news-class workload (BASELINE fednlp_20news row):
     federated text classification with the in-repo transformer encoder;
@@ -235,6 +240,7 @@ def test_gcn_federated_graph_classification():
     assert acc > 0.6, acc
 
 
+@pytest.mark.slow
 def test_vgg_hub_entry_and_learns():
     """VGG-GN (reference model/cv/vgg.py) through the standard create
     surface; a few SGD steps separate a 2-class toy problem."""
@@ -351,6 +357,7 @@ def test_vfl_split_models_learn_xor_of_parties():
     assert acc1 > max(acc0, 0.8)
 
 
+@pytest.mark.slow
 def test_model_hub_every_name_creates_and_forwards():
     """Safety net: every name the hub dispatches must create, init, and
     forward (a latent UnboundLocal in one branch once broke model=rnn for

@@ -2,6 +2,7 @@
 reference, aux loss, capacity dropping, and an EP-sharded run on the mesh."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -97,6 +98,7 @@ def test_moe_expert_parallel_on_mesh():
                                rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_llama_with_moe_trains():
     """LlamaLM with n_experts>0: the MoE block slots into the LM and a
     training step produces finite loss + grads (sown aux loss accessible)."""
@@ -126,6 +128,7 @@ def test_llama_with_moe_trains():
     assert params["layer_0"]["moe_mlp"]["w_gate"].shape == (4, 32, 64)
 
 
+@pytest.mark.slow
 def test_llama_moe_ep_engages_under_context_mesh():
     """EP through the MODEL path: under `with mesh:` the ambient-mesh
     constraint inside Block->MoEMLP must fire (not silently no-op) and the

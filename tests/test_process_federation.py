@@ -4,8 +4,10 @@ the reference's ``run_cross_silo.sh`` 3-process smoke test, and the
 integration-level complement of the in-thread tests)."""
 
 import textwrap
+import pytest
 
 
+@pytest.mark.slow
 def test_three_process_federation(tmp_path):
     from fedml_tpu.cross_silo.client.client_launcher import CrossSiloLauncher
 

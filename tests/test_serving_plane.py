@@ -3,6 +3,7 @@
 template (reference ``serving/templates/hf_template/main_openai.py``)."""
 
 import json
+import pytest
 import threading
 import urllib.request
 
@@ -170,6 +171,7 @@ def test_streaming_preserves_multibyte_utf8():
         srv.stop()
 
 
+@pytest.mark.slow
 def test_kv_cache_decode_matches_full_forward():
     """Decode-mode (prefill + cached single-token steps) must reproduce the
     train-mode forward's logits and the full-buffer greedy generation."""

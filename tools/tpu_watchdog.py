@@ -96,6 +96,19 @@ def main():
             timeout_s=3600)
     run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
             timeout_s=3600)
+    env = dict(os.environ)
+    env["LLM_SCALE_TPU"] = "1"  # let the scale probe use the live TPU
+    try:
+        r = subprocess.run([py, "tools/llm_scale_run.py", "--rounds", "3"],
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=3600, env=env)
+        with open(os.path.join(REPO, "TPU_LLM_SCALE.json"), "w") as f:
+            f.write(r.stdout)
+            if r.returncode != 0:
+                f.write(f"\n[stderr tail]\n{r.stderr[-4000:]}")
+        print(f"[watchdog] TPU_LLM_SCALE.json rc={r.returncode}", flush=True)
+    except subprocess.TimeoutExpired:
+        print("[watchdog] llm_scale_run TIMEOUT", flush=True)
     print("[watchdog] battery complete", flush=True)
 
 

@@ -40,6 +40,44 @@ if _plat:
             "FEDML_TPU_PLATFORM=%s ignored (jax backend already "
             "initialized)", _plat)
 
+# Persistent XLA compilation cache: TPU compiles over the tunnel backend run
+# 20-40s+ each and every process pays them again otherwise.  TPU-path only —
+# XLA:CPU AOT cache entries embed compile-machine features and reload with
+# SIGILL warnings on feature mismatch, and CPU compiles are cheap anyway.
+# Opt out with FEDML_TPU_NO_COMPILE_CACHE=1; explicit
+# JAX_COMPILATION_CACHE_DIR wins.
+_jax_plat_env = os.environ.get("JAX_PLATFORMS", "")
+_cpu_only = ((_plat or "").lower() == "cpu"
+             or (_jax_plat_env and all(
+                 p.strip().lower() in ("cpu", "")
+                 for p in _jax_plat_env.split(","))))
+
+
+def _tpu_plugin_present() -> bool:
+    # only enable the persistent cache when a TPU PJRT plugin could actually
+    # serve this process — on plain-CPU hosts the cache would fill with
+    # XLA:CPU AOT entries that embed compile-machine features and reload
+    # with SIGILL warnings on heterogeneous fleets
+    import importlib.util
+    return any(importlib.util.find_spec(m) is not None
+               for m in ("libtpu", "axon", "jax_plugins"))
+
+
+if (not os.environ.get("FEDML_TPU_NO_COMPILE_CACHE") and not _cpu_only
+        and _tpu_plugin_present()):
+    try:
+        import jax as _jax
+
+        _cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "fedml_tpu_xla")
+        os.makedirs(_cache, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache)
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            _jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+
 from . import constants  # noqa: E402
 from .arguments import Arguments, add_args, load_arguments  # noqa: E402
 from .constants import (  # noqa: E402

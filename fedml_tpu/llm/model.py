@@ -282,6 +282,9 @@ def config_from_args(args, vocab: Optional[int] = None) -> LlamaConfig:
     impl = getattr(args, "attn_impl", None)
     if impl:
         overrides["attn_impl"] = str(impl)
+    dt = getattr(args, "model_dtype", None)
+    if dt:
+        overrides["dtype"] = jnp.dtype(str(dt)).type
     n_experts = getattr(args, "n_experts", None)
     if n_experts is not None:
         overrides["n_experts"] = int(n_experts)

@@ -28,7 +28,12 @@ NEG_INF = -1e30
 
 
 def _block_scores(q, k, sm_scale):
-    return jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    # preferred_element_type keeps the MXU's f32 accumulation instead of
+    # rounding the dot back to bf16 — round-3 root cause of the TPU-bf16
+    # gradient NaN (a bf16 score matrix through the transposed scan NaNs;
+    # tools/tpu_blockwise_bisect.py has the ablation table)
+    return jnp.einsum("...qd,...kd->...qk", q, k,
+                      preferred_element_type=jnp.float32) * sm_scale
 
 
 def blockwise_attention(q, k, v, causal: bool = True,
@@ -93,7 +98,8 @@ def blockwise_attention(q, k, v, causal: bool = True,
         p = jnp.exp(scores - m_new[..., None])
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "...qk,...kd->...qd", p.astype(vblk.dtype), vblk).astype(jnp.float32)
+            "...qk,...kd->...qd", p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
         return (m_new, l_new, acc_new, blk + 1), None
 
     m0 = jnp.full((*lead, s_q), NEG_INF, jnp.float32)
@@ -111,7 +117,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       causal: bool, seq_k: int):
     """Grid: (batch*heads, q_blocks, k_blocks); k innermost ("arbitrary").
     Scratch m/l/acc persist across the k dimension for one (bh, qi) pair.
-    Also emits the per-row logsumexp (m + log l) for the backward pass."""
+    Also emits the per-row logsumexp (m + log l) for the backward pass.
+
+    Layout note (Mosaic): per-row stats are kept 2-D ``(block_q, 1)`` and the
+    lse output is ``(bh, s_q, 1)`` blocked ``(1, block_q, 1)`` — a block's
+    last two dims must be (divisible by 8, divisible by 128) or equal the
+    array dims, so a flat ``(bh, s_q)`` lse with ``(1, block_q)`` blocks does
+    not lower on real TPUs (interpret mode never enforces this)."""
     import jax.experimental.pallas as pl
 
     kj = pl.program_id(2)
@@ -120,9 +132,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(kj == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[:] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[:] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
     # causal: a KV block strictly below the diagonal band is fully masked —
     # skip its matmuls entirely (halves the work for causal attention)
@@ -150,19 +162,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             mask = mask & (kv_pos <= q_pos)
         scores = jnp.where(mask, scores, NEG_INF)
 
-        m_prev = m_ref[:]                           # (block_q,)
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+        m_prev = m_ref[:]                           # (block_q, 1)
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(scores, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new[:, None])
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
+        p = jnp.exp(scores - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
     @pl.when(kj == nk - 1)
     def _finalize():
-        l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)                # (block_q, 1)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
@@ -220,15 +233,15 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q,), jnp.float32),
-            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -239,6 +252,7 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
     if return_lse:
         return out, lse.reshape(b, h, s_q)
     return out
+
 
 
 # -- Pallas TPU backward kernels ---------------------------------------------
@@ -270,8 +284,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = jnp.where(kv_rows, k_ref[0], 0.0)
         v = jnp.where(kv_rows, v_ref[0], 0.0)
         do = do_ref[0]
-        lse = lse_ref[0]                            # (block_q,)
-        delta = delta_ref[0]                        # (block_q,)
+        lse = lse_ref[0]                            # (block_q, 1)
+        delta = delta_ref[0]                        # (block_q, 1)
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -280,9 +294,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = kv_pos < seq_k
         if causal:
             mask = mask & (kv_pos <= q_pos)
-        p = jnp.where(mask, jnp.exp(scores - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dq_acc[:] += jnp.dot(ds.astype(k.dtype), k,
                              preferred_element_type=jnp.float32)
 
@@ -320,8 +334,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, 1), 0)) < seq_q
         q = jnp.where(q_rows, q_ref[0], 0.0)
         do = jnp.where(q_rows, do_ref[0], 0.0)
-        lse = jnp.where(q_rows[:, 0], lse_ref[0], 0.0)
-        delta = jnp.where(q_rows[:, 0], delta_ref[0], 0.0)
+        lse = jnp.where(q_rows, lse_ref[0], 0.0)    # (block_q, 1)
+        delta = jnp.where(q_rows, delta_ref[0], 0.0)
         kv_rows = (kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, 1), 0)) < seq_k
         k = jnp.where(kv_rows, k_ref[0], 0.0)
@@ -336,11 +350,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (kv_pos < seq_k) & (q_pos < seq_q)
         if causal:
             mask = mask & (kv_pos <= q_pos)
-        p = jnp.where(mask, jnp.exp(scores - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
         dv_acc[:] += jnp.dot(p.astype(do.dtype).T, do,
                              preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk_acc[:] += jnp.dot(ds.astype(q.dtype).T, q,
                              preferred_element_type=jnp.float32)
 
@@ -375,10 +389,10 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
     kr = k.reshape(b * h_kv, s_k, d)
     vr = v.reshape(b * h_kv, s_k, d)
     dor = do.reshape(b * h, s_q, d)
-    lser = lse.reshape(b * h, s_q)
+    lser = lse.reshape(b * h, s_q, 1)
     # delta = rowsum(dO * O) — cheap elementwise, stays in XLA
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(b * h, s_q)
+                    axis=-1).reshape(b * h, s_q, 1)
     nq = -(-s_q // block_q)
     nk = -(-s_k // block_k)
     kv_row = _kv_head_map(b, h, h_kv)
@@ -389,7 +403,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     k_spec = pl.BlockSpec((1, block_k, d),
                           lambda bh, i, j: (kv_row(bh), j, 0))
-    r_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+    r_spec = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -407,7 +421,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
     qs_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
     ks_spec = pl.BlockSpec((1, block_k, d),
                            lambda bh, j, i: (kv_row(bh), j, 0))
-    rs_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    rs_spec = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common_kv),
         grid=(b * h, nk, nq),

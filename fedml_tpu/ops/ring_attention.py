@@ -55,8 +55,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         p = jnp.exp(scores - m_new[..., None])
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "...qk,...kd->...qd", p.astype(v_cur.dtype), v_cur
-        ).astype(jnp.float32)
+            "...qk,...kd->...qd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32)
         # rotate KV around the ring (device r -> r+1)
         perm = [(j, (j + 1) % n) for j in range(n)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)

@@ -1,0 +1,78 @@
+"""Real-TPU probe for the attention stack.
+
+1. Lower + run the fixed Pallas flash fwd/bwd at bh>1 shapes (the round-3
+   block-spec fix) and check parity against blockwise.
+2. Minimal bf16 NaN bisection INSIDE attention: blockwise grads with
+   rope on/off, f32 vs bf16 qkv, masked-softmax alone.
+
+Run: python tools/tpu_attn_probe.py
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from fedml_tpu.ops.attention import (blockwise_attention, flash_attention,
+                                     flash_attention_fwd_pallas)
+
+
+def gnorm_finite(fn, *args):
+    g = jax.jit(jax.grad(lambda *a: jnp.sum(fn(*a).astype(jnp.float32))))(*args)
+    gn = float(np.asarray(jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                                       for x in jax.tree.leaves(g)))))
+    return np.isfinite(gn), gn
+
+
+def main():
+    print("backend:", jax.default_backend())
+    b, h, kvh, s, d = 2, 8, 4, 512, 64
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, h, s, d), dtype)
+        k = jax.random.normal(ks[1], (b, kvh, s, d), dtype)
+        v = jax.random.normal(ks[2], (b, kvh, s, d), dtype)
+
+        # 1. pallas fwd lowers + parity
+        out, lse = flash_attention_fwd_pallas(q, k, v, True, return_lse=True)
+        ref = blockwise_attention(q, k, v, True)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        print(f"[{dtype.__name__}] pallas fwd max_abs_err vs blockwise: {err:.2e}")
+
+        # 2. full custom-vjp path (pallas fwd + pallas bwd) grads
+        ok, gn = gnorm_finite(lambda q, k, v: flash_attention(q, k, v, True),
+                              q, k, v)
+        print(f"[{dtype.__name__}] pallas fwd+bwd gnorm={gn:.4f} "
+              f"{'ok' if ok else '*** NaN ***'}")
+
+        # 3. blockwise XLA vjp grads
+        ok, gn = gnorm_finite(
+            lambda q, k, v: blockwise_attention(q, k, v, True), q, k, v)
+        print(f"[{dtype.__name__}] blockwise vjp  gnorm={gn:.4f} "
+              f"{'ok' if ok else '*** NaN ***'}")
+
+    # 4. rope ablation, bf16
+    from fedml_tpu.llm.model import _rope
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
+    pos = jnp.arange(s)
+    ok, gn = gnorm_finite(lambda x: _rope(x, pos, 10000.0), x)
+    print(f"[bf16] rope alone gnorm={gn:.4f} {'ok' if ok else '*** NaN ***'}")
+
+    # 5. rope + blockwise
+    q = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(3), (b, kvh, s, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(4), (b, kvh, s, d), jnp.bfloat16)
+    ok, gn = gnorm_finite(
+        lambda q, k, v: blockwise_attention(_rope(q, pos, 10000.0), _rope(k, pos, 10000.0), v,
+                                            True), q, k, v)
+    print(f"[bf16] rope+blockwise gnorm={gn:.4f} {'ok' if ok else '*** NaN ***'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Inner bisect of the TPU-bf16 blockwise-attention gradient NaN.
+
+Variants toggle one suspect at a time; run on a live TPU.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+NEG_INF = -1e30
+
+
+def blockwise(q, k, v, causal, block_k, neg_inf, pet, upcast):
+    """Minimal MHA copy of ops.attention.blockwise_attention with knobs:
+    neg_inf value, preferred_element_type on the score einsum, full-f32
+    upcast of inputs."""
+    if upcast:
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    *lead, s_q, d = q.shape
+    s_k = k.shape[-2]
+    sm_scale = 1.0 / (d ** 0.5)
+    block_k = min(block_k, s_k)
+    n_blocks = s_k // block_k
+    kb = jnp.moveaxis(k.reshape(*lead, n_blocks, block_k, d), -3, 0)
+    vb = jnp.moveaxis(v.reshape(*lead, n_blocks, block_k, d), -3, 0)
+    q_pos = jnp.arange(s_q)
+
+    def scores_of(q, kblk):
+        if pet:
+            return jnp.einsum("...qd,...kd->...qk", q, kblk,
+                              preferred_element_type=jnp.float32) * sm_scale
+        return jnp.einsum("...qd,...kd->...qk",
+                          q, kblk).astype(jnp.float32) * sm_scale
+
+    def body(carry, inp):
+        m, l, acc, blk = carry
+        kblk, vblk = inp
+        scores = scores_of(q, kblk)
+        kv_pos = blk * block_k + jnp.arange(block_k)
+        if causal:
+            valid = kv_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(valid, scores, neg_inf)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "...qk,...kd->...qd", p.astype(vblk.dtype),
+            vblk).astype(jnp.float32)
+        return (m_new, l_new, acc_new, blk + 1), None
+
+    m0 = jnp.full((*lead, s_q), neg_inf, jnp.float32)
+    l0 = jnp.zeros((*lead, s_q), jnp.float32)
+    acc0 = jnp.zeros((*lead, s_q, d), jnp.float32)
+    (m, l, acc, _), _ = jax.lax.scan(body, (m0, l0, acc0, 0), (kb, vb))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def check(name, **kw):
+    b, h, s, d = 2, 8, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+    fn = functools.partial(blockwise, **kw)
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+        argnums=(0, 1, 2)))(q, k, v)
+    gn = float(np.asarray(jnp.sqrt(sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)))
+        for x in jax.tree.leaves(g)))))
+    print(f"{name:44s} gnorm={gn:12.4f} "
+          f"{'ok' if np.isfinite(gn) else '*** NaN ***'}")
+
+
+def main():
+    print("backend:", jax.default_backend())
+    base = dict(causal=True, block_k=256, neg_inf=NEG_INF, pet=False,
+                upcast=False)
+    check("baseline (causal, 2 blocks, -1e30)", **base)
+    check("non-causal", **{**base, "causal": False})
+    check("single k block", **{**base, "block_k": 512})
+    check("neg_inf=-1e9", **{**base, "neg_inf": -1e9})
+    check("neg_inf=-30000 (bf16-safe)", **{**base, "neg_inf": -30000.0})
+    check("preferred_element_type=f32", **{**base, "pet": True})
+    check("full f32 upcast", **{**base, "upcast": True})
+    check("pet + non-causal", **{**base, "pet": True, "causal": False})
+
+
+if __name__ == "__main__":
+    main()

@@ -245,20 +245,20 @@ def bench_torch_reference_style(n_clients: int = 8) -> float:
 
 # -- LLM LoRA single-chip benchmark ------------------------------------------
 def bench_llm_lora(on_accelerator: bool, peak: float | None) -> dict:
-    """Single-chip LoRA fine-tune step on a small Llama (bf16 on TPU):
-    step time, tokens/sec, approximate MFU (6*N*T formula over total params —
-    backward through frozen base weights still pays their activation grads),
-    and the flash-vs-blockwise forward ratio on the same shapes."""
+    """Single-chip LoRA fine-tune step on a Llama (bf16 on TPU): step time,
+    tokens/sec, MFU with LoRA-aware FLOPs ((4*N + 6*r)*T — frozen base
+    weights pay forward + activation-grad matmuls but no weight-grad
+    matmuls), and the flash-vs-blockwise forward ratio on the same shapes."""
     import jax
     import jax.numpy as jnp
     import optax
     from fedml_tpu.llm.model import LlamaConfig, LlamaLM, causal_nll
 
     if on_accelerator:
-        cfg = LlamaConfig(vocab_size=8192, dim=512, n_layers=8, n_heads=8,
-                          n_kv_heads=4, ffn_dim=1408, max_seq_len=512,
+        cfg = LlamaConfig(vocab_size=16384, dim=1024, n_layers=12, n_heads=16,
+                          n_kv_heads=8, ffn_dim=2816, max_seq_len=1024,
                           dtype=jnp.bfloat16, lora_rank=8)
-        batch, seq, steps = 8, 512, 10
+        batch, seq, steps = 4, 1024, 10
     else:  # CPU fallback: small shapes for wall-clock sanity, but the
         # SHIPPED dtype (bf16) so the bench measures the real configuration
         cfg = LlamaConfig(vocab_size=2048, dim=256, n_layers=4, n_heads=8,
@@ -306,15 +306,18 @@ def bench_llm_lora(on_accelerator: bool, peak: float | None) -> dict:
                       rtt=rtt)
 
     tokens_per_step = batch * seq
-    flops = 6.0 * n_params * tokens_per_step  # fwd+bwd dense approx
+    # LoRA training FLOPs: frozen base weights pay forward (2NT) and
+    # activation-gradient (2NT) matmuls but NOT weight-grad matmuls; the
+    # adapters pay the full 6T per param.  (6NT would overstate MFU ~1.5x.)
+    flops = (4.0 * n_params + 6.0 * n_lora) * tokens_per_step
     final_loss = float(np.asarray(state[0][2]))
     out = {
         "step_time_s": round(dt, 5),
         "tokens_per_sec": round(tokens_per_step / dt, 1),
         "n_params": n_params,
         "n_lora_params": n_lora,
-        # timing is dtype-valid regardless; a non-finite loss flags the
-        # open TPU-bf16 gradient issue (tools/tpu_nan_bisect.py)
+        # a non-finite loss would be a regression of the round-3 bf16
+        # accumulation fix (ops/attention.py preferred_element_type)
         "loss_finite": bool(np.isfinite(final_loss)),
         "mfu": round(flops / dt / peak, 4) if peak else None,
         "config": {"dim": cfg.dim, "layers": cfg.n_layers, "seq": seq,

@@ -60,7 +60,7 @@ def _tpu_plugin_present() -> bool:
     # with SIGILL warnings on heterogeneous fleets
     import importlib.util
     return any(importlib.util.find_spec(m) is not None
-               for m in ("libtpu", "axon", "jax_plugins"))
+               for m in ("libtpu", "axon"))
 
 
 if (not os.environ.get("FEDML_TPU_NO_COMPILE_CACHE") and not _cpu_only

@@ -64,6 +64,16 @@ def _probe_backend_subprocess(timeout_s: float) -> bool:
         return True  # probe infrastructure failed: fall through to direct
 
 
+def _disable_compile_cache():
+    """CPU fallback must not write to the persistent compile cache enabled
+    at import (fedml_tpu/__init__): XLA:CPU AOT entries embed this
+    machine's CPU features and reload with SIGILL warnings elsewhere."""
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+
+
 def _backend_already_up() -> bool:
     try:
         from jax._src import xla_bridge
@@ -114,6 +124,7 @@ def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
                 pass
+            _disable_compile_cache()
             devices = jax.devices("cpu")
             BACKEND_NOTE = (f"cpu fallback (accelerator init hung "
                             f">{timeout_s:.0f}s)")
@@ -153,6 +164,7 @@ def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    _disable_compile_cache()
     try:
         devices = jax.devices("cpu")
         BACKEND_NOTE = f"cpu fallback (accelerator init failed: {str(last).splitlines()[-1] if last else last})"

@@ -118,7 +118,9 @@ def main():
     round_s = min(timed) if timed else compile_round_s
     tokens_per_round = (args_cli.clients_per_round * args_cli.local_steps
                         * 1 * args_cli.seq)
-    flops_per_round = 6.0 * n_params * tokens_per_round
+    # LoRA step FLOPs: frozen base = fwd + activation-grad matmuls only
+    # (4NT); adapters pay the full 6T/param (see bench.py rationale)
+    flops_per_round = (4.0 * n_params + 6.0 * n_lora) * tokens_per_round
 
     # -- live memory vs estimator ------------------------------------------
     live = sum(a.nbytes for a in jax.live_arrays())

@@ -188,9 +188,11 @@ def test_qos2_exactly_once_under_duplicate_publish(broker):
 
     pub = mm.Client(client_id="pub")
     pub.connect("127.0.0.1", broker.port)
-    pub.loop_start()
-    # raw duplicate PUBLISH with the same pid before PUBREL (QoS-2 resend):
-    # broker must route it exactly once
+    # NO loop_start: the client loop would auto-answer the broker's PUBREC
+    # with PUBREL, completing the handshake and legitimately freeing pid 42
+    # for reuse — racing this test's raw duplicate (observed flake under
+    # CPU load).  Without the loop, the duplicate is guaranteed to arrive
+    # before any PUBREL, which is the QoS-2 resend case under test.
     pkt = mm.make_publish("once", b"x", qos=2, retain=False, pid=42)
     pub._send(pkt)
     pub._send(mm.make_publish("once", b"x", qos=2, retain=False, pid=42,

@@ -300,6 +300,21 @@ def _sizes(args, train_n: int, test_n: int,
             int(getattr(args, "test_size", 0) or test_n))
 
 
+def _sklearn_tabular(name: str, seed: int):
+    """Seed-permuted raw sklearn tabular pool: (x, y, classes, src_name).
+    Class count is computed on the FULL pool (pre-slice); normalization is
+    left to the caller so train-only stats are possible."""
+    from sklearn.datasets import load_breast_cancer, load_wine
+    d = load_wine() if name == "wine" else load_breast_cancer()
+    x = d.data.astype(np.float32)
+    y = d.target.astype(np.int64)
+    classes = int(y.max()) + 1
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(x))
+    return x[perm], y[perm], classes, (
+        "wine" if name == "wine" else "breast-cancer")
+
+
 def load(args) -> Tuple[FederatedDataset, int]:
     name = str(getattr(args, "dataset", "synthetic_mnist")).lower()
     cache = str(getattr(args, "data_cache_dir", "") or "")
@@ -521,6 +536,23 @@ def load(args) -> Tuple[FederatedDataset, int]:
                             np.int64)
         return ds, classes
 
+    if name in ("breast_cancer", "wine", "uci_real"):
+        # REAL tabular bytes without egress (sklearn built-ins) — stand-ins
+        # for the reference's UCI/lending_club tabular rows (which need
+        # downloads): breast_cancer 569x30 2-class, wine 178x13 3-class.
+        x, y, classes, src = _sklearn_tabular(name, seed)
+        # the pool is FIXED size: clamp any requested train_size so the
+        # test split never goes empty, and fit normalization on train only
+        cut = int(getattr(args, "train_size", 0)) or int(len(x) * 0.85)
+        cut = min(cut, len(x) - max(1, len(x) // 10))
+        mu, sd = x[:cut].mean(0), x[:cut].std(0)
+        x = (x - mu) / (sd + 1e-8)
+        tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]
+        ds = build_federated(tx, ty, vx, vy, classes, client_num, method,
+                             alpha, seed,
+                             provenance=f"real:sklearn-{src}")
+        return ds, classes
+
     if name == "digits":
         # REAL data available without egress: sklearn's handwritten-digits
         # set (1797 8x8 grayscale images, 10 classes) — the in-image stand-in
@@ -534,7 +566,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         perm = rng.permutation(len(x))
         x, y = x[perm], y[perm]
         cut = int(getattr(args, "train_size", 0)) or int(len(x) * 0.85)
-        tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]
+        cut = min(cut, len(x) - max(1, len(x) // 10))  # fixed pool: never
+        tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]  # empty test
         ds = build_federated(tx, ty, vx, vy, 10, client_num, method, alpha,
                              seed, provenance="real:sklearn-digits")
         return ds, 10
@@ -560,6 +593,19 @@ def load_vertical(args):
     parties = int(getattr(args, "vfl_parties", 2))
     seed = int(getattr(args, "random_seed", 0))
     n = int(getattr(args, "train_size", 4000))
+    if name in ("breast_cancer", "wine", "uci_real"):
+        # REAL vertical split: sklearn tabular features divided contiguously
+        # across parties (the classical-VFL setting on real bytes).  Class
+        # count comes from the full pool (a small train_size slice may miss
+        # a class); normalization is over the returned slice — callers that
+        # re-split should treat the stats as jointly computed (the usual
+        # VFL preprocessing assumption).
+        x, labels, classes, _ = _sklearn_tabular(name, seed)
+        x, labels = x[:n], labels[:n]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        splits = np.array_split(np.arange(x.shape[1]), parties)
+        feats = [x[:, idx] for idx in splits]
+        return feats, labels, classes
     if name in ("nus_wide", "nuswide"):
         # reference split: party A 634 image features, party B 1000 text tags
         fpp = [634, 1000][:parties] if parties <= 2 else [634, 1000] + \

@@ -300,6 +300,13 @@ def _sizes(args, train_n: int, test_n: int,
             int(getattr(args, "test_size", 0) or test_n))
 
 
+def _clamped_cut(args, n: int) -> int:
+    """Train/test split point for a FIXED-size real pool: honor train_size
+    but never let the test split go empty."""
+    cut = int(getattr(args, "train_size", 0)) or int(n * 0.85)
+    return min(cut, n - max(1, n // 10))
+
+
 def _sklearn_tabular(name: str, seed: int):
     """Seed-permuted raw sklearn tabular pool: (x, y, classes, src_name).
     Class count is computed on the FULL pool (pre-slice); normalization is
@@ -541,10 +548,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         # for the reference's UCI/lending_club tabular rows (which need
         # downloads): breast_cancer 569x30 2-class, wine 178x13 3-class.
         x, y, classes, src = _sklearn_tabular(name, seed)
-        # the pool is FIXED size: clamp any requested train_size so the
-        # test split never goes empty, and fit normalization on train only
-        cut = int(getattr(args, "train_size", 0)) or int(len(x) * 0.85)
-        cut = min(cut, len(x) - max(1, len(x) // 10))
+        cut = _clamped_cut(args, len(x))
+        # normalization stats from the train split only (no test leakage)
         mu, sd = x[:cut].mean(0), x[:cut].std(0)
         x = (x - mu) / (sd + 1e-8)
         tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]
@@ -565,9 +570,8 @@ def load(args) -> Tuple[FederatedDataset, int]:
         rng = np.random.default_rng(seed)
         perm = rng.permutation(len(x))
         x, y = x[perm], y[perm]
-        cut = int(getattr(args, "train_size", 0)) or int(len(x) * 0.85)
-        cut = min(cut, len(x) - max(1, len(x) // 10))  # fixed pool: never
-        tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]  # empty test
+        cut = _clamped_cut(args, len(x))
+        tx, ty, vx, vy = x[:cut], y[:cut], x[cut:], y[cut:]
         ds = build_federated(tx, ty, vx, vy, 10, client_num, method, alpha,
                              seed, provenance="real:sklearn-digits")
         return ds, 10

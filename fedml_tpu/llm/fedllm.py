@@ -140,9 +140,21 @@ class FedLLMAPI:
         model, tx = self.model, self.tx
         alpha_steps = self.max_steps
 
-        def loss_fn(lora, base, x, y):
-            logits = model.apply({"params": base, "lora": lora}, x)
-            return causal_nll(logits, y)
+        chunk = int(getattr(self.cfg, "streaming_xent_chunk", 0) or 0)
+        # chunk > vocab would PAD the head matmul up to the chunk width
+        # (32x the work for a 256-vocab model at the tooling default 8192)
+        chunk = min(chunk, self.cfg.vocab_size)
+        if chunk:
+            from fedml_tpu.ops.xent import streaming_xent
+
+            def loss_fn(lora, base, x, y):
+                h = model.apply({"params": base, "lora": lora}, x,
+                                return_hidden=True)
+                return streaming_xent(h, base["lm_head"]["kernel"], y, chunk)
+        else:
+            def loss_fn(lora, base, x, y):
+                logits = model.apply({"params": base, "lora": lora}, x)
+                return causal_nll(logits, y)
 
         def local_train(lora0, base, xb, yb, mask, rank_vec):
             # heterogeneous ranks (HetLoRA-style): a rank-r client receives

@@ -53,6 +53,10 @@ class LlamaConfig:
     #: (llm/moe.py — EP has no reference counterpart, SURVEY §2.9).
     n_experts: int = 0
     moe_top_k: int = 2
+    #: >0 fuses the lm_head matmul into a vocab-chunked streaming softmax
+    #: cross-entropy on the training path (ops/xent.py) — peak activation
+    #: memory O(B*S*chunk) instead of the O(B*S*V) logit tensor.
+    streaming_xent_chunk: int = 0
 
 
 TINY = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
@@ -242,12 +246,14 @@ class LlamaLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
-                 start_pos=None):
+                 start_pos=None, return_hidden: bool = False):
         """``decode=True`` switches attention to the KV-cached path: the
         flax "cache" collection must be mutable in ``apply``, and
         ``start_pos`` (scalar int array) gives the sequence position of
         ``tokens[:, 0]`` — the caller owns position bookkeeping so the
-        jitted single-token step stays stateless."""
+        jitted single-token step stays stateless.  ``return_hidden=True``
+        returns final-norm hidden states without the lm_head projection
+        (the streaming cross-entropy path)."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      name="tok_embed")(tokens)
@@ -260,6 +266,12 @@ class LlamaLM(nn.Module):
                 cfg, name=f"layer_{i}")
             x = block(x, positions, decode)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if return_hidden:
+            # streaming cross-entropy path (ops/xent.py): the caller fuses
+            # the lm_head matmul into a vocab-chunked loss instead of
+            # materializing (B, S, V) logits.  Only valid under apply —
+            # init must run the default path so lm_head params exist.
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)
         return logits
@@ -285,6 +297,9 @@ def config_from_args(args, vocab: Optional[int] = None) -> LlamaConfig:
     dt = getattr(args, "model_dtype", None)
     if dt:
         overrides["dtype"] = jnp.dtype(str(dt)).type
+    sx = getattr(args, "streaming_xent_chunk", None)
+    if sx is not None:
+        overrides["streaming_xent_chunk"] = int(sx)
     n_experts = getattr(args, "n_experts", None)
     if n_experts is not None:
         overrides["n_experts"] = int(n_experts)

@@ -1,0 +1,133 @@
+"""Streaming (vocab-chunked) softmax cross-entropy.
+
+At LLM scale the (B, S, V) logit tensor is the dominant activation: for
+V=32k, S=4096, f32 it is 512 MiB per batch row, and the standard
+``log_softmax → take_along_axis`` path keeps it alive for the backward.
+The reference inherits this cost from HF's ``CausalLMOutput`` logits
+(``/root/reference/python/fedml/train/llm/hf_trainer.py`` path); here the
+head matmul and the loss are FUSED: logits are produced vocab-chunk by
+vocab-chunk inside a ``lax.scan`` (running max / log-sum-exp / target
+gather), so peak memory is O(B·S·chunk), and the backward recomputes each
+chunk's logits instead of storing them (same FLOPs-for-HBM trade as
+``jax.checkpoint``, but shaped to the vocab axis).
+
+Numerics match the dense path to f32 precision: the softmax statistics are
+carried in f32 regardless of the compute dtype, and the chunk matmuls
+request f32 accumulation (``preferred_element_type`` — same rationale as
+ops/attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def streaming_xent(h, w, targets, chunk: int = 4096):
+    """Mean token NLL of ``softmax(h @ w)`` against ``targets`` without
+    materializing the full logit tensor.
+
+    h: (..., D) hidden states; w: (D, V) head weights (no bias — matches
+    LlamaLM's lm_head); targets: (...) int labels in [0, V).
+    ``chunk`` must be a static Python int; V is zero-padded up to a chunk
+    multiple internally and the padded columns are masked out of the
+    softmax statistics.
+    """
+    nll, _ = _streaming_fwd(h, w, targets, chunk)
+    return nll
+
+
+def _lse_and_target(h2, w, t2, chunk):
+    d, v = w.shape
+    n_chunks = -(-v // chunk)
+    pad = n_chunks * chunk - v
+
+    def body(carry, i):
+        m_run, s_run, tl_run = carry
+        base = i * chunk
+        # dynamic_slice over a zero-padded weight view keeps shapes static
+        wc = jax.lax.dynamic_slice(
+            jnp.pad(w, ((0, 0), (0, pad))) if pad else w,
+            (0, base), (d, chunk))
+        if pad:
+            # padded columns: force their logits out of the running stats
+            col = base + jnp.arange(chunk)
+            valid = (col < v).astype(jnp.float32)
+        else:
+            valid = None
+        logits = jnp.einsum("nd,dv->nv", h2, wc,
+                            preferred_element_type=jnp.float32)
+        if valid is not None:
+            logits = jnp.where(valid[None, :] > 0, logits, -1e30)
+        m_c = jnp.max(logits, axis=-1)
+        s_c = jnp.sum(jnp.exp(logits - m_c[:, None]), axis=-1)
+        idx = t2 - base
+        in_chunk = (idx >= 0) & (idx < chunk)
+        tl = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        tl_run = tl_run + jnp.where(in_chunk, tl, 0.0)
+        m_new = jnp.maximum(m_run, m_c)
+        s_run = s_run * jnp.exp(m_run - m_new) + s_c * jnp.exp(m_c - m_new)
+        return (m_new, s_run, tl_run), None
+
+    n = h2.shape[0]
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    (m, s, tl), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    return lse, tl
+
+
+def _streaming_fwd(h, w, targets, chunk):
+    lead = h.shape[:-1]
+    d = h.shape[-1]
+    h2 = h.reshape(-1, d)
+    t2 = targets.reshape(-1)
+    lse, tl = _lse_and_target(h2, w, t2, chunk)
+    nll = jnp.mean(lse - tl)
+    return nll, (h, w, targets, lse.reshape(lead))
+
+
+def _streaming_bwd(chunk, res, g):
+    h, w, targets, lse = res
+    d = h.shape[-1]
+    v = w.shape[1]
+    h2 = h.reshape(-1, d)
+    t2 = targets.reshape(-1)
+    lse2 = lse.reshape(-1)
+    n_tok = h2.shape[0]
+    n_chunks = -(-v // chunk)
+    pad = n_chunks * chunk - v
+    wp = jnp.pad(w, ((0, 0), (0, pad))) if pad else w
+    scale = g / n_tok  # d(mean)/d(per-token terms)
+
+    def body(carry, i):
+        dh_run, = carry
+        base = i * chunk
+        wc = jax.lax.dynamic_slice(wp, (0, base), (d, chunk))
+        logits = jnp.einsum("nd,dv->nv", h2, wc,
+                            preferred_element_type=jnp.float32)
+        col = base + jnp.arange(chunk)
+        p = jnp.exp(logits - lse2[:, None])               # softmax chunk
+        if pad:
+            p = jnp.where((col < v)[None, :], p, 0.0)
+        onehot = (t2[:, None] == col[None, :]).astype(jnp.float32)
+        dlogits = (p - onehot) * scale                    # (N, chunk) f32
+        dh_run = dh_run + jnp.einsum(
+            "nv,dv->nd", dlogits, wc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        dwc = jnp.einsum("nd,nv->dv", h2.astype(jnp.float32), dlogits,
+                         preferred_element_type=jnp.float32)
+        return (dh_run,), dwc
+
+    (dh2,), dwp = jax.lax.scan(
+        body, (jnp.zeros((n_tok, d), jnp.float32),), jnp.arange(n_chunks))
+    # dwp: (n_chunks, d, chunk) → (d, n_chunks*chunk) → trim pad
+    dw = jnp.moveaxis(dwp, 0, 1).reshape(d, n_chunks * chunk)[:, :v]
+    return (dh2.reshape(h.shape).astype(h.dtype), dw.astype(w.dtype), None)
+
+
+streaming_xent.defvjp(_streaming_fwd, _streaming_bwd)

@@ -477,3 +477,33 @@ def test_fedllm_per_client_eval_fairness():
     np.testing.assert_allclose(rep1["nll_mean"],
                                rep1["per_client_nll"].mean(), rtol=1e-6)
     assert rep1["nll_mean"] <= rep1["nll_p90"] <= rep1["nll_max"] + 1e-9
+
+
+def test_fedllm_streaming_xent_matches_dense_loss():
+    """streaming_xent_chunk swaps the training loss to the fused
+    vocab-chunked path (ops/xent.py) — round losses must match the dense
+    logits path to f32 tolerance (identical data/seed/schedule)."""
+    import fedml_tpu
+    from fedml_tpu import data as data_mod
+    from fedml_tpu.core.data.noniid_partition import partition
+    from fedml_tpu.llm.fedllm import FedLLMAPI
+
+    losses = {}
+    for chunk in (0, 64):
+        args = fedml_tpu.init(_llm_args(streaming_xent_chunk=chunk,
+                                        comm_round=2))
+        dataset, _ = data_mod.load(args)
+        dataset.train_x, dataset.train_y = (dataset.train_x[:300],
+                                            dataset.train_y[:300])
+        dataset.test_x, dataset.test_y = (dataset.test_x[:60],
+                                          dataset.test_y[:60])
+        dataset.client_idxs = partition(dataset.train_y[:, 0], 6, "homo",
+                                        0.5, 0)
+        api = FedLLMAPI(args, dataset)
+        m0 = api.train_one_round(0)
+        m1 = api.train_one_round(1)
+        losses[chunk] = (float(m0["train_loss"]), float(m1["train_loss"]))
+    d0, s0 = losses[0][0], losses[64][0]
+    d1, s1 = losses[0][1], losses[64][1]
+    assert abs(d0 - s0) < 5e-3 * max(1.0, abs(d0)), (d0, s0)
+    assert abs(d1 - s1) < 5e-3 * max(1.0, abs(d1)), (d1, s1)

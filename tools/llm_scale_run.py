@@ -4,9 +4,9 @@ flagship had never executed above ~3.4M params).
 Runs REAL federated LoRA rounds through the shipped ``FedLLMAPI`` on a
 >=1B-parameter Llama config (bf16 base, fp32 adapters), measuring:
 
-- wall-clock per federated round + tokens/sec + analytic MFU
-  (6 * n_params * tokens / step, over the device peak — nominal for TPU,
-  measured-matmul for CPU);
+- wall-clock per federated round + tokens/sec + analytic MFU with
+  LoRA-aware FLOPs ((4*N + 6*r)*T over the device peak — nominal for TPU,
+  measured-matmul for CPU; see bench.py rationale);
 - live array bytes (``jax.live_arrays``) vs the closed-form prediction in
   ``core/memory_estimate.py`` — the estimator must be an UPPER bound that
   is not wildly loose (checked: actual <= estimate <= 4x actual).
@@ -17,6 +17,7 @@ chip it is seconds.  ``--dim``/``--layers``/... override; ``--fast`` is a
 CI-scale smoke (still >1B lookup-bound? no: fast drops to ~120M params).
 
 Usage: python tools/llm_scale_run.py [--rounds 2] [--seq 256] [--fast]
+       python tools/llm_scale_run.py --layer7b   # true-7B per-layer bench
 """
 
 from __future__ import annotations
@@ -37,6 +38,91 @@ if os.environ.get("FEDML_TPU_PLATFORM") is None \
     os.environ["FEDML_TPU_PLATFORM"] = "cpu"
 
 
+def layer7b_bench(args_cli):
+    """One Llama-2-7B transformer layer (true 7B dims), LoRA step: measures
+    the per-layer cost a 7B fine-tune pays 32x per step.  Fits one v5e chip
+    (layer params 202M bf16 = 0.4 GiB) where the full 7B (13.5 GiB weights
+    + activations) does not leave room for benching."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import fedml_tpu  # noqa: F401  (backend + compile-cache setup)
+    from fedml_tpu.llm.model import Block, LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=32000, dim=4096, n_layers=1, n_heads=32,
+                      n_kv_heads=32, ffn_dim=11008,
+                      max_seq_len=args_cli.seq, dtype=jnp.bfloat16,
+                      lora_rank=args_cli.lora_rank)
+    batch, seq = 1, args_cli.seq
+    block = Block(cfg)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (batch, seq, cfg.dim), jnp.bfloat16)
+    positions = jnp.arange(seq)
+    variables = block.init(key, x, positions)
+    params, lora = variables["params"], variables.get("lora", {})
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    n_lora = sum(int(np.prod(p.shape))
+                 for p in jax.tree_util.tree_leaves(lora))
+    tx = optax.sgd(1e-3)
+    opt = tx.init(lora)
+
+    def loss_fn(lora, x):
+        out = block.apply({"params": params, "lora": lora}, x, positions)
+        return jnp.mean(jnp.square(out.astype(jnp.float32)))
+
+    @jax.jit
+    def step(lora, opt, x):
+        loss, g = jax.value_and_grad(loss_fn)(lora, x)
+        upd, opt = tx.update(g, opt)
+        return optax.apply_updates(lora, upd), opt, loss
+
+    from bench import _measured_matmul_peak, _peak_flops, _readback, \
+        _timed_chain, measure_rtt
+    state = [step(lora, opt, x)]
+    _readback(state[0][2])
+    rtt = measure_rtt()
+
+    def run_n(k):
+        lo, op, _ = state[0]
+        for _ in range(k):
+            lo, op, loss = step(lo, op, x)
+        state[0] = (lo, op, loss)
+
+    dt = _timed_chain(run_n, lambda: _readback(state[0][2]), n0=5, rtt=rtt)
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev) or _measured_matmul_peak()
+    tokens = batch * seq
+    flops = (4.0 * n_params + 6.0 * n_lora) * tokens
+    result = {
+        "metric": "llama7b_layer_step",
+        "value": round(dt, 5),
+        "unit": "s/layer-step",
+        "vs_baseline": None,
+        "n_layer_params": n_params,
+        "n_lora_params": n_lora,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "mfu": round(flops / dt / peak, 4),
+        "tokens_per_sec_layer": round(tokens / dt, 1),
+        "extrapolated_32layer_stack_step_s": round(dt * 32, 3),
+        "extrapolated_32layer_stack_tokens_per_sec": round(
+            tokens / (dt * 32), 1),
+        "note": ("transformer stack only: tok_embed + lm_head "
+                 "(2 x 32000 x 4096 = 262M params, ~1.3 layer-equivalents "
+                 "of matmul for the head) are excluded from the x32 "
+                 "extrapolation"),
+        "config": {"dim": 4096, "ffn": 11008, "heads": 32, "seq": seq,
+                   "batch": batch, "lora_rank": args_cli.lora_rank,
+                   "dtype": "bfloat16"},
+    }
+    print(json.dumps(result))
+    with open(os.path.join(REPO, "LLM_7B_LAYER.json"), "w") as f:
+        json.dump(result, f, indent=1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dim", type=int, default=2048)
@@ -50,9 +136,19 @@ def main():
     ap.add_argument("--clients-per-round", type=int, default=2)
     ap.add_argument("--local-steps", type=int, default=2)
     ap.add_argument("--lora-rank", type=int, default=16)
+    ap.add_argument("--xent-chunk", type=int, default=8192,
+                    help="vocab chunk for the streaming fused cross-entropy "
+                         "(ops/xent.py); 0 = dense logits path")
     ap.add_argument("--fast", action="store_true",
                     help="~120M-param smoke for CI")
+    ap.add_argument("--layer7b", action="store_true",
+                    help="single-layer microbench at Llama-2-7B dims "
+                         "(dim 4096, ffn 11008, 32q/32kv heads): per-layer "
+                         "fwd+bwd step time and MFU, extrapolated x32 — "
+                         "the 7B per-layer evidence one 16GiB chip allows")
     args_cli = ap.parse_args()
+    if args_cli.layer7b:
+        return layer7b_bench(args_cli)
     if args_cli.fast:
         args_cli.dim, args_cli.layers, args_cli.ffn, args_cli.vocab = \
             512, 8, 1408, 16000
@@ -80,6 +176,7 @@ def main():
         comm_round=args_cli.rounds, batch_size=1,
         llm_max_local_steps=args_cli.local_steps,
         lora_rank=args_cli.lora_rank, learning_rate=1e-4, random_seed=0,
+        streaming_xent_chunk=args_cli.xent_chunk,
     )
     args = fedml_tpu.init(args, should_init_logs=False)
     # the LM loader caps vocab at the spec; force the big-vocab synthetic
@@ -159,7 +256,8 @@ def main():
                    "ffn": args_cli.ffn, "vocab": args_cli.vocab,
                    "seq": args_cli.seq, "lora_rank": args_cli.lora_rank,
                    "clients_per_round": args_cli.clients_per_round,
-                   "local_steps": args_cli.local_steps, "dtype": "bfloat16"},
+                   "local_steps": args_cli.local_steps, "dtype": "bfloat16",
+                   "streaming_xent_chunk": args_cli.xent_chunk},
     }
     print(json.dumps(result))
     out = os.path.join(REPO, "LLM_SCALE_RUN.json")

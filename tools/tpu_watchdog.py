@@ -97,18 +97,27 @@ def main():
     run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
             timeout_s=3600)
     env = dict(os.environ)
-    env["LLM_SCALE_TPU"] = "1"  # let the scale probe use the live TPU
-    try:
-        r = subprocess.run([py, "tools/llm_scale_run.py", "--rounds", "3"],
-                           cwd=REPO, capture_output=True, text=True,
-                           timeout=3600, env=env)
-        with open(os.path.join(REPO, "TPU_LLM_SCALE.json"), "w") as f:
-            f.write(r.stdout)
-            if r.returncode != 0:
-                f.write(f"\n[stderr tail]\n{r.stderr[-4000:]}")
-        print(f"[watchdog] TPU_LLM_SCALE.json rc={r.returncode}", flush=True)
-    except subprocess.TimeoutExpired:
-        print("[watchdog] llm_scale_run TIMEOUT", flush=True)
+    env["LLM_SCALE_TPU"] = "1"  # let the scale probes use the live TPU
+    for cmd, out in ((["tools/llm_scale_run.py", "--rounds", "3"],
+                      "TPU_LLM_SCALE.json"),
+                     (["tools/llm_scale_run.py", "--layer7b",
+                       "--seq", "2048"], "TPU_LLM_7B_LAYER.json")):
+        try:
+            r = subprocess.run([py] + cmd, cwd=REPO, capture_output=True,
+                               text=True, timeout=3600, env=env)
+            with open(os.path.join(REPO, out), "w") as f:
+                f.write(r.stdout)
+                if r.returncode != 0:
+                    f.write(f"\n[stderr tail]\n{r.stderr[-4000:]}")
+            print(f"[watchdog] {out} rc={r.returncode}", flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"[watchdog] {cmd} TIMEOUT", flush=True)
+            # overwrite so a stale previous result can't masquerade as
+            # this run's output (same rule as run_job above)
+            with open(os.path.join(REPO, out), "w") as f:
+                f.write(json.dumps({"metric": "watchdog_timeout",
+                                    "value": None, "unit": None,
+                                    "vs_baseline": None, "cmd": cmd}))
     print("[watchdog] battery complete", flush=True)
 
 

@@ -64,8 +64,13 @@ def quantize_params_int8(params, min_size: int = 1024,
         q = np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
         q_bytes[0] += q.nbytes + scale.nbytes
         # arrays only (the marker int is hashable aux-safe): the payload
-        # must be a valid jit argument so dequant can run inside the trace
-        return {_QLEAF: 1, "q": q, "scale": scale.astype(np.float32)}
+        # must be a valid jit argument so dequant can run inside the trace.
+        # The leaves are committed to device (jnp) — numpy leaves would be
+        # re-uploaded host->device on EVERY jitted decode step, which turns
+        # the int8 path from a bandwidth win into a transfer bottleneck
+        # (observed 44x decode slowdown on the tunnel-attached TPU).
+        return {_QLEAF: 1, "q": jnp.asarray(q),
+                "scale": jnp.asarray(scale, jnp.float32)}
 
     qtree = jax.tree_util.tree_map(quant, params)
     stats = {"dense_bytes": dense_bytes[0], "quantized_bytes": q_bytes[0],

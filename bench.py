@@ -420,9 +420,14 @@ def attn_sweep() -> dict:
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     cases = []
+    # f32 tolerance is platform-dependent: TPU MXU computes f32 dots via
+    # bf16 passes by default (jax default matmul precision), so two
+    # differently-blocked softmax-attention implementations legitimately
+    # diverge by ~1e-3 in f32 on TPU while agreeing to 2e-5 on CPU.
+    f32_tol = 2e-3 if on_tpu else 2e-5
     for s in (512, 2048, 4096):
         for causal in (True, False):
-            for dtype, tol in ((jnp.float32, 2e-5), (jnp.bfloat16, 2e-2)):
+            for dtype, tol in ((jnp.float32, f32_tol), (jnp.bfloat16, 2e-2)):
                 for h, kvh in ((8, 8), (8, 2)):  # MHA and GQA-repeated layout
                     b, d = 1, 128
                     ks = jax.random.split(jax.random.PRNGKey(s + h), 3)
@@ -508,8 +513,15 @@ def serve_bench(on_accelerator: bool) -> dict:
         "int8_weight_bytes_ratio": round(qstats["ratio"], 3),
     }
 
-    for name, p in (("batched_tok_s", params), ("batched_int8_tok_s", qtree)):
-        engine = ContinuousBatchingEngine(model, p, slots=slots, buf_len=buf)
+    # horizon>1 amortizes per-token host dispatch (dominant over a
+    # network-attached TPU) by scanning H decode steps on-device per tick
+    horizon = 16 if on_accelerator else 8
+    for name, p, h in (("batched_tok_s", params, 1),
+                       ("batched_int8_tok_s", qtree, 1),
+                       (f"batched_h{horizon}_tok_s", params, horizon),
+                       (f"batched_h{horizon}_int8_tok_s", qtree, horizon)):
+        engine = ContinuousBatchingEngine(model, p, slots=slots, buf_len=buf,
+                                          horizon=h)
         try:
             engine.generate(prompt, max_new_tokens=2)  # compile
             t0 = time.perf_counter()
@@ -529,12 +541,13 @@ def main():
     if "--serve" in sys.argv:
         info = _platform_info(measure_peak=False)
         result = serve_bench(info["platform"] not in ("cpu",))
+        best_batched = max(v for k, v in result.items()
+                           if k.startswith("batched") and "int8" not in k)
         result.update({
             "metric": "serving_decode_tokens_per_sec",
-            "value": result["batched_tok_s"],
+            "value": best_batched,
             "unit": "tok/s_aggregate_4slots",
-            "vs_baseline": (round(result["batched_tok_s"]
-                                  / result["plain_tok_s"], 2)
+            "vs_baseline": (round(best_batched / result["plain_tok_s"], 2)
                             if result.get("plain_tok_s") else None),
             **{k: info[k] for k in ("platform", "device_kind",
                                     "backend_note")},

@@ -192,9 +192,27 @@ def _kv_head_map(b: int, h: int, h_kv: int):
     return kv_row
 
 
+# Tile sizes measured on TPU v5e (tools/tpu_flash_tune.py, readback-forced
+# timing per BASELINE.md methodology).  The old fixed 512/512 tile ran the
+# bench LLM shape at 0.71x the XLA blockwise scan; (256, 1024) flips it to
+# 2.4x.  Keyed by (seq_k, head_dim); callers that pass explicit blocks
+# bypass the table.
+_TUNED_BLOCKS = {
+    (1024, 64): (256, 1024),
+}
+_DEFAULT_BLOCKS = (256, 1024)
+
+
+def _pick_blocks(s_k: int, d: int, block_q, block_k):
+    tq, tk = _TUNED_BLOCKS.get((s_k, d), _DEFAULT_BLOCKS)
+    return (tq if block_q is None else block_q,
+            tk if block_k is None else block_k)
+
+
 def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
                                sm_scale: Optional[float] = None,
-                               block_q: int = 512, block_k: int = 512,
+                               block_q: Optional[int] = None,
+                               block_k: Optional[int] = None,
                                return_lse: bool = False,
                                interpret: bool = False):
     """q: (B, H, S, D); k, v: (B, H_kv, S, D) with H_kv | H (GQA served by
@@ -208,6 +226,7 @@ def flash_attention_fwd_pallas(q, k, v, causal: bool = True,
     s_k = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _pick_blocks(s_k, d, block_q, block_k)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
     qr = q.reshape(b * h, s_q, d)
@@ -366,7 +385,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
                                sm_scale: Optional[float] = None,
-                               block_q: int = 512, block_k: int = 512,
+                               block_q: Optional[int] = None,
+                               block_k: Optional[int] = None,
                                interpret: bool = False):
     """Flash-attention backward: (dq, dk, dv), no S×S materialization and no
     forward recompute beyond the score blocks (reference capability target:
@@ -383,6 +403,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = True,
     s_k = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _pick_blocks(s_k, d, block_q, block_k)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
     qr = q.reshape(b * h, s_q, d)

@@ -53,13 +53,23 @@ class ContinuousBatchingEngine:
     ``None``; a daemon thread drives the batched decode loop."""
 
     def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
-                 top_k: int = 0):
+                 top_k: int = 0, horizon: int = 1):
         self.model = model
         self.raw_params = params.get("params", params) \
             if isinstance(params, dict) else params
         self.n_slots = int(slots)
         self.buf_len = int(buf_len)
         self.top_k = int(top_k)
+        # decode horizon: tokens generated per device dispatch.  horizon=1 is
+        # token-granularity admission (lowest queueing latency); horizon=H
+        # runs H steps as one lax.scan on-device so per-token host round-trip
+        # cost (dominant over a network-attached TPU) amortizes H-fold.  The
+        # per-step computation is the identical scanned body, so outputs are
+        # bit-equal to horizon=1 for every request; requests only join the
+        # batch every H tokens, and a slot that hits eos/budget mid-horizon
+        # burns its remaining lanes (discarded on host, cache overwritten at
+        # next admission).
+        self.horizon = max(1, int(horizon))
 
         self._prefill, _ = _build_cached_decode(model, self.top_k)
 
@@ -79,7 +89,17 @@ class ContinuousBatchingEngine:
                 key, sub = jax.random.split(key)
                 nxt = _sample_live(logits[0, 0], sub, temp, self.top_k)
                 return nxt, mut["cache"], key
-            return jax.vmap(one)(caches, toks, poss, keys, temps)
+
+            def body(carry, _):
+                caches, toks, poss, keys = carry
+                toks, caches, keys = jax.vmap(one)(
+                    caches, toks, poss, keys, temps)
+                return (caches, toks, poss + 1, keys), toks
+
+            (caches, toks, poss, keys), hist = jax.lax.scan(
+                body, (caches, toks, poss, keys), None, length=self.horizon)
+            # hist: (horizon, n_slots) → host iterates per-slot rows
+            return hist.T, caches, keys
 
         self._step = batched_step
 
@@ -249,10 +269,12 @@ class ContinuousBatchingEngine:
                 self.raw_params, self._caches, jnp.asarray(self._toks),
                 jnp.asarray(self._poss), jnp.asarray(self._keys),
                 jnp.asarray(self._temps))
-            toks_host = np.asarray(toks)
+            toks_host = np.asarray(toks)  # (n_slots, horizon)
             self._keys = np.array(keys)  # writable copy (admit mutates rows)
             self._ticks += 1
             for i in live:
-                self._slots[i].pos += 1
-                if not self._emit(i, int(toks_host[i])):
-                    self._finish(i)
+                for j in range(self.horizon):
+                    self._slots[i].pos += 1
+                    if not self._emit(i, int(toks_host[i, j])):
+                        self._finish(i)
+                        break

@@ -322,6 +322,51 @@ def test_continuous_batching_greedy_parity_and_admission():
         engine.stop()
 
 
+def test_continuous_batching_horizon_parity():
+    """horizon=H runs H decode steps per device dispatch (one lax.scan);
+    outputs must stay bit-identical to horizon=1 / single-request generate,
+    including eos-mid-horizon and budget-mid-horizon requests."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import ContinuousBatchingEngine
+    from fedml_tpu.serving.templates.openai_compat import generate
+
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=32,
+                      dtype=jnp.float32, attn_impl="blockwise")
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+
+    engine = ContinuousBatchingEngine(model, params, slots=2, buf_len=32,
+                                      horizon=8)
+    try:
+        # budgets deliberately not multiples of the horizon
+        prompts = [[5, 17, 42], [7, 7], [1, 2, 3, 4], [60]]
+        budgets = [10, 3, 13, 5]
+        # pick an eos that actually fires mid-stream for one prompt
+        ref0 = generate(apply_fn, params, prompts[0], max_new_tokens=10,
+                        buf_len=32, model=model)
+        eoss = [ref0[4], None, None, None]
+        queues = [engine.submit(p, max_new_tokens=b, eos_id=e)
+                  for p, b, e in zip(prompts, budgets, eoss)]
+        for p, b, e, q in zip(prompts, budgets, eoss, queues):
+            got = []
+            while True:
+                t = q.get(timeout=60)
+                if t is None:
+                    break
+                got.append(t)
+            want = generate(apply_fn, params, p, max_new_tokens=b,
+                            buf_len=32, model=model, eos_id=e)
+            assert got == want, (p, got, want)
+        assert engine.horizon == 8
+    finally:
+        engine.stop()
+
+
 def test_continuous_batching_throughput_beats_sequential():
     """4 concurrent requests through a 4-slot engine must finish faster
     than 4 sequential cached generates (the batched step amortizes per-step

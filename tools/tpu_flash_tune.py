@@ -14,9 +14,12 @@ the tunnel — BASELINE.md round-2 notes).
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, ".")
 
 # (batch, q_heads, kv_heads, seq, head_dim) — bench shape first, then the
 # sweep shapes bench.py --attn exercises, then a 7B-ish GQA slice.
@@ -66,17 +69,18 @@ def main():
 
     from fedml_tpu.ops import attention as A
 
+    # optional argv: indices into SHAPES (resumable sweep), e.g. "1 2 3"
+    idxs = [int(a) for a in sys.argv[1:]] or list(range(len(SHAPES)))
+
     dev = jax.devices()[0]
     rng = np.random.default_rng(0)
     results = []
     table = {}
-    for (b, h, h_kv, s, d) in SHAPES:
+    for (b, h, h_kv, s, d) in [SHAPES[i] for i in idxs]:
         q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
-        k = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.bfloat16)
-        v = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.bfloat16)
-        kg, vg = k, v
-        if h_kv != h:  # blockwise baseline consumes grouped KV natively too
-            pass
+        # grouped KV consumed natively by both paths (no repeat needed)
+        kg = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.bfloat16)
+        vg = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.bfloat16)
 
         base_s = _time_chained(
             lambda x: A.blockwise_attention(x, kg, vg, True), q)
@@ -118,17 +122,21 @@ def main():
         results.append({"shape": shape_key, "blockwise_s": round(base_s, 6),
                         "rows": rows, "best": best, "bwd_s_at_best": bwd_s})
         if best is not None:
-            table[f"{s}_{d}"] = [best["bq"], best["bk"]]
+            table[(s, d)] = (best["bq"], best["bk"])
         print(f"[tune] {shape_key}: blockwise {base_s*1e3:.2f}ms "
               f"best {best}", flush=True)
 
+    # `paste` is literal _TUNED_BLOCKS entry lines (tuple keys/values),
+    # i.e. actually ready to paste into fedml_tpu/ops/attention.py
+    paste = "\n".join(f"    ({s}, {d}): ({bq}, {bk}),"
+                      for (s, d), (bq, bk) in sorted(table.items()))
     print(json.dumps({
         "metric": "flash_block_tune",
         "value": len(table),
         "unit": "shapes_tuned",
         "vs_baseline": None,
         "device_kind": dev.device_kind,
-        "table": table,
+        "paste": paste,
         "results": results,
     }))
 

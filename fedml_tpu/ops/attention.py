@@ -200,7 +200,8 @@ def _kv_head_map(b: int, h: int, h_kv: int):
 _TUNED_BLOCKS = {
     (1024, 64): (256, 1024),
 }
-_DEFAULT_BLOCKS = (256, 1024)
+# untuned shapes keep the round-2 tile — only measured shapes change
+_DEFAULT_BLOCKS = (512, 512)
 
 
 def _pick_blocks(s_k: int, d: int, block_q, block_k):

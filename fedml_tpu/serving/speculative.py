@@ -65,7 +65,45 @@ def _build_spec_fns(model):
             block[None, :], decode=True, start_pos=pos, mutable=["cache"])
         return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), mut["cache"]
 
-    return prefill, step, verify_block
+    @functools.partial(jax.jit, static_argnames=("m",))
+    def propose(params, cache, sync_buf, sync_len, start, m):
+        """Fused draft round: catch-up sync + m-token proposal, ONE dispatch.
+
+        sync_buf: (Kpad,) canonical tokens at positions start.. — only the
+        first sync_len entries are real; the padding's speculative K/V
+        writes are overwritten by the scan below before any query attends
+        them (each decode step writes its own position first), and padding
+        beyond the scan self-heals exactly like rejected draft tokens (see
+        module docstring).  Replaces the host loop that paid one tunnel
+        round-trip per draft token.
+        """
+        p = dequantize_params(params, wdtype)
+        logits, mut = model.apply(
+            {"params": p, "cache": cache}, sync_buf[None, :], decode=True,
+            start_pos=start, mutable=["cache"])
+        cache = mut["cache"]
+        pos = start + sync_len - 1          # last canonical position
+        first = jnp.argmax(jax.lax.dynamic_index_in_dim(
+            logits[0], sync_len - 1, axis=0, keepdims=False)).astype(
+                jnp.int32)                   # draft token at pos+1
+
+        def body(carry, j):
+            tok, cache = carry               # tok sits at position pos+j
+            logits, mut = model.apply(
+                {"params": p, "cache": cache}, tok[None, None], decode=True,
+                start_pos=pos + j, mutable=["cache"])
+            nxt = jnp.argmax(logits[0, 0]).astype(jnp.int32)
+            return (nxt, mut["cache"]), nxt
+
+        if m > 1:
+            (_, cache), rest = jax.lax.scan(
+                body, (first, cache), jnp.arange(1, m))
+            d_tokens = jnp.concatenate([first[None], rest])
+        else:
+            d_tokens = first[None]
+        return d_tokens, cache
+
+    return prefill, step, verify_block, propose
 
 
 def speculative_generate(model, params, draft_model, draft_params,
@@ -91,8 +129,8 @@ def speculative_generate(model, params, draft_model, draft_params,
     raw = params.get("params", params) if isinstance(params, dict) else params
     draw = draft_params.get("params", draft_params) \
         if isinstance(draft_params, dict) else draft_params
-    t_prefill, _, t_verify = _build_spec_fns(model)
-    d_prefill, d_step, d_verify = _build_spec_fns(draft_model)
+    t_prefill, _, t_verify, _ = _build_spec_fns(model)
+    d_prefill, _, _, d_propose = _build_spec_fns(draft_model)
 
     prompt_ids = list(prompt_ids)[-(buf_len - 1):]
     n = len(prompt_ids)
@@ -134,31 +172,30 @@ def speculative_generate(model, params, draft_model, draft_params,
         block_k = min(depth, k, buf_len - pos)
         if block_k < 1:
             break
-        # draft catch-up + first proposal: ONE block writes every canonical
-        # token the draft hasn't confirmed yet (f_d..pos — speculative
-        # writes from earlier rounds are overwritten, and after a
-        # full-accept round the draft is otherwise one position short),
-        # and its last logits are the draft's prediction for pos+1
+        # fused draft round: catch-up sync (every canonical token the draft
+        # hasn't confirmed, f_d..pos — speculative writes from earlier
+        # rounds are overwritten) + (block_k-1)-token proposal scan, all in
+        # ONE device dispatch (the old host loop paid one tunnel round-trip
+        # per draft token)
         d_tokens = []
-        if block_k >= 2:
+        # near the buffer end the fixed (k+1) padded sync would clamp its
+        # cache write (dynamic_update_slice) and silently corrupt canonical
+        # draft K/V below the frontier — fall back to verify-only rounds
+        # for the last few positions instead
+        if block_k >= 2 and f_d + k + 1 <= buf_len:
             sync = [(prompt_ids[p] if p < n else out[p - n])
                     for p in range(f_d, pos + 1)]
-            greedy_d, d_cache = d_verify(draw, d_cache,
-                                         jnp.asarray(sync, jnp.int32),
-                                         jnp.int32(f_d))
-            stats["draft_forwards"] += 1
+            assert len(sync) <= k + 1, (len(sync), k)  # f_d trails pos by <= k
+            sync_buf = np.zeros(k + 1, np.int32)
+            sync_buf[:len(sync)] = sync
+            d_jax, d_cache = d_propose(draw, d_cache, jnp.asarray(sync_buf),
+                                       jnp.int32(len(sync)), jnp.int32(f_d),
+                                       block_k - 1)
+            stats["draft_forwards"] += block_k - 1
             f_d = pos + 1
-            dcur = int(np.asarray(greedy_d)[-1])
-            d_tokens.append(dcur)
-            dpos = pos + 1
-            for _ in range(block_k - 2):
-                dcur, d_cache = d_step(draw, d_cache, jnp.int32(dcur),
-                                       jnp.int32(dpos))
-                stats["draft_forwards"] += 1
-                dcur = int(dcur)
-                d_tokens.append(dcur)
-                dpos += 1
+            d_tokens = [int(t) for t in np.asarray(d_jax)]
         stats["proposed"] += len(d_tokens)
+        block_k = len(d_tokens) + 1  # actual block length (guard may skip)
 
         # one target forward verifies cur + all proposals
         block = jnp.asarray([cur] + d_tokens, jnp.int32)

@@ -191,7 +191,8 @@ class OpenAICompatServer:
     def __init__(self, apply_fn: Callable, params, tokenizer=None,
                  model_name: str = "fedml-tpu-llm", host: str = "127.0.0.1",
                  port: int = 0, buf_len: int = 256, model=None,
-                 batch_slots: int = 0, draft_model=None, draft_params=None):
+                 batch_slots: int = 0, draft_model=None, draft_params=None,
+                 decode_horizon: int = 1):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -200,7 +201,9 @@ class OpenAICompatServer:
         :class:`~fedml_tpu.serving.batching.ContinuousBatchingEngine` so
         concurrent requests share one batched decode program; per-request
         ``top_k`` is ignored in that mode (the engine's sampler is compiled
-        once)."""
+        once).  ``decode_horizon`` > 1 (engine mode only) generates that
+        many tokens per device dispatch — same outputs, H-fold fewer host
+        round-trips; streaming granularity coarsens to H tokens."""
         self.apply_fn = apply_fn
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
@@ -225,7 +228,8 @@ class OpenAICompatServer:
                     "decode=True) — the batching engine is KV-cache based")
             from ..batching import ContinuousBatchingEngine
             self._engine = ContinuousBatchingEngine(
-                model, params, slots=int(batch_slots), buf_len=buf_len)
+                model, params, slots=int(batch_slots), buf_len=buf_len,
+                horizon=int(decode_horizon))
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------

@@ -34,6 +34,21 @@ def test_speculative_matches_target_greedy_any_draft():
             assert got == want, (prompt, n_new, got, want)
 
 
+def test_speculative_buffer_tail_parity():
+    """Decoding all the way to buf_len must stay bit-identical: near the
+    end the fused padded sync would clamp its cache write, so the loop
+    falls back to verify-only rounds there — outputs (and the draft cache
+    it no longer touches) must match target-only greedy exactly."""
+    target, tparams = _model(0)
+    draft, dparams = _model(1, dim=32, layers=1)
+    prompt = list(range(1, 40))  # 39 tokens into a 64-slot buffer
+    want = generate(None, tparams, prompt, max_new_tokens=40,  # hits buf end
+                    buf_len=64, model=target)
+    got, _ = speculative_generate(target, tparams, draft, dparams, prompt,
+                                  max_new_tokens=40, buf_len=64, k=4)
+    assert got == want
+
+
 def test_speculative_respects_eos():
     target, tparams = _model(0)
     draft, dparams = _model(1, dim=32, layers=1)

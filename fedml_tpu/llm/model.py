@@ -17,6 +17,7 @@ delegated DeepSpeed ZeRO-3 (``train/llm/distributed.py``).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -42,6 +43,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"     # auto | blockwise | flash | ring
+    #: Rematerialization policy for transformer blocks on the training path:
+    #: "full" recomputes everything in backward (lowest HBM — the
+    #: memory_estimate upper bounds assume this), "dots" saves matmul
+    #: outputs and recomputes only elementwise ops (~25-30% faster step
+    #: when activations fit), "none" disables remat.
+    remat: str = "full"         # full | dots | none
     #: LoRA rank; 0 = dense fine-tuning.  When >0, attention projections
     #: carry low-rank adapters in the separate "lora" variable collection —
     #: base weights stay frozen/shared, per-client state is adapters only
@@ -57,6 +64,25 @@ class LlamaConfig:
     #: cross-entropy on the training path (ops/xent.py) — peak activation
     #: memory O(B*S*chunk) instead of the O(B*S*V) logit tensor.
     streaming_xent_chunk: int = 0
+    #: KV-cache storage dtype for the decode path: "native" keeps
+    #: ``dtype``; "int8" stores K/V rows as int8 with one f32 scale per
+    #: (batch, kv_head, position) — halves decode HBM traffic (the TPU
+    #: decode bottleneck) at ~1% attention-output error.  Dequantization
+    #: folds into the score/output einsums, so HBM reads stay int8.
+    kv_cache_dtype: str = "native"  # native | int8
+
+    def __post_init__(self):
+        # typos must fail loudly — a silently-defaulted knob produces
+        # measurements the user attributes to the value they typed
+        if self.remat not in ("full", "dots", "none"):
+            raise ValueError(f"remat={self.remat!r}: must be "
+                             "'full', 'dots', or 'none'")
+        if self.kv_cache_dtype not in ("native", "int8"):
+            raise ValueError(f"kv_cache_dtype={self.kv_cache_dtype!r}: "
+                             "must be 'native' or 'int8'")
+        if self.attn_impl not in ("auto", "blockwise", "flash", "ring"):
+            raise ValueError(f"attn_impl={self.attn_impl!r}: must be "
+                             "'auto', 'blockwise', 'flash', or 'ring'")
 
 
 TINY = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
@@ -181,28 +207,63 @@ class Attention(nn.Module):
         """
         cfg = self.cfg
         cache_len = cfg.max_seq_len
+        int8_kv = cfg.kv_cache_dtype == "int8"
+        store_dtype = jnp.int8 if int8_kv else cfg.dtype
         ck = self.variable("cache", "k", jnp.zeros,
                            (b, cfg.n_kv_heads, cache_len, head_dim),
-                           cfg.dtype)
+                           store_dtype)
         cv = self.variable("cache", "v", jnp.zeros,
                            (b, cfg.n_kv_heads, cache_len, head_dim),
-                           cfg.dtype)
+                           store_dtype)
         start = positions[0].astype(jnp.int32)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, 0, start, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, 0, start, 0))
+        if int8_kv:
+            cks = self.variable("cache", "k_scale", jnp.zeros,
+                                (b, cfg.n_kv_heads, cache_len), jnp.float32)
+            cvs = self.variable("cache", "v_scale", jnp.zeros,
+                                (b, cfg.n_kv_heads, cache_len), jnp.float32)
+
+            def quant_rows(x):
+                xf = x.astype(jnp.float32)
+                scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+                q8 = jnp.clip(jnp.round(xf / scale[..., None]),
+                              -127, 127).astype(jnp.int8)
+                return q8, scale
+
+            k8, ks = quant_rows(k)
+            v8, vs = quant_rows(v)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k8,
+                                                    (0, 0, start, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v8,
+                                                    (0, 0, start, 0))
+            cks.value = jax.lax.dynamic_update_slice(cks.value, ks,
+                                                     (0, 0, start))
+            cvs.value = jax.lax.dynamic_update_slice(cvs.value, vs,
+                                                     (0, 0, start))
+        else:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, 0, start, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, 0, start, 0))
         kf, vf = ck.value, cv.value                 # (b, h_kv, L, d)
         rep = cfg.n_heads // cfg.n_kv_heads
         qg = q.reshape(b, cfg.n_kv_heads, rep, s, head_dim)
         scores = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
-                            kf).astype(jnp.float32)  # grouped, no KV repeat
+                            kf.astype(qg.dtype)).astype(
+                                jnp.float32)         # grouped, no KV repeat
+        if int8_kv:
+            # exact dequant: q·(k8*scale) == (q·k8)*scale (scale is
+            # per-position) — the HBM read stays int8
+            scores = scores * cks.value[:, :, None, None, :]
         scores = scores / (head_dim ** 0.5)
         kv_pos = jnp.arange(cache_len)
         mask = kv_pos[None, :] <= positions[:, None]      # (s, cache_len)
         scores = jnp.where(mask[None, None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(vf.dtype)
-        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, vf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if int8_kv:
+            # fold v's per-position scale into probs, keep vf int8 in HBM
+            probs = probs * cvs.value[:, :, None, None, :]
+        probs = probs.astype(cfg.dtype)
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, vf.astype(cfg.dtype))
         out = out.reshape(b, cfg.n_heads, s, head_dim)
         out = out.transpose(0, 2, 1, 3).reshape(
             b, s, cfg.n_heads * head_dim)
@@ -260,10 +321,19 @@ class LlamaLM(nn.Module):
         positions = jnp.arange(tokens.shape[-1])
         if start_pos is not None:
             positions = positions + start_pos
+        if cfg.remat == "none":
+            mk_block = Block
+        elif cfg.remat == "dots":
+            # save MXU outputs, recompute elementwise only — faster backward
+            # than full remat wherever the saved dots fit in HBM
+            mk_block = functools.partial(
+                nn.remat, static_argnums=(3,),
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )(Block)
+        else:   # "full": recompute block activations in backward — HBM for
+            mk_block = nn.remat(Block, static_argnums=(3,))  # FLOPs
         for i in range(cfg.n_layers):
-            # remat: recompute block activations in backward — HBM for FLOPs
-            block = nn.remat(Block, static_argnums=(3,))(
-                cfg, name=f"layer_{i}")
+            block = mk_block(cfg, name=f"layer_{i}")
             x = block(x, positions, decode)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_hidden:
@@ -294,6 +364,12 @@ def config_from_args(args, vocab: Optional[int] = None) -> LlamaConfig:
     impl = getattr(args, "attn_impl", None)
     if impl:
         overrides["attn_impl"] = str(impl)
+    remat = getattr(args, "llm_remat", None)
+    if remat:
+        overrides["remat"] = str(remat)
+    kvd = getattr(args, "llm_kv_cache_dtype", None)
+    if kvd:
+        overrides["kv_cache_dtype"] = str(kvd)
     dt = getattr(args, "model_dtype", None)
     if dt:
         overrides["dtype"] = jnp.dtype(str(dt)).type

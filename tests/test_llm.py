@@ -507,3 +507,44 @@ def test_fedllm_streaming_xent_matches_dense_loss():
     d1, s1 = losses[0][1], losses[64][1]
     assert abs(d0 - s0) < 5e-3 * max(1.0, abs(d0)), (d0, s0)
     assert abs(d1 - s1) < 5e-3 * max(1.0, abs(d1)), (d1, s1)
+
+
+def test_remat_policy_value_parity():
+    """remat is a pure recompute policy — "full"/"dots"/"none" must agree
+    on loss and adapter gradients to float tolerance (only step time and
+    HBM differ; not bitwise because XLA fuses each graph differently)."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM, causal_nll
+
+    import numpy as np
+
+    results = {}
+    for remat in ("full", "dots", "none"):
+        cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_dim=64, max_seq_len=32,
+                          dtype=jnp.float32, lora_rank=4, remat=remat)
+        model = LlamaLM(cfg)
+        rng = jax.random.PRNGKey(0)
+        toks = jax.random.randint(rng, (2, 32), 0, 128)
+        v = model.init(rng, toks)
+        params, lora = v["params"], v["lora"]
+
+        def loss_fn(lora):
+            logits = model.apply({"params": params, "lora": lora}, toks,
+                                 train=True)
+            return causal_nll(logits[:, :-1], toks[:, 1:])
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(lora)
+        results[remat] = (float(loss), jax.tree.leaves(grads))
+
+    l_full, g_full = results["full"]
+    for other in ("dots", "none"):
+        # not bitwise: XLA fuses the three graphs differently, so rounding
+        # differs at the last ulp scale — but the POLICY must not change
+        # the math beyond that
+        l, g = results[other]
+        assert abs(l - l_full) < 1e-5 * max(1.0, abs(l_full)), (other, l,
+                                                                l_full)
+        for a, b in zip(g, g_full):
+            assert np.allclose(a, b, rtol=2e-4, atol=1e-6), other

@@ -46,6 +46,14 @@ class FedLLMLayout:
     lora_bytes: int = 4          # fp32 adapters
     optimizer_slots: int = 2     # adam m+v over adapters
     safety: float = 1.25
+    #: llm.model.LlamaConfig.remat — "full" keeps only block-boundary
+    #: activations; "dots" additionally saves each layer's matmul outputs
+    #: (q/k/v/o + gate/up/down), trading HBM for ~25-30% less backward
+    #: recompute; "none" saves every intermediate (priced like dots +
+    #: attention workspaces — a coarse upper bound)
+    remat: str = "full"
+    ffn_dim: int = 11008
+    kv_dim: int = 4096           # n_kv_heads * head_dim
 
     @property
     def client_shards(self) -> int:
@@ -67,6 +75,17 @@ def estimate_fedllm_memory(layout: FedLLMLayout) -> Dict[str, float]:
     # resident client microbatch, plus ~4 working tensors for the live block
     act_per_client = (lo.n_layers + 4) * (
         lo.batch_per_client * lo.seq_len * lo.dim * 2) / lo.model_shards
+    if lo.remat in ("dots", "none"):
+        # saved matmul outputs per layer per token: q + o (dim each),
+        # k + v (kv_dim each), gate + up (ffn_dim each), down (dim)
+        saved_per_tok = 3 * lo.dim + 2 * lo.kv_dim + 2 * lo.ffn_dim
+        act_per_client += lo.n_layers * (
+            lo.batch_per_client * lo.seq_len * saved_per_tok * 2
+        ) / lo.model_shards
+        if lo.remat == "none":
+            # attention workspaces + norms kept too; coarse 1.5x on the
+            # per-layer saved set (flash never materializes S x S)
+            act_per_client *= 1.5
     activations = act_per_client  # clients run scanned, one live at a time
     # psum/all-gather scratch: one adapter set + one activation buffer
     scratch = lo.n_lora_params * lo.lora_bytes + act_per_client

@@ -548,3 +548,24 @@ def test_remat_policy_value_parity():
                                                                 l_full)
         for a, b in zip(g, g_full):
             assert np.allclose(a, b, rtol=2e-4, atol=1e-6), other
+
+
+def test_memory_estimate_remat_policies():
+    """Estimator must price remat policies monotonically (full < dots <
+    none), keep the north-star layout inside a v4 chip, and reject unknown
+    chips loudly."""
+    import pytest
+    from fedml_tpu.core.memory_estimate import (
+        FedLLMLayout, estimate_fedllm_memory, fits,
+        northstar_llama2_7b_512clients)
+
+    base = dict(n_params=6.74e9, n_lora_params=4 * 32 * 2 * 4096 * 16,
+                n_clients=512, n_chips=256, model_shards=8,
+                batch_per_client=1, seq_len=2048, dim=4096, n_layers=32)
+    totals = {r: estimate_fedllm_memory(FedLLMLayout(**base, remat=r))["total"]
+              for r in ("full", "dots", "none")}
+    assert totals["full"] < totals["dots"] < totals["none"], totals
+    assert fits(FedLLMLayout(**base), chip="v4")
+    assert northstar_llama2_7b_512clients()["total_gib"] < 24
+    with pytest.raises(ValueError):
+        fits(FedLLMLayout(**base), chip="h100")

@@ -139,6 +139,11 @@ def main():
     ap.add_argument("--xent-chunk", type=int, default=8192,
                     help="vocab chunk for the streaming fused cross-entropy "
                          "(ops/xent.py); 0 = dense logits path")
+    ap.add_argument("--remat", default="full",
+                    choices=("full", "dots", "none"),
+                    help="block recompute policy (llm.model.LlamaConfig."
+                         "remat); the memory estimate prices the same "
+                         "policy, so the upper-bound check stays valid")
     ap.add_argument("--fast", action="store_true",
                     help="~120M-param smoke for CI")
     ap.add_argument("--layer7b", action="store_true",
@@ -177,6 +182,7 @@ def main():
         llm_max_local_steps=args_cli.local_steps,
         lora_rank=args_cli.lora_rank, learning_rate=1e-4, random_seed=0,
         streaming_xent_chunk=args_cli.xent_chunk,
+        llm_remat=args_cli.remat,
     )
     args = fedml_tpu.init(args, should_init_logs=False)
     # the LM loader caps vocab at the spec; force the big-vocab synthetic
@@ -225,7 +231,9 @@ def main():
         n_params=n_params, n_lora_params=n_lora,
         n_clients=args_cli.clients_per_round, n_chips=1, model_shards=1,
         batch_per_client=1, seq_len=args_cli.seq, dim=args_cli.dim,
-        n_layers=args_cli.layers)
+        n_layers=args_cli.layers, remat=args_cli.remat,
+        ffn_dim=args_cli.ffn,
+        kv_dim=args_cli.kv_heads * (args_cli.dim // args_cli.heads))
     est = estimate_fedllm_memory(layout)
 
     from bench import _measured_matmul_peak, _peak_flops

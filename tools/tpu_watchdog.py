@@ -96,6 +96,10 @@ def main():
             timeout_s=3600)
     run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
             timeout_s=3600)
+    # remaining flash-tile sweep shapes (shape 0 measured live round-3;
+    # paste results into ops/attention.py::_TUNED_BLOCKS)
+    run_job([py, "tools/tpu_flash_tune.py", "1", "2", "3", "4", "5"],
+            "TPU_FLASH_TUNE.json", timeout_s=3600)
     env = dict(os.environ)
     env["LLM_SCALE_TPU"] = "1"  # let the scale probes use the live TPU
     for cmd, out in ((["tools/llm_scale_run.py", "--rounds", "3"],

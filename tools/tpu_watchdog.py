@@ -89,17 +89,26 @@ def main():
         time.sleep(POLL_INTERVAL_S)
 
     py = sys.executable
-    # serialize: one TPU client at a time (concurrent clients wedge it)
+    # serialize: one TPU client at a time (concurrent clients wedge it).
+    # Ordered by value-per-minute in case the tunnel re-wedges mid-battery:
+    # headline bench first, then the >=1B FedLLM run (the round-3 VERDICT
+    # ask), then serving/attention, then tuning sweeps, then the NaN-fix
+    # regression probe (bug already fixed+committed — lowest priority).
     run_job([py, "bench.py"], "TPU_BENCH_LIVE.json")
+    _run_scale_jobs(py)
     run_job([py, "bench.py", "--serve"], "TPU_SERVE_BENCH.json")
     run_job([py, "bench.py", "--attn"], "TPU_ATTN_SWEEP.json",
-            timeout_s=3600)
-    run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
             timeout_s=3600)
     # remaining flash-tile sweep shapes (shape 0 measured live round-3;
     # paste results into ops/attention.py::_TUNED_BLOCKS)
     run_job([py, "tools/tpu_flash_tune.py", "1", "2", "3", "4", "5"],
             "TPU_FLASH_TUNE.json", timeout_s=3600)
+    run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
+            timeout_s=1200)
+    print("[watchdog] battery complete", flush=True)
+
+
+def _run_scale_jobs(py):
     env = dict(os.environ)
     env["LLM_SCALE_TPU"] = "1"  # let the scale probes use the live TPU
     for cmd, out in ((["tools/llm_scale_run.py", "--rounds", "3"],
@@ -122,7 +131,6 @@ def main():
                 f.write(json.dumps({"metric": "watchdog_timeout",
                                     "value": None, "unit": None,
                                     "vs_baseline": None, "cmd": cmd}))
-    print("[watchdog] battery complete", flush=True)
 
 
 if __name__ == "__main__":

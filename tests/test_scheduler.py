@@ -306,7 +306,7 @@ def test_agent_kill9_daemon_respawns_and_run_recovers(tmp_path):
         str(tmp_path / "agent1"))
     daemon.start()
     try:
-        assert manager.wait_for_agents(1, timeout_s=20.0)
+        assert manager.wait_for_agents(1, timeout_s=45.0)
         pid0 = daemon.agent_pid()
 
         ws = tmp_path / "ws"
@@ -318,7 +318,7 @@ def test_agent_kill9_daemon_respawns_and_run_recovers(tmp_path):
                              job="bash job.sh", job_name="kill9")
         run = manager.launch_job(job, num_workers=1)
         # let the job actually spawn, then murder the agent mid-run
-        deadline = time.time() + 15
+        deadline = time.time() + 45
         while time.time() < deadline:
             rows = manager.run_db.get_run(run.run_id)
             if rows and rows[0].get("status") == "RUNNING":
@@ -328,7 +328,7 @@ def test_agent_kill9_daemon_respawns_and_run_recovers(tmp_path):
             raise AssertionError("run never reached RUNNING")
         os.kill(pid0, signal.SIGKILL)
 
-        assert run.done.wait(timeout=40.0), "run did not recover"
+        assert run.done.wait(timeout=90.0), "run did not recover"
         assert sentinel.exists()
         rows = manager.run_db.get_run(run.run_id)
         assert rows[0].get("status") == "FINISHED", rows
@@ -363,7 +363,7 @@ def test_agent_ota_upgrade_respawn(tmp_path):
         str(tmp_path / "agent1"))
     daemon.start()
     try:
-        assert manager.wait_for_agents(1, timeout_s=20.0)
+        assert manager.wait_for_agents(1, timeout_s=45.0)
         pid0 = daemon.agent_pid()
 
         newcode = tmp_path / "newcode"
@@ -377,7 +377,7 @@ def test_agent_ota_upgrade_respawn(tmp_path):
         manager.center.send_message(msg)
 
         # agent exits with OTA code; daemon respawns a NEW agent pid
-        deadline = time.time() + 30
+        deadline = time.time() + 60
         while time.time() < deadline:
             try:
                 pid1 = daemon.agent_pid(timeout_s=1.0)
@@ -395,7 +395,7 @@ def test_agent_ota_upgrade_respawn(tmp_path):
         assert (tmp_path / "agent1" / "agent_upgrade" / "9.9"
                 / "agent_patch.py").exists()
         # respawned agent re-registers on the plane
-        assert manager.wait_for_agents(1, timeout_s=20.0)
+        assert manager.wait_for_agents(1, timeout_s=45.0)
     finally:
         daemon.stop()
         manager.stop()

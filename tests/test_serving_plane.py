@@ -477,3 +477,52 @@ def test_openai_server_with_batching_engine():
     finally:
         srv_b.stop()
         srv_p.stop()
+
+
+def test_tp_sharded_decode_matches_unsharded():
+    """Multi-chip serving: params sharded over the model axis and the KV
+    cache sharded over kv_heads must reproduce the unsharded greedy decode
+    exactly (dryrun regime 9, kept under pytest guard)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from fedml_tpu.core.mesh import MODEL_AXIS, make_mesh
+    from fedml_tpu.llm.model import LlamaLM, TINY, param_sharding_rules
+    from fedml_tpu.serving.templates.openai_compat import _build_cached_decode
+
+    tp_n = 4
+    mesh = make_mesh(client=1, data=1, model=tp_n, seq=1,
+                     devices=jax.devices()[:tp_n])
+    cfg = dataclasses.replace(TINY, attn_impl="blockwise", n_layers=2,
+                              vocab_size=64, dim=32, n_heads=4, n_kv_heads=4,
+                              ffn_dim=64, max_seq_len=32)
+    lm = LlamaLM(cfg)
+    buf = jnp.zeros((1, cfg.max_seq_len), jnp.int32).at[0, :4].set(
+        jnp.asarray([5, 17, 42, 7], jnp.int32))
+    params = lm.init(jax.random.PRNGKey(0), buf)["params"]
+    sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, param_sharding_rules(params, mesh))
+    cache_spec = NamedSharding(mesh, P(None, MODEL_AXIS, None, None))
+    prefill, step = _build_cached_decode(lm, 0)
+
+    def decode(p, shard_cache):
+        key = jax.random.PRNGKey(0)
+        tok, cache = prefill(p, buf, jnp.int32(4), key, jnp.float32(0.0))
+        if shard_cache:
+            cache = jax.tree_util.tree_map(
+                lambda c: jax.device_put(c, cache_spec)
+                if c.ndim == 4 else c, cache)
+        toks = [int(tok)]
+        for i in range(4, 10):
+            tok, cache = step(p, cache, tok, jnp.int32(i), key,
+                              jnp.float32(0.0))
+            toks.append(int(tok))
+        return toks, cache
+
+    got, cache = decode(sharded, True)
+    k_leaf = jax.tree_util.tree_leaves(cache)[0]
+    assert len(k_leaf.sharding.device_set) == tp_n, k_leaf.sharding
+    want, _ = decode(params, False)
+    assert got == want, (got, want)

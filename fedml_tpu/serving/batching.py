@@ -71,7 +71,7 @@ class ContinuousBatchingEngine:
         # next admission).
         self.horizon = max(1, int(horizon))
 
-        self._prefill, _ = _build_cached_decode(model, self.top_k)
+        self._prefill, _ = _build_cached_decode(model, self.top_k, 1.0)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         wdtype = weight_dtype(model)

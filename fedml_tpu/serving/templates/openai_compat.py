@@ -57,10 +57,31 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
 
-def _sample_live(live, key, temp, top_k: int):
-    """live: (V,) logits → sampled token id (greedy at temp 0)."""
-    if top_k and top_k > 0:
-        kth = jnp.sort(live)[-top_k]
+def _sample_live(live, key, temp, top_k: int, top_p: float = 1.0):
+    """live: (V,) logits → sampled token id (greedy at temp 0).
+
+    ``top_k``/``top_p`` are static (compile-time) filters like the
+    reference HF template's generation kwargs: top-k keeps the k highest
+    logits, nucleus top-p keeps the smallest prefix of the sorted
+    distribution with cumulative probability ≥ p (always ≥ 1 token)."""
+    if (top_k and top_k > 0) or top_p < 1.0:
+        # one descending sort serves both filters; top-k is a prefix mask
+        # on the sorted array, and top-p renormalizes over what top-k kept
+        # (HF generation semantics: k first, then p)
+        sorted_desc = jnp.sort(live)[::-1]
+        if top_k and top_k > 0:
+            idx = jnp.arange(sorted_desc.shape[0])
+            sorted_desc = jnp.where(idx < top_k, sorted_desc, -jnp.inf)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sorted_desc)
+            cum = jnp.cumsum(probs)
+            # keep token i iff the mass BEFORE it is < p; the argmax is
+            # always kept, so top_p <= 0 degrades to greedy, not to
+            # an all-masked distribution
+            keep = (cum - probs < top_p).at[0].set(True)
+            sorted_desc = jnp.where(keep, sorted_desc, -jnp.inf)
+        kth = jnp.min(jnp.where(jnp.isfinite(sorted_desc), sorted_desc,
+                                jnp.inf))
         live = jnp.where(live < kth, -jnp.inf, live)
     greedy = jnp.argmax(live)
     sampled = jax.random.categorical(key, live / jnp.maximum(temp, 1e-6))
@@ -68,7 +89,7 @@ def _sample_live(live, key, temp, top_k: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _build_plain_step(apply_fn: Callable, top_k: int):
+def _build_plain_step(apply_fn: Callable, top_k: int, top_p: float):
     """Jitted full-buffer step, cached across requests (a per-request
     ``@jax.jit`` would re-trace every call — the jit cache is keyed on the
     function object)."""
@@ -79,13 +100,13 @@ def _build_plain_step(apply_fn: Callable, top_k: int):
         # logits at pos-1 predict token at pos
         live = jax.lax.dynamic_index_in_dim(logits[0], pos - 1, axis=0,
                                             keepdims=False)
-        return _sample_live(live, key, temp, top_k)
+        return _sample_live(live, key, temp, top_k, top_p)
 
     return step
 
 
 @functools.lru_cache(maxsize=32)
-def _build_cached_decode(model, top_k: int):
+def _build_cached_decode(model, top_k: int, top_p: float):
     """Jitted (prefill, step) pair for a flax model supporting
     ``decode=True`` with a "cache" collection (``llm.model.LlamaLM``).
 
@@ -102,7 +123,7 @@ def _build_cached_decode(model, top_k: int):
             start_pos=jnp.zeros((), jnp.int32), mutable=["cache"])
         live = jax.lax.dynamic_index_in_dim(logits[0], n - 1, axis=0,
                                             keepdims=False)
-        return _sample_live(live, key, temp, top_k), mut["cache"]
+        return _sample_live(live, key, temp, top_k, top_p), mut["cache"]
 
     @jax.jit
     def step(params, cache, tok, pos, key, temp):
@@ -110,14 +131,16 @@ def _build_cached_decode(model, top_k: int):
             {"params": dequantize_params(params, wdtype), "cache": cache},
             tok[None, None],
             decode=True, start_pos=pos, mutable=["cache"])
-        return _sample_live(logits[0, 0], key, temp, top_k), mut["cache"]
+        return _sample_live(logits[0, 0], key, temp, top_k,
+                            top_p), mut["cache"]
 
     return prefill, step
 
 
 def generate(apply_fn: Callable, params, prompt_ids: List[int],
              max_new_tokens: int = 64, temperature: float = 0.0,
-             top_k: int = 0, seed: int = 0, buf_len: int = 256,
+             top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+             buf_len: int = 256,
              eos_id: Optional[int] = None,
              on_token: Optional[Callable[[int], None]] = None,
              model=None) -> List[int]:
@@ -140,7 +163,8 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
     out: List[int] = []
 
     if model is not None:
-        prefill, step = _build_cached_decode(model, int(top_k))
+        prefill, step = _build_cached_decode(model, int(top_k),
+                                            float(top_p))
         raw_params = params.get("params", params) if isinstance(params, dict) \
             else params
         key, sub = jax.random.split(key)
@@ -159,7 +183,7 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
             pos += 1
         return out
 
-    step = _build_plain_step(apply_fn, int(top_k))
+    step = _build_plain_step(apply_fn, int(top_k), float(top_p))
     pos = n
     for _ in range(max_new_tokens):
         if pos >= buf_len:
@@ -200,8 +224,8 @@ class OpenAICompatServer:
         ``batch_slots`` > 0 (requires ``model``) routes requests through the
         :class:`~fedml_tpu.serving.batching.ContinuousBatchingEngine` so
         concurrent requests share one batched decode program; per-request
-        ``top_k`` is ignored in that mode (the engine's sampler is compiled
-        once).  ``decode_horizon`` > 1 (engine mode only) generates that
+        ``top_k``/``top_p`` are ignored in that mode (the engine's sampler
+        is compiled once).  ``decode_horizon`` > 1 (engine mode only) generates that
         many tokens per device dispatch — same outputs, H-fold fewer host
         round-trips; streaming granularity coarsens to H tokens."""
         self.apply_fn = apply_fn
@@ -287,6 +311,7 @@ class OpenAICompatServer:
                 max_new_tokens=int(req.get("max_tokens", 64)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_k=int(req.get("top_k", 0)),
+                top_p=min(max(float(req.get("top_p", 1.0)), 0.0), 1.0),
                 seed=int(req.get("seed", 0)),
                 buf_len=self.buf_len,
                 eos_id=getattr(tok, "eos_id", None),

@@ -505,7 +505,7 @@ def test_tp_sharded_decode_matches_unsharded():
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params, param_sharding_rules(params, mesh))
     cache_spec = NamedSharding(mesh, P(None, MODEL_AXIS, None, None))
-    prefill, step = _build_cached_decode(lm, 0)
+    prefill, step = _build_cached_decode(lm, 0, 1.0)
 
     def decode(p, shard_cache):
         key = jax.random.PRNGKey(0)
@@ -526,3 +526,33 @@ def test_tp_sharded_decode_matches_unsharded():
     assert len(k_leaf.sharding.device_set) == tp_n, k_leaf.sharding
     want, _ = decode(params, False)
     assert got == want, (got, want)
+
+
+def test_top_p_nucleus_sampling():
+    """top_p must restrict sampling to the smallest prefix of the sorted
+    distribution with cumulative mass >= p: tiny p == greedy even at high
+    temperature; p covering two tokens samples only those two; p=1.0 is a
+    no-op filter."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from fedml_tpu.serving.templates.openai_compat import _sample_live
+
+    # logits: token 3 ~60%, token 1 ~30%, rest tiny
+    live = jnp.asarray([0.0, 2.3, -1.0, 3.0, -2.0])
+    probs = np.asarray(jax.nn.softmax(live))
+    keys = [jax.random.PRNGKey(i) for i in range(200)]
+
+    tiny = {int(_sample_live(live, k, jnp.float32(2.0), 0, 1e-6))
+            for k in keys[:50]}
+    assert tiny == {3}, tiny  # argmax only, despite temp 2.0
+
+    two = probs[3] + probs[1]  # mass of the top-2 nucleus
+    mid = {int(_sample_live(live, k, jnp.float32(1.0), 0,
+                            float(two - 1e-4)))
+           for k in keys}
+    assert mid == {1, 3}, mid
+
+    full = {int(_sample_live(live, k, jnp.float32(3.0), 0, 1.0))
+            for k in keys}
+    assert len(full) >= 4, full  # unfiltered high-temp covers the support

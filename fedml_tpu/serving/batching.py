@@ -53,13 +53,14 @@ class ContinuousBatchingEngine:
     ``None``; a daemon thread drives the batched decode loop."""
 
     def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
-                 top_k: int = 0, horizon: int = 1):
+                 top_k: int = 0, top_p: float = 1.0, horizon: int = 1):
         self.model = model
         self.raw_params = params.get("params", params) \
             if isinstance(params, dict) else params
         self.n_slots = int(slots)
         self.buf_len = int(buf_len)
         self.top_k = int(top_k)
+        self.top_p = float(top_p)
         # decode horizon: tokens generated per device dispatch.  horizon=1 is
         # token-granularity admission (lowest queueing latency); horizon=H
         # runs H steps as one lax.scan on-device so per-token host round-trip
@@ -71,7 +72,8 @@ class ContinuousBatchingEngine:
         # next admission).
         self.horizon = max(1, int(horizon))
 
-        self._prefill, _ = _build_cached_decode(model, self.top_k, 1.0)
+        self._prefill, _ = _build_cached_decode(model, self.top_k,
+                                                self.top_p)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         wdtype = weight_dtype(model)
@@ -87,7 +89,8 @@ class ContinuousBatchingEngine:
                     {"params": params, "cache": cache}, tok[None, None],
                     decode=True, start_pos=pos, mutable=["cache"])
                 key, sub = jax.random.split(key)
-                nxt = _sample_live(logits[0, 0], sub, temp, self.top_k)
+                nxt = _sample_live(logits[0, 0], sub, temp, self.top_k,
+                                   self.top_p)
                 return nxt, mut["cache"], key
 
             def body(carry, _):

@@ -264,20 +264,176 @@ class ContinuousBatchingEngine:
             live = [i for i, s in enumerate(self._slots) if s.live]
             if not live:
                 continue
-
-            for i in live:
-                self._toks[i] = self._slots[i].cur_tok
-                self._poss[i] = self._slots[i].pos
-            toks, self._caches, keys = self._step(
-                self.raw_params, self._caches, jnp.asarray(self._toks),
-                jnp.asarray(self._poss), jnp.asarray(self._keys),
-                jnp.asarray(self._temps))
-            toks_host = np.asarray(toks)  # (n_slots, horizon)
-            self._keys = np.array(keys)  # writable copy (admit mutates rows)
+            self._dispatch(live)
             self._ticks += 1
-            for i in live:
-                for j in range(self.horizon):
-                    self._slots[i].pos += 1
-                    if not self._emit(i, int(toks_host[i, j])):
+
+    def _dispatch(self, live):
+        """One device tick for the live slots (overridden by the
+        speculative engine): horizon-scanned batched decode + emission."""
+        for i in live:
+            self._toks[i] = self._slots[i].cur_tok
+            self._poss[i] = self._slots[i].pos
+        toks, self._caches, keys = self._step(
+            self.raw_params, self._caches, jnp.asarray(self._toks),
+            jnp.asarray(self._poss), jnp.asarray(self._keys),
+            jnp.asarray(self._temps))
+        toks_host = np.asarray(toks)  # (n_slots, horizon)
+        self._keys = np.array(keys)  # writable copy (admit mutates rows)
+        for i in live:
+            for j in range(self.horizon):
+                self._slots[i].pos += 1
+                if not self._emit(i, int(toks_host[i, j])):
+                    self._finish(i)
+                    break
+
+
+class SpeculativeBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching × speculative decoding (greedy-only).
+
+    Every tick runs ONE fused device program: a vmapped draft
+    catch-up+propose block (k tokens per slot) followed by a vmapped
+    target verify block — so each live slot advances up to k+1 tokens
+    per dispatch at full acceptance, and the expensive model runs one
+    (k+1)-token forward per slot per tick regardless of acceptance.
+    Output is bit-identical to the non-speculative engine / single-request
+    ``generate`` (the draft only changes how many target forwards are
+    spent — see :mod:`fedml_tpu.serving.speculative`).
+
+    Cache-overrun discipline: verify/propose blocks write up to position
+    ``buf_len + k`` (positions past a rejection self-heal, per the
+    speculative module's argument), so both models must be built with
+    ``max_seq_len >= buf_len + k + 1`` — asserted at construction instead
+    of silently clamping writes (which would corrupt canonical K/V).
+    """
+
+    def __init__(self, model, params, draft_model, draft_params,
+                 slots: int = 4, buf_len: int = 256, k: int = 4):
+        self.k = int(k)
+        assert self.k >= 1
+        for m, name in ((model, "model"), (draft_model, "draft_model")):
+            msl = getattr(getattr(m, "cfg", None), "max_seq_len", None)
+            if msl is not None and msl < buf_len + self.k + 1:
+                raise ValueError(
+                    f"{name}.cfg.max_seq_len={msl} < buf_len+k+1="
+                    f"{buf_len + self.k + 1}: speculative blocks would "
+                    "clamp their cache writes")
+        self.draft_model = draft_model
+        self.raw_draft = draft_params.get("params", draft_params) \
+            if isinstance(draft_params, dict) else draft_params
+        self._hist: Dict[int, List[int]] = {}
+        self._fds = np.zeros(int(slots), np.int32)
+        super().__init__(model, params, slots=slots, buf_len=buf_len,
+                         top_k=0, horizon=1)
+
+        from ..llm.quantization import dequantize_params, weight_dtype
+        t_wdtype = weight_dtype(model)
+        d_wdtype = weight_dtype(draft_model)
+        k_ = self.k
+
+        self._d_prefill, _ = _build_cached_decode(draft_model, 0, 1.0)
+        dummy = jnp.zeros((1, self.buf_len), jnp.int32)
+        _, dcache0 = self._d_prefill(self.raw_draft, dummy, jnp.int32(1),
+                                     jax.random.PRNGKey(0), jnp.float32(0.0))
+        self._d_caches = jax.tree_util.tree_map(
+            lambda c: jnp.zeros((self.n_slots,) + c.shape, c.dtype), dcache0)
+
+        from .speculative import propose_block, verify_greedy_block
+
+        @jax.jit
+        def spec_tick(draw, raw, d_caches, t_caches, sync_bufs, sync_lens,
+                      fds, curs, poss):
+            # one fused program per tick: vmapped draft propose (shared
+            # body: speculative.propose_block) + vmapped target verify
+            draw = dequantize_params(draw, d_wdtype)
+            raw = dequantize_params(raw, t_wdtype)
+            d_tokens, d_caches = jax.vmap(
+                lambda cache, sync, slen, fd: propose_block(
+                    draft_model, draw, cache, sync, slen, fd, k_)
+            )(d_caches, sync_bufs, sync_lens, fds)
+            blocks = jnp.concatenate([curs[:, None], d_tokens], axis=1)
+            greedy, t_caches = jax.vmap(
+                lambda cache, block, pos: verify_greedy_block(
+                    model, raw, cache, block, pos)
+            )(t_caches, blocks, poss)
+            return d_tokens, greedy, d_caches, t_caches
+
+        self._spec_tick = spec_tick
+        # observability: target forwards vs tokens out (acceptance rate)
+        self.stats = {"target_block_forwards": 0, "proposed": 0,
+                      "accepted": 0}
+
+    def submit(self, prompt_ids, max_new_tokens: int = 64,
+               temperature: float = 0.0, seed: int = 0, eos_id=None):
+        if float(temperature) != 0.0:
+            raise ValueError("SpeculativeBatchingEngine is greedy-only "
+                             "(temperature 0); use ContinuousBatchingEngine "
+                             "for sampled requests")
+        return super().submit(prompt_ids, max_new_tokens=max_new_tokens,
+                              temperature=0.0, seed=seed, eos_id=eos_id)
+
+    def _admit(self, req, slot):
+        self._hist[slot] = list(req["prompt_ids"])
+        super()._admit(req, slot)  # target prefill + first emitted token
+        ids = req["prompt_ids"]
+        n = len(ids)
+        buf = np.zeros((1, self.buf_len), np.int32)
+        buf[0, :n] = ids
+        _, dcache = self._d_prefill(self.raw_draft, jnp.asarray(buf),
+                                    jnp.int32(n), jax.random.PRNGKey(0),
+                                    jnp.float32(0.0))
+        self._d_caches = self._insert(self._d_caches, dcache,
+                                      jnp.int32(slot))
+        self._fds[slot] = n
+
+    def _emit(self, i: int, tok: int) -> bool:
+        s = self._slots[i]
+        before = s.remaining
+        cont = super()._emit(i, tok)
+        if s.remaining < before:  # token was actually delivered
+            self._hist[i].append(tok)
+        return cont
+
+    def _dispatch(self, live):
+        kp1 = self.k + 1
+        sync_bufs = np.zeros((self.n_slots, kp1), np.int32)
+        sync_lens = np.ones(self.n_slots, np.int32)
+        for i in live:
+            s = self._slots[i]
+            hist = self._hist[i]
+            self._toks[i] = s.cur_tok
+            self._poss[i] = s.pos
+            sync = hist[self._fds[i]: s.pos + 1]
+            assert 1 <= len(sync) <= kp1, (len(sync), self.k)
+            sync_bufs[i, :len(sync)] = sync
+            sync_lens[i] = len(sync)
+
+        d_tokens, greedy, self._d_caches, self._caches = self._spec_tick(
+            self.raw_draft, self.raw_params, self._d_caches, self._caches,
+            jnp.asarray(sync_bufs), jnp.asarray(sync_lens),
+            jnp.asarray(self._fds), jnp.asarray(self._toks),
+            jnp.asarray(self._poss))
+        d_host = np.asarray(d_tokens)
+        g_host = np.asarray(greedy)
+        self.stats["target_block_forwards"] += len(live)
+
+        for i in live:
+            s = self._slots[i]
+            self._fds[i] = s.pos + 1  # draft confirmed through old cur
+            self.stats["proposed"] += self.k
+            for j in range(self.k):
+                dj, gj = int(d_host[i, j]), int(g_host[i, j])
+                s.pos += 1
+                if dj != gj:
+                    # first disagreement: the target's own token replaces it
+                    if not self._emit(i, gj):
                         self._finish(i)
-                        break
+                    break
+                self.stats["accepted"] += 1
+                if not self._emit(i, dj):
+                    self._finish(i)
+                    break
+            else:
+                # every proposal accepted: the target's continuation token
+                s.pos += 1
+                if not self._emit(i, int(g_host[i, self.k])):
+                    self._finish(i)

@@ -34,6 +34,51 @@ import numpy as np
 from ..llm.quantization import dequantize_params, weight_dtype
 
 
+def propose_block(model, params, cache, sync, slen, fd, m):
+    """Un-jitted fused draft round: catch-up sync + m-token greedy
+    proposal — the single source of truth for the draft-side cache
+    position logic, shared by :func:`speculative_generate` (jitted per
+    depth) and the vmapped :class:`~.batching.SpeculativeBatchingEngine`.
+
+    ``params`` must already be dequantized.  ``sync``: (Kpad,) canonical
+    tokens at positions ``fd..``; only the first ``slen`` are real (the
+    padding's speculative writes self-heal — module docstring).  Returns
+    ``(d_tokens (m,), cache)``; d_tokens[j] sits at position
+    ``fd + slen + j``.
+    """
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, sync[None, :], decode=True,
+        start_pos=fd, mutable=["cache"])
+    cache = mut["cache"]
+    pos = fd + slen - 1                  # last canonical position
+    first = jnp.argmax(jax.lax.dynamic_index_in_dim(
+        logits[0], slen - 1, axis=0, keepdims=False)).astype(jnp.int32)
+
+    def body(carry, j):
+        tok, cache = carry               # tok sits at position pos+j
+        lg, mut = model.apply(
+            {"params": params, "cache": cache}, tok[None, None],
+            decode=True, start_pos=pos + j, mutable=["cache"])
+        nxt = jnp.argmax(lg[0, 0]).astype(jnp.int32)
+        return (nxt, mut["cache"]), nxt
+
+    if m > 1:
+        (_, cache), rest = jax.lax.scan(body, (first, cache),
+                                        jnp.arange(1, m))
+        return jnp.concatenate([first[None], rest]), cache
+    return first[None], cache
+
+
+def verify_greedy_block(model, params, cache, block, pos):
+    """Un-jitted target verify: ``block`` (k,) tokens written at positions
+    ``pos..pos+k-1``; returns the target's greedy prediction for each next
+    position.  ``params`` must already be dequantized."""
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, block[None, :], decode=True,
+        start_pos=pos, mutable=["cache"])
+    return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), mut["cache"]
+
+
 @functools.lru_cache(maxsize=16)
 def _build_spec_fns(model):
     # not k-specialized: verify_block handles any block length via jit
@@ -58,50 +103,15 @@ def _build_spec_fns(model):
 
     @jax.jit
     def verify_block(params, cache, block, pos):
-        """block: (k,) tokens written at positions pos..pos+k-1; returns the
-        target's greedy prediction for each next position."""
-        logits, mut = model.apply(
-            {"params": dequantize_params(params, wdtype), "cache": cache},
-            block[None, :], decode=True, start_pos=pos, mutable=["cache"])
-        return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), mut["cache"]
+        return verify_greedy_block(model, dequantize_params(params, wdtype),
+                                   cache, block, pos)
 
     @functools.partial(jax.jit, static_argnames=("m",))
     def propose(params, cache, sync_buf, sync_len, start, m):
-        """Fused draft round: catch-up sync + m-token proposal, ONE dispatch.
-
-        sync_buf: (Kpad,) canonical tokens at positions start.. — only the
-        first sync_len entries are real; the padding's speculative K/V
-        writes are overwritten by the scan below before any query attends
-        them (each decode step writes its own position first), and padding
-        beyond the scan self-heals exactly like rejected draft tokens (see
-        module docstring).  Replaces the host loop that paid one tunnel
-        round-trip per draft token.
-        """
-        p = dequantize_params(params, wdtype)
-        logits, mut = model.apply(
-            {"params": p, "cache": cache}, sync_buf[None, :], decode=True,
-            start_pos=start, mutable=["cache"])
-        cache = mut["cache"]
-        pos = start + sync_len - 1          # last canonical position
-        first = jnp.argmax(jax.lax.dynamic_index_in_dim(
-            logits[0], sync_len - 1, axis=0, keepdims=False)).astype(
-                jnp.int32)                   # draft token at pos+1
-
-        def body(carry, j):
-            tok, cache = carry               # tok sits at position pos+j
-            logits, mut = model.apply(
-                {"params": p, "cache": cache}, tok[None, None], decode=True,
-                start_pos=pos + j, mutable=["cache"])
-            nxt = jnp.argmax(logits[0, 0]).astype(jnp.int32)
-            return (nxt, mut["cache"]), nxt
-
-        if m > 1:
-            (_, cache), rest = jax.lax.scan(
-                body, (first, cache), jnp.arange(1, m))
-            d_tokens = jnp.concatenate([first[None], rest])
-        else:
-            d_tokens = first[None]
-        return d_tokens, cache
+        """Fused draft round: catch-up sync + m-token proposal, ONE
+        dispatch (body shared with the batched engine: propose_block)."""
+        return propose_block(model, dequantize_params(params, wdtype),
+                             cache, sync_buf, sync_len, start, m)
 
     return prefill, step, verify_block, propose
 

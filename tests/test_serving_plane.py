@@ -109,6 +109,14 @@ def test_openai_compat_endpoint():
         assert st == 200 and out["choices"][0]["message"]["role"] == \
             "assistant"
 
+        # top_p over HTTP: a near-zero nucleus forces greedy even at high
+        # temperature, so two different seeds must agree
+        tp = [_post(port, "/v1/completions",
+                    {"prompt": "hi", "max_tokens": 4, "temperature": 1.9,
+                     "top_p": 1e-6, "seed": sd})[1] for sd in (1, 2)]
+        assert json.loads(tp[0])["choices"][0]["text"] == \
+            json.loads(tp[1])["choices"][0]["text"]
+
         # streaming
         st, body = _post(port, "/v1/chat/completions",
                          {"messages": [{"role": "user", "content": "yo"}],
@@ -556,3 +564,75 @@ def test_top_p_nucleus_sampling():
     full = {int(_sample_live(live, k, jnp.float32(3.0), 0, 1.0))
             for k in keys}
     assert len(full) >= 4, full  # unfiltered high-temp covers the support
+
+
+def test_speculative_batching_engine_parity_and_acceptance():
+    """SpeculativeBatchingEngine greedy output must be bit-identical to
+    single-request generate for an arbitrary draft; with the target as its
+    own draft (perfectly aligned) every proposal is accepted, so target
+    block-forwards ~= tokens/(k+1); sampled requests are rejected."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import SpeculativeBatchingEngine
+    from fedml_tpu.serving.templates.openai_compat import generate
+
+    k = 3
+    buf = 32
+    # max_seq_len must cover buf + k + 1 (speculative block slack)
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=buf + k + 1,
+                      dtype=jnp.float32, attn_impl="blockwise")
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    dcfg = dataclasses.replace(cfg, dim=16, n_layers=1, n_heads=2,
+                               n_kv_heads=2, ffn_dim=32)
+    draft = LlamaLM(dcfg)
+    dparams = draft.init(jax.random.PRNGKey(1),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+
+    # (a) parity with an unrelated random draft, 4 requests through 2 slots
+    eng = SpeculativeBatchingEngine(model, params, draft, dparams,
+                                    slots=2, buf_len=buf, k=k)
+    try:
+        with pytest.raises(ValueError):
+            eng.submit([1, 2], temperature=0.7)
+        prompts = [[5, 17, 42], [7, 7], [1, 2, 3, 4], [60]]
+        budgets = [10, 3, 13, 6]
+        ref0 = generate(apply_fn, params, prompts[0], max_new_tokens=10,
+                        buf_len=buf, model=model)
+        eoss = [ref0[4], None, None, None]  # eos fires mid-stream for req 0
+        queues = [eng.submit(p, max_new_tokens=b, eos_id=e)
+                  for p, b, e in zip(prompts, budgets, eoss)]
+        for p, b, e, q in zip(prompts, budgets, eoss, queues):
+            got = []
+            while True:
+                t = q.get(timeout=120)
+                if t is None:
+                    break
+                got.append(t)
+            want = generate(apply_fn, params, p, max_new_tokens=b,
+                            buf_len=buf, model=model, eos_id=e)
+            assert got == want, (p, got, want)
+    finally:
+        eng.stop()
+
+    # (b) aligned draft: full acceptance, ~tokens/(k+1) target forwards
+    eng = SpeculativeBatchingEngine(model, params, model, params,
+                                    slots=1, buf_len=buf, k=k)
+    try:
+        n_new = 12
+        out = eng.generate([5, 17, 42], max_new_tokens=n_new)
+        want = generate(apply_fn, params, [5, 17, 42],
+                        max_new_tokens=n_new, buf_len=buf, model=model)
+        assert out == want
+        assert eng.stats["accepted"] == eng.stats["proposed"], eng.stats
+        # prefill emits 1; each block tick then yields k+1 tokens
+        assert eng.stats["target_block_forwards"] <= -(-(n_new - 1) // (k + 1)) + 1, \
+            eng.stats
+    finally:
+        eng.stop()

@@ -312,7 +312,12 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         assert self.k >= 1
         for m, name in ((model, "model"), (draft_model, "draft_model")):
             msl = getattr(getattr(m, "cfg", None), "max_seq_len", None)
-            if msl is not None and msl < buf_len + self.k + 1:
+            if msl is None:
+                raise ValueError(
+                    f"{name} has no cfg.max_seq_len — cannot prove the "
+                    "speculative block writes stay in-bounds (a clamped "
+                    "write would silently corrupt canonical K/V)")
+            if msl < buf_len + self.k + 1:
                 raise ValueError(
                     f"{name}.cfg.max_seq_len={msl} < buf_len+k+1="
                     f"{buf_len + self.k + 1}: speculative blocks would "

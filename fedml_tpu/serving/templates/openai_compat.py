@@ -216,7 +216,7 @@ class OpenAICompatServer:
                  model_name: str = "fedml-tpu-llm", host: str = "127.0.0.1",
                  port: int = 0, buf_len: int = 256, model=None,
                  batch_slots: int = 0, draft_model=None, draft_params=None,
-                 decode_horizon: int = 1):
+                 decode_horizon: int = 1, spec_k: int = 4):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -245,15 +245,33 @@ class OpenAICompatServer:
         if draft_model is not None and draft_params is None:
             raise ValueError("draft_model requires draft_params")
         self._engine = None
+        self._engine_greedy_only = False
         if batch_slots:
             if model is None:
                 raise ValueError(
                     "batch_slots requires `model` (a flax module supporting "
                     "decode=True) — the batching engine is KV-cache based")
-            from ..batching import ContinuousBatchingEngine
-            self._engine = ContinuousBatchingEngine(
-                model, params, slots=int(batch_slots), buf_len=buf_len,
-                horizon=int(decode_horizon))
+            if draft_model is not None:
+                # flagship serving config: speculative continuous batching
+                # for greedy traffic; sampled requests fall through to the
+                # single-request cached path below.  Requires
+                # cfg.max_seq_len >= buf_len + spec_k + 1 (block slack).
+                if int(decode_horizon) > 1:
+                    raise ValueError(
+                        "decode_horizon and draft_model are mutually "
+                        "exclusive: the speculative engine advances up to "
+                        "spec_k+1 tokens per dispatch already")
+                from ..batching import SpeculativeBatchingEngine
+                self._engine = SpeculativeBatchingEngine(
+                    model, params, draft_model, draft_params,
+                    slots=int(batch_slots), buf_len=buf_len,
+                    k=int(spec_k))
+                self._engine_greedy_only = True
+            else:
+                from ..batching import ContinuousBatchingEngine
+                self._engine = ContinuousBatchingEngine(
+                    model, params, slots=int(batch_slots), buf_len=buf_len,
+                    horizon=int(decode_horizon))
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------
@@ -277,7 +295,9 @@ class OpenAICompatServer:
                 on_text(clean[sent:])
                 sent = len(clean)
 
-        if self._engine is not None:
+        if self._engine is not None and not (
+                self._engine_greedy_only
+                and float(req.get("temperature", 0.0)) != 0.0):
             q = self._engine.submit(
                 tok.encode(prompt),
                 max_new_tokens=int(req.get("max_tokens", 64)),

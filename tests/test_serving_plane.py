@@ -636,3 +636,55 @@ def test_speculative_batching_engine_parity_and_acceptance():
             eng.stats
     finally:
         eng.stop()
+
+
+def test_server_speculative_batching_mode():
+    """batch_slots + draft_model => SpeculativeBatchingEngine: greedy HTTP
+    requests go through it (bit-equal to generate); sampled requests fall
+    back to the single-request cached path instead of erroring."""
+    import dataclasses
+    import json as _json
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import SpeculativeBatchingEngine
+    from fedml_tpu.serving.templates.openai_compat import (
+        ByteTokenizer, OpenAICompatServer, generate)
+
+    tok = ByteTokenizer()
+    k = 4
+    buf = 48
+    cfg = LlamaConfig(vocab_size=tok.vocab_size, dim=32, n_layers=1,
+                      n_heads=2, n_kv_heads=2, ffn_dim=64,
+                      max_seq_len=buf + k + 1, dtype=jnp.float32,
+                      attn_impl="blockwise")
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    dcfg = dataclasses.replace(cfg, dim=16, n_heads=2, n_kv_heads=2,
+                               ffn_dim=32)
+    draft = LlamaLM(dcfg)
+    dparams = draft.init(jax.random.PRNGKey(1),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+
+    srv = OpenAICompatServer(apply_fn, params, tokenizer=tok, buf_len=buf,
+                             model=model, batch_slots=2,
+                             draft_model=draft, draft_params=dparams)
+    assert isinstance(srv._engine, SpeculativeBatchingEngine)
+    port = srv.start()
+    try:
+        st, body = _post(port, "/v1/completions",
+                         {"prompt": "hi", "max_tokens": 10})
+        text = _json.loads(body)["choices"][0]["text"]
+        want = generate(apply_fn, params, tok.encode("hi"),
+                        max_new_tokens=10, buf_len=buf, model=model,
+                        eos_id=tok.eos_id)
+        assert text == tok.decode(want)
+        # sampled request: must not error (engine is greedy-only)
+        st, body = _post(port, "/v1/completions",
+                         {"prompt": "hi", "max_tokens": 5,
+                          "temperature": 0.9, "seed": 3})
+        assert st == 200 and _json.loads(body)["choices"][0]["text"]
+    finally:
+        srv.stop()

@@ -75,6 +75,12 @@ def main():
                     "fednlp_20news")
     ap.add_argument("--cache", default=None,
                     help="dataset cache root (default: fresh temp dir)")
+    ap.add_argument("--cifar-rounds", type=int, default=None,
+                    help="override cifar100 comm rounds (full=10; the "
+                         "resnet18 row costs ~20 CPU-min/round on the "
+                         "1-core build box)")
+    ap.add_argument("--cifar-train-n", type=int, default=None,
+                    help="override cifar100 train set size (full=6000)")
     args = ap.parse_args()
     rows = args.rows.split(",")
     cache = args.cache or tempfile.mkdtemp(prefix="fedml_tpu_rows_")
@@ -97,14 +103,16 @@ def main():
     if "cifar100_resnet18" in rows:
         croot = os.path.join(cache, "cifar100")
         make_cifar_bin(croot, "cifar100",
-                       train_n=1000 if args.fast else 6000,
+                       train_n=args.cifar_train_n
+                       or (1000 if args.fast else 6000),
                        test_n=200 if args.fast else 1000)
         r = _run_row("cifar100_resnet18", dict(
             dataset="cifar100", data_cache_dir=croot, model="resnet18_gn",
             federated_optimizer="FedProx", fedprox_mu=0.1,
             client_num_in_total=8 if args.fast else 32,
             client_num_per_round=2 if args.fast else 4,
-            comm_round=2 if args.fast else 10, epochs=1, batch_size=20,
+            comm_round=args.cifar_rounds
+            or (2 if args.fast else 10), epochs=1, batch_size=20,
             learning_rate=0.05, partition_method="hetero",
             partition_alpha=0.5,
             frequency_of_the_test=1 if args.fast else 2, random_seed=0))
@@ -127,8 +135,18 @@ def main():
         print(json.dumps(r), flush=True)
 
     out = os.path.join(REPO, "BASELINE_ROWS.json")
+    # merge by row name so partial reruns (--rows subset) compose instead
+    # of clobbering rows measured earlier
+    merged = {}
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                merged = {r["row"]: r for r in json.load(f)}
+        except Exception:
+            merged = {}
+    merged.update({r["row"]: r for r in results})
     with open(out, "w") as f:
-        json.dump(results, f, indent=1)
+        json.dump(list(merged.values()), f, indent=1)
     print(f"# wrote {out}", file=sys.stderr)
 
 

@@ -110,6 +110,10 @@ class FedLLMAPI:
         seq = dataset.train_x.shape[1]
         dummy = jnp.zeros((1, seq), jnp.int32)
         variables = self.model.init(rng_util.purpose_key(key, "init"), dummy)
+        # The base is FROZEN under LoRA, so init emits matmul weights and
+        # embeddings directly in cfg.store_dtype (bf16 by default — halves
+        # weight HBM vs f32 masters; see LlamaConfig.param_dtype). RMSNorm
+        # scales and MoE router kernels stay f32 (precision-sensitive).
         self.base_params = variables["params"]
         self.global_lora = lora_init(rng_util.purpose_key(key, "lora"),
                                      variables["lora"])

@@ -42,6 +42,15 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    #: storage dtype for matmul weights/embeddings; ``None`` = same as
+    #: ``dtype``.  The base is frozen under LoRA, so bf16 STORAGE (not just
+    #: bf16 compute over f32 masters, the flax default) halves weight HBM
+    #: and weight-stream bandwidth — and avoids ever materializing an f32
+    #: copy at init (a 7B model must never allocate 27 GiB of f32 masters
+    #: on a 16 GiB chip).  RMSNorm scales stay f32 regardless: negligible
+    #: bytes, and bf16 norms were implicated in the round-3 bf16-gradient
+    #: sensitivity work.
+    param_dtype: Any = None
     attn_impl: str = "auto"     # auto | blockwise | flash | ring
     #: Rematerialization policy for transformer blocks on the training path:
     #: "full" recomputes everything in backward (lowest HBM — the
@@ -84,6 +93,10 @@ class LlamaConfig:
             raise ValueError(f"attn_impl={self.attn_impl!r}: must be "
                              "'auto', 'blockwise', 'flash', or 'ring'")
 
+    @property
+    def store_dtype(self):
+        return self.dtype if self.param_dtype is None else self.param_dtype
+
 
 TINY = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                    n_kv_heads=2, ffn_dim=128, max_seq_len=128,
@@ -124,11 +137,12 @@ class LoRADense(nn.Module):
     rank: int
     alpha: float
     dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         y = nn.Dense(self.features, use_bias=False, dtype=self.dtype,
-                     name="base")(x)
+                     param_dtype=self.param_dtype, name="base")(x)
         if self.rank > 0:
             # structure initialized to zeros; lora_init() randomizes A
             # externally (B stays zero so the adapter starts as identity)
@@ -154,10 +168,11 @@ class Attention(nn.Module):
         if cfg.lora_rank > 0:
             dense = lambda feats, name: LoRADense(
                 feats, cfg.lora_rank, cfg.lora_alpha, dtype=cfg.dtype,
-                name=name)
+                param_dtype=cfg.store_dtype, name=name)
         else:
             dense = lambda feats, name: nn.Dense(
-                feats, use_bias=False, dtype=cfg.dtype, name=name)
+                feats, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.store_dtype, name=name)
         q = dense(cfg.n_heads * head_dim, "wq")(x)
         k = dense(cfg.n_kv_heads * head_dim, "wk")(x)
         v = dense(cfg.n_kv_heads * head_dim, "wv")(x)
@@ -277,7 +292,8 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=cfg.dtype, name=name)
+            feats, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.store_dtype, name=name)
         gate = dense(cfg.ffn_dim, "w_gate")(x)
         up = dense(cfg.ffn_dim, "w_up")(x)
         return dense(cfg.dim, "w_down")(nn.silu(gate) * up)
@@ -296,7 +312,7 @@ class Block(nn.Module):
             ffn = MoEMLP(dim=self.cfg.dim, ffn_dim=self.cfg.ffn_dim,
                          n_experts=self.cfg.n_experts,
                          top_k=self.cfg.moe_top_k, dtype=self.cfg.dtype,
-                         name="moe_mlp")
+                         param_dtype=self.cfg.store_dtype, name="moe_mlp")
         else:
             ffn = MLP(self.cfg, name="mlp")
         return h + ffn(RMSNorm(self.cfg.norm_eps, name="mlp_norm")(h))
@@ -317,7 +333,7 @@ class LlamaLM(nn.Module):
         (the streaming cross-entropy path)."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                     name="tok_embed")(tokens)
+                     param_dtype=cfg.store_dtype, name="tok_embed")(tokens)
         positions = jnp.arange(tokens.shape[-1])
         if start_pos is not None:
             positions = positions + start_pos
@@ -342,8 +358,9 @@ class LlamaLM(nn.Module):
             # materializing (B, S, V) logits.  Only valid under apply —
             # init must run the default path so lm_head params exist.
             return x
+        # kernel stored in store_dtype, compute still f32 (logit precision)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                          name="lm_head")(x)
+                          param_dtype=cfg.store_dtype, name="lm_head")(x)
         return logits
 
 
@@ -385,6 +402,13 @@ def config_from_args(args, vocab: Optional[int] = None) -> LlamaConfig:
 
 def build_causal_lm(args, vocab: Optional[int] = None) -> FlaxModel:
     cfg = config_from_args(args, vocab)
+    if cfg.lora_rank == 0 and cfg.param_dtype is None:
+        # the generic trainers behind FlaxModel train the WHOLE param tree
+        # (FlaxModel.init drops the "lora" collection, so dense training is
+        # the only mode here) — keep f32 masters: bf16-stored weights lose
+        # adamw updates below ~2^-9 relative. bf16 storage stays for the
+        # frozen-base paths (FedLLMAPI / LoRA CausalLMTrainer / serving).
+        cfg = dataclasses.replace(cfg, param_dtype=jnp.float32)
     seq = int(getattr(args, "seq_len", min(cfg.max_seq_len, 512)))
     return FlaxModel(LlamaLM(cfg), (seq,), input_dtype=jnp.int32, task="lm")
 

@@ -59,6 +59,7 @@ class MoEMLP(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
     mesh: Optional[Any] = None
 
     @nn.compact
@@ -109,11 +110,11 @@ class MoEMLP(nn.Module):
         expert_in = _ep_constraint(expert_in, self.mesh)
 
         w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
-                            (e, dim, self.ffn_dim))
+                            (e, dim, self.ffn_dim), self.param_dtype)
         w_up = self.param("w_up", nn.initializers.lecun_normal(),
-                          (e, dim, self.ffn_dim))
+                          (e, dim, self.ffn_dim), self.param_dtype)
         w_down = self.param("w_down", nn.initializers.lecun_normal(),
-                            (e, self.ffn_dim, dim))
+                            (e, self.ffn_dim, dim), self.param_dtype)
         h = jnp.einsum("ecd,edf->ecf", expert_in,
                        _ep_constraint(w_gate.astype(self.dtype), self.mesh))
         u = jnp.einsum("ecd,edf->ecf", expert_in,

@@ -59,12 +59,18 @@ class CausalLMTrainer:
         self.lora_only = int(getattr(args, "lora_rank", 0)) > 0
         lr = float(getattr(args, "learning_rate", 1e-3))
 
+        import dataclasses
         cfg = config_from_args(args, dataset.num_classes)
         if self.lora_only and cfg.lora_rank == 0:
-            import dataclasses
             cfg = dataclasses.replace(
                 cfg, lora_rank=int(getattr(args, "lora_rank", 8)),
                 lora_alpha=float(getattr(args, "lora_alpha", 16.0)))
+        if not self.lora_only and cfg.param_dtype is None:
+            # dense fine-tune: the base is TRAINED, so init TRUE f32
+            # masters (adamw updates below ~2^-9 relative round to zero in
+            # bf16, and init-in-bf16-then-upcast would quantize the init);
+            # bf16 storage stays for the frozen-base LoRA/serving paths
+            cfg = dataclasses.replace(cfg, param_dtype=jnp.float32)
         self.model = LlamaLM(cfg)
         key = rng_util.root_key(self.seed)
         seq = dataset.train_x.shape[1]

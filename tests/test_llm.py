@@ -569,3 +569,61 @@ def test_memory_estimate_remat_policies():
     assert northstar_llama2_7b_512clients()["total_gib"] < 24
     with pytest.raises(ValueError):
         fits(FedLLMLayout(**base), chip="h100")
+
+
+def test_param_storage_dtype_policy():
+    """Round-4 storage policy: frozen-base paths store matmul weights in
+    ``LlamaConfig.store_dtype`` (bf16 halves HBM; the memory estimator
+    prices 2 bytes/param), while anything TRAINED densely keeps f32
+    masters (bf16 adamw loses updates below ~2^-9 relative).  Norm scales
+    and MoE router kernels stay f32 everywhere."""
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.models.model_hub import create
+
+    # 1. bf16 model init emits bf16 matmul weights, f32 norm scales
+    cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=32,
+                      dtype=jnp.bfloat16)
+    params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    mats = {str(l.dtype) for l in jax.tree_util.tree_leaves(params)
+            if l.ndim >= 2}
+    norms = {str(l.dtype) for l in jax.tree_util.tree_leaves(params)
+             if l.ndim == 1}
+    assert mats == {"bfloat16"}, mats
+    assert norms == {"float32"}, norms
+
+    # 2. explicit param_dtype=f32 beats dtype (mixed-precision masters)
+    cfg_f32 = LlamaConfig(vocab_size=64, dim=32, n_layers=1, n_heads=4,
+                          n_kv_heads=2, ffn_dim=64, max_seq_len=32,
+                          dtype=jnp.bfloat16, param_dtype=jnp.float32)
+    p32 = LlamaLM(cfg_f32).init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 8), jnp.int32))["params"]
+    assert {str(l.dtype) for l in jax.tree_util.tree_leaves(p32)} \
+        == {"float32"}
+
+    # 3. generic dense-trained path (model_hub -> FlaxModel -> trainers)
+    # keeps f32 masters even though LLAMA2_7B defaults to bf16 compute
+    args = load_arguments()
+    args.update(model="llama", llm_dim=32, llm_n_layers=1, llm_n_heads=4,
+                llm_n_kv_heads=2, llm_ffn_dim=64, llm_max_seq_len=32,
+                seq_len=16)
+    dense = create(args, 64)
+    pd = dense.init(jax.random.PRNGKey(0))
+    assert {str(l.dtype) for l in jax.tree_util.tree_leaves(pd)} \
+        == {"float32"}
+
+    # 4. MoE: expert weights follow store_dtype, router kernel stays f32
+    cfg_moe = LlamaConfig(vocab_size=64, dim=32, n_layers=1, n_heads=4,
+                          n_kv_heads=2, ffn_dim=64, max_seq_len=32,
+                          dtype=jnp.bfloat16, n_experts=4)
+    pm = LlamaLM(cfg_moe).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    flat = jax.tree_util.tree_flatten_with_path(pm)[0]
+    router = [l for path, l in flat
+              if any(getattr(k, "key", "") == "router" for k in path)]
+    experts = [l for path, l in flat
+               if any(getattr(k, "key", "") in ("w_gate", "w_up", "w_down")
+                      and l.ndim == 3 for k in path)]
+    assert router and all(l.dtype == jnp.float32 for l in router)
+    assert experts and all(l.dtype == jnp.bfloat16 for l in experts)

@@ -146,6 +146,9 @@ def main():
                          "policy, so the upper-bound check stays valid")
     ap.add_argument("--fast", action="store_true",
                     help="~120M-param smoke for CI")
+    ap.add_argument("--dump-live", action="store_true",
+                    help="print every live jax array (shape/dtype/bytes) "
+                         "grouped by size — estimator calibration aid")
     ap.add_argument("--layer7b", action="store_true",
                     help="single-layer microbench at Llama-2-7B dims "
                          "(dim 4096, ffn 11008, 32q/32kv heads): per-layer "
@@ -227,6 +230,14 @@ def main():
 
     # -- live memory vs estimator ------------------------------------------
     live = sum(a.nbytes for a in jax.live_arrays())
+    if args_cli.dump_live:
+        from collections import Counter
+        groups = Counter()
+        for a in jax.live_arrays():
+            groups[(str(a.dtype), tuple(a.shape))] += a.nbytes
+        for (dt, shp), nb in sorted(groups.items(), key=lambda kv: -kv[1]):
+            print(f"# live {nb / 2**20:9.2f} MiB  {dt:10s} {shp}",
+                  file=sys.stderr, flush=True)
     layout = FedLLMLayout(
         n_params=n_params, n_lora_params=n_lora,
         n_clients=args_cli.clients_per_round, n_chips=1, model_shards=1,

@@ -262,9 +262,12 @@ class Attention(nn.Module):
         kf, vf = ck.value, cv.value                 # (b, h_kv, L, d)
         rep = cfg.n_heads // cfg.n_kv_heads
         qg = q.reshape(b, cfg.n_kv_heads, rep, s, head_dim)
-        scores = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
-                            kf.astype(qg.dtype)).astype(
-                                jnp.float32)         # grouped, no KV repeat
+        # f32 accumulation (same convention as ops/attention._block_scores:
+        # bf16-accumulated score dots caused the round-3 gradient NaNs, and
+        # int8-dequantized K carries magnitudes up to 127)
+        scores = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kf.astype(qg.dtype),
+                            preferred_element_type=jnp.float32)
+        # grouped, no KV repeat
         if int8_kv:
             # exact dequant: q·(k8*scale) == (q·k8)*scale (scale is
             # per-position) — the HBM read stays int8
@@ -278,7 +281,9 @@ class Attention(nn.Module):
             # fold v's per-position scale into probs, keep vf int8 in HBM
             probs = probs * cvs.value[:, :, None, None, :]
         probs = probs.astype(cfg.dtype)
-        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, vf.astype(cfg.dtype))
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, vf.astype(cfg.dtype),
+                         preferred_element_type=jnp.float32
+                         ).astype(cfg.dtype)
         out = out.reshape(b, cfg.n_heads, s, head_dim)
         out = out.transpose(0, 2, 1, 3).reshape(
             b, s, cfg.n_heads * head_dim)

@@ -424,8 +424,11 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         for i in live:
             s = self._slots[i]
             self._fds[i] = s.pos + 1  # draft confirmed through old cur
-            self.stats["proposed"] += self.k
             for j in range(self.k):
+                # count only proposals actually examined — eos/budget can
+                # truncate the acceptance loop mid-block, and charging the
+                # full k would understate real draft acceptance
+                self.stats["proposed"] += 1
                 dj, gj = int(d_host[i, j]), int(g_host[i, j])
                 s.pos += 1
                 if dj != gj:

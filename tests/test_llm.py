@@ -627,3 +627,45 @@ def test_param_storage_dtype_policy():
                       and l.ndim == 3 for k in path)]
     assert router and all(l.dtype == jnp.float32 for l in router)
     assert experts and all(l.dtype == jnp.bfloat16 for l in experts)
+
+
+def test_flash_autotune_fallback_policy(tmp_path, monkeypatch):
+    """VERDICT r3 item 3: untuned shapes must never silently take the
+    Pallas path — only shapes a sweep measured FASTER than blockwise get
+    tuned-table entries, and load_tuned_blocks skips losing shapes."""
+    import json
+    from fedml_tpu.ops import attention as A
+
+    # gate: tuned shape passes only on TPU; untuned never; env overrides
+    monkeypatch.setattr(A, "_on_tpu", lambda: True)
+    tuned_key = next(iter(A._TUNED_BLOCKS))
+    assert A._use_pallas(*tuned_key)
+    assert not A._use_pallas(12345, 77)          # untuned -> blockwise
+    monkeypatch.setenv("FEDML_TPU_FLASH_MODE", "off")
+    assert not A._use_pallas(*tuned_key)
+    monkeypatch.setenv("FEDML_TPU_FLASH_MODE", "force")
+    assert A._use_pallas(12345, 77)
+    monkeypatch.delenv("FEDML_TPU_FLASH_MODE")
+    monkeypatch.setattr(A, "_on_tpu", lambda: False)
+    assert not A._use_pallas(*tuned_key)         # CPU -> always blockwise
+
+    # loader: winner registered, loser skipped, junk lines tolerated
+    art = tmp_path / "TPU_FLASH_TUNE.json"
+    art.write_text(
+        "[tune] progress line\n" + json.dumps({
+            "metric": "flash_block_tune", "results": [
+                {"shape": "b4_h16_kv16_s777_d64",
+                 "best": {"bq": 256, "bk": 1024, "vs_blockwise": 2.4}},
+                {"shape": "b1_h8_kv8_s888_d128",
+                 "best": {"bq": 512, "bk": 512, "vs_blockwise": 0.7}},
+            ]}) + "\n")
+    before = dict(A._TUNED_BLOCKS)
+    try:
+        added = A.load_tuned_blocks(str(art))
+        assert added == 1
+        assert A._TUNED_BLOCKS[(777, 64)] == (256, 1024)
+        assert (888, 128) not in A._TUNED_BLOCKS
+        assert A.load_tuned_blocks(str(tmp_path / "missing.json")) == 0
+    finally:
+        A._TUNED_BLOCKS.clear()
+        A._TUNED_BLOCKS.update(before)

@@ -3,9 +3,12 @@
 template (reference ``serving/templates/hf_template/main_openai.py``)."""
 
 import json
+import os
 import pytest
 import threading
 import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import numpy as np
@@ -688,3 +691,29 @@ def test_server_speculative_batching_mode():
         assert st == 200 and _json.loads(body)["choices"][0]["text"]
     finally:
         srv.stop()
+
+
+@pytest.mark.slow
+def test_serve_rtt_harness_smoke(tmp_path):
+    """The RTT-injection harness (VERDICT r4 item 4) must run end-to-end,
+    keep greedy parity under injected latency, and show batching/horizon
+    amortizing dispatches vs sequential decode."""
+    import subprocess
+    import sys
+
+    out = str(tmp_path / "serve_rtt_sim.json")
+    r = subprocess.run(
+        [sys.executable, "tools/serve_rtt_harness.py", "--rtt-ms", "20",
+         "--tokens", "12", "--out", out],
+        cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(out) as f:
+        res = json.load(f)
+    lev = res["levers"]
+    # dispatch-count arithmetic is deterministic even when timings jitter
+    assert lev["batched_h8"]["tokens_per_dispatch"] > \
+        lev["batched_h1"]["tokens_per_dispatch"] > \
+        lev["seq_kv"]["tokens_per_dispatch"]
+    assert lev["spec_fused_selfdraft"]["acceptance"] == 1.0
+    # under 20ms injected RTT the horizon path must beat sequential
+    assert lev["batched_h8"]["tok_s"] > lev["seq_kv"]["tok_s"]

@@ -76,7 +76,13 @@ def main():
     print(f"[shard] {len(shards)} shards finished in "
           f"{time.time() - t0:.0f}s, rcs={rcs}", flush=True)
     # pytest exit 5 = "no tests collected" (a shard whose files were all
-    # deselected by -m) — that's success for the shard's purposes
+    # deselected by -m) — fine per shard, but if EVERY shard collected
+    # nothing (e.g. a typo'd -m expression) the run executed zero tests
+    # and must not report success
+    if all(rc == 5 for rc in rcs):
+        print("[shard] ERROR: no tests collected in ANY shard "
+              "(check the -m/-k expression)", flush=True)
+        sys.exit(5)
     sys.exit(max((0 if rc == 5 else rc) for rc in rcs))
 
 

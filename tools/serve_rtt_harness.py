@@ -1,0 +1,203 @@
+"""RTT-injection serving harness (round-4 VERDICT item 4).
+
+The round-3 TPU serve capture was dispatch-bound: every per-token program
+launch paid the ~70 ms tunnel RTT, so the committed numbers measured the
+tunnel, not the stack.  The levers built in round 3 (decode ``horizon``,
+fused speculative draft rounds, continuous batching) all attack exactly
+that: FEWER DISPATCHES PER TOKEN.  When the tunnel is wedged this harness
+demonstrates them under *simulated* latency on CPU: every jitted dispatch
+is wrapped with ``time.sleep(rtt)``, then tokens/sec is measured for
+
+- ``seq_kv``      — single-request KV-cached decode: 1 dispatch / token
+- ``batched_h1``  — 4-slot continuous batching, horizon 1:
+                    1 dispatch / (up to 4) tokens
+- ``batched_h8``  — horizon 8: 1 dispatch / (up to 32) tokens
+- ``spec_fused``  — speculative batching, k=4: 1 fused dispatch advances
+                    each slot up to k+1 tokens (draft+verify in ONE
+                    program — the round-3 "k+1 -> 2 dispatches" fusion,
+                    here 1 because the engine fuses both blocks)
+
+Under dispatch-dominated latency the expected ordering is
+``seq_kv < batched_h1 < batched_h8`` with ratios tracking the
+tokens-per-dispatch arithmetic; the JSON records measured tok/s, measured
+dispatch counts, and the per-lever amortization ratios.
+
+Usage: python tools/serve_rtt_harness.py [--rtt-ms 70] [--tokens 48]
+Writes SERVE_RTT_SIM.json at the repo root.
+
+Reference bar: the serving/model_scheduler inference path
+(/root/reference/python/fedml/serving/ + model_scheduler) has no analog
+lever — it serves per-request eager torch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("FEDML_TPU_PLATFORM") is None:
+    os.environ["FEDML_TPU_PLATFORM"] = "cpu"   # tunnel discipline
+
+
+def _sleepy(fn, rtt_s: float, counter: dict):
+    """Wrap a jitted callable: one injected RTT per dispatch."""
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        counter["dispatches"] += 1
+        time.sleep(rtt_s)
+        return fn(*a, **kw)
+    return wrapped
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rtt-ms", type=float, default=70.0,
+                    help="injected per-dispatch latency (the tunnel's ~70)")
+    ap.add_argument("--tokens", type=int, default=48,
+                    help="new tokens per request")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "SERVE_RTT_SIM.json"))
+    args = ap.parse_args()
+    rtt_s = args.rtt_ms / 1e3
+
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu  # noqa: F401 (backend pin)
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving import batching as B
+    from fedml_tpu.serving.templates import openai_compat as oc
+
+    slots, buf_len, k = 4, 128, 4
+    cfg = LlamaConfig(vocab_size=258, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=4, ffn_dim=128, max_seq_len=buf_len + k + 1,
+                      dtype=jnp.float32, lora_rank=0)
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # tiny draft = same arch (the fusion lever, not draft quality, is
+    # what the harness demonstrates)
+    draft_cfg = LlamaConfig(vocab_size=258, dim=32, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_dim=64,
+                            max_seq_len=buf_len + k + 1,
+                            dtype=jnp.float32, lora_rank=0)
+    draft = LlamaLM(draft_cfg)
+    draft_params = draft.init(jax.random.PRNGKey(1),
+                              jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = [5, 17, 42, 9, 33, 7]
+    n_req = slots  # one request per slot; engines admit all up front
+
+    result = {"rtt_ms": args.rtt_ms, "tokens_per_request": args.tokens,
+              "requests": n_req, "slots": slots, "levers": {}}
+
+    # -- seq_kv: single-request cached decode, 1 dispatch/token -----------
+    prefill, step = oc._build_cached_decode(model, 0, 1.0)
+    # warm compiles OUTSIDE the injected-latency window
+    ref = oc.generate(lambda p, t: model.apply({"params": p}, t), params,
+                      prompt, max_new_tokens=args.tokens, buf_len=buf_len,
+                      model=model)
+    ctr = {"dispatches": 0}
+    orig_build = oc._build_cached_decode
+    oc._build_cached_decode = lambda m, tk, tp: (
+        _sleepy(prefill, rtt_s, ctr), _sleepy(step, rtt_s, ctr))
+    try:
+        t0 = time.perf_counter()
+        outs = [oc.generate(None, params, prompt,
+                            max_new_tokens=args.tokens, buf_len=buf_len,
+                            model=model) for _ in range(n_req)]
+        dt = time.perf_counter() - t0
+    finally:
+        oc._build_cached_decode = orig_build
+    n_tok = sum(len(o) for o in outs)
+    assert all(o == ref for o in outs)
+    result["levers"]["seq_kv"] = {
+        "tok_s": round(n_tok / dt, 1), "dispatches": ctr["dispatches"],
+        "tokens_per_dispatch": round(n_tok / ctr["dispatches"], 2)}
+
+    # -- batched engines at horizon 1 and 8 --------------------------------
+    for name, horizon in (("batched_h1", 1), ("batched_h8", 8)):
+        eng = B.ContinuousBatchingEngine(model, params, slots=slots,
+                                         buf_len=buf_len, horizon=horizon)
+        try:
+            qs = [eng.submit(prompt, max_new_tokens=args.tokens)
+                  for _ in range(n_req)]  # warm-up tick compiles happen on
+            outs = [[t for t in iter(q.get, None)] for q in qs]
+            assert all(o == ref for o in outs), name
+            ctr = {"dispatches": 0}
+            eng._step = _sleepy(eng._step, rtt_s, ctr)
+            qs = [eng.submit(prompt, max_new_tokens=args.tokens)
+                  for _ in range(n_req)]
+            t0 = time.perf_counter()
+            outs = [[t for t in iter(q.get, None)] for q in qs]
+            dt = time.perf_counter() - t0
+        finally:
+            eng.stop()
+        n_tok = sum(len(o) for o in outs)
+        assert all(o == ref for o in outs), name
+        result["levers"][name] = {
+            "tok_s": round(n_tok / dt, 1), "dispatches": ctr["dispatches"],
+            "tokens_per_dispatch": round(n_tok / max(ctr["dispatches"], 1),
+                                         2)}
+
+    # -- fused speculative batching ----------------------------------------
+    # two bounds: a random-init tiny draft (acceptance ~0 — the lever's
+    # floor) and the target as its own draft (acceptance 1 — the ceiling,
+    # k+1 tokens per fused dispatch; a TRAINED draft lands in between)
+    for spec_name, d_model, d_params in (
+            ("spec_fused_tinydraft", draft, draft_params),
+            ("spec_fused_selfdraft", model, params)):
+        _run_spec(B, spec_name, model, params, d_model, d_params, slots,
+                  buf_len, k, prompt, args, rtt_s, ref, result)
+
+    seq = result["levers"]["seq_kv"]["tok_s"]
+    result.update({
+        "metric": "serve_rtt_amortization",
+        "value": round(result["levers"]["batched_h8"]["tok_s"] / seq, 2),
+        "unit": f"x_vs_seq_kv_at_{args.rtt_ms:.0f}ms_rtt",
+        "vs_baseline": None,
+        "amortization": {n: round(v["tok_s"] / seq, 2)
+                         for n, v in result["levers"].items()},
+    })
+    print(json.dumps(result))
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+
+
+def _run_spec(B, name, model, params, d_model, d_params, slots, buf_len, k,
+              prompt, args, rtt_s, ref, result):
+    n_req = slots
+    eng = B.SpeculativeBatchingEngine(model, params, d_model, d_params,
+                                      slots=slots, buf_len=buf_len, k=k)
+    try:
+        qs = [eng.submit(prompt, max_new_tokens=args.tokens)
+              for _ in range(n_req)]
+        outs = [[t for t in iter(q.get, None)] for q in qs]
+        assert all(o == ref for o in outs), f"{name} warmup parity"
+        ctr = {"dispatches": 0}
+        eng._spec_tick = _sleepy(eng._spec_tick, rtt_s, ctr)
+        qs = [eng.submit(prompt, max_new_tokens=args.tokens)
+              for _ in range(n_req)]
+        t0 = time.perf_counter()
+        outs = [[t for t in iter(q.get, None)] for q in qs]
+        dt = time.perf_counter() - t0
+        stats = dict(eng.stats)
+    finally:
+        eng.stop()
+    n_tok = sum(len(o) for o in outs)
+    assert all(o == ref for o in outs), f"{name} parity under injection"
+    result["levers"][name] = {
+        "tok_s": round(n_tok / dt, 1), "dispatches": ctr["dispatches"],
+        "tokens_per_dispatch": round(n_tok / max(ctr["dispatches"], 1), 2),
+        "acceptance": round(stats.get("accepted", 0)
+                            / max(stats.get("proposed", 1), 1), 3)}
+
+
+if __name__ == "__main__":
+    main()

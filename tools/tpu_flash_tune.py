@@ -121,7 +121,9 @@ def main():
         shape_key = f"b{b}_h{h}_kv{h_kv}_s{s}_d{d}"
         results.append({"shape": shape_key, "blockwise_s": round(base_s, 6),
                         "rows": rows, "best": best, "bwd_s_at_best": bwd_s})
-        if best is not None:
+        # autotune-or-fallback: only shapes where flash WINS get a table
+        # entry; losers stay on the blockwise path (attention._use_pallas)
+        if best is not None and best["vs_blockwise"] >= 1.0:
             table[(s, d)] = (best["bq"], best["bk"])
         print(f"[tune] {shape_key}: blockwise {base_s*1e3:.2f}ms "
               f"best {best}", flush=True)

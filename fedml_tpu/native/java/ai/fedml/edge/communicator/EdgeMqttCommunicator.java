@@ -1,0 +1,410 @@
+package ai.fedml.edge.communicator;
+
+import java.io.ByteArrayOutputStream;
+import java.io.EOFException;
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.Map;
+import java.util.concurrent.ConcurrentHashMap;
+import java.util.concurrent.CopyOnWriteArrayList;
+import java.util.concurrent.CountDownLatch;
+import java.util.concurrent.TimeUnit;
+import java.util.concurrent.atomic.AtomicBoolean;
+import java.util.concurrent.atomic.AtomicInteger;
+
+/**
+ * MQTT 3.1.1 edge communicator over a plain TCP socket.
+ *
+ * <p>Mirrors the role of the reference's paho-backed
+ * android/fedmlsdk/src/main/java/ai/fedml/edge/service/communicator/
+ * EdgeCommunicator.java (topic-&gt;listener subscription map, last-will
+ * registration, auto-reconnect with subscription replay) but implements
+ * the OASIS MQTT 3.1.1 wire protocol directly — the same subset the
+ * Python side's {@code mini_mqtt.py} client / {@code mini_broker.py}
+ * broker speak (CONNECT/CONNACK, PUBLISH QoS 0/1 with PUBACK,
+ * SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT,
+ * last-will, retained delivery), so a Java edge client and the Python
+ * federation plane interoperate over one broker with no third-party
+ * MQTT dependency on either side.</p>
+ */
+public final class EdgeMqttCommunicator {
+    // control packet types (MQTT 3.1.1 section 2.2.1)
+    private static final int CONNECT = 0x10;
+    private static final int CONNACK = 0x20;
+    private static final int PUBLISH = 0x30;
+    private static final int PUBACK = 0x40;
+    private static final int SUBSCRIBE = 0x82;
+    private static final int SUBACK = 0x90;
+    private static final int UNSUBSCRIBE = 0xA2;
+    private static final int UNSUBACK = 0xB0;
+    private static final int PINGREQ = 0xC0;
+    private static final int PINGRESP = 0xD0;
+    private static final int DISCONNECT = 0xE0;
+
+    private final String host;
+    private final int port;
+    private final String clientId;
+    private final int keepAliveS;
+    private final Map<String, SubEntry> subscriptions =
+            new ConcurrentHashMap<>();
+    private final CopyOnWriteArrayList<OnMqttConnectionReadyListener>
+            readyListeners = new CopyOnWriteArrayList<>();
+    private final Map<Integer, CountDownLatch> pendingAcks =
+            new ConcurrentHashMap<>();
+    private final AtomicInteger nextPacketId = new AtomicInteger(1);
+    private final AtomicBoolean running = new AtomicBoolean(false);
+
+    private volatile Socket socket;
+    private volatile OutputStream out;
+    private volatile Thread readerThread;
+    private volatile Thread pingThread;
+    private String willTopic;
+    private byte[] willPayload;
+    private int willQos;
+    private boolean willRetain;
+
+    private static final class SubEntry {
+        final int qos;
+        final OnReceivedListener listener;
+
+        SubEntry(int qos, OnReceivedListener listener) {
+            this.qos = qos;
+            this.listener = listener;
+        }
+    }
+
+    public EdgeMqttCommunicator(String host, int port, String clientId,
+                                int keepAliveS) {
+        this.host = host;
+        this.port = port;
+        this.clientId = clientId;
+        this.keepAliveS = keepAliveS;
+    }
+
+    /** Register the last-will message; must be called before connect(). */
+    public void setWill(String topic, byte[] payload, int qos,
+                        boolean retain) {
+        this.willTopic = topic;
+        this.willPayload = payload;
+        this.willQos = qos;
+        this.willRetain = retain;
+    }
+
+    public void addConnectionReadyListener(OnMqttConnectionReadyListener l) {
+        readyListeners.add(l);
+    }
+
+    // -- wire helpers ------------------------------------------------------
+    private static void writeRemainingLength(ByteArrayOutputStream b,
+                                             int len) {
+        // variable-length encoding, 7 bits per byte (section 2.2.3)
+        do {
+            int digit = len % 128;
+            len /= 128;
+            b.write(len > 0 ? digit | 0x80 : digit);
+        } while (len > 0);
+    }
+
+    private static void writeString(ByteArrayOutputStream b, String s) {
+        byte[] raw = s.getBytes(StandardCharsets.UTF_8);
+        b.write(raw.length >> 8);
+        b.write(raw.length & 0xFF);
+        b.write(raw, 0, raw.length);
+    }
+
+    private static int readRemainingLength(InputStream in)
+            throws IOException {
+        int len = 0;
+        int mult = 1;
+        for (int i = 0; i < 4; i++) {
+            int digit = readByte(in);
+            len += (digit & 0x7F) * mult;
+            if ((digit & 0x80) == 0) {
+                return len;
+            }
+            mult *= 128;
+        }
+        throw new IOException("malformed remaining length");
+    }
+
+    private static int readByte(InputStream in) throws IOException {
+        int b = in.read();
+        if (b < 0) {
+            throw new EOFException("broker closed connection");
+        }
+        return b;
+    }
+
+    private static byte[] readFully(InputStream in, int n)
+            throws IOException {
+        byte[] buf = new byte[n];
+        int off = 0;
+        while (off < n) {
+            int r = in.read(buf, off, n - off);
+            if (r < 0) {
+                throw new EOFException("short packet");
+            }
+            off += r;
+        }
+        return buf;
+    }
+
+    private void send(int header, byte[] body) throws IOException {
+        ByteArrayOutputStream b = new ByteArrayOutputStream();
+        b.write(header);
+        writeRemainingLength(b, body.length);
+        b.write(body, 0, body.length);
+        OutputStream o = out;
+        if (o == null) {
+            throw new IOException("not connected");
+        }
+        synchronized (this) {
+            o.write(b.toByteArray());
+            o.flush();
+        }
+    }
+
+    // -- lifecycle ---------------------------------------------------------
+    public synchronized void connect() throws IOException {
+        socket = new Socket(host, port);
+        socket.setTcpNoDelay(true);
+        out = socket.getOutputStream();
+        InputStream in = socket.getInputStream();
+
+        ByteArrayOutputStream body = new ByteArrayOutputStream();
+        writeString(body, "MQTT");
+        body.write(4);                       // protocol level 3.1.1
+        int flags = 0x02;                    // clean session
+        if (willTopic != null) {
+            flags |= 0x04 | (willQos << 3) | (willRetain ? 0x20 : 0);
+        }
+        body.write(flags);
+        body.write(keepAliveS >> 8);
+        body.write(keepAliveS & 0xFF);
+        writeString(body, clientId);
+        if (willTopic != null) {
+            writeString(body, willTopic);
+            body.write(willPayload.length >> 8);
+            body.write(willPayload.length & 0xFF);
+            body.write(willPayload, 0, willPayload.length);
+        }
+        send(CONNECT, body.toByteArray());
+
+        int header = readByte(in);
+        int len = readRemainingLength(in);
+        byte[] ack = readFully(in, len);
+        if ((header & 0xF0) != CONNACK || len != 2 || ack[1] != 0) {
+            throw new IOException("CONNACK refused: rc="
+                    + (len == 2 ? ack[1] : -1));
+        }
+        boolean sessionPresent = (ack[0] & 0x01) != 0;
+
+        running.set(true);
+        readerThread = new Thread(() -> readLoop(in), "mqtt-edge-reader");
+        readerThread.setDaemon(true);
+        readerThread.start();
+        pingThread = new Thread(this::pingLoop, "mqtt-edge-ping");
+        pingThread.setDaemon(true);
+        pingThread.start();
+
+        // replay subscriptions (auto-reconnect path; no-op first time)
+        for (Map.Entry<String, SubEntry> e : subscriptions.entrySet()) {
+            sendSubscribe(e.getKey(), e.getValue().qos);
+        }
+        for (OnMqttConnectionReadyListener l : readyListeners) {
+            l.onReady(sessionPresent);
+        }
+    }
+
+    public void disconnect() {
+        running.set(false);
+        try {
+            send(DISCONNECT, new byte[0]);
+        } catch (IOException ignored) {
+        }
+        closeQuietly();
+    }
+
+    private void closeQuietly() {
+        Socket s = socket;
+        if (s != null) {
+            try {
+                s.close();
+            } catch (IOException ignored) {
+            }
+        }
+    }
+
+    /** Reconnect with exponential backoff; replays subscriptions. */
+    private void reconnectLoop(Throwable cause) {
+        for (OnMqttConnectionReadyListener l : readyListeners) {
+            l.onLost(cause);
+        }
+        long backoffMs = 500;
+        while (running.get()) {
+            try {
+                Thread.sleep(backoffMs);
+                connect();
+                return;
+            } catch (InterruptedException e) {
+                Thread.currentThread().interrupt();
+                return;
+            } catch (IOException e) {
+                backoffMs = Math.min(backoffMs * 2, 30_000);
+            }
+        }
+    }
+
+    // -- pub/sub -----------------------------------------------------------
+    public void publish(String topic, byte[] payload, int qos,
+                        boolean retain) throws IOException {
+        if (qos < 0 || qos > 1) {
+            throw new IllegalArgumentException(
+                    "publish qos 0/1 supported, got " + qos);
+        }
+        ByteArrayOutputStream body = new ByteArrayOutputStream();
+        writeString(body, topic);
+        CountDownLatch ackLatch = null;
+        int pid = 0;
+        if (qos == 1) {
+            pid = nextPacketId.getAndUpdate(p -> p >= 0xFFFF ? 1 : p + 1);
+            body.write(pid >> 8);
+            body.write(pid & 0xFF);
+            ackLatch = new CountDownLatch(1);
+            pendingAcks.put(pid, ackLatch);
+        }
+        body.write(payload, 0, payload.length);
+        int header = PUBLISH | (qos << 1) | (retain ? 1 : 0);
+        send(header, body.toByteArray());
+        if (ackLatch != null) {
+            try {
+                if (!ackLatch.await(30, TimeUnit.SECONDS)) {
+                    throw new IOException("PUBACK timeout pid=" + pid);
+                }
+            } catch (InterruptedException e) {
+                Thread.currentThread().interrupt();
+                throw new IOException("interrupted awaiting PUBACK");
+            } finally {
+                pendingAcks.remove(pid);
+            }
+        }
+    }
+
+    public void subscribe(String topicFilter, int qos,
+                          OnReceivedListener listener) throws IOException {
+        subscriptions.put(topicFilter, new SubEntry(qos, listener));
+        sendSubscribe(topicFilter, qos);
+    }
+
+    public void unsubscribe(String topicFilter) throws IOException {
+        subscriptions.remove(topicFilter);
+        int pid = nextPacketId.getAndUpdate(p -> p >= 0xFFFF ? 1 : p + 1);
+        ByteArrayOutputStream body = new ByteArrayOutputStream();
+        body.write(pid >> 8);
+        body.write(pid & 0xFF);
+        writeString(body, topicFilter);
+        send(UNSUBSCRIBE, body.toByteArray());
+    }
+
+    private void sendSubscribe(String topicFilter, int qos)
+            throws IOException {
+        int pid = nextPacketId.getAndUpdate(p -> p >= 0xFFFF ? 1 : p + 1);
+        ByteArrayOutputStream body = new ByteArrayOutputStream();
+        body.write(pid >> 8);
+        body.write(pid & 0xFF);
+        writeString(body, topicFilter);
+        body.write(qos);
+        send(SUBSCRIBE, body.toByteArray());
+    }
+
+    /** MQTT topic filter match with +/# wildcards (section 4.7). */
+    static boolean topicMatches(String filter, String topic) {
+        String[] f = filter.split("/", -1);
+        String[] t = topic.split("/", -1);
+        int i = 0;
+        for (; i < f.length; i++) {
+            if (f[i].equals("#")) {
+                return true;
+            }
+            if (i >= t.length) {
+                return false;
+            }
+            if (!f[i].equals("+") && !f[i].equals(t[i])) {
+                return false;
+            }
+        }
+        return i == t.length;
+    }
+
+    // -- inbound -----------------------------------------------------------
+    private void readLoop(InputStream in) {
+        try {
+            while (running.get()) {
+                int header = readByte(in);
+                int len = readRemainingLength(in);
+                byte[] body = readFully(in, len);
+                switch (header & 0xF0) {
+                    case PUBLISH & 0xF0:
+                        handlePublish(header, body);
+                        break;
+                    case PUBACK:
+                        int pid = ((body[0] & 0xFF) << 8) | (body[1] & 0xFF);
+                        CountDownLatch latch = pendingAcks.get(pid);
+                        if (latch != null) {
+                            latch.countDown();
+                        }
+                        break;
+                    case PINGRESP:
+                    case SUBACK:
+                    case UNSUBACK:
+                        break;          // fire-and-forget acknowledgements
+                    default:
+                        throw new IOException(String.format(
+                                "unexpected packet 0x%02x", header));
+                }
+            }
+        } catch (IOException e) {
+            closeQuietly();
+            if (running.get()) {
+                reconnectLoop(e);
+            }
+        }
+    }
+
+    private void handlePublish(int header, byte[] body) throws IOException {
+        int qos = (header >> 1) & 0x03;
+        int tlen = ((body[0] & 0xFF) << 8) | (body[1] & 0xFF);
+        String topic = new String(body, 2, tlen, StandardCharsets.UTF_8);
+        int off = 2 + tlen;
+        if (qos > 0) {
+            int pid = ((body[off] & 0xFF) << 8) | (body[off + 1] & 0xFF);
+            off += 2;
+            send(PUBACK, new byte[]{(byte) (pid >> 8), (byte) pid});
+        }
+        byte[] payload = new byte[body.length - off];
+        System.arraycopy(body, off, payload, 0, payload.length);
+        for (Map.Entry<String, SubEntry> e : subscriptions.entrySet()) {
+            if (topicMatches(e.getKey(), topic)) {
+                e.getValue().listener.onReceived(topic, payload);
+            }
+        }
+    }
+
+    private void pingLoop() {
+        long intervalMs = Math.max(1, keepAliveS / 2) * 1000L;
+        while (running.get()) {
+            try {
+                Thread.sleep(intervalMs);
+                send(PINGREQ, new byte[0]);
+            } catch (InterruptedException e) {
+                Thread.currentThread().interrupt();
+                return;
+            } catch (IOException e) {
+                return;                 // reader loop owns reconnection
+            }
+        }
+    }
+}

@@ -1,0 +1,13 @@
+package ai.fedml.edge.communicator;
+
+/**
+ * Connection lifecycle callback (reference android/fedmlsdk
+ * service/communicator/OnMqttConnectionReadyListener.java).  {@code
+ * onReady} fires after CONNACK — including after an automatic reconnect,
+ * once the session's subscriptions have been replayed.
+ */
+public interface OnMqttConnectionReadyListener {
+    void onReady(boolean sessionPresent);
+
+    void onLost(Throwable cause);
+}

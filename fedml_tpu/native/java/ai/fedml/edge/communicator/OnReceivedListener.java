@@ -1,0 +1,9 @@
+package ai.fedml.edge.communicator;
+
+/**
+ * Delivery callback for {@link EdgeMqttCommunicator} subscriptions
+ * (reference android/fedmlsdk service/communicator/OnReceivedListener.java).
+ */
+public interface OnReceivedListener {
+    void onReceived(String topic, byte[] payload);
+}

@@ -1,0 +1,48 @@
+package ai.fedml.edge.constants;
+
+/**
+ * Topic scheme shared with the Python federation plane
+ * ({@code fedml_tpu/core/distributed/communication/mqtt/
+ * mqtt_s3_comm_manager.py}): point-to-point frames ride
+ * {@code fedml_{runId}_{sender}_{receiver}} and liveness/status rides
+ * {@code fedml_{runId}/status/{rank}} (also the last-will topic, so the
+ * broker announces ungraceful death).  Role analog of the reference's
+ * android/fedmlsdk constants/FedMqttTopic.java.
+ */
+public final class FedMqttTopic {
+
+    private FedMqttTopic() {
+    }
+
+    public static String message(long runId, int sender, int receiver) {
+        return "fedml_" + runId + "_" + sender + "_" + receiver;
+    }
+
+    /**
+     * Exact per-sender subscription topics for {@code rank}'s inbox.
+     * Message topics use {@code _} separators, so the whole topic is ONE
+     * MQTT level and a {@code +} wildcard can never match it — like the
+     * Python comm manager (mqtt_s3_comm_manager.py:73), receivers
+     * subscribe one exact topic per expected sender.
+     */
+    public static String[] inbox(long runId, int rank, int[] senders) {
+        String[] topics = new String[senders.length];
+        for (int i = 0; i < senders.length; i++) {
+            topics[i] = message(runId, senders[i], rank);
+        }
+        return topics;
+    }
+
+    public static String status(long runId, int rank) {
+        return "fedml_" + runId + "/status/" + rank;
+    }
+
+    /** MLOps telemetry (system metrics, progress events). */
+    public static String telemetry(long runId, long edgeId) {
+        return "fedml_" + runId + "/mlops/" + edgeId;
+    }
+
+    public static String lastWill(long runId, int rank) {
+        return status(runId, rank);
+    }
+}

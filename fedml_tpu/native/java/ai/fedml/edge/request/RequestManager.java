@@ -1,0 +1,283 @@
+package ai.fedml.edge.request;
+
+import java.io.ByteArrayOutputStream;
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.HttpURLConnection;
+import java.net.URL;
+import java.nio.charset.StandardCharsets;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
+
+import ai.fedml.edge.request.listener.OnBindingListener;
+import ai.fedml.edge.request.listener.OnConfigListener;
+import ai.fedml.edge.request.listener.OnLogUploadListener;
+import ai.fedml.edge.request.listener.OnUnboundListener;
+import ai.fedml.edge.request.listener.OnUserInfoListener;
+import ai.fedml.edge.request.parameter.BindingAccountReq;
+import ai.fedml.edge.request.parameter.LogUploadReq;
+import ai.fedml.edge.request.response.BindingResponse;
+import ai.fedml.edge.request.response.ConfigResponse;
+import ai.fedml.edge.request.response.UserInfoResponse;
+
+/**
+ * Async HTTP client for the MLOps control plane: account binding,
+ * unbinding, user info, run config fetch, and log upload (role analog of
+ * the reference's android/fedmlsdk request/RequestManager.java, which
+ * drives the hosted MLOps REST backend).  Endpoints are served here by
+ * the scheduler/MLOps gateway of the Python plane; the base URL is
+ * injected via {@link #setBaseUrl} so tests point it at a local server.
+ * JSON encode/decode is handled by {@link Json} — flat-object subset, no
+ * third-party dependency.
+ */
+public final class RequestManager {
+    private static volatile String baseUrl = "http://127.0.0.1:18080";
+    private static final ExecutorService POOL =
+            Executors.newFixedThreadPool(2, r -> {
+                Thread t = new Thread(r, "fedml-request");
+                t.setDaemon(true);
+                return t;
+            });
+
+    private RequestManager() {
+    }
+
+    public static void setBaseUrl(String url) {
+        baseUrl = url;
+    }
+
+    public static void bindingAccount(BindingAccountReq req,
+                                      OnBindingListener listener) {
+        POOL.execute(() -> {
+            try {
+                String body = Json.object(
+                        "account_id", req.getAccountId(),
+                        "device_id", req.getDeviceId(),
+                        "os_name", req.getOsName());
+                Map<String, String> resp = Json.parse(
+                        http("POST", "/fedmlOpsServer/edges/binding", body));
+                listener.onDeviceBound(new BindingResponse(
+                        resp.getOrDefault("edge_id", ""),
+                        resp.getOrDefault("account_id",
+                                req.getAccountId())));
+            } catch (IOException e) {
+                listener.onDeviceBindingFailed(e.getMessage());
+            }
+        });
+    }
+
+    public static void unboundAccount(String edgeId,
+                                      OnUnboundListener listener) {
+        POOL.execute(() -> {
+            try {
+                http("POST", "/fedmlOpsServer/edges/unbound",
+                        Json.object("edge_id", edgeId));
+                listener.onDeviceUnbound(true);
+            } catch (IOException e) {
+                listener.onDeviceUnbound(false);
+            }
+        });
+    }
+
+    public static void getUserInfo(String edgeId,
+                                   OnUserInfoListener listener) {
+        POOL.execute(() -> {
+            try {
+                Map<String, String> resp = Json.parse(http(
+                        "GET", "/fedmlOpsServer/users/info?edge_id="
+                                + edgeId, null));
+                listener.onGetUserInfo(new UserInfoResponse(
+                        resp.getOrDefault("user_id", ""),
+                        resp.getOrDefault("account_id", "")));
+            } catch (IOException e) {
+                listener.onGetUserInfo(null);
+            }
+        });
+    }
+
+    public static void fetchConfig(OnConfigListener listener) {
+        POOL.execute(() -> {
+            try {
+                Map<String, String> resp = Json.parse(http(
+                        "GET", "/fedmlOpsServer/configs/fetch", null));
+                listener.onConfig(new ConfigResponse(
+                        resp.getOrDefault("mqtt_host", "127.0.0.1"),
+                        Integer.parseInt(
+                                resp.getOrDefault("mqtt_port", "1883")),
+                        resp.getOrDefault("store_dir", "")));
+            } catch (IOException | NumberFormatException e) {
+                listener.onConfig(null);
+            }
+        });
+    }
+
+    public static void uploadLog(LogUploadReq req,
+                                 OnLogUploadListener listener) {
+        POOL.execute(() -> {
+            try {
+                StringBuilder lines = new StringBuilder("[");
+                List<String> logs = req.getLogLines();
+                for (int i = 0; i < logs.size(); i++) {
+                    if (i > 0) {
+                        lines.append(',');
+                    }
+                    lines.append(Json.quote(logs.get(i)));
+                }
+                lines.append(']');
+                String body = "{\"run_id\":" + req.getRunId()
+                        + ",\"edge_id\":" + req.getEdgeId()
+                        + ",\"logs\":" + lines + "}";
+                http("POST", "/fedmlOpsServer/logs/update", body);
+                listener.onLogUploaded(true);
+            } catch (IOException e) {
+                listener.onLogUploaded(false);
+            }
+        });
+    }
+
+    // -- transport ---------------------------------------------------------
+    private static String http(String method, String path, String jsonBody)
+            throws IOException {
+        HttpURLConnection conn = (HttpURLConnection)
+                new URL(baseUrl + path).openConnection();
+        conn.setRequestMethod(method);
+        conn.setConnectTimeout(10_000);
+        conn.setReadTimeout(30_000);
+        if (jsonBody != null) {
+            conn.setDoOutput(true);
+            conn.setRequestProperty("Content-Type", "application/json");
+            try (OutputStream os = conn.getOutputStream()) {
+                os.write(jsonBody.getBytes(StandardCharsets.UTF_8));
+            }
+        }
+        int code = conn.getResponseCode();
+        if (code / 100 != 2) {
+            throw new IOException("HTTP " + code + " for " + path);
+        }
+        try (InputStream in = conn.getInputStream()) {
+            ByteArrayOutputStream buf = new ByteArrayOutputStream();
+            byte[] chunk = new byte[4096];
+            int n;
+            while ((n = in.read(chunk)) > 0) {
+                buf.write(chunk, 0, n);
+            }
+            return buf.toString("UTF-8");
+        } finally {
+            conn.disconnect();
+        }
+    }
+
+    /** Flat-JSON helper (string values; enough for the control plane). */
+    static final class Json {
+        private Json() {
+        }
+
+        static String quote(String s) {
+            StringBuilder b = new StringBuilder("\"");
+            for (int i = 0; i < s.length(); i++) {
+                char c = s.charAt(i);
+                if (c == '"' || c == '\\') {
+                    b.append('\\').append(c);
+                } else if (c == '\n') {
+                    b.append("\\n");
+                } else if (c < 0x20) {
+                    b.append(String.format("\\u%04x", (int) c));
+                } else {
+                    b.append(c);
+                }
+            }
+            return b.append('"').toString();
+        }
+
+        static String object(String... kv) {
+            StringBuilder b = new StringBuilder("{");
+            for (int i = 0; i < kv.length; i += 2) {
+                if (i > 0) {
+                    b.append(',');
+                }
+                b.append(quote(kv[i])).append(':').append(quote(kv[i + 1]));
+            }
+            return b.append('}').toString();
+        }
+
+        /** Parse a FLAT json object; nested values are returned raw. */
+        static Map<String, String> parse(String s) throws IOException {
+            java.util.HashMap<String, String> outMap =
+                    new java.util.HashMap<>();
+            int i = s.indexOf('{');
+            if (i < 0) {
+                throw new IOException("not a json object");
+            }
+            i++;
+            while (i < s.length()) {
+                while (i < s.length() && (Character.isWhitespace(s.charAt(i))
+                        || s.charAt(i) == ',')) {
+                    i++;
+                }
+                if (i >= s.length() || s.charAt(i) == '}') {
+                    break;
+                }
+                if (s.charAt(i) != '"') {
+                    throw new IOException("expected key at " + i);
+                }
+                int[] pos = {i};
+                String key = readString(s, pos);
+                i = pos[0];
+                while (i < s.length() && s.charAt(i) != ':') {
+                    i++;
+                }
+                i++;
+                while (i < s.length()
+                        && Character.isWhitespace(s.charAt(i))) {
+                    i++;
+                }
+                if (s.charAt(i) == '"') {
+                    pos[0] = i;
+                    outMap.put(key, readString(s, pos));
+                    i = pos[0];
+                } else {
+                    int j = i;
+                    int depth = 0;
+                    while (j < s.length()) {
+                        char c = s.charAt(j);
+                        if (c == '{' || c == '[') {
+                            depth++;
+                        } else if (c == '}' || c == ']') {
+                            if (depth == 0) {
+                                break;
+                            }
+                            depth--;
+                        } else if (c == ',' && depth == 0) {
+                            break;
+                        }
+                        j++;
+                    }
+                    outMap.put(key, s.substring(i, j).trim());
+                    i = j;
+                }
+            }
+            return outMap;
+        }
+
+        private static String readString(String s, int[] pos) {
+            StringBuilder b = new StringBuilder();
+            int i = pos[0] + 1;                     // skip opening quote
+            while (i < s.length() && s.charAt(i) != '"') {
+                char c = s.charAt(i);
+                if (c == '\\' && i + 1 < s.length()) {
+                    i++;
+                    char e = s.charAt(i);
+                    b.append(e == 'n' ? '\n' : e);
+                } else {
+                    b.append(c);
+                }
+                i++;
+            }
+            pos[0] = i + 1;                         // past closing quote
+            return b.toString();
+        }
+    }
+}

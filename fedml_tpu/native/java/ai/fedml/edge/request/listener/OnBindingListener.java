@@ -1,0 +1,10 @@
+package ai.fedml.edge.request.listener;
+
+import ai.fedml.edge.request.response.BindingResponse;
+
+/** Binding outcome callback (reference request/listener analog). */
+public interface OnBindingListener {
+    void onDeviceBound(BindingResponse response);
+
+    void onDeviceBindingFailed(String reason);
+}

@@ -1,0 +1,8 @@
+package ai.fedml.edge.request.listener;
+
+import ai.fedml.edge.request.response.ConfigResponse;
+
+/** Config fetch callback; {@code null} signals the fetch failed. */
+public interface OnConfigListener {
+    void onConfig(ConfigResponse config);
+}

@@ -1,0 +1,5 @@
+package ai.fedml.edge.request.listener;
+
+public interface OnLogUploadListener {
+    void onLogUploaded(boolean ok);
+}

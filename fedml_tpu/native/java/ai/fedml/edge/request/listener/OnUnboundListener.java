@@ -1,0 +1,5 @@
+package ai.fedml.edge.request.listener;
+
+public interface OnUnboundListener {
+    void onDeviceUnbound(boolean ok);
+}

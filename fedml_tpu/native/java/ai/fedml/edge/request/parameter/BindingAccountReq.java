@@ -1,0 +1,27 @@
+package ai.fedml.edge.request.parameter;
+
+/** Account-binding request (reference request/parameter analog). */
+public final class BindingAccountReq {
+    private final String accountId;
+    private final String deviceId;
+    private final String osName;
+
+    public BindingAccountReq(String accountId, String deviceId,
+                             String osName) {
+        this.accountId = accountId;
+        this.deviceId = deviceId;
+        this.osName = osName;
+    }
+
+    public String getAccountId() {
+        return accountId;
+    }
+
+    public String getDeviceId() {
+        return deviceId;
+    }
+
+    public String getOsName() {
+        return osName;
+    }
+}

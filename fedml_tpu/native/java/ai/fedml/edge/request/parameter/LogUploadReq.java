@@ -1,0 +1,27 @@
+package ai.fedml.edge.request.parameter;
+
+import java.util.List;
+
+public final class LogUploadReq {
+    private final long runId;
+    private final long edgeId;
+    private final List<String> logLines;
+
+    public LogUploadReq(long runId, long edgeId, List<String> logLines) {
+        this.runId = runId;
+        this.edgeId = edgeId;
+        this.logLines = logLines;
+    }
+
+    public long getRunId() {
+        return runId;
+    }
+
+    public long getEdgeId() {
+        return edgeId;
+    }
+
+    public List<String> getLogLines() {
+        return logLines;
+    }
+}

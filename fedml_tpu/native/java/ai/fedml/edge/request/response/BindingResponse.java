@@ -1,0 +1,19 @@
+package ai.fedml.edge.request.response;
+
+public final class BindingResponse {
+    private final String edgeId;
+    private final String accountId;
+
+    public BindingResponse(String edgeId, String accountId) {
+        this.edgeId = edgeId;
+        this.accountId = accountId;
+    }
+
+    public String getEdgeId() {
+        return edgeId;
+    }
+
+    public String getAccountId() {
+        return accountId;
+    }
+}

@@ -1,0 +1,26 @@
+package ai.fedml.edge.request.response;
+
+/** MQTT/storage endpoints handed to a freshly bound edge device. */
+public final class ConfigResponse {
+    private final String mqttHost;
+    private final int mqttPort;
+    private final String storeDir;
+
+    public ConfigResponse(String mqttHost, int mqttPort, String storeDir) {
+        this.mqttHost = mqttHost;
+        this.mqttPort = mqttPort;
+        this.storeDir = storeDir;
+    }
+
+    public String getMqttHost() {
+        return mqttHost;
+    }
+
+    public int getMqttPort() {
+        return mqttPort;
+    }
+
+    public String getStoreDir() {
+        return storeDir;
+    }
+}

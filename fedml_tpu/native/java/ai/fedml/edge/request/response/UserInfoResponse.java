@@ -1,0 +1,19 @@
+package ai.fedml.edge.request.response;
+
+public final class UserInfoResponse {
+    private final String userId;
+    private final String accountId;
+
+    public UserInfoResponse(String userId, String accountId) {
+        this.userId = userId;
+        this.accountId = accountId;
+    }
+
+    public String getUserId() {
+        return userId;
+    }
+
+    public String getAccountId() {
+        return accountId;
+    }
+}

@@ -24,6 +24,7 @@ TPU-native serving decisions:
 
 from __future__ import annotations
 
+import collections
 import functools
 import json
 import logging
@@ -137,13 +138,94 @@ def _build_cached_decode(model, top_k: int, top_p: float):
     return prefill, step
 
 
+class PrefixCache:
+    """LRU cache of prefill KV states keyed by prompt token prefix.
+
+    Serving workloads re-send shared prefixes constantly (a system
+    prompt, a federated-eval template) — this skips prefill work for the
+    longest cached prefix: an exact hit replays one idempotent decode
+    step (re-writing the last position with identical K/V) instead of
+    the whole prefill; a prefix hit continues from the cached state
+    through only the unseen tail tokens.  vLLM calls the idea automatic
+    prefix caching; the reference's serving path
+    (/root/reference/python/fedml/serving/) re-forwards every request
+    from scratch.
+
+    Greedy outputs are BIT-IDENTICAL with or without the cache (pinned
+    by test).  Sampled requests draw a different-but-equally-distributed
+    key sequence (the prefill split is skipped), so seeds don't
+    reproduce across cache states — same caveat vLLM documents.
+
+    Memory: ``capacity`` x one full KV buffer (layers x 2 x B x H_kv x
+    buf_len x head_dim in the model's KV dtype); size capacity to HBM.
+    Entries are immutable jax arrays, so sharing them across requests
+    and threads is safe; the dict itself is guarded by a lock.
+    """
+
+    def __init__(self, capacity: int = 8):
+        self.capacity = int(capacity)
+        self._entries = collections.OrderedDict()   # tuple(ids) -> cache
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "exact_hits": 0, "misses": 0,
+                      "insertions": 0, "prefill_tokens_skipped": 0}
+
+    def lookup(self, ids: List[int]):
+        """Longest COMMON prefix between ``ids`` and any cached entry →
+        (c, cache) or (0, None).  A cached buffer whose prompt diverges
+        after position c is still valid for the first c tokens: decode
+        steps attend only positions <= their own, and each step writes
+        its position's K/V before attending, so the stale tail
+        progressively self-heals (the same mask-discipline argument the
+        speculative verify blocks rely on)."""
+        t = tuple(ids)
+        with self._lock:
+            best, best_key = 0, None
+            for key in self._entries:
+                c = 0
+                for a, b in zip(key, t):
+                    if a != b:
+                        break
+                    c += 1
+                if c > best:
+                    best, best_key = c, key
+            # hit policy: the uncached tail replays as single-token steps
+            # (one dispatch each), so a SHORT common prefix would be
+            # slower than one prefill dispatch — take the hit only when
+            # the tail is at most max(4, n/4) tokens (>= ~75% of prefill
+            # work skipped); otherwise report a miss and let the caller
+            # prefill from scratch
+            if best_key is not None and \
+                    len(t) - best <= max(4, len(t) // 4):
+                self._entries.move_to_end(best_key)   # LRU recency
+                cache = self._entries[best_key]
+                self.stats["hits"] += 1
+                if best == len(t):
+                    self.stats["exact_hits"] += 1
+                self.stats["prefill_tokens_skipped"] += best
+                return best, cache
+            self.stats["misses"] += 1
+            return 0, None
+
+    def insert(self, ids: List[int], cache) -> None:
+        t = tuple(ids)
+        with self._lock:
+            if t in self._entries:
+                self._entries.move_to_end(t)
+                return
+            self._entries[t] = cache
+            self.stats["insertions"] += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+
 def generate(apply_fn: Callable, params, prompt_ids: List[int],
              max_new_tokens: int = 64, temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0, seed: int = 0,
              buf_len: int = 256,
              eos_id: Optional[int] = None,
              on_token: Optional[Callable[[int], None]] = None,
-             model=None) -> List[int]:
+             model=None, prefix_cache: Optional[PrefixCache] = None
+             ) -> List[int]:
     """Sample ``max_new_tokens`` continuations of ``prompt_ids``.
 
     ``apply_fn(params, tokens)`` must return logits of shape (B, T, V).
@@ -167,8 +249,27 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
                                             float(top_p))
         raw_params = params.get("params", params) if isinstance(params, dict) \
             else params
-        key, sub = jax.random.split(key)
-        tok, cache = prefill(raw_params, buf_j, n, sub, temp)
+        hit_len, hit_cache = (prefix_cache.lookup(prompt_ids)
+                              if prefix_cache is not None and n > 0
+                              else (0, None))
+        if hit_cache is not None:
+            # continue from the cached state through the unseen tail; an
+            # exact hit replays only the LAST prompt token — position
+            # n-1's K/V rewrite is idempotent (same deterministic apply),
+            # and its logits equal the prefill's, so greedy output is
+            # bit-identical to the uncached path
+            cache = hit_cache
+            tok = None
+            for j in range(min(hit_len, n - 1), n):
+                key, sub = jax.random.split(key)
+                tok, cache = step(raw_params, cache,
+                                  jnp.int32(prompt_ids[j]),
+                                  jnp.int32(j), sub, temp)
+        else:
+            key, sub = jax.random.split(key)
+            tok, cache = prefill(raw_params, buf_j, n, sub, temp)
+        if prefix_cache is not None and n > 0:
+            prefix_cache.insert(prompt_ids, cache)
         pos = n
         while pos < buf_len and len(out) < max_new_tokens:
             t = int(tok)
@@ -216,7 +317,8 @@ class OpenAICompatServer:
                  model_name: str = "fedml-tpu-llm", host: str = "127.0.0.1",
                  port: int = 0, buf_len: int = 256, model=None,
                  batch_slots: int = 0, draft_model=None, draft_params=None,
-                 decode_horizon: int = 1, spec_k: int = 4):
+                 decode_horizon: int = 1, spec_k: int = 4,
+                 prefix_cache_slots: int = 0):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -244,6 +346,14 @@ class OpenAICompatServer:
                              "target) — speculative decode is cache-based")
         if draft_model is not None and draft_params is None:
             raise ValueError("draft_model requires draft_params")
+        # prefix_cache_slots > 0 (requires ``model``, non-engine path):
+        # reuse prefill KV for shared prompt prefixes (see PrefixCache)
+        self.prefix_cache = None
+        if prefix_cache_slots:
+            if model is None:
+                raise ValueError("prefix_cache_slots requires `model` "
+                                 "(prefix caching is KV-cache-based)")
+            self.prefix_cache = PrefixCache(prefix_cache_slots)
         self._engine = None
         self._engine_greedy_only = False
         if batch_slots:
@@ -336,7 +446,7 @@ class OpenAICompatServer:
                 buf_len=self.buf_len,
                 eos_id=getattr(tok, "eos_id", None),
                 on_token=emit if on_text else None,
-                model=self.model)
+                model=self.model, prefix_cache=self.prefix_cache)
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail

@@ -717,3 +717,118 @@ def test_serve_rtt_harness_smoke(tmp_path):
     assert lev["spec_fused_selfdraft"]["acceptance"] == 1.0
     # under 20ms injected RTT the horizon path must beat sequential
     assert lev["batched_h8"]["tok_s"] > lev["seq_kv"]["tok_s"]
+
+
+def test_prefix_cache_greedy_parity_and_reuse():
+    """PrefixCache: greedy outputs must be BIT-IDENTICAL with and without
+    the cache for (a) cold miss, (b) exact-prompt hit, (c) shared-prefix
+    hit with a tail; stats must show prefill work skipped; LRU must evict
+    past capacity."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.templates.openai_compat import (PrefixCache,
+                                                           generate)
+
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=96,
+                      dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+    system = [7, 11, 13, 17, 19, 23]            # the shared "system prompt"
+    prompts = [system + [29], system + [31, 37], system + [29]]  # last=exact
+
+    refs = [generate(apply_fn, params, p, max_new_tokens=10, buf_len=64,
+                     model=model) for p in prompts]
+    pc = PrefixCache(capacity=4)
+    outs = [generate(apply_fn, params, p, max_new_tokens=10, buf_len=64,
+                     model=model, prefix_cache=pc) for p in prompts]
+    assert outs == refs, "prefix cache changed greedy output"
+    # first call misses; the others hit (shared system prefix, then exact)
+    assert pc.stats["misses"] == 1
+    assert pc.stats["hits"] == 2
+    assert pc.stats["exact_hits"] == 1
+    assert pc.stats["prefill_tokens_skipped"] >= 2 * len(system)
+
+    # LRU eviction: tiny capacity keeps only the most recent entries
+    small = PrefixCache(capacity=1)
+    generate(apply_fn, params, [1, 2, 3], max_new_tokens=2, buf_len=64,
+             model=model, prefix_cache=small)
+    generate(apply_fn, params, [4, 5, 6], max_new_tokens=2, buf_len=64,
+             model=model, prefix_cache=small)
+    assert len(small._entries) == 1
+    m, c = small.lookup([1, 2, 3])
+    assert c is None, "evicted entry still served"
+
+
+def test_prefix_cache_over_http_server():
+    """Server wiring: prefix_cache_slots routes the non-engine cached
+    path through one shared PrefixCache; repeated identical prompts hit."""
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.serving.templates.openai_compat import OpenAICompatServer
+
+    cfg = LlamaConfig(vocab_size=258, dim=32, n_layers=1, n_heads=2,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=160,
+                      dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = OpenAICompatServer(
+        lambda p, t: model.apply({"params": p}, t), params, model=model,
+        buf_len=128, prefix_cache_slots=4)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/v1/completions"
+        body = json.dumps({"prompt": "hello federated world",
+                           "max_tokens": 6}).encode()
+        texts = []
+        for _ in range(2):
+            r = urllib.request.urlopen(urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"}), timeout=60)
+            texts.append(json.loads(r.read())["choices"][0]["text"])
+        assert texts[0] == texts[1]
+        assert srv.prefix_cache.stats["exact_hits"] >= 1
+        assert srv.prefix_cache.stats["misses"] == 1
+    finally:
+        srv.stop()
+
+
+def test_prefix_cache_divergent_tail_self_heals():
+    """A cached entry whose prompt DIVERGES from the new request after c
+    tokens must still serve its first c positions: the stale tail is
+    progressively overwritten and never attended (each decode step
+    writes position j before attending <= j).  Output must be bit-equal
+    to the uncached run."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.templates.openai_compat import (PrefixCache,
+                                                           generate)
+
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=96,
+                      dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+    # cached prompt is LONGER than the shared prefix and diverges at
+    # position 3: reuse must take exactly 3 tokens and self-heal the rest
+    cached_prompt = [5, 9, 12, 40, 41, 42, 43, 44]
+    new_prompt = [5, 9, 12, 60, 61]
+
+    ref = generate(apply_fn, params, new_prompt, max_new_tokens=12,
+                   buf_len=64, model=model)
+    pc = PrefixCache(capacity=2)
+    generate(apply_fn, params, cached_prompt, max_new_tokens=2, buf_len=64,
+             model=model, prefix_cache=pc)
+    out = generate(apply_fn, params, new_prompt, max_new_tokens=12,
+                   buf_len=64, model=model, prefix_cache=pc)
+    assert out == ref, "stale tail leaked into attention"
+    assert pc.stats["hits"] == 1
+    assert pc.stats["prefill_tokens_skipped"] == 3

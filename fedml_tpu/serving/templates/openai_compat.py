@@ -166,19 +166,48 @@ class PrefixCache:
         self.capacity = int(capacity)
         self._entries = collections.OrderedDict()   # tuple(ids) -> cache
         self._lock = threading.Lock()
+        #: the params tree the cached KV was computed under — held by
+        #: STRONG reference so identity comparison is exact (an id() of a
+        #: freed tree could be reused); entries are invalidated wholesale
+        #: when a different tree shows up (federated serving swaps
+        #: weights every round — old-weight KV must never mix with
+        #: new-weight decode).  NOTE the strong ref keeps the OLD tree
+        #: alive until the first post-swap request arrives; weight-swap
+        #: paths should call :meth:`clear` eagerly (the server's
+        #: ``update_params`` does) so the old weights + stale KV free
+        #: immediately instead of squatting on HBM through the idle gap
+        self._params_ref = None
         self.stats = {"hits": 0, "exact_hits": 0, "misses": 0,
-                      "insertions": 0, "prefill_tokens_skipped": 0}
+                      "insertions": 0, "invalidations": 0,
+                      "prefill_tokens_skipped": 0}
 
-    def lookup(self, ids: List[int]):
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._params_ref = None
+
+    def _sync_params(self, params) -> None:
+        """Caller holds the lock.  Drop every entry when the weights the
+        cache was built under are replaced."""
+        if self._params_ref is not params:
+            if self._entries:
+                self.stats["invalidations"] += 1
+                self._entries.clear()
+            self._params_ref = params
+
+    def lookup(self, ids: List[int], params=None):
         """Longest COMMON prefix between ``ids`` and any cached entry →
         (c, cache) or (0, None).  A cached buffer whose prompt diverges
         after position c is still valid for the first c tokens: decode
         steps attend only positions <= their own, and each step writes
         its position's K/V before attending, so the stale tail
         progressively self-heals (the same mask-discipline argument the
-        speculative verify blocks rely on)."""
+        speculative verify blocks rely on).  ``params`` (the weight tree
+        the caller will decode with) invalidates the cache on change."""
         t = tuple(ids)
         with self._lock:
+            if params is not None:
+                self._sync_params(params)
             best, best_key = 0, None
             for key in self._entries:
                 c = 0
@@ -206,9 +235,11 @@ class PrefixCache:
             self.stats["misses"] += 1
             return 0, None
 
-    def insert(self, ids: List[int], cache) -> None:
+    def insert(self, ids: List[int], cache, params=None) -> None:
         t = tuple(ids)
         with self._lock:
+            if params is not None:
+                self._sync_params(params)
             if t in self._entries:
                 self._entries.move_to_end(t)
                 return
@@ -249,7 +280,7 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
                                             float(top_p))
         raw_params = params.get("params", params) if isinstance(params, dict) \
             else params
-        hit_len, hit_cache = (prefix_cache.lookup(prompt_ids)
+        hit_len, hit_cache = (prefix_cache.lookup(prompt_ids, raw_params)
                               if prefix_cache is not None and n > 0
                               else (0, None))
         if hit_cache is not None:
@@ -269,7 +300,7 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
             key, sub = jax.random.split(key)
             tok, cache = prefill(raw_params, buf_j, n, sub, temp)
         if prefix_cache is not None and n > 0:
-            prefix_cache.insert(prompt_ids, cache)
+            prefix_cache.insert(prompt_ids, cache, raw_params)
         pos = n
         while pos < buf_len and len(out) < max_new_tokens:
             t = int(tok)
@@ -353,6 +384,11 @@ class OpenAICompatServer:
             if model is None:
                 raise ValueError("prefix_cache_slots requires `model` "
                                  "(prefix caching is KV-cache-based)")
+            if batch_slots:
+                raise ValueError(
+                    "prefix_cache_slots serves the non-engine cached "
+                    "path; with batch_slots the engine owns per-slot "
+                    "caches and would never consult it — drop one")
             self.prefix_cache = PrefixCache(prefix_cache_slots)
         self._engine = None
         self._engine_greedy_only = False
@@ -538,6 +574,14 @@ class OpenAICompatServer:
                 log.debug("openai-compat: " + fmt, *args)
 
         return Handler
+
+    def update_params(self, params) -> None:
+        """Swap the serving weights (federated round boundary).  Clears
+        the prefix cache EAGERLY: its strong params ref would otherwise
+        keep the old tree + stale KV resident until the next request."""
+        self.params = params
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:

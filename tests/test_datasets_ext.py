@@ -343,34 +343,6 @@ def test_shakespeare_raw_text_ingestion(tmp_path):
     assert ds.num_clients == 4
 
 
-def test_real_tabular_breast_cancer_federation():
-    """REAL tabular bytes (sklearn breast_cancer, reference UCI-row
-    stand-in): LR federation must clear 85% test accuracy — only real
-    structure gets there; the synthetic fallback would sit near chance at
-    these sizes."""
-    import fedml_tpu
-    from fedml_tpu.arguments import load_arguments
-    from fedml_tpu import data as data_mod, device as device_mod, \
-        model as model_mod
-    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
-
-    args = load_arguments()
-    args.update(dataset="breast_cancer", model="lr", input_shape=(30,),
-                client_num_in_total=8, client_num_per_round=8, comm_round=20,
-                epochs=1, batch_size=16, learning_rate=0.3,
-                partition_method="homo", frequency_of_the_test=10 ** 9,
-                random_seed=0)
-    args = fedml_tpu.init(args, should_init_logs=False)
-    dataset, out_dim = data_mod.load(args)
-    assert dataset.provenance == "real:sklearn-breast-cancer"
-    assert out_dim == 2 and dataset.train_x.shape[1] == 30
-    model = model_mod.create(args, out_dim)
-    api = FedAvgAPI(args, device_mod.get_device(args), dataset, model)
-    api.train()
-    _, acc = [float(v) for v in api.evaluate()[:2]]
-    assert acc > 0.85, acc
-
-
 def test_real_vertical_split_wine():
     """REAL vertical federation: wine features split across 2 parties."""
     from fedml_tpu.arguments import load_arguments
@@ -415,6 +387,8 @@ def test_real_tabular_federated_accuracy():
         args = fedml_tpu.init(args, should_init_logs=False)
         ds, out_dim = data_mod.load(args)
         assert ds.provenance.startswith("real:sklearn-"), ds.provenance
+        assert ds.train_x.shape[1] == feats
+        assert out_dim == (2 if name == "breast_cancer" else 3)
         model = model_mod.create(args, out_dim)
         api = FedAvgAPI(args, None, ds, model)
         api.train()

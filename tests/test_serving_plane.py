@@ -832,3 +832,39 @@ def test_prefix_cache_divergent_tail_self_heals():
     assert out == ref, "stale tail leaked into attention"
     assert pc.stats["hits"] == 1
     assert pc.stats["prefill_tokens_skipped"] == 3
+
+
+def test_prefix_cache_invalidated_on_weight_swap():
+    """Federated serving swaps weights every round: a PrefixCache hit
+    computed under OLD params must never serve after the params tree
+    changes — the cache invalidates wholesale on identity change and the
+    new-weight output must equal an uncached new-weight run."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.templates.openai_compat import (PrefixCache,
+                                                           generate)
+
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=1, n_heads=2,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=64,
+                      dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+    p_old = model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+    p_new = model.init(jax.random.PRNGKey(1),
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = [5, 9, 12, 15, 18, 21]
+
+    pc = PrefixCache(capacity=4)
+    generate(apply_fn, p_old, prompt, max_new_tokens=6, buf_len=48,
+             model=model, prefix_cache=pc)                # warm under OLD
+    ref_new = generate(apply_fn, p_new, prompt, max_new_tokens=6,
+                       buf_len=48, model=model)           # uncached NEW
+    out_new = generate(apply_fn, p_new, prompt, max_new_tokens=6,
+                       buf_len=48, model=model, prefix_cache=pc)
+    assert out_new == ref_new, "stale old-weight KV served after swap"
+    assert pc.stats["invalidations"] == 1
+    # manual clear() is public
+    pc.clear()
+    assert len(pc._entries) == 0

@@ -53,7 +53,8 @@ class ContinuousBatchingEngine:
     ``None``; a daemon thread drives the batched decode loop."""
 
     def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
-                 top_k: int = 0, top_p: float = 1.0, horizon: int = 1):
+                 top_k: int = 0, top_p: float = 1.0, horizon: int = 1,
+                 prefix_cache_slots: int = 0):
         self.model = model
         self.raw_params = params.get("params", params) \
             if isinstance(params, dict) else params
@@ -72,8 +73,17 @@ class ContinuousBatchingEngine:
         # next admission).
         self.horizon = max(1, int(horizon))
 
-        self._prefill, _ = _build_cached_decode(model, self.top_k,
-                                                self.top_p)
+        self._prefill, self._tail_step = _build_cached_decode(
+            model, self.top_k, self.top_p)
+        # prefix_cache_slots > 0: admission reuses prefill KV for shared
+        # prompt prefixes (templates/openai_compat.PrefixCache — LRU,
+        # longest-common-prefix, params-identity invalidation); only the
+        # engine thread touches it during _admit, but the cache carries
+        # its own lock anyway
+        self.prefix_cache = None
+        if prefix_cache_slots:
+            from .templates.openai_compat import PrefixCache
+            self.prefix_cache = PrefixCache(prefix_cache_slots)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         wdtype = weight_dtype(model)
@@ -209,10 +219,29 @@ class ContinuousBatchingEngine:
         buf = np.zeros((1, self.buf_len), np.int32)
         buf[0, :n] = ids
         key = jax.random.PRNGKey(req["seed"])
-        key, sub = jax.random.split(key)
-        tok, cache = self._prefill(self.raw_params, jnp.asarray(buf),
-                                   jnp.int32(n), sub,
-                                   jnp.float32(req["temperature"]))
+        temp = jnp.float32(req["temperature"])
+        hit_len, hit_cache = (self.prefix_cache.lookup(ids, self.raw_params)
+                              if self.prefix_cache is not None and n > 0
+                              else (0, None))
+        if hit_cache is not None:
+            # same replay discipline as templates/openai_compat.generate:
+            # exact hits rewrite only the last position (idempotent),
+            # prefix hits continue through the unseen tail; stale tail
+            # positions past the divergence point are masked until
+            # overwritten
+            cache = hit_cache
+            tok = None
+            for j in range(min(hit_len, n - 1), n):
+                key, sub = jax.random.split(key)
+                tok, cache = self._tail_step(self.raw_params, cache,
+                                             jnp.int32(ids[j]),
+                                             jnp.int32(j), sub, temp)
+        else:
+            key, sub = jax.random.split(key)
+            tok, cache = self._prefill(self.raw_params, jnp.asarray(buf),
+                                       jnp.int32(n), sub, temp)
+        if self.prefix_cache is not None and n > 0:
+            self.prefix_cache.insert(ids, cache, self.raw_params)
         self._caches = self._insert(self._caches, cache, jnp.int32(slot))
         s = self._slots[slot]
         s.live = True
@@ -307,7 +336,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     """
 
     def __init__(self, model, params, draft_model, draft_params,
-                 slots: int = 4, buf_len: int = 256, k: int = 4):
+                 slots: int = 4, buf_len: int = 256, k: int = 4,
+                 prefix_cache_slots: int = 0):
         self.k = int(k)
         assert self.k >= 1
         for m, name in ((model, "model"), (draft_model, "draft_model")):
@@ -328,7 +358,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         self._hist: Dict[int, List[int]] = {}
         self._fds = np.zeros(int(slots), np.int32)
         super().__init__(model, params, slots=slots, buf_len=buf_len,
-                         top_k=0, horizon=1)
+                         top_k=0, horizon=1,
+                         prefix_cache_slots=prefix_cache_slots)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         t_wdtype = weight_dtype(model)

@@ -377,18 +377,20 @@ class OpenAICompatServer:
                              "target) — speculative decode is cache-based")
         if draft_model is not None and draft_params is None:
             raise ValueError("draft_model requires draft_params")
-        # prefix_cache_slots > 0 (requires ``model``, non-engine path):
-        # reuse prefill KV for shared prompt prefixes (see PrefixCache)
+        # prefix_cache_slots > 0 (requires ``model``): reuse prefill KV
+        # for shared prompt prefixes.  Non-engine path: one PrefixCache
+        # consulted by generate(); engine path: the engine builds its own
+        # and consults it at admission (self.prefix_cache aliases it
+        # below so stats stay reachable either way — but the sampled
+        # fall-through around a greedy-only engine does NOT use it: the
+        # engine admits with its construction-time params while
+        # generate() uses self.params, and after update_params() those
+        # identities differ, so sharing would ping-pong invalidation).
         self.prefix_cache = None
-        if prefix_cache_slots:
-            if model is None:
-                raise ValueError("prefix_cache_slots requires `model` "
-                                 "(prefix caching is KV-cache-based)")
-            if batch_slots:
-                raise ValueError(
-                    "prefix_cache_slots serves the non-engine cached "
-                    "path; with batch_slots the engine owns per-slot "
-                    "caches and would never consult it — drop one")
+        if prefix_cache_slots and model is None:
+            raise ValueError("prefix_cache_slots requires `model` "
+                             "(prefix caching is KV-cache-based)")
+        if prefix_cache_slots and not batch_slots:
             self.prefix_cache = PrefixCache(prefix_cache_slots)
         self._engine = None
         self._engine_greedy_only = False
@@ -411,13 +413,17 @@ class OpenAICompatServer:
                 self._engine = SpeculativeBatchingEngine(
                     model, params, draft_model, draft_params,
                     slots=int(batch_slots), buf_len=buf_len,
-                    k=int(spec_k))
+                    k=int(spec_k),
+                    prefix_cache_slots=int(prefix_cache_slots))
+                self.prefix_cache = self._engine.prefix_cache
                 self._engine_greedy_only = True
             else:
                 from ..batching import ContinuousBatchingEngine
                 self._engine = ContinuousBatchingEngine(
                     model, params, slots=int(batch_slots), buf_len=buf_len,
-                    horizon=int(decode_horizon))
+                    horizon=int(decode_horizon),
+                    prefix_cache_slots=int(prefix_cache_slots))
+                self.prefix_cache = self._engine.prefix_cache
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------
@@ -482,7 +488,9 @@ class OpenAICompatServer:
                 buf_len=self.buf_len,
                 eos_id=getattr(tok, "eos_id", None),
                 on_token=emit if on_text else None,
-                model=self.model, prefix_cache=self.prefix_cache)
+                model=self.model,
+                prefix_cache=(self.prefix_cache if self._engine is None
+                              else None))
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail

@@ -868,3 +868,54 @@ def test_prefix_cache_invalidated_on_weight_swap():
     # manual clear() is public
     pc.clear()
     assert len(pc._entries) == 0
+
+
+def test_prefix_cache_in_batching_engine():
+    """Engine admission with prefix_cache_slots: outputs bit-equal to an
+    uncached engine (greedy), cache hits recorded across requests sharing
+    a system prefix, and the speculative engine threads the knob through
+    (still parity with generate)."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import (ContinuousBatchingEngine,
+                                            SpeculativeBatchingEngine)
+    from fedml_tpu.serving.templates.openai_compat import generate
+
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=160,
+                      dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    system = [7, 11, 13, 17, 19, 23, 29, 31]
+    prompts = [system + [41], system + [43, 47], system + [41]]
+    refs = [generate(lambda p, t: model.apply({"params": p}, t), params,
+                     pr, max_new_tokens=8, buf_len=96, model=model)
+            for pr in prompts]
+
+    eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=96,
+                                   prefix_cache_slots=4)
+    try:
+        outs = [eng.generate(pr, max_new_tokens=8) for pr in prompts]
+    finally:
+        eng.stop()
+    assert outs == refs
+    assert eng.prefix_cache.stats["hits"] == 2
+    assert eng.prefix_cache.stats["exact_hits"] == 1
+
+    draft_cfg = LlamaConfig(vocab_size=97, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_dim=32, max_seq_len=160,
+                            dtype=jnp.float32)
+    draft = LlamaLM(draft_cfg)
+    dparams = draft.init(jax.random.PRNGKey(1),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    spec = SpeculativeBatchingEngine(model, params, draft, dparams,
+                                     slots=2, buf_len=96, k=3,
+                                     prefix_cache_slots=4)
+    try:
+        outs = [spec.generate(pr, max_new_tokens=8) for pr in prompts]
+    finally:
+        spec.stop()
+    assert outs == refs
+    assert spec.prefix_cache.stats["hits"] == 2

@@ -166,10 +166,18 @@ def main():
         if args_cli.mesh < 2 or args_cli.mesh % 2:
             ap.error(f"--mesh {args_cli.mesh}: must be an even count >= 2 "
                      "(mesh layout is client x model with model=2)")
-        # must precede the jax import below
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_"
-                                     f"count={args_cli.mesh}").strip()
+        # must precede the jax import below.  The collective timeouts
+        # matter at >=1B params: N virtual devices SERIALIZE on this
+        # 1-core box, so a cross-module all-gather legitimately waits
+        # minutes for all participants — XLA's default 40s terminate
+        # timeout kills a correct program (observed at 1.075B; 40M fits
+        # inside the window)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args_cli.mesh}"
+            + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+            + " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+            + " --xla_cpu_collective_timeout_seconds=7200").strip()
     if args_cli.layer7b:
         return layer7b_bench(args_cli)
     if args_cli.fast:

@@ -125,8 +125,9 @@ class ContinuousBatchingEngine:
 
         # materialize the stacked cache template from one dummy prefill
         dummy = jnp.zeros((1, self.buf_len), jnp.int32)
-        _, cache0 = self._prefill(self.raw_params, dummy, jnp.int32(1),
-                                  jax.random.PRNGKey(0), jnp.float32(0.0))
+        _, cache0 = self._prefill(self.raw_params, None, dummy,
+                                  jnp.int32(1), jax.random.PRNGKey(0),
+                                  jnp.float32(0.0))
         self._caches = jax.tree_util.tree_map(
             lambda c: jnp.zeros((self.n_slots,) + c.shape, c.dtype), cache0)
 
@@ -233,13 +234,14 @@ class ContinuousBatchingEngine:
             tok = None
             for j in range(min(hit_len, n - 1), n):
                 key, sub = jax.random.split(key)
-                tok, cache = self._tail_step(self.raw_params, cache,
-                                             jnp.int32(ids[j]),
+                tok, cache = self._tail_step(self.raw_params, None,
+                                             cache, jnp.int32(ids[j]),
                                              jnp.int32(j), sub, temp)
         else:
             key, sub = jax.random.split(key)
-            tok, cache = self._prefill(self.raw_params, jnp.asarray(buf),
-                                       jnp.int32(n), sub, temp)
+            tok, cache = self._prefill(self.raw_params, None,
+                                       jnp.asarray(buf), jnp.int32(n),
+                                       sub, temp)
         if self.prefix_cache is not None and n > 0:
             self.prefix_cache.insert(ids, cache, self.raw_params)
         self._caches = self._insert(self._caches, cache, jnp.int32(slot))
@@ -368,7 +370,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
         self._d_prefill, _ = _build_cached_decode(draft_model, 0, 1.0)
         dummy = jnp.zeros((1, self.buf_len), jnp.int32)
-        _, dcache0 = self._d_prefill(self.raw_draft, dummy, jnp.int32(1),
+        _, dcache0 = self._d_prefill(self.raw_draft, None, dummy,
+                                     jnp.int32(1),
                                      jax.random.PRNGKey(0), jnp.float32(0.0))
         self._d_caches = jax.tree_util.tree_map(
             lambda c: jnp.zeros((self.n_slots,) + c.shape, c.dtype), dcache0)
@@ -414,7 +417,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         n = len(ids)
         buf = np.zeros((1, self.buf_len), np.int32)
         buf[0, :n] = ids
-        _, dcache = self._d_prefill(self.raw_draft, jnp.asarray(buf),
+        _, dcache = self._d_prefill(self.raw_draft, None, jnp.asarray(buf),
                                     jnp.int32(n), jax.random.PRNGKey(0),
                                     jnp.float32(0.0))
         self._d_caches = self._insert(self._d_caches, dcache,

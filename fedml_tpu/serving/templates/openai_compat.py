@@ -111,31 +111,51 @@ def _build_cached_decode(model, top_k: int, top_p: float):
     """Jitted (prefill, step) pair for a flax model supporting
     ``decode=True`` with a "cache" collection (``llm.model.LlamaLM``).
 
-    int8-quantized param trees (``llm/quantization.py``) pass through
-    transparently: the dequantize runs inside the traced program, so the
-    weights stay int8 in HBM and the per-matmul dequant fuses."""
+    Both functions take ``lora`` as their second argument: a LoRA tree
+    (the "lora" collection of LoRADense layers) for per-request
+    personalization — a traced argument, so ONE compiled program serves
+    every adapter of a given shape — or ``None`` (an empty pytree; the
+    presence/absence is part of the jit cache key) for models without
+    adapters.  int8-quantized param trees (``llm/quantization.py``) pass
+    through transparently: the dequantize runs inside the traced
+    program, so the weights stay int8 in HBM and the per-matmul dequant
+    fuses."""
     from ...llm.quantization import dequantize_params, weight_dtype
     wdtype = weight_dtype(model)
 
+    def _vars(params, lora):
+        v = {"params": dequantize_params(params, wdtype)}
+        if lora is not None:        # trace-time: None is an empty pytree
+            v["lora"] = lora
+        return v
+
     @jax.jit
-    def prefill(params, buf, n, key, temp):
+    def prefill(params, lora, buf, n, key, temp):
         logits, mut = model.apply(
-            {"params": dequantize_params(params, wdtype)}, buf, decode=True,
+            _vars(params, lora), buf, decode=True,
             start_pos=jnp.zeros((), jnp.int32), mutable=["cache"])
         live = jax.lax.dynamic_index_in_dim(logits[0], n - 1, axis=0,
                                             keepdims=False)
         return _sample_live(live, key, temp, top_k, top_p), mut["cache"]
 
     @jax.jit
-    def step(params, cache, tok, pos, key, temp):
+    def step(params, lora, cache, tok, pos, key, temp):
         logits, mut = model.apply(
-            {"params": dequantize_params(params, wdtype), "cache": cache},
-            tok[None, None],
+            {**_vars(params, lora), "cache": cache}, tok[None, None],
             decode=True, start_pos=pos, mutable=["cache"])
         return _sample_live(logits[0, 0], key, temp, top_k,
                             top_p), mut["cache"]
 
     return prefill, step
+
+
+class RequestError(ValueError):
+    """Client-side request mistake -> HTTP 4xx (a 500 would be counted
+    against server error budgets and retried by OpenAI-style clients)."""
+
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = int(status)
 
 
 class PrefixCache:
@@ -177,6 +197,7 @@ class PrefixCache:
         #: ``update_params`` does) so the old weights + stale KV free
         #: immediately instead of squatting on HBM through the idle gap
         self._params_ref = None
+        self._lora_ref = None
         self.stats = {"hits": 0, "exact_hits": 0, "misses": 0,
                       "insertions": 0, "invalidations": 0,
                       "prefill_tokens_skipped": 0}
@@ -185,17 +206,21 @@ class PrefixCache:
         with self._lock:
             self._entries.clear()
             self._params_ref = None
+            self._lora_ref = None
 
-    def _sync_params(self, params) -> None:
-        """Caller holds the lock.  Drop every entry when the weights the
-        cache was built under are replaced."""
-        if self._params_ref is not params:
+    def _sync_params(self, params, lora=None) -> None:
+        """Caller holds the lock.  Drop every entry when the weights OR
+        the adapter the cache was built under are replaced — prefix KV is
+        (params, lora)-specific, so uniform-adapter traffic caches
+        normally while a change of either tree invalidates wholesale."""
+        if self._params_ref is not params or self._lora_ref is not lora:
             if self._entries:
                 self.stats["invalidations"] += 1
                 self._entries.clear()
             self._params_ref = params
+            self._lora_ref = lora
 
-    def lookup(self, ids: List[int], params=None):
+    def lookup(self, ids: List[int], params=None, lora=None):
         """Longest COMMON prefix between ``ids`` and any cached entry →
         (c, cache) or (0, None).  A cached buffer whose prompt diverges
         after position c is still valid for the first c tokens: decode
@@ -207,7 +232,7 @@ class PrefixCache:
         t = tuple(ids)
         with self._lock:
             if params is not None:
-                self._sync_params(params)
+                self._sync_params(params, lora)
             best, best_key = 0, None
             for key in self._entries:
                 c = 0
@@ -235,11 +260,12 @@ class PrefixCache:
             self.stats["misses"] += 1
             return 0, None
 
-    def insert(self, ids: List[int], cache, params=None) -> None:
+    def insert(self, ids: List[int], cache, params=None,
+               lora=None) -> None:
         t = tuple(ids)
         with self._lock:
             if params is not None:
-                self._sync_params(params)
+                self._sync_params(params, lora)
             if t in self._entries:
                 self._entries.move_to_end(t)
                 return
@@ -255,8 +281,8 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
              buf_len: int = 256,
              eos_id: Optional[int] = None,
              on_token: Optional[Callable[[int], None]] = None,
-             model=None, prefix_cache: Optional[PrefixCache] = None
-             ) -> List[int]:
+             model=None, prefix_cache: Optional[PrefixCache] = None,
+             lora=None) -> List[int]:
     """Sample ``max_new_tokens`` continuations of ``prompt_ids``.
 
     ``apply_fn(params, tokens)`` must return logits of shape (B, T, V).
@@ -276,11 +302,19 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
     out: List[int] = []
 
     if model is not None:
-        prefill, step = _build_cached_decode(model, int(top_k),
-                                            float(top_p))
         raw_params = params.get("params", params) if isinstance(params, dict) \
             else params
-        hit_len, hit_cache = (prefix_cache.lookup(prompt_ids, raw_params)
+        prefill_p, step_p = _build_cached_decode(model, int(top_k),
+                                                 float(top_p))
+        prefill = functools.partial(prefill_p, raw_params, lora)
+        step = functools.partial(step_p, raw_params, lora)
+        # prefix KV is adapter-specific: the cache keys validity on
+        # (params, lora) identity, so uniform-adapter traffic (e.g. the
+        # server's shared zero adapter) caches normally while a CHANGE of
+        # adapter invalidates wholesale — stale cross-adapter KV can
+        # never serve
+        hit_len, hit_cache = (prefix_cache.lookup(prompt_ids, raw_params,
+                                                  lora)
                               if prefix_cache is not None and n > 0
                               else (0, None))
         if hit_cache is not None:
@@ -293,14 +327,13 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
             tok = None
             for j in range(min(hit_len, n - 1), n):
                 key, sub = jax.random.split(key)
-                tok, cache = step(raw_params, cache,
-                                  jnp.int32(prompt_ids[j]),
+                tok, cache = step(cache, jnp.int32(prompt_ids[j]),
                                   jnp.int32(j), sub, temp)
         else:
             key, sub = jax.random.split(key)
-            tok, cache = prefill(raw_params, buf_j, n, sub, temp)
+            tok, cache = prefill(buf_j, n, sub, temp)
         if prefix_cache is not None and n > 0:
-            prefix_cache.insert(prompt_ids, cache, raw_params)
+            prefix_cache.insert(prompt_ids, cache, raw_params, lora)
         pos = n
         while pos < buf_len and len(out) < max_new_tokens:
             t = int(tok)
@@ -310,8 +343,8 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
             if on_token is not None:
                 on_token(t)
             key, sub = jax.random.split(key)
-            tok, cache = step(raw_params, cache, jnp.int32(t),
-                              jnp.int32(pos), sub, temp)
+            tok, cache = step(cache, jnp.int32(t), jnp.int32(pos), sub,
+                              temp)
             pos += 1
         return out
 
@@ -349,7 +382,7 @@ class OpenAICompatServer:
                  port: int = 0, buf_len: int = 256, model=None,
                  batch_slots: int = 0, draft_model=None, draft_params=None,
                  decode_horizon: int = 1, spec_k: int = 4,
-                 prefix_cache_slots: int = 0):
+                 prefix_cache_slots: int = 0, adapters=None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -392,6 +425,43 @@ class OpenAICompatServer:
                              "(prefix caching is KV-cache-based)")
         if prefix_cache_slots and not batch_slots:
             self.prefix_cache = PrefixCache(prefix_cache_slots)
+        # adapters: {name: LoRA tree} over ONE shared base — per-request
+        # personalization for federated clients (request field
+        # {"adapter": name}; no field = the zero adapter = base behavior).
+        # Requires a lora_rank>0 model config; one compiled program
+        # serves every adapter (the tree is a traced argument).  The
+        # reference serves one full model copy per personalized endpoint.
+        self.adapters = None
+        self._zero_lora = None
+        if adapters is not None:
+            if model is None:
+                raise ValueError("adapters require `model` (KV-cached "
+                                 "decode carries the lora collection)")
+            if getattr(getattr(model, "cfg", None), "lora_rank", 0) <= 0:
+                raise ValueError("adapters require a lora_rank>0 model "
+                                 "config (LoRADense layers)")
+            if batch_slots:
+                raise ValueError(
+                    "adapters serve the single-request path; the batched "
+                    "engine applies no lora collection — drop batch_slots")
+            if draft_model is not None:
+                raise ValueError(
+                    "adapters and draft_model are incompatible: the "
+                    "speculative path applies no lora collection (a "
+                    "greedy request would crash or silently serve base "
+                    "output) — drop one")
+            self.adapters = dict(adapters)
+            # zero A/B -> the adapter term vanishes: base behavior.
+            # eval_shape + zeros, NOT model.init: init would materialize
+            # a full base-parameter tree (and trace a forward) just to
+            # read the lora collection — a transient full-model
+            # allocation a box sized for int8-quantized weights may not
+            # survive
+            shapes = jax.eval_shape(
+                lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
+                jax.random.PRNGKey(0))["lora"]
+            self._zero_lora = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes)
         self._engine = None
         self._engine_greedy_only = False
         if batch_slots:
@@ -447,6 +517,20 @@ class OpenAICompatServer:
                 on_text(clean[sent:])
                 sent = len(clean)
 
+        adapter_name = req.get("adapter")
+        lora = None
+        if self.adapters is not None:
+            if adapter_name:
+                if adapter_name not in self.adapters:
+                    raise RequestError(
+                        f"unknown adapter {adapter_name!r}; have "
+                        f"{sorted(self.adapters)}", status=404)
+                lora = self.adapters[adapter_name]
+            else:
+                lora = self._zero_lora
+        elif adapter_name:
+            raise RequestError("server has no adapters configured")
+
         if self._engine is not None and not (
                 self._engine_greedy_only
                 and float(req.get("temperature", 0.0)) != 0.0):
@@ -490,7 +574,8 @@ class OpenAICompatServer:
                 on_token=emit if on_text else None,
                 model=self.model,
                 prefix_cache=(self.prefix_cache if self._engine is None
-                              else None))
+                              else None),
+                lora=lora)
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail
@@ -574,6 +659,10 @@ class OpenAICompatServer:
                                          "finish_reason": "stop"}]})
                     else:
                         self._send_json(404, {"error": "not found"})
+                except RequestError as e:
+                    # client mistake (unknown adapter, bad field) — 4xx,
+                    # not a retryable server fault
+                    self._send_json(e.status, {"error": str(e)})
                 except Exception as e:
                     log.exception("generation failed")
                     self._send_json(500, {"error": str(e)})
@@ -582,6 +671,15 @@ class OpenAICompatServer:
                 log.debug("openai-compat: " + fmt, *args)
 
         return Handler
+
+    def add_adapter(self, name: str, lora_tree) -> None:
+        """Register/replace a personalization adapter (e.g. a client's
+        trained LoRA from a federated round).  No recompile: the adapter
+        tree is a traced argument of the shared decode program."""
+        if self.adapters is None:
+            raise ValueError("server built without adapters= — construct "
+                             "with adapters={} to enable personalization")
+        self.adapters[str(name)] = lora_tree
 
     def update_params(self, params) -> None:
         """Swap the serving weights (federated round boundary).  Clears

@@ -520,14 +520,15 @@ def test_tp_sharded_decode_matches_unsharded():
 
     def decode(p, shard_cache):
         key = jax.random.PRNGKey(0)
-        tok, cache = prefill(p, buf, jnp.int32(4), key, jnp.float32(0.0))
+        tok, cache = prefill(p, None, buf, jnp.int32(4), key,
+                             jnp.float32(0.0))
         if shard_cache:
             cache = jax.tree_util.tree_map(
                 lambda c: jax.device_put(c, cache_spec)
                 if c.ndim == 4 else c, cache)
         toks = [int(tok)]
         for i in range(4, 10):
-            tok, cache = step(p, cache, tok, jnp.int32(i), key,
+            tok, cache = step(p, None, cache, tok, jnp.int32(i), key,
                               jnp.float32(0.0))
             toks.append(int(tok))
         return toks, cache

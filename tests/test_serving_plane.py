@@ -1050,3 +1050,21 @@ def test_multi_adapter_personalized_serving():
         assert srv.prefix_cache.stats["invalidations"] >= 1
     finally:
         srv.stop()
+
+
+@pytest.mark.slow
+def test_personalized_adapters_example():
+    """examples/serving/personalized_adapters.py must run end-to-end:
+    federated LoRA rounds -> one endpoint serving base + adapters with
+    per-request personalization actually changing outputs."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, FEDML_TPU_PLATFORM="cpu",
+               PYTHONPATH=f"{REPO}:{os.environ.get('PYTHONPATH', '')}")
+    r = subprocess.run(
+        [sys.executable, "examples/serving/personalized_adapters.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "personalized outputs differ from base: True" in r.stdout, \
+        r.stdout[-1000:]

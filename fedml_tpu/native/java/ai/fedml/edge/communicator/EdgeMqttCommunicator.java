@@ -11,6 +11,8 @@ import java.util.Map;
 import java.util.concurrent.ConcurrentHashMap;
 import java.util.concurrent.CopyOnWriteArrayList;
 import java.util.concurrent.CountDownLatch;
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
 import java.util.concurrent.TimeUnit;
 import java.util.concurrent.atomic.AtomicBoolean;
 import java.util.concurrent.atomic.AtomicInteger;
@@ -61,6 +63,24 @@ public final class EdgeMqttCommunicator {
     private volatile OutputStream out;
     private volatile Thread readerThread;
     private volatile Thread pingThread;
+    /** monotonic-ms of the last byte read off the socket — the ping loop
+     *  uses it to detect half-dead connections (reader blocked in read()
+     *  forever) the way paho's keepalive enforcement does.  Updated at
+     *  BYTE granularity (readByte/readFully) so a multi-MB model PUBLISH
+     *  crawling over a slow edge link keeps registering progress instead
+     *  of tripping the watchdog mid-transfer. */
+    private volatile long lastInboundMs;
+    /** Listener callbacks run on this single-thread executor, not the
+     *  reader thread: a slow subscriber (e.g. one that trains on the
+     *  received model) must neither stall inbound packet processing nor
+     *  starve the keepalive watchdog into a false disconnect.  One
+     *  thread preserves per-connection delivery order. */
+    private final ExecutorService listenerExec =
+            Executors.newSingleThreadExecutor(r -> {
+                Thread t = new Thread(r, "mqtt-edge-dispatch");
+                t.setDaemon(true);
+                return t;
+            });
     private String willTopic;
     private byte[] willPayload;
     private int willQos;
@@ -115,7 +135,7 @@ public final class EdgeMqttCommunicator {
         b.write(raw, 0, raw.length);
     }
 
-    private static int readRemainingLength(InputStream in)
+    private int readRemainingLength(InputStream in)
             throws IOException {
         int len = 0;
         int mult = 1;
@@ -130,15 +150,16 @@ public final class EdgeMqttCommunicator {
         throw new IOException("malformed remaining length");
     }
 
-    private static int readByte(InputStream in) throws IOException {
+    private int readByte(InputStream in) throws IOException {
         int b = in.read();
         if (b < 0) {
             throw new EOFException("broker closed connection");
         }
+        lastInboundMs = System.nanoTime() / 1_000_000L;
         return b;
     }
 
-    private static byte[] readFully(InputStream in, int n)
+    private byte[] readFully(InputStream in, int n)
             throws IOException {
         byte[] buf = new byte[n];
         int off = 0;
@@ -148,6 +169,7 @@ public final class EdgeMqttCommunicator {
                 throw new EOFException("short packet");
             }
             off += r;
+            lastInboundMs = System.nanoTime() / 1_000_000L;
         }
         return buf;
     }
@@ -169,10 +191,34 @@ public final class EdgeMqttCommunicator {
 
     // -- lifecycle ---------------------------------------------------------
     public synchronized void connect() throws IOException {
-        socket = new Socket(host, port);
-        socket.setTcpNoDelay(true);
-        out = socket.getOutputStream();
-        InputStream in = socket.getInputStream();
+        Thread oldPing = pingThread;
+        if (oldPing != null) {
+            oldPing.interrupt();    // reconnect path: exactly one ping loop
+        }
+        Socket s = new Socket(host, port);
+        try {
+            connectOn(s);
+        } catch (IOException e) {
+            // a failed handshake must not leak the fd — reconnectLoop
+            // retries forever, one leaked socket per attempt otherwise
+            try {
+                s.close();
+            } catch (IOException ignored) {
+            }
+            throw e;
+        }
+    }
+
+    private void connectOn(Socket s) throws IOException {
+        socket = s;
+        s.setTcpNoDelay(true);
+        // a broker that accepts TCP but never answers CONNACK must not
+        // hang connect() forever: bound the handshake read.  Cleared
+        // after CONNACK — steady-state liveness is the ping loop's job
+        // (a read timeout there would false-trip on idle topics).
+        s.setSoTimeout(Math.max(keepAliveS, 10) * 1000);
+        out = s.getOutputStream();
+        InputStream in = s.getInputStream();
 
         ByteArrayOutputStream body = new ByteArrayOutputStream();
         writeString(body, "MQTT");
@@ -201,12 +247,14 @@ public final class EdgeMqttCommunicator {
                     + (len == 2 ? ack[1] : -1));
         }
         boolean sessionPresent = (ack[0] & 0x01) != 0;
+        s.setSoTimeout(0);                   // handshake bounded; see above
+        lastInboundMs = System.nanoTime() / 1_000_000L;
 
         running.set(true);
         readerThread = new Thread(() -> readLoop(in), "mqtt-edge-reader");
         readerThread.setDaemon(true);
         readerThread.start();
-        pingThread = new Thread(this::pingLoop, "mqtt-edge-ping");
+        pingThread = new Thread(() -> pingLoop(s), "mqtt-edge-ping");
         pingThread.setDaemon(true);
         pingThread.start();
 
@@ -366,7 +414,12 @@ public final class EdgeMqttCommunicator {
                                 "unexpected packet 0x%02x", header));
                 }
             }
-        } catch (IOException e) {
+        } catch (Exception e) {
+            // Exception, not just IOException: a malformed packet body
+            // (ArrayIndexOutOfBounds) or a subscriber's RuntimeException
+            // must not kill the reader silently — that would leave the
+            // client looking connected but permanently deaf, with no
+            // onLost and no reconnect.
             closeQuietly();
             if (running.get()) {
                 reconnectLoop(e);
@@ -388,21 +441,58 @@ public final class EdgeMqttCommunicator {
         System.arraycopy(body, off, payload, 0, payload.length);
         for (Map.Entry<String, SubEntry> e : subscriptions.entrySet()) {
             if (topicMatches(e.getKey(), topic)) {
-                e.getValue().listener.onReceived(topic, payload);
+                final OnReceivedListener l = e.getValue().listener;
+                final String filter = e.getKey();
+                listenerExec.execute(() -> {
+                    try {
+                        l.onReceived(topic, payload);
+                    } catch (RuntimeException ex) {
+                        // one throwing subscriber must not starve the
+                        // others or tear down the connection
+                        System.err.println("fedml-edge: listener for "
+                                + filter + " threw: " + ex);
+                    }
+                });
             }
         }
     }
 
-    private void pingLoop() {
+    private void pingLoop(Socket mySocket) {
         long intervalMs = Math.max(1, keepAliveS / 2) * 1000L;
         while (running.get()) {
             try {
                 Thread.sleep(intervalMs);
+                if (socket != mySocket) {
+                    return;     // a reconnect replaced this connection —
+                }               // its own ping thread owns liveness now
+                // keepalive-based liveness (what paho enforces): if no
+                // packet — PINGRESP or otherwise — has arrived within
+                // 1.5x the keepalive window, the connection is half-dead
+                // (reader blocked in read() on a socket the broker has
+                // abandoned).  Closing OUR socket (never a replacement)
+                // unblocks the reader with an exception, and the reader
+                // owns reconnection.
+                if (keepAliveS > 0 && System.nanoTime() / 1_000_000L
+                        - lastInboundMs > keepAliveS * 1500L) {
+                    try {
+                        mySocket.close();
+                    } catch (IOException ignored) {
+                    }
+                    return;
+                }
                 send(PINGREQ, new byte[0]);
             } catch (InterruptedException e) {
                 Thread.currentThread().interrupt();
                 return;
             } catch (IOException e) {
+                // a failed PINGREQ write (half-open link: write hits
+                // ETIMEDOUT while read blocks forever) must still close
+                // the socket — otherwise the reader never unblocks and
+                // the watchdog this loop provides silently vanishes
+                try {
+                    mySocket.close();
+                } catch (IOException ignored) {
+                }
                 return;                 // reader loop owns reconnection
             }
         }

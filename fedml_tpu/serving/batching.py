@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional
 
@@ -34,6 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from .templates.openai_compat import _build_cached_decode, _sample_live
+
+
+def _unwrap_params(params):
+    """Accept either a raw param tree or a ``{"params": tree}`` wrapper
+    (the flax ``init`` convention) — one place, used by construction and
+    weight-swap paths alike."""
+    return params.get("params", params) if isinstance(params, dict) \
+        else params
 
 
 class _Slot:
@@ -54,10 +63,9 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
                  top_k: int = 0, top_p: float = 1.0, horizon: int = 1,
-                 prefix_cache_slots: int = 0):
+                 prefix_cache_slots: int = 0, prefix_max_tail: int = 4):
         self.model = model
-        self.raw_params = params.get("params", params) \
-            if isinstance(params, dict) else params
+        self.raw_params = _unwrap_params(params)
         self.n_slots = int(slots)
         self.buf_len = int(buf_len)
         self.top_k = int(top_k)
@@ -83,7 +91,8 @@ class ContinuousBatchingEngine:
         self.prefix_cache = None
         if prefix_cache_slots:
             from .templates.openai_compat import PrefixCache
-            self.prefix_cache = PrefixCache(prefix_cache_slots)
+            self.prefix_cache = PrefixCache(prefix_cache_slots,
+                                            max_tail=prefix_max_tail)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         wdtype = weight_dtype(model)
@@ -140,6 +149,9 @@ class ContinuousBatchingEngine:
         self._waiting: "queue.Queue[dict]" = queue.Queue()
         self._cond = threading.Condition()
         self._stopped = False
+        # weight swap staged by update_params(); applied by the engine
+        # thread once live slots drain (admission pauses meanwhile)
+        self._pending_params = None
         self._ticks = 0  # batched steps executed (observability)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -178,6 +190,43 @@ class ContinuousBatchingEngine:
             if t is None:
                 return out
             out.append(t)
+
+    def update_params(self, params, wait: bool = True,
+                      timeout: float = 60.0) -> None:
+        """Swap the serving weights (federated round boundary).
+
+        The swap is staged and applied by the engine thread only once the
+        in-flight slots drain — admission pauses while a swap is pending —
+        so every request is served end-to-end by exactly one weight
+        version (no mid-stream weight change, no old-weights engine vs
+        new-weights fall-through split).  The engine's prefix cache is
+        cleared atomically with the swap.  Same-structure trees reuse the
+        compiled programs (params are traced arguments).  ``wait=True``
+        blocks until the swap lands; the drain is bounded by in-flight
+        ``max_new_tokens`` budgets.
+        """
+        raw = _unwrap_params(params)
+        with self._cond:
+            if self._stopped or not self._thread.is_alive():
+                raise RuntimeError("engine stopped")
+            self._pending_params = raw
+            self._cond.notify_all()
+            if not wait:
+                return
+            deadline = time.monotonic() + timeout
+            while self._pending_params is not None:
+                if self._stopped or not self._thread.is_alive():
+                    raise RuntimeError("engine stopped during weight swap")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        "weight swap did not land within "
+                        f"{timeout}s (in-flight requests still draining)")
+                self._cond.wait(timeout=min(0.5, remaining))
+
+    def _on_swap(self) -> None:
+        """Hook run (under ``_cond``) when the staged swap is applied —
+        the speculative subclass swaps its draft tree here."""
 
     def stop(self):
         self._stopped = True
@@ -270,11 +319,13 @@ class ContinuousBatchingEngine:
                         self._finish(i)
                 while not self._waiting.empty():
                     self._waiting.get()["q"].put(None)
+                self._cond.notify_all()  # wake update_params waiters
 
     def _run_loop(self):
         while True:
             with self._cond:
                 while (not self._stopped and self._waiting.empty()
+                       and self._pending_params is None
                        and not any(s.live for s in self._slots)):
                     self._cond.wait(timeout=0.5)
                 if self._stopped:
@@ -283,10 +334,26 @@ class ContinuousBatchingEngine:
                             self._finish(i)
                     while not self._waiting.empty():
                         self._waiting.get()["q"].put(None)
+                    self._cond.notify_all()
                     return
+                # apply a staged weight swap once live slots drain; the
+                # prefix cache clears atomically with it (its old entries
+                # are keyed by the old params identity anyway — clearing
+                # frees the old tree + stale KV eagerly)
+                swap_pending = self._pending_params is not None
+                if swap_pending and not any(s.live for s in self._slots):
+                    self.raw_params = self._pending_params
+                    self._pending_params = None
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.clear()
+                    self._on_swap()
+                    swap_pending = False
+                    self._cond.notify_all()
 
-            # admit waiting requests into free slots (token-granularity join)
-            while not self._waiting.empty():
+            # admit waiting requests into free slots (token-granularity
+            # join) — paused while a swap waits for the drain, so no
+            # request straddles the weight boundary
+            while not swap_pending and not self._waiting.empty():
                 slot = self._free_slot()
                 if slot is None:
                     break
@@ -339,7 +406,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, model, params, draft_model, draft_params,
                  slots: int = 4, buf_len: int = 256, k: int = 4,
-                 prefix_cache_slots: int = 0):
+                 prefix_cache_slots: int = 0, prefix_max_tail: int = 4):
         self.k = int(k)
         assert self.k >= 1
         for m, name in ((model, "model"), (draft_model, "draft_model")):
@@ -355,13 +422,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                     f"{buf_len + self.k + 1}: speculative blocks would "
                     "clamp their cache writes")
         self.draft_model = draft_model
-        self.raw_draft = draft_params.get("params", draft_params) \
-            if isinstance(draft_params, dict) else draft_params
+        self.raw_draft = _unwrap_params(draft_params)
+        self._pending_draft = None
         self._hist: Dict[int, List[int]] = {}
         self._fds = np.zeros(int(slots), np.int32)
         super().__init__(model, params, slots=slots, buf_len=buf_len,
                          top_k=0, horizon=1,
-                         prefix_cache_slots=prefix_cache_slots)
+                         prefix_cache_slots=prefix_cache_slots,
+                         prefix_max_tail=prefix_max_tail)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         t_wdtype = weight_dtype(model)
@@ -400,6 +468,22 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         # observability: target forwards vs tokens out (acceptance rate)
         self.stats = {"target_block_forwards": 0, "proposed": 0,
                       "accepted": 0}
+
+    def update_params(self, params, draft_params=None, wait: bool = True,
+                      timeout: float = 60.0) -> None:
+        """Swap target (and optionally draft) weights after the in-flight
+        drain.  A stale draft only lowers the acceptance rate — greedy
+        verification against the target keeps outputs exact — so the
+        draft swap is optional."""
+        if draft_params is not None:
+            with self._cond:
+                self._pending_draft = _unwrap_params(draft_params)
+        super().update_params(params, wait=wait, timeout=timeout)
+
+    def _on_swap(self) -> None:
+        if self._pending_draft is not None:
+            self.raw_draft = self._pending_draft
+            self._pending_draft = None
 
     def submit(self, prompt_ids, max_new_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0, eos_id=None):

@@ -182,8 +182,16 @@ class PrefixCache:
     and threads is safe; the dict itself is guarded by a lock.
     """
 
-    def __init__(self, capacity: int = 8):
+    def __init__(self, capacity: int = 8, max_tail: int = 4):
         self.capacity = int(capacity)
+        #: partial-hit admission bound, in TOKENS of uncached tail.  The
+        #: tail replays as one jitted dispatch PER token while the miss
+        #: path is ONE prefill dispatch, so on dispatch-bound targets
+        #: (~70 ms/launch over a tunnel-attached TPU — SERVE_RTT_SIM) the
+        #: break-even is a few tokens regardless of prompt length; a
+        #: proportional bound (n/4) would invert the win exactly where
+        #: serving latency matters most (round-4 advisor finding).
+        self.max_tail = int(max_tail)
         self._entries = collections.OrderedDict()   # tuple(ids) -> cache
         self._lock = threading.Lock()
         #: the params tree the cached KV was computed under — held by
@@ -243,19 +251,20 @@ class PrefixCache:
                 if c > best:
                     best, best_key = c, key
             # hit policy: the uncached tail replays as single-token steps
-            # (one dispatch each), so a SHORT common prefix would be
-            # slower than one prefill dispatch — take the hit only when
-            # the tail is at most max(4, n/4) tokens (>= ~75% of prefill
-            # work skipped); otherwise report a miss and let the caller
-            # prefill from scratch
-            if best_key is not None and \
-                    len(t) - best <= max(4, len(t) // 4):
+            # (one dispatch each) while a miss costs ONE prefill dispatch,
+            # so admission is gated on an ABSOLUTE tail bound (max_tail
+            # tokens) — dispatch count, not FLOPs, is the serving cost
+            # model; exact hits (1 idempotent replay step) always win
+            if best_key is not None and len(t) - best <= self.max_tail:
                 self._entries.move_to_end(best_key)   # LRU recency
                 cache = self._entries[best_key]
                 self.stats["hits"] += 1
                 if best == len(t):
                     self.stats["exact_hits"] += 1
-                self.stats["prefill_tokens_skipped"] += best
+                # positions genuinely not re-forwarded: an exact hit still
+                # replays the last prompt position, a prefix hit replays
+                # best..n-1 — so min(best, n-1), not the matched length
+                self.stats["prefill_tokens_skipped"] += min(best, len(t) - 1)
                 return best, cache
             self.stats["misses"] += 1
             return 0, None
@@ -382,7 +391,8 @@ class OpenAICompatServer:
                  port: int = 0, buf_len: int = 256, model=None,
                  batch_slots: int = 0, draft_model=None, draft_params=None,
                  decode_horizon: int = 1, spec_k: int = 4,
-                 prefix_cache_slots: int = 0, adapters=None):
+                 prefix_cache_slots: int = 0, prefix_max_tail: int = 4,
+                 adapters=None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -424,7 +434,8 @@ class OpenAICompatServer:
             raise ValueError("prefix_cache_slots requires `model` "
                              "(prefix caching is KV-cache-based)")
         if prefix_cache_slots and not batch_slots:
-            self.prefix_cache = PrefixCache(prefix_cache_slots)
+            self.prefix_cache = PrefixCache(prefix_cache_slots,
+                                            max_tail=prefix_max_tail)
         # adapters: {name: LoRA tree} over ONE shared base — per-request
         # personalization for federated clients (request field
         # {"adapter": name}; no field = the zero adapter = base behavior).
@@ -484,7 +495,8 @@ class OpenAICompatServer:
                     model, params, draft_model, draft_params,
                     slots=int(batch_slots), buf_len=buf_len,
                     k=int(spec_k),
-                    prefix_cache_slots=int(prefix_cache_slots))
+                    prefix_cache_slots=int(prefix_cache_slots),
+                    prefix_max_tail=int(prefix_max_tail))
                 self.prefix_cache = self._engine.prefix_cache
                 self._engine_greedy_only = True
             else:
@@ -492,7 +504,8 @@ class OpenAICompatServer:
                 self._engine = ContinuousBatchingEngine(
                     model, params, slots=int(batch_slots), buf_len=buf_len,
                     horizon=int(decode_horizon),
-                    prefix_cache_slots=int(prefix_cache_slots))
+                    prefix_cache_slots=int(prefix_cache_slots),
+                    prefix_max_tail=int(prefix_max_tail))
                 self.prefix_cache = self._engine.prefix_cache
         self._server: Optional[ThreadingHTTPServer] = None
 
@@ -681,12 +694,34 @@ class OpenAICompatServer:
                              "with adapters={} to enable personalization")
         self.adapters[str(name)] = lora_tree
 
-    def update_params(self, params) -> None:
-        """Swap the serving weights (federated round boundary).  Clears
-        the prefix cache EAGERLY: its strong params ref would otherwise
-        keep the old tree + stale KV resident until the next request."""
+    def update_params(self, params, draft_params=None) -> None:
+        """Swap the serving weights (federated round boundary).
+
+        Engine mode: the swap is delegated to the batching engine, which
+        applies it once in-flight requests drain (its admission pauses
+        meanwhile) and clears its prefix cache atomically with the swap —
+        so the engine path and the sampled fall-through path serve the
+        SAME weight version once this returns.  Non-engine mode: swaps
+        ``self.params`` and clears the prefix cache eagerly (its strong
+        params ref would otherwise keep the old tree + stale KV resident
+        until the next request).  ``draft_params`` also swaps the
+        speculative draft (optional: a stale draft only lowers acceptance
+        rate; greedy verification keeps outputs exact).
+        """
+        if draft_params is not None and self.draft_model is None:
+            # validate BEFORE mutating: a failed call must not leave the
+            # fall-through path on new weights with the engine on old
+            raise ValueError("draft_params given but the server was "
+                             "built without draft_model")
         self.params = params
-        if self.prefix_cache is not None:
+        if draft_params is not None:
+            self.draft_params = draft_params
+        if self._engine is not None:
+            if hasattr(self._engine, "raw_draft"):
+                self._engine.update_params(params, draft_params=draft_params)
+            else:
+                self._engine.update_params(params)
+        elif self.prefix_cache is not None:
             self.prefix_cache.clear()
 
     # -- lifecycle ---------------------------------------------------------

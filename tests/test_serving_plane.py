@@ -763,6 +763,29 @@ def test_prefix_cache_greedy_parity_and_reuse():
     m, c = small.lookup([1, 2, 3])
     assert c is None, "evicted entry still served"
 
+    # dispatch-aware admission (round-4 advisor): a long uncached tail
+    # must MISS regardless of prompt length — each tail token replays as
+    # one dispatch, so a 10-token tail costs ~10 RTTs where the miss
+    # path costs 1; the old proportional bound (n/4) would have hit here
+    gate = PrefixCache(capacity=4)
+    long_prompt = list(range(1, 51))
+    gate.insert(long_prompt, object(), params)
+    hit_len, cache = gate.lookup(long_prompt[:40] + [91] * 10, params)
+    assert cache is None and gate.stats["misses"] == 1
+    # tail at the bound still hits; skipped counts positions genuinely
+    # not re-forwarded (exact hit replays the last position: n-1)
+    hit_len, cache = gate.lookup(long_prompt[:46] + [91] * 4, params)
+    assert cache is not None and hit_len == 46
+    assert gate.stats["prefill_tokens_skipped"] == 46
+    hit_len, cache = gate.lookup(long_prompt, params)
+    assert gate.stats["exact_hits"] == 1
+    assert gate.stats["prefill_tokens_skipped"] == 46 + 49
+    # the bound is configurable for dispatch-cheap (local-chip) targets
+    roomy = PrefixCache(capacity=4, max_tail=16)
+    roomy.insert(long_prompt, object(), params)
+    _, cache = roomy.lookup(long_prompt[:40] + [91] * 10, params)
+    assert cache is not None
+
 
 def test_prefix_cache_over_http_server():
     """Server wiring: prefix_cache_slots routes the non-engine cached
@@ -964,6 +987,89 @@ def test_server_weight_swap_over_http():
         assert ask() == new                     # stable under new weights
     finally:
         srv.stop()
+
+
+def test_engine_weight_swap_serves_new_weights():
+    """Round-4 advisor (medium): a server built with batch_slots kept
+    serving its engine's construction-time weights after update_params().
+    The engine must swap: post-swap greedy outputs equal a fresh engine
+    built on the new tree, the engine prefix cache clears with the swap,
+    and the speculative engine swaps target+draft while outputs stay
+    exact."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import (ContinuousBatchingEngine,
+                                            SpeculativeBatchingEngine)
+    from fedml_tpu.serving.templates.openai_compat import (OpenAICompatServer,
+                                                           generate)
+
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=1, n_heads=2,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=160,
+                      dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    p0 = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+    p1 = model.init(jax.random.PRNGKey(9),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = [5, 9, 12, 15, 18]
+    apply_fn = lambda p, t: model.apply({"params": p}, t)
+    ref0 = generate(apply_fn, p0, prompt, max_new_tokens=8, buf_len=96,
+                    model=model)
+    ref1 = generate(apply_fn, p1, prompt, max_new_tokens=8, buf_len=96,
+                    model=model)
+    assert ref0 != ref1  # differently-seeded inits must actually differ
+
+    eng = ContinuousBatchingEngine(model, p0, slots=2, buf_len=96,
+                                   prefix_cache_slots=4)
+    try:
+        assert eng.generate(prompt, max_new_tokens=8) == ref0
+        eng.update_params({"params": p1})        # wrapped tree accepted
+        assert len(eng.prefix_cache._entries) == 0, \
+            "engine prefix cache must clear with the swap"
+        assert eng.generate(prompt, max_new_tokens=8) == ref1, \
+            "engine still serving construction-time weights after swap"
+        assert eng.generate(prompt, max_new_tokens=8) == ref1
+    finally:
+        eng.stop()
+
+    # server-level: batch_slots path must route the swap into its engine
+    srv = OpenAICompatServer(apply_fn, p0, model=model, buf_len=96,
+                             batch_slots=2)
+    try:
+        q = srv._engine.submit(prompt, max_new_tokens=8)
+        out = []
+        while (t := q.get()) is not None:
+            out.append(t)
+        assert out == ref0
+        srv.update_params(p1)
+        q = srv._engine.submit(prompt, max_new_tokens=8)
+        out = []
+        while (t := q.get()) is not None:
+            out.append(t)
+        assert out == ref1, "server engine path served old weights"
+    finally:
+        srv.stop()
+
+    # speculative engine: swap target+draft, outputs stay exact (greedy
+    # verification against the swapped target)
+    draft_cfg = LlamaConfig(vocab_size=97, dim=16, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_dim=32, max_seq_len=160,
+                            dtype=jnp.float32)
+    draft = LlamaLM(draft_cfg)
+    d0 = draft.init(jax.random.PRNGKey(1),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+    d1 = draft.init(jax.random.PRNGKey(2),
+                    jnp.zeros((1, 8), jnp.int32))["params"]
+    spec = SpeculativeBatchingEngine(model, p0, draft, d0, slots=2,
+                                     buf_len=96, k=3)
+    try:
+        assert spec.generate(prompt, max_new_tokens=8) == ref0
+        spec.update_params(p1, draft_params=d1)
+        assert spec.generate(prompt, max_new_tokens=8) == ref1
+        assert spec.raw_draft is d1
+    finally:
+        spec.stop()
 
 
 def test_multi_adapter_personalized_serving():

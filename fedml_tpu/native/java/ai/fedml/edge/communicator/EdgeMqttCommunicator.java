@@ -12,7 +12,8 @@ import java.util.concurrent.ConcurrentHashMap;
 import java.util.concurrent.CopyOnWriteArrayList;
 import java.util.concurrent.CountDownLatch;
 import java.util.concurrent.ExecutorService;
-import java.util.concurrent.Executors;
+import java.util.concurrent.LinkedBlockingQueue;
+import java.util.concurrent.ThreadPoolExecutor;
 import java.util.concurrent.TimeUnit;
 import java.util.concurrent.atomic.AtomicBoolean;
 import java.util.concurrent.atomic.AtomicInteger;
@@ -74,13 +75,28 @@ public final class EdgeMqttCommunicator {
      *  reader thread: a slow subscriber (e.g. one that trains on the
      *  received model) must neither stall inbound packet processing nor
      *  starve the keepalive watchdog into a false disconnect.  One
-     *  thread preserves per-connection delivery order. */
+     *  thread preserves per-connection delivery order.  The queue is
+     *  BOUNDED with a blocking-put overflow handler: under sustained
+     *  overload the reader blocks on the full queue (keeping FIFO
+     *  delivery — caller-runs would let new messages jump the queue),
+     *  restoring the TCP flow-control backpressure that throttles the
+     *  broker instead of buffering unbounded multi-MB payloads until
+     *  OutOfMemoryError on a memory-constrained edge device. */
     private final ExecutorService listenerExec =
-            Executors.newSingleThreadExecutor(r -> {
-                Thread t = new Thread(r, "mqtt-edge-dispatch");
-                t.setDaemon(true);
-                return t;
-            });
+            new ThreadPoolExecutor(1, 1, 0L, TimeUnit.MILLISECONDS,
+                    new LinkedBlockingQueue<>(64), r -> {
+                        Thread t = new Thread(r, "mqtt-edge-dispatch");
+                        t.setDaemon(true);
+                        return t;
+                    }, (r, exec) -> {
+                        try {
+                            if (!exec.isShutdown()) {
+                                exec.getQueue().put(r);
+                            }
+                        } catch (InterruptedException ie) {
+                            Thread.currentThread().interrupt();
+                        }
+                    });
     private String willTopic;
     private byte[] willPayload;
     private int willQos;

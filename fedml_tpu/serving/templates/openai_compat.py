@@ -425,10 +425,11 @@ class OpenAICompatServer:
         # consulted by generate(); engine path: the engine builds its own
         # and consults it at admission (self.prefix_cache aliases it
         # below so stats stay reachable either way — but the sampled
-        # fall-through around a greedy-only engine does NOT use it: the
-        # engine admits with its construction-time params while
-        # generate() uses self.params, and after update_params() those
-        # identities differ, so sharing would ping-pong invalidation).
+        # fall-through around a greedy-only engine does NOT use it:
+        # update_params() swaps the engine only after its in-flight
+        # drain, so MID-SWAP the engine's tree and self.params diverge
+        # and sharing one cache would ping-pong invalidation between the
+        # two identities; separate caches keep each path self-consistent).
         self.prefix_cache = None
         if prefix_cache_slots and model is None:
             raise ValueError("prefix_cache_slots requires `model` "
@@ -694,7 +695,8 @@ class OpenAICompatServer:
                              "with adapters={} to enable personalization")
         self.adapters[str(name)] = lora_tree
 
-    def update_params(self, params, draft_params=None) -> None:
+    def update_params(self, params, draft_params=None,
+                      timeout: float = 60.0) -> None:
         """Swap the serving weights (federated round boundary).
 
         Engine mode: the swap is delegated to the batching engine, which
@@ -706,22 +708,30 @@ class OpenAICompatServer:
         params ref would otherwise keep the old tree + stale KV resident
         until the next request).  ``draft_params`` also swaps the
         speculative draft (optional: a stale draft only lowers acceptance
-        rate; greedy verification keeps outputs exact).
+        rate; greedy verification keeps outputs exact).  ``timeout``
+        bounds the engine drain — size it to the slowest legal request
+        (roughly ``buf_len`` x per-dispatch latency); on ``TimeoutError``
+        NOTHING has been mutated, so the caller can simply retry.
         """
         if draft_params is not None and self.draft_model is None:
             # validate BEFORE mutating: a failed call must not leave the
             # fall-through path on new weights with the engine on old
             raise ValueError("draft_params given but the server was "
                              "built without draft_model")
+        # engine swap FIRST, for the same reason: it can raise on a drain
+        # timeout, and a failed call must leave the server fully on the
+        # old version — assigning self.params before the engine landed
+        # would split the sampled fall-through (new) from the engine (old)
+        if self._engine is not None:
+            if hasattr(self._engine, "raw_draft"):
+                self._engine.update_params(params, draft_params=draft_params,
+                                           timeout=timeout)
+            else:
+                self._engine.update_params(params, timeout=timeout)
         self.params = params
         if draft_params is not None:
             self.draft_params = draft_params
-        if self._engine is not None:
-            if hasattr(self._engine, "raw_draft"):
-                self._engine.update_params(params, draft_params=draft_params)
-            else:
-                self._engine.update_params(params)
-        elif self.prefix_cache is not None:
+        if self._engine is None and self.prefix_cache is not None:
             self.prefix_cache.clear()
 
     # -- lifecycle ---------------------------------------------------------

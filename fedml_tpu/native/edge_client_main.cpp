@@ -18,13 +18,31 @@
 // exposes the same C ABI the .so does).
 //
 // usage: fedml_edge_client <work_dir> <client_id> <data_bundle> [poll_ms]
+//        [drop_round]
+//
+// Secure mode (task.txt: secure=1 lsa_n=N lsa_u=U lsa_t=T) runs the full
+// LightSecAgg protocol natively (reference
+// android/fedmlsdk/MobileNN/src/security/LightSecAgg.cpp capability):
+//   1. quantize trained weights into GF(p), add a private PRG mask z_i,
+//      upload client_C.masked.i64 (the server never sees plaintext);
+//   2. LCC-encode z_i into N Vandermonde shares, upload shares_C.i64
+//      (row j is for client j — the shared dir stands in for the
+//      pairwise channels of the reference's MQTT transport);
+//   3. wait for the server's survivors.txt announcement, sum the share
+//      rows addressed to us from surviving sources, upload
+//      aggshare_C.i64; the server one-shot-decodes the SUM mask from any
+//      U aggregate shares and unmasks the aggregate.
+// [drop_round]: exit after step 2 of that round — deterministic dropout
+// for tests; the protocol must still reconstruct (that is its point).
 
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
 #include <chrono>
+#include <vector>
 
 #include <sys/stat.h>
 
@@ -36,6 +54,13 @@ void fedml_edge_get_epoch_and_loss(void* mgr, int* epoch, float* loss);
 int fedml_edge_save_model(void* mgr, const char* path);
 void fedml_edge_destroy(void* mgr);
 long long fedml_edge_num_samples(void* mgr);
+long long fedml_edge_flat_size(void* mgr);
+void fedml_edge_get_flat(void* mgr, float* out);
+void fedml_lsa_mask(long long* data, long long n, long long seed, int sign);
+long long fedml_lsa_encode(const long long* mask, long long d, int N, int U,
+                           int T, long long seed, long long* out_shares);
+void fedml_lsa_aggregate(const long long* shares, int m, long long block,
+                         long long* out);
 }
 
 namespace {
@@ -49,6 +74,8 @@ struct Task {
   int round = -1, epochs = 1, batch = 32;
   float lr = 0.05f;
   long long seed = 0;
+  // secure aggregation (LightSecAgg) — 0/absent = plaintext uploads
+  int secure = 0, lsa_n = 0, lsa_u = 0, lsa_t = 0;
 };
 
 bool read_task(const std::string& path, Task* t) {
@@ -62,9 +89,68 @@ bool read_task(const std::string& path, Task* t) {
     else if (!std::strcmp(key, "batch")) t->batch = (int)val;
     else if (!std::strcmp(key, "lr")) t->lr = (float)val;
     else if (!std::strcmp(key, "seed")) t->seed = (long long)val;
+    else if (!std::strcmp(key, "secure")) t->secure = (int)val;
+    else if (!std::strcmp(key, "lsa_n")) t->lsa_n = (int)val;
+    else if (!std::strcmp(key, "lsa_u")) t->lsa_u = (int)val;
+    else if (!std::strcmp(key, "lsa_t")) t->lsa_t = (int)val;
   }
   std::fclose(f);
   return t->round >= 0;
+}
+
+// int64-vector files for field payloads (masked updates, coded shares):
+// magic "FTI8", int64 count, raw little-endian int64s.  The float .fteb
+// bundle cannot carry field elements — values up to 2^31-1 do not survive
+// a float32 mantissa.
+constexpr uint32_t kI64Magic = 0x38495446;  // "FTI8"
+
+bool write_i64(const std::string& path, const long long* v, long long n) {
+  const std::string tmp = path + ".tmp";
+  FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (!f) return false;
+  bool ok = std::fwrite(&kI64Magic, 4, 1, f) == 1 &&
+            std::fwrite(&n, 8, 1, f) == 1 &&
+            std::fwrite(v, 8, (size_t)n, f) == (size_t)n;
+  std::fclose(f);
+  return ok && std::rename(tmp.c_str(), path.c_str()) == 0;
+}
+
+bool read_i64(const std::string& path, std::vector<long long>* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  uint32_t magic = 0;
+  long long n = 0;
+  bool ok = std::fread(&magic, 4, 1, f) == 1 && magic == kI64Magic &&
+            std::fread(&n, 8, 1, f) == 1 && n >= 0;
+  if (ok) {
+    out->resize((size_t)n);
+    ok = std::fread(out->data(), 8, (size_t)n, f) == (size_t)n;
+  }
+  std::fclose(f);
+  return ok;
+}
+
+// quantize trained weights into GF(p) — fixed-point, matches
+// core/mpc/secagg.py::quantize (scale 2^16, wraparound negatives)
+constexpr long long kP = (1LL << 31) - 1;
+constexpr double kScale = 65536.0;
+
+void quantize_flat(const float* w, long long d, long long* out) {
+  for (long long i = 0; i < d; ++i) {
+    long long q = (long long)std::llround((double)w[i] * kScale) % kP;
+    out[i] = q < 0 ? q + kP : q;
+  }
+}
+
+// survivors.txt: one client id per line (the server's round-2 announcement
+// of which sources' masked updates it accepted)
+bool read_survivors(const std::string& path, std::vector<int>* out) {
+  FILE* f = std::fopen(path.c_str(), "r");
+  if (!f) return false;
+  int id;
+  while (std::fscanf(f, "%d\n", &id) == 1) out->push_back(id);
+  std::fclose(f);
+  return !out->empty();
 }
 
 }  // namespace
@@ -80,6 +166,7 @@ int main(int argc, char** argv) {
   const int client_id = std::atoi(argv[2]);
   const std::string data_path = argv[3];
   const int poll_ms = argc > 4 ? std::atoi(argv[4]) : 50;
+  const int drop_round = argc > 5 ? std::atoi(argv[5]) : -1;
 
   int round = 0;
   for (;;) {
@@ -115,6 +202,93 @@ int main(int argc, char** argv) {
     long long n = fedml_edge_num_samples(mgr);
 
     const std::string out = rdir + "/client_" + std::to_string(client_id);
+    if (task.secure) {
+      // -- LightSecAgg upload path (no plaintext leaves the device) ------
+      const int k = task.lsa_u - task.lsa_t;
+      if (k <= 0 || task.lsa_n < task.lsa_u) {
+        std::fprintf(stderr, "[edge %d] bad LSA params N=%d U=%d T=%d\n",
+                     client_id, task.lsa_n, task.lsa_u, task.lsa_t);
+        fedml_edge_destroy(mgr);
+        return 1;
+      }
+      const long long d = fedml_edge_flat_size(mgr);
+      const long long block = (d + k - 1) / k;
+      std::vector<float> flat((size_t)d);
+      fedml_edge_get_flat(mgr, flat.data());
+      std::vector<long long> q((size_t)d);
+      quantize_flat(flat.data(), d, q.data());
+      // private per-round mask z_i: PRG from zeros via fedml_lsa_mask
+      // (deterministic seed keeps tests reproducible; a deployment would
+      // draw from the device entropy source)
+      std::vector<long long> z((size_t)k * block, 0);
+      const long long zseed =
+          task.seed * 7919LL + 104729LL * client_id + round;
+      fedml_lsa_mask(z.data(), (long long)z.size(), zseed, 1);
+      for (long long i = 0; i < d; ++i) q[(size_t)i] = (q[i] + z[i]) % kP;
+      std::vector<long long> shares((size_t)task.lsa_n * block);
+      if (fedml_lsa_encode(z.data(), (long long)z.size(), task.lsa_n,
+                           task.lsa_u, task.lsa_t, zseed ^ 0x5C5CLL,
+                           shares.data()) != block ||
+          !write_i64(out + ".masked.i64", q.data(), d) ||
+          !write_i64(rdir + "/shares_" + std::to_string(client_id) + ".i64",
+                     shares.data(), (long long)shares.size())) {
+        std::fprintf(stderr, "[edge %d] secure upload failed\n", client_id);
+        fedml_edge_destroy(mgr);
+        return 1;
+      }
+      FILE* df = std::fopen((out + ".done.tmp").c_str(), "w");
+      std::fprintf(df, "n_samples=%lld\nloss=%f\nepoch=%d\n", n,
+                   (double)loss, epoch);
+      std::fclose(df);
+      std::rename((out + ".done.tmp").c_str(), (out + ".done").c_str());
+      fedml_edge_destroy(mgr);
+      mgr = nullptr;
+      if (drop_round == round) {
+        std::fprintf(stderr,
+                     "[edge %d] simulated dropout after shares (round %d)\n",
+                     client_id, round);
+        return 0;
+      }
+      // -- aggregation phase: wait for the survivor announcement --------
+      std::vector<int> survivors;
+      const std::string surv_path = rdir + "/survivors.txt";
+      while (!read_survivors(surv_path, &survivors)) {
+        if (exists(work_dir + "/finish.txt")) return 0;
+        survivors.clear();
+        std::this_thread::sleep_for(std::chrono::milliseconds(poll_ms));
+      }
+      std::vector<long long> agg((size_t)block, 0);
+      std::vector<long long> their;
+      bool ok = true;
+      for (int src : survivors) {
+        their.clear();
+        const std::string sp =
+            rdir + "/shares_" + std::to_string(src) + ".i64";
+        // survivors' shares files exist by construction: the server only
+        // lists sources whose shares it has seen
+        if (!read_i64(sp, &their) ||
+            (long long)their.size() < (long long)(client_id + 1) * block) {
+          ok = false;
+          break;
+        }
+        const long long* row = their.data() + (size_t)client_id * block;
+        for (long long b = 0; b < block; ++b)
+          agg[(size_t)b] = (agg[b] + row[b] % kP) % kP;
+      }
+      if (!ok) {
+        std::fprintf(stderr, "[edge %d] share read failed\n", client_id);
+        return 1;
+      }
+      if (!write_i64(out + ".aggshare.i64", agg.data(), block)) {
+        std::fprintf(stderr, "[edge %d] aggshare write failed\n", client_id);
+        return 1;
+      }
+      std::fprintf(stderr,
+                   "[edge %d] secure round %d done: n=%lld loss=%.4f\n",
+                   client_id, round, n, (double)loss);
+      ++round;
+      continue;
+    }
     const std::string tmp = out + ".fteb.tmp";
     if (fedml_edge_save_model(mgr, tmp.c_str()) != 0) {
       std::fprintf(stderr, "[edge %d] save failed\n", client_id);

@@ -18,6 +18,7 @@
 #include <cmath>
 #include <cstdlib>
 #include <string>
+#include <utility>
 #include <vector>
 #include <atomic>
 
@@ -265,6 +266,23 @@ class EdgeTrainer {
   float loss() const { return loss_; }
   int64_t num_samples() const { return n_; }
 
+  // flattened parameter vector (w1, b1[, w2, b2] order — the layout the
+  // secure-aggregation path quantizes into the field)
+  int64_t flat_size() const {
+    int64_t n = w1_.size() + b1_.size();
+    if (has_hidden_) n += w2_.size() + b2_.size();
+    return n;
+  }
+  void get_flat(float* out) const {
+    const Tensor* ts[4] = {&w1_, &b1_, has_hidden_ ? &w2_ : nullptr,
+                           has_hidden_ ? &b2_ : nullptr};
+    for (const Tensor* t : ts) {
+      if (!t) continue;
+      std::memcpy(out, t->data.data(), sizeof(float) * t->data.size());
+      out += t->data.size();
+    }
+  }
+
  private:
   Tensor w1_, b1_, w2_, b2_, x_, y_;
   bool has_hidden_ = false;
@@ -275,6 +293,76 @@ class EdgeTrainer {
   float loss_ = 0.f;
   std::atomic<bool> stop_{false};
 };
+
+// -- GF(p) helpers for LightSecAgg LCC coding (p = 2^31-1: products of two
+// residues stay < 2^62, so plain int64 arithmetic never overflows) --------
+inline long long mulmod_p(long long a, long long b) {
+  return (a % kPrime) * (b % kPrime) % kPrime;
+}
+
+inline long long powmod_p(long long a, long long e) {
+  long long r = 1;
+  a %= kPrime;
+  while (e > 0) {
+    if (e & 1) r = mulmod_p(r, a);
+    a = mulmod_p(a, a);
+    e >>= 1;
+  }
+  return r;
+}
+
+inline long long invmod_p(long long a) {  // Fermat: a^(p-2) mod p
+  return powmod_p(a, kPrime - 2);
+}
+
+// Vandermonde matrix rows at evaluation points xs[0..rows-1], width k:
+// V[i][j] = xs[i]^j mod p (matches core/mpc/lightsecagg.py::_vandermonde).
+void vandermonde_p(const long long* xs, int rows, int k, long long* V) {
+  for (int i = 0; i < rows; ++i) {
+    long long e = 1;
+    for (int j = 0; j < k; ++j) {
+      V[i * k + j] = e;
+      e = mulmod_p(e, xs[i] % kPrime);
+    }
+  }
+}
+
+// Gaussian elimination over GF(p): solve A X = B in place
+// (A: n x n, B: n x cols).  Returns false on a singular system.
+// Mirrors core/mpc/lightsecagg.py::_solve_field.
+bool solve_field_p(long long* A, long long* B, int n, long long cols) {
+  for (int col = 0; col < n; ++col) {
+    int piv = -1;
+    for (int r = col; r < n; ++r)
+      if (A[r * n + col] % kPrime != 0) { piv = r; break; }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int j = 0; j < n; ++j)
+        std::swap(A[col * n + j], A[piv * n + j]);
+      for (long long j = 0; j < cols; ++j)
+        std::swap(B[col * cols + j], B[piv * cols + j]);
+    }
+    long long inv = invmod_p(A[col * n + col]);
+    for (int j = 0; j < n; ++j) A[col * n + j] = mulmod_p(A[col * n + j], inv);
+    for (long long j = 0; j < cols; ++j)
+      B[col * cols + j] = mulmod_p(B[col * cols + j], inv);
+    for (int r = 0; r < n; ++r) {
+      if (r == col) continue;
+      long long f = A[r * n + col] % kPrime;
+      if (f == 0) continue;
+      for (int j = 0; j < n; ++j) {
+        long long v = (A[r * n + j] - mulmod_p(f, A[col * n + j])) % kPrime;
+        A[r * n + j] = v < 0 ? v + kPrime : v;
+      }
+      for (long long j = 0; j < cols; ++j) {
+        long long v = (B[r * cols + j] - mulmod_p(f, B[col * cols + j]))
+                      % kPrime;
+        B[r * cols + j] = v < 0 ? v + kPrime : v;
+      }
+    }
+  }
+  return true;
+}
 
 }  // namespace
 
@@ -312,6 +400,14 @@ long long fedml_edge_num_samples(void* mgr) {
   return static_cast<EdgeTrainer*>(mgr)->num_samples();
 }
 
+long long fedml_edge_flat_size(void* mgr) {
+  return static_cast<EdgeTrainer*>(mgr)->flat_size();
+}
+
+void fedml_edge_get_flat(void* mgr, float* out) {
+  static_cast<EdgeTrainer*>(mgr)->get_flat(out);
+}
+
 void fedml_edge_destroy(void* mgr) { delete static_cast<EdgeTrainer*>(mgr); }
 
 // LightSecAgg field masking (reference MobileNN LightSecAgg.cpp): adds a
@@ -323,6 +419,75 @@ void fedml_lsa_mask(long long* data, long long n, long long seed, int sign) {
     long long v = (data[i] + (long long)sign * m) % kPrime;
     data[i] = v < 0 ? v + kPrime : v;
   }
+}
+
+// -- LightSecAgg LCC encode/decode (full protocol, not just masking) -----
+// C++ twin of the reference's Lagrange-coded mask encoding
+// (android/fedmlsdk/MobileNN/src/security/LightSecAgg.cpp,
+//  includes/security/LightSecAgg.h) with the same wire layout as the
+// Python plane (fedml_tpu/core/mpc/lightsecagg.py): data blocks F_1..F_{U-T}
+// then T random blocks, Vandermonde-evaluated at points 1..N.  A C++ edge
+// client's shares therefore mix freely with Python clients' shares in one
+// aggregate, and either side can decode.
+
+// Encode a d-length mod-p mask into N coded shares of length
+// block = ceil(d / (U-T)).  out_shares must hold N*block int64s (share for
+// evaluation point j+1 lands at row j).  Returns block length, or -1 on
+// bad parameters.
+long long fedml_lsa_encode(const long long* mask, long long d, int N, int U,
+                           int T, long long seed, long long* out_shares) {
+  int k = U - T;
+  if (k <= 0 || N < U || d <= 0) return -1;
+  long long block = (d + k - 1) / k;
+  // generator matrix: k data rows (padded mask) + T PRG noise rows
+  std::vector<long long> gen((size_t)U * block, 0);
+  for (long long i = 0; i < d; ++i) {
+    long long v = mask[i] % kPrime;
+    gen[(size_t)i] = v < 0 ? v + kPrime : v;
+  }
+  Rng rng((uint64_t)seed * 2654435761ULL + 0x11CCULL);
+  for (long long i = (long long)k * block; i < (long long)U * block; ++i)
+    gen[(size_t)i] = (long long)(rng.next() % (uint64_t)kPrime);
+  std::vector<long long> xs(N);
+  for (int j = 0; j < N; ++j) xs[j] = j + 1;
+  std::vector<long long> V((size_t)N * U);
+  vandermonde_p(xs.data(), N, U, V.data());
+  for (int j = 0; j < N; ++j)
+    for (long long b = 0; b < block; ++b) {
+      long long acc = 0;
+      for (int u = 0; u < U; ++u)
+        acc = (acc + mulmod_p(V[(size_t)j * U + u], gen[(size_t)u * block + b]))
+              % kPrime;
+      out_shares[(size_t)j * block + b] = acc;
+    }
+  return block;
+}
+
+// Sum m shares elementwise mod p (each surviving client aggregates the
+// shares it holds — lightsecagg.py::aggregate_shares).
+void fedml_lsa_aggregate(const long long* shares, int m, long long block,
+                         long long* out) {
+  for (long long b = 0; b < block; ++b) out[b] = 0;
+  for (int i = 0; i < m; ++i)
+    for (long long b = 0; b < block; ++b)
+      out[b] = (out[b] + shares[(size_t)i * block + b] % kPrime) % kPrime;
+}
+
+// One-shot reconstruction: from U aggregated shares at evaluation points
+// ids[0..U-1] (1-based), solve the Vandermonde system and emit the k=U-T
+// data rows (k*block int64s) — the SUM mask, noise rows discarded
+// (lightsecagg.py::decode_aggregate_mask).  Returns 0, or 1 if singular
+// (duplicate ids).
+int fedml_lsa_decode(const long long* agg_shares, const long long* ids,
+                     int U, int T, long long block, long long* out_data) {
+  int k = U - T;
+  if (k <= 0) return 1;
+  std::vector<long long> V((size_t)U * U);
+  vandermonde_p(ids, U, U, V.data());
+  std::vector<long long> B(agg_shares, agg_shares + (size_t)U * block);
+  if (!solve_field_p(V.data(), B.data(), U, block)) return 1;
+  std::memcpy(out_data, B.data(), sizeof(long long) * (size_t)k * block);
+  return 0;
 }
 
 }  // extern "C"

@@ -51,8 +51,22 @@ def load_lib() -> ctypes.CDLL:
         lib.fedml_lsa_mask.argtypes = [
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
             ctypes.c_longlong, ctypes.c_int]
+        LL = ctypes.POINTER(ctypes.c_longlong)
+        lib.fedml_lsa_encode.restype = ctypes.c_longlong
+        lib.fedml_lsa_encode.argtypes = [LL, ctypes.c_longlong, ctypes.c_int,
+                                         ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_longlong, LL]
+        lib.fedml_lsa_aggregate.argtypes = [LL, ctypes.c_int,
+                                            ctypes.c_longlong, LL]
+        lib.fedml_lsa_decode.restype = ctypes.c_int
+        lib.fedml_lsa_decode.argtypes = [LL, LL, ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_longlong, LL]
         _LIB = lib
     return _LIB
+
+
+def _ll_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
 
 
 class FedMLClientManager:
@@ -110,6 +124,59 @@ def lsa_mask(values: np.ndarray, seed: int, sign: int = 1) -> np.ndarray:
     finite-field pipeline in core/mpc)."""
     lib = load_lib()
     arr = np.ascontiguousarray(values, dtype=np.int64)
-    ptr = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
-    lib.fedml_lsa_mask(ptr, arr.size, seed, sign)
+    lib.fedml_lsa_mask(_ll_ptr(arr), arr.size, seed, sign)
     return arr
+
+
+def lsa_encode(mask: np.ndarray, n: int, u: int, t: int,
+               seed: int) -> Dict[int, np.ndarray]:
+    """LCC mask encoding via the native core: returns {eval_point: share}
+    with the same wire layout as ``core.mpc.lightsecagg.mask_encoding``
+    (data blocks then noise blocks, Vandermonde points 1..N), so C++ and
+    Python clients' shares mix in one aggregate."""
+    k = u - t
+    if k <= 0 or n < u:
+        raise ValueError(f"bad LCC parameters N={n} U={u} T={t} "
+                         "(need 0 <= T < U <= N)")
+    lib = load_lib()
+    arr = np.ascontiguousarray(mask, dtype=np.int64)
+    block = -(-arr.size // k)
+    out = np.zeros((n, block), dtype=np.int64)
+    rc = lib.fedml_lsa_encode(_ll_ptr(arr), arr.size, n, u, t, seed,
+                              _ll_ptr(out))
+    if rc < 0:
+        raise ValueError(f"bad LCC parameters N={n} U={u} T={t}")
+    return {j + 1: out[j] for j in range(n)}
+
+
+def lsa_aggregate(shares: "list[np.ndarray]") -> np.ndarray:
+    """Sum shares mod p via the native core (client-side aggregation)."""
+    lib = load_lib()
+    stacked = np.ascontiguousarray(np.stack(shares), dtype=np.int64)
+    out = np.zeros(stacked.shape[1], dtype=np.int64)
+    lib.fedml_lsa_aggregate(_ll_ptr(stacked), stacked.shape[0],
+                            stacked.shape[1], _ll_ptr(out))
+    return out
+
+
+def lsa_decode(agg_shares: Dict[int, np.ndarray], u: int,
+               t: int) -> np.ndarray:
+    """One-shot aggregate-mask reconstruction via the native core: from any
+    ``u`` aggregated shares, recover the (u-t, block) data rows of the sum
+    mask — the server-side decode of
+    ``core.mpc.lightsecagg.decode_aggregate_mask``."""
+    if len(agg_shares) < u:
+        raise ValueError(f"need {u} aggregate shares to decode, have "
+                         f"{len(agg_shares)}")
+    lib = load_lib()
+    ids = sorted(agg_shares.keys())[:u]
+    block = len(agg_shares[ids[0]])
+    stacked = np.ascontiguousarray(
+        np.stack([agg_shares[i] for i in ids]), dtype=np.int64)
+    ids_arr = np.asarray(ids, dtype=np.int64)
+    out = np.zeros((u - t, block), dtype=np.int64)
+    rc = lib.fedml_lsa_decode(_ll_ptr(stacked), _ll_ptr(ids_arr), u, t,
+                              block, _ll_ptr(out))
+    if rc != 0:
+        raise ValueError("singular LCC system (duplicate evaluation points?)")
+    return out

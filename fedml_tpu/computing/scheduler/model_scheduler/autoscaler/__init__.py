@@ -1,6 +1,6 @@
 from .autoscaler import Autoscaler
 from .policies import (AutoscalingPolicy, ConcurrentQueryPolicy, EWMPolicy,
-                       ReactivePolicy)
+                       PredictivePolicy, ReactivePolicy)
 
 __all__ = ["Autoscaler", "AutoscalingPolicy", "ConcurrentQueryPolicy",
-           "EWMPolicy", "ReactivePolicy"]
+           "EWMPolicy", "PredictivePolicy", "ReactivePolicy"]

@@ -42,3 +42,20 @@ class ReactivePolicy(AutoscalingPolicy):
     ReactivePolicy)."""
     metric: str = "qps"              # "qps" | "latency"
     target_value: float = 10.0
+
+
+@dataclass
+class PredictivePolicy(AutoscalingPolicy):
+    """Lookahead (predictive) scaling.  The reference DECLARES this policy
+    but ships it as a TODO stub (``model_scheduler/autoscaler/policies.py:96``
+    and ``autoscaler.py:42`` — "TO BE COMPLETED!"); here it is implemented:
+    Holt double-exponential smoothing (level + trend) over the per-second
+    qps series, extrapolated ``lookahead_secs + scaleup_cost_secs`` ahead,
+    so capacity is provisioned for the load that will exist when a cold
+    replica becomes READY — scale-up happens BEFORE the load arrives
+    instead of after the reactive threshold trips."""
+    target_qps_per_replica: float = 10.0
+    lookahead_secs: float = 30.0
+    history_secs: float = 300.0
+    level_alpha: float = 0.6         # smoothing for the qps level
+    trend_beta: float = 0.3          # smoothing for the qps/sec trend

@@ -160,6 +160,10 @@ def test_text_transformer_fednlp_learns():
     args = load_arguments()
     args.update(dataset="20news", model="distilbert", seq_len=32,
                 vocab_size=512, model_dim=64, model_layers=2, model_heads=4,
+                # easy generator setting: this test pins that the MODEL
+                # learns in 8 tiny rounds; task difficulty itself is pinned
+                # by test_datasets_ext.py::test_text_generator_calibration
+                text_class_signal=0.5, text_keyword_width=1.0,
                 model_ffn_dim=128, train_size=600, test_size=120,
                 client_num_in_total=6, client_num_per_round=3, comm_round=8,
                 epochs=1, batch_size=20, learning_rate=1e-3,

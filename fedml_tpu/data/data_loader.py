@@ -37,6 +37,9 @@ from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
 _IMAGE_SPECS = {
     "mnist": (10, (28, 28, 1), 60000, 10000),
     "synthetic_mnist": (10, (28, 28, 1), 60000, 10000),
+    # REAL bytes in-image: sklearn's UCI optical-digits corpus, shipped as
+    # a LEAF shard by tools/make_real_shards.py (data_shards/digits)
+    "digits": (10, (8, 8, 1), 1527, 270),
     "femnist": (62, (28, 28, 1), 60000, 10000),
     "fashionmnist": (10, (28, 28, 1), 60000, 10000),
     "emnist": (62, (28, 28, 1), 60000, 10000),
@@ -83,6 +86,10 @@ _TEXTCLS_SPECS = {
     "fednlp": (20, 30000, 128, 11000, 2000, 0.25, 2.5),
     "20news": (20, 30000, 128, 11000, 2000, 0.25, 2.5),
     "agnews": (4, 30000, 64, 12000, 2000, 0.35, 2.0),
+    # REAL bytes in-image: installed-package documentation prose
+    # (tools/make_real_shards.py; data_shards/realtext/realtext.npz) —
+    # the synthetic knobs are the fallback path only
+    "realtext": (10, 8192, 128, 2967, 530, 0.25, 2.5),
 }
 
 # large-image sets (reference ``data/ImageNet/`` incl. hdf5 variant,

@@ -52,11 +52,14 @@ def create(args, output_dim: int = 10) -> FlaxModel:
     if name == "mlp":
         return FlaxModel(MLP(hidden=128, output_dim=output_dim), _img_shape(args))
     if name == "cnn":
-        # reference: CNN_DropOut for femnist/mnist (model_hub.py:30-40)
+        # reference: CNN_DropOut for femnist/mnist (model_hub.py:30-40);
+        # honor an explicit input_shape (e.g. the 8x8 real-digits shard) —
+        # flax infers the Dense fan-in from the init dummy, so init and
+        # apply must agree on the image shape
         only_digits = "femnist" not in ds and "emnist" not in ds
         out = output_dim if output_dim else (10 if only_digits else 62)
-        return FlaxModel(CNNDropOut(out, only_digits=only_digits), _IMG28,
-                         has_dropout=True)
+        return FlaxModel(CNNDropOut(out, only_digits=only_digits),
+                         _img_shape(args), has_dropout=True)
     if name == "cnn_web":
         return FlaxModel(CNNWeb(output_dim), _img_shape(args))
     if name == "cnn_cifar":

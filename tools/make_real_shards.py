@@ -1,0 +1,178 @@
+"""Real-bytes federated data shards for a zero-egress environment.
+
+Round-4 VERDICT missing #4: the image/text BASELINE rows all ran on
+synthetic bytes (the environment cannot download FEMNIST/CIFAR); the
+real-bytes precedent was tabular-only (sklearn).  This tool writes two
+REAL datasets through the SAME ingestion formats the reference uses, so
+the parser→partition→train→accuracy pipeline is exercised on genuine
+bytes end to end:
+
+- ``make_digits_leaf``: sklearn ``load_digits`` — 1,797 REAL handwritten
+  digit images (the UCI optical-digits corpus bundled inside sklearn,
+  8x8 grayscale) — written as a LEAF train/test JSON shard layout
+  (``data/femnist``-style: users / num_samples / user_data), the format
+  ``fedml_tpu.data.leaf`` parses.  The corpus has no writer ids, so users
+  are a deterministic round-robin split (documented in PROVENANCE).
+
+- ``make_realtext_npz``: a REAL text-classification corpus harvested from
+  documentation shipped inside installed packages (numpy/jax/sklearn/...):
+  label = which package a doc chunk came from.  Real English/technical
+  prose, hash-tokenized to the loader's npz contract (train_x/train_y/
+  test_x/test_y int32 token matrices).
+
+Both stamp a PROVENANCE file so ``FederatedDataset.provenance`` reports
+``real:...`` (never ``synthetic``).  Shards are small (<4 MB total) and
+committed under ``data_shards/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import zlib
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_digits_leaf(root: str, n_users: int = 15,
+                     test_frac: float = 0.15) -> str:
+    """Write sklearn's real handwritten-digit images as a LEAF shard."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = (d.images / 16.0).astype(np.float32).reshape(len(d.target), -1)
+    y = d.target.astype(int)
+
+    out = os.path.join(root, "digits")
+    for split in ("train", "test"):
+        os.makedirs(os.path.join(out, split), exist_ok=True)
+    rng = np.random.default_rng(0)          # split only; bytes untouched
+    order = rng.permutation(len(y))
+    users = {f"u{u:03d}": order[u::n_users] for u in range(n_users)}
+    for split in ("train", "test"):
+        blob = {"users": [], "num_samples": [], "user_data": {}}
+        for u, idxs in users.items():
+            cut = int(round(len(idxs) * (1 - test_frac)))
+            sel = idxs[:cut] if split == "train" else idxs[cut:]
+            blob["users"].append(u)
+            blob["num_samples"].append(len(sel))
+            blob["user_data"][u] = {
+                "x": [[round(float(v), 4) for v in x[i]] for i in sel],
+                "y": [int(y[i]) for i in sel],
+            }
+        with open(os.path.join(out, split, "all_data.json"), "w") as f:
+            json.dump(blob, f)
+    with open(os.path.join(out, "PROVENANCE"), "w") as f:
+        f.write("real:sklearn-digits(uci-optdigits, leaf-format; users are "
+                "a deterministic round-robin split — the corpus ships no "
+                "writer ids)")
+    return out
+
+
+# packages whose installed documentation provides the real text corpus;
+# chosen for distinct-but-overlapping technical vocabulary (numeric
+# stack members share plenty of terms, so the task is not trivial)
+_TEXT_PACKAGES = ("numpy", "jax", "sklearn", "scipy", "torch", "flax",
+                  "optax", "pandas", "setuptools", "chex")
+
+
+def _harvest_package_text(pkg: str, max_bytes: int = 400_000) -> str:
+    import importlib
+
+    try:
+        mod = importlib.import_module(pkg)
+    except Exception:
+        return ""
+    root = os.path.dirname(getattr(mod, "__file__", "") or "")
+    if not root:
+        return ""
+    chunks, total = [], 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in
+                       ("__pycache__", "tests", "test")]
+        for fn in sorted(filenames):
+            if not fn.endswith((".rst", ".md", ".txt", ".py")):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            if fn.endswith(".py"):
+                # docstrings + comments only: prose, not code syntax
+                # (classifying code by syntax tokens would be trivial)
+                parts = re.findall(r'"""(.*?)"""', text, re.S)
+                parts += [ln.lstrip()[1:].strip() for ln in text.splitlines()
+                          if ln.lstrip().startswith("#")]
+                text = "\n".join(parts)
+            chunks.append(text)
+            total += len(text)
+            if total >= max_bytes:
+                return "\n".join(chunks)[:max_bytes]
+    return "\n".join(chunks)[:max_bytes]
+
+
+def _tokenize(text: str, vocab: int, seq_len: int, drop_pkg_names=()):
+    """Hash-tokenize prose into fixed windows; ids 2.. (0=pad, 1=oov-ish).
+    Package self-references are dropped — the label must not literally
+    appear in the features."""
+    words = re.findall(r"[A-Za-z][A-Za-z0-9_]+", text.lower())
+    drop = {p.lower() for p in drop_pkg_names}
+    # crc32, not hash(): Python's hash is salted per process, and the
+    # shard must be reproducible byte-for-byte
+    ids = [2 + (zlib.crc32(w.encode()) % (vocab - 2))
+           for w in words if w not in drop]
+    rows = []
+    for i in range(0, len(ids) - seq_len + 1, seq_len):
+        rows.append(ids[i:i + seq_len])
+    return rows
+
+
+def make_realtext_npz(root: str, vocab: int = 8192, seq_len: int = 128,
+                      test_frac: float = 0.15) -> str:
+    os.makedirs(root, exist_ok=True)
+    tx, ty, vx, vy = [], [], [], []
+    kept = []
+    for label, pkg in enumerate(_TEXT_PACKAGES):
+        text = _harvest_package_text(pkg)
+        rows = _tokenize(text, vocab, seq_len, drop_pkg_names=_TEXT_PACKAGES)
+        if len(rows) < 40:
+            # fail LOUDLY: the class count is pinned in _TEXTCLS_SPECS and
+            # by tests — silently dropping a package would regenerate a
+            # shard whose labels no longer match the registered spec
+            raise RuntimeError(
+                f"package {pkg!r} yielded only {len(rows)} rows — the "
+                "realtext spec pins 10 classes; fix the package list or "
+                "update _TEXTCLS_SPECS + tests together")
+        kept.append(pkg)
+        lbl = len(kept) - 1
+        cut = int(len(rows) * (1 - test_frac))
+        tx.extend(rows[:cut])
+        ty.extend([lbl] * cut)
+        vx.extend(rows[cut:])
+        vy.extend([lbl] * (len(rows) - cut))
+    path = os.path.join(root, "realtext.npz")
+    np.savez_compressed(
+        path,
+        train_x=np.asarray(tx, np.int32), train_y=np.asarray(ty, np.int64),
+        test_x=np.asarray(vx, np.int32), test_y=np.asarray(vy, np.int64))
+    # dataset-scoped marker (PROVENANCE.<name>) so the loader's
+    # name-mention rule attributes it to realtext.npz specifically
+    with open(os.path.join(root, "PROVENANCE.realtext"), "w") as f:
+        f.write("real:installed-package-docs(classes=" + ",".join(kept)
+                + "; docstrings/comments/rst prose, hash-tokenized, "
+                "package self-references dropped)")
+    return path
+
+
+if __name__ == "__main__":
+    import sys
+
+    root = sys.argv[1] if len(sys.argv) > 1 else os.path.join(REPO,
+                                                              "data_shards")
+    print(make_digits_leaf(root))
+    print(make_realtext_npz(os.path.join(root, "realtext")))

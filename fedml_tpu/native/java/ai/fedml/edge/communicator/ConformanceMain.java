@@ -1,0 +1,99 @@
+package ai.fedml.edge.communicator;
+
+import java.nio.charset.StandardCharsets;
+import java.util.concurrent.LinkedBlockingQueue;
+import java.util.concurrent.TimeUnit;
+
+/**
+ * Wire-level conformance harness: drives {@link EdgeMqttCommunicator}
+ * through a scripted MQTT 3.1.1 session against the Python plane's
+ * {@code mini_broker} and prints a canonical transcript to stdout.
+ *
+ * <p>The transcript is compared line-for-line against the checked-in
+ * expectation (tests/data/java_mqtt_transcript.expected) by
+ * {@code tests/test_java_sdk.py::test_java_wire_conformance}, which
+ * activates automatically once a JDK is present in the image (none is
+ * today — that test documents the blocker).  RECV events arrive on the
+ * dispatch thread, so they are funneled through a queue and printed by
+ * the main thread in protocol order, keeping the transcript
+ * deterministic.</p>
+ *
+ * <p>usage: {@code java ai.fedml.edge.communicator.ConformanceMain
+ * <host> <port>}</p>
+ */
+public final class ConformanceMain {
+    private ConformanceMain() {
+    }
+
+    public static void main(String[] args) throws Exception {
+        final String host = args.length > 0 ? args[0] : "127.0.0.1";
+        final int port = args.length > 1 ? Integer.parseInt(args[1]) : 1883;
+        final LinkedBlockingQueue<String> recvd =
+                new LinkedBlockingQueue<>();
+
+        EdgeMqttCommunicator comm =
+                new EdgeMqttCommunicator(host, port, "java-conformance", 30);
+        comm.setWill("fedml/test/will", "java-died".getBytes(
+                StandardCharsets.UTF_8), 1, false);
+        comm.addConnectionReadyListener(new OnMqttConnectionReadyListener() {
+            @Override
+            public void onReady(boolean sessionPresent) {
+                recvd.offer("CONNECT ok sessionPresent=" + sessionPresent);
+            }
+
+            @Override
+            public void onLost(Throwable cause) {
+                recvd.offer("LOST " + cause.getClass().getSimpleName());
+            }
+        });
+        comm.connect();
+        emit(recvd, 10);
+
+        OnReceivedListener listener = (topic, payload) -> recvd.offer(
+                "RECV " + topic + " "
+                        + new String(payload, StandardCharsets.UTF_8));
+
+        comm.subscribe("fedml/test/echo", 1, listener);
+        System.out.println("SUB fedml/test/echo");
+        comm.publish("fedml/test/echo",
+                "hello-qos1".getBytes(StandardCharsets.UTF_8), 1, false);
+        System.out.println("PUB qos1 fedml/test/echo hello-qos1");
+        emit(recvd, 10);
+
+        // retained delivery: publish BEFORE subscribing, receive on sub
+        comm.publish("fedml/test/retained",
+                "state-7".getBytes(StandardCharsets.UTF_8), 1, true);
+        System.out.println("PUB retained fedml/test/retained state-7");
+        comm.subscribe("fedml/test/retained", 1, listener);
+        System.out.println("SUB fedml/test/retained");
+        emit(recvd, 10);
+
+        // wildcard filter: one-level + must match
+        comm.subscribe("fedml/rounds/+/task", 1, listener);
+        System.out.println("SUB fedml/rounds/+/task");
+        comm.publish("fedml/rounds/3/task",
+                "round:3".getBytes(StandardCharsets.UTF_8), 0, false);
+        System.out.println("PUB qos0 fedml/rounds/3/task round:3");
+        emit(recvd, 10);
+
+        // after unsubscribe the echo topic must go silent
+        comm.unsubscribe("fedml/test/echo");
+        System.out.println("UNSUB fedml/test/echo");
+        comm.publish("fedml/test/echo",
+                "silent".getBytes(StandardCharsets.UTF_8), 1, false);
+        System.out.println("PUB qos1 fedml/test/echo silent");
+        String late = recvd.poll(2, TimeUnit.SECONDS);
+        System.out.println(late == null ? "NORECV fedml/test/echo"
+                : "UNEXPECTED " + late);
+
+        comm.disconnect();
+        System.out.println("DONE");
+    }
+
+    /** Drain exactly one queued async event into the transcript. */
+    private static void emit(LinkedBlockingQueue<String> q, int timeoutS)
+            throws InterruptedException {
+        String ev = q.poll(timeoutS, TimeUnit.SECONDS);
+        System.out.println(ev == null ? "TIMEOUT" : ev);
+    }
+}

@@ -138,6 +138,12 @@ def _timed_chain(run_n_rounds, result_of, min_total_s: float = 2.0,
     return max(total - rtt, 1e-9) / n
 
 
+#: host-context keys every bench mode's JSON carries (one list, three
+#: consumers — --serve, --attn, default)
+_HOST_CTX_KEYS = ("platform", "device_kind", "backend_note",
+                  "host_load_avg_1m", "host_load_avg_5m", "host_cpus")
+
+
 def _platform_info(measure_peak: bool = True):
     from fedml_tpu import device as device_mod
     devices = device_mod.initialize_backend()
@@ -153,12 +159,22 @@ def _platform_info(measure_peak: bool = True):
         # capture so a wedged tunnel doesn't read as "no TPU evidence"
         note += ("; last live TPU capture: TPU_BENCH_LIVE.json / "
                  "BASELINE.md round-3 table")
+    # concurrent-load context (round-4 weak #8: CPU numbers swung 3x
+    # between rounds with no way to attribute noise — record the host
+    # load so cross-round CPU comparisons carry their own caveat)
+    try:
+        load1, load5, _ = os.getloadavg()
+    except OSError:
+        load1 = load5 = None
     return {
         "platform": d.platform,
         "device_kind": getattr(d, "device_kind", "?"),
         "backend_note": note,
         "peak_flops": peak,
         "peak_flops_source": source if peak is not None else None,
+        "host_load_avg_1m": load1,
+        "host_load_avg_5m": load5,
+        "host_cpus": os.cpu_count(),
     }
 
 
@@ -649,8 +665,7 @@ def main():
             "unit": "tok/s_aggregate_4slots",
             "vs_baseline": (round(best_batched / result["plain_tok_s"], 2)
                             if result.get("plain_tok_s") else None),
-            **{k: info[k] for k in ("platform", "device_kind",
-                                    "backend_note")},
+            **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))
         return
@@ -658,8 +673,7 @@ def main():
     if "--attn" in sys.argv:
         info = _platform_info(measure_peak=False)
         result = attn_sweep()
-        result.update({k: info[k] for k in ("platform", "device_kind",
-                                            "backend_note")})
+        result.update({k: info[k] for k in _HOST_CTX_KEYS})
         print(json.dumps(result))
         return
 
@@ -692,6 +706,9 @@ def main():
         "backend_note": info["backend_note"],
         "peak_flops": info["peak_flops"],
         "peak_flops_source": info["peak_flops_source"],
+        "host_load_avg_1m": info["host_load_avg_1m"],
+        "host_load_avg_5m": info["host_load_avg_5m"],
+        "host_cpus": info["host_cpus"],
     }
     print(json.dumps(result))
 

@@ -99,9 +99,14 @@ class MLOpsRuntimeLogDaemon:
         return True
 
     def drain(self):
-        """One synchronous pass over all watched files (tests/shutdown)."""
+        """One synchronous pass over all watched files (tests/shutdown);
+        also flushes a buffering sink (HttpLogSink) so outage-stranded
+        batches re-ship even when no new lines arrived."""
         for key in list(self._files):
             self._drain_one(key)
+        flush = getattr(self.sink, "flush", None)
+        if callable(flush):
+            flush()
 
     def start(self):
         self._stop.clear()
@@ -119,3 +124,153 @@ class MLOpsRuntimeLogDaemon:
             self._thread.join(timeout=2.0)
             self._thread = None
         self.drain()
+
+
+class HttpLogSink:
+    """Batch-upload sink over HTTP (the reference's
+    ``mlops_runtime_log_daemon.py:391`` posts line batches to its MLOps
+    backend's log endpoint).  Point it at any collector — the loopback
+    :class:`LogCollectorServer` for pod-local deployments, or a real
+    backend URL from plain config.
+
+    Failure discipline: an unreachable collector must never lose lines or
+    wedge the daemon — failed batches buffer locally (bounded) and are
+    re-shipped in order ahead of the next batch once the collector
+    returns."""
+
+    def __init__(self, url: str, edge_id: str = "0",
+                 max_buffered_batches: int = 1000,
+                 timeout_s: float = 3.0):
+        self.url = url.rstrip("/")
+        self.edge_id = str(edge_id)
+        self.timeout_s = float(timeout_s)
+        self.max_buffered = int(max_buffered_batches)
+        self._pending: List[tuple] = []
+        self._lock = threading.Lock()
+        self.stats = {"posted": 0, "buffered": 0, "dropped": 0}
+
+    def _post(self, run_id: str, lines: List[str]) -> bool:
+        import json
+        import urllib.request
+        body = json.dumps({"run_id": str(run_id), "edge_id": self.edge_id,
+                           "lines": lines}).encode()
+        req = urllib.request.Request(
+            f"{self.url}/api/v1/logs", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return 200 <= r.status < 300
+        except Exception:
+            return False
+
+    def __call__(self, run_id: str, lines: List[str]) -> None:
+        with self._lock:
+            self._pending.append((run_id, lines))
+            while len(self._pending) > self.max_buffered:
+                self._pending.pop(0)     # oldest lines sacrificed, bounded
+                self.stats["dropped"] += 1
+            self.stats["buffered"] = len(self._pending)
+        self.flush()
+
+    def flush(self) -> bool:
+        """Ship buffered batches oldest-first; returns True when the
+        buffer fully drained.  The daemon calls this on every drain pass
+        and at stop(), so batches buffered during a collector outage ship
+        on recovery even if no further lines are ever logged.  The HTTP
+        post happens OUTSIDE the lock — a blackholed collector costs one
+        bounded timeout per flush, never a lock-holder stall for
+        concurrent producers."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    self.stats["buffered"] = 0
+                    return True
+                head = self._pending[0]
+            if not self._post(head[0], head[1]):
+                with self._lock:
+                    self.stats["buffered"] = len(self._pending)
+                return False
+            with self._lock:
+                # the head may have been trimmed by an overflow during the
+                # unlocked post; only pop if it is still the same entry
+                if self._pending and self._pending[0] is head:
+                    self._pending.pop(0)
+                self.stats["posted"] += 1
+                self.stats["buffered"] = len(self._pending)
+
+
+class LogCollectorServer:
+    """Loopback log collector — the in-repo analog of the reference's
+    MLOps log backend: accepts the :class:`HttpLogSink` batches
+    (``POST /api/v1/logs``) and serves them back per run
+    (``GET /api/v1/logs/<run_id>``) for operators/tests.  stdlib-only, so
+    the whole upload plane runs without a cloud."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, int(port)
+        self._server = None
+        self._runs: dict = {}
+        self._lock = threading.Lock()
+
+    def start(self) -> int:
+        import json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        collector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, payload: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                if self.path != "/api/v1/logs":
+                    self._send(404, b"{}")
+                    return
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                try:
+                    msg = json.loads(body)
+                    run_id = str(msg["run_id"])
+                    lines = list(msg["lines"])
+                except Exception:
+                    self._send(400, b'{"error": "bad batch"}')
+                    return
+                with collector._lock:
+                    collector._runs.setdefault(run_id, []).extend(
+                        (str(msg.get("edge_id", "0")), ln) for ln in lines)
+                self._send(200, b'{"ok": true}')
+
+            def do_GET(self):
+                if not self.path.startswith("/api/v1/logs/"):
+                    self._send(404, b"{}")
+                    return
+                run_id = self.path.rsplit("/", 1)[-1]
+                with collector._lock:
+                    entries = list(collector._runs.get(run_id, []))
+                self._send(200, json.dumps(
+                    {"run_id": run_id,
+                     "lines": [ln for _, ln in entries],
+                     "edges": sorted({e for e, _ in entries})}).encode())
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self.port
+
+    def lines(self, run_id: str) -> List[str]:
+        with self._lock:
+            return [ln for _, ln in self._runs.get(str(run_id), [])]
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -45,4 +45,25 @@ public final class FedMqttTopic {
     public static String lastWill(long runId, int rank) {
         return status(runId, rank);
     }
+
+    // -- agent control plane (reference FedMqttTopic.java:51-59:
+    // flserver_agent/<edgeId>/{start_train,stop_train,
+    // exit_train_with_exception}) -----------------------------------------
+    public static String startTrain(long edgeId) {
+        return "flserver_agent/" + edgeId + "/start_train";
+    }
+
+    public static String stopTrain(long edgeId) {
+        return "flserver_agent/" + edgeId + "/stop_train";
+    }
+
+    public static String exitTrainWithException(long runId) {
+        return "flserver_agent/" + runId + "/client_exit_train_with_exception";
+    }
+
+    /** Run-status transitions the agent reports to the MLOps plane
+     *  (reference MessageDefine run-status topic family). */
+    public static String runStatus(long runId, long edgeId) {
+        return "fl_run/fl_client/mlops/" + runId + "/" + edgeId + "/status";
+    }
 }

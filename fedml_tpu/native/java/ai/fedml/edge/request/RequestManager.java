@@ -22,6 +22,7 @@ import ai.fedml.edge.request.parameter.LogUploadReq;
 import ai.fedml.edge.request.response.BindingResponse;
 import ai.fedml.edge.request.response.ConfigResponse;
 import ai.fedml.edge.request.response.UserInfoResponse;
+import ai.fedml.edge.utils.Json;
 
 /**
  * Async HTTP client for the MLOps control plane: account binding,
@@ -170,114 +171,4 @@ public final class RequestManager {
         }
     }
 
-    /** Flat-JSON helper (string values; enough for the control plane). */
-    static final class Json {
-        private Json() {
-        }
-
-        static String quote(String s) {
-            StringBuilder b = new StringBuilder("\"");
-            for (int i = 0; i < s.length(); i++) {
-                char c = s.charAt(i);
-                if (c == '"' || c == '\\') {
-                    b.append('\\').append(c);
-                } else if (c == '\n') {
-                    b.append("\\n");
-                } else if (c < 0x20) {
-                    b.append(String.format("\\u%04x", (int) c));
-                } else {
-                    b.append(c);
-                }
-            }
-            return b.append('"').toString();
-        }
-
-        static String object(String... kv) {
-            StringBuilder b = new StringBuilder("{");
-            for (int i = 0; i < kv.length; i += 2) {
-                if (i > 0) {
-                    b.append(',');
-                }
-                b.append(quote(kv[i])).append(':').append(quote(kv[i + 1]));
-            }
-            return b.append('}').toString();
-        }
-
-        /** Parse a FLAT json object; nested values are returned raw. */
-        static Map<String, String> parse(String s) throws IOException {
-            java.util.HashMap<String, String> outMap =
-                    new java.util.HashMap<>();
-            int i = s.indexOf('{');
-            if (i < 0) {
-                throw new IOException("not a json object");
-            }
-            i++;
-            while (i < s.length()) {
-                while (i < s.length() && (Character.isWhitespace(s.charAt(i))
-                        || s.charAt(i) == ',')) {
-                    i++;
-                }
-                if (i >= s.length() || s.charAt(i) == '}') {
-                    break;
-                }
-                if (s.charAt(i) != '"') {
-                    throw new IOException("expected key at " + i);
-                }
-                int[] pos = {i};
-                String key = readString(s, pos);
-                i = pos[0];
-                while (i < s.length() && s.charAt(i) != ':') {
-                    i++;
-                }
-                i++;
-                while (i < s.length()
-                        && Character.isWhitespace(s.charAt(i))) {
-                    i++;
-                }
-                if (s.charAt(i) == '"') {
-                    pos[0] = i;
-                    outMap.put(key, readString(s, pos));
-                    i = pos[0];
-                } else {
-                    int j = i;
-                    int depth = 0;
-                    while (j < s.length()) {
-                        char c = s.charAt(j);
-                        if (c == '{' || c == '[') {
-                            depth++;
-                        } else if (c == '}' || c == ']') {
-                            if (depth == 0) {
-                                break;
-                            }
-                            depth--;
-                        } else if (c == ',' && depth == 0) {
-                            break;
-                        }
-                        j++;
-                    }
-                    outMap.put(key, s.substring(i, j).trim());
-                    i = j;
-                }
-            }
-            return outMap;
-        }
-
-        private static String readString(String s, int[] pos) {
-            StringBuilder b = new StringBuilder();
-            int i = pos[0] + 1;                     // skip opening quote
-            while (i < s.length() && s.charAt(i) != '"') {
-                char c = s.charAt(i);
-                if (c == '\\' && i + 1 < s.length()) {
-                    i++;
-                    char e = s.charAt(i);
-                    b.append(e == 'n' ? '\n' : e);
-                } else {
-                    b.append(c);
-                }
-                i++;
-            }
-            pos[0] = i + 1;                         // past closing quote
-            return b.toString();
-        }
-    }
 }

@@ -1,0 +1,131 @@
+package ai.fedml.edge.service;
+
+import java.io.IOException;
+import java.nio.charset.StandardCharsets;
+import java.util.Map;
+
+import ai.fedml.edge.EdgeMessageDefine;
+import ai.fedml.edge.OnTrainProgressListener;
+import ai.fedml.edge.OnTrainingStatusListener;
+import ai.fedml.edge.communicator.EdgeMqttCommunicator;
+import ai.fedml.edge.constants.FedMqttTopic;
+import ai.fedml.edge.service.component.MetricsReporter;
+import ai.fedml.edge.service.entity.TrainProgress;
+import ai.fedml.edge.service.entity.TrainingParams;
+import ai.fedml.edge.utils.Json;
+
+/**
+ * MQTT-driven training lifecycle for one edge device — the role of the
+ * reference's android/fedmlsdk service/ClientAgentManager.java: subscribe
+ * the agent control topics ({@code flserver_agent/<edgeId>/start_train},
+ * {@code .../stop_train}), parse the task JSON, run it on the
+ * {@link TrainingExecutor}, and report status transitions + metrics to
+ * the MLOps topics via {@link MetricsReporter}.
+ *
+ * <p>State machine (EdgeMessageDefine.STATUS_*): IDLE → TRAINING →
+ * UPLOADING → FINISHED back to IDLE; STOPPED on a stop-train message;
+ * ERROR on executor failure (also published as exit-with-exception, like
+ * the reference's client_exit_train_with_exception topic).  Overlapping
+ * start-train messages while a task runs are refused and reported as an
+ * error, never queued silently.</p>
+ */
+public final class ClientAgentManager {
+    private final long edgeId;
+    private final EdgeMqttCommunicator comm;
+    private final TrainingExecutor executor;
+    private final MetricsReporter reporter;
+    private final OnTrainingStatusListener statusListener;
+    private final OnTrainProgressListener progressListener;
+    private volatile long runId;
+    private volatile int status = EdgeMessageDefine.STATUS_IDLE;
+
+    public ClientAgentManager(long edgeId, EdgeMqttCommunicator comm,
+                              TrainingExecutor executor,
+                              OnTrainingStatusListener statusListener,
+                              OnTrainProgressListener progressListener) {
+        this.edgeId = edgeId;
+        this.comm = comm;
+        this.executor = executor;
+        this.reporter = new MetricsReporter(comm);
+        this.statusListener = statusListener;
+        this.progressListener = progressListener;
+    }
+
+    /** Subscribe the agent control topics (call after connect()). */
+    public void start() throws IOException {
+        comm.subscribe(FedMqttTopic.startTrain(edgeId), 1,
+                (topic, payload) -> handleStartTrain(payload));
+        comm.subscribe(FedMqttTopic.stopTrain(edgeId), 1,
+                (topic, payload) -> handleStopTrain());
+    }
+
+    public int status() {
+        return status;
+    }
+
+    public long runId() {
+        return runId;
+    }
+
+    private void setStatus(int next) {
+        status = next;
+        if (statusListener != null) {
+            statusListener.onStatusChanged(next);
+        }
+        reporter.reportClientStatus(runId, edgeId, next);
+    }
+
+    private void handleStartTrain(byte[] payload) {
+        TrainingParams params;
+        try {
+            Map<String, String> msg = Json.parse(
+                    new String(payload, StandardCharsets.UTF_8));
+            params = new TrainingParams(
+                    Long.parseLong(msg.getOrDefault("run_id", "0")),
+                    edgeId,
+                    msg.getOrDefault("model_bundle", ""),
+                    msg.getOrDefault("data_bundle", ""),
+                    Integer.parseInt(msg.getOrDefault("epochs", "1")),
+                    Integer.parseInt(msg.getOrDefault("batch_size", "32")),
+                    Float.parseFloat(msg.getOrDefault("lr", "0.05")),
+                    Long.parseLong(msg.getOrDefault("seed", "0")));
+        } catch (IOException | NumberFormatException e) {
+            reporter.reportTrainingError(runId, edgeId,
+                    "malformed start_train: " + e);
+            return;
+        }
+        runId = params.runId;
+        String outPath = params.modelBundle + ".trained";
+        boolean started = executor.execute(params, outPath,
+                progressListener, new TrainingExecutor.OnTrainCompleted() {
+                    @Override
+                    public void onCompleted(TrainingParams p,
+                                            TrainProgress fin,
+                                            String savedModelPath) {
+                        setStatus(EdgeMessageDefine.STATUS_UPLOADING);
+                        reporter.reportTrainingMetric(p.runId, edgeId,
+                                fin.epoch, fin.loss, fin.numSamples);
+                        setStatus(EdgeMessageDefine.STATUS_FINISHED);
+                        setStatus(EdgeMessageDefine.STATUS_IDLE);
+                    }
+
+                    @Override
+                    public void onError(TrainingParams p, Throwable err) {
+                        reporter.reportTrainingError(p.runId, edgeId,
+                                String.valueOf(err));
+                        setStatus(EdgeMessageDefine.STATUS_ERROR);
+                    }
+                });
+        if (started) {
+            setStatus(EdgeMessageDefine.STATUS_TRAINING);
+        } else {
+            reporter.reportTrainingError(params.runId, edgeId,
+                    "start_train refused: a task is already running");
+        }
+    }
+
+    private void handleStopTrain() {
+        executor.stopTrain();
+        setStatus(EdgeMessageDefine.STATUS_STOPPED);
+    }
+}

@@ -1,0 +1,144 @@
+package ai.fedml.edge.service;
+
+import java.util.concurrent.atomic.AtomicBoolean;
+
+import ai.fedml.edge.OnTrainProgressListener;
+import ai.fedml.edge.service.entity.TrainProgress;
+import ai.fedml.edge.service.entity.TrainingParams;
+
+/**
+ * Runs one training task on a background thread with periodic progress
+ * polling — the role of the reference's
+ * android/fedmlsdk service/TrainingExecutor.java (which drives the MNN
+ * trainer through NativeFedMLClientManager and relays epoch/loss
+ * callbacks).  The trainer is injected behind {@link Trainer} so the
+ * JNI-backed {@code NativeEdgeTrainer} and pure-Java fakes (tests,
+ * simulators) run through the identical lifecycle.
+ */
+public final class TrainingExecutor {
+
+    /** Minimal trainer surface (NativeEdgeTrainer conforms). */
+    public interface Trainer extends AutoCloseable {
+        void train(int epochs, long seed);
+
+        int epoch();
+
+        float loss();
+
+        long numSamples();
+
+        void saveModel(String path);
+
+        void stopTraining();
+
+        @Override
+        void close();
+    }
+
+    /** Builds a trainer for the task (indirection for tests/JNI). */
+    public interface TrainerFactory {
+        Trainer create(TrainingParams params);
+    }
+
+    /** Outcome callback (completion or failure; at most one fires). */
+    public interface OnTrainCompleted {
+        void onCompleted(TrainingParams params, TrainProgress finalState,
+                         String savedModelPath);
+
+        void onError(TrainingParams params, Throwable error);
+    }
+
+    private final TrainerFactory factory;
+    private final long pollMs;
+    private volatile Thread worker;
+    private volatile Trainer active;
+    private final AtomicBoolean running = new AtomicBoolean(false);
+
+    public TrainingExecutor(TrainerFactory factory) {
+        this(factory, 500);
+    }
+
+    public TrainingExecutor(TrainerFactory factory, long pollMs) {
+        this.factory = factory;
+        this.pollMs = pollMs;
+    }
+
+    public boolean isRunning() {
+        return running.get();
+    }
+
+    /**
+     * Start the task; returns false if one is already running (the agent
+     * must refuse overlapping start-train messages, like the reference's
+     * executor refuses a second bind).
+     */
+    public synchronized boolean execute(TrainingParams params,
+                                        String saveModelPath,
+                                        OnTrainProgressListener progress,
+                                        OnTrainCompleted done) {
+        if (!running.compareAndSet(false, true)) {
+            return false;
+        }
+        worker = new Thread(() -> {
+            Trainer t = null;
+            try {
+                t = factory.create(params);
+                active = t;
+                final Trainer poll = t;
+                Thread poller = new Thread(() -> {
+                    int lastEpoch = -1;
+                    while (running.get()) {
+                        int e = poll.epoch();
+                        if (e != lastEpoch && progress != null) {
+                            progress.onEpochLoss((int) params.runId, e,
+                                    poll.loss());
+                            progress.onProgressChanged(
+                                    (int) params.runId,
+                                    100f * e / Math.max(params.epochs, 1));
+                            lastEpoch = e;
+                        }
+                        try {
+                            Thread.sleep(pollMs);
+                        } catch (InterruptedException ie) {
+                            return;
+                        }
+                    }
+                }, "fedml-train-poll");
+                poller.setDaemon(true);
+                poller.start();
+                t.train(params.epochs, params.seed);
+                poller.interrupt();
+                TrainProgress fin = new TrainProgress(
+                        t.epoch(), t.loss(), t.numSamples());
+                t.saveModel(saveModelPath);
+                done.onCompleted(params, fin, saveModelPath);
+            } catch (Throwable e) {   // surface, never die silently
+                done.onError(params, e);
+            } finally {
+                if (t != null) {
+                    t.close();
+                }
+                active = null;
+                running.set(false);
+            }
+        }, "fedml-train-exec");
+        worker.setDaemon(true);
+        worker.start();
+        return true;
+    }
+
+    /** Ask the in-flight task to stop (no-op when idle). */
+    public void stopTrain() {
+        Trainer t = active;
+        if (t != null) {
+            t.stopTraining();
+        }
+    }
+
+    public void join(long timeoutMs) throws InterruptedException {
+        Thread w = worker;
+        if (w != null) {
+            w.join(timeoutMs);
+        }
+    }
+}

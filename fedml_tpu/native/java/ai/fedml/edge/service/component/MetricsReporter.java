@@ -1,0 +1,63 @@
+package ai.fedml.edge.service.component;
+
+import java.io.IOException;
+import java.nio.charset.StandardCharsets;
+
+import ai.fedml.edge.communicator.EdgeMqttCommunicator;
+import ai.fedml.edge.constants.FedMqttTopic;
+import ai.fedml.edge.utils.Json;
+
+/**
+ * Publishes run-status transitions and training metrics to the MLOps
+ * topics — the role of the reference's
+ * android/fedmlsdk service/component/MetricsReporter.java (singleton that
+ * reports client status / training progress over the shared MQTT
+ * connection).  Publish failures are swallowed after marking the
+ * connection suspect: telemetry must never crash training.
+ */
+public final class MetricsReporter {
+    private final EdgeMqttCommunicator comm;
+    private volatile long lastPublishFailureMs = -1;
+
+    public MetricsReporter(EdgeMqttCommunicator comm) {
+        this.comm = comm;
+    }
+
+    public void reportClientStatus(long runId, long edgeId, int status) {
+        publish(FedMqttTopic.runStatus(runId, edgeId), Json.object(
+                "run_id", Long.toString(runId),
+                "edge_id", Long.toString(edgeId),
+                "status", Integer.toString(status)));
+    }
+
+    public void reportTrainingMetric(long runId, long edgeId, int epoch,
+                                     float loss, long numSamples) {
+        publish(FedMqttTopic.telemetry(runId, edgeId), Json.object(
+                "run_id", Long.toString(runId),
+                "edge_id", Long.toString(edgeId),
+                "epoch", Integer.toString(epoch),
+                "loss", Float.toString(loss),
+                "num_samples", Long.toString(numSamples)));
+    }
+
+    public void reportTrainingError(long runId, long edgeId, String error) {
+        publish(FedMqttTopic.exitTrainWithException(runId), Json.object(
+                "run_id", Long.toString(runId),
+                "edge_id", Long.toString(edgeId),
+                "error", error));
+    }
+
+    /** Monotonic-ms of the last failed publish, or -1 (observability). */
+    public long lastPublishFailureMs() {
+        return lastPublishFailureMs;
+    }
+
+    private void publish(String topic, String json) {
+        try {
+            comm.publish(topic, json.getBytes(StandardCharsets.UTF_8), 1,
+                    false);
+        } catch (IOException e) {
+            lastPublishFailureMs = System.nanoTime() / 1_000_000L;
+        }
+    }
+}

@@ -1,0 +1,17 @@
+package ai.fedml.edge.service.entity;
+
+/**
+ * Progress snapshot surfaced to listeners and the metrics topic
+ * (reference android/fedmlsdk service/entity/TrainProgress.java).
+ */
+public final class TrainProgress {
+    public final int epoch;
+    public final float loss;
+    public final long numSamples;
+
+    public TrainProgress(int epoch, float loss, long numSamples) {
+        this.epoch = epoch;
+        this.loss = loss;
+        this.numSamples = numSamples;
+    }
+}

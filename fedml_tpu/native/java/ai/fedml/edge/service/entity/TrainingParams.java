@@ -1,0 +1,30 @@
+package ai.fedml.edge.service.entity;
+
+/**
+ * One training task's parameters as announced on the start-train topic
+ * (reference android/fedmlsdk service/entity/TrainingParams.java carries
+ * runId/edgeId/dataset/batch/lr/epochs between the agent and executor).
+ */
+public final class TrainingParams {
+    public final long runId;
+    public final long edgeId;
+    public final String modelBundle;
+    public final String dataBundle;
+    public final int epochs;
+    public final int batchSize;
+    public final float learningRate;
+    public final long seed;
+
+    public TrainingParams(long runId, long edgeId, String modelBundle,
+                          String dataBundle, int epochs, int batchSize,
+                          float learningRate, long seed) {
+        this.runId = runId;
+        this.edgeId = edgeId;
+        this.modelBundle = modelBundle;
+        this.dataBundle = dataBundle;
+        this.epochs = epochs;
+        this.batchSize = batchSize;
+        this.learningRate = learningRate;
+        this.seed = seed;
+    }
+}

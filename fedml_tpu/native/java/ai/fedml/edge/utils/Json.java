@@ -1,0 +1,123 @@
+package ai.fedml.edge.utils;
+
+import java.io.IOException;
+import java.util.HashMap;
+import java.util.Map;
+
+/**
+ * Flat-JSON helper (string values; enough for the edge control plane) —
+ * the no-dependency stand-in for the reference SDK's Gson/JSONObject use
+ * (android/fedmlsdk utils/GsonUtils.java).  Shared by the request plane
+ * ({@code request.RequestManager}) and the MQTT agent plane
+ * ({@code service.ClientAgentManager}); nested values parse to their raw
+ * source text so callers can re-parse sub-objects.
+ */
+public final class Json {
+    private Json() {
+    }
+
+    public static String quote(String s) {
+        StringBuilder b = new StringBuilder("\"");
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            if (c == '"' || c == '\\') {
+                b.append('\\').append(c);
+            } else if (c == '\n') {
+                b.append("\\n");
+            } else if (c < 0x20) {
+                b.append(String.format("\\u%04x", (int) c));
+            } else {
+                b.append(c);
+            }
+        }
+        return b.append('"').toString();
+    }
+
+    /** Build a flat object from alternating key/value pairs. */
+    public static String object(String... kv) {
+        StringBuilder b = new StringBuilder("{");
+        for (int i = 0; i < kv.length; i += 2) {
+            if (i > 0) {
+                b.append(',');
+            }
+            b.append(quote(kv[i])).append(':').append(quote(kv[i + 1]));
+        }
+        return b.append('}').toString();
+    }
+
+    /** Parse a FLAT json object; nested values are returned raw. */
+    public static Map<String, String> parse(String s) throws IOException {
+        HashMap<String, String> outMap = new HashMap<>();
+        int i = s.indexOf('{');
+        if (i < 0) {
+            throw new IOException("not a json object");
+        }
+        i++;
+        while (i < s.length()) {
+            while (i < s.length() && (Character.isWhitespace(s.charAt(i))
+                    || s.charAt(i) == ',')) {
+                i++;
+            }
+            if (i >= s.length() || s.charAt(i) == '}') {
+                break;
+            }
+            if (s.charAt(i) != '"') {
+                throw new IOException("expected key at " + i);
+            }
+            int[] pos = {i};
+            String key = readString(s, pos);
+            i = pos[0];
+            while (i < s.length() && s.charAt(i) != ':') {
+                i++;
+            }
+            i++;
+            while (i < s.length()
+                    && Character.isWhitespace(s.charAt(i))) {
+                i++;
+            }
+            if (s.charAt(i) == '"') {
+                pos[0] = i;
+                outMap.put(key, readString(s, pos));
+                i = pos[0];
+            } else {
+                int j = i;
+                int depth = 0;
+                while (j < s.length()) {
+                    char c = s.charAt(j);
+                    if (c == '{' || c == '[') {
+                        depth++;
+                    } else if (c == '}' || c == ']') {
+                        if (depth == 0) {
+                            break;
+                        }
+                        depth--;
+                    } else if (c == ',' && depth == 0) {
+                        break;
+                    }
+                    j++;
+                }
+                outMap.put(key, s.substring(i, j).trim());
+                i = j;
+            }
+        }
+        return outMap;
+    }
+
+    private static String readString(String s, int[] pos) {
+        StringBuilder b = new StringBuilder();
+        int i = pos[0] + 1;                     // skip opening quote
+        while (i < s.length() && s.charAt(i) != '"') {
+            char c = s.charAt(i);
+            if (c == '\\' && i + 1 < s.length()) {
+                i++;
+                char e = s.charAt(i);
+                b.append(e == 'n' ? '\n' : e);
+            } else {
+                b.append(c);
+            }
+            i++;
+        }
+        pos[0] = i + 1;                         // past closing quote
+        return b.toString();
+    }
+}

@@ -1,0 +1,96 @@
+package ai.fedml.edge.utils.preference;
+
+import java.io.File;
+import java.io.FileInputStream;
+import java.io.FileOutputStream;
+import java.io.IOException;
+import java.util.Properties;
+
+/**
+ * Persistent key-value store for edge identity/config — the role of the
+ * reference SDK's SharedPreferences stack
+ * (android/fedmlsdk utils/preference/SharePreferencesData.java +
+ * SharedPreferenceProxy/Provider, which guard a multi-process Android
+ * SharedPreferences).  Without Android the durable store is a properties
+ * file; writes are atomic (temp + rename) so a crash mid-save never
+ * leaves a torn binding, and the same keys the reference persists are
+ * exposed as typed accessors (account id, bound edge id, hashed private
+ * paths).
+ */
+public final class SharePreferencesData {
+    public static final String KEY_ACCOUNT_ID = "account_id";
+    public static final String KEY_BINDING_ID = "binding_id";
+    public static final String KEY_DEVICE_ID = "device_id";
+    public static final String KEY_PRIVATE_PATH = "private_path";
+
+    private final File file;
+    private final Properties props = new Properties();
+
+    public SharePreferencesData(String path) {
+        this.file = new File(path);
+        if (file.exists()) {
+            try (FileInputStream in = new FileInputStream(file)) {
+                props.load(in);
+            } catch (IOException ignored) {
+                // unreadable store: start empty, the next save rewrites it
+            }
+        }
+    }
+
+    public synchronized String get(String key, String dflt) {
+        return props.getProperty(key, dflt);
+    }
+
+    public synchronized void put(String key, String value) {
+        props.setProperty(key, value);
+        save();
+    }
+
+    public synchronized void remove(String key) {
+        props.remove(key);
+        save();
+    }
+
+    private void save() {
+        File tmp = new File(file.getPath() + ".tmp");
+        try (FileOutputStream out = new FileOutputStream(tmp)) {
+            props.store(out, "fedml edge preferences");
+        } catch (IOException e) {
+            throw new IllegalStateException("preference persist failed", e);
+        }
+        if (!tmp.renameTo(file)) {
+            // cross-filesystem or locked target: fall back to direct write
+            try (FileOutputStream out = new FileOutputStream(file)) {
+                props.store(out, "fedml edge preferences");
+            } catch (IOException e) {
+                throw new IllegalStateException("preference persist failed",
+                        e);
+            }
+        }
+    }
+
+    // -- typed accessors matching the reference's surface ------------------
+    public String getAccountId() {
+        return get(KEY_ACCOUNT_ID, "");
+    }
+
+    public void saveAccountId(String accountId) {
+        put(KEY_ACCOUNT_ID, accountId);
+    }
+
+    public String getBindingId() {
+        return get(KEY_BINDING_ID, "");
+    }
+
+    public void saveBindingId(String bindingId) {
+        put(KEY_BINDING_ID, bindingId);
+    }
+
+    public String getPrivatePath() {
+        return get(KEY_PRIVATE_PATH, "");
+    }
+
+    public void savePrivatePath(String path) {
+        put(KEY_PRIVATE_PATH, path);
+    }
+}

@@ -34,7 +34,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .templates.openai_compat import _build_cached_decode, _sample_live
+from .templates.openai_compat import (TAIL_BLOCK, PrefixCache,
+                                      _build_cached_decode,
+                                      _replay_tail, _sample_live)
 
 
 def _unwrap_params(params):
@@ -63,7 +65,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
                  top_k: int = 0, top_p: float = 1.0, horizon: int = 1,
-                 prefix_cache_slots: int = 0, prefix_max_tail: int = 4):
+                 prefix_cache_slots: int = 0,
+                 prefix_max_tail: int = TAIL_BLOCK):
         self.model = model
         self.raw_params = _unwrap_params(params)
         self.n_slots = int(slots)
@@ -81,8 +84,8 @@ class ContinuousBatchingEngine:
         # next admission).
         self.horizon = max(1, int(horizon))
 
-        self._prefill, self._tail_step = _build_cached_decode(
-            model, self.top_k, self.top_p)
+        self._prefill, self._tail_step, self._tail_block = \
+            _build_cached_decode(model, self.top_k, self.top_p)
         # prefix_cache_slots > 0: admission reuses prefill KV for shared
         # prompt prefixes (templates/openai_compat.PrefixCache — LRU,
         # longest-common-prefix, params-identity invalidation); only the
@@ -90,9 +93,8 @@ class ContinuousBatchingEngine:
         # its own lock anyway
         self.prefix_cache = None
         if prefix_cache_slots:
-            from .templates.openai_compat import PrefixCache
             self.prefix_cache = PrefixCache(prefix_cache_slots,
-                                            max_tail=prefix_max_tail)
+                                            max_tail=int(prefix_max_tail))
 
         from ..llm.quantization import dequantize_params, weight_dtype
         wdtype = weight_dtype(model)
@@ -274,18 +276,17 @@ class ContinuousBatchingEngine:
                               if self.prefix_cache is not None and n > 0
                               else (0, None))
         if hit_cache is not None:
-            # same replay discipline as templates/openai_compat.generate:
-            # exact hits rewrite only the last position (idempotent),
-            # prefix hits continue through the unseen tail; stale tail
-            # positions past the divergence point are masked until
-            # overwritten
+            # shared replay discipline (openai_compat._replay_tail): exact
+            # hits rewrite only the last position (idempotent); fitting
+            # multi-token tails replay as ONE tail_block dispatch
             cache = hit_cache
-            tok = None
-            for j in range(min(hit_len, n - 1), n):
-                key, sub = jax.random.split(key)
-                tok, cache = self._tail_step(self.raw_params, None,
-                                             cache, jnp.int32(ids[j]),
-                                             jnp.int32(j), sub, temp)
+            start = min(hit_len, n - 1)
+            max_seq = getattr(getattr(self.model, "cfg", None),
+                              "max_seq_len", self.buf_len)
+            tok, cache, key = _replay_tail(
+                partial(self._tail_step, self.raw_params, None),
+                partial(self._tail_block, self.raw_params, None),
+                cache, jnp.asarray(buf), ids, start, n, max_seq, key, temp)
         else:
             key, sub = jax.random.split(key)
             tok, cache = self._prefill(self.raw_params, None,
@@ -406,7 +407,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, model, params, draft_model, draft_params,
                  slots: int = 4, buf_len: int = 256, k: int = 4,
-                 prefix_cache_slots: int = 0, prefix_max_tail: int = 4):
+                 prefix_cache_slots: int = 0,
+                 prefix_max_tail: int = TAIL_BLOCK):
         self.k = int(k)
         assert self.k >= 1
         for m, name in ((model, "model"), (draft_model, "draft_model")):
@@ -436,7 +438,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         d_wdtype = weight_dtype(draft_model)
         k_ = self.k
 
-        self._d_prefill, _ = _build_cached_decode(draft_model, 0, 1.0)
+        self._d_prefill, _, _ = _build_cached_decode(draft_model, 0, 1.0)
         dummy = jnp.zeros((1, self.buf_len), jnp.int32)
         _, dcache0 = self._d_prefill(self.raw_draft, None, dummy,
                                      jnp.int32(1),

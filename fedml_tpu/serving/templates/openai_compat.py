@@ -146,7 +146,60 @@ def _build_cached_decode(model, top_k: int, top_p: float):
         return _sample_live(logits[0, 0], key, temp, top_k,
                             top_p), mut["cache"]
 
-    return prefill, step
+    @jax.jit
+    def tail_block(params, lora, cache, padded_buf, start, n, key, temp):
+        """Replay prompt positions start..n-1 in ONE dispatch (prefix-cache
+        partial hits: a per-token tail replay costs one host round-trip
+        per token, which inverts the caching win on dispatch-bound
+        targets — round-4 advisor).  ``padded_buf`` is the prompt buffer
+        right-padded with TAIL_BLOCK zeros so the dynamic slice never
+        clamps; the block writes K/V for a fixed TAIL_BLOCK window whose
+        stale positions >= n progressively self-heal (each later decode
+        step overwrites position p's K/V before any query attends it —
+        the same mask-discipline argument the speculative verify blocks
+        rely on).  Logits are read at the last REAL position (n-1)."""
+        block = jax.lax.dynamic_slice(padded_buf, (0, start),
+                                      (1, TAIL_BLOCK))
+        logits, mut = model.apply(
+            {**_vars(params, lora), "cache": cache}, block,
+            decode=True, start_pos=start, mutable=["cache"])
+        live = jax.lax.dynamic_index_in_dim(logits[0], n - 1 - start,
+                                            axis=0, keepdims=False)
+        return _sample_live(live, key, temp, top_k, top_p), mut["cache"]
+
+    return prefill, step, tail_block
+
+
+#: fixed width of the one-dispatch tail-replay block (compiled once; a
+#: partial prefix hit with an uncached tail up to this long replays as a
+#: single device program instead of per-token steps)
+TAIL_BLOCK = 32
+
+
+def _replay_tail(step_fn, tail_fn, cache, buf_j, ids, start, n, max_seq,
+                 key, temp):
+    """Replay prompt positions ``start..n-1`` onto a cached KV state —
+    the ONE shared implementation of the prefix-hit replay discipline
+    (generate() and the batching engine's admission both use it, so the
+    correctness guards cannot diverge).  Multi-token tails that fit the
+    fixed block AND the context window replay as one tail_block dispatch;
+    everything else (exact hits, tails longer than TAIL_BLOCK under a
+    custom admission bound, the window's very end) takes the bounded
+    per-token path.  Returns ``(tok, cache, key)``."""
+    tail = n - start
+    if 1 < tail <= TAIL_BLOCK and start + TAIL_BLOCK <= max_seq:
+        padded = jnp.concatenate(
+            [buf_j, jnp.zeros((1, TAIL_BLOCK), jnp.int32)], axis=1)
+        key, sub = jax.random.split(key)
+        tok, cache = tail_fn(cache, padded, jnp.int32(start),
+                             jnp.int32(n), sub, temp)
+        return tok, cache, key
+    tok = None
+    for j in range(start, n):
+        key, sub = jax.random.split(key)
+        tok, cache = step_fn(cache, jnp.int32(ids[j]), jnp.int32(j), sub,
+                             temp)
+    return tok, cache, key
 
 
 class RequestError(ValueError):
@@ -182,15 +235,17 @@ class PrefixCache:
     and threads is safe; the dict itself is guarded by a lock.
     """
 
-    def __init__(self, capacity: int = 8, max_tail: int = 4):
+    def __init__(self, capacity: int = 8, max_tail: int = TAIL_BLOCK):
         self.capacity = int(capacity)
         #: partial-hit admission bound, in TOKENS of uncached tail.  The
-        #: tail replays as one jitted dispatch PER token while the miss
-        #: path is ONE prefill dispatch, so on dispatch-bound targets
-        #: (~70 ms/launch over a tunnel-attached TPU — SERVE_RTT_SIM) the
-        #: break-even is a few tokens regardless of prompt length; a
-        #: proportional bound (n/4) would invert the win exactly where
-        #: serving latency matters most (round-4 advisor finding).
+        #: serving cost model is DISPATCHES, not FLOPs (~70 ms/launch over
+        #: a tunnel-attached TPU — SERVE_RTT_SIM): tails up to TAIL_BLOCK
+        #: replay as ONE tail_block dispatch — dispatch-parity with the
+        #: miss path's single prefill while skipping the cached prefix's
+        #: FLOPs — so the default bound is TAIL_BLOCK.  Longer tails would
+        #: fall back to one dispatch PER token, inverting the win exactly
+        #: where latency matters most (round-4 advisor finding), so they
+        #: miss instead.
         self.max_tail = int(max_tail)
         self._entries = collections.OrderedDict()   # tuple(ids) -> cache
         self._lock = threading.Lock()
@@ -313,10 +368,11 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
     if model is not None:
         raw_params = params.get("params", params) if isinstance(params, dict) \
             else params
-        prefill_p, step_p = _build_cached_decode(model, int(top_k),
-                                                 float(top_p))
+        prefill_p, step_p, tail_p = _build_cached_decode(model, int(top_k),
+                                                         float(top_p))
         prefill = functools.partial(prefill_p, raw_params, lora)
         step = functools.partial(step_p, raw_params, lora)
+        tail_blk = functools.partial(tail_p, raw_params, lora)
         # prefix KV is adapter-specific: the cache keys validity on
         # (params, lora) identity, so uniform-adapter traffic (e.g. the
         # server's shared zero adapter) caches normally while a CHANGE of
@@ -331,13 +387,18 @@ def generate(apply_fn: Callable, params, prompt_ids: List[int],
             # exact hit replays only the LAST prompt token — position
             # n-1's K/V rewrite is idempotent (same deterministic apply),
             # and its logits equal the prefill's, so greedy output is
-            # bit-identical to the uncached path
+            # bit-identical to the uncached path.  Multi-token tails
+            # replay as ONE tail_block dispatch (vs one dispatch per
+            # token) whenever the fixed block fits inside the context
+            # window; at the window's very end the bounded per-token
+            # fallback runs instead.
             cache = hit_cache
-            tok = None
-            for j in range(min(hit_len, n - 1), n):
-                key, sub = jax.random.split(key)
-                tok, cache = step(cache, jnp.int32(prompt_ids[j]),
-                                  jnp.int32(j), sub, temp)
+            start = min(hit_len, n - 1)
+            max_seq = getattr(getattr(model, "cfg", None), "max_seq_len",
+                              buf_len)
+            tok, cache, key = _replay_tail(step, tail_blk, cache, buf_j,
+                                           prompt_ids, start, n, max_seq,
+                                           key, temp)
         else:
             key, sub = jax.random.split(key)
             tok, cache = prefill(buf_j, n, sub, temp)
@@ -391,7 +452,8 @@ class OpenAICompatServer:
                  port: int = 0, buf_len: int = 256, model=None,
                  batch_slots: int = 0, draft_model=None, draft_params=None,
                  decode_horizon: int = 1, spec_k: int = 4,
-                 prefix_cache_slots: int = 0, prefix_max_tail: int = 4,
+                 prefix_cache_slots: int = 0,
+                 prefix_max_tail: int = TAIL_BLOCK,
                  adapters=None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
@@ -436,7 +498,7 @@ class OpenAICompatServer:
                              "(prefix caching is KV-cache-based)")
         if prefix_cache_slots and not batch_slots:
             self.prefix_cache = PrefixCache(prefix_cache_slots,
-                                            max_tail=prefix_max_tail)
+                                            max_tail=int(prefix_max_tail))
         # adapters: {name: LoRA tree} over ONE shared base — per-request
         # personalization for federated clients (request field
         # {"adapter": name}; no field = the zero adapter = base behavior).

@@ -516,7 +516,7 @@ def test_tp_sharded_decode_matches_unsharded():
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params, param_sharding_rules(params, mesh))
     cache_spec = NamedSharding(mesh, P(None, MODEL_AXIS, None, None))
-    prefill, step = _build_cached_decode(lm, 0, 1.0)
+    prefill, step, _ = _build_cached_decode(lm, 0, 1.0)
 
     def decode(p, shard_cache):
         key = jax.random.PRNGKey(0)
@@ -763,28 +763,31 @@ def test_prefix_cache_greedy_parity_and_reuse():
     m, c = small.lookup([1, 2, 3])
     assert c is None, "evicted entry still served"
 
-    # dispatch-aware admission (round-4 advisor): a long uncached tail
-    # must MISS regardless of prompt length — each tail token replays as
-    # one dispatch, so a 10-token tail costs ~10 RTTs where the miss
-    # path costs 1; the old proportional bound (n/4) would have hit here
+    # dispatch-aware admission (round-4 advisor): tails up to TAIL_BLOCK
+    # replay as ONE tail_block dispatch (dispatch parity with the miss
+    # path's single prefill, fewer FLOPs), so they hit; a tail BEYOND the
+    # block would fall back to one dispatch per token — those miss
+    from fedml_tpu.serving.templates.openai_compat import TAIL_BLOCK
     gate = PrefixCache(capacity=4)
-    long_prompt = list(range(1, 51))
+    long_prompt = list(range(1, 81))
     gate.insert(long_prompt, object(), params)
-    hit_len, cache = gate.lookup(long_prompt[:40] + [91] * 10, params)
+    hit_len, cache = gate.lookup(
+        long_prompt[:40] + [91] * (TAIL_BLOCK + 8), params)
     assert cache is None and gate.stats["misses"] == 1
-    # tail at the bound still hits; skipped counts positions genuinely
-    # not re-forwarded (exact hit replays the last position: n-1)
-    hit_len, cache = gate.lookup(long_prompt[:46] + [91] * 4, params)
+    # a block-sized tail hits; skipped counts positions genuinely not
+    # re-forwarded (exact hit replays the last position: n-1)
+    hit_len, cache = gate.lookup(long_prompt[:46] + [91] * 10, params)
     assert cache is not None and hit_len == 46
     assert gate.stats["prefill_tokens_skipped"] == 46
     hit_len, cache = gate.lookup(long_prompt, params)
     assert gate.stats["exact_hits"] == 1
-    assert gate.stats["prefill_tokens_skipped"] == 46 + 49
-    # the bound is configurable for dispatch-cheap (local-chip) targets
-    roomy = PrefixCache(capacity=4, max_tail=16)
-    roomy.insert(long_prompt, object(), params)
-    _, cache = roomy.lookup(long_prompt[:40] + [91] * 10, params)
-    assert cache is not None
+    assert gate.stats["prefill_tokens_skipped"] == 46 + 79
+    # the bound stays configurable (e.g. a strict-latency deployment that
+    # wants exact/near-exact hits only)
+    strict = PrefixCache(capacity=4, max_tail=2)
+    strict.insert(long_prompt, object(), params)
+    _, cache = strict.lookup(long_prompt[:40] + [91] * 10, params)
+    assert cache is None
 
 
 def test_prefix_cache_over_http_server():
@@ -856,6 +859,46 @@ def test_prefix_cache_divergent_tail_self_heals():
     assert out == ref, "stale tail leaked into attention"
     assert pc.stats["hits"] == 1
     assert pc.stats["prefill_tokens_skipped"] == 3
+
+    # LONG uncached tail (> a handful, < TAIL_BLOCK): replays via the
+    # one-dispatch tail_block — greedy output must stay bit-equal to the
+    # uncached run, including the block's fixed-window K/V writes past
+    # the prompt end (self-healed by later decode steps); and at the very
+    # END of the context window the bounded per-token fallback engages
+    # (start + TAIL_BLOCK > max_seq_len) with identical output
+    long_new = [5, 9, 12] + [70 + i for i in range(20)]     # tail of 20
+    ref_long = generate(apply_fn, params, long_new, max_new_tokens=10,
+                        buf_len=64, model=model)
+    out_long = generate(apply_fn, params, long_new, max_new_tokens=10,
+                        buf_len=64, model=model, prefix_cache=pc)
+    assert out_long == ref_long, "tail_block replay diverged"
+    end_prompt = cached_prompt + [80 + i for i in range(76)]  # n=84 of 96
+    pc2 = PrefixCache(capacity=2, max_tail=96)
+    generate(apply_fn, params, cached_prompt + [80 + i for i in range(70)],
+             max_new_tokens=1, buf_len=90, model=model, prefix_cache=pc2)
+    ref_end = generate(apply_fn, params, end_prompt, max_new_tokens=4,
+                       buf_len=90, model=model)
+    out_end = generate(apply_fn, params, end_prompt, max_new_tokens=4,
+                       buf_len=90, model=model, prefix_cache=pc2)
+    assert out_end == ref_end, "per-token fallback at window end diverged"
+
+    # regression (round-5 review): a tail LONGER than TAIL_BLOCK under a
+    # custom admission bound must NOT take the block path — the block
+    # would replay only the first TAIL_BLOCK positions, clamp the logit
+    # read, and insert a half-written cache keyed by the full prompt
+    pc3 = PrefixCache(capacity=2, max_tail=96)
+    generate(apply_fn, params, [5, 9, 12, 40], max_new_tokens=1, buf_len=64,
+             model=model, prefix_cache=pc3)
+    over = [5, 9, 12] + [50 + (i % 40) for i in range(40)]   # tail of 40
+    ref_over = generate(apply_fn, params, over, max_new_tokens=6,
+                        buf_len=64, model=model)
+    out_over = generate(apply_fn, params, over, max_new_tokens=6,
+                        buf_len=64, model=model, prefix_cache=pc3)
+    assert out_over == ref_over, "over-length tail corrupted the replay"
+    # and the cache inserted by that hit must serve a CLEAN exact hit
+    out_exact = generate(apply_fn, params, over, max_new_tokens=6,
+                         buf_len=64, model=model, prefix_cache=pc3)
+    assert out_exact == ref_over, "poisoned cache served on exact hit"
 
 
 def test_prefix_cache_invalidated_on_weight_swap():

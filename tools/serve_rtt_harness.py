@@ -98,7 +98,7 @@ def main():
               "requests": n_req, "slots": slots, "levers": {}}
 
     # -- seq_kv: single-request cached decode, 1 dispatch/token -----------
-    prefill, step = oc._build_cached_decode(model, 0, 1.0)
+    prefill, step, tail_blk = oc._build_cached_decode(model, 0, 1.0)
     # warm compiles OUTSIDE the injected-latency window
     ref = oc.generate(lambda p, t: model.apply({"params": p}, t), params,
                       prompt, max_new_tokens=args.tokens, buf_len=buf_len,
@@ -106,7 +106,8 @@ def main():
     ctr = {"dispatches": 0}
     orig_build = oc._build_cached_decode
     oc._build_cached_decode = lambda m, tk, tp: (
-        _sleepy(prefill, rtt_s, ctr), _sleepy(step, rtt_s, ctr))
+        _sleepy(prefill, rtt_s, ctr), _sleepy(step, rtt_s, ctr),
+        _sleepy(tail_blk, rtt_s, ctr))
     try:
         t0 = time.perf_counter()
         outs = [oc.generate(None, params, prompt,

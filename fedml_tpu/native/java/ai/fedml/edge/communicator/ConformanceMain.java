@@ -86,8 +86,78 @@ public final class ConformanceMain {
         System.out.println(late == null ? "NORECV fedml/test/echo"
                 : "UNEXPECTED " + late);
 
+        agentPhase(comm, recvd);
+
         comm.disconnect();
         System.out.println("DONE");
+    }
+
+    /**
+     * Drive the service layer over the SAME broker: a
+     * {@link ai.fedml.edge.service.ClientAgentManager} with a pure-Java
+     * fake trainer receives a start_train message published by this very
+     * client (broker loopback) and must walk the full status machine.
+     */
+    private static void agentPhase(EdgeMqttCommunicator comm,
+                                   LinkedBlockingQueue<String> recvd)
+            throws Exception {
+        final long edgeId = 7;
+        ai.fedml.edge.service.TrainingExecutor executor =
+                new ai.fedml.edge.service.TrainingExecutor(params ->
+                        new ai.fedml.edge.service.TrainingExecutor.Trainer() {
+                            private int epoch;
+
+                            @Override
+                            public void train(int epochs, long seed) {
+                                for (int e = 0; e < epochs; e++) {
+                                    epoch = e + 1;
+                                }
+                            }
+
+                            @Override
+                            public int epoch() {
+                                return epoch;
+                            }
+
+                            @Override
+                            public float loss() {
+                                return 0.25f;
+                            }
+
+                            @Override
+                            public long numSamples() {
+                                return 120;
+                            }
+
+                            @Override
+                            public void saveModel(String path) {
+                            }
+
+                            @Override
+                            public void stopTraining() {
+                            }
+
+                            @Override
+                            public void close() {
+                            }
+                        }, 50);
+        ai.fedml.edge.service.ClientAgentManager agent =
+                new ai.fedml.edge.service.ClientAgentManager(
+                        edgeId, comm, executor,
+                        status -> recvd.offer("STATUS " + status), null);
+        agent.start();
+        System.out.println("AGENT start edgeId=" + edgeId);
+        comm.publish(
+                ai.fedml.edge.constants.FedMqttTopic.startTrain(edgeId),
+                ("{\"run_id\":\"3\",\"epochs\":\"2\","
+                        + "\"model_bundle\":\"/tmp/conf-model\","
+                        + "\"data_bundle\":\"/tmp/conf-data\"}")
+                        .getBytes(StandardCharsets.UTF_8), 1, false);
+        System.out.println("PUB start_train run=3");
+        // TRAINING(2) -> UPLOADING(3) -> FINISHED(4) -> IDLE(0)
+        for (int i = 0; i < 4; i++) {
+            emit(recvd, 15);
+        }
     }
 
     /** Drain exactly one queued async event into the transcript. */

@@ -96,6 +96,16 @@ public final class ClientAgentManager {
         }
         runId = params.runId;
         String outPath = params.modelBundle + ".trained";
+        // TRAINING is announced BEFORE the worker launches: a fast task
+        // could otherwise complete (UPLOADING/FINISHED/IDLE) before the
+        // TRAINING transition, scrambling the status sequence observers
+        // rely on.  Rolled back below if the executor refuses.
+        if (executor.isRunning()) {
+            reporter.reportTrainingError(params.runId, edgeId,
+                    "start_train refused: a task is already running");
+            return;
+        }
+        setStatus(EdgeMessageDefine.STATUS_TRAINING);
         boolean started = executor.execute(params, outPath,
                 progressListener, new TrainingExecutor.OnTrainCompleted() {
                     @Override
@@ -116,11 +126,10 @@ public final class ClientAgentManager {
                         setStatus(EdgeMessageDefine.STATUS_ERROR);
                     }
                 });
-        if (started) {
-            setStatus(EdgeMessageDefine.STATUS_TRAINING);
-        } else {
+        if (!started) {          // lost a start race despite the pre-check
             reporter.reportTrainingError(params.runId, edgeId,
                     "start_train refused: a task is already running");
+            setStatus(EdgeMessageDefine.STATUS_IDLE);
         }
     }
 

@@ -602,14 +602,17 @@ def serve_bench(on_accelerator: bool) -> dict:
     sys_prompt = list(range(2, 2 + (128 if on_accelerator else 64)))
     reqs = [sys_prompt + [200 + i] for i in range(4)]
 
-    def shared_prefix_run(pc):
+    def _timed_prefix_run(request_list, pc):
         t0 = time.perf_counter()
         total = 0
-        for r in reqs:
+        for r in request_list:
             total += len(generate(apply_fn, params, r,
                                   max_new_tokens=8, buf_len=buf,
                                   model=model, prefix_cache=pc))
         return round(total / (time.perf_counter() - t0), 1)
+
+    def shared_prefix_run(pc):
+        return _timed_prefix_run(reqs, pc)
 
     generate(apply_fn, params, reqs[0], max_new_tokens=2, buf_len=buf,
              model=model)                                     # compile
@@ -618,6 +621,31 @@ def serve_bench(on_accelerator: bool) -> dict:
     result["shared_prefix_cached_tok_s"] = shared_prefix_run(pc)
     result["prefix_cache_hits"] = pc.stats["hits"]
     result["prefix_tokens_skipped"] = pc.stats["prefill_tokens_skipped"]
+
+    # partial hits with a MULTI-token uncached tail (round-5 tail_block
+    # lever: the tail replays as ONE dispatch, so this row isolates the
+    # dispatch-amortization a per-token replay would forfeit — the
+    # decisive case over a network-attached chip)
+    tail_reqs = [sys_prompt + [210 + i + j for j in range(12)]
+                 for i in range(4)]
+
+    def tail_run(pc2):
+        return _timed_prefix_run(tail_reqs, pc2)
+
+    # compile BOTH replay paths outside the timed window: a miss-path
+    # prefill AND a partial-hit tail_block (the warm cache below forces
+    # the block program to trace now, not inside the cached timing)
+    warm_pc = PrefixCache(capacity=2)
+    generate(apply_fn, params, sys_prompt, max_new_tokens=1, buf_len=buf,
+             model=model, prefix_cache=warm_pc)
+    generate(apply_fn, params, tail_reqs[0], max_new_tokens=2, buf_len=buf,
+             model=model, prefix_cache=warm_pc)
+    result["prefix_tail12_tok_s"] = tail_run(None)
+    pc_t = PrefixCache(capacity=8)
+    generate(apply_fn, params, sys_prompt, max_new_tokens=1, buf_len=buf,
+             model=model, prefix_cache=pc_t)                  # warm prefix
+    result["prefix_tail12_cached_tok_s"] = tail_run(pc_t)
+    result["prefix_tail12_hits"] = pc_t.stats["hits"]
 
     # horizon>1 amortizes per-token host dispatch (dominant over a
     # network-attached TPU) by scanning H decode steps on-device per tick;

@@ -94,17 +94,22 @@ public final class ClientAgentManager {
                     "malformed start_train: " + e);
             return;
         }
-        runId = params.runId;
-        String outPath = params.modelBundle + ".trained";
-        // TRAINING is announced BEFORE the worker launches: a fast task
-        // could otherwise complete (UPLOADING/FINISHED/IDLE) before the
-        // TRAINING transition, scrambling the status sequence observers
-        // rely on.  Rolled back below if the executor refuses.
+        // refuse BEFORE touching any state: a refused run must not
+        // hijack runId (the in-flight run's later status reports would
+        // publish under the refused run's id)
         if (executor.isRunning()) {
             reporter.reportTrainingError(params.runId, edgeId,
                     "start_train refused: a task is already running");
             return;
         }
+        final int prevStatus = status;
+        final long prevRunId = runId;
+        runId = params.runId;
+        String outPath = params.modelBundle + ".trained";
+        // TRAINING is announced BEFORE the worker launches: a fast task
+        // could otherwise complete (UPLOADING/FINISHED/IDLE) before the
+        // TRAINING transition, scrambling the status sequence observers
+        // rely on.  Rolled back below if the executor refuses anyway.
         setStatus(EdgeMessageDefine.STATUS_TRAINING);
         boolean started = executor.execute(params, outPath,
                 progressListener, new TrainingExecutor.OnTrainCompleted() {
@@ -126,10 +131,15 @@ public final class ClientAgentManager {
                         setStatus(EdgeMessageDefine.STATUS_ERROR);
                     }
                 });
-        if (!started) {          // lost a start race despite the pre-check
+        if (!started) {
+            // lost a start race despite the pre-check (only reachable if
+            // one executor is shared across managers): restore the PRIOR
+            // state — the winning task is still mid-training, so IDLE
+            // here would scramble the sequence this method protects
             reporter.reportTrainingError(params.runId, edgeId,
                     "start_train refused: a task is already running");
-            setStatus(EdgeMessageDefine.STATUS_IDLE);
+            runId = prevRunId;
+            setStatus(prevStatus);
         }
     }
 

@@ -37,9 +37,6 @@ from .synthetic import (synthetic_image_classification, synthetic_lm_tokens,
 _IMAGE_SPECS = {
     "mnist": (10, (28, 28, 1), 60000, 10000),
     "synthetic_mnist": (10, (28, 28, 1), 60000, 10000),
-    # REAL bytes in-image: sklearn's UCI optical-digits corpus, shipped as
-    # a LEAF shard by tools/make_real_shards.py (data_shards/digits)
-    "digits": (10, (8, 8, 1), 1527, 270),
     "femnist": (62, (28, 28, 1), 60000, 10000),
     "fashionmnist": (10, (28, 28, 1), 60000, 10000),
     "emnist": (62, (28, 28, 1), 60000, 10000),
@@ -585,6 +582,20 @@ def load(args) -> Tuple[FederatedDataset, int]:
         # set (1797 8x8 grayscale images, 10 classes) — the in-image stand-in
         # for MNIST accuracy-parity runs (MNIST pixels cannot be downloaded
         # here; the idx/LEAF parsers above handle them when provided).
+        # A LEAF shard in the cache (tools/make_real_shards.py writes
+        # data_shards/digits) wins: same real bytes, but with the NATURAL
+        # per-user partition the BASELINE row exercises.  Either way the
+        # provenance is real — digits never falls back to synthetic.
+        if cache:
+            leaf_root = find_leaf_root(cache, "digits")
+            if leaf_root is not None:
+                tx, ty, vx, vy, cidx, tidx = load_leaf(
+                    leaf_root, input_shape=(8, 8, 1))
+                ds = FederatedDataset(
+                    tx, ty, vx, vy, cidx, 10, test_client_idxs=tidx,
+                    provenance=_cache_provenance(leaf_root,
+                                                 "real:leaf", "digits"))
+                return ds, 10
         from sklearn.datasets import load_digits
         d = load_digits()
         x = (d.data.astype(np.float32) / 16.0).reshape(-1, 8, 8, 1)

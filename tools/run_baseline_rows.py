@@ -88,6 +88,10 @@ def main():
                          " same 2-2-2-2 resnet at 1/4 width — ~16x fewer "
                          "conv FLOPs, the honestly-labeled reduction that "
                          "makes 20+ rounds feasible on the 1-core box)")
+    ap.add_argument("--news-rounds", type=int, default=None,
+                    help="override fednlp_20news comm rounds (full=40; the "
+                         "calibrated task is still rising there — longer "
+                         "horizons approach the 0.82 NB ceiling)")
     ap.add_argument("--femnist-rounds", type=int, default=None,
                     help="override femnist comm rounds (full=30; the "
                          "round-3 curve was still rising at 30 — plateau "
@@ -163,7 +167,9 @@ def main():
             # row's reduced vocab=2000/seq=64: 0.82 (the spec-default
             # 30000/128 shape probes at 0.74) — judge the curve against
             # 0.82, not 1.0
-            comm_round=2 if args.fast else 40, epochs=1, batch_size=16,
+            comm_round=(2 if args.fast
+                        else (args.news_rounds or 40)), epochs=1,
+            batch_size=16,
             learning_rate=3e-3, client_optimizer="adam",
             clip_grad_norm=1.0, partition_method="hetero",
             partition_alpha=0.5,

@@ -1,0 +1,77 @@
+"""Secure cross-device federation: LightSecAgg with NATIVE C++ clients.
+
+Round-5 capability (reference MobileNN ``src/security/LightSecAgg.cpp``
+plus the Python protocol in ``core/mpc/lightsecagg.py``, combined into
+one running federation): every C++ edge client quantizes its trained
+weights into GF(2^31-1), masks them with a private PRG mask, LCC-encodes
+the mask into N Vandermonde shares, and uploads only masked bytes —
+the server NEVER sees a plaintext update.  One client drops out between
+upload and the aggregation phase, and its contribution is still
+reconstructed from the shares the surviving clients hold (the one-shot
+reconstruction property that distinguishes LightSecAgg from pairwise
+SecAgg).
+
+Run:  python examples/cross_device/secure_native_federation.py
+"""
+
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from fedml_tpu.cross_device.edge_federation import (EdgeFederationServer,
+                                                    build_client_binary,
+                                                    export_client_data)
+
+N_CLIENTS, U, T = 4, 3, 1
+
+
+def main():
+    rng = np.random.default_rng(0)
+    d, classes, n_per = 16, 3, 150
+    centers = rng.normal(0, 2.0, (classes, d))
+    work = tempfile.mkdtemp(prefix="fedml_secure_edge_")
+    os.makedirs(os.path.join(work, "fed"))
+
+    for c in range(N_CLIENTS):
+        y = rng.integers(0, classes, n_per)
+        x = centers[y] + rng.normal(0, 0.5, (n_per, d))
+        export_client_data(os.path.join(work, f"data_{c}.fteb"),
+                           x.astype(np.float32), y)
+
+    binary = build_client_binary()
+    procs = []
+    for c in range(N_CLIENTS):
+        # client 3 simulates dropout AFTER uploading its masked update and
+        # shares in round 1 — the round must still aggregate it
+        drop_round = "1" if c == N_CLIENTS - 1 else "-1"
+        procs.append(subprocess.Popen(
+            [binary, os.path.join(work, "fed"), str(c),
+             os.path.join(work, f"data_{c}.fteb"), "20", drop_round]))
+
+    srv = EdgeFederationServer(
+        os.path.join(work, "fed"),
+        {"w1": np.zeros((d, classes), np.float32),
+         "b1": np.zeros((classes,), np.float32)},
+        num_clients=N_CLIENTS, rounds=2, epochs=3, batch_size=20, lr=0.1,
+        seed=11, round_timeout_s=60.0, secure=(U, T))
+    final = srv.run()
+    for p in procs:
+        p.wait(timeout=30)
+
+    logits = centers @ final["w1"] + final["b1"]
+    acc = float((logits.argmax(1) == np.arange(classes)).mean())
+    print(f"secure federation over {N_CLIENTS} C++ clients "
+          f"(U={U}, T={T}, 1 dropout mid-protocol): "
+          f"round losses {[round(h['loss'], 4) for h in srv.history]}, "
+          f"center accuracy {acc:.2f}")
+    plaintext = [p for r in range(2)
+                 for p in os.listdir(os.path.join(work, "fed", f"round_{r}"))
+                 if p.endswith(".fteb") and p.startswith("client_")]
+    print(f"plaintext model uploads in the shared dir: {plaintext} "
+          "(empty = the server only ever saw masked field elements)")
+
+
+if __name__ == "__main__":
+    main()

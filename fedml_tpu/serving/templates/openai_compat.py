@@ -461,9 +461,10 @@ class OpenAICompatServer:
         ``decode=True`` → KV-cached decode (see :func:`generate`).
         ``batch_slots`` > 0 (requires ``model``) routes requests through the
         :class:`~fedml_tpu.serving.batching.ContinuousBatchingEngine` so
-        concurrent requests share one batched decode program; per-request
-        ``top_k``/``top_p`` are ignored in that mode (the engine's sampler
-        is compiled once).  ``decode_horizon`` > 1 (engine mode only) generates that
+        concurrent requests share one batched decode program; sampled
+        requests that ALSO ask for ``top_k``/``top_p`` fall through to the
+        single-request path (one compiled program per distinct filter
+        pair) so the fields are honored, never silently ignored.  ``decode_horizon`` > 1 (engine mode only) generates that
         many tokens per device dispatch — same outputs, H-fold fewer host
         round-trips; streaming granularity coarsens to H tokens."""
         self.apply_fn = apply_fn
@@ -607,7 +608,16 @@ class OpenAICompatServer:
         elif adapter_name:
             raise RequestError("server has no adapters configured")
 
-        if self._engine is not None and not (
+        # per-request top_k/top_p cannot ride the engine (its sampler is
+        # one compiled program for the pool) — rather than silently
+        # IGNORING the fields, such requests fall through to the
+        # single-request path, whose builder compiles one program per
+        # distinct (top_k, top_p) pair (lru-cached); greedy requests are
+        # filter-independent, so they stay on the engine either way
+        wants_filters = (float(req.get("temperature", 0.0)) != 0.0
+                         and (int(req.get("top_k", 0)) > 0
+                              or float(req.get("top_p", 1.0)) < 1.0))
+        if self._engine is not None and not wants_filters and not (
                 self._engine_greedy_only
                 and float(req.get("temperature", 0.0)) != 0.0):
             q = self._engine.submit(

@@ -1032,6 +1032,46 @@ def test_server_weight_swap_over_http():
         srv.stop()
 
 
+def test_engine_mode_honors_per_request_filters():
+    """An engine-mode server must HONOR per-request top_k/top_p (round-4
+    doc said 'ignored'): sampled requests with filters fall through to
+    the single-request path — a near-zero nucleus at high temperature
+    must decode greedily (seed-independent), while plain sampled
+    requests still ride the engine."""
+    import dataclasses
+    import jax
+    import numpy as np
+    from fedml_tpu.llm.model import LlamaLM, TINY
+    from fedml_tpu.serving.templates import ByteTokenizer, OpenAICompatServer
+
+    tok = ByteTokenizer()
+    cfg = dataclasses.replace(TINY, vocab_size=tok.vocab_size, n_layers=1,
+                              dim=32, n_heads=2, n_kv_heads=2, ffn_dim=64,
+                              max_seq_len=160)
+    lm = LlamaLM(cfg)
+    params = lm.init(jax.random.PRNGKey(0),
+                     np.zeros((1, 8), np.int32))["params"]
+    srv = OpenAICompatServer(lambda p, t: lm.apply({"params": p}, t),
+                             params, tokenizer=tok, buf_len=96, model=lm,
+                             batch_slots=2)
+    srv.start()
+    try:
+        ticks0 = srv._engine._ticks
+        outs = [_post(srv.port, "/v1/completions",
+                      {"prompt": "hi", "max_tokens": 4, "temperature": 1.9,
+                       "top_p": 1e-6, "seed": sd})[1] for sd in (1, 2)]
+        a, b = (json.loads(o)["choices"][0]["text"] for o in outs)
+        assert a == b, "top_p filter was ignored in engine mode"
+        # those requests did NOT ride the engine...
+        assert srv._engine._ticks == ticks0
+        # ...but a plain sampled request does
+        _post(srv.port, "/v1/completions",
+              {"prompt": "hi", "max_tokens": 4, "temperature": 0.9})
+        assert srv._engine._ticks > ticks0
+    finally:
+        srv.stop()
+
+
 def test_engine_weight_swap_serves_new_weights():
     """Round-4 advisor (medium): a server built with batch_slots kept
     serving its engine's construction-time weights after update_params().

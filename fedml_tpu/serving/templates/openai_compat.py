@@ -614,16 +614,21 @@ class OpenAICompatServer:
         # single-request path, whose builder compiles one program per
         # distinct (top_k, top_p) pair (lru-cached); greedy requests are
         # filter-independent, so they stay on the engine either way
-        wants_filters = (float(req.get("temperature", 0.0)) != 0.0
-                         and (int(req.get("top_k", 0)) > 0
-                              or float(req.get("top_p", 1.0)) < 1.0))
+        # None-safe field parsing: OpenAI-style clients serialize unset
+        # optionals as explicit JSON nulls, and dict.get's default does
+        # not apply to a present null
+        temp = float(req.get("temperature") or 0.0)
+        req_top_k = int(req.get("top_k") or 0)
+        req_top_p = float(1.0 if req.get("top_p") is None
+                          else req.get("top_p"))
+        wants_filters = (temp != 0.0
+                         and (req_top_k > 0 or req_top_p < 1.0))
         if self._engine is not None and not wants_filters and not (
-                self._engine_greedy_only
-                and float(req.get("temperature", 0.0)) != 0.0):
+                self._engine_greedy_only and temp != 0.0):
             q = self._engine.submit(
                 tok.encode(prompt),
                 max_new_tokens=int(req.get("max_tokens", 64)),
-                temperature=float(req.get("temperature", 0.0)),
+                temperature=temp,
                 seed=int(req.get("seed", 0)),
                 eos_id=getattr(tok, "eos_id", None))
             out = []
@@ -637,8 +642,7 @@ class OpenAICompatServer:
                 out.append(t)
                 if on_text:
                     emit(t)
-        elif (self.draft_model is not None
-              and float(req.get("temperature", 0.0)) == 0.0):
+        elif self.draft_model is not None and temp == 0.0:
             from ..speculative import speculative_generate
             out, _spec_stats = speculative_generate(
                 self.model, self.params, self.draft_model,
@@ -651,9 +655,9 @@ class OpenAICompatServer:
             out = generate(
                 self.apply_fn, self.params, tok.encode(prompt),
                 max_new_tokens=int(req.get("max_tokens", 64)),
-                temperature=float(req.get("temperature", 0.0)),
-                top_k=int(req.get("top_k", 0)),
-                top_p=min(max(float(req.get("top_p", 1.0)), 0.0), 1.0),
+                temperature=temp,
+                top_k=req_top_k,
+                top_p=min(max(req_top_p, 0.0), 1.0),
                 seed=int(req.get("seed", 0)),
                 buf_len=self.buf_len,
                 eos_id=getattr(tok, "eos_id", None),

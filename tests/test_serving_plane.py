@@ -1064,9 +1064,12 @@ def test_engine_mode_honors_per_request_filters():
         assert a == b, "top_p filter was ignored in engine mode"
         # those requests did NOT ride the engine...
         assert srv._engine._ticks == ticks0
-        # ...but a plain sampled request does
-        _post(srv.port, "/v1/completions",
-              {"prompt": "hi", "max_tokens": 4, "temperature": 0.9})
+        # ...but a plain sampled request does — and explicit JSON nulls
+        # for the optional fields (OpenAI-client style) must not 500
+        st, _ = _post(srv.port, "/v1/completions",
+                      {"prompt": "hi", "max_tokens": 4, "temperature": 0.9,
+                       "top_k": None, "top_p": None})
+        assert st == 200
         assert srv._engine._ticks > ticks0
     finally:
         srv.stop()

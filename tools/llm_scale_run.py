@@ -69,26 +69,29 @@ def layer7b_bench(args_cli):
     tx = optax.sgd(1e-3)
     opt = tx.init(lora)
 
-    def loss_fn(lora, x):
+    # params ride as a jit ARGUMENT: closing over the 0.4 GiB weight tree
+    # would inline it into the HLO constants and blow the tunnel's
+    # remote-compile request limit (HTTP 413, observed 2026-08-01)
+    def loss_fn(lora, params, x):
         out = block.apply({"params": params, "lora": lora}, x, positions)
         return jnp.mean(jnp.square(out.astype(jnp.float32)))
 
     @jax.jit
-    def step(lora, opt, x):
-        loss, g = jax.value_and_grad(loss_fn)(lora, x)
+    def step(lora, opt, params, x):
+        loss, g = jax.value_and_grad(loss_fn)(lora, params, x)
         upd, opt = tx.update(g, opt)
         return optax.apply_updates(lora, upd), opt, loss
 
     from bench import _measured_matmul_peak, _peak_flops, _readback, \
         _timed_chain, measure_rtt
-    state = [step(lora, opt, x)]
+    state = [step(lora, opt, params, x)]
     _readback(state[0][2])
     rtt = measure_rtt()
 
     def run_n(k):
         lo, op, _ = state[0]
         for _ in range(k):
-            lo, op, loss = step(lo, op, x)
+            lo, op, loss = step(lo, op, params, x)
         state[0] = (lo, op, loss)
 
     dt = _timed_chain(run_n, lambda: _readback(state[0][2]), n0=5, rtt=rtt)

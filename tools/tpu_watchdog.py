@@ -1,15 +1,20 @@
 """TPU-tunnel watchdog: probe until the wedged tunnel revives, then run
-the full benchmark battery once and exit.
+the HEADLINE captures once and exit.
 
 The tunnel-attached TPU in this image wedges for hours at a time
 (BASELINE.md round-2 notes): ``jax.devices()`` blocks indefinitely and
 only an out-of-process probe can tell.  This tool polls cheaply and, the
-moment a probe succeeds, captures every TPU-side artifact in one pass:
+moment a probe succeeds, captures the two highest-value artifacts:
 
 - ``TPU_BENCH_LIVE.json``   — bench.py default mode (FedAvg + LLM LoRA)
-- ``TPU_ATTN_SWEEP.json``   — bench.py --attn (flash vs blockwise parity+timing)
-- ``TPU_SERVE_BENCH.json``  — bench.py --serve (decode stack tokens/sec)
-- ``TPU_NAN_BISECT.out``    — tools/tpu_nan_bisect.py (bf16 gradient issue)
+- ``TPU_LLM_SCALE.json``    — the 1.075B flagship scale run
+
+Everything else (serve, attn sweep, flash tune, the MFU ablation grid,
+the 7B layer) is owned by ``tools/r5_tpu_controller.py``, which writes
+attempts to side files and replaces an artifact ONLY with a validated
+on-TPU capture — this tool's overwrite-on-timeout stubs must never race
+it for those files (they destroyed a live capture's successor slot on
+2026-08-01).
 
 Run detached:  nohup python tools/tpu_watchdog.py > tools/watchdog.log 2>&1 &
 """
@@ -52,11 +57,12 @@ def tpu_alive() -> bool:
     return alive
 
 
-def run_job(cmd, out_path, timeout_s=JOB_TIMEOUT_S) -> bool:
+def run_job(cmd, out_path, timeout_s=JOB_TIMEOUT_S, extra_env=None) -> bool:
     print(f"[watchdog] running: {' '.join(cmd)}", flush=True)
     try:
         r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
-                           timeout=timeout_s)
+                           timeout=timeout_s,
+                           env=dict(os.environ, **(extra_env or {})))
     except subprocess.TimeoutExpired as e:
         print(f"[watchdog] TIMEOUT: {cmd}", flush=True)
         # overwrite the artifact so a stale previous result can't
@@ -90,31 +96,26 @@ def main():
 
     py = sys.executable
     # serialize: one TPU client at a time (concurrent clients wedge it).
-    # Ordered by value-per-minute in case the tunnel re-wedges mid-battery:
-    # headline bench first, then the >=1B FedLLM run (the round-3 VERDICT
-    # ask), then serving/attention, then tuning sweeps, then the NaN-fix
-    # regression probe (bug already fixed+committed — lowest priority).
+    # Headline bench first, then the >=1B FedLLM run — highest value per
+    # minute in case the tunnel re-wedges mid-battery.  The rest of the
+    # battery (serve, attn, flash tune, MFU ablation, 7B layer) is OWNED
+    # by tools/r5_tpu_controller.py: its overwrite rule (side-file
+    # attempts, artifact replaced only by a validated on-TPU capture)
+    # must not race this tool's overwrite-on-timeout stubs, which can
+    # destroy validated evidence (observed hazard 2026-08-01).
     run_job([py, "bench.py"], "TPU_BENCH_LIVE.json")
     _run_scale_jobs(py)
-    run_job([py, "bench.py", "--serve"], "TPU_SERVE_BENCH.json")
-    run_job([py, "bench.py", "--attn"], "TPU_ATTN_SWEEP.json",
-            timeout_s=3600)
-    # remaining flash-tile sweep shapes (shape 0 measured live round-3;
-    # paste results into ops/attention.py::_TUNED_BLOCKS)
-    run_job([py, "tools/tpu_flash_tune.py", "1", "2", "3", "4", "5"],
-            "TPU_FLASH_TUNE.json", timeout_s=3600)
-    run_job([py, "tools/tpu_nan_bisect.py"], "TPU_NAN_BISECT.out",
-            timeout_s=1200)
-    print("[watchdog] battery complete", flush=True)
+    print("[watchdog] headline captures complete; run "
+          "tools/r5_tpu_controller.py for the remaining artifacts",
+          flush=True)
 
 
 def _run_scale_jobs(py):
     env = dict(os.environ)
     env["LLM_SCALE_TPU"] = "1"  # let the scale probes use the live TPU
+    # (the 7B-layer probe moved to r5_tpu_controller's queue — see main)
     for cmd, out in ((["tools/llm_scale_run.py", "--rounds", "3"],
-                      "TPU_LLM_SCALE.json"),
-                     (["tools/llm_scale_run.py", "--layer7b",
-                       "--seq", "2048"], "TPU_LLM_7B_LAYER.json")):
+                      "TPU_LLM_SCALE.json"),):
         try:
             r = subprocess.run([py] + cmd, cwd=REPO, capture_output=True,
                                text=True, timeout=3600, env=env)

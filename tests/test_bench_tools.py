@@ -1,0 +1,88 @@
+"""Pins for the round-5 bench/capture tooling invariants.
+
+These guard the measurement infrastructure itself (bench.py ablate grid,
+tools/r5_tpu_controller.py validation), not the framework — a corrupted
+capture pipeline silently poisons every committed perf number, which is
+exactly what round 3's retractions cost.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _import_bench():
+    sys.path.insert(0, REPO)
+    import bench
+    return bench
+
+
+def test_bench_llm_lora_restores_flash_mode_env(monkeypatch):
+    """flash_mode must be visible to the traces the call makes and be
+    restored afterward — on success AND when the impl raises (a leaked
+    "off" would silently corrupt the next same-process measurement)."""
+    bench = _import_bench()
+    seen = {}
+
+    def fake_impl(on_accel, peak, batch, remat, flash_mode):
+        seen["env"] = os.environ.get("FEDML_TPU_FLASH_MODE")
+        if flash_mode == "boom":
+            raise RuntimeError("impl failed")
+        return {"mfu": 1.0}
+
+    monkeypatch.setattr(bench, "_bench_llm_lora_impl", fake_impl)
+
+    monkeypatch.setenv("FEDML_TPU_FLASH_MODE", "auto")
+    out = bench.bench_llm_lora(False, None, flash_mode="off")
+    assert out == {"mfu": 1.0}
+    assert seen["env"] == "off"
+    assert os.environ["FEDML_TPU_FLASH_MODE"] == "auto"  # restored
+
+    monkeypatch.delenv("FEDML_TPU_FLASH_MODE")
+    with pytest.raises(RuntimeError):
+        bench.bench_llm_lora(False, None, flash_mode="boom")
+    assert "FEDML_TPU_FLASH_MODE" not in os.environ  # restored to absent
+
+    # no override -> env untouched
+    bench.bench_llm_lora(False, None)
+    assert "FEDML_TPU_FLASH_MODE" not in os.environ
+
+
+def test_controller_validates_platform_from_last_json_line(tmp_path):
+    """The controller must accept an artifact only when its final JSON
+    line self-reports TPU — progress lines before the payload (the serve
+    bench emits them) must not confuse the parse."""
+    import r5_tpu_controller as ctl
+
+    art = tmp_path / "x.json"
+    art.write_text("[serve-row] plain_tok_s=1.0 t=3\n"
+                   + json.dumps({"metric": "m", "platform": "tpu"}) + "\n")
+    assert ctl._on_tpu(ctl._last_json(str(art)))
+
+    art.write_text(json.dumps({"metric": "m", "platform": "cpu",
+                               "device_kind": "cpu"}))
+    assert not ctl._on_tpu(ctl._last_json(str(art)))
+
+    # axon device_kind strings count as TPU; missing file does not crash
+    assert ctl._on_tpu({"device_kind": "TPU v5 lite"})
+    assert ctl._on_tpu({"on_tpu": True})
+    assert not ctl._on_tpu(ctl._last_json(str(tmp_path / "missing.json")))
+
+
+def test_serve_quick_filter_keeps_kvint8_and_a_headline_row():
+    """The quick-mode trim must keep the dense baseline, a horizon row
+    (headline eligible: best_row excludes int8 weights), and the KV-int8
+    bandwidth lever — dropping only the int8-WEIGHT engine variants."""
+    names = ["batched_tok_s", "batched_int8_tok_s", "batched_h16_tok_s",
+             "batched_h16_int8_tok_s", "batched_h16_kvint8_tok_s"]
+    kept = [n for n in names if "_int8" not in n or "kvint8" in n]
+    assert kept == ["batched_tok_s", "batched_h16_tok_s",
+                    "batched_h16_kvint8_tok_s"]
+    headline_eligible = [n for n in kept
+                         if n.startswith("batched") and "int8" not in n]
+    assert headline_eligible  # main()'s max() never sees an empty dict

@@ -42,16 +42,30 @@ def _readback(x):
     return float(np.asarray(jnp.sum(leaf.astype(jnp.float32))))
 
 
-def _time_chained(fn, x0, reps=REPS):
-    """Time reps sequential applications of fn chained through its output
-    (device-order dependency), one readback at the end; returns s/call."""
+def _time_chained(fn, x0, reps=REPS, min_total_s=1.0):
+    """Time reps-long jitted chains of fn, dispatched back-to-back n times
+    (async dispatches pipeline in device program order; the one final
+    readback forces them all), growing n until wall-clock >= min_total_s
+    so the tunnel RTT amortizes; returns s/call.  The table this feeds
+    gates the autotune-or-fallback policy — a single short sample whose
+    time is mostly one RTT draw can crown a losing tile."""
     import jax
 
     f = jax.jit(lambda x: _chain(fn, x, reps))
     _readback(f(x0))  # compile
-    t0 = time.perf_counter()
-    _readback(f(x0))
-    return (time.perf_counter() - t0) / reps
+    n, total = 1, 0.0
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = f(x0)
+        _readback(out)
+        total = time.perf_counter() - t0
+        if total >= min_total_s:
+            break
+        per = max(total / n, 1e-6)
+        n = min(int(min_total_s * 1.3 / per) + 1, 512)
+    return total / (n * reps)
 
 
 def _chain(fn, x, reps):

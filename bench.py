@@ -14,6 +14,13 @@ prints that as one json line.
 full-buffer decode, continuous batching vs sequential, and int8 vs full
 precision, printing one json line of tokens/sec numbers.
 
+``python bench.py --agg`` times the mesh engine's server-update layouts —
+``update_sharding=scatter`` (reduce-scatter merge + shard-resident server
+optimizer, docs/UPDATE_SHARDING.md) vs ``replicated`` (full-model psum +
+N-way redundant update) — at 256 clients/round on an 8-shard mesh (virtual
+CPU devices when no accelerator provides 8), one json line with both
+wall-clocks.
+
 ``vs_baseline``: the reference has no published numbers (BASELINE.md), so the
 ratio is measured against an in-process torch-CPU eager reimplementation of
 the reference's client loop (``my_model_trainer_classification.py``
@@ -265,6 +272,67 @@ def bench_torch_reference_style(n_clients: int = 8) -> float:
         one_round()
     per_round = (time.perf_counter() - t0) / reps
     return per_round * (CLIENTS_PER_ROUND / n_clients)
+
+
+# -- server-update sharding benchmark (--agg) --------------------------------
+def bench_update_sharding(rounds: int | None = None,
+                          clients_per_round: int | None = None) -> dict:
+    """scatter vs replicated server-update wall-clock on the mesh engine,
+    same cohort/seed/model for both layouts.  FedOpt is the representative
+    algorithm: its Adam step is the heaviest stage-2 the zoo has, so it
+    exposes the per-chip 1/n_shards update win the scatter layout buys.
+    FEDML_AGG_QUICK=1 shrinks the cohort for smoke tests."""
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    quick = os.environ.get("FEDML_AGG_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (2 if quick else ROUNDS_TIMED)
+    rtt = None
+    out = {"clients_per_round": cpr, "quick": quick}
+
+    for mode in ("scatter", "replicated"):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total,
+            client_num_per_round=cpr, comm_round=timed_rounds + 2,
+            epochs=1, batch_size=BATCH, learning_rate=0.03,
+            partition_method="homo", frequency_of_the_test=10 ** 9,
+            random_seed=0, federated_optimizer="FedOpt",
+            update_sharding=mode,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        api = MeshFedAvgAPI(args, None, dataset, model)
+        out["n_shards"] = api.n_shards
+        api.train_one_round(0)  # compile
+        api.train_one_round(1)
+        _readback(api.state.global_params)
+        if rtt is None:
+            rtt = measure_rtt()
+        rounds_done = [2]
+
+        def run_n(n):
+            for _ in range(n):
+                api.train_one_round(rounds_done[0] % args.comm_round)
+                rounds_done[0] += 1
+
+        dt = _timed_chain(run_n,
+                          lambda: _readback(api.state.global_params),
+                          min_total_s=0.5 if quick else 2.0,
+                          n0=timed_rounds, rtt=rtt)
+        out[f"{mode}_s_per_round"] = round(dt, 5)
+    out["scatter_speedup"] = round(
+        out["replicated_s_per_round"] / out["scatter_s_per_round"], 3)
+    return out
 
 
 # -- LLM LoRA single-chip benchmark ------------------------------------------
@@ -726,6 +794,27 @@ def serve_bench(on_accelerator: bool) -> dict:
 
 
 def main():
+    if "--agg" in sys.argv:
+        # the scatter-vs-replicated comparison needs a multi-shard mesh;
+        # force 8 virtual host-platform devices BEFORE the backend
+        # initializes (a no-op for the accelerator platform if one serves
+        # >= 8 real chips)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        info = _platform_info(measure_peak=False)
+        result = bench_update_sharding()
+        result.update({
+            "metric": "server_update_scatter_vs_replicated",
+            "value": result["scatter_s_per_round"],
+            "unit": "s/round",
+            "vs_baseline": result["scatter_speedup"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
     if "--serve" in sys.argv:
         info = _platform_info(measure_peak=False)
         result = serve_bench(info["platform"] not in ("cpu",))

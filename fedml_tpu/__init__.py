@@ -78,6 +78,10 @@ if (not os.environ.get("FEDML_TPU_NO_COMPILE_CACHE") and not _cpu_only
     except Exception:
         pass
 
+from . import compat as _compat  # noqa: E402
+
+_compat.install()
+
 from . import constants  # noqa: E402
 from .arguments import Arguments, add_args, load_arguments  # noqa: E402
 from .constants import (  # noqa: E402

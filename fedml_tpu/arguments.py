@@ -124,6 +124,11 @@ _DEFAULTS: Dict[str, Any] = dict(
     mesh_data=1,
     mesh_model=1,
     mesh_seq=1,
+    # server-update layout on the mesh: replicated | scatter | auto
+    # (auto = scatter whenever the client axis has > 1 shard)
+    update_sharding="auto",
+    # double-buffered host->device cohort staging (mesh engine)
+    async_staging=True,
     compute_dtype="float32",
     clients_per_device=1,
 )

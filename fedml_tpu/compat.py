@@ -1,0 +1,75 @@
+"""JAX version-compatibility shims.
+
+The engine targets the current ``jax.shard_map`` surface (top-level export,
+``check_vma=`` replication check).  The jax graft baked into some images
+predates both (``jax.experimental.shard_map.shard_map`` with ``check_rep=``),
+so every entry point funnels through :func:`install` once at package import:
+if ``jax.shard_map`` is absent, an adapter with the modern signature is
+installed in its place.  Call sites (and tests) then use ``jax.shard_map``
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        # modern check_vma= is legacy check_rep= (same meaning: verify the
+        # body's claimed replication); default matches legacy (True)
+        check_rep = kwargs.pop("check_rep", check_vma)
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=True if check_rep is None else bool(check_rep),
+            **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # some versions return the frame object, some the size itself
+        frame = jax.core.axis_frame(axis_name)
+        return getattr(frame, "size", frame)
+
+    jax.lax.axis_size = axis_size
+
+
+def _install_pallas_params() -> None:
+    # modern pallas renamed TPUCompilerParams -> CompilerParams; alias the
+    # new name onto old installs so kernels write the modern spelling.
+    # pallas may be absent entirely on minimal builds — then the kernels
+    # that would need it are unreachable anyway.
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except Exception:
+        return
+    if not hasattr(pltpu, "CompilerParams") and \
+            hasattr(pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+def _install_export() -> None:
+    # `jax.export.export(...)` needs the submodule imported once before
+    # plain attribute access works on versions that don't re-export it
+    try:
+        import jax.export  # noqa: F401
+    except Exception:
+        pass
+
+
+def install() -> None:
+    _install_shard_map()
+    _install_axis_size()
+    _install_pallas_params()
+    _install_export()

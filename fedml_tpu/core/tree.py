@@ -130,3 +130,28 @@ def tree_unflatten_1d(vec: jnp.ndarray, like: Pytree) -> Pytree:
 
 def num_params(tree: Pytree) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def padded_flat_size(tree: Pytree, multiple: int) -> int:
+    """Length of ``tree_flatten_padded(tree, multiple)`` — the flat model
+    vector zero-padded so it chunks evenly into ``multiple`` shards."""
+    n = num_params(tree)
+    return -(-n // multiple) * multiple
+
+
+def tree_flatten_padded(tree: Pytree, multiple: int) -> jnp.ndarray:
+    """Flatten a pytree into one f32 vector zero-padded to a multiple of
+    ``multiple`` — the scatter-mode server update's working layout: each of
+    ``multiple`` mesh shards owns one contiguous ``1/multiple`` chunk."""
+    vec = tree_flatten_1d(tree)
+    pad = padded_flat_size(tree, multiple) - vec.shape[0]
+    if pad:
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    return vec
+
+
+def flat_chunk(vec: jnp.ndarray, index, n_chunks: int) -> jnp.ndarray:
+    """Chunk ``index`` of ``vec`` split into ``n_chunks`` equal blocks
+    (``index`` may be traced, e.g. ``lax.axis_index`` inside shard_map)."""
+    chunk = vec.shape[0] // n_chunks
+    return jax.lax.dynamic_slice(vec, (index * chunk,), (chunk,))

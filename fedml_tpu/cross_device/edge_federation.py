@@ -263,16 +263,33 @@ class EdgeFederationServer:
 
 
 def build_client_binary() -> str:
-    """Compile the standalone edge client (cached beside the sources)."""
+    """Compile the standalone edge client (cached beside the sources).
+
+    The mtime cache alone is not enough: a binary built on another machine
+    (different glibc/libstdc++) loads fine there but aborts with
+    ``GLIBC_x.y not found`` here, and every client subprocess then dies
+    instantly while the server polls to timeout.  So a cached binary must
+    also prove it EXECUTES on this host (argc<2 exits with the usage
+    message, which is all we need) before it is trusted."""
     import subprocess
     src_dir = os.path.dirname(os.path.abspath(__file__))
     native = os.path.join(os.path.dirname(src_dir), "native")
     out = os.path.join(native, "fedml_edge_client")
     srcs = [os.path.join(native, "edge_client_main.cpp"),
             os.path.join(native, "edge_trainer.cpp")]
+
+    def _loads_here() -> bool:
+        try:
+            r = subprocess.run([out], capture_output=True, timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        # usage exit is fine; a loader failure mentions GLIBC/GLIBCXX
+        return b"GLIBC" not in r.stderr
+
     if (not os.path.exists(out)
             or any(os.path.getmtime(s) > os.path.getmtime(out)
-                   for s in srcs)):
+                   for s in srcs)
+            or not _loads_here()):
         subprocess.run(["g++", "-O2", "-std=c++17", *srcs, "-o", out],
                        check=True)
     return out

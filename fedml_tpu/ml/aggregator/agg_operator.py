@@ -44,13 +44,42 @@ class FedMLAggOperator:
 @flax.struct.dataclass
 class ServerState:
     """All server-side algorithm state as one pytree (checkpointable with
-    orbax as a unit)."""
+    orbax as a unit).
+
+    Two layouts share this class:
+
+    - replicated (``ServerOptimizer.init``): every aux field mirrors the
+      ``global_params`` pytree structure; every chip holds all of it.
+    - scatter (``ServerOptimizer.init_sharded``): aux state lives as flat
+      f32 vectors over the padded flattened model, sharded over the
+      ``client`` mesh axis so each chip permanently owns ``1/n_shards`` of
+      the server optimizer state; only ``global_params`` stays a replicated
+      pytree (clients need the full model each round).
+    """
     round_idx: jnp.ndarray
     global_params: Any
     opt_state: Any = None        # FedOpt server optimizer state
     c_server: Any = None         # SCAFFOLD
     h: Any = None                # FedDyn
     momentum: Any = None         # Mime
+
+
+def sharded_state_map(state: ServerState, repl, shard) -> ServerState:
+    """Build a ServerState-shaped pytree marking each leaf of a SCATTER-mode
+    state with ``shard`` (flat shard-resident aux vectors) or ``repl``
+    (round counter, replicated global params, scalar optimizer counters like
+    Adam's step count).  Used twice with different leaf types: shard_map
+    in/out PartitionSpecs and ``jax.device_put`` NamedShardings."""
+    def mark(sub, sharded):
+        return jax.tree_util.tree_map(
+            lambda x: shard if (sharded and jnp.ndim(x) >= 1) else repl, sub)
+    return ServerState(
+        round_idx=repl,
+        global_params=mark(state.global_params, False),
+        opt_state=mark(state.opt_state, True),
+        c_server=mark(state.c_server, True),
+        h=mark(state.h, True),
+        momentum=mark(state.momentum, True))
 
 class ServerOptimizer:
     """Builds jittable server-update functions per algorithm."""
@@ -86,6 +115,25 @@ class ServerOptimizer:
             st = st.replace(momentum=tree_util.tree_zeros_like(params))
         return st
 
+    def init_sharded(self, params, n_shards: int) -> ServerState:
+        """Scatter-mode init (arXiv:2004.13336 layout): every aux field is a
+        flat f32 vector over the padded flattened model — ONE logical array
+        the caller device_puts with ``P(client)`` so each chip owns a
+        contiguous ``1/n_shards`` chunk.  ``global_params`` stays the
+        replicated pytree the per-client bodies broadcast from."""
+        flat = tree_util.tree_flatten_padded(params, n_shards)
+        st = ServerState(round_idx=jnp.zeros((), jnp.int32),
+                         global_params=params)
+        if self.server_tx is not None:
+            st = st.replace(opt_state=self.server_tx.init(flat))
+        if self.algorithm == "scaffold":
+            st = st.replace(c_server=jnp.zeros_like(flat))
+        if self.algorithm == "feddyn":
+            st = st.replace(h=jnp.zeros_like(flat))
+        if self.algorithm == "mime":
+            st = st.replace(momentum=jnp.zeros_like(flat))
+        return st
+
     # -- stage 1: cross-client reductions ---------------------------------
     # Computed either over a stacked client axis (sp/vmap engines) or inside
     # shard_map where each reduction becomes a `psum` over the `client` mesh
@@ -101,7 +149,10 @@ class ServerOptimizer:
         agg = {
             "avg_params": tree_util.stacked_weighted_average(
                 client_params_stacked, weights),
-            "n_sampled": jnp.asarray(weights.shape[0], jnp.float32),
+            # count REAL clients only: padded zero-weight rows (bucketed /
+            # mesh-padded cohorts) must not inflate SCAFFOLD's and FedDyn's
+            # |S|/N fraction (the mesh path already counted w > 0)
+            "n_sampled": jnp.sum((weights > 0).astype(jnp.float32)),
         }
         if alg == "scaffold":
             agg["mean_delta_c"] = tree_util.stacked_weighted_average(
@@ -198,6 +249,51 @@ class ServerOptimizer:
 
         # FedAvg / FedProx / FedAvg_seq / default: params ← weighted average
         return state.replace(round_idx=state.round_idx + 1, global_params=avg)
+
+    # -- stage 2 on a flat parameter SHARD (scatter mode) ------------------
+    def update_shard(self, state: ServerState, gshard: jnp.ndarray,
+                     agg: dict) -> Tuple[jnp.ndarray, dict]:
+        """Same state transitions as :meth:`update_from_aggregates`, but on
+        this chip's contiguous flat chunk of the model: ``gshard`` is the
+        current global params' chunk, ``agg`` values are reduce-scattered
+        chunks (plus replicated scalars), and ``state``'s aux fields arrive
+        as their shard_map-sliced chunks.  Returns ``(new_gshard,
+        replaced_fields)``; the caller all_gathers only ``new_gshard`` while
+        the replaced aux fields stay shard-resident forever.  Per-chip cost
+        is |model|/n_shards FLOPs and HBM instead of the replicated path's
+        N-way redundant full-model update."""
+        alg = self.algorithm
+        avg = agg["avg_params"]
+
+        if alg in ("fedopt", "fedopt_seq"):
+            pseudo_grad = gshard - avg
+            updates, new_opt = self.server_tx.update(
+                pseudo_grad, state.opt_state, gshard)
+            return optax.apply_updates(gshard, updates), {"opt_state": new_opt}
+
+        if alg == "scaffold":
+            new_g = gshard + self.server_lr * (avg - gshard)
+            frac = agg["n_sampled"] / self.total_clients
+            new_c = state.c_server + frac * agg["mean_delta_c"]
+            return new_g, {"c_server": new_c}
+
+        if alg == "fednova":
+            return gshard - agg["tau_eff"] * agg["nova_d"], {}
+
+        if alg == "feddyn":
+            frac = agg["n_sampled"] / self.total_clients
+            new_h = state.h - self.feddyn_alpha * frac * (avg - gshard)
+            return avg - new_h / self.feddyn_alpha, {"h": new_h}
+
+        if alg == "mime":
+            b = self.server_momentum
+            new_mom = b * state.momentum + (1 - b) * agg["avg_grad"]
+            return avg, {"momentum": new_mom}
+
+        if alg == "fedsgd":
+            return gshard - self.server_lr * agg["avg_grad"], {}
+
+        return avg, {}
 
     def update(self, state: ServerState, client_params_stacked: Any,
                weights: jnp.ndarray, aux: Optional[dict] = None) -> ServerState:

@@ -11,9 +11,29 @@ Replaces the reference's two distributed simulators with one TPU-native one:
 become: clients sharded over the ``client`` axis of a ``jax.sharding.Mesh``;
 each device runs its cohort shard through the SAME compiled per-client body
 the SP engine uses (``vmap`` across its local clients, ``lax.scan`` within
-each client's batches); the FedAvg merge is ``lax.psum`` over ICI.  The whole
-round — local SGD for all clients on all chips + global merge + server
-optimizer step — is ONE ``jit(shard_map(...))`` dispatch.
+each client's batches).  The whole round — local SGD for all clients on all
+chips + global merge + server optimizer step — is ONE ``jit(shard_map(...))``
+dispatch.
+
+The FedAvg merge + server update runs in one of two layouts
+(``args.update_sharding``):
+
+- ``replicated`` — the weighted numerator is ``psum``-all-reduced per leaf
+  and every chip runs the full-model server update redundantly (the original
+  engine).
+- ``scatter`` (default on multi-shard meshes) — the cross-replica layout of
+  "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+  Training" (arXiv:2004.13336): the client-weighted partial sums are
+  flattened into one padded vector and ``psum_scatter``-ed so each chip
+  receives only its contiguous ``1/n_shards`` chunk; the server optimizer
+  (``ServerOptimizer.update_shard``) then transitions ONLY that chunk —
+  FedOpt moments, SCAFFOLD ``c_server``, FedDyn ``h`` and Mime momentum are
+  permanently shard-resident (``ServerOptimizer.init_sharded``) — and a
+  single ``all_gather`` rebuilds just the new ``global_params`` for the next
+  round's client broadcast.  Per round that is reduce-scatter + all-gather
+  bytes (≈ all-reduce) but ``1/n_shards`` of the server-update FLOPs/HBM
+  per chip, and the optimizer state never crosses the interconnect at all.
+  See ``docs/UPDATE_SHARDING.md`` for the accounting.
 
 The reference's ``SeqTrainScheduler`` (exhaustive-search client→worker
 assignment, ``core/schedule/seq_train_scheduler.py:9``) is unnecessary here:
@@ -26,7 +46,7 @@ into SPMD.  For strongly non-uniform cohorts the scheduler in
 from __future__ import annotations
 
 import logging
-from functools import partial
+from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +56,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...core import rng as rng_util
 from ...core import tree as tree_util
 from ...core.mesh import CLIENT_AXIS, make_mesh
-from ...ml.aggregator.agg_operator import ServerOptimizer, ServerState
+from ...ml.aggregator.agg_operator import (ServerOptimizer, ServerState,
+                                           sharded_state_map)
 from ...ml.trainer.local_trainer import LocalTrainer
 from ..round_engine import next_pow2
 from ..sp.fedavg_api import FedAvgAPI
@@ -54,13 +75,44 @@ def _psum_wavg(stacked, w, axis_name):
     return jax.tree_util.tree_map(lambda x: (x / den).astype(x.dtype), num)
 
 
+class AsyncCohortStager:
+    """Double-buffered host→device cohort staging.
+
+    ``build(round_idx)`` must be a pure function of the round index that
+    returns the staged (device_put) round inputs.  While round ``r``'s
+    compiled program runs, a single worker thread builds and stages cohort
+    ``r+1`` so the host-side batching + transfer overlaps device compute
+    instead of serializing in front of every dispatch."""
+
+    def __init__(self, build, enabled: bool = True):
+        self._build = build
+        self._enabled = enabled
+        self._pool = ThreadPoolExecutor(max_workers=1) if enabled else None
+        self._pending = {}
+
+    def get(self, round_idx: int, prefetch=None):
+        fut = self._pending.pop(round_idx, None)
+        staged = fut.result() if fut is not None else self._build(round_idx)
+        if self._enabled and prefetch is not None \
+                and prefetch not in self._pending:
+            self._pending[prefetch] = self._pool.submit(self._build, prefetch)
+        return staged
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+
 def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        mesh: Mesh, gather: bool = False,
-                       sharded_data: bool = False):
+                       sharded_data: bool = False,
+                       update_sharding: str = "replicated",
+                       state_template: ServerState = None,
+                       donate: bool = False):
     """round_fn(state, x|idx, y|·, mask, weights, key, c_clients) with the
-    client axis sharded over the mesh; state replicated.  In gather mode the
-    first data arg is the (C, S, B) index tensor and ``y`` is the
-    device-resident dataset pair (train_x, train_y):
+    client axis sharded over the mesh.  In gather mode the first data arg is
+    the (C, S, B) index tensor and ``y`` is the device-resident dataset pair
+    (train_x, train_y):
 
     - ``sharded_data=False`` — dataset replicated per device; the gather is
       a local ``jnp.take`` inside the shard (fast, HBM cost = |dataset| per
@@ -69,15 +121,27 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
       (resident HBM cost = |dataset|/n_shards per chip); the cohort gather
       runs as a jitted global ``jnp.take`` over the sharded table BEFORE
       ``shard_map``, so XLA inserts the cross-chip collectives and only the
-      cohort (not the dataset) lands on each shard."""
+      cohort (not the dataset) lands on each shard.
+
+    ``update_sharding="scatter"`` selects the reduce-scatter / shard-update /
+    all-gather merge (module docstring); it needs ``state_template`` — a
+    state from ``ServerOptimizer.init_sharded`` — to derive the mixed
+    replicated/sharded specs of the ServerState pytree.  ``donate=True``
+    donates the state argument so XLA reuses the old ServerState buffers
+    in place instead of copying model + optimizer state every round."""
     local_train = trainer.make_local_train()
     alg = server_opt.algorithm
+    n_shards = mesh.shape[CLIENT_AXIS]
+    scatter = update_sharding == "scatter"
+    if scatter and state_template is None:
+        raise ValueError("scatter mode needs a state_template from "
+                         "ServerOptimizer.init_sharded")
     from ..round_engine import make_server_ctx
 
     use_ingather = gather and not sharded_data
 
-    def per_shard(state: ServerState, x, y, mask, w, rngs, c_clients):
-        # shapes here are per-device shards: x (c_local, S, B, ...), w (c_local,)
+    def run_cohort(state: ServerState, x, y, mask, rngs, c_clients):
+        # shapes here are per-device shards: x (c_local, S, B, ...)
         if use_ingather:
             idx, (train_x, train_y) = x, y
             x = jnp.take(train_x, idx, axis=0)
@@ -85,8 +149,19 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
         ctx = make_server_ctx(trainer, state)
         fn = lambda xb, yb, mb, rng, cc: local_train(
             state.global_params, xb, yb, mb, rng, ctx, cc)
-        outs = jax.vmap(fn)(x, y, mask, rngs, c_clients)
+        return jax.vmap(fn)(x, y, mask, rngs, c_clients)
 
+    def shard_metrics(outs, w):
+        wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
+        return {
+            "train_loss": jax.lax.psum(jnp.sum(outs.loss * w),
+                                       CLIENT_AXIS) / wsum,
+            "total_steps": jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS),
+        }
+
+    def per_shard_replicated(state: ServerState, x, y, mask, w, rngs,
+                             c_clients):
+        outs = run_cohort(state, x, y, mask, rngs, c_clients)
         agg = {
             "avg_params": _psum_wavg(outs.params, w, CLIENT_AXIS),
             "n_sampled": jax.lax.psum(
@@ -108,21 +183,88 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             agg["avg_grad"] = _psum_wavg(outs.grad_sum, w, CLIENT_AXIS)
 
         new_state = server_opt.update_from_aggregates(state, agg)
-        wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-        metrics = {
-            "train_loss": jax.lax.psum(jnp.sum(outs.loss * w), CLIENT_AXIS) / wsum,
-            "total_steps": jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS),
-        }
         # only per-client algorithm state leaves the shard (returning
         # outs.params would materialize C × |model| for nothing)
-        return new_state, metrics, outs.new_client_state
+        return new_state, shard_metrics(outs, w), outs.new_client_state
+
+    def per_shard_scatter(state: ServerState, x, y, mask, w, rngs, c_clients):
+        # client-VISIBLE server state (SCAFFOLD's c_server in the corrected
+        # gradient, Mime's momentum in the client step) is shard-resident;
+        # all_gather + unflatten it back to the params structure for the
+        # per-client bodies.  Server-side-only state (FedOpt moments,
+        # FedDyn h) never leaves its shard.
+        ctx_state = state
+        gathered = {}
+        for field in ("c_server", "momentum"):
+            v = getattr(state, field)
+            if v is not None:
+                full = jax.lax.all_gather(v, CLIENT_AXIS, tiled=True)
+                gathered[field] = tree_util.tree_unflatten_1d(
+                    full, state.global_params)
+        if gathered:
+            ctx_state = state.replace(**gathered)
+        outs = run_cohort(ctx_state, x, y, mask, rngs, c_clients)
+        den = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
+
+        def scatter_wavg(stacked, ww, dd):
+            # local client-weighted partial sums per leaf, flattened into
+            # ONE padded vector, then reduce-scattered: each chip receives
+            # only its contiguous 1/n_shards chunk of the cohort-summed
+            # numerator instead of the full all-reduced model
+            num = jax.tree_util.tree_map(
+                lambda l: jnp.tensordot(ww, l.astype(jnp.float32), axes=1),
+                stacked)
+            flat = tree_util.tree_flatten_padded(num, n_shards)
+            return jax.lax.psum_scatter(flat, CLIENT_AXIS,
+                                        scatter_dimension=0, tiled=True) / dd
+
+        agg = {
+            "avg_params": scatter_wavg(outs.params, w, den),
+            "n_sampled": jax.lax.psum(
+                jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
+        }
+        if alg == "scaffold":
+            real = (w > 0).astype(jnp.float32)
+            real_den = jax.lax.psum(jnp.sum(real), CLIENT_AXIS)
+            agg["mean_delta_c"] = scatter_wavg(outs.delta_c, real, real_den)
+        if alg == "fednova":
+            tau = outs.tau
+            deltas = jax.tree_util.tree_map(
+                lambda yi, gx: (gx[None] - yi) / jnp.maximum(
+                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
+                outs.params, state.global_params)
+            agg["nova_d"] = scatter_wavg(deltas, w, den)
+            agg["tau_eff"] = jax.lax.psum(jnp.sum(w * tau), CLIENT_AXIS) / den
+        if alg in ("mime", "fedsgd"):
+            agg["avg_grad"] = scatter_wavg(outs.grad_sum, w, den)
+
+        # this chip's chunk of the current global params, then the sharded
+        # stage-2 transition on 1/n_shards of the model
+        gflat = tree_util.tree_flatten_padded(state.global_params, n_shards)
+        gshard = tree_util.flat_chunk(
+            gflat, jax.lax.axis_index(CLIENT_AXIS), n_shards)
+        new_gshard, new_fields = server_opt.update_shard(state, gshard, agg)
+        # all_gather ONLY the new params for the next round's broadcast;
+        # opt_state/c_server/h/momentum stay shard-resident
+        new_flat = jax.lax.all_gather(new_gshard, CLIENT_AXIS, tiled=True)
+        new_params = tree_util.tree_unflatten_1d(new_flat,
+                                                 state.global_params)
+        new_state = state.replace(round_idx=state.round_idx + 1,
+                                  global_params=new_params, **new_fields)
+        return new_state, shard_metrics(outs, w), outs.new_client_state
 
     shard = P(CLIENT_AXIS)
     data_spec = P() if use_ingather else shard
+    if scatter:
+        state_spec = sharded_state_map(state_template, P(), shard)
+        per_shard = per_shard_scatter
+    else:
+        state_spec = P()
+        per_shard = per_shard_replicated
     sharded = jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(), shard, data_spec, shard, shard, shard, shard),
-        out_specs=(P(), P(), shard),
+        in_specs=(state_spec, shard, data_spec, shard, shard, shard, shard),
+        out_specs=(state_spec, P(), shard),
         check_vma=False,
     )
 
@@ -142,7 +284,7 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                 jnp.take(train_y, idx, axis=0), cohort_spec)
         return sharded(state, x, y, mask, w, rngs, c_clients)
 
-    return jax.jit(round_fn)
+    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
 
 
 class MeshFedAvgAPI(FedAvgAPI):
@@ -150,6 +292,11 @@ class MeshFedAvgAPI(FedAvgAPI):
 
     The accuracy curve is bitwise-comparable to the SP engine under the same
     seed (same per-client keys, same batch schedule) — the §7 exit criterion.
+
+    ``args.update_sharding``: "replicated" | "scatter" | "auto" (default:
+    scatter whenever the mesh has more than one client shard).
+    ``args.async_staging`` (default True): double-buffer the host→device
+    cohort staging so round r+1's transfer overlaps round r's compute.
     """
 
     def __init__(self, args, device, dataset, model, mesh: Mesh = None):
@@ -158,11 +305,28 @@ class MeshFedAvgAPI(FedAvgAPI):
             data=int(getattr(args, "mesh_data", 1)),
             model=int(getattr(args, "mesh_model", 1)),
             seq=int(getattr(args, "mesh_seq", 1)))
-        super().__init__(args, device, dataset, model, client_mode="vmap")
         self.n_shards = self.mesh.shape[CLIENT_AXIS]
+        mode = str(getattr(args, "update_sharding", "auto") or "auto").lower()
+        if mode == "auto":
+            mode = "scatter" if self.n_shards > 1 else "replicated"
+        if mode not in ("replicated", "scatter"):
+            raise ValueError(
+                f"update_sharding must be 'replicated', 'scatter' or "
+                f"'auto', got {mode!r}")
+        self.update_sharding = mode
+        super().__init__(args, device, dataset, model, client_mode="vmap")
         self._data_sharding = NamedSharding(self.mesh, P(CLIENT_AXIS))
         self._repl_sharding = NamedSharding(self.mesh, P())
-        self.state = jax.device_put(self.state, self._repl_sharding)
+        if self.update_sharding == "scatter":
+            # mixed placement: flat aux state sharded over the client axis,
+            # params + round counter (+ scalar optimizer counters) replicated
+            self.state = jax.device_put(self.state, sharded_state_map(
+                self.state, self._repl_sharding, self._data_sharding))
+        else:
+            self.state = jax.device_put(self.state, self._repl_sharding)
+        self._stager = AsyncCohortStager(
+            self._stage_cohort,
+            enabled=bool(getattr(args, "async_staging", True)))
 
     def _build_round_fn(self, client_mode: str):
         # device_data: True/"replicated" | "sharded" | False ("host")
@@ -192,11 +356,22 @@ class MeshFedAvgAPI(FedAvgAPI):
                 self._dev_data = (
                     jax.device_put(jnp.asarray(self.dataset.train_x), repl),
                     jax.device_put(jnp.asarray(self.dataset.train_y), repl))
+        if self.update_sharding == "scatter":
+            # re-init server aux state into its permanent shard-resident
+            # flat layout (FedAvgAPI.__init__ built the replicated one)
+            self.state = self.server_opt.init_sharded(
+                self.state.global_params, self.n_shards)
         return make_mesh_round_fn(self.trainer, self.server_opt, self.mesh,
                                   gather=self._gather,
-                                  sharded_data=self._sharded_data)
+                                  sharded_data=self._sharded_data,
+                                  update_sharding=self.update_sharding,
+                                  state_template=self.state,
+                                  donate=self.DONATE_STATE)
 
-    def train_one_round(self, round_idx: int):
+    def _stage_cohort(self, round_idx: int):
+        """Build + device_put one round's cohort tensors.  Pure function of
+        the round index (sampling and batching are seed-derived), so the
+        stager may run it ahead of time on a worker thread."""
         clients = self._client_sampling(round_idx)
         n = len(clients)
         n_padded = -(-n // self.n_shards) * self.n_shards
@@ -222,18 +397,26 @@ class MeshFedAvgAPI(FedAvgAPI):
                 mask = np.pad(mask, [(0, pad_c), (0, pad_s)])
                 w = np.pad(w, (0, pad_c))
             data_x, data_y = x, y
+        put = lambda a: jax.device_put(jnp.asarray(a), self._data_sharding)
+        dy = data_y if self._gather else put(data_y)
+        return clients, pad_c, put(data_x), dy, put(mask), put(w)
+
+    def train_one_round(self, round_idx: int):
+        nxt = round_idx + 1 if round_idx + 1 < self.comm_rounds else None
+        clients, pad_c, data_x, data_y, mask, w = self._stager.get(
+            round_idx, prefetch=nxt)
+        n = len(clients)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
+        # per-client algorithm state depends on the PREVIOUS round's
+        # scatter-back, so it stages synchronously (never prefetched)
         c_stacked = None
         if self._c_clients is not None:
             zeros = tree_util.tree_zeros_like(self.state.global_params)
             c_stacked = tree_util.tree_stack(
                 [self._c_clients.get(int(c), zeros) for c in clients]
                 + [zeros] * pad_c)
-        put = lambda a: jax.device_put(jnp.asarray(a), self._data_sharding)
-        dy = data_y if self._gather else put(data_y)
         self.state, metrics, new_c = self.round_fn(
-            self.state, put(data_x), dy, put(mask), put(w), key,
-            c_stacked)
+            self.state, data_x, data_y, mask, w, key, c_stacked)
         if self._c_clients is not None:
             self._scatter_c(clients, jax.device_get(
                 jax.tree_util.tree_map(lambda a: a[:n], new_c)))

@@ -168,9 +168,6 @@ def make_bucket_agg_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     def bucket_fn(state: ServerState, x, y, mask, weights, rngs):
         outs: ClientOut = run_clients(state, x, y, mask, rngs, None)
         agg = server_opt.compute_aggregates(state, outs.params, weights, {})
-        # padded rows (weight 0) must not count as sampled clients
-        # (FedDyn's frac = n_sampled / total_clients reads this)
-        agg["n_sampled"] = jnp.sum((weights > 0).astype(jnp.float32))
         total_w = jnp.sum(weights)
         loss_w = jnp.sum(outs.loss * weights)
         return agg, total_w, loss_w, jnp.sum(outs.num_steps)

@@ -53,6 +53,20 @@ def test_bench_llm_lora_restores_flash_mode_env(monkeypatch):
     assert "FEDML_TPU_FLASH_MODE" not in os.environ
 
 
+def test_bench_update_sharding_quick(monkeypatch):
+    """bench.py --agg smoke: the scatter-vs-replicated comparison runs green
+    on the 8-virtual-device mesh and reports both modes' wall-clock (tier-1
+    exercises the scatter path end-to-end through the bench harness)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_AGG_QUICK", "1")
+    out = bench.bench_update_sharding()
+    assert out["quick"] is True
+    assert out["n_shards"] == 8
+    assert out["scatter_s_per_round"] > 0
+    assert out["replicated_s_per_round"] > 0
+    assert out["scatter_speedup"] > 0
+
+
 def test_controller_validates_platform_from_last_json_line(tmp_path):
     """The controller must accept an artifact only when its final JSON
     line self-reports TPU — progress lines before the payload (the serve

@@ -29,6 +29,9 @@ from tpu_watchdog import tpu_alive  # noqa: E402
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 POLL_S = 300
 MAX_ATTEMPTS = 3
+RETRY_BACKOFF_S = 60  # between failed attempts on a LIVE tunnel: a job that
+# crashes deterministically in seconds must not burn all MAX_ATTEMPTS
+# instantly while DEADLINE_S still has hours left
 DEADLINE_S = 8.5 * 3600  # leave the tail of the session for curation
 
 
@@ -110,7 +113,11 @@ def run_once(art, cmd, timeout_s, extra_env, attempt) -> bool:
 
 def main():
     t0 = time.time()
-    attempts = {art: 0 for art, *_ in JOBS}
+    attempts = {art: 0 for art, *_ in JOBS}  # budget counter (refundable)
+    # side-file naming uses a SEPARATE monotonic try counter: a refunded
+    # budget attempt must not reuse its index and overwrite the prior
+    # side file — that file is the evidence the scheme exists to preserve
+    tries = {art: 0 for art, *_ in JOBS}
     pending = list(JOBS)
     while pending and time.time() - t0 < DEADLINE_S:
         art, cmd, timeout_s, extra_env = pending[0]
@@ -120,7 +127,8 @@ def main():
             time.sleep(POLL_S)
             continue
         attempts[art] += 1
-        if run_once(art, cmd, timeout_s, extra_env, attempts[art]):
+        tries[art] += 1
+        if run_once(art, cmd, timeout_s, extra_env, tries[art]):
             pending.pop(0)
         elif not tpu_alive():
             # the tunnel wedged mid-job: that's the environment failing,
@@ -133,6 +141,13 @@ def main():
             print(f"[ctl] {art}: giving up after {attempts[art]} attempts",
                   flush=True)
             pending.pop(0)
+        else:
+            # live tunnel + failed job: back off so a fast-failing job
+            # spreads its remaining attempts over the window instead of
+            # burning them in seconds
+            print(f"[ctl] {art}: attempt {attempts[art]} failed on a live "
+                  f"tunnel; backoff {RETRY_BACKOFF_S}s", flush=True)
+            time.sleep(RETRY_BACKOFF_S)
         # loop re-probes liveness before the next attempt either way
     print(f"[ctl] done; unfinished: {[a for a, *_ in pending]}", flush=True)
 

@@ -1,0 +1,34 @@
+"""Static + runtime analysis for the federated hot paths.
+
+Two complementary auditors, both born from the multi-chip engine in PR 1
+(reduce-scatter merges, shard-resident optimizer state, donated ServerState
+buffers, double-buffered staging) — every one of those patterns fails
+*silently* in JAX when misused:
+
+- :mod:`fedml_tpu.analysis.fedlint` — a pure-stdlib AST pass (no jax import
+  needed to lint) with a rule registry: jit-boundary host syncs, RNG key
+  discipline, collective axis names vs. declared mesh axes, buffer donation
+  hazards, recompilation hazards, pytree iteration order.  Exposed as
+  ``tools/fedlint.py`` and enforced in tier-1 by ``tests/test_fedlint.py``.
+- :mod:`fedml_tpu.analysis.runtime` — a context manager that counts XLA
+  backend compilations and explicit host↔device transfers through jax's
+  monitoring hooks, so tests can pin "the mesh round compiles exactly once".
+"""
+
+from .fedlint import (  # noqa: F401
+    Finding,
+    RULES,
+    analyze_paths,
+    analyze_source,
+    render_findings,
+    findings_to_json,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_paths",
+    "analyze_source",
+    "render_findings",
+    "findings_to_json",
+]

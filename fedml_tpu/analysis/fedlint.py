@@ -1,0 +1,1157 @@
+"""fedlint — JAX-aware static analysis for federated TPU hot paths.
+
+Why a bespoke lint instead of pyflakes/ruff: the failure modes that matter
+at mesh scale are *semantic to JAX*, invisible to generic linters, and only
+surface at trace time on real hardware (or worse, silently corrupt numerics):
+
+- a stray ``float()``/``.item()``/``print`` on a traced value inside a
+  jitted round forces a host sync (or a trace-time crash),
+- a PRNG key consumed twice correlates client sampling streams,
+- a collective whose axis name doesn't match any declared mesh axis dies
+  only when the enclosing ``shard_map`` traces on a real mesh,
+- touching a buffer after it was donated to ``jit`` reads garbage,
+- unhashable static args and Python ``if`` on tracers retrace every call,
+- iterating an unordered dict into ``tree_map`` reorders leaves between
+  processes and breaks multi-host checkpoint/collective agreement.
+
+Design:
+
+- **Pure stdlib.** Only ``ast``/``tokenize``; linting needs no jax install
+  and never executes the target code.
+- **Two passes.** Pass 1 indexes every module: module-level string
+  constants (``CLIENT_AXIS = "client"``), imports, and *declared* mesh axis
+  names (``Mesh(devs, axis_names)``, ``pmap(..., axis_name=...)``,
+  ``shard_map`` kwargs).  Pass 2 runs the rules per module with the
+  package-wide index available for cross-module constant resolution.
+- **Jit-reachability.** Host-side ``float(loss)`` is fine; the same call
+  inside a jitted function is a bug.  A function is considered
+  jit-reachable when it is (a) decorated/wrapped with ``jax.jit`` /
+  ``pmap`` / ``shard_map`` (including ``partial(jax.jit, ...)``), (b)
+  passed by name to one of those or to ``vmap`` / ``lax.scan`` /
+  ``while_loop`` / ``cond`` / ``fori_loop`` / ``grad`` /
+  ``value_and_grad`` / ``checkpoint``, (c) lexically nested inside a
+  reachable function, (d) called by name from a reachable function in the
+  same module, or (e) its own body directly uses trace-only primitives
+  (``jax.lax.*`` collectives/scan, ``jax.vmap``, ``jax.grad``).  This is a
+  lint-grade approximation: factories that return closures jitted in
+  *another* module are covered by (e) in practice.
+
+Suppression: trailing ``# fedlint: disable=rule-a,rule-b`` on the flagged
+line, ``# fedlint: disable-next-line=...`` on the line above, or
+``disable=all``.  Suppressions should carry a reason after an extra ``--``
+comment; ``tests/test_fedlint.py`` keeps the package at zero unsuppressed
+errors.
+
+Adding a rule: subclass nothing — write ``def check_<name>(module, out)``
+appending :class:`Finding`, then register it in :data:`RULES` with a
+severity and a one-line doc.  See ``docs/FEDLINT.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    severity: str
+    doc: str
+
+
+RULES: Dict[str, Rule] = {
+    r.name: r
+    for r in [
+        Rule("jit-host-sync", ERROR,
+             "float()/int()/.item()/np.asarray/print on values inside "
+             "jit-reachable functions force a host sync or trace error"),
+        Rule("rng-key-reuse", ERROR,
+             "a PRNG key consumed more than once (or across loop "
+             "iterations, or PRNGKey built inside a loop) correlates "
+             "random streams"),
+        Rule("collective-axis-check", ERROR,
+             "psum/psum_scatter/all_gather/... axis name must match an "
+             "axis declared by a Mesh/pmap/shard_map in the package"),
+        Rule("donation-after-use", ERROR,
+             "an argument listed in donate_argnums is read after the "
+             "jitted call — its buffer now holds garbage"),
+        Rule("recompile-hazard", WARNING,
+             "jit built inside a loop, unhashable static args, or Python "
+             "if/while on a traced parameter retrace/recompile every call"),
+        Rule("pytree-order", WARNING,
+             "iterating an unordered dict into tree_map/flatten/stack "
+             "makes leaf order process-dependent"),
+    ]
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def key(self):
+        return (self.path, self.line, self.rule)
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+# --------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for Attribute chains, 'psum' for Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_attr(node: ast.AST) -> Optional[str]:
+    d = dotted_name(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+
+def enclosing(node: ast.AST, parents, kinds) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def func_name(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+# names that wrap a function into a jit-reachable one when it is the first
+# positional argument (or the wrapped partial's first argument)
+_JIT_WRAPPERS = {"jit", "pmap", "shard_map", "xmap", "pjit"}
+_TRACE_WRAPPERS = _JIT_WRAPPERS | {
+    "vmap", "scan", "while_loop", "fori_loop", "cond", "switch", "grad",
+    "value_and_grad", "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "associative_scan",
+}
+# primitives whose presence in a function BODY marks it as traced code
+_TRACE_MARKERS = {
+    "scan", "while_loop", "fori_loop", "cond", "switch", "psum", "pmean",
+    "pmax", "pmin", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "pshuffle", "axis_index", "axis_size", "vmap", "grad", "value_and_grad",
+    "stop_gradient", "dynamic_slice", "dynamic_update_slice", "select",
+    "associative_scan",
+}
+
+_COLLECTIVES_AXIS_POS = {
+    # call -> positional index of the axis-name argument
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+_HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_NP = {"asarray", "array", "copy", "save", "savez", "allclose",
+                 "array_equal", "asnumpy"}
+_HOST_SYNC_ATTRS = {"item", "tolist", "to_py"}
+
+_RNG_DERIVERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data",
+                 "key_impl"}
+# this repo's own key-derivation helpers (core/rng.py)
+_RNG_LOCAL_PRODUCERS = {"root_key", "round_key", "client_key", "purpose_key"}
+
+_TREE_CONSUMERS = {"tree_map", "tree_multimap", "tree_flatten",
+                   "tree_leaves", "tree_stack", "tree_unflatten",
+                   "weighted_average", "stacked_weighted_average",
+                   "tree_all", "tree_reduce"}
+
+_STATIC_ANNOTATIONS = {"str", "bool", "int", "float"}
+
+
+# --------------------------------------------------------------------------
+# pass 1 — per-module index
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ModuleIndex:
+    path: str
+    tree: ast.AST
+    lines: List[str]
+    constants: Dict[str, object]           # module-level NAME -> str|tuple
+    imports: Dict[str, str]                # local name -> source module
+    declared_axes: Set[str]                # axis names declared HERE
+
+
+def _const_value(node: ast.AST, constants: Dict[str, object]):
+    """Resolve a literal/Name/tuple to python values using module consts."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_const_value(e, constants) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)
+    return None
+
+
+def index_module(path: str, source: str) -> Optional[ModuleIndex]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    constants: Dict[str, object] = {}
+    imports: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            val = _const_value(node.value, constants)
+            if isinstance(val, (str, tuple)):
+                constants[node.targets[0].id] = val
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                imports[alias.asname or alias.name] = node.module
+
+    declared: Set[str] = set()
+
+    def note_axes(val):
+        if isinstance(val, str):
+            declared.add(val)
+        elif isinstance(val, tuple):
+            for v in val:
+                note_axes(v)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = last_attr(node.func)
+        if fn == "Mesh":
+            if len(node.args) >= 2:
+                note_axes(_const_value(node.args[1], constants))
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    note_axes(_const_value(kw.value, constants))
+        if fn in ("pmap", "shard_map", "xmap", "vmap", "make_mesh",
+                  "Mesh", "AbstractMesh"):
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    note_axes(_const_value(kw.value, constants))
+    return ModuleIndex(path=path, tree=tree, lines=source.splitlines(),
+                       constants=constants, imports=imports,
+                       declared_axes=declared)
+
+
+@dataclasses.dataclass
+class PackageIndex:
+    """Cross-module context: every declared axis name and every module-level
+    string constant in the analyzed file set, keyed by bare name (imports in
+    this package re-export constants under their defining name)."""
+    axes: Set[str]
+    constants: Dict[str, object]
+
+    @classmethod
+    def build(cls, modules: Iterable[ModuleIndex]) -> "PackageIndex":
+        axes: Set[str] = set()
+        constants: Dict[str, object] = {}
+        for m in modules:
+            axes |= m.declared_axes
+            for k, v in m.constants.items():
+                constants.setdefault(k, v)
+        return cls(axes=axes, constants=constants)
+
+
+# --------------------------------------------------------------------------
+# jit-reachability
+# --------------------------------------------------------------------------
+
+class Reachability:
+    def __init__(self, mod: ModuleIndex, parents):
+        self.parents = parents
+        self.funcs: List[ast.AST] = [
+            n for n in ast.walk(mod.tree) if isinstance(n, FUNC_NODES)]
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        for f in self.funcs:
+            if not isinstance(f, ast.Lambda):
+                self.by_name.setdefault(f.name, []).append(f)
+        self.aliases: Dict[str, Set[str]] = {}   # name -> names of defs
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Name):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.aliases.setdefault(t.id, set()).add(
+                            node.value.id)
+        self.reachable: Set[ast.AST] = set()
+        self._seed(mod)
+        self._close()
+
+    def _defs_for(self, name: str, seen=None) -> List[ast.AST]:
+        seen = seen or set()
+        if name in seen:
+            return []
+        seen.add(name)
+        out = list(self.by_name.get(name, []))
+        for alias in self.aliases.get(name, ()):
+            out.extend(self._defs_for(alias, seen))
+        return out
+
+    def _wrapped_fn_names(self, call: ast.Call) -> List[ast.AST]:
+        """Defs referenced by the wrapped-function argument of a call."""
+        out: List[ast.AST] = []
+        args = list(call.args)
+        # cond/switch pass branch callables at positions 1..n
+        fn_attr = last_attr(call.func)
+        cand = args[:1] if fn_attr not in ("cond", "switch") else args[1:]
+        for a in cand:
+            if isinstance(a, ast.Name):
+                out.extend(self._defs_for(a.id))
+            elif isinstance(a, ast.Lambda):
+                out.append(a)
+            elif isinstance(a, ast.Call) and \
+                    last_attr(a.func) == "partial" and a.args:
+                inner = a.args[0]
+                if isinstance(inner, ast.Name):
+                    out.extend(self._defs_for(inner.id))
+                elif isinstance(inner, ast.Lambda):
+                    out.append(inner)
+        return out
+
+    def _is_jit_decorator(self, dec: ast.AST) -> bool:
+        name = last_attr(dec if not isinstance(dec, ast.Call) else dec.func)
+        if name in _JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call) and name == "partial" and dec.args:
+            return last_attr(dec.args[0]) in _JIT_WRAPPERS
+        return False
+
+    def _seed(self, mod: ModuleIndex):
+        for f in self.funcs:
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._is_jit_decorator(d) for d in f.decorator_list):
+                    self.reachable.add(f)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = last_attr(node.func)
+            if name in _TRACE_WRAPPERS:
+                for f in self._wrapped_fn_names(node):
+                    self.reachable.add(f)
+        # marker pass: a body that itself calls trace-only primitives
+        for f in self.funcs:
+            if f in self.reachable:
+                continue
+            for node in self._own_body_walk(f):
+                if isinstance(node, ast.Call) and \
+                        last_attr(node.func) in _TRACE_MARKERS:
+                    d = dotted_name(node.func) or ""
+                    if d.startswith(("jax.", "lax.")) or "." not in d:
+                        self.reachable.add(f)
+                        break
+
+    def _own_body_walk(self, fn: ast.AST):
+        """Walk a function's body WITHOUT descending into nested defs."""
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        stack = list(body) if isinstance(body, list) else [body]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, FUNC_NODES):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _close(self):
+        changed = True
+        while changed:
+            changed = False
+            for f in list(self.reachable):
+                # lexically nested defs trace with their parent
+                for node in ast.walk(f):
+                    if node is f or not isinstance(node, FUNC_NODES):
+                        continue
+                    if node not in self.reachable:
+                        self.reachable.add(node)
+                        changed = True
+                # calls by name from a traced body trace too
+                for node in self._own_body_walk(f):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name):
+                        for d in self._defs_for(node.func.id):
+                            if d not in self.reachable:
+                                self.reachable.add(d)
+                                changed = True
+
+    def innermost_fn(self, node: ast.AST) -> Optional[ast.AST]:
+        return enclosing(node, self.parents, FUNC_NODES)
+
+    def in_reachable(self, node: ast.AST) -> bool:
+        fn = self.innermost_fn(node)
+        return fn is not None and fn in self.reachable
+
+
+# --------------------------------------------------------------------------
+# module view shared by the rules
+# --------------------------------------------------------------------------
+
+class ModuleView:
+    def __init__(self, mod: ModuleIndex, pkg: PackageIndex):
+        self.mod = mod
+        self.pkg = pkg
+        self.parents = build_parents(mod.tree)
+        self.reach = Reachability(mod, self.parents)
+
+    def resolve_str(self, node: ast.AST):
+        """Resolve an axis-name expression to str / tuple-of-str / None."""
+        v = _const_value(node, self.mod.constants)
+        if v is None and isinstance(node, ast.Name):
+            v = self.pkg.constants.get(node.id)
+        return v
+
+
+# --------------------------------------------------------------------------
+# rule: jit-host-sync
+# --------------------------------------------------------------------------
+
+def _is_staticish(node: ast.AST) -> bool:
+    """Expressions that are static under tracing: literals, shape/dtype
+    attribute chains, len() of those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in (
+            "shape", "ndim", "dtype", "size"):
+        return True
+    if isinstance(node, ast.Subscript):
+        return _is_staticish(node.value)
+    if isinstance(node, ast.Call):
+        f = last_attr(node.func)
+        if f in ("len", "getattr", "prod"):
+            return True
+    if isinstance(node, ast.BinOp):
+        return _is_staticish(node.left) and _is_staticish(node.right)
+    return False
+
+
+def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not mv.reach.in_reachable(node):
+            continue
+        fn = node.func
+        msg = None
+        if isinstance(fn, ast.Name) and fn.id in _HOST_SYNC_BUILTINS:
+            if len(node.args) == 1 and not _is_staticish(node.args[0]):
+                msg = (f"{fn.id}() on a (possibly traced) value inside "
+                       "jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}' forces "
+                       "a host sync / trace error")
+        elif isinstance(fn, ast.Name) and fn.id in ("print", "breakpoint"):
+            msg = (f"{fn.id}() inside jit-reachable "
+                   f"'{func_name(mv.reach.innermost_fn(node))}' — use "
+                   "jax.debug.print/breakpoint")
+        elif isinstance(fn, ast.Attribute):
+            d = dotted_name(fn) or ""
+            if d.startswith(("np.", "numpy.")) and \
+                    fn.attr in _HOST_SYNC_NP and node.args and \
+                    not _is_staticish(node.args[0]):
+                msg = (f"{d}() materializes its argument on host inside "
+                       f"jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}'")
+            elif fn.attr in _HOST_SYNC_ATTRS and not node.args:
+                msg = (f".{fn.attr}() inside jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}' blocks "
+                       "on device and breaks under tracing")
+            elif d == "jax.device_get":
+                msg = ("jax.device_get inside a jit-reachable function "
+                       "forces a device→host transfer")
+        if msg:
+            out.append(Finding("jit-host-sync", RULES["jit-host-sync"]
+                               .severity, mv.mod.path, node.lineno,
+                               node.col_offset, msg))
+
+
+# --------------------------------------------------------------------------
+# rule: rng-key-reuse
+# --------------------------------------------------------------------------
+
+def _stmt_assigned_names(stmt: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+    return names
+
+
+def _is_rng_producer(call: ast.Call) -> bool:
+    f = last_attr(call.func)
+    if f in ("PRNGKey", "key", "fold_in"):
+        d = dotted_name(call.func) or f
+        return "random" in d or f in ("PRNGKey", "fold_in")
+    return f in _RNG_LOCAL_PRODUCERS
+
+
+def _rng_uses_in(call: ast.Call, key: str) -> Optional[str]:
+    """Classify how `call` uses name `key`: 'sample'|'derive'|'opaque'|None.
+    Only first-arg / key= positions count for jax.random calls."""
+    d = dotted_name(call.func) or ""
+    f = last_attr(call.func)
+    argexprs = list(call.args) + [kw.value for kw in call.keywords]
+    used = any(isinstance(a, ast.Name) and a.id == key for a in argexprs)
+    if not used:
+        return None
+    if "random" in d or f in _RNG_DERIVERS | _RNG_LOCAL_PRODUCERS:
+        return "derive" if f in _RNG_DERIVERS | _RNG_LOCAL_PRODUCERS \
+            else "sample"
+    return "opaque"
+
+
+def check_rng_key_reuse(mv: ModuleView, out: List[Finding]):
+    sev = RULES["rng-key-reuse"].severity
+
+    # (b) PRNGKey(...) built inside a loop body
+    for node in ast.walk(mv.mod.tree):
+        if isinstance(node, ast.Call) and \
+                last_attr(node.func) in ("PRNGKey", "key") and \
+                "random" in (dotted_name(node.func) or ""):
+            loop = enclosing(node, mv.parents, LOOP_NODES)
+            if loop is not None:
+                const = node.args and isinstance(node.args[0], ast.Constant)
+                out.append(Finding(
+                    "rng-key-reuse", sev, mv.mod.path, node.lineno,
+                    node.col_offset,
+                    "PRNGKey constructed inside a loop "
+                    + ("with a constant seed — every iteration gets the "
+                       "SAME stream" if const else
+                       "— derive per-iteration keys with fold_in/split "
+                       "from one root key")))
+
+    # (a)/(c) linear def-use scan per function body
+    for fn in mv.reach.funcs:
+        if isinstance(fn, ast.Lambda):
+            continue
+        events: List[Tuple[int, str, str, ast.AST]] = []
+        # (line, kind, name, node): kind in assign|sample|derive|opaque
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, FUNC_NODES) and stmt is not fn:
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.For, ast.AsyncFor)):
+                val = getattr(stmt, "value", None) or getattr(
+                    stmt, "iter", None)
+                produced = isinstance(val, ast.Call) and (
+                    _is_rng_producer(val) or
+                    last_attr(val.func) in _RNG_DERIVERS)
+                for name in _stmt_assigned_names(stmt):
+                    events.append((stmt.lineno,
+                                   "assign_key" if produced else "assign",
+                                   name, stmt))
+        key_names = {n for (_, k, n, _) in events if k == "assign_key"}
+        if not key_names:
+            continue
+        def innermost_nonlambda(node):
+            cur = enclosing(node, mv.parents, FUNC_NODES)
+            while isinstance(cur, ast.Lambda):
+                cur = enclosing(cur, mv.parents, FUNC_NODES)
+            return cur
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                if innermost_nonlambda(node) is not fn:
+                    continue
+                for key in key_names:
+                    use = _rng_uses_in(node, key)
+                    if use:
+                        events.append((node.lineno, use, key, node))
+        events.sort(key=lambda e: e[0])
+        state: Dict[str, List[Tuple[int, str, ast.AST]]] = {}
+        for line, kind, name, node in events:
+            if name not in key_names:
+                continue
+            if kind.startswith("assign"):
+                state[name] = []
+                continue
+            uses = state.setdefault(name, [])
+            uses.append((line, kind, node))
+            samples = [u for u in uses if u[1] == "sample"]
+            total = [u for u in uses if u[1] in ("sample", "opaque")]
+            if len(total) >= 2 and len(samples) >= 1:
+                out.append(Finding(
+                    "rng-key-reuse", sev, mv.mod.path, line,
+                    node.col_offset,
+                    f"key '{name}' consumed again without an intervening "
+                    f"split/fold_in (first use line {total[0][0]}) — "
+                    "reused streams correlate"))
+                state[name] = []  # report once per reuse site
+
+        # cross-iteration: sample inside a loop, key bound outside it
+        assigns = {}
+        for line, kind, name, node in events:
+            if kind.startswith("assign"):
+                assigns.setdefault(name, []).append((line, node))
+        for line, kind, name, node in events:
+            if kind != "sample":
+                continue
+            loop = enclosing(node, mv.parents, LOOP_NODES)
+            if loop is None or enclosing(
+                    loop, mv.parents, FUNC_NODES) is not fn:
+                continue
+            rebound = any(
+                loop.lineno <= aline <= max(
+                    getattr(loop, "end_lineno", aline), aline)
+                for aline, _ in assigns.get(name, []))
+            if not rebound:
+                out.append(Finding(
+                    "rng-key-reuse", sev, mv.mod.path, line,
+                    node.col_offset,
+                    f"key '{name}' sampled inside a loop but never "
+                    "re-split per iteration — every pass reuses the "
+                    "same stream"))
+
+
+# --------------------------------------------------------------------------
+# rule: collective-axis-check
+# --------------------------------------------------------------------------
+
+def check_collective_axis(mv: ModuleView, out: List[Finding]):
+    sev = RULES["collective-axis-check"].severity
+    declared = mv.pkg.axes | mv.mod.declared_axes
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = last_attr(node.func)
+        if f not in _COLLECTIVES_AXIS_POS:
+            continue
+        d = dotted_name(node.func) or ""
+        if not (d.startswith(("jax.lax.", "lax.")) or d == f):
+            continue
+        pos = _COLLECTIVES_AXIS_POS[f]
+        axis_expr = None
+        if len(node.args) > pos:
+            axis_expr = node.args[pos]
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_expr = kw.value
+        if axis_expr is None:
+            continue
+        val = mv.resolve_str(axis_expr)
+        if val is None:
+            continue  # parameter/dynamic — can't prove, don't guess
+        names = val if isinstance(val, tuple) else (val,)
+        for name in names:
+            if isinstance(name, str) and name not in declared:
+                out.append(Finding(
+                    "collective-axis-check", sev, mv.mod.path,
+                    node.lineno, node.col_offset,
+                    f"{f}(axis {name!r}) does not match any declared "
+                    f"mesh/pmap axis "
+                    f"({', '.join(sorted(declared)) or 'none declared'})"))
+
+
+# --------------------------------------------------------------------------
+# rule: donation-after-use  (+ static-arg tracking for recompile-hazard)
+# --------------------------------------------------------------------------
+
+def _int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _collect_jit_bindings(mv: ModuleView):
+    """Map binding ('name'|'attr', identifier) -> info about the jit call:
+    donate positions/names, static positions/names."""
+    bindings: Dict[Tuple[str, str], dict] = {}
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call) or \
+                last_attr(call.func) not in _JIT_WRAPPERS:
+            continue
+        info = {"donate_nums": (), "donate_names": (),
+                "static_nums": (), "static_names": (), "node": call}
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                info["donate_nums"] = _int_tuple(kw.value) or ()
+            elif kw.arg == "donate_argnames":
+                info["donate_names"] = _str_tuple(kw.value) or ()
+            elif kw.arg == "static_argnums":
+                info["static_nums"] = _int_tuple(kw.value) or ()
+            elif kw.arg == "static_argnames":
+                info["static_names"] = _str_tuple(kw.value) or ()
+        if not any(info[k] for k in ("donate_nums", "donate_names",
+                                     "static_nums", "static_names")):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                bindings[("name", t.id)] = info
+            elif isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                bindings[("attr", t.attr)] = info
+    return bindings
+
+
+def _call_binding(call: ast.Call, bindings):
+    if isinstance(call.func, ast.Name):
+        return bindings.get(("name", call.func.id))
+    if isinstance(call.func, ast.Attribute) and \
+            isinstance(call.func.value, ast.Name) and \
+            call.func.value.id == "self":
+        return bindings.get(("attr", call.func.attr))
+    return None
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable dotted string for Name / self.attr chains."""
+    return dotted_name(node)
+
+
+def check_donation_after_use(mv: ModuleView, out: List[Finding]):
+    sev = RULES["donation-after-use"].severity
+    bindings = _collect_jit_bindings(mv)
+    if not bindings:
+        return
+    for fn in mv.reach.funcs:
+        if isinstance(fn, ast.Lambda):
+            continue
+        body = list(ast.walk(fn))
+        calls = [n for n in body if isinstance(n, ast.Call)
+                 and _call_binding(n, bindings)]
+        for call in calls:
+            info = _call_binding(call, bindings)
+            donated: List[str] = []
+            for p in info["donate_nums"]:
+                if p < len(call.args):
+                    k = _expr_key(call.args[p])
+                    if k:
+                        donated.append(k)
+            for nm in info["donate_names"]:
+                for kw in call.keywords:
+                    if kw.arg == nm:
+                        k = _expr_key(kw.value)
+                        if k:
+                            donated.append(k)
+            if not donated:
+                continue
+            # the statement holding this call; rebinding in the SAME
+            # statement (x = f(x)) is the sanctioned idiom
+            stmt = call
+            while not isinstance(stmt, ast.stmt) and \
+                    mv.parents.get(stmt) is not None:
+                stmt = mv.parents[stmt]
+            rebound_here: Set[str] = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for sub in ast.walk(t):
+                        k = _expr_key(sub)
+                        if k:
+                            rebound_here.add(k)
+            stmt_end = getattr(stmt, "end_lineno", call.lineno)
+            for key in donated:
+                if key in rebound_here:
+                    continue
+                # scan statements after the call STATEMENT for a read of
+                # key (multi-line call args are part of the call itself)
+                for node in body:
+                    if not isinstance(node, (ast.Name, ast.Attribute)):
+                        continue
+                    if node.lineno <= stmt_end:
+                        continue
+                    if _expr_key(node) != key:
+                        continue
+                    if not isinstance(getattr(node, "ctx", None), ast.Load):
+                        continue
+                    # stop at a rebind between call and use
+                    rebind = False
+                    for st in ast.walk(fn):
+                        if isinstance(st, (ast.Assign, ast.AugAssign)) and \
+                                call.lineno < st.lineno < node.lineno:
+                            tgts = st.targets if isinstance(
+                                st, ast.Assign) else [st.target]
+                            for t in tgts:
+                                if _expr_key(t) == key:
+                                    rebind = True
+                    if not rebind:
+                        out.append(Finding(
+                            "donation-after-use", sev, mv.mod.path,
+                            node.lineno, node.col_offset,
+                            f"'{key}' was donated to the jitted call on "
+                            f"line {call.lineno} (donate_argnums) — its "
+                            "buffer is dead after that call"))
+                        break
+                # call inside a loop without rebinding key in the loop
+                loop = enclosing(call, mv.parents, LOOP_NODES)
+                if loop is not None and key not in rebound_here:
+                    rebound_in_loop = False
+                    for st in ast.walk(loop):
+                        if isinstance(st, ast.Assign):
+                            for t in st.targets:
+                                for sub in ast.walk(t):
+                                    if _expr_key(sub) == key:
+                                        rebound_in_loop = True
+                    if not rebound_in_loop:
+                        out.append(Finding(
+                            "donation-after-use", sev, mv.mod.path,
+                            call.lineno, call.col_offset,
+                            f"'{key}' is donated inside a loop but never "
+                            "rebound — iteration 2 passes a dead buffer"))
+
+
+# --------------------------------------------------------------------------
+# rule: recompile-hazard
+# --------------------------------------------------------------------------
+
+def check_recompile_hazard(mv: ModuleView, out: List[Finding]):
+    sev = RULES["recompile-hazard"].severity
+    bindings = _collect_jit_bindings(mv)
+
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = last_attr(node.func)
+        # (s1) jit/shard_map/pmap constructed inside a loop
+        if f in _JIT_WRAPPERS:
+            loop = enclosing(node, mv.parents, LOOP_NODES)
+            if loop is not None:
+                out.append(Finding(
+                    "recompile-hazard", sev, mv.mod.path, node.lineno,
+                    node.col_offset,
+                    f"{f}() constructed inside a loop — every iteration "
+                    "builds (and compiles) a fresh callable; hoist it"))
+            # fresh lambda jitted at call depth inside a function that is
+            # itself re-invoked is caught by (s1); module level is fine
+        # (s2) unhashable literal passed at a static position
+        info = _call_binding(node, bindings)
+        if info:
+            def unhashable(a):
+                return isinstance(a, (ast.Dict, ast.List, ast.Set,
+                                      ast.Lambda, ast.JoinedStr,
+                                      ast.ListComp, ast.DictComp,
+                                      ast.SetComp))
+            for p in info["static_nums"]:
+                if p < len(node.args) and unhashable(node.args[p]):
+                    out.append(Finding(
+                        "recompile-hazard", sev, mv.mod.path,
+                        node.args[p].lineno, node.args[p].col_offset,
+                        f"unhashable/freshly-built object at static arg "
+                        f"position {p} — every call is a new cache entry "
+                        "(or a TypeError)"))
+            for nm in info["static_names"]:
+                for kw in node.keywords:
+                    if kw.arg == nm and unhashable(kw.value):
+                        out.append(Finding(
+                            "recompile-hazard", sev, mv.mod.path,
+                            kw.value.lineno, kw.value.col_offset,
+                            f"unhashable/freshly-built object for static "
+                            f"arg {nm!r} — every call recompiles"))
+
+    # (s3) Python if/while on a bare traced parameter
+    for fn in mv.reach.funcs:
+        if fn not in mv.reach.reachable or isinstance(fn, ast.Lambda):
+            continue
+        static_params: Set[str] = set()
+        dyn_params: Set[str] = set()
+        args = fn.args
+        all_args = (args.posonlyargs + args.args + args.kwonlyargs)
+        defaults = list(args.defaults)
+        # map trailing defaults to their params
+        defaulted = {a.arg for a in args.args[len(args.args)
+                                             - len(defaults):]}
+        for a in all_args:
+            ann = getattr(a.annotation, "id", None) or \
+                last_attr(a.annotation) if a.annotation else None
+            if ann in _STATIC_ANNOTATIONS or ann in ("Mesh", "Callable"):
+                static_params.add(a.arg)
+            elif a.arg in defaulted:
+                static_params.add(a.arg)   # bool/str default idiom
+            elif a.arg not in ("self", "cls"):
+                dyn_params.add(a.arg)
+        for node in ast.walk(fn):
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            if test is None:
+                continue
+            if enclosing(node, mv.parents, FUNC_NODES) is not fn:
+                continue
+            for sub in ast.walk(test):
+                if not (isinstance(sub, ast.Name)
+                        and sub.id in dyn_params):
+                    continue
+                # climb to the test root: static-attribute access,
+                # is/is-not comparisons and shape-ish calls are all fine
+                exempt = False
+                cur = sub
+                while cur is not test and cur is not None:
+                    parent = mv.parents.get(cur)
+                    if isinstance(parent, ast.Attribute) and parent.attr \
+                            in ("shape", "ndim", "dtype", "size"):
+                        exempt = True
+                        break
+                    if isinstance(parent, ast.Compare) and all(
+                            isinstance(op, (ast.Is, ast.IsNot))
+                            for op in parent.ops):
+                        exempt = True
+                        break
+                    if isinstance(parent, ast.Call) and last_attr(
+                            parent.func) in ("len", "isinstance", "getattr",
+                                             "hasattr", "callable"):
+                        exempt = True
+                        break
+                    cur = parent
+                if exempt:
+                    continue
+                out.append(Finding(
+                    "recompile-hazard", sev, mv.mod.path, sub.lineno,
+                    sub.col_offset,
+                    f"Python branch on parameter '{sub.id}' of "
+                    f"jit-reachable '{func_name(fn)}' — a tracer here "
+                    "raises at trace time; use lax.cond/jnp.where"))
+                break
+
+
+# --------------------------------------------------------------------------
+# rule: pytree-order
+# --------------------------------------------------------------------------
+
+def _dict_iteration(node: ast.AST) -> Optional[str]:
+    """Return a description if `node` iterates dict views unsorted."""
+    gens = []
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+        gens = node.generators
+    elif isinstance(node, ast.DictComp):
+        gens = node.generators
+    for g in gens:
+        it = g.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) \
+                and it.func.attr in ("items", "keys", "values") \
+                and not it.args:
+            return f".{it.func.attr}()"
+    if isinstance(node, ast.Call) and last_attr(node.func) == "list" and \
+            node.args and isinstance(node.args[0], ast.Call) and \
+            isinstance(node.args[0].func, ast.Attribute) and \
+            node.args[0].func.attr in ("items", "keys", "values"):
+        return f".{node.args[0].func.attr}()"
+    return None
+
+
+def check_pytree_order(mv: ModuleView, out: List[Finding]):
+    sev = RULES["pytree-order"].severity
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = last_attr(node.func)
+        if f not in _TREE_CONSUMERS:
+            continue
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.Starred):
+                a = a.value
+            desc = _dict_iteration(a)
+            if desc:
+                out.append(Finding(
+                    "pytree-order", sev, mv.mod.path, a.lineno,
+                    a.col_offset,
+                    f"{f}() fed by unsorted dict {desc} iteration — leaf "
+                    "order is insertion-dependent and breaks cross-host "
+                    "agreement; iterate sorted(...)"))
+
+
+# --------------------------------------------------------------------------
+# suppression + driver
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedlint:\s*(disable|disable-next-line)\s*=\s*"
+    r"([A-Za-z0-9_,\-]+|all)")
+
+
+def _suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    supp: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        which, rules = m.groups()
+        names = {r.strip() for r in rules.split(",") if r.strip()}
+        target = i + 1 if which == "disable-next-line" else i
+        supp.setdefault(target, set()).update(names)
+    return supp
+
+
+ALL_CHECKS = [
+    check_jit_host_sync,
+    check_rng_key_reuse,
+    check_collective_axis,
+    check_donation_after_use,
+    check_recompile_hazard,
+    check_pytree_order,
+]
+
+
+def analyze_module(mod: ModuleIndex, pkg: PackageIndex,
+                   rules: Optional[Set[str]] = None) -> List[Finding]:
+    mv = ModuleView(mod, pkg)
+    raw: List[Finding] = []
+    for check in ALL_CHECKS:
+        check(mv, raw)
+    if rules is not None:
+        raw = [f for f in raw if f.rule in rules]
+    supp = _suppressions(mod.lines)
+    seen = set()
+    out = []
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        marked = supp.get(f.line, set())
+        if "all" in marked or f.rule in marked:
+            f.suppressed = True
+        out.append(f)
+    return out
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            files.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+    return files
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Set[str]] = None,
+                  severity_overrides: Optional[Dict[str, str]] = None
+                  ) -> List[Finding]:
+    """Lint every .py under `paths`. Two passes: package index, then rules."""
+    files = iter_py_files(paths)
+    modules: List[ModuleIndex] = []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        mod = index_module(path, src)
+        if mod is not None:
+            modules.append(mod)
+    pkg = PackageIndex.build(modules)
+    findings: List[Finding] = []
+    for mod in modules:
+        findings.extend(analyze_module(mod, pkg, rules))
+    if severity_overrides:
+        for f in findings:
+            if f.rule in severity_overrides:
+                f.severity = severity_overrides[f.rule]
+    return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   extra_axes: Iterable[str] = (),
+                   rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Single-source entry point (fixture tests use this)."""
+    mod = index_module(path, source)
+    if mod is None:
+        raise SyntaxError(f"cannot parse {path}")
+    pkg = PackageIndex.build([mod])
+    pkg.axes |= set(extra_axes)
+    return analyze_module(mod, pkg, rules)
+
+
+def render_findings(findings: Sequence[Finding],
+                    show_suppressed: bool = False) -> str:
+    lines = []
+    for f in findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = " (suppressed)" if f.suppressed else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: "
+                     f"[{f.severity}] {f.rule}: {f.message}{tag}")
+    active = [f for f in findings if not f.suppressed]
+    errs = sum(1 for f in active if f.severity == ERROR)
+    warns = sum(1 for f in active if f.severity == WARNING)
+    sup = sum(1 for f in findings if f.suppressed)
+    lines.append(f"fedlint: {errs} error(s), {warns} warning(s), "
+                 f"{sup} suppressed")
+    return "\n".join(lines)
+
+
+def findings_to_json(findings: Sequence[Finding]) -> str:
+    return json.dumps([dataclasses.asdict(f) for f in findings], indent=2)
+
+
+def exit_code(findings: Sequence[Finding], strict: bool = False) -> int:
+    active = [f for f in findings if not f.suppressed]
+    if any(f.severity == ERROR for f in active):
+        return 1
+    if strict and active:
+        return 1
+    return 0

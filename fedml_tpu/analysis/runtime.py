@@ -1,0 +1,112 @@
+"""Runtime auditor — counts XLA compilations and explicit host↔device
+transfers inside a scope.
+
+fedlint (the static half of :mod:`fedml_tpu.analysis`) proves properties of
+the *source*; this context manager checks the property that actually costs
+wall-clock at mesh scale: **a steady-state federated round must not
+compile**.  A recompile per round means a shape leak (unpadded cohort, a
+Python scalar that should be a traced array, a fresh closure handed to
+``jax.jit``) and turns a 0.2 s round into a 20 s one on a real TPU — the
+exact regression class PR 1's pow2 step padding exists to prevent.
+
+Compilations are observed through jax's monitoring hooks
+(``/jax/core/compile/backend_compile_duration`` fires once per XLA backend
+compile, cache misses only).  Explicit transfers are counted by wrapping
+``jax.device_put`` / ``jax.device_get`` for the duration of the scope —
+implicit syncs (``float(arr)``, ``np.asarray(arr)``) go through the C++
+array path and are *not* observable here; fedlint's ``jit-host-sync`` rule
+covers those statically.
+
+Usage::
+
+    with JaxRuntimeAudit() as audit:
+        api.train_one_round(2)
+        api.train_one_round(3)
+    assert audit.compilations == 0, audit.compiled
+
+``tests/test_mesh.py::test_mesh_round_compiles_once`` pins the mesh engine
+to exactly this contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class JaxRuntimeAudit:
+    """Counts backend compiles + explicit transfers within a ``with`` scope.
+
+    Attributes after (or during) the scope:
+
+    - ``compilations`` — number of XLA backend compiles observed.
+    - ``compiled`` — the event names seen (one entry per compile; jax's
+      duration events don't carry the function name in this version, so
+      entries are the event key — the *count* is the contract).
+    - ``device_puts`` / ``device_gets`` — explicit transfer calls.
+
+    Listener de-registration uses the supported private helper when
+    present; otherwise the listener stays registered but inert (guarded by
+    ``self._active``), which is safe for test processes.
+    """
+
+    def __init__(self):
+        self.compilations = 0
+        self.compiled: List[str] = []
+        self.device_puts = 0
+        self.device_gets = 0
+        self._active = False
+        self._lock = threading.Lock()
+        self._orig_put = None
+        self._orig_get = None
+
+    # -- monitoring hook ---------------------------------------------------
+    def _on_event_duration(self, event: str, duration: float, **kw) -> None:
+        if not self._active or event != _BACKEND_COMPILE_EVENT:
+            return
+        with self._lock:
+            self.compilations += 1
+            self.compiled.append(event)
+
+    def __enter__(self) -> "JaxRuntimeAudit":
+        jax.monitoring.register_event_duration_secs_listener(
+            self._on_event_duration)
+        self._active = True
+
+        audit = self
+        self._orig_put, self._orig_get = jax.device_put, jax.device_get
+
+        def counted_put(*a, **kw):
+            with audit._lock:
+                audit.device_puts += 1
+            return audit._orig_put(*a, **kw)
+
+        def counted_get(*a, **kw):
+            with audit._lock:
+                audit.device_gets += 1
+            return audit._orig_get(*a, **kw)
+
+        jax.device_put, jax.device_get = counted_put, counted_get
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self._active = False
+        jax.device_put, jax.device_get = self._orig_put, self._orig_get
+        try:  # best-effort unregister (private API, version-guarded)
+            from jax._src import monitoring as _mon
+            _mon._unregister_event_duration_listener_by_callback(
+                self._on_event_duration)
+        except Exception:
+            pass
+        return None
+
+
+def count_compilations(fn, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)``; return ``(result, n_compilations)``."""
+    with JaxRuntimeAudit() as audit:
+        result = fn(*args, **kwargs)
+    return result, audit.compilations

@@ -62,6 +62,9 @@ def propose_block(model, params, cache, sync, slen, fd, m):
         nxt = jnp.argmax(lg[0, 0]).astype(jnp.int32)
         return (nxt, mut["cache"]), nxt
 
+    # m is the host-static draft block length (engine config, never a
+    # tracer); the branch just picks the scan-free shape for m == 1
+    # fedlint: disable-next-line=recompile-hazard
     if m > 1:
         (_, cache), rest = jax.lax.scan(body, (first, cache),
                                         jnp.arange(1, m))

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import rng as rng_util
 from ..core.state import make_client_optimizer
 from ..data.federated_dataset import FederatedDataset
 from ..ml.trainer.local_trainer import accuracy, cross_entropy_loss
@@ -97,11 +98,15 @@ class CentralizedTrainer:
     def train(self):
         """Reference ``train():48`` — epochs of pooled-data SGD with
         periodic train/test eval."""
+        root = rng_util.root_key(self.seed)
         for epoch in range(self.epochs):
             xb, yb = self._epoch_batches(epoch)
+            # fedlint rng-key-reuse fix: the old PRNGKey(epoch) ignored the
+            # run seed entirely — every seed shared identical per-epoch
+            # dropout streams; fold the epoch into the seed-derived root
             self.params, self.opt_state, loss, acc = self._epoch(
                 self.params, self.opt_state, jnp.asarray(xb),
-                jnp.asarray(yb), jax.random.PRNGKey(epoch))
+                jnp.asarray(yb), rng_util.round_key(root, epoch))
             rec = {"epoch": epoch, "train_loss": float(loss),
                    "train_acc": float(acc)}
             if epoch % max(self.eval_freq, 1) == 0 or epoch == self.epochs - 1:

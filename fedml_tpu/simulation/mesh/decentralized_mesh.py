@@ -61,8 +61,11 @@ class MeshDecentralizedAPI(DecentralizedFedAPI):
         if self.per_shard < 1:
             raise ValueError("need at least one client per shard")
         # ring row of SymmetricTopologyManager(n, 2): 1/3 self + 1/3 each ±1
-        self.w_self = float(np.asarray(self.W[0, 0]))
-        self.w_nbr = float(np.asarray(self.W[0, 1]))
+        # (one device→host transfer for the row, not one blocking sync per
+        # scalar — the jit-host-sync discipline fedlint enforces in traced
+        # code applies to the host hot path too)
+        row0 = np.asarray(self.W[0, :2])
+        self.w_self, self.w_nbr = float(row0[0]), float(row0[1])
         self.round_fn = self._build_mesh_round_fn()
 
     def _build_mesh_round_fn(self):

@@ -1,0 +1,18 @@
+"""Fixture: collectives against declared axes (and unresolvable params)."""
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+CLIENT_AXIS = "client"
+
+mesh = Mesh(np.array(jax.devices()), (CLIENT_AXIS,))
+
+
+def per_shard(x):
+    total = jax.lax.psum(x, CLIENT_AXIS)        # resolved module constant
+    return total + jax.lax.axis_index("client")  # literal, declared
+
+
+def generic(x, axis_name):
+    # dynamic axis argument: can't be proven wrong, must not be flagged
+    return jax.lax.pmean(x, axis_name)

@@ -1,0 +1,14 @@
+"""Fixture: collective axis names that match no declared mesh axis."""
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+CLIENT_AXIS = "client"
+
+mesh = Mesh(np.array(jax.devices()), (CLIENT_AXIS,))
+
+
+def per_shard(x):
+    total = jax.lax.psum(x, "clients")     # typo: declared axis is 'client'
+    idx = jax.lax.axis_index("batch")      # never declared anywhere
+    return total, idx

@@ -1,0 +1,21 @@
+"""Fixture: the donate-and-rebind idiom — no findings."""
+import jax
+
+
+def step(state, x):
+    return state + x, x.sum()
+
+
+train_step = jax.jit(step, donate_argnums=(0,))
+
+
+def good_driver(state, xs):
+    state, loss = train_step(state, xs)   # rebound in the same statement
+    return state, loss
+
+
+def good_loop(state, xs):
+    loss = None
+    for x in xs:
+        state, loss = train_step(state, x)
+    return state, loss

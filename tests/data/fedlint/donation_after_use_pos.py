@@ -1,0 +1,22 @@
+"""Fixture: donated buffers read after the jitted call (all findings)."""
+import jax
+
+
+def step(state, x):
+    return state + x, x.sum()
+
+
+train_step = jax.jit(step, donate_argnums=(0,))
+
+
+def bad_driver(state, xs):
+    new_state, loss = train_step(state, xs)
+    stale = state.sum()        # 'state' buffer was donated one line up
+    return new_state, stale
+
+
+def bad_loop_driver(state, xs):
+    out = None
+    for x in xs:
+        out = train_step(state, x)   # donated every iteration, never rebound
+    return out

@@ -1,0 +1,18 @@
+"""Fixture: the same operations placed correctly — no findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_round(params, x):
+    loss = jnp.mean(x)
+    jax.debug.print("loss {l}", l=loss)   # trace-safe print
+    n = int(x.shape[0])                    # shapes are static under jit
+    return params, loss / n
+
+
+def host_driver(x):
+    # host-side casts AFTER the jitted call are the normal sync point
+    _, loss = good_round(None, x)
+    return float(loss), np.asarray(x)

@@ -1,0 +1,20 @@
+"""Fixture: host syncs inside jit-reachable functions (all findings)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_round(params, x):
+    loss = jnp.mean(x)
+    print("loss", loss)            # host print under tracing
+    scale = float(loss)            # blocking device->host cast
+    host = np.asarray(x)           # numpy materialization of a tracer
+    return params, scale, host
+
+
+def bad_nested(xs):
+    def body(carry, x):
+        carry = carry + x.item()   # .item() inside a scanned body
+        return carry, carry
+    return jax.lax.scan(body, 0.0, xs)

@@ -1,0 +1,13 @@
+"""Fixture: ordered access into pytree ops — clean."""
+import jax
+
+
+def good_merge(models):
+    names = sorted(models)
+    return jax.tree_util.tree_map(
+        lambda *xs: sum(xs), *[models[k] for k in names])
+
+
+def good_list(trees):
+    # iterating a list is order-stable
+    return jax.tree_util.tree_map(lambda *xs: sum(xs), *[t for t in trees])

@@ -1,0 +1,11 @@
+"""Fixture: unordered dict iteration feeding pytree ops (all findings)."""
+import jax
+
+
+def bad_merge(models):
+    return jax.tree_util.tree_map(
+        lambda *xs: sum(xs), *[m for m in models.values()])
+
+
+def bad_flatten(d):
+    return jax.tree_util.tree_flatten(list(d.values()))[0]

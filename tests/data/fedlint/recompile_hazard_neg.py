@@ -1,0 +1,19 @@
+"""Fixture: jit built once, hashable statics, static branches — clean."""
+import jax
+import jax.numpy as jnp
+
+double = jax.jit(lambda a: a * 2)              # module-level: built once
+apply_fn = jax.jit(lambda x, cfg: x, static_argnames=("cfg",))
+
+
+def call_good(x):
+    return apply_fn(x, cfg=("depth", 3))       # hashable tuple static
+
+
+@jax.jit
+def good(x, flag: bool = False):
+    if flag:                                   # annotated static config
+        return x * 2
+    if x.shape[0] > 1:                         # shapes are static
+        return x
+    return jnp.where(x > 0, x, -x)             # traced select is the fix

@@ -1,0 +1,23 @@
+"""Fixture: recompilation hazards (all findings)."""
+import jax
+
+apply_fn = jax.jit(lambda x, cfg: x, static_argnames=("cfg",))
+
+
+def run(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda a: a * 2)   # fresh jit per iteration
+        outs.append(f(x))
+    return outs
+
+
+def call_bad(x):
+    return apply_fn(x, cfg={"depth": 3})   # unhashable static arg
+
+
+@jax.jit
+def branchy(x):
+    if x > 0:                # Python branch on a traced parameter
+        return x
+    return -x

@@ -1,0 +1,24 @@
+"""Fixture: correct key handling — no findings."""
+import jax
+
+
+def good_split(seed):
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a, b
+
+
+def good_loop(seed, n):
+    key = jax.random.PRNGKey(seed)
+    outs = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        outs.append(jax.random.normal(sub, (2,)))
+    return outs
+
+
+def good_fold(seed, round_idx):
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), round_idx)
+    return jax.random.normal(key, (4,))

@@ -1,0 +1,25 @@
+"""Fixture: PRNG key discipline violations (all findings)."""
+import jax
+
+
+def bad_double_sample(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))     # same key sampled twice
+    return a, b
+
+
+def bad_loop_key(seed, n):
+    key = jax.random.PRNGKey(seed)
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.normal(key, (2,)))  # reused every iteration
+    return outs
+
+
+def bad_key_in_loop(n):
+    outs = []
+    for _ in range(n):
+        key = jax.random.PRNGKey(0)       # same constant stream per pass
+        outs.append(jax.random.normal(key, (2,)))
+    return outs

@@ -1,0 +1,11 @@
+"""Fixture: inline and next-line suppression forms."""
+import jax
+
+
+@jax.jit
+def tapped(x):
+    print("x", x)  # fedlint: disable=jit-host-sync -- debug tap
+    # fedlint: disable-next-line=jit-host-sync
+    print("again", x)
+    print("not suppressed", x)  # fedlint: disable=rng-key-reuse
+    return x

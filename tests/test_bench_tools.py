@@ -88,6 +88,42 @@ def test_controller_validates_platform_from_last_json_line(tmp_path):
     assert not ctl._on_tpu(ctl._last_json(str(tmp_path / "missing.json")))
 
 
+def test_pytest_shard_partition_deterministic():
+    """Shard assignment must be a pure function of the file SET — glob
+    returns filesystem-dependent order and `-p no:randomly` runs must
+    reproduce the same shards, or a flake 'moves' between workers and
+    becomes unreproducible."""
+    import random
+
+    import pytest_shard as ps
+
+    files = [f"tests/test_{n}.py" for n in
+             ["llm", "mesh", "algorithms", "xent", "comm", "flow",
+              "chaos", "moe", "pipeline", "zzz_unknown", "aaa_unknown"]]
+    base = ps.partition(list(files), 4)
+    rng = random.Random(0)
+    for _ in range(10):
+        shuffled = list(files)
+        rng.shuffle(shuffled)
+        assert ps.partition(shuffled, 4) == base
+
+    # every file lands in exactly one shard
+    flat = [f for s in base for f in s]
+    assert sorted(flat) == sorted(files)
+
+    # equal-weight ties (both unknown files) break on basename, not on
+    # input order: aaa before zzz in the greedy sequence
+    seq = sorted(files, key=lambda f: (-ps.WEIGHTS.get(
+        os.path.basename(f), ps.DEFAULT_WEIGHT), os.path.basename(f)))
+    aaa = seq.index("tests/test_aaa_unknown.py")
+    zzz = seq.index("tests/test_zzz_unknown.py")
+    assert aaa < zzz
+
+    # n > files: empty shards dropped, still deterministic
+    tiny = ps.partition(files[:2], 8)
+    assert len(tiny) == 2 and ps.partition(files[1::-1], 8) == tiny
+
+
 def test_serve_quick_filter_keeps_kvint8_and_a_headline_row():
     """The quick-mode trim must keep the dense baseline, a horizon row
     (headline eligible: best_row excludes int8 weights), and the KV-int8

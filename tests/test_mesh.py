@@ -163,6 +163,39 @@ def test_mesh_hierarchical_matches_sp():
         make(MeshHierarchicalAPI, federated_optimizer="FedOpt")
 
 
+def test_mesh_round_compiles_once():
+    """Recompile regression (fedml_tpu.analysis.runtime): after the first
+    rounds warm the caches, steady-state mesh rounds must add ZERO XLA
+    compilations — a recompile per round means a shape leak (unpadded
+    cohort, fresh closure handed to jit) and turns a 0.2s round into a
+    20s one on a real TPU."""
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu import data as data_mod, device as device_mod, \
+        model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    args = fedml_tpu.init(args_for("mesh", rounds=6))
+    # homo partition => every cohort has the same pow2 step count, so the
+    # steady state is exactly ONE compiled program.  (Under the default
+    # hetero Dirichlet split, later rounds may legitimately hit a NEW pow2
+    # step class — that's the bounded-recompile contract, not a leak.)
+    args.update(partition_method="homo")
+    dev = device_mod.get_device(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api = MeshFedAvgAPI(args, dev, dataset, model)
+    assert api.n_shards == 8 and api.update_sharding == "scatter"
+
+    api.train_one_round(0)   # traces + compiles the round program
+    api.train_one_round(1)   # warms any second-round-only eager ops
+    with JaxRuntimeAudit() as audit:
+        for r in (2, 3, 4):
+            api.train_one_round(r)
+    assert audit.compilations == 0, (
+        f"steady-state mesh rounds recompiled {audit.compilations}x: "
+        f"{audit.compiled}")
+
+
 def test_mesh_engine_per_client_eval():
     """evaluate_per_client (inherited from the sp API) works on the mesh
     engine: replicated global params scored per client shard."""

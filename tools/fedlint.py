@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""fedlint CLI — JAX-aware static analysis over the federated hot paths.
+
+Usage:
+    python tools/fedlint.py fedml_tpu/                 # human output
+    python tools/fedlint.py --json fedml_tpu/ tests/   # machine output
+    python tools/fedlint.py --rules jit-host-sync,rng-key-reuse fedml_tpu/
+    python tools/fedlint.py --severity pytree-order=error fedml_tpu/
+    python tools/fedlint.py --list-rules
+
+Exit codes: 0 = no unsuppressed errors; 1 = at least one unsuppressed
+error (or any unsuppressed finding with --strict); 2 = usage error.
+
+The analyzer itself (``fedml_tpu/analysis/fedlint.py``) is pure stdlib —
+this wrapper loads it by file path so linting works on machines without
+jax installed (CI lint shards, pre-commit hooks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_fedlint():
+    """Load the analyzer module directly, bypassing fedml_tpu/__init__
+    (which imports jax and initializes a backend)."""
+    path = os.path.join(REPO, "fedml_tpu", "analysis", "fedlint.py")
+    spec = importlib.util.spec_from_file_location("_fedlint_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fedlint", description="JAX-aware static analysis "
+        "(jit boundaries, RNG discipline, collectives, donation)")
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON (includes suppressed)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on warnings too")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in human output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--severity", action="append", default=[],
+                    metavar="RULE=LEVEL",
+                    help="override a rule's severity (error|warning); "
+                         "repeatable")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    fl = _load_fedlint()
+
+    if args.list_rules:
+        for r in fl.RULES.values():
+            print(f"{r.name:24s} [{r.severity}] {r.doc}")
+        return 0
+
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("fedlint: error: no paths given", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(fl.RULES)
+        if unknown:
+            print(f"fedlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    overrides = {}
+    for spec in args.severity:
+        if "=" not in spec:
+            print(f"fedlint: bad --severity {spec!r} (want RULE=LEVEL)",
+                  file=sys.stderr)
+            return 2
+        rule, level = spec.split("=", 1)
+        if rule not in fl.RULES or level not in (fl.ERROR, fl.WARNING):
+            print(f"fedlint: bad --severity {spec!r}", file=sys.stderr)
+            return 2
+        overrides[rule] = level
+
+    findings = fl.analyze_paths(args.paths, rules=rules,
+                                severity_overrides=overrides)
+    if args.as_json:
+        print(fl.findings_to_json(findings))
+    else:
+        print(fl.render_findings(findings,
+                                 show_suppressed=args.show_suppressed))
+    return fl.exit_code(findings, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,10 +42,15 @@ DEFAULT_WEIGHT = 50
 
 
 def partition(files, n):
+    """Greedy LPT bin packing, fully deterministic: ties in weight break on
+    the basename, ties in load break on the lowest shard index, so the same
+    file set always yields the same shards regardless of input order
+    (glob order is filesystem-dependent) or `-p no:randomly`."""
     shards = [[] for _ in range(n)]
     loads = [0.0] * n
-    for f in sorted(files, key=lambda f: -WEIGHTS.get(os.path.basename(f),
-                                                      DEFAULT_WEIGHT)):
+    for f in sorted(files, key=lambda f: (-WEIGHTS.get(os.path.basename(f),
+                                                       DEFAULT_WEIGHT),
+                                          os.path.basename(f))):
         i = loads.index(min(loads))
         shards[i].append(f)
         loads[i] += WEIGHTS.get(os.path.basename(f), DEFAULT_WEIGHT)
@@ -63,7 +68,7 @@ def main():
                     help="extra pytest args (after --)")
     args = ap.parse_args()
 
-    files = glob.glob(os.path.join(REPO, "tests", "test_*.py"))
+    files = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
     shards = partition(files, args.n)
     base = [sys.executable, "-m", "pytest", "-q"]
     if args.m:

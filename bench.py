@@ -335,6 +335,78 @@ def bench_update_sharding(rounds: int | None = None,
     return out
 
 
+# -- round-block fusion benchmark (--fused) ----------------------------------
+def bench_round_fusion(rounds: int | None = None,
+                       clients_per_round: int | None = None,
+                       block: int = 8) -> dict:
+    """Fused round-block (``args.round_block``) vs per-round dispatch on the
+    SP engine: steady-state s/round at K=1 and K=``block`` on the 256-client
+    MNIST-LR config.  K=1 runs the normal ``train_one_round`` loop (per-round
+    staging + dispatch); K=``block`` runs ``train_block`` (one compiled
+    ``lax.scan`` over K rounds, cohorts for the next block staged on the
+    worker thread).  FEDML_FUSED_QUICK=1 shrinks the cohort for smoke
+    tests."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    quick = os.environ.get("FEDML_FUSED_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (2 * block if quick else 5 * block)
+    rtt = None
+    out = {"clients_per_round": cpr, "round_block": block, "quick": quick}
+
+    for k in (1, block):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total,
+            client_num_per_round=cpr,
+            # comm_round only clamps the ragged tail; sampling/staging are
+            # pure functions of round_idx, so steady-state blocks can run
+            # at any start index
+            comm_round=10 ** 6,
+            epochs=1, batch_size=BATCH, learning_rate=0.03,
+            partition_method="homo", frequency_of_the_test=10 ** 9,
+            random_seed=0, round_block=k,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        api = FedAvgAPI(args, None, dataset, model, client_mode="vmap")
+
+        rounds_done = [0]
+
+        def run_rounds(n):
+            if k == 1:
+                for _ in range(n):
+                    api.train_one_round(rounds_done[0])
+                    rounds_done[0] += 1
+            else:
+                done = 0
+                while done < n:
+                    kk, _ = api.train_block(rounds_done[0])
+                    rounds_done[0] += kk
+                    done += kk
+
+        run_rounds(2 * k)  # compile + warm
+        _readback(api.state.global_params)
+        if rtt is None:
+            rtt = measure_rtt()
+        dt = _timed_chain(run_rounds,
+                          lambda: _readback(api.state.global_params),
+                          min_total_s=0.5 if quick else 2.0,
+                          n0=timed_rounds, rtt=rtt)
+        out["fused_s_per_round" if k > 1 else "unfused_s_per_round"] = \
+            round(dt, 5)
+    out["fused_speedup"] = round(
+        out["unfused_s_per_round"] / out["fused_s_per_round"], 3)
+    return out
+
+
 # -- LLM LoRA single-chip benchmark ------------------------------------------
 def bench_llm_lora(on_accelerator: bool, peak: float | None,
                    batch: int | None = None, remat: str | None = None,
@@ -810,6 +882,19 @@ def main():
             "value": result["scatter_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["scatter_speedup"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--fused" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_round_fusion()
+        result.update({
+            "metric": "fedavg_round_block_fusion",
+            "value": result["fused_s_per_round"],
+            "unit": "s/round",
+            "vs_baseline": result["fused_speedup"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -28,15 +28,36 @@ class RoundCheckpointer:
                                                  create=True),
         )
 
-    def save(self, round_idx: int, state: Any,
-             client_state: Optional[dict] = None, force: bool = False):
-        """state: any pytree (ServerState); client_state: host dict of
-        per-client pytrees (SCAFFOLD variates / FedDyn residuals)."""
+    @staticmethod
+    def _is_legacy_dict(client_state) -> bool:
+        """Legacy layout: a host dict keyed by int client id.  The current
+        engines keep per-client state as a device-resident dense table
+        (one pytree, rows indexed by client id) instead."""
+        return isinstance(client_state, dict) and (
+            not client_state
+            or all(isinstance(k, int) for k in client_state))
+
+    def _composite(self, state: Any, client_state) -> dict:
         composite = {"state": state}
-        if client_state:
-            composite["client_state"] = {
-                str(k): v for k, v in client_state.items()}
-        self.mngr.save(round_idx, args=ocp.args.StandardSave(composite),
+        if client_state is None:
+            return composite
+        if self._is_legacy_dict(client_state):
+            if client_state:
+                composite["client_state"] = {
+                    str(k): v for k, v in client_state.items()}
+        else:
+            composite["client_table"] = client_state
+        return composite
+
+    def save(self, round_idx: int, state: Any,
+             client_state: Optional[Any] = None, force: bool = False):
+        """state: any pytree (ServerState); client_state: the dense
+        per-client state table (pytree with a leading client-row axis —
+        orbax persists its sharding like any other leaf) or the legacy
+        host dict of per-client pytrees."""
+        self.mngr.save(round_idx,
+                       args=ocp.args.StandardSave(
+                           self._composite(state, client_state)),
                        force=force)
         self.mngr.wait_until_finished()
 
@@ -45,19 +66,20 @@ class RoundCheckpointer:
 
     def restore(self, round_idx: Optional[int] = None,
                 template: Optional[Any] = None):
-        """Returns (state, client_state_dict) or None if no checkpoint."""
+        """Returns (state, client_state) or None if no checkpoint;
+        ``client_state`` is the dense table pytree when one was saved,
+        else the legacy int-keyed dict (``{}`` when absent)."""
         step = round_idx if round_idx is not None else self.mngr.latest_step()
         if step is None:
             return None
         if template is not None:
-            composite = {"state": template[0]}
-            if template[1]:
-                composite["client_state"] = {
-                    str(k): v for k, v in template[1].items()}
             restored = self.mngr.restore(
-                step, args=ocp.args.StandardRestore(composite))
+                step, args=ocp.args.StandardRestore(
+                    self._composite(template[0], template[1])))
         else:
             restored = self.mngr.restore(step)
+        if "client_table" in restored:
+            return restored["state"], restored["client_table"]
         client_state = {
             int(k): v for k, v in restored.get("client_state", {}).items()}
         return restored["state"], client_state

@@ -155,3 +155,38 @@ def flat_chunk(vec: jnp.ndarray, index, n_chunks: int) -> jnp.ndarray:
     (``index`` may be traced, e.g. ``lax.axis_index`` inside shard_map)."""
     chunk = vec.shape[0] // n_chunks
     return jax.lax.dynamic_slice(vec, (index * chunk,), (chunk,))
+
+
+# -- dense per-client state table (SCAFFOLD c_i / FedDyn residuals) ----------
+# The table replaces the host-side {client_id: pytree} dict: every leaf gains
+# a leading (num_clients[+pad],) row axis and lives on device (optionally
+# sharded over the client mesh axis), so the cohort's rows move HBM->HBM by
+# gather/scatter INSIDE the compiled round instead of a per-round
+# device_get + host tree_stack.
+
+def client_table_init(params: Pytree, rows: int) -> Pytree:
+    """Zero table of per-client state: one row per client, shaped like
+    ``params`` per row — the dense equivalent of ``dict.get(c, zeros)``."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros((rows,) + p.shape, p.dtype), params)
+
+
+def cohort_gather(table: Pytree, cohort) -> Pytree:
+    """Rows ``cohort`` of the client-state table, stacked on a leading
+    cohort axis.  Out-of-range ids (the padded-cohort sentinel) read as
+    ZERO rows — the same default the host-dict era's ``dict.get(c, zeros)``
+    gave a never-sampled client (the jnp default fill is NaN, which would
+    poison the whole cohort's weighted loss through the padded lanes)."""
+    return jax.tree_util.tree_map(
+        lambda t: jnp.take(t, cohort, axis=0, mode="fill", fill_value=0),
+        table)
+
+
+def cohort_scatter(table: Pytree, cohort, new_rows: Pytree) -> Pytree:
+    """Write the cohort's updated per-client state back into the table.
+    ``mode="drop"`` makes the out-of-range sentinel id used for padded
+    cohort rows a true no-op (the default scatter mode CLIPS, which would
+    corrupt the last real client's row)."""
+    return jax.tree_util.tree_map(
+        lambda t, n: t.at[cohort].set(n.astype(t.dtype), mode="drop"),
+        table, new_rows)

@@ -46,7 +46,6 @@ into SPMD.  For strongly non-uniform cohorts the scheduler in
 from __future__ import annotations
 
 import logging
-from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +60,8 @@ from ...ml.aggregator.agg_operator import (ServerOptimizer, ServerState,
 from ...ml.trainer.local_trainer import LocalTrainer
 from ..round_engine import next_pow2
 from ..sp.fedavg_api import FedAvgAPI
+from ..staging import AsyncCohortStager  # noqa: F401  (re-export: the
+# stager predates ISSUE 3's fused blocks and callers import it from here)
 
 log = logging.getLogger(__name__)
 
@@ -73,34 +74,6 @@ def _psum_wavg(stacked, w, axis_name):
                                axis_name), stacked)
     den = jax.lax.psum(jnp.sum(w), axis_name)
     return jax.tree_util.tree_map(lambda x: (x / den).astype(x.dtype), num)
-
-
-class AsyncCohortStager:
-    """Double-buffered host→device cohort staging.
-
-    ``build(round_idx)`` must be a pure function of the round index that
-    returns the staged (device_put) round inputs.  While round ``r``'s
-    compiled program runs, a single worker thread builds and stages cohort
-    ``r+1`` so the host-side batching + transfer overlaps device compute
-    instead of serializing in front of every dispatch."""
-
-    def __init__(self, build, enabled: bool = True):
-        self._build = build
-        self._enabled = enabled
-        self._pool = ThreadPoolExecutor(max_workers=1) if enabled else None
-        self._pending = {}
-
-    def get(self, round_idx: int, prefetch=None):
-        fut = self._pending.pop(round_idx, None)
-        staged = fut.result() if fut is not None else self._build(round_idx)
-        if self._enabled and prefetch is not None \
-                and prefetch not in self._pending:
-            self._pending[prefetch] = self._pool.submit(self._build, prefetch)
-        return staged
-
-    def close(self):
-        if self._pool is not None:
-            self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
@@ -129,6 +102,19 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     replicated/sharded specs of the ServerState pytree.  ``donate=True``
     donates the state argument so XLA reuses the old ServerState buffers
     in place instead of copying model + optimizer state every round."""
+    round_fn = _make_mesh_round_core(trainer, server_opt, mesh, gather,
+                                     sharded_data, update_sharding,
+                                     state_template)
+    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+
+
+def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                          mesh: Mesh, gather: bool, sharded_data: bool,
+                          update_sharding: str,
+                          state_template: ServerState):
+    """Unjitted round body shared by the per-round jit
+    (:func:`make_mesh_round_fn`) and the fused round-block scan
+    (:func:`make_mesh_block_fn`)."""
     local_train = trainer.make_local_train()
     alg = server_opt.algorithm
     n_shards = mesh.shape[CLIENT_AXIS]
@@ -284,7 +270,59 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                 jnp.take(train_y, idx, axis=0), cohort_spec)
         return sharded(state, x, y, mask, w, rngs, c_clients)
 
-    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
+    return round_fn
+
+
+def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                       mesh: Mesh, gather: bool = False,
+                       sharded_data: bool = False,
+                       update_sharding: str = "replicated",
+                       state_template: ServerState = None,
+                       donate: bool = False):
+    """Fused mesh round-block: K rounds as ONE ``jit(lax.scan(round))``
+    dispatch (ISSUE 3 tentpole; same composition DrJAX builds from,
+    arXiv:2403.07128).
+
+    ``block_fn(state, x_blk, dev_data, mask_blk, w_blk, keys_blk,
+    cohort_blk, client_table)``: cohort inputs carry a leading round axis
+    (``x_blk`` is the ``(K, C, S, B)`` index tensor in gather mode —
+    fusion requires device-resident data so a staged block is indices
+    only); ``dev_data`` is the device-resident ``(train_x, train_y)`` pair
+    passed once per call, not per round.  ServerState and the
+    client-axis-sharded per-client state table thread through the scan
+    carry (both donated), the table gathered/scattered by ``cohort_blk``
+    ids INSIDE the compiled program, and per-round metrics stack into
+    ``(K,)`` outputs so the host syncs once per block."""
+    core = _make_mesh_round_core(trainer, server_opt, mesh, gather,
+                                 sharded_data, update_sharding,
+                                 state_template)
+    has_table = server_opt.algorithm in ("scaffold", "feddyn")
+    row_sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+
+    def block_fn(state: ServerState, x_blk, dev_data, mask_blk, w_blk,
+                 keys_blk, cohort_blk, client_table=None):
+        def step(carry, inp):
+            st, table = carry
+            x, mask, w, key, cohort = inp
+            c = None
+            if has_table:
+                # rows of the client-axis-sharded table -> cohort stack,
+                # pinned back onto the client axis for the shard_map body
+                c = jax.lax.with_sharding_constraint(
+                    tree_util.cohort_gather(table, cohort), row_sharding)
+            st, metrics, new_c = core(st, x, dev_data, mask, w, key, c)
+            if has_table:
+                table = jax.lax.with_sharding_constraint(
+                    tree_util.cohort_scatter(table, cohort, new_c),
+                    row_sharding)
+            return (st, table), metrics
+
+        (state, client_table), metrics = jax.lax.scan(
+            step, (state, client_table),
+            (x_blk, mask_blk, w_blk, keys_blk, cohort_blk))
+        return state, metrics, client_table
+
+    return jax.jit(block_fn, donate_argnums=(0, 7) if donate else ())
 
 
 class MeshFedAvgAPI(FedAvgAPI):
@@ -368,6 +406,73 @@ class MeshFedAvgAPI(FedAvgAPI):
                                   state_template=self.state,
                                   donate=self.DONATE_STATE)
 
+    def _init_client_table(self):
+        """Client-state table rows padded to a multiple of the shard count
+        and sharded over the client axis: each chip permanently owns
+        ``rows/n_shards`` clients' SCAFFOLD/FedDyn state; cohort rows move
+        by gather/scatter collectives inside the compiled round."""
+        self._table_rows = -(-self.dataset.num_clients
+                             // self.n_shards) * self.n_shards
+        table = tree_util.client_table_init(self.state.global_params,
+                                            self._table_rows)
+        return jax.device_put(table,
+                              NamedSharding(self.mesh, P(CLIENT_AXIS)))
+
+    def _build_block_fn(self):
+        if not self._gather:
+            raise ValueError(
+                "round_block fusion on the mesh engine needs "
+                "device-resident data (device_data=True or 'sharded'): "
+                "staging a block must ship index tensors, not cohorts")
+        inner = make_mesh_block_fn(self.trainer, self.server_opt, self.mesh,
+                                   gather=self._gather,
+                                   sharded_data=self._sharded_data,
+                                   update_sharding=self.update_sharding,
+                                   state_template=self.state,
+                                   donate=self.DONATE_STATE)
+        dev_data = self._dev_data
+
+        def call(state, idx, mask, w, keys, cohort, table):
+            return inner(state, idx, dev_data, mask, w, keys, cohort, table)
+
+        return call
+
+    def _stage_block(self, start_round: int):
+        """Mesh block staging: stacked index/mask/weight tensors sharded
+        over the client axis (leading round axis replicated), cohort ids
+        padded with the out-of-range sentinel so pad rows never touch the
+        client-state table.  Pure function of ``start_round``."""
+        k = min(self._round_block, self.comm_rounds - start_round)
+        rounds = range(start_round, start_round + k)
+        per = []
+        for r in rounds:
+            clients = self._client_sampling(r)
+            idx, mask, w = self.dataset.cohort_indices(
+                clients, self.batch_size, self.seed, r, self.epochs)
+            per.append((clients, idx, mask, w))
+        n = per[0][1].shape[0]
+        n_padded = -(-n // self.n_shards) * self.n_shards
+        steps = next_pow2(max(p[1].shape[1] for p in per))
+        sentinel = getattr(self, "_table_rows", self.dataset.num_clients)
+        idx_blk = np.zeros((k, n_padded, steps, self.batch_size), np.int32)
+        mask_blk = np.zeros((k, n_padded, steps), np.float32)
+        w_blk = np.zeros((k, n_padded), np.float32)
+        cohort_blk = np.full((k, n_padded), sentinel, np.int32)
+        for i, (clients, idx, mask, w) in enumerate(per):
+            s = idx.shape[1]
+            idx_blk[i, :n, :s] = idx
+            mask_blk[i, :n, :s] = mask
+            w_blk[i, :n] = w
+            cohort_blk[i, :n] = clients
+        root = rng_util.root_key(self.seed)
+        keys_blk = np.stack([np.asarray(rng_util.round_key(root, r))
+                             for r in rounds])
+        shard = NamedSharding(self.mesh, P(None, CLIENT_AXIS))
+        put = lambda a: jax.device_put(jnp.asarray(a), shard)
+        repl = lambda a: jax.device_put(jnp.asarray(a), self._repl_sharding)
+        return (k, steps, put(idx_blk), put(mask_blk), put(w_blk),
+                repl(keys_blk), repl(cohort_blk))
+
     def _stage_cohort(self, round_idx: int):
         """Build + device_put one round's cohort tensors.  Pure function of
         the round index (sampling and batching are seed-derived), so the
@@ -405,19 +510,19 @@ class MeshFedAvgAPI(FedAvgAPI):
         nxt = round_idx + 1 if round_idx + 1 < self.comm_rounds else None
         clients, pad_c, data_x, data_y, mask, w = self._stager.get(
             round_idx, prefetch=nxt)
-        n = len(clients)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
-        # per-client algorithm state depends on the PREVIOUS round's
-        # scatter-back, so it stages synchronously (never prefetched)
+        # per-client state rows gather/scatter on DEVICE against the
+        # client-axis-sharded table (the host-dict era device_got the whole
+        # stacked cohort state back every round); pad rows use the
+        # out-of-range sentinel so their writes drop
+        cohort = None
         c_stacked = None
-        if self._c_clients is not None:
-            zeros = tree_util.tree_zeros_like(self.state.global_params)
-            c_stacked = tree_util.tree_stack(
-                [self._c_clients.get(int(c), zeros) for c in clients]
-                + [zeros] * pad_c)
+        if self.client_table is not None:
+            cohort = np.concatenate(
+                [np.asarray(clients, np.int32),
+                 np.full(pad_c, self._table_rows, np.int32)])
+            c_stacked = self._gather_c(cohort)
         self.state, metrics, new_c = self.round_fn(
             self.state, data_x, data_y, mask, w, key, c_stacked)
-        if self._c_clients is not None:
-            self._scatter_c(clients, jax.device_get(
-                jax.tree_util.tree_map(lambda a: a[:n], new_c)))
+        self._scatter_c(cohort, new_c)
         return metrics

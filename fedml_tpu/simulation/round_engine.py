@@ -128,6 +128,46 @@ def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     return round_fn
 
 
+def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
+                        train_x, train_y, mode: str = "vmap") -> Callable:
+    """Fused round-block: K federated rounds as ONE compiled program
+    (``jit(lax.scan(round))`` — the DrJAX observation that rounds compose as
+    pure JAX primitives, arXiv:2403.07128).
+
+    ``block_fn(state, idx_blk, mask_blk, w_blk, keys_blk, cohort_blk,
+    client_table) -> (new_state, metrics, new_client_table)`` where every
+    cohort input gains a leading round axis of length K (``idx_blk``:
+    ``(K, C, S, B)`` int32 — gather mode only, so pre-staging a whole block
+    ships kilobytes of indices, not data), ``keys_blk`` stacks the K
+    per-round keys (identical to the unfused path's, so parity is exact),
+    and ``cohort_blk`` is the ``(K, C)`` sampled-client ids indexing the
+    device-resident per-client state table (SCAFFOLD/FedDyn; ``None``
+    otherwise).  The ServerState and the table thread through the scan
+    carry; per-round metrics stack into ``(K,)`` outputs so the host syncs
+    once per block instead of once per round.
+    """
+    inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode)
+    has_table = server_opt.algorithm in ("scaffold", "feddyn")
+
+    def block_fn(state: ServerState, idx_blk, mask_blk, w_blk, keys_blk,
+                 cohort_blk, client_table=None):
+        def step(carry, inp):
+            st, table = carry
+            idx, mask, w, key, cohort = inp
+            c = tree_util.cohort_gather(table, cohort) if has_table else None
+            st, metrics, new_c = inner(st, idx, mask, w, key, c)
+            if has_table:
+                table = tree_util.cohort_scatter(table, cohort, new_c)
+            return (st, table), metrics
+
+        (state, client_table), metrics = jax.lax.scan(
+            step, (state, client_table),
+            (idx_blk, mask_blk, w_blk, keys_blk, cohort_blk))
+        return state, metrics, client_table
+
+    return block_fn
+
+
 def next_pow2(n: int) -> int:
     p = 1
     while p < n:

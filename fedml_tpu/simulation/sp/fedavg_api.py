@@ -29,6 +29,7 @@ from ...ml.aggregator.agg_operator import ServerOptimizer
 from ...ml.trainer.local_trainer import LocalTrainer
 from ...mlops import event, log_round_info
 from ..round_engine import make_round_fn, next_pow2
+from ..staging import AsyncCohortStager
 
 log = logging.getLogger(__name__)
 
@@ -71,14 +72,36 @@ class FedAvgAPI:
                 f"{type(self).__name__} does not implement cohort_bucketing")
         self._bucket_fn = None
         self._update_from_agg = None
+        # round-block fusion (ISSUE 3): K rounds per compiled dispatch
+        self._round_block = int(getattr(args, "round_block", 1) or 1)
+        if self._round_block > 1:
+            if self._bucketing:
+                raise ValueError(
+                    "round_block fusion needs the unbucketed cohort path "
+                    "(bucket partials are data-dependent per round)")
+            if type(self).train_one_round is not FedAvgAPI.train_one_round \
+                    and type(self)._build_block_fn is FedAvgAPI._build_block_fn:
+                # a subclass with its own round loop would silently run the
+                # base engine's fused block and skip its logic
+                raise ValueError(
+                    f"{type(self).__name__} does not implement round_block "
+                    "fusion")
+        self._client_mode = client_mode
+        self._block_fn = None
+        self._block_stager: Optional[AsyncCohortStager] = None
+        self._ct_ops = None
         key = rng_util.root_key(self.seed)
         params = model.init(rng_util.purpose_key(key, "init"))
         self.state = self.server_opt.init(params)
         self.round_fn = self._build_round_fn(client_mode)
-        # Per-client algorithm state host-resident between rounds:
-        # SCAFFOLD control variates c_i / FedDyn lagrangian residuals ∇̂_i
-        self._c_clients: Optional[dict] = (
-            {} if self.server_opt.algorithm in ("scaffold", "feddyn") else None)
+        # Per-client algorithm state (SCAFFOLD control variates c_i / FedDyn
+        # lagrangian residuals ∇̂_i) lives DEVICE-resident between rounds as
+        # a dense (num_clients, ...) table gathered/scattered by cohort ids
+        # inside the compiled program — the old host dict forced a
+        # device_get + tree_stack every round (ISSUE 3 tentpole).
+        self.client_table = (
+            self._init_client_table()
+            if self.server_opt.algorithm in ("scaffold", "feddyn") else None)
         self.metrics_history = []
 
     #: donate the ServerState buffers into the round (in-place update on
@@ -109,18 +132,37 @@ class FedAvgAPI:
                                        self.dataset.num_clients,
                                        self.clients_per_round)
 
-    def _gather_c(self, clients):
-        if self._c_clients is None:
-            return None
-        zeros = tree_util.tree_zeros_like(self.state.global_params)
-        return tree_util.tree_stack(
-            [self._c_clients.get(int(c), zeros) for c in clients])
+    def _init_client_table(self):
+        """Dense per-client state table: row ``c`` is client ``c``'s
+        SCAFFOLD c_i / FedDyn ∇̂_i, zero-initialized (the dict semantics'
+        ``get(c, zeros)`` default).  The mesh engine overrides this to pad
+        the row count and shard the rows over the client axis."""
+        self._table_rows = self.dataset.num_clients
+        return tree_util.client_table_init(self.state.global_params,
+                                           self._table_rows)
 
-    def _scatter_c(self, clients, new_state_stacked):
-        if self._c_clients is None or new_state_stacked is None:
+    def _table_ops(self):
+        """Jitted cohort gather/scatter over the client-state table, built
+        once per API instance; the scatter donates the old table buffers so
+        the update is in-place on device."""
+        if self._ct_ops is None:
+            self._ct_ops = (
+                jax.jit(tree_util.cohort_gather),
+                jax.jit(tree_util.cohort_scatter, donate_argnums=(0,)))
+        return self._ct_ops
+
+    def _gather_c(self, cohort):
+        """Stack the cohort's per-client state rows — an HBM→HBM gather on
+        the device table (no host dict, no per-round tree_stack)."""
+        if self.client_table is None:
+            return None
+        return self._table_ops()[0](self.client_table, cohort)
+
+    def _scatter_c(self, cohort, new_state_stacked):
+        if self.client_table is None or new_state_stacked is None:
             return
-        for i, c in enumerate(clients):
-            self._c_clients[int(c)] = tree_util.tree_index(new_state_stacked, i)
+        self.client_table = self._table_ops()[1](self.client_table, cohort,
+                                                 new_state_stacked)
 
     def _train_one_round_bucketed(self, round_idx: int):
         """Ragged-cohort round: clients grouped into pow2 step-count
@@ -190,7 +232,8 @@ class FedAvgAPI:
             return self._train_one_round_bucketed(round_idx)
         clients = self._client_sampling(round_idx)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
-        c_stacked = self._gather_c(clients)
+        cohort = np.asarray(clients, dtype=np.int32)
+        c_stacked = self._gather_c(cohort)
         if hasattr(self, "_dev_x"):
             idx, mask, w = self.dataset.cohort_indices(
                 clients, self.batch_size, self.seed, round_idx, self.epochs)
@@ -215,10 +258,82 @@ class FedAvgAPI:
             self.state, metrics, new_c = self.round_fn(
                 self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
                 jnp.asarray(w), key, c_stacked)
-        self._scatter_c(clients, new_c)
+        self._scatter_c(cohort, new_c)
         metrics = dict(metrics)
         metrics["allocated_steps"] = len(clients) * steps
         return metrics
+
+    # -- fused round blocks (ISSUE 3 tentpole) -----------------------------
+    def _build_block_fn(self):
+        """jit of ``round_engine.make_block_round_fn`` over the
+        device-resident dataset; ServerState (arg 0) and the client-state
+        table (arg 6) are donated so the scan carry updates in place."""
+        if not hasattr(self, "_dev_x"):
+            raise ValueError(
+                "round_block fusion needs the device-gather cohort path "
+                "(device_data=True): pre-staging a block is cheap only "
+                "when rounds ship index tensors, not data")
+        from ..round_engine import make_block_round_fn
+        donate = (0, 6) if self.DONATE_STATE else ()
+        return jax.jit(make_block_round_fn(
+            self.trainer, self.server_opt, self._dev_x, self._dev_y,
+            mode=self._client_mode), donate_argnums=donate)
+
+    def _stage_block(self, start_round: int):
+        """Build one block's stacked cohort tensors: every per-round input
+        gains a leading round axis of length ``k = min(round_block,
+        comm_rounds - start_round)`` (the ragged tail reuses the same
+        traced fn as a smaller final block).  Steps pad to the BLOCK-max
+        pow2 class so homogeneous blocks hit one compiled program (the
+        PR 2 bounded-recompile contract).  Pure function of
+        ``start_round`` — safe for the async stager's worker thread."""
+        k = min(self._round_block, self.comm_rounds - start_round)
+        rounds = range(start_round, start_round + k)
+        per = []
+        for r in rounds:
+            clients = self._client_sampling(r)
+            idx, mask, w = self.dataset.cohort_indices(
+                clients, self.batch_size, self.seed, r, self.epochs)
+            per.append((clients, idx, mask, w))
+        steps = next_pow2(max(p[1].shape[1] for p in per))
+        n = per[0][1].shape[0]
+        idx_blk = np.zeros((k, n, steps, self.batch_size), np.int32)
+        mask_blk = np.zeros((k, n, steps), np.float32)
+        w_blk = np.zeros((k, n), np.float32)
+        cohort_blk = np.zeros((k, n), np.int32)
+        for i, (clients, idx, mask, w) in enumerate(per):
+            s = idx.shape[1]
+            idx_blk[i, :, :s] = idx
+            mask_blk[i, :, :s] = mask
+            w_blk[i] = w
+            cohort_blk[i] = clients
+        root = rng_util.root_key(self.seed)
+        keys_blk = np.stack([np.asarray(rng_util.round_key(root, r))
+                             for r in rounds])
+        return (k, steps, jnp.asarray(idx_blk), jnp.asarray(mask_blk),
+                jnp.asarray(w_blk), jnp.asarray(keys_blk),
+                jnp.asarray(cohort_blk))
+
+    def train_block(self, start_round: int):
+        """Run ``min(round_block, comm_rounds - start_round)`` rounds as
+        ONE compiled dispatch.  Returns ``(k, metrics)`` with each metrics
+        leaf a stacked ``(k,)`` device array — the caller syncs the whole
+        block at once (or not at all)."""
+        if self._block_fn is None:
+            self._block_fn = self._build_block_fn()
+        if self._block_stager is None:
+            self._block_stager = AsyncCohortStager(
+                self._stage_block,
+                enabled=bool(getattr(self.args, "async_staging", True)))
+        nxt = start_round + self._round_block
+        k, steps, idx, mask, w, keys, cohort = self._block_stager.get(
+            start_round, prefetch=nxt if nxt < self.comm_rounds else None)
+        self.state, metrics, self.client_table = self._block_fn(
+            self.state, idx, mask, w, keys, cohort, self.client_table)
+        metrics = dict(metrics)
+        metrics["allocated_steps"] = np.full(
+            k, idx.shape[1] * steps, np.int64)
+        return k, metrics
 
     def evaluate(self):
         xb, yb, mb = self.dataset.test_batches()
@@ -294,42 +409,104 @@ class FedAvgAPI:
         if ckpt is None or ckpt.latest_round() is None:
             return 0
         state, client_state = ckpt.restore(
-            template=(self.state, self._c_clients))
+            template=(self.state, self.client_table))
         self.state = state
-        if self._c_clients is not None:
-            self._c_clients = client_state
+        if self.client_table is not None and client_state is not None:
+            self.client_table = client_state
         return int(ckpt.latest_round()) + 1
 
-    def maybe_checkpoint(self, round_idx: int):
+    def maybe_checkpoint(self, round_idx: int, window: int = 1):
+        """Checkpoint when any round in ``[round_idx - window + 1,
+        round_idx]`` hits the frequency (fused blocks checkpoint at block
+        granularity: the state only exists at block boundaries)."""
         ckpt = self._checkpointer()
         if ckpt is None:
             return
         freq = int(getattr(self.args, "checkpoint_freq", 10))
-        if round_idx % freq == 0 or round_idx == self.comm_rounds - 1:
-            ckpt.save(round_idx, self.state, self._c_clients)
+        due = (round_idx == self.comm_rounds - 1
+               or any((round_idx - j) % freq == 0 for j in range(window)))
+        if due:
+            ckpt.save(round_idx, self.state, self.client_table)
 
     # -- main loop (reference fedavg_api.py:66 train) ----------------------
-    def train(self):
-        t_start = time.time()
-        start_round = self.maybe_resume()
-        for round_idx in range(start_round, self.comm_rounds):
-            event("train", started=True, round_idx=round_idx)
-            t0 = time.time()
-            metrics = self.train_one_round(round_idx)
+    def _is_log_round(self, round_idx: int) -> bool:
+        return (round_idx % self.eval_freq == 0
+                or round_idx == self.comm_rounds - 1)
+
+    def _flush_round_records(self, pending):
+        """Materialize deferred per-round metrics into host records.  The
+        ``float()`` here is the ONE device→host sync point for every round
+        since the last flush — between flushes the device queue stays full
+        (the old loop's per-round blocking ``float(train_loss)`` serialized
+        host and device; ISSUE 3 satellite)."""
+        while pending:
+            round_idx, metrics, dt = pending.pop(0)
             train_loss = float(metrics["train_loss"])
-            event("train", started=False, round_idx=round_idx)
             record = {"round": round_idx, "train_loss": train_loss,
-                      "round_time": time.time() - t0,
+                      "round_time": dt,
                       "dataset_provenance": getattr(self.dataset,
                                                     "provenance", "unknown")}
-            if round_idx % self.eval_freq == 0 or round_idx == self.comm_rounds - 1:
+            if self._is_log_round(round_idx):
+                # flush is called AT the log round, so self.state is this
+                # round's state and the eval matches the old cadence
                 test_loss, test_acc = self.evaluate()
                 record.update(test_loss=test_loss, test_acc=test_acc)
                 log.info("round %d: train_loss=%.4f test_acc=%.4f (%.2fs)",
-                         round_idx, train_loss, test_acc, record["round_time"])
+                         round_idx, train_loss, test_acc,
+                         record["round_time"])
             log_round_info(round_idx, record)
             self.metrics_history.append(record)
-            self.maybe_checkpoint(round_idx)
+
+    def _train_fused(self, start_round: int):
+        """Fused driver: ``round_block`` rounds per dispatch, one host sync
+        per block (the stacked ``(k,)`` metrics), cohorts for block ``b+1``
+        staged on the worker thread while block ``b`` runs."""
+        r = start_round
+        while r < self.comm_rounds:
+            event("train", started=True, round_idx=r)
+            t0 = time.time()
+            k, ms = self.train_block(r)
+            # ONE sync per block: materializing the stacked losses waits
+            # for the whole block's compiled program
+            losses = np.asarray(ms["train_loss"])
+            block_dt = time.time() - t0
+            event("train", started=False, round_idx=r)
+            eval_due = any(self._is_log_round(ri) for ri in range(r, r + k))
+            for j in range(k):
+                ri = r + j
+                record = {"round": ri, "train_loss": float(losses[j]),
+                          "round_time": block_dt / k,
+                          "dataset_provenance": getattr(
+                              self.dataset, "provenance", "unknown")}
+                if j == k - 1 and eval_due:
+                    test_loss, test_acc = self.evaluate()
+                    record.update(test_loss=test_loss, test_acc=test_acc)
+                    log.info(
+                        "round %d: train_loss=%.4f test_acc=%.4f "
+                        "(block of %d, %.2fs)", ri, record["train_loss"],
+                        test_acc, k, block_dt)
+                log_round_info(ri, record)
+                self.metrics_history.append(record)
+            self.maybe_checkpoint(r + k - 1, window=k)
+            r += k
+
+    def train(self):
+        t_start = time.time()
+        start_round = self.maybe_resume()
+        if self._round_block > 1:
+            self._train_fused(start_round)
+        else:
+            pending = []
+            for round_idx in range(start_round, self.comm_rounds):
+                event("train", started=True, round_idx=round_idx)
+                t0 = time.time()
+                metrics = self.train_one_round(round_idx)
+                event("train", started=False, round_idx=round_idx)
+                pending.append((round_idx, metrics, time.time() - t0))
+                if self._is_log_round(round_idx):
+                    self._flush_round_records(pending)
+                self.maybe_checkpoint(round_idx)
+            self._flush_round_records(pending)
         total = time.time() - t_start
         log.info("finished %d rounds in %.1fs (%.3fs/round)",
                  self.comm_rounds, total, total / max(self.comm_rounds, 1))

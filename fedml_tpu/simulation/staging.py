@@ -1,0 +1,83 @@
+"""Host→device cohort staging that overlaps device compute.
+
+``AsyncCohortStager`` double-buffers the host-side cohort build (sampling,
+batch-index materialization, padding, device transfer): while the compiled
+program for round/block ``r`` runs, a single worker thread builds and stages
+``r+1`` so host work overlaps device compute instead of serializing in front
+of every dispatch.  Both the per-round mesh path and the fused round-block
+drivers (``args.round_block``) stage through this class — fused blocks key
+the stager by the block's first round index.
+
+Failure semantics (hardened in ISSUE 3): a ``build`` exception on the worker
+thread re-raises at the NEXT ``get()`` regardless of which round it was
+speculatively built for, stale pending futures for already-passed rounds are
+dropped, and ``close()`` is idempotent (a closed stager degrades to
+synchronous builds instead of raising on a shut-down executor).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+class AsyncCohortStager:
+    """Double-buffered host→device cohort staging.
+
+    ``build(round_idx)`` must be a pure function of the round index that
+    returns the staged (device_put) round inputs.
+    """
+
+    def __init__(self, build, enabled: bool = True):
+        self._build = build
+        self._enabled = enabled
+        self._pool = ThreadPoolExecutor(max_workers=1) if enabled else None
+        self._pending = {}
+        self._failed = None   # first uncollected worker-thread exception
+        self._closed = False
+
+    def _worker_build(self, round_idx: int):
+        try:
+            return self._build(round_idx)
+        except BaseException as e:  # surfaced via _failed at the next get()
+            if self._failed is None:
+                self._failed = e
+            raise
+
+    def get(self, round_idx: int, prefetch=None):
+        # a pending future for an already-passed round can never be
+        # consumed — drop it so it neither leaks nor masks a failure
+        for stale in [r for r in self._pending if r < round_idx]:
+            self._pending.pop(stale).cancel()
+        fut = self._pending.pop(round_idx, None)
+        if self._failed is not None and fut is None:
+            # a speculative build (possibly for a LATER round) already
+            # failed: re-raise promptly instead of waiting until the driver
+            # reaches that round
+            err, self._failed = self._failed, None
+            for f in self._pending.values():
+                f.cancel()
+            self._pending.clear()
+            raise err
+        if fut is not None:
+            try:
+                staged = fut.result()
+            except BaseException:
+                # this failure is being delivered right here; don't
+                # re-deliver it on the next get()
+                self._failed = None
+                raise
+        else:
+            staged = self._build(round_idx)
+        if self._enabled and not self._closed and prefetch is not None \
+                and prefetch not in self._pending:
+            self._pending[prefetch] = self._pool.submit(
+                self._worker_build, prefetch)
+        return staged
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._pending.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)

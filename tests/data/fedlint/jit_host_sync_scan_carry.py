@@ -1,0 +1,39 @@
+"""Fixture: the fused round-block's scan-carry metrics pattern.
+
+Per-round metrics accumulate through the ``lax.scan`` carry / stacked
+outputs and convert to host floats ONCE per block, OUTSIDE the jit (the
+``round_block`` driver contract) — no findings.  The leaky variant syncs
+inside the scanned body, which under jit is a trace error or a per-round
+host round-trip — flagged.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def fused_block(state, losses_blk):
+    """K rounds as one program: metrics ride the carry, stacked per round."""
+    def step(carry, loss):
+        state, loss_sum = carry
+        return (state - loss, loss_sum + loss), loss
+
+    (state, loss_sum), per_round = jax.lax.scan(
+        step, (state, jnp.zeros(())), losses_blk)
+    return state, loss_sum, per_round
+
+
+@jax.jit
+def leaky_block(state, losses_blk):
+    def step(carry, loss):
+        scale = float(loss)          # host sync inside the scanned body
+        return carry + scale, loss
+
+    out, per_round = jax.lax.scan(step, state, losses_blk)
+    return out, per_round
+
+
+def block_driver(losses_blk):
+    # ONE sync per block, at the host boundary: the stacked (K,) metrics
+    # materialize together after the compiled block completes
+    state, loss_sum, per_round = fused_block(jnp.zeros(()), losses_blk)
+    return float(loss_sum), [float(l) for l in per_round]

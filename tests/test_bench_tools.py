@@ -67,6 +67,22 @@ def test_bench_update_sharding_quick(monkeypatch):
     assert out["scatter_speedup"] > 0
 
 
+def test_bench_round_fusion_quick(monkeypatch):
+    """bench.py --fused smoke: the K=8 fused round-block runs green through
+    the bench harness and reports both dispatch modes' wall-clock plus the
+    round_block provenance field (tier-1 exercises the fused scan path
+    end-to-end; the >=1.2x acceptance number comes from the full-size
+    run, not this trimmed cohort)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_FUSED_QUICK", "1")
+    out = bench.bench_round_fusion()
+    assert out["quick"] is True
+    assert out["round_block"] == 8
+    assert out["unfused_s_per_round"] > 0
+    assert out["fused_s_per_round"] > 0
+    assert out["fused_speedup"] > 0
+
+
 def test_controller_validates_platform_from_last_json_line(tmp_path):
     """The controller must accept an artifact only when its final JSON
     line self-reports TPU — progress lines before the payload (the serve

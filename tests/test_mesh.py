@@ -196,6 +196,34 @@ def test_mesh_round_compiles_once():
         f"{audit.compiled}")
 
 
+def test_mesh_fused_block_compiles_once():
+    """ISSUE 3 acceptance: the fused mesh round-block (round_block=K as one
+    jit(lax.scan) dispatch) must add ZERO XLA compilations across
+    consecutive steady-state blocks (homo partition → every block pads to
+    the same pow2 step class, so the block program compiles exactly once
+    and the tail never appears when K divides comm_round)."""
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu import data as data_mod, device as device_mod, \
+        model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    args = fedml_tpu.init(args_for("mesh", rounds=12))
+    args.update(partition_method="homo", round_block=4)
+    dev = device_mod.get_device(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api = MeshFedAvgAPI(args, dev, dataset, model)
+    assert api.n_shards == 8 and api.update_sharding == "scatter"
+
+    api.train_block(0)   # traces + compiles the block program
+    api.train_block(4)   # warms any second-block-only eager ops
+    with JaxRuntimeAudit() as audit:
+        api.train_block(8)
+    assert audit.compilations == 0, (
+        f"steady-state fused block recompiled {audit.compilations}x: "
+        f"{audit.compiled}")
+
+
 def test_mesh_engine_per_client_eval():
     """evaluate_per_client (inherited from the sp API) works on the mesh
     engine: replicated global params scored per client shard."""

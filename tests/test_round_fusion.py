@@ -1,0 +1,316 @@
+"""Round-block fusion (ISSUE 3): ``args.round_block = K`` executes K
+federated rounds as ONE ``jit(lax.scan(...))`` dispatch, with per-client
+SCAFFOLD/FedDyn state in a device-resident dense table instead of the old
+host dict.
+
+Pinned here:
+
+- fused K-block ≡ per-round dispatch (same seed → identical per-round
+  losses + params within the PR 1 parity bar) for fedavg/fedopt/scaffold/
+  feddyn on BOTH the SP engine and the 8-shard scatter-mode mesh,
+  including a ragged tail block (``comm_rounds % K != 0``);
+- the dense client-state table reproduces the host-dict semantics
+  (zeros for never-sampled clients, rows persist across non-sampled
+  rounds, padded cohort rows never touch real rows) and survives
+  checkpoint round-trips;
+- the hardened ``AsyncCohortStager`` failure path (prompt re-raise,
+  stale-future drop, idempotent close).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.simulation.staging import AsyncCohortStager
+
+ALGS = ["FedAvg", "FedOpt", "SCAFFOLD", "FedDyn"]
+
+
+def args_for(rounds=5, **over):
+    args = load_arguments()
+    args.update(
+        dataset="synthetic", num_classes=10, input_shape=(28, 28, 1),
+        train_size=1024, test_size=256, model="lr",
+        client_num_in_total=16, client_num_per_round=8, comm_round=rounds,
+        epochs=1, batch_size=16, learning_rate=0.1, random_seed=7,
+        frequency_of_the_test=10 ** 9,
+    )
+    args.update(**over)
+    return args
+
+
+def make_api(backend, rounds=5, **over):
+    from fedml_tpu import data as data_mod, model as model_mod
+
+    args = fedml_tpu.init(args_for(rounds=rounds, **over))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    if backend == "mesh":
+        from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+        return MeshFedAvgAPI(args, None, dataset, model)
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+    return FedAvgAPI(args, None, dataset, model)
+
+
+def run_per_round(api, rounds):
+    return [round(float(api.train_one_round(r)["train_loss"]), 6)
+            for r in range(rounds)]
+
+
+def run_fused(api, rounds):
+    losses, r = [], 0
+    while r < rounds:
+        k, ms = api.train_block(r)
+        losses += [round(float(x), 6) for x in np.asarray(ms["train_loss"])]
+        r += k
+    return losses
+
+
+def assert_params_close(a, b, atol=2e-5):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=atol, rtol=1e-4)
+
+
+# -- fused ≡ per-round parity ----------------------------------------------
+
+@pytest.mark.parametrize("opt", ALGS)
+@pytest.mark.parametrize("backend", ["sp", "mesh"])
+def test_fused_block_matches_per_round(backend, opt):
+    """K=2 over 5 rounds: blocks of 2+2+1 — the final ragged block reuses
+    the same traced block fn at a smaller K.  Losses must match the
+    per-round path exactly (same per-round keys, same cohort tensors) and
+    params within the PR 1 parity bar."""
+    ref = make_api(backend, federated_optimizer=opt, round_block=1)
+    ref_losses = run_per_round(ref, 5)
+    fused = make_api(backend, federated_optimizer=opt, round_block=2)
+    if backend == "mesh":
+        assert fused.n_shards == 8 and fused.update_sharding == "scatter"
+    fused_losses = run_fused(fused, 5)
+    assert ref_losses == fused_losses, (opt, ref_losses, fused_losses)
+    assert_params_close(ref.state.global_params, fused.state.global_params)
+
+
+def test_fused_train_driver_end_to_end():
+    """``train()`` with round_block=3 over 5 rounds (3+2 blocks): one
+    record per ROUND with host-float losses, same curve as the unfused
+    driver, eval attached at the block boundary."""
+    ref = make_api("sp", federated_optimizer="SCAFFOLD", round_block=1,
+                   frequency_of_the_test=2)
+    ref.train()
+    fused = make_api("sp", federated_optimizer="SCAFFOLD", round_block=3,
+                     frequency_of_the_test=2)
+    fused.train()
+    assert [r["round"] for r in fused.metrics_history] == list(range(5))
+    ref_losses = [round(r["train_loss"], 6) for r in ref.metrics_history]
+    fused_losses = [round(r["train_loss"], 6) for r in fused.metrics_history]
+    assert ref_losses == fused_losses
+    assert all(isinstance(r["train_loss"], float)
+               for r in fused.metrics_history)
+    assert_params_close(ref.state.global_params, fused.state.global_params)
+    # eval lands on the last round of any block containing a log round
+    assert "test_acc" in fused.metrics_history[2]   # block 0..2 (round 2 due)
+    assert "test_acc" in fused.metrics_history[4]   # final block
+
+    # the unfused driver defers the float() sync to log rounds but must
+    # still record every round as floats
+    assert [r["round"] for r in ref.metrics_history] == list(range(5))
+    assert all(isinstance(r["train_loss"], float)
+               for r in ref.metrics_history)
+
+
+def test_round_block_rejects_unfusable_configs():
+    with pytest.raises(ValueError, match="unbucketed"):
+        make_api("sp", round_block=4, cohort_bucketing=True)
+    # host-data mode: block staging would ship whole cohorts, not indices
+    api = make_api("sp", round_block=4, device_data=False)
+    with pytest.raises(ValueError, match="device-gather"):
+        api.train_block(0)
+    # a subclass with its own round loop must refuse the flag loudly
+    from fedml_tpu.simulation.sp.hierarchical_fl import HierarchicalFedAvgAPI
+    from fedml_tpu import data as data_mod, model as model_mod
+    args = fedml_tpu.init(args_for(group_num=4, group_comm_round=2,
+                                   round_block=4))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    with pytest.raises(ValueError, match="round_block"):
+        HierarchicalFedAvgAPI(args, None, dataset, model)
+
+
+# -- dense client-state table semantics ------------------------------------
+
+def _table_rows_abs(table):
+    """Per-row max |value| over all leaves: (rows,) numpy array."""
+    rows = None
+    for leaf in jax.tree_util.tree_leaves(table):
+        a = np.abs(np.asarray(leaf)).reshape(leaf.shape[0], -1).max(axis=1)
+        rows = a if rows is None else np.maximum(rows, a)
+    return rows
+
+
+def test_client_table_matches_host_dict_semantics(monkeypatch):
+    """The device table must reproduce the old ``{client: pytree}`` dict:
+    zeros for never-sampled clients, rows persist while a client sits out,
+    rows update when it is resampled."""
+    api = make_api("sp", federated_optimizer="SCAFFOLD", rounds=4)
+    cohorts = {0: np.array([0, 1, 2, 3, 4, 5, 6, 7]),
+               1: np.array([0, 1, 2, 3, 8, 9, 10, 11]),
+               2: np.array([4, 5, 6, 7, 8, 9, 10, 11])}
+    monkeypatch.setattr(api, "_client_sampling", lambda r: cohorts[r])
+    api.train_one_round(0)
+    after0 = _table_rows_abs(api.client_table)
+    assert (after0[:8] > 0).all(), "sampled clients must be written"
+    assert (after0[8:] == 0).all(), "never-sampled clients must stay zero"
+    row7_r0 = np.asarray(jax.tree_util.tree_leaves(api.client_table)[0][7])
+
+    api.train_one_round(1)
+    after1 = _table_rows_abs(api.client_table)
+    assert (after1[8:12] > 0).all()
+    assert (after1[12:] == 0).all()
+    row7_r1 = np.asarray(jax.tree_util.tree_leaves(api.client_table)[0][7])
+    np.testing.assert_array_equal(row7_r0, row7_r1,
+                                  "client 7 sat out round 1: row must "
+                                  "persist unchanged (dict semantics)")
+
+    api.train_one_round(2)
+    row7_r2 = np.asarray(jax.tree_util.tree_leaves(api.client_table)[0][7])
+    assert np.abs(row7_r2 - row7_r1).max() > 0, \
+        "client 7 resampled in round 2: row must update"
+
+
+def test_mesh_padded_cohort_never_corrupts_table():
+    """6-of-16 cohort on 8 shards → 2 sentinel pad rows per round.  Pad
+    writes must drop: unsampled clients' rows stay exactly zero and the
+    curve matches the SP engine under the same seed."""
+    sp = make_api("sp", federated_optimizer="SCAFFOLD",
+                  client_num_per_round=6, rounds=3)
+    sp_losses = run_per_round(sp, 3)
+    mesh = make_api("mesh", federated_optimizer="SCAFFOLD",
+                    client_num_per_round=6, rounds=3)
+    mesh_losses = run_per_round(mesh, 3)
+    assert sp_losses == mesh_losses
+    assert_params_close(sp.state.global_params, mesh.state.global_params)
+    sampled = set()
+    for r in range(3):
+        sampled |= set(int(c) for c in mesh._client_sampling(r))
+    rows = _table_rows_abs(mesh.client_table)
+    for c in range(mesh.dataset.num_clients):
+        if c not in sampled:
+            assert rows[c] == 0, f"unsampled client {c} row written"
+    # SP and mesh tables agree row-for-row on the real clients
+    sp_rows = _table_rows_abs(sp.client_table)
+    np.testing.assert_allclose(rows[:16], sp_rows, atol=2e-5, rtol=1e-4)
+
+
+def test_client_table_checkpoint_roundtrip(tmp_path):
+    """The dense table checkpoints/restores as one pytree (replacing the
+    legacy per-client dict layout) and training continues on the same
+    curve as an uninterrupted run."""
+    ck = str(tmp_path / "ck")
+    api = make_api("sp", federated_optimizer="SCAFFOLD",
+                   checkpoint_dir=ck, checkpoint_freq=1)
+    for r in range(2):
+        api.train_one_round(r)
+    api.maybe_checkpoint(1)
+
+    api2 = make_api("sp", federated_optimizer="SCAFFOLD",
+                    checkpoint_dir=ck, checkpoint_freq=1)
+    start = api2.maybe_resume()
+    assert start == 2
+    for a, b in zip(jax.tree_util.tree_leaves(api.client_table),
+                    jax.tree_util.tree_leaves(api2.client_table)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    uninterrupted = make_api("sp", federated_optimizer="SCAFFOLD")
+    for r in range(3):
+        uninterrupted.train_one_round(r)
+    api2.train_one_round(2)
+    assert_params_close(uninterrupted.state.global_params,
+                        api2.state.global_params)
+
+
+# -- AsyncCohortStager failure semantics -----------------------------------
+
+def _wait_for(cond, timeout=5.0):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.01)
+
+
+def test_stager_reraises_worker_failure_promptly():
+    """A build exception on the worker thread must surface at the NEXT
+    get(), not silently wait until the driver reaches the failed round."""
+    def build(r):
+        if r == 1:
+            raise RuntimeError("boom round 1")
+        return f"cohort-{r}"
+
+    s = AsyncCohortStager(build, enabled=True)
+    try:
+        assert s.get(0, prefetch=1) == "cohort-0"   # round 1 builds async
+        _wait_for(lambda: s._failed is not None)
+        # driver jumps to round 2 (round 1's future is now stale):
+        # the failure must re-raise HERE, not be dropped with the future
+        with pytest.raises(RuntimeError, match="boom round 1"):
+            s.get(2, prefetch=3)
+        # delivered once: the stager recovers afterwards
+        assert s.get(2) == "cohort-2"
+    finally:
+        s.close()
+
+
+def test_stager_delivers_failure_at_its_own_round_once():
+    calls = []
+
+    def build(r):
+        calls.append(r)
+        if r == 1:
+            raise RuntimeError("boom")
+        return r
+
+    s = AsyncCohortStager(build, enabled=True)
+    try:
+        assert s.get(0, prefetch=1) == 0
+        with pytest.raises(RuntimeError, match="boom"):
+            s.get(1, prefetch=2)
+        # the failure was consumed; later rounds proceed normally
+        assert s.get(2) == 2
+        assert s.get(3) == 3
+    finally:
+        s.close()
+
+
+def test_stager_drops_stale_pending_futures():
+    s = AsyncCohortStager(lambda r: r, enabled=True)
+    try:
+        s.get(0, prefetch=1)
+        _wait_for(lambda: 1 in s._pending and s._pending[1].done())
+        # driver skipped ahead: round 1's staged cohort can never be used
+        assert s.get(5, prefetch=6) == 5
+        assert 1 not in s._pending
+    finally:
+        s.close()
+
+
+def test_stager_close_is_idempotent_and_degrades_to_sync():
+    s = AsyncCohortStager(lambda r: r * 10, enabled=True)
+    s.get(0, prefetch=1)
+    s.close()
+    s.close()                       # second close must be a no-op
+    assert s.get(7, prefetch=8) == 70   # synchronous build, no new prefetch
+    assert 8 not in s._pending
+
+
+def test_stager_disabled_builds_synchronously():
+    s = AsyncCohortStager(lambda r: -r, enabled=False)
+    assert s.get(3, prefetch=4) == -3
+    assert not s._pending
+    s.close()
+    s.close()

@@ -21,6 +21,12 @@ N-way redundant update) — at 256 clients/round on an 8-shard mesh (virtual
 CPU devices when no accelerator provides 8), one json line with both
 wall-clocks.
 
+``python bench.py --trace`` measures the fedtrace observability plane:
+steady-state s/round untraced vs. traced (acceptance: <5% overhead) plus the
+``tools/fedtrace.py summarize`` per-phase round breakdown folded into the
+json line (docs/OBSERVABILITY.md); FEDML_TRACE_OUT=path keeps the Chrome
+trace.
+
 ``vs_baseline``: the reference has no published numbers (BASELINE.md), so the
 ratio is measured against an in-process torch-CPU eager reimplementation of
 the reference's client loop (``my_model_trainer_classification.py``
@@ -404,6 +410,107 @@ def bench_round_fusion(rounds: int | None = None,
             round(dt, 5)
     out["fused_speedup"] = round(
         out["unfused_s_per_round"] / out["fused_s_per_round"], 3)
+    return out
+
+
+# -- fedtrace overhead + breakdown benchmark (--trace) -----------------------
+def _import_fedtrace():
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import fedtrace
+    return fedtrace
+
+
+def bench_trace(rounds: int | None = None,
+                clients_per_round: int | None = None) -> dict:
+    """--trace: cost and content of the fedtrace plane on the 256-client
+    MNIST-LR config.  Times steady-state rounds untraced vs. traced (the
+    acceptance bar is <5% overhead — tracing adds host span bookkeeping
+    only, never a device sync or compile), then drives one traced
+    ``train()`` so the capture carries round/staging spans plus the
+    per-round ObsCarry counters, and folds ``tools/fedtrace.py
+    summarize``'s per-phase breakdown into the bench JSON.
+    FEDML_TRACE_QUICK=1 shrinks the cohort for smoke tests;
+    FEDML_TRACE_OUT=path additionally writes the Chrome trace file."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod, obs
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    quick = os.environ.get("FEDML_TRACE_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (3 if quick else ROUNDS_TIMED)
+    out = {"clients_per_round": cpr, "quick": quick}
+    rtt = None
+
+    def make_api():
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total, client_num_per_round=cpr,
+            comm_round=10 ** 6, epochs=1, batch_size=BATCH,
+            learning_rate=0.03, partition_method="homo",
+            frequency_of_the_test=10 ** 9, random_seed=0,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        return FedAvgAPI(args, None, dataset, model, client_mode="vmap")
+
+    try:
+        # ONE api, interleaved untraced/traced timings, min of each pair:
+        # on a loaded 1-core host, two separately-built apis measured
+        # minutes apart read ~15-20% apart from load drift alone — the
+        # overhead question is about the tracer, so toggle ONLY the tracer
+        api = make_api()
+        api.train_one_round(0)  # compile
+        api.train_one_round(1)
+        _readback(api.state.global_params)
+        rtt = measure_rtt()
+        rounds_done = [2]
+
+        def run_n(n):
+            for _ in range(n):
+                api.train_one_round(rounds_done[0])
+                rounds_done[0] += 1
+
+        samples = {False: [], True: []}
+        for traced in (False, True, False, True):
+            obs.configure(enabled=traced, reset=traced)
+            samples[traced].append(_timed_chain(
+                run_n, lambda: _readback(api.state.global_params),
+                min_total_s=0.5 if quick else 2.0, n0=timed_rounds,
+                rtt=rtt))
+        out["untraced_s_per_round"] = round(min(samples[False]), 5)
+        out["traced_s_per_round"] = round(min(samples[True]), 5)
+        out["timing_samples"] = {
+            "untraced": [round(s, 5) for s in samples[False]],
+            "traced": [round(s, 5) for s in samples[True]]}
+
+        # a short traced train() run so the capture flushes the per-round
+        # ObsCarry counters (the timed loop above defers them); rounds are
+        # pure functions of the index, so re-running 0..N on the warm
+        # program is cheap and deterministic
+        obs.configure(enabled=True, reset=True)
+        api.comm_rounds = 4 if quick else 8
+        api.eval_freq = 2
+        api.train()
+        trace = obs.get_tracer().export_chrome()
+        summary = _import_fedtrace().summarize(trace)
+        out["phases"] = summary["phases"]
+        out["trace_rounds"] = summary["rounds"]
+        out["trace_events"] = len(trace["traceEvents"])
+        tp = os.environ.get("FEDML_TRACE_OUT")
+        if tp:
+            obs.get_tracer().export_chrome(tp)
+            out["trace_path"] = tp
+    finally:
+        obs.configure(enabled=False)
+    out["trace_overhead_pct"] = round(
+        100.0 * (out["traced_s_per_round"] / out["untraced_s_per_round"]
+                 - 1.0), 2)
     return out
 
 
@@ -882,6 +989,19 @@ def main():
             "value": result["scatter_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["scatter_speedup"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--trace" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_trace()
+        result.update({
+            "metric": "fedtrace_overhead_and_breakdown",
+            "value": result["trace_overhead_pct"],
+            "unit": "pct_overhead_traced_vs_untraced",
+            "vs_baseline": None,
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -9,8 +9,11 @@ Python scalar that should be a traced array, a fresh closure handed to
 ``jax.jit``) and turns a 0.2 s round into a 20 s one on a real TPU — the
 exact regression class PR 1's pow2 step padding exists to prevent.
 
-Compilations are observed through jax's monitoring hooks
-(``/jax/core/compile/backend_compile_duration`` fires once per XLA backend
+Compilations are observed through the shared :mod:`fedml_tpu.obs.jaxhooks`
+monitoring hub (ONE process-wide jax listener fanned out to subscribers —
+the fedtrace tracer attaches to the same hub, so audits and Perfetto
+traces see the identical compile stream;
+``/jax/core/compile/backend_compile_duration`` fires once per XLA backend
 compile, cache misses only).  Explicit transfers are counted by wrapping
 ``jax.device_put`` / ``jax.device_get`` for the duration of the scope —
 implicit syncs (``float(arr)``, ``np.asarray(arr)``) go through the C++
@@ -25,7 +28,9 @@ Usage::
     assert audit.compilations == 0, audit.compiled
 
 ``tests/test_mesh.py::test_mesh_round_compiles_once`` pins the mesh engine
-to exactly this contract.
+to exactly this contract, and ``tests/test_fedtrace.py`` uses the same
+auditor to pin the fedtrace overhead contract (tracing on adds zero
+compiles and zero explicit transfers).
 """
 
 from __future__ import annotations
@@ -35,7 +40,9 @@ from typing import List, Optional
 
 import jax
 
-_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+from ..obs import jaxhooks
+
+_BACKEND_COMPILE_EVENT = jaxhooks.BACKEND_COMPILE_EVENT
 
 
 class JaxRuntimeAudit:
@@ -49,9 +56,9 @@ class JaxRuntimeAudit:
       entries are the event key — the *count* is the contract).
     - ``device_puts`` / ``device_gets`` — explicit transfer calls.
 
-    Listener de-registration uses the supported private helper when
-    present; otherwise the listener stays registered but inert (guarded by
-    ``self._active``), which is safe for test processes.
+    The hub's jax listener registers once per process and stays
+    registered; this auditor merely subscribes/unsubscribes its callback
+    (guarded by ``self._active``), so nested or repeated scopes are safe.
     """
 
     def __init__(self):
@@ -64,8 +71,9 @@ class JaxRuntimeAudit:
         self._orig_put = None
         self._orig_get = None
 
-    # -- monitoring hook ---------------------------------------------------
-    def _on_event_duration(self, event: str, duration: float, **kw) -> None:
+    # -- monitoring hub callback -------------------------------------------
+    def _on_event_duration(self, event: str, duration: float = 0.0,
+                           **kw) -> None:
         if not self._active or event != _BACKEND_COMPILE_EVENT:
             return
         with self._lock:
@@ -73,8 +81,7 @@ class JaxRuntimeAudit:
             self.compiled.append(event)
 
     def __enter__(self) -> "JaxRuntimeAudit":
-        jax.monitoring.register_event_duration_secs_listener(
-            self._on_event_duration)
+        jaxhooks.subscribe(self._on_event_duration)
         self._active = True
 
         audit = self
@@ -96,12 +103,7 @@ class JaxRuntimeAudit:
     def __exit__(self, *exc) -> Optional[bool]:
         self._active = False
         jax.device_put, jax.device_get = self._orig_put, self._orig_get
-        try:  # best-effort unregister (private API, version-guarded)
-            from jax._src import monitoring as _mon
-            _mon._unregister_event_duration_listener_by_callback(
-                self._on_event_duration)
-        except Exception:
-            pass
+        jaxhooks.unsubscribe(self._on_event_duration)
         return None
 
 

@@ -129,6 +129,10 @@ _DEFAULTS: Dict[str, Any] = dict(
     update_sharding="auto",
     # double-buffered host->device cohort staging (mesh engine)
     async_staging=True,
+    # fedtrace round-telemetry plane (docs/OBSERVABILITY.md): trace=True
+    # enables the global tracer; trace_path sets the Chrome-trace output
+    trace=False,
+    trace_path=None,
     compute_dtype="float32",
     clients_per_device=1,
 )

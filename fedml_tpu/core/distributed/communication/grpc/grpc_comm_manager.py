@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 import grpc
 
+from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message, encode_tree, decode_tree
 
@@ -95,7 +96,13 @@ class GRPCCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message):
         data = _serialize_message(msg)
-        self._stub(msg.get_receiver_id())(data, wait_for_ready=True, timeout=300)
+        # fedtrace RTT span: the unary call blocks until the receiver acks,
+        # so the span duration IS the message round-trip
+        with get_tracer().span("comm.send", cat="comm", backend="grpc",
+                               dst=msg.get_receiver_id(),
+                               nbytes=len(data)):
+            self._stub(msg.get_receiver_id())(data, wait_for_ready=True,
+                                              timeout=300)
 
     # -- loop --------------------------------------------------------------
     def add_observer(self, observer: Observer):

@@ -15,6 +15,7 @@ import threading
 from collections import defaultdict
 from typing import Dict, List
 
+from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message
 
@@ -39,9 +40,12 @@ class LocalCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message):
         receiver = msg.get_receiver_id()
-        with _REGISTRY_LOCK:
-            q = _REGISTRY[self.run_id].setdefault(receiver, queue.Queue())
-        q.put(msg)
+        with get_tracer().span("comm.send", cat="comm", backend="local",
+                               dst=receiver):
+            with _REGISTRY_LOCK:
+                q = _REGISTRY[self.run_id].setdefault(receiver,
+                                                      queue.Queue())
+            q.put(msg)
 
     def add_observer(self, observer: Observer):
         self._observers.append(observer)

@@ -22,6 +22,7 @@ import time
 import uuid
 from typing import List
 
+from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message, encode_tree, decode_tree, MSG_ARG_KEY_MODEL_PARAMS
 
@@ -91,13 +92,17 @@ class MqttS3CommManager(BaseCommunicationManager):
 
     # -- BaseCommunicationManager -----------------------------------------
     def send_message(self, msg: Message):
-        params = dict(msg.get_params())
-        model = params.pop(MSG_ARG_KEY_MODEL_PARAMS, None)
-        if model is not None:
-            params["model_params_key"] = self._put_blob(model)
-        self._client.publish(
-            self._topic(msg.get_sender_id(), msg.get_receiver_id()),
-            json.dumps(params, default=float), qos=2)
+        # fedtrace span covers the blob store write + broker publish (the
+        # two wire legs of the reference's split transport)
+        with get_tracer().span("comm.send", cat="comm", backend="mqtt",
+                               dst=msg.get_receiver_id()):
+            params = dict(msg.get_params())
+            model = params.pop(MSG_ARG_KEY_MODEL_PARAMS, None)
+            if model is not None:
+                params["model_params_key"] = self._put_blob(model)
+            self._client.publish(
+                self._topic(msg.get_sender_id(), msg.get_receiver_id()),
+                json.dumps(params, default=float), qos=2)
 
     def _on_message(self, client, userdata, mqtt_msg):
         params = json.loads(mqtt_msg.payload)

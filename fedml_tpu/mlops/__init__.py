@@ -15,6 +15,7 @@ import json
 import logging
 import os
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
 _logger = logging.getLogger("fedml_tpu.mlops")
@@ -38,6 +39,31 @@ def register_exporter(fn):
     """Exporters receive every structured record (the MQTT/HTTP uploaders of
     the reference attach here)."""
     _state["exporters"].append(fn)
+
+
+def unregister_exporter(fn) -> bool:
+    """Detach an exporter previously passed to :func:`register_exporter`.
+    Returns whether it was attached (idempotent — a second call is a
+    no-op, not an error)."""
+    try:
+        _state["exporters"].remove(fn)
+        return True
+    except ValueError:
+        return False
+
+
+@contextmanager
+def capture_events():
+    """Scoped exporter: collect every record emitted inside the ``with``
+    into the yielded list, detaching on exit even on exceptions.  The
+    supported test/tooling pattern (replacing ad-hoc
+    ``_state["exporters"].remove(...)`` teardown)."""
+    records: list = []
+    register_exporter(records.append)
+    try:
+        yield records
+    finally:
+        unregister_exporter(records.append)
 
 
 def _emit(record: Dict[str, Any]):

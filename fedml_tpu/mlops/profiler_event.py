@@ -3,20 +3,36 @@
 started/ended span events onto the metrics bus, optionally mirrored to
 wandb).
 
+Rebuilt on :class:`fedml_tpu.obs.Tracer` (ISSUE 4): every span also lands
+in the fedtrace Chrome-trace timeline (category ``mlops``) when tracing
+is enabled, so framework phases line up with staging/compile/comm lanes
+in Perfetto.  Nesting is explicit — each name keeps a LIFO stack of
+start times, so reentrant spans (``started(a); started(a); ended(a);
+ended(a)``) pair innermost-first instead of silently overwriting the
+open-start timestamp.  An ``ended`` with no matching ``started`` warns
+once per name and reports duration 0.
+
 TPU-era addition: when ``sys_perf_profiling`` is on and a trace dir is
 configured, spans also drive ``jax.profiler`` start/stop_trace so XLA/TPU
 timelines line up with the framework's round phases."""
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Set
 
 from . import _emit
+from ..obs import get_tracer
 
 EVENT_TYPE_STARTED = 0
 EVENT_TYPE_ENDED = 1
+
+_log = logging.getLogger(__name__)
+#: names already warned about (mismatched end) — warn ONCE per name so a
+#: per-round mismatch doesn't flood the training log
+_warned_unmatched: Set[str] = set()
 
 
 class MLOpsProfilerEvent:
@@ -31,14 +47,22 @@ class MLOpsProfilerEvent:
             return cls._instance
 
     def __init__(self, trace_dir: Optional[str] = None):
-        self._open: Dict[str, float] = {}
+        # name -> LIFO stack of start times (reentrant spans pair
+        # innermost-first; the old single-slot dict silently dropped the
+        # outer start on reentry)
+        self._open: Dict[str, List[float]] = {}
         self.trace_dir = trace_dir
         self._tracing = False
+
+    def _any_open(self) -> bool:
+        return any(self._open.values())
 
     def log_event_started(self, event_name: str,
                           event_value: Optional[str] = None,
                           event_edge_id: Optional[int] = None) -> None:
-        self._open[event_name] = time.time()
+        self._open.setdefault(event_name, []).append(time.time())
+        get_tracer().begin(event_name, cat="mlops", value=event_value,
+                           edge_id=event_edge_id)
         _emit({"kind": "span", "event_type": EVENT_TYPE_STARTED,
                "name": event_name, "value": event_value,
                "edge_id": event_edge_id})
@@ -53,12 +77,24 @@ class MLOpsProfilerEvent:
     def log_event_ended(self, event_name: str,
                         event_value: Optional[str] = None,
                         event_edge_id: Optional[int] = None) -> float:
-        t0 = self._open.pop(event_name, None)
-        dur = (time.time() - t0) if t0 is not None else 0.0
+        stack = self._open.get(event_name)
+        if stack:
+            t0 = stack.pop()
+            dur = time.time() - t0
+            get_tracer().end(event_name)
+        else:
+            # unmatched (or over-popped reentrant) end: explicit, once
+            if event_name not in _warned_unmatched:
+                _warned_unmatched.add(event_name)
+                _log.warning(
+                    "log_event_ended(%r) without a matching "
+                    "log_event_started — span dropped (warning once per "
+                    "name)", event_name)
+            dur = 0.0
         _emit({"kind": "span", "event_type": EVENT_TYPE_ENDED,
                "name": event_name, "value": event_value,
                "edge_id": event_edge_id, "duration_s": dur})
-        if self.trace_dir and self._tracing and not self._open:
+        if self.trace_dir and self._tracing and not self._any_open():
             try:
                 import jax
                 jax.profiler.stop_trace()

@@ -1,0 +1,53 @@
+"""fedtrace — the sync-free round-telemetry plane (ISSUE 4).
+
+Three layers, one overhead contract (ZERO extra host syncs, ZERO extra
+steady-state compiles on the round hot path — pinned by the
+``JaxRuntimeAudit``-based tests in ``tests/test_fedtrace.py``):
+
+1. **Device-carry metrics** (:mod:`.carry`): a fixed-shape
+   :class:`ObsCarry` pytree (per-phase FLOP weights, cohort counters,
+   update norm) computed INSIDE the compiled round and returned through
+   the existing metrics pytree, so it rides the same ``jit``/``lax.scan``
+   outputs the loss does and materializes only on the driver's existing
+   eval/log-round syncs.
+2. **Host spans + counters** (:mod:`.tracer`): a thread-safe
+   :class:`Tracer` recording staging spans + queue depth, XLA compile
+   events with durations (through the shared :mod:`.jaxhooks` monitoring
+   hub the runtime auditor also uses), ``device_put``/``device_get``
+   byte counters, and comm-manager RTT spans — exported as Chrome
+   trace-event JSON (loadable in Perfetto / ``chrome://tracing``) plus a
+   Prometheus-style aggregate text dump.
+3. **Analysis** (``tools/fedtrace.py``): ``summarize`` turns a trace
+   into a per-phase (staging / gather / client steps / merge / server
+   update) time breakdown; ``diff`` compares two traces.
+
+See ``docs/OBSERVABILITY.md`` for the attribution model and the Perfetto
+how-to.
+"""
+
+from __future__ import annotations
+
+from .tracer import (  # noqa: F401
+    DEVICE_PHASES,
+    PHASES,
+    Tracer,
+    configure,
+    get_tracer,
+    trace_enabled,
+)
+
+#: symbols resolved lazily so importing :mod:`fedml_tpu.obs` (e.g. from a
+#: comm manager that never touches jax) stays stdlib-light; :mod:`.carry`
+#: pulls in jax + flax.
+_CARRY_EXPORTS = ("ObsCarry", "OPT_FLOPS", "obs_host", "obs_host_rows",
+                  "param_count", "round_obs")
+
+__all__ = ["DEVICE_PHASES", "PHASES", "Tracer", "configure", "get_tracer",
+           "trace_enabled", *_CARRY_EXPORTS]
+
+
+def __getattr__(name):
+    if name in _CARRY_EXPORTS:
+        from . import carry
+        return getattr(carry, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,124 @@
+"""Device-carry round telemetry: the fixed-shape :class:`ObsCarry` pytree.
+
+The fused round path (``jit(lax.scan(round))``, docs/ROUND_FUSION.md)
+syncs the host once per block, so nothing host-side can observe where
+time goes INSIDE a round.  ObsCarry closes that gap without breaking the
+sync contract: a handful of f32 scalars (plus one ``(4,)`` vector of
+per-phase FLOP weights) computed in-trace from quantities the round
+already has, returned through the same metrics pytree the loss rides —
+stacked to ``(K,)`` by the block scan exactly like ``train_loss`` — and
+materialized only on the driver's existing eval/log-round flush.
+
+Cost on the hot path: a few scalar reductions plus one tree-sized
+subtract-square-sum for the update norm (~2 FLOPs/param against the
+round's ~6·examples FLOPs/param of client training) and ZERO extra host
+syncs / compiles (pinned by ``tests/test_fedtrace.py``).
+
+The phase FLOP weights are attribution weights, not exact counts:
+``tools/fedtrace.py summarize`` apportions each round's measured
+wall-clock across the device phases proportionally to them (see
+docs/OBSERVABILITY.md for the model).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tracer import DEVICE_PHASES
+
+#: server-update FLOPs/param attribution class per algorithm (stage-2
+#: transition cost: plain wavg ≈ 2, Adam-family moments ≈ 18, control
+#: variate / residual updates in between) — weights for time attribution,
+#: not exact counts
+OPT_FLOPS = {
+    "fedavg": 2.0, "fedavg_seq": 2.0, "fedprox": 2.0, "fedsgd": 4.0,
+    "fedopt": 18.0, "fedopt_seq": 18.0, "scaffold": 8.0, "feddyn": 10.0,
+    "fednova": 6.0, "mime": 10.0,
+}
+
+
+@flax.struct.dataclass
+class ObsCarry:
+    """Fixed-shape per-round telemetry (all f32; ``phase_flops`` is a
+    ``(4,)`` vector aligned with :data:`~fedml_tpu.obs.DEVICE_PHASES`:
+    gather / client_steps / merge / server_update)."""
+
+    steps: jnp.ndarray        # real (mask-weighted) local SGD steps, summed
+    clients: jnp.ndarray      # sampled clients with weight > 0
+    examples: jnp.ndarray     # real examples consumed (steps × batch)
+    update_norm: jnp.ndarray  # ‖new_global − old_global‖₂ (f32)
+    phase_flops: jnp.ndarray  # (4,) per-phase FLOP attribution weights
+
+
+def param_count(tree: Any) -> int:
+    """Static (trace-time) element count of a params pytree."""
+    return sum(int(math.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
+              batch: int, feat: int,
+              opt_flops_per_param: float) -> ObsCarry:
+    """Build the ObsCarry INSIDE the compiled round.
+
+    ``real_steps``/``real_clients`` are traced scalars the round already
+    computes; ``batch``/``feat`` (examples per step / elements per
+    example) and the param count are trace-time statics, so every phase
+    weight is a static × traced product — no extra reductions beyond the
+    update norm.
+    """
+    f32 = jnp.float32
+    p = float(param_count(old_params))
+    steps = jnp.asarray(real_steps, f32)
+    clients = jnp.asarray(real_clients, f32)
+    examples = steps * float(batch)
+    sq = jax.tree_util.tree_map(
+        lambda n, o: jnp.sum((n.astype(f32) - o.astype(f32)) ** 2),
+        new_params, old_params)
+    update_norm = jnp.sqrt(sum(jax.tree_util.tree_leaves(sq)))
+    phase_flops = jnp.stack([
+        examples * float(max(int(feat), 1)),        # gather: elements moved
+        (6.0 * p) * examples,                       # client steps: fwd+bwd
+        (2.0 * p) * clients,                        # merge: weighted sums
+        jnp.asarray(float(opt_flops_per_param) * p, f32),  # server update
+    ])
+    return ObsCarry(steps=steps, clients=clients, examples=examples,
+                    update_norm=update_norm, phase_flops=phase_flops)
+
+
+# -- host-side materialization (called ONLY at the driver's existing
+#    log-round sync points; the values are already computed on device) ------
+
+def _row(steps, clients, examples, norm, pf) -> Dict[str, float]:
+    out = {"steps": float(steps), "clients": float(clients),
+           "examples": float(examples), "update_norm": float(norm)}
+    for i, phase in enumerate(DEVICE_PHASES):
+        out[f"flops_{phase}"] = float(pf[i])
+    return out
+
+
+def obs_host(carry: ObsCarry) -> Dict[str, float]:
+    """Materialize a scalar ObsCarry into plain host floats."""
+    return _row(np.asarray(carry.steps), np.asarray(carry.clients),
+                np.asarray(carry.examples), np.asarray(carry.update_norm),
+                np.asarray(carry.phase_flops))
+
+
+def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
+    """Materialize a block-stacked ``(K,)`` ObsCarry into K row dicts
+    (one host copy per field, then pure indexing)."""
+    steps = np.asarray(carry.steps)
+    clients = np.asarray(carry.clients)
+    examples = np.asarray(carry.examples)
+    norm = np.asarray(carry.update_norm)
+    pf = np.asarray(carry.phase_flops)
+    if steps.ndim == 0:
+        return [_row(steps, clients, examples, norm, pf)]
+    return [_row(steps[j], clients[j], examples[j], norm[j], pf[j])
+            for j in range(steps.shape[0])]

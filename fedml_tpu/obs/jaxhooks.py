@@ -1,0 +1,96 @@
+"""Process-wide jax monitoring hub + transfer probes.
+
+jax's ``monitoring.register_event_duration_secs_listener`` has no public
+unregister, so every consumer registering its own listener leaks one per
+scope (the pre-ISSUE-4 ``JaxRuntimeAudit`` worked around this with a
+private helper).  This module registers ONE listener lazily and fans out
+to subscribers — the runtime auditor (:mod:`fedml_tpu.analysis.runtime`)
+and the fedtrace tracer both attach here, so audits and traces observe
+the identical compile stream.
+
+The transfer probe wraps ``jax.device_put``/``jax.device_get`` to count
+bytes.  Wrapping intercepts — it never ADDS a transfer or a sync, which
+is what keeps ``JaxRuntimeAudit`` counters identical between traced and
+untraced runs (pinned in ``tests/test_fedtrace.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+#: fires once per XLA backend compile (cache misses only)
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_subscribers: List[Callable] = []
+_registered = False
+_lock = threading.Lock()
+
+
+def _dispatch(event: str, duration: float, **kw):
+    for fn in list(_subscribers):
+        try:
+            fn(event, duration)
+        except Exception:  # a broken subscriber must not break compilation
+            pass
+
+
+def subscribe(fn: Callable[[str, float], None]):
+    """Attach ``fn(event, duration)`` to the duration-event stream.  The
+    underlying jax listener registers once per process and stays
+    registered (dispatching to an empty list when all subscribers leave —
+    safe and inert)."""
+    global _registered
+    import jax
+
+    with _lock:
+        if not _registered:
+            jax.monitoring.register_event_duration_secs_listener(_dispatch)
+            _registered = True
+        if fn not in _subscribers:
+            _subscribers.append(fn)
+
+
+def unsubscribe(fn: Callable):
+    with _lock:
+        if fn in _subscribers:
+            _subscribers.remove(fn)
+
+
+def tree_nbytes(x) -> int:
+    """Total buffer bytes across the pytree's array leaves."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def install_tracer_hooks(tracer) -> Callable[[], None]:
+    """Subscribe ``tracer`` to compile events and install the transfer
+    byte probes; returns an uninstall callable restoring both."""
+    import jax
+
+    def on_event(event: str, duration: float):
+        if event == BACKEND_COMPILE_EVENT:
+            tracer.complete("xla_compile", duration, cat="compile")
+
+    subscribe(on_event)
+    orig_put, orig_get = jax.device_put, jax.device_get
+
+    def traced_put(x, *a, **kw):
+        tracer.add_bytes("device_put_bytes", tree_nbytes(x))
+        return orig_put(x, *a, **kw)
+
+    def traced_get(x, *a, **kw):
+        tracer.add_bytes("device_get_bytes", tree_nbytes(x))
+        return orig_get(x, *a, **kw)
+
+    jax.device_put, jax.device_get = traced_put, traced_get
+
+    def uninstall():
+        unsubscribe(on_event)
+        jax.device_put, jax.device_get = orig_put, orig_get
+
+    return uninstall

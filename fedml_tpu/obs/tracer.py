@@ -1,0 +1,326 @@
+"""obs.Tracer — host-side spans and counters behind the fedtrace plane.
+
+Design constraints (the whole point of this module):
+
+- **Disabled means free.** Every public method early-returns on one
+  attribute check; ``span()`` returns a shared no-op context manager, so
+  call sites on the round hot path cost a branch when tracing is off.
+- **Enabled means sync-free.** The tracer only ever reads host clocks and
+  host ints; it never touches a device value.  Device-side telemetry
+  arrives through :mod:`.carry` at the driver's existing log-round sync
+  (:meth:`Tracer.round_obs`), never through a tracer-initiated transfer.
+- **Chrome trace-event output.** ``export_chrome`` writes the JSON object
+  format (``{"traceEvents": [...]}``) with paired ``B``/``E`` duration
+  events per thread, ``C`` counter events, and ``M`` metadata — loadable
+  in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.  Events sort by
+  timestamp at export; still-open spans get a synthesized end so the file
+  is always well-formed.
+- **Prometheus-style aggregates.** ``export_prometheus`` renders the
+  running span totals and counters as a text-format dump for scrape-style
+  consumption without parsing the full trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: device phases attributed from the ObsCarry FLOP weights, in the order
+#: they appear in ``ObsCarry.phase_flops``
+DEVICE_PHASES = ("gather", "client_steps", "merge", "server_update")
+#: full per-round phase set (staging is host-measured via real spans)
+PHASES = ("staging",) + DEVICE_PHASES
+
+#: synthetic thread lane for retroactive XLA-compile spans (a compile's
+#: duration arrives after the fact; emitting it on the caller thread would
+#: cross-nest with whatever span is open there)
+COMPILE_TID = -2
+
+
+class _NullSpan:
+    """Shared no-op context manager returned when tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_name", "_cat", "_args")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self):
+        self._tracer.begin(self._name, cat=self._cat, **self._args)
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.end(self._name)
+        return False
+
+
+class Tracer:
+    """Thread-safe trace-event recorder (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        # tid -> stack of (name, ts_us) for B/E pairing
+        self._open: Dict[int, List[tuple]] = {}
+        # name -> [count, total_seconds] for the prometheus aggregate
+        self._span_agg: Dict[str, List[float]] = {}
+        self._counters: Dict[str, float] = {}
+        self.enabled = False
+        self.path: Optional[str] = None
+        self.dropped_ends = 0
+        self._origin = time.perf_counter()
+        self._pid = os.getpid()
+
+    # -- clock -------------------------------------------------------------
+    def _ts(self) -> float:
+        """Microseconds since tracer origin (Chrome trace ts unit)."""
+        return (time.perf_counter() - self._origin) * 1e6
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self._open.clear()
+            self._span_agg.clear()
+            self._counters.clear()
+            self.dropped_ends = 0
+            self._origin = time.perf_counter()
+
+    # -- spans -------------------------------------------------------------
+    def begin(self, name: str, cat: str = "host", **args):
+        if not self.enabled:
+            return
+        ts = self._ts()
+        tid = threading.get_ident()
+        ev: Dict[str, Any] = {"name": name, "ph": "B", "ts": ts,
+                              "pid": self._pid, "tid": tid, "cat": cat}
+        clean = {k: v for k, v in args.items() if v is not None}
+        if clean:
+            ev["args"] = clean
+        with self._lock:
+            self._events.append(ev)
+            self._open.setdefault(tid, []).append((name, ts))
+
+    def end(self, name: str, **args) -> Optional[float]:
+        """Close the most recent open span named ``name`` on this thread;
+        returns its duration in seconds, or None if no matching begin
+        exists (the unmatched end is dropped, keeping exports paired)."""
+        if not self.enabled:
+            return None
+        ts = self._ts()
+        tid = threading.get_ident()
+        with self._lock:
+            stack = self._open.get(tid, [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == name:
+                    _, t0 = stack.pop(i)
+                    break
+            else:
+                self.dropped_ends += 1
+                return None
+            ev: Dict[str, Any] = {"name": name, "ph": "E", "ts": ts,
+                                  "pid": self._pid, "tid": tid}
+            if args:
+                ev["args"] = dict(args)
+            self._events.append(ev)
+            dur = (ts - t0) / 1e6
+            agg = self._span_agg.setdefault(name, [0, 0.0])
+            agg[0] += 1
+            agg[1] += dur
+            return dur
+
+    def span(self, name: str, cat: str = "host", **args):
+        """Context-manager span; a shared no-op object when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _SpanCtx(self, name, cat, args)
+
+    def complete(self, name: str, duration_s: float, cat: str = "host",
+                 tid: int = COMPILE_TID, **args):
+        """Retroactive B/E pair on a synthetic lane — for events whose
+        duration is only known after the fact (XLA compiles)."""
+        if not self.enabled:
+            return
+        ts1 = self._ts()
+        ts0 = max(ts1 - float(duration_s) * 1e6, 0.0)
+        base = {"name": name, "pid": self._pid, "tid": tid, "cat": cat}
+        b: Dict[str, Any] = {**base, "ph": "B", "ts": ts0}
+        if args:
+            b["args"] = dict(args)
+        e: Dict[str, Any] = {"name": name, "ph": "E", "ts": ts1,
+                             "pid": self._pid, "tid": tid}
+        with self._lock:
+            self._events.extend((b, e))
+            agg = self._span_agg.setdefault(name, [0, 0.0])
+            agg[0] += 1
+            agg[1] += float(duration_s)
+
+    # -- counters ----------------------------------------------------------
+    def counter(self, name: str, value: float, **args):
+        """Gauge-style counter sample (Chrome ``C`` event)."""
+        if not self.enabled:
+            return
+        a: Dict[str, Any] = {"value": value}
+        a.update(args)
+        ev = {"name": name, "ph": "C", "ts": self._ts(), "pid": self._pid,
+              "tid": threading.get_ident(), "args": a}
+        with self._lock:
+            self._events.append(ev)
+            try:
+                self._counters[name] = float(value)
+            except (TypeError, ValueError):
+                pass
+
+    def add_bytes(self, name: str, n: int):
+        """Cumulative byte counter (device_put/get probes)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "C", "ts": self._ts(), "pid": self._pid,
+              "tid": threading.get_ident()}
+        with self._lock:
+            total = self._counters.get(name, 0.0) + float(n)
+            self._counters[name] = total
+            ev["args"] = {"value": total}
+            self._events.append(ev)
+
+    def round_obs(self, round_idx: int, round_time_s: float,
+                  obs: Dict[str, float]):
+        """One per-round device-telemetry record.  Called from the driver's
+        existing log-round flush with ALREADY-materialized host floats —
+        the tracer itself never syncs the device."""
+        if not self.enabled:
+            return
+        args: Dict[str, Any] = {"round": int(round_idx),
+                                "round_time_s": float(round_time_s)}
+        for k, v in obs.items():
+            args[k] = float(v)
+        ev = {"name": "obs.round", "ph": "C", "ts": self._ts(),
+              "pid": self._pid, "tid": threading.get_ident(), "args": args}
+        with self._lock:
+            self._events.append(ev)
+
+    # -- export ------------------------------------------------------------
+    def events(self) -> List[Dict[str, Any]]:
+        """Snapshot: ts-sorted events with synthesized ends for any span
+        still open, so every B has a matching E."""
+        with self._lock:
+            evs = list(self._events)
+            open_copy = {tid: list(st) for tid, st in self._open.items()
+                         if st}
+        ts = self._ts()
+        for tid, stack in open_copy.items():
+            for name, _t0 in reversed(stack):
+                evs.append({"name": name, "ph": "E", "ts": ts,
+                            "pid": self._pid, "tid": tid,
+                            "args": {"synthesized_end": True}})
+        evs.sort(key=lambda e: e.get("ts", 0.0))
+        return evs
+
+    def export_chrome(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Chrome trace-event JSON object; written to ``path`` (or the
+        configured default path) when one is given."""
+        trace = {
+            "traceEvents": [
+                {"name": "thread_name", "ph": "M", "ts": 0.0,
+                 "pid": self._pid, "tid": COMPILE_TID,
+                 "args": {"name": "xla-compile"}},
+            ] + self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {"exporter": "fedml_tpu.obs",
+                          "dropped_ends": self.dropped_ends},
+        }
+        path = path or self.path
+        if path:
+            with open(path, "w") as fh:
+                json.dump(trace, fh)
+        return trace
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "spans": {n: {"count": int(c), "total_s": t}
+                          for n, (c, t) in sorted(self._span_agg.items())},
+                "counters": dict(self._counters),
+                "dropped_ends": self.dropped_ends,
+            }
+
+    def export_prometheus(self, path: Optional[str] = None) -> str:
+        """Prometheus text-format aggregate of span totals + counters."""
+        s = self.summary()
+        lines = ["# TYPE fedtrace_span_seconds_total counter",
+                 "# TYPE fedtrace_span_count counter",
+                 "# TYPE fedtrace_counter gauge"]
+        for name, row in s["spans"].items():
+            lines.append(f'fedtrace_span_seconds_total{{name="{name}"}} '
+                         f'{row["total_s"]:.9f}')
+            lines.append(f'fedtrace_span_count{{name="{name}"}} '
+                         f'{row["count"]}')
+        for name, v in sorted(s["counters"].items()):
+            lines.append(f'fedtrace_counter{{name="{name}"}} {v:g}')
+        text = "\n".join(lines) + "\n"
+        if path:
+            with open(path, "w") as fh:
+                fh.write(text)
+        return text
+
+
+# -- global tracer ---------------------------------------------------------
+_TRACER = Tracer()
+_jax_uninstall = None
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def trace_enabled() -> bool:
+    return _TRACER.enabled
+
+
+def configure(enabled: Optional[bool] = None, path: Optional[str] = None,
+              reset: bool = False, jax_hooks: bool = True) -> Tracer:
+    """Configure the global tracer.
+
+    Enabling subscribes the tracer to the shared jax monitoring hub
+    (XLA compile events) and wraps ``jax.device_put``/``device_get`` with
+    byte counters (:mod:`.jaxhooks`); disabling restores both.  The hooks
+    never add a transfer, a sync, or a compile — the CI smoke pins
+    ``JaxRuntimeAudit`` counter equality between traced and untraced runs.
+    """
+    global _jax_uninstall
+    tr = _TRACER
+    if path is not None:
+        tr.path = path
+    if reset:
+        tr.reset()
+    if enabled is None:
+        return tr
+    if enabled and not tr.enabled:
+        tr.enabled = True
+        if jax_hooks and _jax_uninstall is None:
+            from . import jaxhooks
+            _jax_uninstall = jaxhooks.install_tracer_hooks(tr)
+    elif not enabled and tr.enabled:
+        tr.enabled = False
+        if _jax_uninstall is not None:
+            _jax_uninstall()
+            _jax_uninstall = None
+    return tr

@@ -46,6 +46,7 @@ into SPMD.  For strongly non-uniform cohorts the scheduler in
 from __future__ import annotations
 
 import logging
+import math
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,7 @@ from ...core.mesh import CLIENT_AXIS, make_mesh
 from ...ml.aggregator.agg_operator import (ServerOptimizer, ServerState,
                                            sharded_state_map)
 from ...ml.trainer.local_trainer import LocalTrainer
+from ...obs.carry import OPT_FLOPS, round_obs
 from ..round_engine import next_pow2
 from ..sp.fedavg_api import FedAvgAPI
 from ..staging import AsyncCohortStager  # noqa: F401  (re-export: the
@@ -137,13 +139,31 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             state.global_params, xb, yb, mb, rng, ctx, cc)
         return jax.vmap(fn)(x, y, mask, rngs, c_clients)
 
-    def shard_metrics(outs, w):
+    def _cohort_dims(x, y):
+        """Trace-time statics for the ObsCarry phase weights: examples per
+        step (B) and elements per example (feat)."""
+        batch = int(x.shape[2])
+        src_shape = y[0].shape[1:] if use_ingather else x.shape[3:]
+        return batch, math.prod(src_shape)
+
+    def shard_metrics(outs, w, old_state, new_state, batch, feat):
         wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-        return {
+        steps = jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS)
+        clients = jax.lax.psum(jnp.sum((w > 0).astype(jnp.float32)),
+                               CLIENT_AXIS)
+        metrics = {
             "train_loss": jax.lax.psum(jnp.sum(outs.loss * w),
                                        CLIENT_AXIS) / wsum,
-            "total_steps": jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS),
+            "total_steps": steps,
         }
+        # device-carry telemetry (ISSUE 4): psummed globals + static shape
+        # products; global_params are replicated in both update layouts so
+        # the update norm is shard-identical and leaves with the P() spec
+        metrics["obs"] = round_obs(
+            old_state.global_params, new_state.global_params,
+            real_steps=steps, real_clients=clients, batch=batch, feat=feat,
+            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0))
+        return metrics
 
     def per_shard_replicated(state: ServerState, x, y, mask, w, rngs,
                              c_clients):
@@ -171,7 +191,9 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         new_state = server_opt.update_from_aggregates(state, agg)
         # only per-client algorithm state leaves the shard (returning
         # outs.params would materialize C × |model| for nothing)
-        return new_state, shard_metrics(outs, w), outs.new_client_state
+        batch, feat = _cohort_dims(x, y)
+        return (new_state, shard_metrics(outs, w, state, new_state, batch,
+                                         feat), outs.new_client_state)
 
     def per_shard_scatter(state: ServerState, x, y, mask, w, rngs, c_clients):
         # client-VISIBLE server state (SCAFFOLD's c_server in the corrected
@@ -237,7 +259,9 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
                                                  state.global_params)
         new_state = state.replace(round_idx=state.round_idx + 1,
                                   global_params=new_params, **new_fields)
-        return new_state, shard_metrics(outs, w), outs.new_client_state
+        batch, feat = _cohort_dims(x, y)
+        return (new_state, shard_metrics(outs, w, state, new_state, batch,
+                                         feat), outs.new_client_state)
 
     shard = P(CLIENT_AXIS)
     data_spec = P() if use_ingather else shard

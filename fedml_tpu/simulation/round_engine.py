@@ -21,6 +21,7 @@ server merge — is a single jitted function over a *cohort tensor*:
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -30,6 +31,7 @@ import jax.numpy as jnp
 from ..core import tree as tree_util
 from ..ml.aggregator.agg_operator import ServerOptimizer, ServerState
 from ..ml.trainer.local_trainer import ClientOut, LocalTrainer, ServerCtx
+from ..obs.carry import OPT_FLOPS, round_obs
 
 
 def _client_body(local_train, server_opt: ServerOptimizer):
@@ -99,10 +101,22 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
         if alg in ("mime", "fedsgd"):
             aux["grad_sum"] = outs.grad_sum
         new_state = server_opt.update(state, outs.params, weights, aux)
+        total_steps = jnp.sum(outs.num_steps)
         metrics = {
             "train_loss": jnp.sum(outs.loss * weights) / jnp.sum(weights),
-            "total_steps": jnp.sum(outs.num_steps),
+            "total_steps": total_steps,
         }
+        # device-carry telemetry (ISSUE 4): fixed-shape scalars computed
+        # in-trace and returned through the metrics pytree — they ride the
+        # same outputs the loss does (stacked (K,) under the block scan)
+        # and materialize only at the driver's existing log-round flush
+        feat = math.prod(x.shape[3:])
+        metrics["obs"] = round_obs(
+            state.global_params, new_state.global_params,
+            real_steps=total_steps,
+            real_clients=jnp.sum((weights > 0).astype(jnp.float32)),
+            batch=int(x.shape[2]), feat=feat,
+            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0))
         # Return ONLY the per-client state (SCAFFOLD/FedDyn) — returning the
         # full stacked ``outs.params`` would force XLA to materialize a
         # C × |model| output buffer every round for data nothing consumes.

@@ -28,6 +28,8 @@ from ...data.federated_dataset import FederatedDataset
 from ...ml.aggregator.agg_operator import ServerOptimizer
 from ...ml.trainer.local_trainer import LocalTrainer
 from ...mlops import event, log_round_info
+from ...obs import get_tracer
+from ...obs.carry import obs_host, obs_host_rows
 from ..round_engine import make_round_fn, next_pow2
 from ..staging import AsyncCohortStager
 
@@ -53,6 +55,15 @@ class FedAvgAPI:
         self.comm_rounds = int(getattr(args, "comm_round", 10))
         self.clients_per_round = int(getattr(args, "client_num_per_round", 10))
         self.eval_freq = int(getattr(args, "frequency_of_the_test", 5))
+
+        # fedtrace (ISSUE 4): args.trace turns the global tracer on (file
+        # path via args.trace_path); when off every tracer call site below
+        # costs a single attribute check
+        if bool(getattr(args, "trace", False)):
+            from ...obs import configure as _obs_configure
+            _obs_configure(enabled=True,
+                           path=getattr(args, "trace_path", None))
+        self._tracer = get_tracer()
 
         self.trainer = LocalTrainer(model, args)
         self.server_opt = ServerOptimizer(args)
@@ -235,29 +246,39 @@ class FedAvgAPI:
         cohort = np.asarray(clients, dtype=np.int32)
         c_stacked = self._gather_c(cohort)
         if hasattr(self, "_dev_x"):
-            idx, mask, w = self.dataset.cohort_indices(
-                clients, self.batch_size, self.seed, round_idx, self.epochs)
-            # pad steps to pow2 buckets → bounded recompile count
-            steps = next_pow2(idx.shape[1])
-            if steps != idx.shape[1]:
-                pad = steps - idx.shape[1]
-                idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
-                mask = np.pad(mask, [(0, 0), (0, pad)])
+            with self._tracer.span("staging", cat="staging",
+                                   round=round_idx):
+                idx, mask, w = self.dataset.cohort_indices(
+                    clients, self.batch_size, self.seed, round_idx,
+                    self.epochs)
+                # pad steps to pow2 buckets → bounded recompile count
+                steps = next_pow2(idx.shape[1])
+                if steps != idx.shape[1]:
+                    pad = steps - idx.shape[1]
+                    idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
+                    mask = np.pad(mask, [(0, 0), (0, pad)])
+                idx, mask, w = (jnp.asarray(idx), jnp.asarray(mask),
+                                jnp.asarray(w))
             self.state, metrics, new_c = self.round_fn(
-                self.state, jnp.asarray(idx), jnp.asarray(mask),
-                jnp.asarray(w), key, c_stacked)
+                self.state, idx, mask, w, key, c_stacked)
         else:
-            x, y, mask, w = self.dataset.cohort_batches(
-                clients, self.batch_size, self.seed, round_idx, self.epochs)
-            steps = next_pow2(x.shape[1])
-            if steps != x.shape[1]:
-                pad = steps - x.shape[1]
-                x = np.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
-                y = np.pad(y, [(0, 0), (0, pad)] + [(0, 0)] * (y.ndim - 2))
-                mask = np.pad(mask, [(0, 0), (0, pad)])
+            with self._tracer.span("staging", cat="staging",
+                                   round=round_idx):
+                x, y, mask, w = self.dataset.cohort_batches(
+                    clients, self.batch_size, self.seed, round_idx,
+                    self.epochs)
+                steps = next_pow2(x.shape[1])
+                if steps != x.shape[1]:
+                    pad = steps - x.shape[1]
+                    x = np.pad(x,
+                               [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
+                    y = np.pad(y,
+                               [(0, 0), (0, pad)] + [(0, 0)] * (y.ndim - 2))
+                    mask = np.pad(mask, [(0, 0), (0, pad)])
+                x, y, mask, w = (jnp.asarray(x), jnp.asarray(y),
+                                 jnp.asarray(mask), jnp.asarray(w))
             self.state, metrics, new_c = self.round_fn(
-                self.state, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-                jnp.asarray(w), key, c_stacked)
+                self.state, x, y, mask, w, key, c_stacked)
         self._scatter_c(cohort, new_c)
         metrics = dict(metrics)
         metrics["allocated_steps"] = len(clients) * steps
@@ -336,8 +357,10 @@ class FedAvgAPI:
         return k, metrics
 
     def evaluate(self):
-        xb, yb, mb = self.dataset.test_batches()
-        return self.trainer.evaluate(self.state.global_params, xb, yb, mb)
+        with self._tracer.span("eval", cat="eval"):
+            xb, yb, mb = self.dataset.test_batches()
+            return self.trainer.evaluate(self.state.global_params, xb, yb,
+                                         mb)
 
     def _per_client_eval_fn(self):
         """Compiled all-clients eval program, built once per API instance
@@ -442,6 +465,13 @@ class FedAvgAPI:
         while pending:
             round_idx, metrics, dt = pending.pop(0)
             train_loss = float(metrics["train_loss"])
+            if self._tracer.enabled and isinstance(metrics, dict) \
+                    and metrics.get("obs") is not None:
+                # piggyback the existing sync: the float() above already
+                # blocked on this round's program, so materializing the
+                # device-carry scalars here adds no new sync point
+                self._tracer.round_obs(round_idx, dt,
+                                       obs_host(metrics["obs"]))
             record = {"round": round_idx, "train_loss": train_loss,
                       "round_time": dt,
                       "dataset_provenance": getattr(self.dataset,
@@ -465,12 +495,17 @@ class FedAvgAPI:
         while r < self.comm_rounds:
             event("train", started=True, round_idx=r)
             t0 = time.time()
-            k, ms = self.train_block(r)
-            # ONE sync per block: materializing the stacked losses waits
-            # for the whole block's compiled program
-            losses = np.asarray(ms["train_loss"])
+            with self._tracer.span("block", cat="round", start_round=r):
+                k, ms = self.train_block(r)
+                # ONE sync per block: materializing the stacked losses
+                # waits for the whole block's compiled program
+                losses = np.asarray(ms["train_loss"])
             block_dt = time.time() - t0
             event("train", started=False, round_idx=r)
+            if self._tracer.enabled and ms.get("obs") is not None:
+                # stacked (k,) device-carry rows ride the block's ONE sync
+                for j, row in enumerate(obs_host_rows(ms["obs"])):
+                    self._tracer.round_obs(r + j, block_dt / k, row)
             eval_due = any(self._is_log_round(ri) for ri in range(r, r + k))
             for j in range(k):
                 ri = r + j
@@ -500,7 +535,9 @@ class FedAvgAPI:
             for round_idx in range(start_round, self.comm_rounds):
                 event("train", started=True, round_idx=round_idx)
                 t0 = time.time()
-                metrics = self.train_one_round(round_idx)
+                with self._tracer.span("round", cat="round",
+                                       round=round_idx):
+                    metrics = self.train_one_round(round_idx)
                 event("train", started=False, round_idx=round_idx)
                 pending.append((round_idx, metrics, time.time() - t0))
                 if self._is_log_round(round_idx):
@@ -510,4 +547,10 @@ class FedAvgAPI:
         total = time.time() - t_start
         log.info("finished %d rounds in %.1fs (%.3fs/round)",
                  self.comm_rounds, total, total / max(self.comm_rounds, 1))
+        if self._tracer.enabled and self._tracer.path:
+            # args.trace_path contract: the YAML user gets the Chrome
+            # trace on disk without touching the tracer API
+            self._tracer.export_chrome()
+            log.info("fedtrace: wrote %s (analyze with tools/fedtrace.py)",
+                     self._tracer.path)
         return self.state.global_params

@@ -19,12 +19,19 @@ from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
 
+from ..obs import get_tracer
+
 
 class AsyncCohortStager:
     """Double-buffered host→device cohort staging.
 
     ``build(round_idx)`` must be a pure function of the round index that
     returns the staged (device_put) round inputs.
+
+    Every build (synchronous or on the worker thread) runs under a
+    fedtrace ``staging`` span, and the pending-future depth is sampled as
+    the ``staging.queue_depth`` counter — the tracer call sites are a
+    single attribute check when tracing is off.
     """
 
     def __init__(self, build, enabled: bool = True):
@@ -35,9 +42,16 @@ class AsyncCohortStager:
         self._failed = None   # first uncollected worker-thread exception
         self._closed = False
 
+    def _traced_build(self, round_idx: int):
+        tr = get_tracer()
+        if not tr.enabled:
+            return self._build(round_idx)
+        with tr.span("staging", cat="staging", round=round_idx):
+            return self._build(round_idx)
+
     def _worker_build(self, round_idx: int):
         try:
-            return self._build(round_idx)
+            return self._traced_build(round_idx)
         except BaseException as e:  # surfaced via _failed at the next get()
             if self._failed is None:
                 self._failed = e
@@ -67,11 +81,14 @@ class AsyncCohortStager:
                 self._failed = None
                 raise
         else:
-            staged = self._build(round_idx)
+            staged = self._traced_build(round_idx)
         if self._enabled and not self._closed and prefetch is not None \
                 and prefetch not in self._pending:
             self._pending[prefetch] = self._pool.submit(
                 self._worker_build, prefetch)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.counter("staging.queue_depth", len(self._pending))
         return staged
 
     def close(self):

@@ -1,0 +1,37 @@
+"""Fixture: instrumentation that reads a metric INSIDE the compiled round.
+
+The fedtrace contract (docs/OBSERVABILITY.md) is that device-carry
+metrics stay device-resident until the driver's existing log-round sync.
+The leaky variant materializes a counter inside the jitted round body — a
+blocking device→host sync per round under eager fallback, a trace error
+under jit — flagged.  The correct form returns the ObsCarry-style scalar
+through the round's outputs and lets the HOST driver feed the tracer at
+its own sync point — no findings.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def record_counter(name, value):
+    """Stand-in for a tracer/metrics sink (host-side)."""
+
+
+@jax.jit
+def instrumented_round_leaky(state, grads):
+    update_norm = jnp.sqrt(jnp.sum(grads * grads))
+    record_counter("update_norm", float(update_norm))  # host sync in jit
+    return state - grads
+
+
+@jax.jit
+def instrumented_round(state, grads):
+    update_norm = jnp.sqrt(jnp.sum(grads * grads))
+    obs = {"update_norm": update_norm}   # stays in the round's outputs
+    return state - grads, obs
+
+
+def driver(state, grads):
+    state, obs = instrumented_round(state, grads)
+    # the host boundary AFTER the dispatch is the sanctioned sync point
+    record_counter("update_norm", float(obs["update_norm"]))
+    return state

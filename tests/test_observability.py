@@ -1,8 +1,10 @@
 """Observability plane: profiler spans, sys stats, runtime log pipeline,
-engine adapter torch interop, cross-cloud surface."""
+engine adapter torch interop, cross-cloud surface.  (The fedtrace
+tracer/CLI layer has its own suite in ``tests/test_fedtrace.py``.)"""
 
 import logging
 import tempfile
+import time
 import types
 
 import numpy as np
@@ -12,9 +14,7 @@ def test_profiler_event_spans():
     from fedml_tpu import mlops
     from fedml_tpu.mlops.profiler_event import MLOpsProfilerEvent
 
-    records = []
-    mlops.register_exporter(records.append)
-    try:
+    with mlops.capture_events() as records:
         ev = MLOpsProfilerEvent()
         ev.log_event_started("train")
         dur = ev.log_event_ended("train")
@@ -25,9 +25,56 @@ def test_profiler_event_spans():
                  if r.get("kind") == "span"]
         assert ("train", 0) in kinds and ("train", 1) in kinds
         assert ("agg", 0) in kinds and ("agg", 1) in kinds
-    finally:
-        mlops._state["exporters"].remove(records.append) if records.append in \
-            mlops._state["exporters"] else None
+
+
+def test_exporter_lifecycle():
+    """ISSUE 4 satellite: unregister_exporter + the capture_events scoped
+    exporter (replacing the old manual ``_state["exporters"].remove``
+    teardown)."""
+    from fedml_tpu import mlops
+
+    seen = []
+    mlops.register_exporter(seen.append)
+    assert mlops.unregister_exporter(seen.append) is True
+    assert mlops.unregister_exporter(seen.append) is False   # idempotent
+
+    with mlops.capture_events() as records:
+        mlops.log_metric({"a": 1}, step=0)
+    assert records and records[-1]["type"] == "metric"
+    n = len(records)
+    mlops.log_metric({"a": 2}, step=1)   # outside the scope: detached
+    assert len(records) == n
+    assert records.append not in mlops._state["exporters"]
+
+
+def test_profiler_event_nesting_and_mismatch_warns_once(caplog):
+    """ISSUE 4 satellite: reentrant spans pair innermost-first off an
+    explicit stack; an unmatched end reports 0 and warns once per name."""
+    from fedml_tpu import mlops
+    from fedml_tpu.mlops import profiler_event
+    from fedml_tpu.mlops.profiler_event import MLOpsProfilerEvent
+
+    ev = MLOpsProfilerEvent()
+    with mlops.capture_events() as records:
+        ev.log_event_started("outer")
+        time.sleep(0.02)
+        ev.log_event_started("outer")        # reentrant same-name span
+        inner = ev.log_event_ended("outer")
+        outer = ev.log_event_ended("outer")
+        assert 0 <= inner <= outer, (inner, outer)
+        assert outer >= 0.02                 # outer kept ITS start time
+
+        profiler_event._warned_unmatched.discard("ghost")
+        with caplog.at_level(logging.WARNING,
+                             logger="fedml_tpu.mlops.profiler_event"):
+            assert ev.log_event_ended("ghost") == 0.0
+            assert ev.log_event_ended("ghost") == 0.0
+        warns = [r for r in caplog.records if "ghost" in r.getMessage()]
+        assert len(warns) == 1, "mismatch must warn exactly once per name"
+
+    ended = [r for r in records if r.get("kind") == "span"
+             and r["event_type"] == 1]
+    assert len(ended) == 4   # two matched outer pairs + two ghost ends
 
 
 def test_sys_stats_sampler():

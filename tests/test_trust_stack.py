@@ -279,11 +279,15 @@ def test_fhe_ckks_roundtrip_weighted_fedavg():
     np.testing.assert_allclose(out["w"], ref_w, atol=1e-3)
     np.testing.assert_allclose(out["b"], ref_b, atol=1e-3)
 
-    # ciphertext leaks nothing linear about the plaintext
+    # ciphertext leaks nothing linear about the plaintext.  Encryption
+    # randomness is OS-entropy seeded (ckks.py: per-encryption (a, e)),
+    # so the sample correlation of 200 independent points has std
+    # ~1/sqrt(200) ≈ 0.071 — bound at 4.2σ (p ~ 2e-5), not 2.1σ (the old
+    # 0.15 bound failed ~3% of runs by pure chance)
     flat = trees[0]["w"].ravel()
     c0 = np.asarray(cts[0][1].c0[0, 0][: flat.size], np.float64)
     corr = abs(np.corrcoef(c0, flat)[0, 1])
-    assert corr < 0.15, corr
+    assert corr < 0.3, corr
 
 
 def test_fhe_mock_requires_explicit_optin(caplog):

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""fedtrace — analyze fedml_tpu Chrome trace-event captures.
+
+Pure stdlib (runs without jax installed, like ``tools/fedlint.py``):
+
+- ``fedtrace.py summarize TRACE.json [--json]`` — span totals, counters,
+  and the per-phase (staging / gather / client_steps / merge /
+  server_update) round-time breakdown.
+- ``fedtrace.py diff A.json B.json [--json]`` — per-phase comparison of
+  two traces (e.g. fused vs. unfused, or two commits).
+
+Attribution model (docs/OBSERVABILITY.md): ``staging`` is measured
+directly from host spans; the four device phases are apportioned from
+each round's measured wall-clock (the ``obs.round`` counter's
+``round_time_s``) proportionally to the per-phase FLOP weights the
+compiled round records on device (``ObsCarry.phase_flops``) — the device
+side of a fused ``jit(lax.scan(round))`` dispatch cannot be host-timed
+per phase without breaking the zero-sync contract, so the breakdown is a
+flop-weighted attribution, not a per-phase stopwatch.
+
+Exit codes: 0 ok, 1 malformed trace, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+DEVICE_PHASES = ("gather", "client_steps", "merge", "server_update")
+PHASES = ("staging",) + DEVICE_PHASES
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        trace = json.load(fh)
+    if isinstance(trace, list):  # bare-array Chrome format
+        trace = {"traceEvents": trace}
+    if "traceEvents" not in trace:
+        raise ValueError(f"{path}: no traceEvents key")
+    return trace
+
+
+def validate_events(events: List[dict]) -> List[str]:
+    """Schema check: required keys, monotonic ts, paired B/E per thread.
+    Returns a list of problems (empty == valid)."""
+    problems: List[str] = []
+    last_ts = None
+    stacks: Dict[Any, List[str]] = {}
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if "name" not in ev or ph is None:
+            problems.append(f"event {i}: missing name/ph")
+            continue
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i} ({ev['name']}): missing ts")
+            continue
+        if last_ts is not None and ts < last_ts:
+            problems.append(f"event {i} ({ev['name']}): ts not monotonic "
+                            f"({ts} < {last_ts})")
+        last_ts = ts
+        tid = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(tid, []).append(ev["name"])
+        elif ph == "E":
+            stack = stacks.get(tid, [])
+            if ev["name"] in stack:
+                # pop through (tolerates interleaved-but-paired spans)
+                while stack and stack[-1] != ev["name"]:
+                    stack.pop()
+                if stack:
+                    stack.pop()
+            else:
+                problems.append(f"event {i}: E '{ev['name']}' without B "
+                                f"on tid {tid}")
+        elif ph not in ("C", "i", "X"):
+            problems.append(f"event {i}: unknown ph {ph!r}")
+    for tid, stack in stacks.items():
+        for name in stack:
+            problems.append(f"unclosed B '{name}' on tid {tid}")
+    return problems
+
+
+def span_totals(events: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Per-name span aggregation from paired B/E events."""
+    open_: Dict[Any, List[tuple]] = {}
+    agg: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        tid = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            open_.setdefault(tid, []).append((ev["name"], ev["ts"]))
+        elif ph == "E":
+            stack = open_.get(tid, [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == ev["name"]:
+                    name, t0 = stack.pop(i)
+                    row = agg.setdefault(name, {"count": 0, "total_s": 0.0})
+                    row["count"] += 1
+                    row["total_s"] += (ev["ts"] - t0) / 1e6
+                    break
+    return agg
+
+
+def counter_last(events: List[dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for ev in events:
+        if ev.get("ph") == "C" and ev.get("name") != "obs.round":
+            v = (ev.get("args") or {}).get("value")
+            if isinstance(v, (int, float)):
+                out[ev["name"]] = float(v)
+    return out
+
+
+def round_records(events: List[dict]) -> List[dict]:
+    return [dict(ev.get("args") or {}) for ev in events
+            if ev.get("ph") == "C" and ev.get("name") == "obs.round"]
+
+
+def phase_breakdown(events: List[dict],
+                    spans: Optional[Dict[str, Dict[str, float]]] = None
+                    ) -> Dict[str, Any]:
+    """Per-phase seconds: staging measured from spans; device phases
+    attributed from per-round wall-clock × on-device FLOP weights."""
+    spans = spans if spans is not None else span_totals(events)
+    rounds = round_records(events)
+    phases = {p: 0.0 for p in PHASES}
+    phases["staging"] = spans.get("staging", {}).get("total_s", 0.0)
+    total_round_s = 0.0
+    for rec in rounds:
+        rt = float(rec.get("round_time_s", 0.0))
+        total_round_s += rt
+        weights = [max(float(rec.get(f"flops_{p}", 0.0)), 0.0)
+                   for p in DEVICE_PHASES]
+        wsum = sum(weights)
+        if wsum <= 0:
+            continue
+        for p, w in zip(DEVICE_PHASES, weights):
+            phases[p] += rt * (w / wsum)
+    return {
+        "phases": {p: round(v, 6) for p, v in phases.items()},
+        "rounds": len(rounds),
+        "round_time_total_s": round(total_round_s, 6),
+        "compile_s": round(spans.get("xla_compile", {}).get("total_s", 0.0),
+                           6),
+        "compile_count": int(spans.get("xla_compile", {}).get("count", 0)),
+    }
+
+
+def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
+    events = trace["traceEvents"]
+    spans = span_totals(events)
+    out = phase_breakdown(events, spans)
+    out["spans"] = {n: {"count": int(r["count"]),
+                        "total_s": round(r["total_s"], 6)}
+                    for n, r in sorted(spans.items())}
+    out["counters"] = counter_last(events)
+    recs = round_records(events)
+    if recs:
+        out["update_norm_last"] = round(
+            float(recs[-1].get("update_norm", 0.0)), 6)
+        out["examples_total"] = round(
+            sum(float(r.get("examples", 0.0)) for r in recs), 1)
+    return out
+
+
+def diff(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    sa, sb = summarize(a), summarize(b)
+    out: Dict[str, Any] = {"a_rounds": sa["rounds"], "b_rounds": sb["rounds"],
+                           "phases": {}}
+    for p in PHASES:
+        va, vb = sa["phases"][p], sb["phases"][p]
+        na = va / max(sa["rounds"], 1)
+        nb = vb / max(sb["rounds"], 1)
+        out["phases"][p] = {
+            "a_s": round(va, 6), "b_s": round(vb, 6),
+            "a_s_per_round": round(na, 6), "b_s_per_round": round(nb, 6),
+            "b_vs_a": round(nb / na, 3) if na > 0 else None,
+        }
+    ra = sa["round_time_total_s"] / max(sa["rounds"], 1)
+    rb = sb["round_time_total_s"] / max(sb["rounds"], 1)
+    out["round_s_per_round"] = {"a": round(ra, 6), "b": round(rb, 6),
+                                "b_vs_a": round(rb / ra, 3) if ra > 0
+                                else None}
+    return out
+
+
+def _render_summary(s: Dict[str, Any]) -> str:
+    lines = [f"rounds: {s['rounds']}   "
+             f"round wall-clock: {s['round_time_total_s']:.4f}s   "
+             f"compiles: {s['compile_count']} ({s['compile_s']:.2f}s)"]
+    lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")
+    total = sum(s["phases"].values()) or 1.0
+    for p in PHASES:
+        v = s["phases"][p]
+        lines.append(f"{p:<16}{v:>12.4f}{100.0 * v / total:>8.1f}%")
+    if s.get("spans"):
+        lines.append("spans:")
+        for n, row in s["spans"].items():
+            lines.append(f"  {n:<22}x{row['count']:<6}"
+                         f"{row['total_s']:.4f}s")
+    return "\n".join(lines)
+
+
+def _render_diff(d: Dict[str, Any]) -> str:
+    lines = [f"{'phase':<16}{'A s/round':>12}{'B s/round':>12}{'B/A':>8}"]
+    for p in PHASES:
+        row = d["phases"][p]
+        ratio = row["b_vs_a"]
+        lines.append(f"{p:<16}{row['a_s_per_round']:>12.5f}"
+                     f"{row['b_s_per_round']:>12.5f}"
+                     f"{ratio if ratio is not None else '-':>8}")
+    r = d["round_s_per_round"]
+    lines.append(f"{'round (total)':<16}{r['a']:>12.5f}{r['b']:>12.5f}"
+                 f"{r['b_vs_a'] if r['b_vs_a'] is not None else '-':>8}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fedtrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd")
+    p_sum = sub.add_parser("summarize", help="per-phase breakdown of one "
+                                             "trace")
+    p_sum.add_argument("trace")
+    p_sum.add_argument("--json", action="store_true")
+    p_diff = sub.add_parser("diff", help="compare two traces per phase")
+    p_diff.add_argument("trace_a")
+    p_diff.add_argument("trace_b")
+    p_diff.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.cmd is None:
+        parser.print_usage(sys.stderr)
+        return 2
+    try:
+        if args.cmd == "summarize":
+            s = summarize(load_trace(args.trace))
+            print(json.dumps(s) if args.json else _render_summary(s))
+        else:
+            d = diff(load_trace(args.trace_a), load_trace(args.trace_b))
+            print(json.dumps(d) if args.json else _render_diff(d))
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"fedtrace: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

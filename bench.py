@@ -21,6 +21,12 @@ N-way redundant update) — at 256 clients/round on an 8-shard mesh (virtual
 CPU devices when no accelerator provides 8), one json line with both
 wall-clocks.
 
+``python bench.py --comms`` compares the low-precision collective layer
+(``collective_precision`` = fp32 | bf16 | int8, docs/COLLECTIVE_PRECISION.md)
+on the 8-shard scatter mesh: steady-state s/round plus the modeled
+interconnect bytes/round each precision moves through the merge+broadcast
+collectives, one json line.
+
 ``python bench.py --trace`` measures the fedtrace observability plane:
 steady-state s/round untraced vs. traced (acceptance: <5% overhead) plus the
 ``tools/fedtrace.py summarize`` per-phase round breakdown folded into the
@@ -338,6 +344,80 @@ def bench_update_sharding(rounds: int | None = None,
         out[f"{mode}_s_per_round"] = round(dt, 5)
     out["scatter_speedup"] = round(
         out["replicated_s_per_round"] / out["scatter_s_per_round"], 3)
+    return out
+
+
+# -- low-precision collective benchmark (--comms) ----------------------------
+def bench_comms(rounds: int | None = None,
+                clients_per_round: int | None = None) -> dict:
+    """--comms: the low-precision collective layer
+    (``args.collective_precision``, docs/COLLECTIVE_PRECISION.md) on the
+    8-shard scatter mesh at 256 clients/round: steady-state s/round AND the
+    modeled interconnect payload bytes/round of the merge+broadcast
+    collectives at each precision.  The byte numbers are read back from the
+    round's own device-carried ObsCarry record (the same field ``fedtrace
+    summarize`` reports), so the bench exercises the real plumbing rather
+    than re-deriving the model host-side.  FEDML_COMMS_QUICK=1 shrinks the
+    cohort for smoke tests."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    quick = os.environ.get("FEDML_COMMS_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (2 if quick else ROUNDS_TIMED)
+    rtt = None
+    out = {"clients_per_round": cpr, "quick": quick,
+           "update_sharding": "scatter"}
+
+    for precision in ("fp32", "bf16", "int8"):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total,
+            client_num_per_round=cpr, comm_round=timed_rounds + 2,
+            epochs=1, batch_size=BATCH, learning_rate=0.03,
+            partition_method="homo", frequency_of_the_test=10 ** 9,
+            random_seed=0, update_sharding="scatter",
+            collective_precision=precision,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        api = MeshFedAvgAPI(args, None, dataset, model)
+        out["n_shards"] = api.n_shards
+        metrics = api.train_one_round(0)  # compile
+        # device-carried modeled bytes (trace-time static, so round 0's
+        # record is the steady-state value)
+        out[f"{precision}_bytes_per_round"] = int(
+            np.asarray(metrics["obs"].collective_bytes))
+        out[f"{precision}_quant_error_norm"] = round(float(
+            np.asarray(metrics["obs"].quant_error_norm)), 6)
+        api.train_one_round(1)
+        _readback(api.state.global_params)
+        if rtt is None:
+            rtt = measure_rtt()
+        rounds_done = [2]
+
+        def run_n(n):
+            for _ in range(n):
+                api.train_one_round(rounds_done[0] % args.comm_round)
+                rounds_done[0] += 1
+
+        dt = _timed_chain(run_n,
+                          lambda: _readback(api.state.global_params),
+                          min_total_s=0.5 if quick else 2.0,
+                          n0=timed_rounds, rtt=rtt)
+        out[f"{precision}_s_per_round"] = round(dt, 5)
+    for precision in ("bf16", "int8"):
+        out[f"{precision}_bytes_reduction"] = round(
+            out["fp32_bytes_per_round"]
+            / out[f"{precision}_bytes_per_round"], 3)
+        out[f"{precision}_round_slowdown"] = round(
+            out[f"{precision}_s_per_round"] / out["fp32_s_per_round"], 3)
     return out
 
 
@@ -989,6 +1069,26 @@ def main():
             "value": result["scatter_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["scatter_speedup"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--comms" in sys.argv:
+        # like --agg: the collective-precision comparison needs a
+        # multi-shard mesh, so force 8 virtual host devices up front
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        info = _platform_info(measure_peak=False)
+        result = bench_comms()
+        result.update({
+            "metric": "collective_precision_bytes_and_time",
+            "value": result["int8_bytes_reduction"],
+            "unit": "x_bytes_reduction_int8_vs_fp32",
+            "vs_baseline": result["bf16_bytes_reduction"],
+            "collective_precision": ["fp32", "bf16", "int8"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

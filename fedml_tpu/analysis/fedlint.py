@@ -83,7 +83,11 @@ RULES: Dict[str, Rule] = {
              "random streams"),
         Rule("collective-axis-check", ERROR,
              "psum/psum_scatter/all_gather/... axis name must match an "
-             "axis declared by a Mesh/pmap/shard_map in the package"),
+             "axis declared by a Mesh/pmap/shard_map in the package; also "
+             "flags an fp32 upcast (.astype(float32)) fed directly into a "
+             "collective payload — quantize or keep the compute dtype so "
+             "the interconnect doesn't move full-width bytes "
+             "(docs/COLLECTIVE_PRECISION.md)"),
         Rule("donation-after-use", ERROR,
              "an argument listed in donate_argnums is read after the "
              "jitted call — its buffer now holds garbage"),
@@ -650,6 +654,39 @@ def check_rng_key_reuse(mv: ModuleView, out: List[Finding]):
 # rule: collective-axis-check
 # --------------------------------------------------------------------------
 
+#: collectives with a data payload at position 0 (axis_index/axis_size
+#: take no payload) — targets of the fp32-upcast sub-check
+_COLLECTIVES_WITH_PAYLOAD = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle",
+}
+
+_F32_NAMES = {"float32", "f32"}
+
+
+def _is_f32_dtype_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value in _F32_NAMES
+    name = last_attr(node)
+    return name in _F32_NAMES
+
+
+def _payload_f32_upcast(payload: ast.AST) -> Optional[ast.Call]:
+    """First ``<expr>.astype(float32-ish)`` call inside a collective's
+    payload expression (the value upcast was available at its compute
+    dtype, so full-width bytes crossing the interconnect is a choice that
+    deserves at least a suppression comment).  Bool sources are exempt:
+    ``(w > 0).astype(float32)`` widens a mask for arithmetic — there is no
+    narrower compute dtype to keep."""
+    for sub in ast.walk(payload):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr == "astype" and sub.args \
+                and _is_f32_dtype_expr(sub.args[0]) \
+                and not isinstance(sub.func.value, ast.Compare):
+            return sub
+    return None
+
+
 def check_collective_axis(mv: ModuleView, out: List[Finding]):
     sev = RULES["collective-axis-check"].severity
     declared = mv.pkg.axes | mv.mod.declared_axes
@@ -662,6 +699,18 @@ def check_collective_axis(mv: ModuleView, out: List[Finding]):
         d = dotted_name(node.func) or ""
         if not (d.startswith(("jax.lax.", "lax.")) or d == f):
             continue
+        if f in _COLLECTIVES_WITH_PAYLOAD and node.args:
+            upcast = _payload_f32_upcast(node.args[0])
+            if upcast is not None:
+                out.append(Finding(
+                    "collective-axis-check", sev, mv.mod.path,
+                    node.lineno, node.col_offset,
+                    f"{f}() payload contains an fp32 upcast "
+                    "(.astype(float32)) — the collective moves full-width "
+                    "bytes although a compute-dtype input was available; "
+                    "quantize the payload (collective_precision, "
+                    "docs/COLLECTIVE_PRECISION.md) or suppress with a "
+                    "reason if fp32 on the wire is intentional"))
         pos = _COLLECTIVES_AXIS_POS[f]
         axis_expr = None
         if len(node.args) > pos:

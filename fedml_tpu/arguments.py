@@ -129,6 +129,12 @@ _DEFAULTS: Dict[str, Any] = dict(
     update_sharding="auto",
     # double-buffered host->device cohort staging (mesh engine)
     async_staging=True,
+    # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
+    # fp32 | bf16 | int8 | auto (auto = bf16 whenever the client axis has
+    # > 1 shard); quant_block is the per-absmax-scale chunk of the int8
+    # block-scaled quantizer
+    collective_precision="fp32",
+    quant_block=256,
     # fedtrace round-telemetry plane (docs/OBSERVABILITY.md): trace=True
     # enables the global tracer; trace_path sets the Chrome-trace output
     trace=False,

@@ -2,6 +2,10 @@
 ``python/fedml/utils/compression.py`` rebuilt as pure pytree transforms —
 see ``compressors.py``)."""
 
+from .blockscale import (COLLECTIVE_PRECISIONS, bf16_stochastic_round,
+                         blockscale_dequantize, blockscale_quantize,
+                         collective_payload_nbytes, collective_quantize,
+                         modeled_collective_bytes)
 from .compressors import (EFTopKCompressor, NoneCompressor, QSGDCompressor,
                           QuantizationCompressor, TopKCompressor,
                           create_compressor, is_compressed_payload,
@@ -13,4 +17,7 @@ __all__ = [
     "QuantizationCompressor", "QSGDCompressor", "create_compressor",
     "is_compressed_payload", "payload_nbytes", "tree_nbytes",
     "FedMLCompression",
+    "COLLECTIVE_PRECISIONS", "blockscale_quantize", "blockscale_dequantize",
+    "bf16_stochastic_round", "collective_quantize",
+    "collective_payload_nbytes", "modeled_collective_bytes",
 ]

@@ -1,0 +1,188 @@
+"""Block-scaled low-precision quantization — the in-jit pure-function core
+shared by the mesh engine's compiled collective layer
+(``args.collective_precision``, docs/COLLECTIVE_PRECISION.md) and the host
+message-path compressors (:mod:`.compressors`).
+
+Everything here is shape-static jnp math, safe inside ``jit`` / ``shard_map``
+/ ``lax.scan``:
+
+- :func:`blockscale_quantize` / :func:`blockscale_dequantize` — symmetric
+  per-chunk-absmax integer quantization of a flat vector (chunk = ``block``
+  contiguous elements, one f32 scale per chunk), stochastic rounding by
+  default (unbiased, Alistarh et al. 2017) or round-to-nearest when no key
+  is given.
+- :func:`bf16_stochastic_round` — stochastic rounding f32→bf16 by the
+  classic add-random-low-bits-then-truncate trick on the raw u32 encoding.
+- :func:`collective_quantize` — the precision-dispatched
+  quantize→dequantize pair the engines apply to a collective payload; the
+  caller keeps ``payload − dequantized`` as the error-feedback residual.
+- :func:`collective_payload_nbytes` / :func:`modeled_collective_bytes` —
+  the wire-size model (`q` at integer width + per-chunk f32 scales) used by
+  the ObsCarry ``collective_bytes`` field and ``bench.py --comms``.
+
+The int8 collective path dequantizes BEFORE the ``psum``/``psum_scatter``:
+XLA has no mixed int8×scale reduction, and a real deployment would move the
+(int8 q, f32 scales) payload with an all-to-all and sum after dequantizing —
+so the in-program numerics are exactly the deployed numerics and the byte
+model (not the in-simulation dtype) carries the wire accounting.  bf16
+payloads ARE reduced at bf16 (native on TPU ICI), accumulation error
+included.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+#: accepted values of ``args.collective_precision`` after "auto" resolution
+COLLECTIVE_PRECISIONS = ("fp32", "bf16", "int8")
+
+#: default per-chunk absmax block (``args.quant_block``): one f32 scale per
+#: 256 int8 elements = 1.6% scale overhead on the wire
+DEFAULT_BLOCK = 256
+
+
+def _pad_to_block(vec: jnp.ndarray, block: int):
+    n = vec.shape[0]
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    return vec.reshape(nb, block), n
+
+
+def stochastic_round(x: jnp.ndarray, key) -> jnp.ndarray:
+    """Unbiased rounding of non-negative-step values: ``floor(x + u)`` with
+    ``u ~ U[0, 1)`` — E[result] == x.  ``key=None`` falls back to
+    round-to-nearest (biased)."""
+    if key is None:
+        return jnp.round(x)
+    return jnp.floor(x + jax.random.uniform(key, x.shape))
+
+
+def blockscale_quantize(vec: jnp.ndarray, *, bits: int = 8,
+                        block: int = DEFAULT_BLOCK, key=None):
+    """Flat f32 vector → ``(q, scales)``: symmetric per-chunk quantization
+    to ``2**(bits-1) - 1`` signed levels, int8 storage for bits<=8 else
+    int16.  Stochastic rounding when ``key`` is given."""
+    levels = (1 << (bits - 1)) - 1
+    store = jnp.int8 if bits <= 8 else jnp.int16
+    x = jnp.asarray(vec, jnp.float32)
+    chunks, _ = _pad_to_block(x, block)
+    scales = jnp.maximum(jnp.max(jnp.abs(chunks), axis=1), 1e-12) / levels
+    q = chunks / scales[:, None]
+    q = jnp.sign(q) * stochastic_round(jnp.abs(q), key)
+    q = jnp.clip(q, -levels, levels).astype(store)
+    return q, scales.astype(jnp.float32)
+
+
+def blockscale_dequantize(q: jnp.ndarray, scales: jnp.ndarray,
+                          n: int) -> jnp.ndarray:
+    """Inverse of :func:`blockscale_quantize`: f32 vector of length ``n``."""
+    x = q.astype(jnp.float32) * scales[:, None].astype(jnp.float32)
+    return x.reshape(-1)[:n]
+
+
+def bf16_stochastic_round(x: jnp.ndarray, key=None) -> jnp.ndarray:
+    """f32 → bf16.  With a key: stochastic rounding via a random 16-bit
+    add on the u32 encoding then truncation (a carry into the kept bits IS
+    the round-up path, so E[result] == x); without: hardware
+    round-to-nearest-even."""
+    x = jnp.asarray(x, jnp.float32)
+    if key is None:
+        return x.astype(jnp.bfloat16)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.randint(key, x.shape, 0, 1 << 16,
+                               dtype=jnp.uint32)
+    trunc = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(trunc, jnp.float32).astype(
+        jnp.bfloat16)
+
+
+def collective_quantize(vec: jnp.ndarray, precision: str, key=None,
+                        block: int = DEFAULT_BLOCK):
+    """Quantize→dequantize a flat f32 collective payload at ``precision``.
+
+    Returns ``(deq, err_sq)``: the f32 values the collective actually moves
+    (for bf16 they are exactly bf16-representable, so a subsequent
+    ``.astype(bfloat16)`` is lossless) and the squared L2 norm of the
+    residual ``vec − deq`` the caller accumulates into its error-feedback
+    buffer.  ``precision="fp32"`` is the identity."""
+    x = jnp.asarray(vec, jnp.float32)
+    if precision == "fp32":
+        return x, jnp.zeros((), jnp.float32)
+    if precision == "bf16":
+        deq = bf16_stochastic_round(x, key).astype(jnp.float32)
+    elif precision == "int8":
+        q, scales = blockscale_quantize(x, bits=8, block=block, key=key)
+        deq = blockscale_dequantize(q, scales, x.shape[0])
+    else:
+        raise ValueError(f"unknown collective precision {precision!r}")
+    err = x - deq
+    return deq, jnp.sum(err * err)
+
+
+def quantize_broadcast(master: jnp.ndarray, ef, precision: str, key=None,
+                       block: int = DEFAULT_BLOCK):
+    """Quantize the flat fp32 master params for the post-update broadcast.
+
+    Returns ``(send, new_ef, err_sq)``: the f32 values the all-gather moves,
+    the updated broadcast EF residual (unchanged/None unless int8), and the
+    squared residual norm for telemetry.
+
+    bf16 rounds to nearest (no EF, no key): the master never degrades —
+    each round re-rounds from fp32, so the ~2⁻⁹ relative error is white,
+    not accumulating.  int8's per-block step is ~1/254 of the block range,
+    large enough that the residual is fed back (``ef``) so the broadcast
+    params track the master in time-average."""
+    x = jnp.asarray(master, jnp.float32)
+    if precision == "fp32":
+        return x, ef, jnp.zeros((), jnp.float32)
+    if precision == "bf16":
+        deq = bf16_stochastic_round(x).astype(jnp.float32)
+        err = x - deq
+        return deq, ef, jnp.sum(err * err)
+    v = x + ef
+    deq, err_sq = collective_quantize(v, precision, key, block)
+    return deq, v - deq, err_sq
+
+
+# -- wire-size model ---------------------------------------------------------
+
+def collective_payload_nbytes(n: int, precision: str,
+                              block: int = DEFAULT_BLOCK) -> int:
+    """Wire bytes of one n-element payload at ``precision`` (int8 counts
+    the per-chunk f32 scale arrays — the same fix
+    ``compressors.payload_nbytes`` applies to the host path)."""
+    if precision == "fp32":
+        return 4 * n
+    if precision == "bf16":
+        return 2 * n
+    if precision == "int8":
+        return n + 4 * math.ceil(n / block)
+    raise ValueError(f"unknown collective precision {precision!r}")
+
+
+def modeled_collective_bytes(n_flat: int, n_shards: int, precision: str,
+                             block: int = DEFAULT_BLOCK,
+                             update_sharding: str = "scatter") -> int:
+    """Modeled interconnect payload bytes per round for the mesh engine's
+    two hot-path collectives (docs/COLLECTIVE_PRECISION.md):
+
+    - ``scatter``: reduce-scatter of the EF-quantized FedAvg numerator
+      (``n_flat`` elements) + all-gather of the quantized new params
+      (``n_shards`` chunks of ``n_flat/n_shards``, each block-scaled
+      independently in int8 mode).
+    - ``replicated``: one all-reduce of the quantized numerator.
+
+    Payload bytes entering the collectives; topology factors like the ring
+    ``(N−1)/N`` cancel in the fp32-vs-quantized ratios ``bench.py --comms``
+    reports, so they are deliberately omitted."""
+    merge = collective_payload_nbytes(n_flat, precision, block)
+    if update_sharding != "scatter":
+        return merge
+    chunk = -(-n_flat // max(n_shards, 1))
+    bcast = n_shards * collective_payload_nbytes(chunk, precision, block)
+    return merge + bcast

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import blockscale
+
 _KIND = "__compressed__"
 _CLEAF = "__cleaf__"
 
@@ -52,13 +54,21 @@ def _map_cleaves(fn, payload_tree):
 
 
 def payload_nbytes(payload) -> int:
-    """Wire size of a compressed payload (sum of array bytes)."""
+    """Wire size of a compressed payload: array bytes PLUS the scalar
+    metadata each leaf ships (per-chunk scale arrays, lo/norm floats —
+    pre-fix only the arrays were counted, under-reporting the quantized
+    wire size by exactly the scale overhead the block-scaled format
+    pays)."""
     total = [0]
 
     def add(d):
-        for v in d.values():
+        for k, v in d.items():
+            if k == _CLEAF:
+                continue
             if isinstance(v, np.ndarray):
                 total[0] += v.nbytes
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                total[0] += 4  # f32 on the wire
         return d
 
     _map_cleaves(add, payload["tree"])
@@ -158,62 +168,75 @@ class EFTopKCompressor(TopKCompressor):
 
 
 class QuantizationCompressor:
-    """Uniform min-max quantization to ``2**bits`` levels (reference
-    ``compression.py:175``; ``is_biased=False`` selects unbiased stochastic
-    rounding as in QSGD, Alistarh et al. 2017)."""
+    """Block-scaled symmetric quantization (reference ``compression.py:175``
+    semantics — host-path leaf quantization — rebased onto the shared
+    :func:`blockscale.blockscale_quantize` pair the mesh engine's compiled
+    collective layer uses, so host messages and in-jit collectives share ONE
+    quantizer implementation and wire format: signed ``2**(bits-1)-1``-level
+    values with one f32 absmax scale per ``block`` elements.
+    ``is_biased=False`` selects unbiased stochastic rounding (QSGD-style,
+    Alistarh et al. 2017)."""
 
     name = "quantize"
 
-    def __init__(self, bits: int = 8, is_biased: bool = True, seed: int = 0):
-        if not 1 <= int(bits) <= 16:
+    def __init__(self, bits: int = 8, is_biased: bool = True, seed: int = 0,
+                 block: int = blockscale.DEFAULT_BLOCK):
+        if not 2 <= int(bits) <= 16:
             raise ValueError(
-                f"quantize compression_bits must be in [1, 16], got {bits}")
+                f"quantize compression_bits must be in [2, 16], got {bits}")
         self.bits = int(bits)
         self.is_biased = bool(is_biased)
+        self.block = int(block)
         self._key = jax.random.PRNGKey(seed ^ 0xC0)
         self._key_lock = threading.Lock()
 
     def compress(self, tree, state=None):
-        levels = (1 << self.bits) - 1
-        store = np.uint8 if self.bits <= 8 else np.uint16
-
         def enc_dev(leaf):
-            x = jnp.asarray(leaf, jnp.float32)
-            lo = jnp.min(x)
-            scale = jnp.maximum(jnp.max(x) - lo, 1e-12) / levels
-            q = (x - lo) / scale
-            if self.is_biased:
-                q = jnp.round(q)
-            else:
+            x = jnp.asarray(leaf, jnp.float32).reshape(-1)
+            key = None
+            if not self.is_biased:
                 with self._key_lock:  # co-resident client threads
-                    self._key, sub = jax.random.split(self._key)
-                q = jnp.floor(q + jax.random.uniform(sub, q.shape))
-            # cast to the wire dtype ON DEVICE so the batched host
+                    self._key, key = jax.random.split(self._key)
+            # q lands in the wire dtype ON DEVICE so the batched host
             # transfer ships 1-2 bytes/element, not f32 width
-            return {_CLEAF: 1,
-                    "q": jnp.clip(q, 0, levels).astype(
-                        jnp.uint8 if self.bits <= 8 else jnp.uint16),
-                    "lo": lo, "scale": scale}
+            q, scales = blockscale.blockscale_quantize(
+                x, bits=self.bits, block=self.block, key=key)
+            return {_CLEAF: 1, "q": q, "scales": scales}
 
-        # every leaf's q/lo/scale lands in ONE batched host transfer
+        # every leaf's q/scales lands in ONE batched host transfer
         # (device_get async-copies all leaves before blocking) instead of a
         # per-leaf float() sync that would serialize device round-trips
         host = jax.device_get(_map_leaves(enc_dev, tree))
 
         def finish(d, leaf):
-            return {_CLEAF: 1, "q": np.asarray(d["q"], store),
-                    "lo": float(d["lo"]), "scale": float(d["scale"]),
+            shape = tuple(getattr(leaf, "shape", np.asarray(leaf).shape))
+            n = int(np.prod(shape)) if shape else 1
+            return {_CLEAF: 1,
+                    # ship only the real elements; the block padding is
+                    # reconstructed from `scales`' chunk count at decode
+                    "q": np.asarray(d["q"]).reshape(-1)[:n],
+                    "scales": np.asarray(d["scales"], np.float32),
+                    "shape": np.asarray(shape, np.int64),
                     "dtype": (str(leaf.dtype) if hasattr(leaf, "dtype")
                               else str(np.asarray(leaf).dtype))}
 
         out = jax.tree_util.tree_map(finish, host, tree, is_leaf=_is_cleaf)
-        return {_KIND: self.name, "tree": out}, state
+        return {_KIND: self.name, "block": self.block, "tree": out}, state
 
-    def decompress(self, payload):
+    @staticmethod
+    def decompress(payload):
+        block = int(payload.get("block", blockscale.DEFAULT_BLOCK))
+
         def dec(d):
-            x = (jnp.asarray(d["q"], jnp.float32) * float(d["scale"])
-                 + float(d["lo"]))
-            return x.astype(d["dtype"])
+            shape = tuple(int(s) for s in np.asarray(d["shape"]))
+            n = int(np.prod(shape)) if shape else 1
+            scales = np.asarray(d["scales"], np.float32)
+            q = np.zeros(scales.shape[0] * block, np.asarray(d["q"]).dtype)
+            q[:n] = np.asarray(d["q"]).reshape(-1)
+            x = blockscale.blockscale_dequantize(
+                jnp.asarray(q).reshape(scales.shape[0], block),
+                jnp.asarray(scales), n)
+            return x.reshape(shape).astype(d["dtype"])
 
         return _map_cleaves(dec, payload["tree"])
 
@@ -238,10 +261,12 @@ class QSGDCompressor:
         def enc_dev(leaf):
             x = jnp.asarray(leaf, jnp.float32)
             norm = jnp.maximum(jnp.linalg.norm(x.reshape(-1)), 1e-12)
-            level = jnp.abs(x) / norm * s
             with self._key_lock:  # co-resident client threads
                 self._key, sub = jax.random.split(self._key)
-            level = jnp.floor(level + jax.random.uniform(sub, x.shape))
+            # shared unbiased rounding core (blockscale.stochastic_round):
+            # QSGD keeps its per-leaf 2-norm scale, only the leaf math is
+            # rebased onto the collective layer's quantizer helpers
+            level = blockscale.stochastic_round(jnp.abs(x) / norm * s, sub)
             # int8 on device: the batched host transfer ships wire width
             return {_CLEAF: 1,
                     "q": (jnp.sign(x) * level).astype(jnp.int8),

@@ -47,6 +47,28 @@ class TrainState:
         return self.replace(step=self.step + 1, params=new_params, opt_state=new_opt)
 
 
+def resolve_collective_precision(args, n_shards: int = 1) -> str:
+    """Resolve ``args.collective_precision`` (docs/COLLECTIVE_PRECISION.md)
+    for an engine running on ``n_shards`` client-axis shards.
+
+    ``fp32`` (default) keeps the collectives exactly as before; ``bf16`` /
+    ``int8`` quantize the merge numerator (with on-device error feedback)
+    and the post-update broadcast while the server update keeps an fp32
+    master copy; ``auto`` picks bf16 whenever the payload actually crosses
+    an interconnect (multi-shard mesh) and fp32 otherwise — the same shape
+    of default ``update_sharding="auto"`` uses."""
+    mode = str(getattr(args, "collective_precision", "fp32")
+               or "fp32").lower()
+    if mode == "auto":
+        return "bf16" if n_shards > 1 else "fp32"
+    from .compression.blockscale import COLLECTIVE_PRECISIONS
+    if mode not in COLLECTIVE_PRECISIONS:
+        raise ValueError(
+            f"collective_precision must be one of "
+            f"{COLLECTIVE_PRECISIONS + ('auto',)}, got {mode!r}")
+    return mode
+
+
 def make_sgd(lr: float, momentum: float = 0.0, weight_decay: float = 0.0,
              clip_grad: Optional[float] = None) -> optax.GradientTransformation:
     """The reference's default client optimizer (torch SGD, see

@@ -74,6 +74,47 @@ def _disable_compile_cache():
         pass
 
 
+#: verdict-cache TTLs (seconds): a success is trusted for an hour; a hang
+#: is trusted only briefly so a recovered tunnel is re-probed soon
+#: (override with FEDML_TPU_PROBE_OK_TTL / FEDML_TPU_PROBE_HUNG_TTL)
+PROBE_OK_TTL_S = 3600.0
+PROBE_HUNG_TTL_S = 600.0
+
+
+def _probe_verdict_path() -> str:
+    return os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        f"fedml_tpu_probe_verdict_uid{os.getuid()}")
+
+
+def _read_probe_verdict():
+    """Cached liveness verdict ("ok" | "hung") if still fresh, else None."""
+    path = _probe_verdict_path()
+    try:
+        with open(path) as f:
+            verdict = f.read().strip()
+        age = time.time() - os.path.getmtime(path)
+    except OSError:
+        return None
+    ttl = {
+        "ok": float(os.environ.get("FEDML_TPU_PROBE_OK_TTL",
+                                   PROBE_OK_TTL_S)),
+        "hung": float(os.environ.get("FEDML_TPU_PROBE_HUNG_TTL",
+                                     PROBE_HUNG_TTL_S)),
+    }.get(verdict)
+    if ttl is None or age >= ttl:
+        return None
+    return verdict
+
+
+def _write_probe_verdict(verdict: str):
+    try:
+        with open(_probe_verdict_path(), "w") as f:
+            f.write(verdict + "\n")
+    except OSError:
+        pass
+
+
 def _backend_already_up() -> bool:
     try:
         from jax._src import xla_bridge
@@ -101,40 +142,42 @@ def initialize_backend(retries: int = 3, backoff_s: float = 2.0):
     if not _backend_already_up() and forced.lower() not in ("cpu",):
         timeout_s = float(os.environ.get(
             "FEDML_TPU_DEVICE_PROBE_TIMEOUT", "120") or 120)
-        # a machine-local success marker skips the subprocess probe on
-        # healthy machines (it costs a full extra plugin init); stale
-        # markers expire so a later wedge is still caught
-        marker = os.path.join(
-            os.environ.get("TMPDIR", "/tmp"),
-            f"fedml_tpu_probe_ok_uid{os.getuid()}")
-        marker_fresh = False
-        try:
-            import time as _time
-            marker_fresh = (os.path.exists(marker) and
-                            _time.time() - os.path.getmtime(marker) < 3600)
-        except OSError:
-            pass
-        if timeout_s > 0 and not marker_fresh \
-                and not _probe_backend_subprocess(timeout_s):
-            log.error(
-                "accelerator init HUNG >%ss in the liveness probe "
-                "(wedged tunnel?); forcing the CPU backend for this "
-                "process", timeout_s)
+        # The probe VERDICT (ok/hung) is cached in a machine-local side
+        # file: "ok" skips the subprocess probe on healthy machines (it
+        # costs a full extra plugin init), and "hung" skips it on a wedged
+        # tunnel so the 120 s hang is paid once per boot, not once per
+        # bench/test invocation (BENCH_r05).  Both verdicts expire — the
+        # negative one sooner, so a recovered tunnel is re-detected fast.
+        verdict = _read_probe_verdict()
+        if verdict == "hung" or (
+                verdict is None and timeout_s > 0
+                and not _probe_backend_subprocess(timeout_s)):
+            if verdict == "hung":
+                log.error(
+                    "accelerator liveness verdict cached as HUNG "
+                    "(%s); forcing the CPU backend without re-probing "
+                    "— delete the file or wait out the TTL to retry",
+                    _probe_verdict_path())
+                note = "cpu fallback (cached probe verdict: hung)"
+            else:
+                log.error(
+                    "accelerator init HUNG >%ss in the liveness probe "
+                    "(wedged tunnel?); forcing the CPU backend for this "
+                    "process", timeout_s)
+                _write_probe_verdict("hung")
+                note = (f"cpu fallback (accelerator init hung "
+                        f">{timeout_s:.0f}s)")
             try:
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
                 pass
             _disable_compile_cache()
             devices = jax.devices("cpu")
-            BACKEND_NOTE = (f"cpu fallback (accelerator init hung "
-                            f">{timeout_s:.0f}s)")
+            BACKEND_NOTE = note
             return devices
-        if not marker_fresh:
-            try:  # probe succeeded (or was skipped): refresh the marker
-                with open(marker, "w") as f:
-                    f.write("ok\n")
-            except OSError:
-                pass
+        if verdict is None:
+            # probe succeeded (or was disabled): cache the positive verdict
+            _write_probe_verdict("ok")
     for attempt in range(1, retries + 1):
         try:
             devices = jax.devices()

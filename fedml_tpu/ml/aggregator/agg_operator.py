@@ -62,6 +62,18 @@ class ServerState:
     c_server: Any = None         # SCAFFOLD
     h: Any = None                # FedDyn
     momentum: Any = None         # Mime
+    # -- low-precision collective layer (docs/COLLECTIVE_PRECISION.md);
+    #    all None when collective_precision == "fp32" --------------------
+    #: per-shard error-feedback residual of the quantized merge numerator,
+    #: (n_shards, flat_len) — each shard owns its own row
+    ef_num: Any = None
+    #: fp32 master copy of the flattened params; with a quantized
+    #: broadcast, ``global_params`` holds the low-precision COMPUTE copy
+    #: the clients train from while the server update transitions this
+    #: master (scatter mode keeps it permanently shard-resident)
+    master_flat: Any = None
+    #: error-feedback residual of the int8 params broadcast, (flat_len,)
+    ef_bcast: Any = None
 
 
 def sharded_state_map(state: ServerState, repl, shard) -> ServerState:
@@ -79,7 +91,23 @@ def sharded_state_map(state: ServerState, repl, shard) -> ServerState:
         opt_state=mark(state.opt_state, True),
         c_server=mark(state.c_server, True),
         h=mark(state.h, True),
-        momentum=mark(state.momentum, True))
+        momentum=mark(state.momentum, True),
+        # collective-precision state: ef_num rows and the flat master /
+        # broadcast-residual vectors are shard-resident like opt_state
+        ef_num=mark(state.ef_num, True),
+        master_flat=mark(state.master_flat, True),
+        ef_bcast=mark(state.ef_bcast, True))
+
+
+def replicated_ef_state_map(state: ServerState, repl, shard) -> ServerState:
+    """Leaf-spec map for a REPLICATED-mode state that carries the
+    collective-precision EF buffer: everything replicated except ``ef_num``,
+    whose rows are per-shard residuals (each chip quantizes its own local
+    numerator, so the rows are genuinely different arrays per shard)."""
+    marked = jax.tree_util.tree_map(lambda _: repl, state)
+    if state.ef_num is not None:
+        marked = marked.replace(ef_num=shard)
+    return marked
 
 class ServerOptimizer:
     """Builds jittable server-update functions per algorithm."""
@@ -103,7 +131,9 @@ class ServerOptimizer:
         else:
             self.server_tx = None
 
-    def init(self, params) -> ServerState:
+    def init(self, params, collective_precision: str = "fp32",
+             ef_shards: int = 1, quantized_broadcast: bool = True
+             ) -> ServerState:
         st = ServerState(round_idx=jnp.zeros((), jnp.int32), global_params=params)
         if self.server_tx is not None:
             st = st.replace(opt_state=self.server_tx.init(params))
@@ -113,9 +143,24 @@ class ServerOptimizer:
             st = st.replace(h=tree_util.tree_zeros_like(params))
         if self.algorithm == "mime":
             st = st.replace(momentum=tree_util.tree_zeros_like(params))
+        if collective_precision != "fp32":
+            # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
+            # one EF residual row per shard quantizing its local numerator;
+            # the fp32 master copy splits off global_params only when the
+            # broadcast itself is quantized (sp / mesh-scatter — the mesh's
+            # replicated merge mode keeps params fp32-replicated and only
+            # quantizes the numerator all-reduce)
+            flat = tree_util.tree_flatten_1d(params)
+            st = st.replace(ef_num=jnp.zeros((ef_shards, flat.shape[0]),
+                                             jnp.float32))
+            if quantized_broadcast:
+                st = st.replace(master_flat=flat)
+                if collective_precision == "int8":
+                    st = st.replace(ef_bcast=jnp.zeros_like(flat))
         return st
 
-    def init_sharded(self, params, n_shards: int) -> ServerState:
+    def init_sharded(self, params, n_shards: int,
+                     collective_precision: str = "fp32") -> ServerState:
         """Scatter-mode init (arXiv:2004.13336 layout): every aux field is a
         flat f32 vector over the padded flattened model — ONE logical array
         the caller device_puts with ``P(client)`` so each chip owns a
@@ -132,6 +177,17 @@ class ServerOptimizer:
             st = st.replace(h=jnp.zeros_like(flat))
         if self.algorithm == "mime":
             st = st.replace(momentum=jnp.zeros_like(flat))
+        if collective_precision != "fp32":
+            # EF residual rows (one per shard) for the quantized
+            # reduce-scatter numerator, the permanently shard-resident fp32
+            # master of the flat params (global_params becomes the
+            # low-precision broadcast copy), and the int8 broadcast's own
+            # EF residual — all sharded over the client axis like opt_state
+            st = st.replace(
+                ef_num=jnp.zeros((n_shards, flat.shape[0]), jnp.float32),
+                master_flat=flat)
+            if collective_precision == "int8":
+                st = st.replace(ef_bcast=jnp.zeros_like(flat))
         return st
 
     # -- stage 1: cross-client reductions ---------------------------------

@@ -54,6 +54,12 @@ class ObsCarry:
     examples: jnp.ndarray     # real examples consumed (steps × batch)
     update_norm: jnp.ndarray  # ‖new_global − old_global‖₂ (f32)
     phase_flops: jnp.ndarray  # (4,) per-phase FLOP attribution weights
+    # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
+    # modeled interconnect payload bytes of merge+broadcast this round
+    # (trace-time static — fp32 reports its dense payload so ratios work)
+    # and the L2 norm of this round's quantization residual (0 at fp32)
+    collective_bytes: jnp.ndarray
+    quant_error_norm: jnp.ndarray
 
 
 def param_count(tree: Any) -> int:
@@ -63,8 +69,9 @@ def param_count(tree: Any) -> int:
 
 
 def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
-              batch: int, feat: int,
-              opt_flops_per_param: float) -> ObsCarry:
+              batch: int, feat: int, opt_flops_per_param: float,
+              collective_bytes: float = 0.0,
+              quant_error=None) -> ObsCarry:
     """Build the ObsCarry INSIDE the compiled round.
 
     ``real_steps``/``real_clients`` are traced scalars the round already
@@ -89,17 +96,25 @@ def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
         jnp.asarray(float(opt_flops_per_param) * p, f32),  # server update
     ])
     return ObsCarry(steps=steps, clients=clients, examples=examples,
-                    update_norm=update_norm, phase_flops=phase_flops)
+                    update_norm=update_norm, phase_flops=phase_flops,
+                    collective_bytes=jnp.asarray(float(collective_bytes),
+                                                 f32),
+                    quant_error_norm=(jnp.zeros((), f32) if quant_error
+                                      is None
+                                      else jnp.asarray(quant_error, f32)))
 
 
 # -- host-side materialization (called ONLY at the driver's existing
 #    log-round sync points; the values are already computed on device) ------
 
-def _row(steps, clients, examples, norm, pf) -> Dict[str, float]:
+def _row(steps, clients, examples, norm, pf, cbytes, qerr
+         ) -> Dict[str, float]:
     out = {"steps": float(steps), "clients": float(clients),
            "examples": float(examples), "update_norm": float(norm)}
     for i, phase in enumerate(DEVICE_PHASES):
         out[f"flops_{phase}"] = float(pf[i])
+    out["collective_bytes"] = float(cbytes)
+    out["quant_error_norm"] = float(qerr)
     return out
 
 
@@ -107,7 +122,9 @@ def obs_host(carry: ObsCarry) -> Dict[str, float]:
     """Materialize a scalar ObsCarry into plain host floats."""
     return _row(np.asarray(carry.steps), np.asarray(carry.clients),
                 np.asarray(carry.examples), np.asarray(carry.update_norm),
-                np.asarray(carry.phase_flops))
+                np.asarray(carry.phase_flops),
+                np.asarray(carry.collective_bytes),
+                np.asarray(carry.quant_error_norm))
 
 
 def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
@@ -118,7 +135,10 @@ def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
     examples = np.asarray(carry.examples)
     norm = np.asarray(carry.update_norm)
     pf = np.asarray(carry.phase_flops)
+    cb = np.asarray(carry.collective_bytes)
+    qe = np.asarray(carry.quant_error_norm)
     if steps.ndim == 0:
-        return [_row(steps, clients, examples, norm, pf)]
-    return [_row(steps[j], clients[j], examples[j], norm[j], pf[j])
+        return [_row(steps, clients, examples, norm, pf, cb, qe)]
+    return [_row(steps[j], clients[j], examples[j], norm[j], pf[j],
+                 cb[j], qe[j])
             for j in range(steps.shape[0])]

@@ -55,12 +55,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core import rng as rng_util
 from ...core import tree as tree_util
+from ...core.compression import blockscale
 from ...core.mesh import CLIENT_AXIS, make_mesh
+from ...core.state import resolve_collective_precision
 from ...ml.aggregator.agg_operator import (ServerOptimizer, ServerState,
+                                           replicated_ef_state_map,
                                            sharded_state_map)
 from ...ml.trainer.local_trainer import LocalTrainer
 from ...obs.carry import OPT_FLOPS, round_obs
-from ..round_engine import next_pow2
+from ..round_engine import QUANT_KEY_TAG, next_pow2
 from ..sp.fedavg_api import FedAvgAPI
 from ..staging import AsyncCohortStager  # noqa: F401  (re-export: the
 # stager predates ISSUE 3's fused blocks and callers import it from here)
@@ -72,6 +75,11 @@ def _psum_wavg(stacked, w, axis_name):
     """Globally-correct weighted average of a client-axis-sharded stack:
     local partial numerator/denominator, then one psum each over ICI."""
     num = jax.tree_util.tree_map(
+        # intentional fp32 master-copy merge: collective_precision=fp32
+        # requests full-width wire bytes and the weighted sum must
+        # accumulate at f32; the quantized path bypasses this helper
+        # entirely (docs/COLLECTIVE_PRECISION.md)
+        # fedlint: disable-next-line=collective-axis-check -- see above
         lambda l: jax.lax.psum(jnp.tensordot(w, l.astype(jnp.float32), axes=1),
                                axis_name), stacked)
     den = jax.lax.psum(jnp.sum(w), axis_name)
@@ -83,7 +91,9 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        sharded_data: bool = False,
                        update_sharding: str = "replicated",
                        state_template: ServerState = None,
-                       donate: bool = False):
+                       donate: bool = False,
+                       collective_precision: str = "fp32",
+                       quant_block: int = blockscale.DEFAULT_BLOCK):
     """round_fn(state, x|idx, y|·, mask, weights, key, c_clients) with the
     client axis sharded over the mesh.  In gather mode the first data arg is
     the (C, S, B) index tensor and ``y`` is the device-resident dataset pair
@@ -103,17 +113,28 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     state from ``ServerOptimizer.init_sharded`` — to derive the mixed
     replicated/sharded specs of the ServerState pytree.  ``donate=True``
     donates the state argument so XLA reuses the old ServerState buffers
-    in place instead of copying model + optimizer state every round."""
+    in place instead of copying model + optimizer state every round.
+
+    ``collective_precision`` (docs/COLLECTIVE_PRECISION.md) quantizes the
+    two hot-path collectives INSIDE the compiled round: the flattened
+    FedAvg numerator is block-scaled/stochastically rounded against a
+    per-shard error-feedback buffer before the merge collective, and
+    (scatter mode) the post-update ``all_gather`` ships the quantized new
+    params while the server update transitions the shard-resident fp32
+    master (``ServerState.master_flat``)."""
     round_fn = _make_mesh_round_core(trainer, server_opt, mesh, gather,
                                      sharded_data, update_sharding,
-                                     state_template)
+                                     state_template, collective_precision,
+                                     quant_block)
     return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
 
 
 def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
                           mesh: Mesh, gather: bool, sharded_data: bool,
                           update_sharding: str,
-                          state_template: ServerState):
+                          state_template: ServerState,
+                          collective_precision: str = "fp32",
+                          quant_block: int = blockscale.DEFAULT_BLOCK):
     """Unjitted round body shared by the per-round jit
     (:func:`make_mesh_round_fn`) and the fused round-block scan
     (:func:`make_mesh_block_fn`)."""
@@ -121,12 +142,33 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
     alg = server_opt.algorithm
     n_shards = mesh.shape[CLIENT_AXIS]
     scatter = update_sharding == "scatter"
+    precision = collective_precision
+    quantized = precision != "fp32"
     if scatter and state_template is None:
         raise ValueError("scatter mode needs a state_template from "
                          "ServerOptimizer.init_sharded")
+    if quantized and state_template is None:
+        raise ValueError("collective_precision needs a state_template "
+                         "carrying the EF buffers (ServerOptimizer.init/"
+                         "init_sharded with collective_precision set)")
     from ..round_engine import make_server_ctx
 
     use_ingather = gather and not sharded_data
+
+    def _wire_cast(v):
+        """Payload dtype of a quantized collective: bf16 values really move
+        (and accumulate) at bf16; int8 payloads dequantize BEFORE the
+        collective (the modeled wire format is (int8 q, f32 scales) moved
+        by an all-to-all and summed after dequant — XLA has no mixed
+        int8×scale reduction), so the in-program reduction runs f32."""
+        return v.astype(jnp.bfloat16) if precision == "bf16" else v
+
+    def _shard_qkey(qkey, slot: int):
+        """Per-shard, per-payload stochastic-rounding key: decorrelated
+        across shards (each quantizes a different local payload) and
+        across the merge/broadcast slots within a round."""
+        return jax.random.fold_in(
+            jax.random.fold_in(qkey, jax.lax.axis_index(CLIENT_AXIS)), slot)
 
     def run_cohort(state: ServerState, x, y, mask, rngs, c_clients):
         # shapes here are per-device shards: x (c_local, S, B, ...)
@@ -146,7 +188,25 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         src_shape = y[0].shape[1:] if use_ingather else x.shape[3:]
         return batch, math.prod(src_shape)
 
-    def shard_metrics(outs, w, old_state, new_state, batch, feat):
+    def _bytes_model(state) -> float:
+        """Trace-time static: modeled interconnect payload bytes/round of
+        the merge (+ scatter-mode broadcast) collectives at this round's
+        precision — rides ObsCarry, consumed by ``fedtrace summarize`` and
+        ``bench.py --comms``."""
+        if scatter:
+            n_flat = tree_util.padded_flat_size(state.global_params,
+                                                n_shards)
+        else:
+            n_flat = tree_util.num_params(state.global_params)
+        # float() of a pure python int computed from static shapes — no
+        # traced value involved, so no host sync
+        # fedlint: disable-next-line=jit-host-sync -- see above
+        return float(blockscale.modeled_collective_bytes(
+            n_flat, n_shards, precision, quant_block,
+            "scatter" if scatter else "replicated"))
+
+    def shard_metrics(outs, w, old_state, new_state, batch, feat,
+                      quant_err_sq=None):
         wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
         steps = jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS)
         clients = jax.lax.psum(jnp.sum((w > 0).astype(jnp.float32)),
@@ -159,17 +219,41 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         # device-carry telemetry (ISSUE 4): psummed globals + static shape
         # products; global_params are replicated in both update layouts so
         # the update norm is shard-identical and leaves with the P() spec
+        qerr = None
+        if quant_err_sq is not None:
+            # per-shard residual energies sum into one replicated scalar
+            qerr = jnp.sqrt(jax.lax.psum(quant_err_sq, CLIENT_AXIS))
         metrics["obs"] = round_obs(
             old_state.global_params, new_state.global_params,
             real_steps=steps, real_clients=clients, batch=batch, feat=feat,
-            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0))
+            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0),
+            collective_bytes=_bytes_model(old_state), quant_error=qerr)
         return metrics
 
-    def per_shard_replicated(state: ServerState, x, y, mask, w, rngs,
+    def per_shard_replicated(state: ServerState, x, y, mask, w, rngs, qkey,
                              c_clients):
         outs = run_cohort(state, x, y, mask, rngs, c_clients)
+        quant_err_sq = None
+        if quantized:
+            # EF-quantized merge numerator: each shard adds its residual
+            # row, quantizes its LOCAL flat contribution to the average,
+            # and the all-reduce moves the low-precision payload; the
+            # residual goes back into this shard's ef_num row
+            num = jax.tree_util.tree_map(
+                lambda l: jnp.tensordot(w, l.astype(jnp.float32), axes=1),
+                outs.params)
+            den = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
+            v = state.ef_num[0] + tree_util.tree_flatten_1d(num) / den
+            deq, quant_err_sq = blockscale.collective_quantize(
+                v, precision, _shard_qkey(qkey, 0), quant_block)
+            new_ef_num = (v - deq)[None]
+            summed = jax.lax.psum(_wire_cast(deq), CLIENT_AXIS).astype(
+                jnp.float32)
+            avg = tree_util.tree_unflatten_1d(summed, state.global_params)
+        else:
+            avg = _psum_wavg(outs.params, w, CLIENT_AXIS)
         agg = {
-            "avg_params": _psum_wavg(outs.params, w, CLIENT_AXIS),
+            "avg_params": avg,
             "n_sampled": jax.lax.psum(
                 jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
         }
@@ -189,13 +273,17 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             agg["avg_grad"] = _psum_wavg(outs.grad_sum, w, CLIENT_AXIS)
 
         new_state = server_opt.update_from_aggregates(state, agg)
+        if quantized:
+            new_state = new_state.replace(ef_num=new_ef_num)
         # only per-client algorithm state leaves the shard (returning
         # outs.params would materialize C × |model| for nothing)
         batch, feat = _cohort_dims(x, y)
         return (new_state, shard_metrics(outs, w, state, new_state, batch,
-                                         feat), outs.new_client_state)
+                                         feat, quant_err_sq),
+                outs.new_client_state)
 
-    def per_shard_scatter(state: ServerState, x, y, mask, w, rngs, c_clients):
+    def per_shard_scatter(state: ServerState, x, y, mask, w, rngs, qkey,
+                          c_clients):
         # client-VISIBLE server state (SCAFFOLD's c_server in the corrected
         # gradient, Mime's momentum in the client step) is shard-resident;
         # all_gather + unflatten it back to the params structure for the
@@ -226,8 +314,29 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             return jax.lax.psum_scatter(flat, CLIENT_AXIS,
                                         scatter_dimension=0, tiled=True) / dd
 
+        quant_err_sq = None
+        if quantized:
+            # EF-quantized reduce-scatter of the FedAvg numerator: the
+            # shard's flat contribution to the AVERAGE (divide by the
+            # psummed weight first — EF residuals then live in stable
+            # param-delta units across rounds) plus this shard's residual
+            # row, block-scaled/stochastically rounded, reduce-scattered
+            # at the wire precision
+            num = jax.tree_util.tree_map(
+                lambda l: jnp.tensordot(w, l.astype(jnp.float32), axes=1),
+                outs.params)
+            flat = tree_util.tree_flatten_padded(num, n_shards) / den
+            v = state.ef_num[0] + flat
+            deq, quant_err_sq = blockscale.collective_quantize(
+                v, precision, _shard_qkey(qkey, 0), quant_block)
+            new_ef_num = (v - deq)[None]
+            avg_chunk = jax.lax.psum_scatter(
+                _wire_cast(deq), CLIENT_AXIS, scatter_dimension=0,
+                tiled=True).astype(jnp.float32)
+        else:
+            avg_chunk = scatter_wavg(outs.params, w, den)
         agg = {
-            "avg_params": scatter_wavg(outs.params, w, den),
+            "avg_params": avg_chunk,
             "n_sampled": jax.lax.psum(
                 jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
         }
@@ -247,33 +356,64 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             agg["avg_grad"] = scatter_wavg(outs.grad_sum, w, den)
 
         # this chip's chunk of the current global params, then the sharded
-        # stage-2 transition on 1/n_shards of the model
-        gflat = tree_util.tree_flatten_padded(state.global_params, n_shards)
-        gshard = tree_util.flat_chunk(
-            gflat, jax.lax.axis_index(CLIENT_AXIS), n_shards)
+        # stage-2 transition on 1/n_shards of the model.  With quantized
+        # collectives the chunk comes from the shard-resident fp32 MASTER
+        # (state.global_params is the low-precision broadcast copy the
+        # clients trained from — transitioning it would compound the
+        # broadcast rounding into the model state every round).
+        if quantized:
+            gshard = state.master_flat
+        else:
+            gflat = tree_util.tree_flatten_padded(state.global_params,
+                                                  n_shards)
+            gshard = tree_util.flat_chunk(
+                gflat, jax.lax.axis_index(CLIENT_AXIS), n_shards)
         new_gshard, new_fields = server_opt.update_shard(state, gshard, agg)
         # all_gather ONLY the new params for the next round's broadcast;
         # opt_state/c_server/h/momentum stay shard-resident
-        new_flat = jax.lax.all_gather(new_gshard, CLIENT_AXIS, tiled=True)
+        if quantized:
+            # broadcast at the collective precision: the all_gather ships
+            # the quantized chunk; the fp32 master never crosses the wire
+            send, new_ef_bcast, berr_sq = blockscale.quantize_broadcast(
+                new_gshard, state.ef_bcast, precision,
+                _shard_qkey(qkey, 1), quant_block)
+            new_fields["master_flat"] = new_gshard
+            new_fields["ef_num"] = new_ef_num
+            if state.ef_bcast is not None:
+                new_fields["ef_bcast"] = new_ef_bcast
+            quant_err_sq = quant_err_sq + berr_sq
+            new_flat = jax.lax.all_gather(
+                _wire_cast(send), CLIENT_AXIS, tiled=True).astype(
+                    jnp.float32)
+        else:
+            new_flat = jax.lax.all_gather(new_gshard, CLIENT_AXIS,
+                                          tiled=True)
         new_params = tree_util.tree_unflatten_1d(new_flat,
                                                  state.global_params)
         new_state = state.replace(round_idx=state.round_idx + 1,
                                   global_params=new_params, **new_fields)
         batch, feat = _cohort_dims(x, y)
         return (new_state, shard_metrics(outs, w, state, new_state, batch,
-                                         feat), outs.new_client_state)
+                                         feat, quant_err_sq),
+                outs.new_client_state)
 
     shard = P(CLIENT_AXIS)
     data_spec = P() if use_ingather else shard
     if scatter:
         state_spec = sharded_state_map(state_template, P(), shard)
         per_shard = per_shard_scatter
+    elif quantized:
+        # replicated merge with a quantized numerator: only the per-shard
+        # EF residual rows break full replication
+        state_spec = replicated_ef_state_map(state_template, P(), shard)
+        per_shard = per_shard_replicated
     else:
         state_spec = P()
         per_shard = per_shard_replicated
     sharded = jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(state_spec, shard, data_spec, shard, shard, shard, shard),
+        in_specs=(state_spec, shard, data_spec, shard, shard, shard, P(),
+                  shard),
         out_specs=(state_spec, P(), shard),
         check_vma=False,
     )
@@ -282,6 +422,9 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         # split inside the compiled program (host-side split costs a device
         # roundtrip per round); GSPMD shards the keys per in_spec
         rngs = jax.random.split(key, mask.shape[0])
+        # stochastic-rounding stream of the collective layer, derived from
+        # the same round key (replicated; shards fold in their axis index)
+        qkey = jax.random.fold_in(key, QUANT_KEY_TAG)
         if gather and sharded_data:
             # cohort gather over the ROW-SHARDED dataset: XLA lowers the
             # take into cross-chip collectives; pin the result onto the
@@ -292,7 +435,7 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
                 jnp.take(train_x, idx, axis=0), cohort_spec)
             y = jax.lax.with_sharding_constraint(
                 jnp.take(train_y, idx, axis=0), cohort_spec)
-        return sharded(state, x, y, mask, w, rngs, c_clients)
+        return sharded(state, x, y, mask, w, rngs, qkey, c_clients)
 
     return round_fn
 
@@ -302,7 +445,9 @@ def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        sharded_data: bool = False,
                        update_sharding: str = "replicated",
                        state_template: ServerState = None,
-                       donate: bool = False):
+                       donate: bool = False,
+                       collective_precision: str = "fp32",
+                       quant_block: int = blockscale.DEFAULT_BLOCK):
     """Fused mesh round-block: K rounds as ONE ``jit(lax.scan(round))``
     dispatch (ISSUE 3 tentpole; same composition DrJAX builds from,
     arXiv:2403.07128).
@@ -319,7 +464,8 @@ def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     ``(K,)`` outputs so the host syncs once per block."""
     core = _make_mesh_round_core(trainer, server_opt, mesh, gather,
                                  sharded_data, update_sharding,
-                                 state_template)
+                                 state_template, collective_precision,
+                                 quant_block)
     has_table = server_opt.algorithm in ("scaffold", "feddyn")
     row_sharding = NamedSharding(mesh, P(CLIENT_AXIS))
 
@@ -384,6 +530,12 @@ class MeshFedAvgAPI(FedAvgAPI):
             # params + round counter (+ scalar optimizer counters) replicated
             self.state = jax.device_put(self.state, sharded_state_map(
                 self.state, self._repl_sharding, self._data_sharding))
+        elif self.collective_precision != "fp32":
+            # replicated layout with a quantized merge: only the per-shard
+            # EF residual rows (each chip quantizes its own local numerator)
+            # break full replication
+            self.state = jax.device_put(self.state, replicated_ef_state_map(
+                self.state, self._repl_sharding, self._data_sharding))
         else:
             self.state = jax.device_put(self.state, self._repl_sharding)
         self._stager = AsyncCohortStager(
@@ -422,13 +574,27 @@ class MeshFedAvgAPI(FedAvgAPI):
             # re-init server aux state into its permanent shard-resident
             # flat layout (FedAvgAPI.__init__ built the replicated one)
             self.state = self.server_opt.init_sharded(
-                self.state.global_params, self.n_shards)
+                self.state.global_params, self.n_shards,
+                collective_precision=self.collective_precision)
         return make_mesh_round_fn(self.trainer, self.server_opt, self.mesh,
                                   gather=self._gather,
                                   sharded_data=self._sharded_data,
                                   update_sharding=self.update_sharding,
                                   state_template=self.state,
-                                  donate=self.DONATE_STATE)
+                                  donate=self.DONATE_STATE,
+                                  collective_precision=self.collective_precision,
+                                  quant_block=self.quant_block)
+
+    def _init_server_state(self, params):
+        """Replicated-layout init for the mesh: one EF residual row PER
+        SHARD (each chip quantizes its own local numerator), and no
+        master/broadcast split — the replicated merge mode has no
+        post-update all_gather, so global_params stay fp32 and only the
+        numerator all-reduce is quantized.  Scatter mode replaces this
+        state wholesale in ``_build_round_fn`` via ``init_sharded``."""
+        return self.server_opt.init(
+            params, collective_precision=self.collective_precision,
+            ef_shards=self.n_shards, quantized_broadcast=False)
 
     def _init_client_table(self):
         """Client-state table rows padded to a multiple of the shard count
@@ -453,7 +619,9 @@ class MeshFedAvgAPI(FedAvgAPI):
                                    sharded_data=self._sharded_data,
                                    update_sharding=self.update_sharding,
                                    state_template=self.state,
-                                   donate=self.DONATE_STATE)
+                                   donate=self.DONATE_STATE,
+                                   collective_precision=self.collective_precision,
+                                   quant_block=self.quant_block)
         dev_data = self._dev_data
 
         def call(state, idx, mask, w, keys, cohort, table):

@@ -29,9 +29,15 @@ import jax
 import jax.numpy as jnp
 
 from ..core import tree as tree_util
+from ..core.compression import blockscale
 from ..ml.aggregator.agg_operator import ServerOptimizer, ServerState
 from ..ml.trainer.local_trainer import ClientOut, LocalTrainer, ServerCtx
 from ..obs.carry import OPT_FLOPS, round_obs
+
+#: fold_in tag deriving the per-round stochastic-rounding key stream of the
+#: low-precision collective layer from the round key — disjoint from the
+#: per-client streams (which come from jax.random.split of the same key)
+QUANT_KEY_TAG = 0x5C41E
 
 
 def _client_body(local_train, server_opt: ServerOptimizer):
@@ -77,14 +83,72 @@ def make_run_clients(trainer: LocalTrainer, server_opt: ServerOptimizer,
 
 
 def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                  mode: str = "scan") -> Callable:
+                  mode: str = "scan", collective_precision: str = "fp32",
+                  quant_block: int = blockscale.DEFAULT_BLOCK) -> Callable:
     """Build round_fn(state, x, y, mask, weights, key, c_clients) ->
     (new_state, metrics, new_client_state).  All client-axis inputs are
     stacked; ``key`` is the single round key (split per client inside the
     jit); ``c_clients`` is None unless the algorithm keeps per-client state
-    (SCAFFOLD/FedDyn)."""
+    (SCAFFOLD/FedDyn).
+
+    ``collective_precision != "fp32"`` applies the SAME quantize →
+    accumulate-EF math the mesh engine's collective layer runs
+    (docs/COLLECTIVE_PRECISION.md) — here the "collectives" are
+    intra-process, so this is the single-shard reference the mesh parity
+    tests compare against: the merge numerator is quantized against
+    ``state.ef_num``, the server update transitions the fp32
+    ``state.master_flat``, and ``state.global_params`` becomes the
+    low-precision broadcast copy the next round's clients train from."""
     alg = server_opt.algorithm
+    precision = collective_precision
     run_clients = make_run_clients(trainer, server_opt, mode)
+
+    def quantized_update(state: ServerState, outs: ClientOut, weights, aux,
+                         qkey):
+        # stage 1 with the EF-quantized numerator: the aggregate's
+        # avg_params is rebuilt from the flat quantized contribution;
+        # auxiliary aggregates (delta_c / nova_d / grad_sum) stay fp32,
+        # exactly as on the mesh
+        agg = server_opt.compute_aggregates(state, outs.params, weights,
+                                            aux)
+        num = jax.tree_util.tree_map(
+            lambda l: jnp.tensordot(weights, l.astype(jnp.float32),
+                                    axes=1), outs.params)
+        den = jnp.sum(weights)
+        contrib = tree_util.tree_flatten_1d(num) / den
+        v = state.ef_num[0] + contrib
+        deq, err_sq = blockscale.collective_quantize(
+            v, precision, jax.random.fold_in(qkey, 0), quant_block)
+        new_ef_num = (v - deq)[None]
+        agg["avg_params"] = tree_util.tree_unflatten_1d(
+            deq, state.global_params)
+        # stage 2 transitions the fp32 MASTER (global_params is the
+        # broadcast copy the clients just trained from; deltas inside
+        # compute_aggregates reference it, matching the mesh)
+        master = tree_util.tree_unflatten_1d(state.master_flat,
+                                             state.global_params)
+        new_state = server_opt.update_from_aggregates(
+            state.replace(global_params=master), agg)
+        new_master = tree_util.tree_flatten_1d(new_state.global_params)
+        send, new_ef_bcast, berr_sq = blockscale.quantize_broadcast(
+            new_master, state.ef_bcast, precision,
+            jax.random.fold_in(qkey, 1), quant_block)
+        new_state = new_state.replace(
+            global_params=tree_util.tree_unflatten_1d(
+                send, state.global_params),
+            master_flat=new_master, ef_num=new_ef_num,
+            ef_bcast=new_ef_bcast)
+        return new_state, jnp.sqrt(err_sq + berr_sq)
+
+    # modeled interconnect payload of merge + broadcast at this precision
+    # (trace-time static; 0 would hide the fp32 baseline, so fp32 reports
+    # its own dense payload and --comms ratios stay meaningful)
+    def _bytes_model(n_flat: int) -> float:
+        return float(
+            blockscale.collective_payload_nbytes(n_flat, precision,
+                                                 quant_block)
+            + blockscale.collective_payload_nbytes(n_flat, precision,
+                                                   quant_block))
 
     def round_fn(state: ServerState, x, y, mask, weights, key,
                  c_clients=None):
@@ -100,7 +164,13 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             aux["grad_sum"] = outs.grad_sum
         if alg in ("mime", "fedsgd"):
             aux["grad_sum"] = outs.grad_sum
-        new_state = server_opt.update(state, outs.params, weights, aux)
+        if precision == "fp32":
+            new_state = server_opt.update(state, outs.params, weights, aux)
+            quant_err = jnp.zeros((), jnp.float32)
+        else:
+            qkey = jax.random.fold_in(key, QUANT_KEY_TAG)
+            new_state, quant_err = quantized_update(state, outs, weights,
+                                                    aux, qkey)
         total_steps = jnp.sum(outs.num_steps)
         metrics = {
             "train_loss": jnp.sum(outs.loss * weights) / jnp.sum(weights),
@@ -116,7 +186,10 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             real_steps=total_steps,
             real_clients=jnp.sum((weights > 0).astype(jnp.float32)),
             batch=int(x.shape[2]), feat=feat,
-            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0))
+            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0),
+            collective_bytes=_bytes_model(
+                tree_util.num_params(state.global_params)),
+            quant_error=quant_err)
         # Return ONLY the per-client state (SCAFFOLD/FedDyn) — returning the
         # full stacked ``outs.params`` would force XLA to materialize a
         # C × |model| output buffer every round for data nothing consumes.
@@ -126,12 +199,17 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
 
 
 def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                         train_x, train_y, mode: str = "vmap") -> Callable:
+                         train_x, train_y, mode: str = "vmap",
+                         collective_precision: str = "fp32",
+                         quant_block: int = blockscale.DEFAULT_BLOCK
+                         ) -> Callable:
     """Device-gather variant: the dataset lives on device once; the round
     takes only a (C, S, B) int32 index tensor from the host (KBs instead of
     the reference's per-round sample shipping).  The gather is HBM→HBM and
     fuses into the scanned step."""
-    inner = make_round_fn(trainer, server_opt, mode)
+    inner = make_round_fn(trainer, server_opt, mode,
+                          collective_precision=collective_precision,
+                          quant_block=quant_block)
 
     def round_fn(state: ServerState, idx, mask, weights, key,
                  c_clients=None):
@@ -143,7 +221,10 @@ def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
 
 
 def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                        train_x, train_y, mode: str = "vmap") -> Callable:
+                        train_x, train_y, mode: str = "vmap",
+                        collective_precision: str = "fp32",
+                        quant_block: int = blockscale.DEFAULT_BLOCK
+                        ) -> Callable:
     """Fused round-block: K federated rounds as ONE compiled program
     (``jit(lax.scan(round))`` — the DrJAX observation that rounds compose as
     pure JAX primitives, arXiv:2403.07128).
@@ -160,7 +241,9 @@ def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     carry; per-round metrics stack into ``(K,)`` outputs so the host syncs
     once per block instead of once per round.
     """
-    inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode)
+    inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode,
+                                 collective_precision=collective_precision,
+                                 quant_block=quant_block)
     has_table = server_opt.algorithm in ("scaffold", "feddyn")
 
     def block_fn(state: ServerState, idx_blk, mask_blk, w_blk, keys_blk,

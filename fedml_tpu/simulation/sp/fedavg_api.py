@@ -24,6 +24,8 @@ import numpy as np
 
 from ...core import rng as rng_util
 from ...core import tree as tree_util
+from ...core.compression.blockscale import DEFAULT_BLOCK
+from ...core.state import resolve_collective_precision
 from ...data.federated_dataset import FederatedDataset
 from ...ml.aggregator.agg_operator import ServerOptimizer
 from ...ml.trainer.local_trainer import LocalTrainer
@@ -67,6 +69,13 @@ class FedAvgAPI:
 
         self.trainer = LocalTrainer(model, args)
         self.server_opt = ServerOptimizer(args)
+        # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
+        # resolved against the engine's shard count (the mesh subclass sets
+        # n_shards before super().__init__, so "auto" sees the real mesh)
+        self.collective_precision = resolve_collective_precision(
+            args, getattr(self, "n_shards", 1))
+        self.quant_block = int(getattr(args, "quant_block", 0)
+                               or DEFAULT_BLOCK)
         # ragged-cohort bucketing (stateless wavg algorithms only)
         from ..round_engine import BUCKETABLE_ALGS
         self._bucketing = bool(getattr(args, "cohort_bucketing", False))
@@ -75,6 +84,11 @@ class FedAvgAPI:
             raise ValueError(
                 f"cohort_bucketing supports {BUCKETABLE_ALGS}, not "
                 f"{self.server_opt.algorithm!r}")
+        if self._bucketing and self.collective_precision != "fp32":
+            # bucket partials merge on host; there is no single in-program
+            # merge collective to quantize against one EF buffer
+            raise ValueError(
+                "collective_precision requires the unbucketed cohort path")
         if self._bucketing and \
                 type(self).train_one_round is not FedAvgAPI.train_one_round:
             # a subclass with its own round loop would silently ignore the
@@ -103,7 +117,7 @@ class FedAvgAPI:
         self._ct_ops = None
         key = rng_util.root_key(self.seed)
         params = model.init(rng_util.purpose_key(key, "init"))
-        self.state = self.server_opt.init(params)
+        self.state = self._init_server_state(params)
         self.round_fn = self._build_round_fn(client_mode)
         # Per-client algorithm state (SCAFFOLD control variates c_i / FedDyn
         # lagrangian residuals ∇̂_i) lives DEVICE-resident between rounds as
@@ -120,6 +134,13 @@ class FedAvgAPI:
     #: (hierarchical group loop) must turn this off.
     DONATE_STATE = True
 
+    def _init_server_state(self, params):
+        """Initial ServerState; with a quantized collective layer it also
+        carries the EF residual row, the fp32 flat master copy, and (int8)
+        the broadcast residual.  The mesh subclass overrides the layout."""
+        return self.server_opt.init(
+            params, collective_precision=self.collective_precision)
+
     def _build_round_fn(self, client_mode: str):
         donate = (0,) if self.DONATE_STATE else ()
         if self._bucketing:
@@ -133,9 +154,13 @@ class FedAvgAPI:
             from ..round_engine import make_gather_round_fn
             return jax.jit(make_gather_round_fn(
                 self.trainer, self.server_opt, self._dev_x, self._dev_y,
-                mode=client_mode), donate_argnums=donate)
-        return jax.jit(make_round_fn(self.trainer, self.server_opt,
-                                     mode=client_mode), donate_argnums=donate)
+                mode=client_mode,
+                collective_precision=self.collective_precision,
+                quant_block=self.quant_block), donate_argnums=donate)
+        return jax.jit(make_round_fn(
+            self.trainer, self.server_opt, mode=client_mode,
+            collective_precision=self.collective_precision,
+            quant_block=self.quant_block), donate_argnums=donate)
 
     # -- round pieces ------------------------------------------------------
     def _client_sampling(self, round_idx: int) -> np.ndarray:
@@ -298,7 +323,9 @@ class FedAvgAPI:
         donate = (0, 6) if self.DONATE_STATE else ()
         return jax.jit(make_block_round_fn(
             self.trainer, self.server_opt, self._dev_x, self._dev_y,
-            mode=self._client_mode), donate_argnums=donate)
+            mode=self._client_mode,
+            collective_precision=self.collective_precision,
+            quant_block=self.quant_block), donate_argnums=donate)
 
     def _stage_block(self, start_round: int):
         """Build one block's stacked cohort tensors: every per-round input

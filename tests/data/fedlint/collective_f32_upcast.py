@@ -1,0 +1,34 @@
+"""Fixture: fp32 upcasts fed straight into a collective payload.
+
+The collective-axis-check extension flags ``.astype(float32)`` inside a
+payload expression — the interconnect moves full-width bytes although the
+compute-dtype input was available (quantize it or suppress with a reason,
+docs/COLLECTIVE_PRECISION.md).  Bool-mask widenings are exempt, and the
+intentional fp32 master-copy gather documents itself with a suppression.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+CLIENT_AXIS = "client"
+
+mesh = Mesh(np.array(jax.devices()), (CLIENT_AXIS,))
+
+
+def merge(deltas, w):
+    # BUG: bf16 client deltas upcast to f32 right inside the psum payload
+    return jax.lax.psum(deltas.astype(jnp.float32) * w, CLIENT_AXIS)
+
+
+def mask_weight(w):
+    # bool mask widened for arithmetic — no narrower compute dtype exists,
+    # must NOT be flagged
+    return jax.lax.psum((w > 0).astype(jnp.float32), CLIENT_AXIS)
+
+
+def broadcast(master):
+    # intentional: the fp32 master copy crosses the wire at full width
+    # fedlint: disable-next-line=collective-axis-check -- fp32 master-copy gather is the point
+    return jax.lax.all_gather(master.astype(jnp.float32), CLIENT_AXIS,
+                              tiled=True)

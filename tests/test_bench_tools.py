@@ -9,6 +9,7 @@ exactly what round 3's retractions cost.
 import json
 import os
 import sys
+import time
 
 import pytest
 
@@ -81,6 +82,69 @@ def test_bench_round_fusion_quick(monkeypatch):
     assert out["unfused_s_per_round"] > 0
     assert out["fused_s_per_round"] > 0
     assert out["fused_speedup"] > 0
+
+
+def test_bench_comms_quick(monkeypatch):
+    """bench.py --comms smoke: the collective-precision comparison runs
+    green on the 8-virtual-device scatter mesh and reports the modeled
+    interconnect bytes each precision moves (read back from the round's
+    own ObsCarry record) — the byte ratios are cohort-size-independent,
+    so the acceptance numbers hold even in this trimmed config; the
+    s/round acceptance comes from the full-size run."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_COMMS_QUICK", "1")
+    out = bench.bench_comms()
+    assert out["quick"] is True
+    assert out["n_shards"] == 8
+    for p in ("fp32", "bf16", "int8"):
+        assert out[f"{p}_s_per_round"] > 0
+        assert out[f"{p}_bytes_per_round"] > 0
+    # modeled wire bytes: bf16 halves fp32 exactly; int8 ~3.9x (q bytes +
+    # per-256-chunk f32 scales)
+    assert out["bf16_bytes_reduction"] >= 1.9
+    assert out["int8_bytes_reduction"] >= 3.5
+    # quantization really happened (residual norm is 0 only at fp32)
+    assert out["fp32_quant_error_norm"] == 0.0
+    assert out["bf16_quant_error_norm"] > 0
+    assert out["int8_quant_error_norm"] > out["bf16_quant_error_norm"]
+
+
+def test_probe_verdict_cache_ttl_semantics(tmp_path, monkeypatch):
+    """The accelerator liveness-probe verdict is cached in a side file so a
+    wedged tunnel costs one 120s hang per boot, not one per bench/test
+    invocation (BENCH_r05): both verdicts round-trip, expire on their own
+    TTLs (hung expires sooner so a recovered tunnel is re-detected fast),
+    and garbage never counts as a verdict."""
+    from fedml_tpu import device as dev
+
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    assert dev._read_probe_verdict() is None          # no file yet
+
+    dev._write_probe_verdict("ok")
+    assert dev._read_probe_verdict() == "ok"
+    dev._write_probe_verdict("hung")
+    assert dev._read_probe_verdict() == "hung"
+
+    # expiry: age the file past the hung TTL but inside the ok TTL
+    path = dev._probe_verdict_path()
+    old = time.time() - (dev.PROBE_HUNG_TTL_S + 1)
+    os.utime(path, (old, old))
+    assert dev._read_probe_verdict() is None          # hung expired
+    dev._write_probe_verdict("ok")
+    os.utime(path, (old, old))
+    assert dev._read_probe_verdict() == "ok"          # ok still fresh
+    older = time.time() - (dev.PROBE_OK_TTL_S + 1)
+    os.utime(path, (older, older))
+    assert dev._read_probe_verdict() is None          # ok expired too
+
+    # env override shortens the ok TTL; unknown content is no verdict
+    dev._write_probe_verdict("ok")
+    monkeypatch.setenv("FEDML_TPU_PROBE_OK_TTL", "0")
+    assert dev._read_probe_verdict() is None
+    monkeypatch.delenv("FEDML_TPU_PROBE_OK_TTL")
+    with open(path, "w") as f:
+        f.write("garbage\n")
+    assert dev._read_probe_verdict() is None
 
 
 def test_controller_validates_platform_from_last_json_line(tmp_path):

@@ -99,10 +99,30 @@ def test_quantize_roundtrip_error_bound():
     for orig, rec in zip(jax.tree_util.tree_leaves(t),
                          jax.tree_util.tree_leaves(out)):
         orig, rec = np.asarray(orig), np.asarray(rec)
-        rng = orig.max() - orig.min()
-        # biased rounding error <= half a quantization step
-        assert np.max(np.abs(orig - rec)) <= rng / 255 * 0.51 + 1e-7
+        # symmetric block-scaled (shared with the collective layer,
+        # blockscale.py): round-to-nearest error <= half a step, step =
+        # per-chunk absmax / 127 <= leaf absmax / 127
+        step = np.max(np.abs(orig)) / 127
+        assert np.max(np.abs(orig - rec)) <= step * 0.51 + 1e-7
     assert payload_nbytes(payload) < 0.35 * tree_nbytes(t)
+
+
+def test_quantize_payload_counts_scale_arrays():
+    """payload_nbytes must include the per-chunk f32 scale arrays (the wire
+    really ships them); pre-fix only the int8 q bytes were counted."""
+    n = 1024
+    t = {"w": jnp.asarray(np.random.default_rng(3)
+                          .normal(size=n).astype(np.float32))}
+    comp = QuantizationCompressor(bits=8, is_biased=True, block=256)
+    payload, _ = comp.compress(t)
+    nb = payload_nbytes(payload)
+    # q: n int8 bytes; scales: ceil(n/256) f32; shape: 1 int64
+    assert nb >= n + 4 * (n // 256) + 8
+    scales = payload["tree"]["w"]["scales"]
+    assert scales.shape == (n // 256,) and scales.dtype == np.float32
+    # and the blockscale wire model agrees on the q+scales portion
+    from fedml_tpu.core.compression import collective_payload_nbytes
+    assert nb - 8 == collective_payload_nbytes(n, "int8", block=256)
 
 
 def test_qsgd_unbiased():

@@ -251,12 +251,16 @@ def test_fedtrace_summarize_golden_fixture():
 def test_fedtrace_golden_values_are_hand_checkable():
     """The fixture's numbers are chosen so the attribution is checkable
     by hand: round 0 (0.2s, weights 10/60/20/10) + round 1 (0.1s,
-    weights 10/70/10/10)."""
+    weights 10/70/10/10); collective bytes 40000 + 20000 with quant-error
+    norms 0.02 then 0.01 (docs/COLLECTIVE_PRECISION.md fields)."""
     s = fedtrace.summarize(fedtrace.load_trace(FIXTURE))
     assert s["phases"] == {"staging": 0.15, "gather": 0.03,
                            "client_steps": 0.19, "merge": 0.05,
                            "server_update": 0.03}
     assert s["compile_count"] == 1 and s["compile_s"] == 0.05
+    assert s["collective_bytes_per_round"] == 30000.0
+    assert s["collective_bytes_total"] == 60000.0
+    assert s["quant_error_norm_last"] == 0.01
 
 
 def _run_cli(*args):

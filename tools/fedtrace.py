@@ -165,6 +165,18 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
             float(recs[-1].get("update_norm", 0.0)), 6)
         out["examples_total"] = round(
             sum(float(r.get("examples", 0.0)) for r in recs), 1)
+        # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
+        # modeled interconnect payload of the merge+broadcast collectives
+        # and the quantization-residual norm the round carried on device
+        cb = [float(r["collective_bytes"]) for r in recs
+              if "collective_bytes" in r]
+        if cb:
+            out["collective_bytes_per_round"] = round(sum(cb) / len(cb), 1)
+            out["collective_bytes_total"] = round(sum(cb), 1)
+        qe = [float(r["quant_error_norm"]) for r in recs
+              if "quant_error_norm" in r]
+        if qe:
+            out["quant_error_norm_last"] = round(qe[-1], 6)
     return out
 
 
@@ -193,6 +205,12 @@ def _render_summary(s: Dict[str, Any]) -> str:
     lines = [f"rounds: {s['rounds']}   "
              f"round wall-clock: {s['round_time_total_s']:.4f}s   "
              f"compiles: {s['compile_count']} ({s['compile_s']:.2f}s)"]
+    if "collective_bytes_per_round" in s:
+        lines.append(
+            f"collective bytes/round: "
+            f"{s['collective_bytes_per_round']:.0f}   "
+            f"quant error norm (last): "
+            f"{s.get('quant_error_norm_last', 0.0):g}")
     lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")
     total = sum(s["phases"].values()) or 1.0
     for p in PHASES:

@@ -27,6 +27,13 @@ on the 8-shard scatter mesh: steady-state s/round plus the modeled
 interconnect bytes/round each precision moves through the merge+broadcast
 collectives, one json line.
 
+``python bench.py --mesh2d`` compares the 1-D ``(8, 1)`` vs 2-D ``(4, 2)``
+``client × model`` mesh layout (``args.mesh_shape``, docs/MESH_2D.md) at a
+fixed 8-chip count — s/round + per-axis modeled interconnect bytes — and
+records the LLM_SCALE row the 2-D layout unlocks: the largest model whose
+per-chip HBM estimate fits ``(4, 2)`` but exceeds one chip on the 1-D
+layout (``core/memory_estimate.py``), one json line.
+
 ``python bench.py --trace`` measures the fedtrace observability plane:
 steady-state s/round untraced vs. traced (acceptance: <5% overhead) plus the
 ``tools/fedtrace.py summarize`` per-phase round breakdown folded into the
@@ -418,6 +425,124 @@ def bench_comms(rounds: int | None = None,
             / out[f"{precision}_bytes_per_round"], 3)
         out[f"{precision}_round_slowdown"] = round(
             out[f"{precision}_s_per_round"] / out["fp32_s_per_round"], 3)
+    return out
+
+
+# -- 2-D client × model mesh benchmark (--mesh2d) ----------------------------
+def bench_mesh2d(rounds: int | None = None,
+                 clients_per_round: int | None = None) -> dict:
+    """--mesh2d: the 1-D ``(8, 1)`` vs 2-D ``(4, 2)`` layout
+    (``args.mesh_shape``, docs/MESH_2D.md) at a FIXED 8-chip count:
+    steady-state s/round plus the per-axis modeled interconnect bytes the
+    round carries in its own ObsCarry record (``collective_bytes_client``
+    vs ``collective_bytes_model``), and final-round losses so layout
+    parity is visible in the json line.
+
+    The LLM_SCALE row is the scale unlock itself: using
+    ``core.memory_estimate.estimate_mesh_state_memory`` it picks the
+    largest candidate model whose per-chip HBM estimate fits the 2-D
+    layout on a v5e chip, and records that the SAME model exceeds one
+    chip on the 1-D layout — the config the 1-D mesh cannot run at all.
+    FEDML_MESH2D_QUICK=1 shrinks the cohort for smoke tests."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.core.memory_estimate import (
+        GIB, HBM_PER_CHIP, MeshStateLayout, estimate_mesh_state_memory,
+        largest_runnable_params)
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    quick = os.environ.get("FEDML_MESH2D_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (2 if quick else ROUNDS_TIMED)
+    rtt = None
+    out = {"clients_per_round": cpr, "quick": quick,
+           "update_sharding": "scatter"}
+
+    for label, shape in (("mesh1d", "8,1"), ("mesh2d", "4,2")):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total,
+            client_num_per_round=cpr, comm_round=timed_rounds + 2,
+            epochs=1, batch_size=BATCH, learning_rate=0.03,
+            partition_method="homo", frequency_of_the_test=10 ** 9,
+            random_seed=0, federated_optimizer="FedOpt",
+            # toy-default server_lr=1.0 drives the synthetic LR task to a
+            # saturated (loss-underflow) optimum in one round; 0.03 keeps
+            # the curve informative so layout parity is visible in the row
+            server_lr=0.03,
+            update_sharding="scatter", mesh_shape=shape,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        api = MeshFedAvgAPI(args, None, dataset, model)
+        out[f"{label}_shape"] = [api.n_shards, api.n_model_shards]
+        metrics = api.train_one_round(0)  # compile
+        # per-axis modeled bytes from the round's own ObsCarry record
+        # (trace-time static, so round 0's value is steady-state)
+        obs = metrics["obs"]
+        out[f"{label}_client_bytes_per_round"] = int(
+            np.asarray(obs.collective_bytes_client))
+        out[f"{label}_model_bytes_per_round"] = int(
+            np.asarray(obs.collective_bytes_model))
+        m2 = api.train_one_round(1)
+        out[f"{label}_round1_loss"] = round(float(
+            np.asarray(m2["train_loss"])), 6)
+        _readback(api.state.global_params)
+        if rtt is None:
+            rtt = measure_rtt()
+        rounds_done = [2]
+
+        def run_n(n):
+            for _ in range(n):
+                api.train_one_round(rounds_done[0] % args.comm_round)
+                rounds_done[0] += 1
+
+        dt = _timed_chain(run_n,
+                          lambda: _readback(api.state.global_params),
+                          min_total_s=0.5 if quick else 2.0,
+                          n0=timed_rounds, rtt=rtt)
+        out[f"{label}_s_per_round"] = round(dt, 5)
+    out["mesh2d_vs_1d_round"] = round(
+        out["mesh1d_s_per_round"] / out["mesh2d_s_per_round"], 3)
+
+    # -- LLM_SCALE row: the model the 2-D layout unlocks ---------------------
+    # scan the 8-chip mesh factorizations for the largest candidate model
+    # whose per-chip estimate fits a v5e, then record that the winning
+    # config exceeds one chip on the 1-D (8, 1) layout — the model the
+    # 1-D mesh cannot run at all (ISSUE 6 acceptance; the 1.075B
+    # BASELINE flagship sits exactly in this band)
+    chip = "v5e"
+    budget = HBM_PER_CHIP[chip]
+    est_kw = dict(clients_per_round=8, algorithm="fedopt",
+                  collective_precision="int8", param_bytes=2)
+    candidates = [0.25e9, 0.5e9, 0.75e9, 1.075e9, 1.5e9, 2e9, 3e9, 6.74e9]
+    shapes = [(8, 1), (4, 2), (2, 4), (1, 8)]
+    per_shape = {s: largest_runnable_params(budget, s, candidates, **est_kw)
+                 for s in shapes}
+    best = max((s for s in shapes if s[1] > 1),
+               key=lambda s: (per_shape[s], s[0]))
+    n = per_shape[best]
+    est2 = estimate_mesh_state_memory(
+        MeshStateLayout(n_params=n, mesh_shape=best, **est_kw))
+    est1 = estimate_mesh_state_memory(
+        MeshStateLayout(n_params=n, mesh_shape=(8, 1), **est_kw))
+    out["llm_scale"] = {
+        "chip": chip, "hbm_per_chip_gib": round(budget / GIB, 2),
+        "n_params": n,
+        "mesh_shape": list(best),
+        "largest_runnable_b_by_shape": {
+            f"{c}x{m}": round(per_shape[(c, m)] / 1e9, 3)
+            for c, m in shapes},
+        "mesh1d_per_chip_gib": round(est1["total_gib"], 2),
+        "mesh1d_fits": est1["total"] <= budget,
+        "mesh2d_per_chip_gib": round(est2["total_gib"], 2),
+        "mesh2d_fits": est2["total"] <= budget,
+    }
     return out
 
 
@@ -1089,6 +1214,25 @@ def main():
             "unit": "x_bytes_reduction_int8_vs_fp32",
             "vs_baseline": result["bf16_bytes_reduction"],
             "collective_precision": ["fp32", "bf16", "int8"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--mesh2d" in sys.argv:
+        # fixed 8-chip count for the 1-D (8,1) vs 2-D (4,2) comparison;
+        # force 8 virtual host devices like --agg/--comms
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        info = _platform_info(measure_peak=False)
+        result = bench_mesh2d()
+        result.update({
+            "metric": "mesh2d_client_x_model_layout",
+            "value": result["mesh2d_s_per_round"],
+            "unit": "s/round",
+            "vs_baseline": result["mesh2d_vs_1d_round"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

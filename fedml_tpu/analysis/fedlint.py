@@ -83,7 +83,9 @@ RULES: Dict[str, Rule] = {
              "random streams"),
         Rule("collective-axis-check", ERROR,
              "psum/psum_scatter/all_gather/... axis name must match an "
-             "axis declared by a Mesh/pmap/shard_map in the package; also "
+             "axis declared by a Mesh/pmap/shard_map in the package "
+             "(multi-axis tuples like axis_name=('client','model') check "
+             "every element against 2-D mesh declarations); also "
              "flags an fp32 upcast (.astype(float32)) fed directly into a "
              "collective payload — quantize or keep the compute dtype so "
              "the interconnect doesn't move full-width bytes "
@@ -260,12 +262,13 @@ def index_module(path: str, source: str) -> Optional[ModuleIndex]:
         if not isinstance(node, ast.Call):
             continue
         fn = last_attr(node.func)
-        if fn == "Mesh":
+        if fn in ("Mesh", "make_mesh", "AbstractMesh"):
+            # positional axis names: Mesh(devices, names) and the 2-D
+            # factories jax.make_mesh(axis_shapes, axis_names) /
+            # AbstractMesh(axis_shapes, axis_names) — a ("client",
+            # "model") tuple here declares BOTH axes (docs/MESH_2D.md)
             if len(node.args) >= 2:
                 note_axes(_const_value(node.args[1], constants))
-            for kw in node.keywords:
-                if kw.arg == "axis_names":
-                    note_axes(_const_value(kw.value, constants))
         if fn in ("pmap", "shard_map", "xmap", "vmap", "make_mesh",
                   "Mesh", "AbstractMesh"):
             for kw in node.keywords:
@@ -439,6 +442,15 @@ class ModuleView:
         v = _const_value(node, self.mod.constants)
         if v is None and isinstance(node, ast.Name):
             v = self.pkg.constants.get(node.id)
+        if v is None and isinstance(node, (ast.Tuple, ast.List)):
+            # multi-axis collectives (axis_name=("client", "model"),
+            # docs/MESH_2D.md) may mix literals with constants imported
+            # from other modules — resolve element-wise with the package
+            # index as fallback; any unresolvable element keeps the whole
+            # tuple unproven (no guessing)
+            vals = [self.resolve_str(e) for e in node.elts]
+            if all(isinstance(x, str) for x in vals):
+                v = tuple(vals)
         return v
 
 

@@ -124,6 +124,9 @@ _DEFAULTS: Dict[str, Any] = dict(
     mesh_data=1,
     mesh_model=1,
     mesh_seq=1,
+    # 2-D (n_client_shards, n_model_shards) mesh (docs/MESH_2D.md): a
+    # 2-tuple/"c,m" string; wins over the per-axis mesh_* knobs when set
+    mesh_shape=None,
     # server-update layout on the mesh: replicated | scatter | auto
     # (auto = scatter whenever the client axis has > 1 shard)
     update_sharding="auto",

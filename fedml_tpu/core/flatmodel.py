@@ -1,0 +1,100 @@
+"""FlatModel — the flatten-concat-pad view of a params pytree, first-class.
+
+Three subsystems used to re-derive this layout independently: the scatter
+merge (``tree_flatten_padded`` + ``flat_chunk`` in the mesh engine), the
+quantized-collective layer (``blockscale`` operating on ad-hoc flat
+vectors), and checkpoint restore of ``ServerState.master_flat`` (a bare
+``(flat_len,)`` array whose meaning lived in comments).  ``FlatSpec``
+makes the layout one tested object: leaf order, per-leaf offsets, the pad
+multiple the shard count demands, and the flatten/unflatten/chunk
+operations — so the 2-D mesh can change the pad multiple from
+``n_client_shards`` to ``n_client_shards * n_model_shards`` in exactly one
+place (docs/MESH_2D.md).
+
+The flat layout is the SAME one ``core.tree.tree_flatten_1d`` has always
+produced (leaves in ``tree_flatten`` order, raveled, f32, zero-padded at
+the end), so specs and the legacy helpers interoperate bitwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSpec:
+    """Static description of a pytree's flat view (host-side, hashable —
+    safe to close over in jitted code; carries no arrays)."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    n_params: int          # real elements (pre-padding)
+    multiple: int          # flat length pads to a multiple of this
+    padded_size: int
+
+    @classmethod
+    def of(cls, tree: Pytree, multiple: int = 1) -> "FlatSpec":
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        shapes = tuple(tuple(l.shape) for l in leaves)
+        dtypes = tuple(l.dtype for l in leaves)
+        n = sum(int(math.prod(s)) for s in shapes)
+        multiple = max(int(multiple), 1)
+        padded = -(-n // multiple) * multiple
+        return cls(treedef=treedef, shapes=shapes, dtypes=dtypes,
+                   n_params=n, multiple=multiple, padded_size=padded)
+
+    # -- vec <-> tree ------------------------------------------------------
+    def flatten(self, tree: Pytree) -> jnp.ndarray:
+        """One padded f32 vector in tree_flatten leaf order.
+
+        Built by ``dynamic_update_slice`` into a zeros vector rather than
+        ``jnp.concatenate``: this toolchain's SPMD partitioner miscompiles
+        a jit-level concatenate over differently-sharded operands whenever
+        a manual-subgroup (partial-auto shard_map) consumer is present in
+        the program — values come out scaled by a mesh-axis size.  DUS
+        partitions correctly under the same conditions (docs/MESH_2D.md,
+        Known limits)."""
+        vec = jnp.zeros((self.padded_size,), jnp.float32)
+        off = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            r = jnp.ravel(leaf).astype(jnp.float32)
+            vec = jax.lax.dynamic_update_slice(vec, r, (off,))
+            off += r.shape[0]
+        return vec
+
+    def unflatten(self, vec: jnp.ndarray) -> Pytree:
+        """Inverse of :meth:`flatten`; padding is dropped, leaves restore
+        their original shapes/dtypes."""
+        out, off = [], 0
+        for shape, dtype in zip(self.shapes, self.dtypes):
+            n = int(math.prod(shape))
+            out.append(jnp.reshape(vec[off:off + n], shape).astype(dtype))
+            off += n
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    # -- shard chunks ------------------------------------------------------
+    @property
+    def chunk_size(self) -> int:
+        return self.padded_size // self.multiple
+
+    def chunk(self, vec: jnp.ndarray, index, n_chunks: int) -> jnp.ndarray:
+        """Chunk ``index`` of ``vec`` split into ``n_chunks`` equal blocks
+        (``index`` may be traced)."""
+        size = vec.shape[0] // n_chunks
+        return jax.lax.dynamic_slice(vec, (index * size,), (size,))
+
+    def zeros(self) -> jnp.ndarray:
+        return jnp.zeros((self.padded_size,), jnp.float32)
+
+
+def flat_spec(tree: Pytree, multiple: int = 1) -> FlatSpec:
+    """Convenience constructor mirroring ``FlatSpec.of``."""
+    return FlatSpec.of(tree, multiple)

@@ -121,3 +121,99 @@ def northstar_llama2_7b_512clients(n_chips: int = 256,
         n_params=6.74e9, n_lora_params=lora_per_client, n_clients=512,
         n_chips=n_chips, model_shards=model_shards, batch_per_client=1,
         seq_len=2048, dim=4096, n_layers=32))
+
+
+# -- mesh-engine state estimate (2-D client × model layout) ------------------
+
+#: flat f32 aux vectors ``ServerOptimizer.init_sharded`` allocates per
+#: algorithm (docs/UPDATE_SHARDING.md): FedOpt's Adam m+v, SCAFFOLD's
+#: c_server, FedDyn's h, Mime's momentum
+OPT_FLAT_SLOTS = {
+    "fedavg": 0, "fedsgd": 0, "fedopt": 2, "scaffold": 1, "feddyn": 1,
+    "fednova": 0, "mime": 1,
+}
+
+
+@dataclasses.dataclass
+class MeshStateLayout:
+    """What ``MeshFedAvgAPI`` keeps resident per chip for one model
+    (docs/MESH_2D.md): the broadcast params copy, the shard-resident flat
+    server state, the quantized-collective buffers, and the vmapped
+    cohort's per-client params copies.  ``mesh_shape`` is
+    ``(n_client_shards, n_model_shards)`` — ``args.mesh_shape``."""
+    n_params: float
+    mesh_shape: tuple = (8, 1)
+    clients_per_round: int = 8
+    algorithm: str = "fedavg"
+    collective_precision: str = "fp32"
+    param_bytes: int = 4         # f32 params (the LR/MLP zoo); LLMs pass 2
+    safety: float = 1.25
+
+    @property
+    def n_client_shards(self) -> int:
+        return int(self.mesh_shape[0])
+
+    @property
+    def n_model_shards(self) -> int:
+        return int(self.mesh_shape[1])
+
+
+def estimate_mesh_state_memory(lo: MeshStateLayout) -> Dict[str, float]:
+    """Per-chip HBM of the mesh engine's persistent + round-resident state.
+
+    The 2-D unlock this prices (docs/MESH_2D.md): everything that scales
+    with the model divides by ``n_model_shards`` — params/cohort copies
+    because matrices shard per ``MeshLayout.param_spec``, the flat server
+    state (opt moments, fp32 master, broadcast EF) because flat vectors
+    chunk over BOTH axes (each chip owns ``1/(c*m)``), and the per-shard
+    EF rows because their columns shard over ``model``.  On the 1-D layout
+    (``m == 1``) params replicate and one client's model must fit in one
+    chip's HBM — the ceiling this estimator makes visible."""
+    c, m = lo.n_client_shards, lo.n_model_shards
+    flat = -(-int(lo.n_params) // (c * m)) * (c * m)   # padded flat length
+    quantized = lo.collective_precision != "fp32"
+    # broadcast params copy the clients train from: replicated on 1-D,
+    # matrix leaves sharded over ``model`` on 2-D
+    params = lo.n_params * lo.param_bytes / m
+    # scatter-mode flat aux state, f32, each chip owns 1/(c*m)
+    n_flat_slots = OPT_FLAT_SLOTS.get(lo.algorithm.lower(), 2)
+    if quantized:
+        n_flat_slots += 2            # master_flat + ef_bcast
+    opt_state = n_flat_slots * 4.0 * flat / (c * m)
+    # per-shard EF rows: one (flat,) row per client shard, columns over m
+    ef_rows = (4.0 * flat / m) if quantized else 0.0
+    # vmapped cohort: each client shard trains its cohort slice, and every
+    # live client's params/update copy (outs.params) shards over ``model``
+    clients_per_shard = -(-lo.clients_per_round // c)
+    cohort = clients_per_shard * lo.n_params * 4.0 / m
+    # merge scratch: the flat numerator + one reduce-scattered chunk
+    scratch = 4.0 * flat / m + 4.0 * flat / (c * m)
+    total = (params + opt_state + ef_rows + cohort + scratch) * lo.safety
+    return {
+        "params_bcast": params,
+        "opt_state_flat": opt_state,
+        "ef_rows": ef_rows,
+        "cohort_params": cohort,
+        "merge_scratch": scratch,
+        "total": total,
+        "total_gib": total / GIB,
+    }
+
+
+def mesh_state_fits(lo: MeshStateLayout, hbm_bytes: float) -> bool:
+    """Whether the estimate fits a per-chip HBM budget (bytes)."""
+    return estimate_mesh_state_memory(lo)["total"] <= hbm_bytes
+
+
+def largest_runnable_params(hbm_bytes: float, mesh_shape: tuple,
+                            candidates, **layout_kw) -> float:
+    """Largest ``n_params`` among ``candidates`` whose per-chip estimate
+    fits ``hbm_bytes`` on ``mesh_shape`` — how ``bench.py --mesh2d`` picks
+    its LLM_SCALE row (0.0 when nothing fits)."""
+    best = 0.0
+    for n in sorted(float(n) for n in candidates):
+        if mesh_state_fits(MeshStateLayout(n_params=n,
+                                           mesh_shape=tuple(mesh_shape),
+                                           **layout_kw), hbm_bytes):
+            best = n
+    return best

@@ -62,6 +62,37 @@ def make_mesh(
     return Mesh(arr, ALL_AXES)
 
 
+def parse_mesh_shape(value) -> Optional[tuple]:
+    """Normalize ``args.mesh_shape`` to ``(n_client_shards,
+    n_model_shards)`` or None.  Accepts a 2-tuple/list, or a string like
+    ``"4,2"`` / ``"4x2"``; ``-1`` in the client slot absorbs the remaining
+    devices (``make_mesh`` semantics)."""
+    if value in (None, "", "none", "auto"):
+        return None
+    if isinstance(value, str):
+        parts = value.replace("x", ",").split(",")
+        value = [int(p) for p in parts if p.strip()]
+    shape = tuple(int(v) for v in value)
+    if len(shape) != 2:
+        raise ValueError(
+            f"mesh_shape must be (n_client_shards, n_model_shards), "
+            f"got {shape!r}")
+    if shape[1] < 1:
+        raise ValueError(f"n_model_shards must be >= 1, got {shape[1]}")
+    return shape
+
+
+def make_mesh2d(mesh_shape, devices: Optional[Sequence[jax.Device]] = None
+                ) -> Mesh:
+    """2-D ``(client, model)`` mesh factory (docs/MESH_2D.md): clients
+    sharded along ``client``, each client's model spanning the
+    ``n_model_shards`` chips of its ``model`` group.  Returns the
+    canonical 4-axis mesh with data/seq pinned to 1, so every existing
+    ``P(CLIENT_AXIS)`` spec keeps working."""
+    c, m = parse_mesh_shape(mesh_shape)
+    return make_mesh(client=c, model=m, devices=devices)
+
+
 def single_device_mesh() -> Mesh:
     return make_mesh(client=1)
 

@@ -118,14 +118,10 @@ def tree_flatten_1d(tree: Pytree) -> jnp.ndarray:
 
 
 def tree_unflatten_1d(vec: jnp.ndarray, like: Pytree) -> Pytree:
-    """Reshape a flat vector back into the structure/shapes/dtypes of `like`."""
-    leaves, treedef = jax.tree_util.tree_flatten(like)
-    out, off = [], 0
-    for leaf in leaves:
-        n = leaf.size
-        out.append(jnp.reshape(vec[off : off + n], leaf.shape).astype(leaf.dtype))
-        off += n
-    return jax.tree_util.tree_unflatten(treedef, out)
+    """Reshape a flat vector back into the structure/shapes/dtypes of `like`
+    (first-class form: ``core.flatmodel.FlatSpec.unflatten``)."""
+    from .flatmodel import FlatSpec
+    return FlatSpec.of(like).unflatten(vec)
 
 
 def num_params(tree: Pytree) -> int:
@@ -135,19 +131,17 @@ def num_params(tree: Pytree) -> int:
 def padded_flat_size(tree: Pytree, multiple: int) -> int:
     """Length of ``tree_flatten_padded(tree, multiple)`` — the flat model
     vector zero-padded so it chunks evenly into ``multiple`` shards."""
-    n = num_params(tree)
-    return -(-n // multiple) * multiple
+    from .flatmodel import FlatSpec
+    return FlatSpec.of(tree, multiple).padded_size
 
 
 def tree_flatten_padded(tree: Pytree, multiple: int) -> jnp.ndarray:
     """Flatten a pytree into one f32 vector zero-padded to a multiple of
     ``multiple`` — the scatter-mode server update's working layout: each of
-    ``multiple`` mesh shards owns one contiguous ``1/multiple`` chunk."""
-    vec = tree_flatten_1d(tree)
-    pad = padded_flat_size(tree, multiple) - vec.shape[0]
-    if pad:
-        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
-    return vec
+    ``multiple`` mesh shards owns one contiguous ``1/multiple`` chunk.
+    (First-class form: ``core.flatmodel.FlatSpec.flatten``.)"""
+    from .flatmodel import FlatSpec
+    return FlatSpec.of(tree, multiple).flatten(tree)
 
 
 def flat_chunk(vec: jnp.ndarray, index, n_chunks: int) -> jnp.ndarray:

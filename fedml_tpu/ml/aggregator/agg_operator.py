@@ -81,10 +81,15 @@ def sharded_state_map(state: ServerState, repl, shard) -> ServerState:
     state with ``shard`` (flat shard-resident aux vectors) or ``repl``
     (round counter, replicated global params, scalar optimizer counters like
     Adam's step count).  Used twice with different leaf types: shard_map
-    in/out PartitionSpecs and ``jax.device_put`` NamedShardings."""
+    in/out PartitionSpecs and ``jax.device_put`` NamedShardings.  ``shard``
+    may be a callable of the leaf (the 2-D mesh layout places 1-D flat
+    vectors and 2-D EF rows differently — simulation/mesh/layout.py)."""
+    pick = shard if callable(shard) else (lambda _x: shard)
+
     def mark(sub, sharded):
         return jax.tree_util.tree_map(
-            lambda x: shard if (sharded and jnp.ndim(x) >= 1) else repl, sub)
+            lambda x: pick(x) if (sharded and jnp.ndim(x) >= 1) else repl,
+            sub)
     return ServerState(
         round_idx=repl,
         global_params=mark(state.global_params, False),
@@ -160,13 +165,20 @@ class ServerOptimizer:
         return st
 
     def init_sharded(self, params, n_shards: int,
-                     collective_precision: str = "fp32") -> ServerState:
+                     collective_precision: str = "fp32",
+                     flat_multiple: int = None) -> ServerState:
         """Scatter-mode init (arXiv:2004.13336 layout): every aux field is a
         flat f32 vector over the padded flattened model — ONE logical array
         the caller device_puts with ``P(client)`` so each chip owns a
         contiguous ``1/n_shards`` chunk.  ``global_params`` stays the
-        replicated pytree the per-client bodies broadcast from."""
-        flat = tree_util.tree_flatten_padded(params, n_shards)
+        replicated pytree the per-client bodies broadcast from.
+
+        ``flat_multiple`` (default ``n_shards``) sets the flat pad multiple;
+        the 2-D mesh passes ``n_client_shards * n_model_shards`` so each
+        client-axis chunk subdivides evenly over the ``model`` axis
+        (core/flatmodel.py, docs/MESH_2D.md)."""
+        flat = tree_util.tree_flatten_padded(params,
+                                             flat_multiple or n_shards)
         st = ServerState(round_idx=jnp.zeros((), jnp.int32),
                          global_params=params)
         if self.server_tx is not None:

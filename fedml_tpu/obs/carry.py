@@ -60,6 +60,12 @@ class ObsCarry:
     # and the L2 norm of this round's quantization residual (0 at fp32)
     collective_bytes: jnp.ndarray
     quant_error_norm: jnp.ndarray
+    # per-mesh-axis split of collective_bytes (docs/MESH_2D.md): merge +
+    # broadcast payload crossing the ``client`` axis vs. the model-parallel
+    # traffic crossing the ``model`` axis (0 on 1-D layouts; the two sum
+    # to collective_bytes)
+    collective_bytes_client: jnp.ndarray
+    collective_bytes_model: jnp.ndarray
 
 
 def param_count(tree: Any) -> int:
@@ -71,6 +77,8 @@ def param_count(tree: Any) -> int:
 def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
               batch: int, feat: int, opt_flops_per_param: float,
               collective_bytes: float = 0.0,
+              collective_bytes_client: float = None,
+              collective_bytes_model: float = 0.0,
               quant_error=None) -> ObsCarry:
     """Build the ObsCarry INSIDE the compiled round.
 
@@ -95,26 +103,36 @@ def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
         (2.0 * p) * clients,                        # merge: weighted sums
         jnp.asarray(float(opt_flops_per_param) * p, f32),  # server update
     ])
+    if collective_bytes_client is None:
+        # single-axis engines (sp, 1-D mesh): all modeled bytes cross the
+        # client axis
+        collective_bytes_client = collective_bytes
     return ObsCarry(steps=steps, clients=clients, examples=examples,
                     update_norm=update_norm, phase_flops=phase_flops,
                     collective_bytes=jnp.asarray(float(collective_bytes),
                                                  f32),
                     quant_error_norm=(jnp.zeros((), f32) if quant_error
                                       is None
-                                      else jnp.asarray(quant_error, f32)))
+                                      else jnp.asarray(quant_error, f32)),
+                    collective_bytes_client=jnp.asarray(
+                        float(collective_bytes_client), f32),
+                    collective_bytes_model=jnp.asarray(
+                        float(collective_bytes_model), f32))
 
 
 # -- host-side materialization (called ONLY at the driver's existing
 #    log-round sync points; the values are already computed on device) ------
 
-def _row(steps, clients, examples, norm, pf, cbytes, qerr
-         ) -> Dict[str, float]:
+def _row(steps, clients, examples, norm, pf, cbytes, qerr, cb_client,
+         cb_model) -> Dict[str, float]:
     out = {"steps": float(steps), "clients": float(clients),
            "examples": float(examples), "update_norm": float(norm)}
     for i, phase in enumerate(DEVICE_PHASES):
         out[f"flops_{phase}"] = float(pf[i])
     out["collective_bytes"] = float(cbytes)
     out["quant_error_norm"] = float(qerr)
+    out["collective_bytes_client"] = float(cb_client)
+    out["collective_bytes_model"] = float(cb_model)
     return out
 
 
@@ -124,7 +142,9 @@ def obs_host(carry: ObsCarry) -> Dict[str, float]:
                 np.asarray(carry.examples), np.asarray(carry.update_norm),
                 np.asarray(carry.phase_flops),
                 np.asarray(carry.collective_bytes),
-                np.asarray(carry.quant_error_norm))
+                np.asarray(carry.quant_error_norm),
+                np.asarray(carry.collective_bytes_client),
+                np.asarray(carry.collective_bytes_model))
 
 
 def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
@@ -137,8 +157,10 @@ def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
     pf = np.asarray(carry.phase_flops)
     cb = np.asarray(carry.collective_bytes)
     qe = np.asarray(carry.quant_error_norm)
+    cbc = np.asarray(carry.collective_bytes_client)
+    cbm = np.asarray(carry.collective_bytes_model)
     if steps.ndim == 0:
-        return [_row(steps, clients, examples, norm, pf, cb, qe)]
+        return [_row(steps, clients, examples, norm, pf, cb, qe, cbc, cbm)]
     return [_row(steps[j], clients[j], examples[j], norm[j], pf[j],
-                 cb[j], qe[j])
+                 cb[j], qe[j], cbc[j], cbm[j])
             for j in range(steps.shape[0])]

@@ -1,0 +1,108 @@
+"""Mesh collectives — the quantized hot-path reductions of the federated
+round, split out of ``mesh_simulator.py`` (ISSUE 6; see docs/MESH_2D.md,
+docs/COLLECTIVE_PRECISION.md and MIGRATION.md).
+
+Everything here runs INSIDE the compiled round: the weighted-average
+``psum`` merge, the EF-quantized ``psum_scatter`` of the FedAvg numerator,
+the quantized params broadcast, and the modeled interconnect byte
+accounting ``ObsCarry`` carries per axis (``client`` vs ``model``).
+
+On the 2-D layout the bodies run under a partial-``auto`` ``shard_map``
+(manual over ``client``, GSPMD over ``model``), where two historical
+idioms are unavailable — ``jax.lax.axis_index`` (XLA's PartitionId is
+ambiguous under SPMD auto partitioning) and in-body ``all_gather`` with a
+replicated out-spec (spmd_partitioner manual-subgroup check).  Both are
+replaced here by bitwise-equal formulations that work on BOTH layouts:
+per-shard keys are precomputed outside the body and sliced in by the
+``P(client)`` in-spec, and the post-update params gather happens by
+returning the shard chunk through a ``P(client)`` out-spec.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.compression import blockscale
+
+#: re-export so engine/callers keep one import site for the quantizer knobs
+DEFAULT_BLOCK = blockscale.DEFAULT_BLOCK
+
+
+def psum_wavg(stacked, w, axis_name):
+    """Globally-correct weighted average of a client-axis-sharded stack:
+    local partial numerator/denominator, then one psum each over ICI."""
+    num = jax.tree_util.tree_map(
+        # intentional fp32 master-copy merge: collective_precision=fp32
+        # requests full-width wire bytes and the weighted sum must
+        # accumulate at f32; the quantized path bypasses this helper
+        # entirely (docs/COLLECTIVE_PRECISION.md)
+        # fedlint: disable-next-line=collective-axis-check -- see above
+        lambda l: jax.lax.psum(jnp.tensordot(w, l.astype(jnp.float32), axes=1),
+                               axis_name), stacked)
+    den = jax.lax.psum(jnp.sum(w), axis_name)
+    return jax.tree_util.tree_map(lambda x: (x / den).astype(x.dtype), num)
+
+
+def wire_cast(v, precision: str):
+    """Payload dtype of a quantized collective: bf16 values really move
+    (and accumulate) at bf16; int8 payloads dequantize BEFORE the
+    collective (the modeled wire format is (int8 q, f32 scales) moved
+    by an all-to-all and summed after dequant — XLA has no mixed
+    int8×scale reduction), so the in-program reduction runs f32."""
+    return v.astype(jnp.bfloat16) if precision == "bf16" else v
+
+
+def shard_qkeys(qkey, n_shards: int):
+    """Per-client-shard stochastic-rounding base keys, computed OUTSIDE the
+    shard_map body (2-D layouts cannot call ``axis_index`` inside — module
+    docstring): row ``i`` is ``fold_in(qkey, i)``, bitwise what the
+    historical in-body ``fold_in(qkey, axis_index(client))`` produced.
+    Sliced per shard by the ``P(client)`` in-spec."""
+    return jax.vmap(lambda i: jax.random.fold_in(qkey, i))(
+        jnp.arange(n_shards, dtype=jnp.uint32))
+
+
+def slot_key(qrow, slot: int):
+    """Per-payload key within a round: decorrelates the merge (slot 0) and
+    broadcast (slot 1) quantizations of one shard."""
+    return jax.random.fold_in(qrow, slot)
+
+
+def quantize_ef(v, precision: str, key, quant_block: int):
+    """Block-scale/stochastically-round ``v`` (which already includes this
+    shard's error-feedback residual); returns ``(deq, err_sq)``."""
+    return blockscale.collective_quantize(v, precision, key, quant_block)
+
+
+def quantize_broadcast(new_gshard, ef_bcast, precision: str, key,
+                       quant_block: int):
+    """Quantize the post-update params chunk for the broadcast gather."""
+    return blockscale.quantize_broadcast(new_gshard, ef_bcast, precision,
+                                         key, quant_block)
+
+
+# -- modeled interconnect bytes (ObsCarry / fedtrace / bench --comms) --------
+
+def client_axis_bytes(n_flat: int, n_client_shards: int, precision: str,
+                      quant_block: int, mode: str) -> float:
+    """Payload bytes/round of the ``client``-axis merge (+ scatter-mode
+    broadcast) collectives at this precision — the historical
+    ``collective_bytes`` model (docs/COLLECTIVE_PRECISION.md)."""
+    return float(blockscale.modeled_collective_bytes(
+        n_flat, n_client_shards, precision, quant_block, mode))
+
+
+def model_axis_bytes(n_flat: int, n_model_shards: int,
+                     param_bytes: int = 4) -> float:
+    """Payload bytes/round crossing the ``model`` axis on the 2-D layout:
+    the post-update params assembly each model rank is missing
+    ``(m-1)/m`` of (the all-gather GSPMD inserts rebuilding the full
+    broadcast copy from model-sharded chunks).  A modeled lower bound —
+    per-op activation reductions inside the model-parallel train step are
+    workload-dependent and not priced here (docs/MESH_2D.md).  Zero on
+    the 1-D layout."""
+    if n_model_shards <= 1:
+        return 0.0
+    return float(n_flat) * (n_model_shards - 1) / n_model_shards \
+        * float(param_bytes)

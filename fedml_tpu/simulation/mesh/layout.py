@@ -1,0 +1,211 @@
+"""Mesh layout rules — who owns which bytes on the ``(client, model)`` mesh.
+
+Split out of the 720-line ``mesh_simulator.py`` (ISSUE 6 enabling refactor;
+see docs/MESH_2D.md and MIGRATION.md).  Everything here is *static* layout
+policy: axis names, per-parameter PartitionSpecs, the ServerState sharding
+maps, and the flat-model pad multiple.  The collectives live in
+``collectives.py``; the round/block programs in ``engine.py``.
+
+Two layouts share one code path:
+
+- 1-D (``n_model_shards == 1``): the engine's historical layout — clients
+  sharded over ``client``, params replicated, flat aux state chunked over
+  ``client``.  ``shard_map`` runs fully manual.
+- 2-D (``n_model_shards > 1``): the GSPMD ``("batch", "model")`` pattern of
+  arXiv:2204.06514 on top of the arXiv:2004.13336 scatter merge — client
+  train steps run model-parallel (params sharded per :meth:`param_spec`,
+  XLA partitioning the matmuls over ``model``), the FedAvg numerator keeps
+  its ``psum_scatter`` along ``client``, and flat server state (opt
+  moments, EF rows, fp32 master) shards along BOTH axes so each chip owns
+  ``1/(c*m)`` of it.  ``shard_map`` runs manual over ``client`` and *auto*
+  over ``model``: collectives along ``client`` stay explicit while GSPMD
+  propagates the ``model`` factor through the per-client bodies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.flatmodel import FlatSpec
+from ...core.mesh import CLIENT_AXIS, MODEL_AXIS, make_mesh
+from ...ml.aggregator.agg_operator import (ServerState,
+                                           replicated_ef_state_map,
+                                           sharded_state_map)
+
+
+class MeshLayout:
+    """Static sharding policy for one mesh.
+
+    ``flat_multiple`` is ``n_client_shards * n_model_shards``: the flat
+    model vector pads so the per-client-shard chunk (``psum_scatter``
+    granularity) still divides evenly into ``model``-axis subchunks.  With
+    ``m == 1`` this is exactly the historical pad-to-``n_shards``.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.n_client_shards = int(mesh.shape[CLIENT_AXIS])
+        self.n_model_shards = int(mesh.shape.get(MODEL_AXIS, 1))
+        self.two_d = self.n_model_shards > 1
+        #: shard_map axes GSPMD partitions automatically (docs/MESH_2D.md);
+        #: empty on the 1-D layout so the historical fully-manual program
+        #: is byte-identical
+        self.auto_axes = (frozenset({MODEL_AXIS}) if self.two_d
+                          else frozenset())
+        self.flat_multiple = self.n_client_shards * self.n_model_shards
+        # -- shard_map PartitionSpecs (manual axes only) -------------------
+        self.client_spec = P(CLIENT_AXIS)
+        self.repl_spec = P()
+        # -- device_put placements (full sharding incl. the model axis) ---
+        self.repl_sharding = NamedSharding(mesh, P())
+        self.client_sharding = NamedSharding(mesh, P(CLIENT_AXIS))
+        #: flat server-state vectors: one contiguous chunk per chip across
+        #: BOTH axes — per-chip HBM = padded_flat / (c*m)
+        self.flat_sharding = NamedSharding(mesh, P((CLIENT_AXIS, MODEL_AXIS))
+                                           if self.two_d else P(CLIENT_AXIS))
+        #: per-shard EF residual rows (n_client_shards, flat_len): rows over
+        #: ``client``, columns over ``model``
+        self.ef_rows_sharding = NamedSharding(
+            mesh, P(CLIENT_AXIS, MODEL_AXIS) if self.two_d
+            else P(CLIENT_AXIS))
+
+    @classmethod
+    def from_args(cls, args, mesh: Optional[Mesh] = None) -> "MeshLayout":
+        """Build the mesh from ``args.mesh_shape`` (2-D ``(client, model)``
+        form, which wins when set) or the per-axis ``mesh_*`` knobs."""
+        if mesh is None:
+            from ...core.mesh import parse_mesh_shape
+            shape = parse_mesh_shape(getattr(args, "mesh_shape", None))
+            if shape is not None:
+                mesh = make_mesh(client=shape[0], model=shape[1])
+            else:
+                mesh = make_mesh(
+                    client=int(getattr(args, "mesh_client", -1)),
+                    data=int(getattr(args, "mesh_data", 1)),
+                    model=int(getattr(args, "mesh_model", 1)),
+                    seq=int(getattr(args, "mesh_seq", 1)))
+        return cls(mesh)
+
+    # -- per-parameter partition rules ------------------------------------
+    def param_spec(self, leaf) -> P:
+        """Model-axis PartitionSpec of one parameter leaf: matrices
+        (ndim >= 2 — LoRA A/B, attention q/k/v/o, MLP gate/up/down,
+        embeddings) shard their largest ``model``-divisible dim; vectors
+        and scalars (biases, norm scales) replicate."""
+        if not self.two_d:
+            return P()
+        shape = tuple(np.shape(leaf) if not hasattr(leaf, "shape")
+                      else leaf.shape)
+        if len(shape) < 2:
+            return P()
+        dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for d in dims:
+            if shape[d] % self.n_model_shards == 0 and shape[d] >= \
+                    self.n_model_shards:
+                spec = [None] * len(shape)
+                spec[d] = MODEL_AXIS
+                return P(*spec)
+        return P()
+
+    def params_pspec(self, params: Any) -> Any:
+        return jax.tree_util.tree_map(self.param_spec, params)
+
+    def params_sharding(self, params: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda l: NamedSharding(self.mesh, self.param_spec(l)), params)
+
+    def constrain_params(self, params: Any) -> Any:
+        """Pin a params pytree onto its resting layout — replicated on 1-D
+        (the historical broadcast copy), the model-axis rules on 2-D.
+        Keeps the round's output layout stable across rounds so donation
+        reuses buffers and steady-state rounds never recompile."""
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.lax.with_sharding_constraint(l, s),
+            params, self.params_sharding(params))
+
+    # -- per-client state table (SCAFFOLD c_i / FedDyn residuals) ----------
+    def table_spec(self, leaf) -> P:
+        """Rows over ``client``; each row (param-shaped) follows the
+        model-axis rule shifted past the leading row dim."""
+        row = jax.ShapeDtypeStruct(tuple(leaf.shape)[1:], leaf.dtype)
+        return P(CLIENT_AXIS, *self.param_spec(row))
+
+    def table_sharding(self, table: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda l: NamedSharding(self.mesh, self.table_spec(l)), table)
+
+    def constrain_table(self, table: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.lax.with_sharding_constraint(l, s),
+            table, self.table_sharding(table))
+
+    # -- ServerState maps ---------------------------------------------------
+    def state_partition_specs(self, state: ServerState, scatter: bool,
+                              quantized: bool) -> ServerState:
+        """shard_map in/out specs for the ServerState pytree — manual axes
+        only; the ``model`` factor of every leaf rides the auto axis."""
+        if scatter:
+            return sharded_state_map(state, self.repl_spec, self.client_spec)
+        if quantized:
+            return replicated_ef_state_map(state, self.repl_spec,
+                                           self.client_spec)
+        return self.repl_spec
+
+    def state_sharding(self, state: ServerState, scatter: bool,
+                       quantized: bool) -> Any:
+        """``jax.device_put`` placement of the persistent ServerState:
+        like :meth:`state_partition_specs` but with the model axis made
+        explicit — flat aux vectors over BOTH axes, ``global_params`` per
+        the :meth:`param_spec` rules."""
+        def shard_leaf(x):
+            # flat (L,) vectors chunk over both axes; the (n_shards, L) EF
+            # rows keep rows on ``client`` and columns on ``model``
+            if np.ndim(x) >= 2:
+                return self.ef_rows_sharding
+            return self.flat_sharding
+
+        if scatter:
+            marked = sharded_state_map(state, self.repl_sharding, shard_leaf)
+        elif quantized:
+            marked = replicated_ef_state_map(state, self.repl_sharding,
+                                             self.ef_rows_sharding)
+        else:
+            marked = jax.tree_util.tree_map(lambda _: self.repl_sharding,
+                                            state)
+        if self.two_d and state.global_params is not None:
+            marked = marked.replace(
+                global_params=self.params_sharding(state.global_params))
+        return marked
+
+    def constrain_state(self, state: ServerState, scatter: bool,
+                        quantized: bool) -> ServerState:
+        """Pin the post-merge ServerState back onto its resting placement
+        (:meth:`state_sharding`).  The merge shard_map's out-specs only fix
+        the manual ``client`` factor; along the auto ``model`` axis GSPMD
+        would otherwise replicate the flat aux state on round exit,
+        silently forfeiting the 1/(c*m) per-chip ownership.  Identity on
+        the 1-D layout (the historical program is already resting)."""
+        if not self.two_d:
+            return state
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.lax.with_sharding_constraint(l, s),
+            state, self.state_sharding(state, scatter, quantized))
+
+    def replicate_leaves(self, tree: Any) -> Any:
+        """Pin every leaf replicated.  Needed before a jit-level
+        ``FlatSpec.flatten`` of model-sharded params: this toolchain's
+        SPMD partitioner miscompiles ``concatenate`` over mixed-sharded
+        operands (values scale by an axis size), so the leaves must agree
+        on a sharding before they concat (docs/MESH_2D.md, Known limits)."""
+        return jax.tree_util.tree_map(
+            lambda l: jax.lax.with_sharding_constraint(l,
+                                                       self.repl_sharding),
+            tree)
+
+    # -- flat-model view ----------------------------------------------------
+    def flat_spec_of(self, params: Any) -> FlatSpec:
+        return FlatSpec.of(params, self.flat_multiple)

@@ -1,720 +1,17 @@
-"""Mesh-sharded federated simulation — the north-star engine.
+"""Compatibility shim — the mesh engine now lives in three modules
+(ISSUE 6 enabling refactor; see MIGRATION.md and docs/MESH_2D.md):
 
-Replaces the reference's two distributed simulators with one TPU-native one:
+- ``layout.py``      — axis/sharding rules (``MeshLayout``: per-param
+  PartitionSpecs, ServerState placement, the flat-model pad multiple)
+- ``collectives.py`` — quantized psum_scatter/gather merge + EF algebra
+  and the per-axis interconnect byte models
+- ``engine.py``      — the round/block programs and ``MeshFedAvgAPI``
 
-- ``simulation/mpi`` (rank-per-client FSMs exchanging pickled state_dicts,
-  reference ``simulation/mpi/fedavg/FedAvgAPI.py:13``) and
-- ``simulation/nccl`` (per-GPU ``BaseLocalAggregator`` hosting many simulated
-  clients, merged with pre-scaled ``dist.reduce(SUM)``,
-  ``simulation/nccl/base_framework/common.py:196-228``)
-
-become: clients sharded over the ``client`` axis of a ``jax.sharding.Mesh``;
-each device runs its cohort shard through the SAME compiled per-client body
-the SP engine uses (``vmap`` across its local clients, ``lax.scan`` within
-each client's batches).  The whole round — local SGD for all clients on all
-chips + global merge + server optimizer step — is ONE ``jit(shard_map(...))``
-dispatch.
-
-The FedAvg merge + server update runs in one of two layouts
-(``args.update_sharding``):
-
-- ``replicated`` — the weighted numerator is ``psum``-all-reduced per leaf
-  and every chip runs the full-model server update redundantly (the original
-  engine).
-- ``scatter`` (default on multi-shard meshes) — the cross-replica layout of
-  "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
-  Training" (arXiv:2004.13336): the client-weighted partial sums are
-  flattened into one padded vector and ``psum_scatter``-ed so each chip
-  receives only its contiguous ``1/n_shards`` chunk; the server optimizer
-  (``ServerOptimizer.update_shard``) then transitions ONLY that chunk —
-  FedOpt moments, SCAFFOLD ``c_server``, FedDyn ``h`` and Mime momentum are
-  permanently shard-resident (``ServerOptimizer.init_sharded``) — and a
-  single ``all_gather`` rebuilds just the new ``global_params`` for the next
-  round's client broadcast.  Per round that is reduce-scatter + all-gather
-  bytes (≈ all-reduce) but ``1/n_shards`` of the server-update FLOPs/HBM
-  per chip, and the optimizer state never crosses the interconnect at all.
-  See ``docs/UPDATE_SHARDING.md`` for the accounting.
-
-The reference's ``SeqTrainScheduler`` (exhaustive-search client→worker
-assignment, ``core/schedule/seq_train_scheduler.py:9``) is unnecessary here:
-cohort packing pads ragged clients into a dense tensor and masks, so every
-chip executes the identical program — the load-balancing problem dissolves
-into SPMD.  For strongly non-uniform cohorts the scheduler in
-``core/schedule`` still provides bucketed assignment (see that module).
+Import from those going forward; this module re-exports the historical
+public names so existing callers keep working unchanged.
 """
 
-from __future__ import annotations
-
-import logging
-import math
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ...core import rng as rng_util
-from ...core import tree as tree_util
-from ...core.compression import blockscale
-from ...core.mesh import CLIENT_AXIS, make_mesh
-from ...core.state import resolve_collective_precision
-from ...ml.aggregator.agg_operator import (ServerOptimizer, ServerState,
-                                           replicated_ef_state_map,
-                                           sharded_state_map)
-from ...ml.trainer.local_trainer import LocalTrainer
-from ...obs.carry import OPT_FLOPS, round_obs
-from ..round_engine import QUANT_KEY_TAG, next_pow2
-from ..sp.fedavg_api import FedAvgAPI
-from ..staging import AsyncCohortStager  # noqa: F401  (re-export: the
-# stager predates ISSUE 3's fused blocks and callers import it from here)
-
-log = logging.getLogger(__name__)
-
-
-def _psum_wavg(stacked, w, axis_name):
-    """Globally-correct weighted average of a client-axis-sharded stack:
-    local partial numerator/denominator, then one psum each over ICI."""
-    num = jax.tree_util.tree_map(
-        # intentional fp32 master-copy merge: collective_precision=fp32
-        # requests full-width wire bytes and the weighted sum must
-        # accumulate at f32; the quantized path bypasses this helper
-        # entirely (docs/COLLECTIVE_PRECISION.md)
-        # fedlint: disable-next-line=collective-axis-check -- see above
-        lambda l: jax.lax.psum(jnp.tensordot(w, l.astype(jnp.float32), axes=1),
-                               axis_name), stacked)
-    den = jax.lax.psum(jnp.sum(w), axis_name)
-    return jax.tree_util.tree_map(lambda x: (x / den).astype(x.dtype), num)
-
-
-def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                       mesh: Mesh, gather: bool = False,
-                       sharded_data: bool = False,
-                       update_sharding: str = "replicated",
-                       state_template: ServerState = None,
-                       donate: bool = False,
-                       collective_precision: str = "fp32",
-                       quant_block: int = blockscale.DEFAULT_BLOCK):
-    """round_fn(state, x|idx, y|·, mask, weights, key, c_clients) with the
-    client axis sharded over the mesh.  In gather mode the first data arg is
-    the (C, S, B) index tensor and ``y`` is the device-resident dataset pair
-    (train_x, train_y):
-
-    - ``sharded_data=False`` — dataset replicated per device; the gather is
-      a local ``jnp.take`` inside the shard (fast, HBM cost = |dataset| per
-      chip; fine at MNIST scale, breaks at the scale the engine is for).
-    - ``sharded_data=True`` — dataset ROWS sharded over the client axis
-      (resident HBM cost = |dataset|/n_shards per chip); the cohort gather
-      runs as a jitted global ``jnp.take`` over the sharded table BEFORE
-      ``shard_map``, so XLA inserts the cross-chip collectives and only the
-      cohort (not the dataset) lands on each shard.
-
-    ``update_sharding="scatter"`` selects the reduce-scatter / shard-update /
-    all-gather merge (module docstring); it needs ``state_template`` — a
-    state from ``ServerOptimizer.init_sharded`` — to derive the mixed
-    replicated/sharded specs of the ServerState pytree.  ``donate=True``
-    donates the state argument so XLA reuses the old ServerState buffers
-    in place instead of copying model + optimizer state every round.
-
-    ``collective_precision`` (docs/COLLECTIVE_PRECISION.md) quantizes the
-    two hot-path collectives INSIDE the compiled round: the flattened
-    FedAvg numerator is block-scaled/stochastically rounded against a
-    per-shard error-feedback buffer before the merge collective, and
-    (scatter mode) the post-update ``all_gather`` ships the quantized new
-    params while the server update transitions the shard-resident fp32
-    master (``ServerState.master_flat``)."""
-    round_fn = _make_mesh_round_core(trainer, server_opt, mesh, gather,
-                                     sharded_data, update_sharding,
-                                     state_template, collective_precision,
-                                     quant_block)
-    return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
-
-
-def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                          mesh: Mesh, gather: bool, sharded_data: bool,
-                          update_sharding: str,
-                          state_template: ServerState,
-                          collective_precision: str = "fp32",
-                          quant_block: int = blockscale.DEFAULT_BLOCK):
-    """Unjitted round body shared by the per-round jit
-    (:func:`make_mesh_round_fn`) and the fused round-block scan
-    (:func:`make_mesh_block_fn`)."""
-    local_train = trainer.make_local_train()
-    alg = server_opt.algorithm
-    n_shards = mesh.shape[CLIENT_AXIS]
-    scatter = update_sharding == "scatter"
-    precision = collective_precision
-    quantized = precision != "fp32"
-    if scatter and state_template is None:
-        raise ValueError("scatter mode needs a state_template from "
-                         "ServerOptimizer.init_sharded")
-    if quantized and state_template is None:
-        raise ValueError("collective_precision needs a state_template "
-                         "carrying the EF buffers (ServerOptimizer.init/"
-                         "init_sharded with collective_precision set)")
-    from ..round_engine import make_server_ctx
-
-    use_ingather = gather and not sharded_data
-
-    def _wire_cast(v):
-        """Payload dtype of a quantized collective: bf16 values really move
-        (and accumulate) at bf16; int8 payloads dequantize BEFORE the
-        collective (the modeled wire format is (int8 q, f32 scales) moved
-        by an all-to-all and summed after dequant — XLA has no mixed
-        int8×scale reduction), so the in-program reduction runs f32."""
-        return v.astype(jnp.bfloat16) if precision == "bf16" else v
-
-    def _shard_qkey(qkey, slot: int):
-        """Per-shard, per-payload stochastic-rounding key: decorrelated
-        across shards (each quantizes a different local payload) and
-        across the merge/broadcast slots within a round."""
-        return jax.random.fold_in(
-            jax.random.fold_in(qkey, jax.lax.axis_index(CLIENT_AXIS)), slot)
-
-    def run_cohort(state: ServerState, x, y, mask, rngs, c_clients):
-        # shapes here are per-device shards: x (c_local, S, B, ...)
-        if use_ingather:
-            idx, (train_x, train_y) = x, y
-            x = jnp.take(train_x, idx, axis=0)
-            y = jnp.take(train_y, idx, axis=0)
-        ctx = make_server_ctx(trainer, state)
-        fn = lambda xb, yb, mb, rng, cc: local_train(
-            state.global_params, xb, yb, mb, rng, ctx, cc)
-        return jax.vmap(fn)(x, y, mask, rngs, c_clients)
-
-    def _cohort_dims(x, y):
-        """Trace-time statics for the ObsCarry phase weights: examples per
-        step (B) and elements per example (feat)."""
-        batch = int(x.shape[2])
-        src_shape = y[0].shape[1:] if use_ingather else x.shape[3:]
-        return batch, math.prod(src_shape)
-
-    def _bytes_model(state) -> float:
-        """Trace-time static: modeled interconnect payload bytes/round of
-        the merge (+ scatter-mode broadcast) collectives at this round's
-        precision — rides ObsCarry, consumed by ``fedtrace summarize`` and
-        ``bench.py --comms``."""
-        if scatter:
-            n_flat = tree_util.padded_flat_size(state.global_params,
-                                                n_shards)
-        else:
-            n_flat = tree_util.num_params(state.global_params)
-        # float() of a pure python int computed from static shapes — no
-        # traced value involved, so no host sync
-        # fedlint: disable-next-line=jit-host-sync -- see above
-        return float(blockscale.modeled_collective_bytes(
-            n_flat, n_shards, precision, quant_block,
-            "scatter" if scatter else "replicated"))
-
-    def shard_metrics(outs, w, old_state, new_state, batch, feat,
-                      quant_err_sq=None):
-        wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-        steps = jax.lax.psum(jnp.sum(outs.num_steps), CLIENT_AXIS)
-        clients = jax.lax.psum(jnp.sum((w > 0).astype(jnp.float32)),
-                               CLIENT_AXIS)
-        metrics = {
-            "train_loss": jax.lax.psum(jnp.sum(outs.loss * w),
-                                       CLIENT_AXIS) / wsum,
-            "total_steps": steps,
-        }
-        # device-carry telemetry (ISSUE 4): psummed globals + static shape
-        # products; global_params are replicated in both update layouts so
-        # the update norm is shard-identical and leaves with the P() spec
-        qerr = None
-        if quant_err_sq is not None:
-            # per-shard residual energies sum into one replicated scalar
-            qerr = jnp.sqrt(jax.lax.psum(quant_err_sq, CLIENT_AXIS))
-        metrics["obs"] = round_obs(
-            old_state.global_params, new_state.global_params,
-            real_steps=steps, real_clients=clients, batch=batch, feat=feat,
-            opt_flops_per_param=OPT_FLOPS.get(alg, 4.0),
-            collective_bytes=_bytes_model(old_state), quant_error=qerr)
-        return metrics
-
-    def per_shard_replicated(state: ServerState, x, y, mask, w, rngs, qkey,
-                             c_clients):
-        outs = run_cohort(state, x, y, mask, rngs, c_clients)
-        quant_err_sq = None
-        if quantized:
-            # EF-quantized merge numerator: each shard adds its residual
-            # row, quantizes its LOCAL flat contribution to the average,
-            # and the all-reduce moves the low-precision payload; the
-            # residual goes back into this shard's ef_num row
-            num = jax.tree_util.tree_map(
-                lambda l: jnp.tensordot(w, l.astype(jnp.float32), axes=1),
-                outs.params)
-            den = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-            v = state.ef_num[0] + tree_util.tree_flatten_1d(num) / den
-            deq, quant_err_sq = blockscale.collective_quantize(
-                v, precision, _shard_qkey(qkey, 0), quant_block)
-            new_ef_num = (v - deq)[None]
-            summed = jax.lax.psum(_wire_cast(deq), CLIENT_AXIS).astype(
-                jnp.float32)
-            avg = tree_util.tree_unflatten_1d(summed, state.global_params)
-        else:
-            avg = _psum_wavg(outs.params, w, CLIENT_AXIS)
-        agg = {
-            "avg_params": avg,
-            "n_sampled": jax.lax.psum(
-                jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
-        }
-        if alg == "scaffold":
-            real = (w > 0).astype(jnp.float32)
-            agg["mean_delta_c"] = _psum_wavg(outs.delta_c, real, CLIENT_AXIS)
-        if alg == "fednova":
-            tau = outs.tau
-            deltas = jax.tree_util.tree_map(
-                lambda yi, gx: (gx[None] - yi) / jnp.maximum(
-                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
-                outs.params, state.global_params)
-            agg["nova_d"] = _psum_wavg(deltas, w, CLIENT_AXIS)
-            wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-            agg["tau_eff"] = jax.lax.psum(jnp.sum(w * tau), CLIENT_AXIS) / wsum
-        if alg in ("mime", "fedsgd"):
-            agg["avg_grad"] = _psum_wavg(outs.grad_sum, w, CLIENT_AXIS)
-
-        new_state = server_opt.update_from_aggregates(state, agg)
-        if quantized:
-            new_state = new_state.replace(ef_num=new_ef_num)
-        # only per-client algorithm state leaves the shard (returning
-        # outs.params would materialize C × |model| for nothing)
-        batch, feat = _cohort_dims(x, y)
-        return (new_state, shard_metrics(outs, w, state, new_state, batch,
-                                         feat, quant_err_sq),
-                outs.new_client_state)
-
-    def per_shard_scatter(state: ServerState, x, y, mask, w, rngs, qkey,
-                          c_clients):
-        # client-VISIBLE server state (SCAFFOLD's c_server in the corrected
-        # gradient, Mime's momentum in the client step) is shard-resident;
-        # all_gather + unflatten it back to the params structure for the
-        # per-client bodies.  Server-side-only state (FedOpt moments,
-        # FedDyn h) never leaves its shard.
-        ctx_state = state
-        gathered = {}
-        for field in ("c_server", "momentum"):
-            v = getattr(state, field)
-            if v is not None:
-                full = jax.lax.all_gather(v, CLIENT_AXIS, tiled=True)
-                gathered[field] = tree_util.tree_unflatten_1d(
-                    full, state.global_params)
-        if gathered:
-            ctx_state = state.replace(**gathered)
-        outs = run_cohort(ctx_state, x, y, mask, rngs, c_clients)
-        den = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-
-        def scatter_wavg(stacked, ww, dd):
-            # local client-weighted partial sums per leaf, flattened into
-            # ONE padded vector, then reduce-scattered: each chip receives
-            # only its contiguous 1/n_shards chunk of the cohort-summed
-            # numerator instead of the full all-reduced model
-            num = jax.tree_util.tree_map(
-                lambda l: jnp.tensordot(ww, l.astype(jnp.float32), axes=1),
-                stacked)
-            flat = tree_util.tree_flatten_padded(num, n_shards)
-            return jax.lax.psum_scatter(flat, CLIENT_AXIS,
-                                        scatter_dimension=0, tiled=True) / dd
-
-        quant_err_sq = None
-        if quantized:
-            # EF-quantized reduce-scatter of the FedAvg numerator: the
-            # shard's flat contribution to the AVERAGE (divide by the
-            # psummed weight first — EF residuals then live in stable
-            # param-delta units across rounds) plus this shard's residual
-            # row, block-scaled/stochastically rounded, reduce-scattered
-            # at the wire precision
-            num = jax.tree_util.tree_map(
-                lambda l: jnp.tensordot(w, l.astype(jnp.float32), axes=1),
-                outs.params)
-            flat = tree_util.tree_flatten_padded(num, n_shards) / den
-            v = state.ef_num[0] + flat
-            deq, quant_err_sq = blockscale.collective_quantize(
-                v, precision, _shard_qkey(qkey, 0), quant_block)
-            new_ef_num = (v - deq)[None]
-            avg_chunk = jax.lax.psum_scatter(
-                _wire_cast(deq), CLIENT_AXIS, scatter_dimension=0,
-                tiled=True).astype(jnp.float32)
-        else:
-            avg_chunk = scatter_wavg(outs.params, w, den)
-        agg = {
-            "avg_params": avg_chunk,
-            "n_sampled": jax.lax.psum(
-                jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
-        }
-        if alg == "scaffold":
-            real = (w > 0).astype(jnp.float32)
-            real_den = jax.lax.psum(jnp.sum(real), CLIENT_AXIS)
-            agg["mean_delta_c"] = scatter_wavg(outs.delta_c, real, real_den)
-        if alg == "fednova":
-            tau = outs.tau
-            deltas = jax.tree_util.tree_map(
-                lambda yi, gx: (gx[None] - yi) / jnp.maximum(
-                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
-                outs.params, state.global_params)
-            agg["nova_d"] = scatter_wavg(deltas, w, den)
-            agg["tau_eff"] = jax.lax.psum(jnp.sum(w * tau), CLIENT_AXIS) / den
-        if alg in ("mime", "fedsgd"):
-            agg["avg_grad"] = scatter_wavg(outs.grad_sum, w, den)
-
-        # this chip's chunk of the current global params, then the sharded
-        # stage-2 transition on 1/n_shards of the model.  With quantized
-        # collectives the chunk comes from the shard-resident fp32 MASTER
-        # (state.global_params is the low-precision broadcast copy the
-        # clients trained from — transitioning it would compound the
-        # broadcast rounding into the model state every round).
-        if quantized:
-            gshard = state.master_flat
-        else:
-            gflat = tree_util.tree_flatten_padded(state.global_params,
-                                                  n_shards)
-            gshard = tree_util.flat_chunk(
-                gflat, jax.lax.axis_index(CLIENT_AXIS), n_shards)
-        new_gshard, new_fields = server_opt.update_shard(state, gshard, agg)
-        # all_gather ONLY the new params for the next round's broadcast;
-        # opt_state/c_server/h/momentum stay shard-resident
-        if quantized:
-            # broadcast at the collective precision: the all_gather ships
-            # the quantized chunk; the fp32 master never crosses the wire
-            send, new_ef_bcast, berr_sq = blockscale.quantize_broadcast(
-                new_gshard, state.ef_bcast, precision,
-                _shard_qkey(qkey, 1), quant_block)
-            new_fields["master_flat"] = new_gshard
-            new_fields["ef_num"] = new_ef_num
-            if state.ef_bcast is not None:
-                new_fields["ef_bcast"] = new_ef_bcast
-            quant_err_sq = quant_err_sq + berr_sq
-            new_flat = jax.lax.all_gather(
-                _wire_cast(send), CLIENT_AXIS, tiled=True).astype(
-                    jnp.float32)
-        else:
-            new_flat = jax.lax.all_gather(new_gshard, CLIENT_AXIS,
-                                          tiled=True)
-        new_params = tree_util.tree_unflatten_1d(new_flat,
-                                                 state.global_params)
-        new_state = state.replace(round_idx=state.round_idx + 1,
-                                  global_params=new_params, **new_fields)
-        batch, feat = _cohort_dims(x, y)
-        return (new_state, shard_metrics(outs, w, state, new_state, batch,
-                                         feat, quant_err_sq),
-                outs.new_client_state)
-
-    shard = P(CLIENT_AXIS)
-    data_spec = P() if use_ingather else shard
-    if scatter:
-        state_spec = sharded_state_map(state_template, P(), shard)
-        per_shard = per_shard_scatter
-    elif quantized:
-        # replicated merge with a quantized numerator: only the per-shard
-        # EF residual rows break full replication
-        state_spec = replicated_ef_state_map(state_template, P(), shard)
-        per_shard = per_shard_replicated
-    else:
-        state_spec = P()
-        per_shard = per_shard_replicated
-    sharded = jax.shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(state_spec, shard, data_spec, shard, shard, shard, P(),
-                  shard),
-        out_specs=(state_spec, P(), shard),
-        check_vma=False,
-    )
-
-    def round_fn(state, x, y, mask, w, key, c_clients):
-        # split inside the compiled program (host-side split costs a device
-        # roundtrip per round); GSPMD shards the keys per in_spec
-        rngs = jax.random.split(key, mask.shape[0])
-        # stochastic-rounding stream of the collective layer, derived from
-        # the same round key (replicated; shards fold in their axis index)
-        qkey = jax.random.fold_in(key, QUANT_KEY_TAG)
-        if gather and sharded_data:
-            # cohort gather over the ROW-SHARDED dataset: XLA lowers the
-            # take into cross-chip collectives; pin the result onto the
-            # client axis so only the cohort is resident per shard
-            idx, (train_x, train_y) = x, y
-            cohort_spec = NamedSharding(mesh, P(CLIENT_AXIS))
-            x = jax.lax.with_sharding_constraint(
-                jnp.take(train_x, idx, axis=0), cohort_spec)
-            y = jax.lax.with_sharding_constraint(
-                jnp.take(train_y, idx, axis=0), cohort_spec)
-        return sharded(state, x, y, mask, w, rngs, qkey, c_clients)
-
-    return round_fn
-
-
-def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
-                       mesh: Mesh, gather: bool = False,
-                       sharded_data: bool = False,
-                       update_sharding: str = "replicated",
-                       state_template: ServerState = None,
-                       donate: bool = False,
-                       collective_precision: str = "fp32",
-                       quant_block: int = blockscale.DEFAULT_BLOCK):
-    """Fused mesh round-block: K rounds as ONE ``jit(lax.scan(round))``
-    dispatch (ISSUE 3 tentpole; same composition DrJAX builds from,
-    arXiv:2403.07128).
-
-    ``block_fn(state, x_blk, dev_data, mask_blk, w_blk, keys_blk,
-    cohort_blk, client_table)``: cohort inputs carry a leading round axis
-    (``x_blk`` is the ``(K, C, S, B)`` index tensor in gather mode —
-    fusion requires device-resident data so a staged block is indices
-    only); ``dev_data`` is the device-resident ``(train_x, train_y)`` pair
-    passed once per call, not per round.  ServerState and the
-    client-axis-sharded per-client state table thread through the scan
-    carry (both donated), the table gathered/scattered by ``cohort_blk``
-    ids INSIDE the compiled program, and per-round metrics stack into
-    ``(K,)`` outputs so the host syncs once per block."""
-    core = _make_mesh_round_core(trainer, server_opt, mesh, gather,
-                                 sharded_data, update_sharding,
-                                 state_template, collective_precision,
-                                 quant_block)
-    has_table = server_opt.algorithm in ("scaffold", "feddyn")
-    row_sharding = NamedSharding(mesh, P(CLIENT_AXIS))
-
-    def block_fn(state: ServerState, x_blk, dev_data, mask_blk, w_blk,
-                 keys_blk, cohort_blk, client_table=None):
-        def step(carry, inp):
-            st, table = carry
-            x, mask, w, key, cohort = inp
-            c = None
-            if has_table:
-                # rows of the client-axis-sharded table -> cohort stack,
-                # pinned back onto the client axis for the shard_map body
-                c = jax.lax.with_sharding_constraint(
-                    tree_util.cohort_gather(table, cohort), row_sharding)
-            st, metrics, new_c = core(st, x, dev_data, mask, w, key, c)
-            if has_table:
-                table = jax.lax.with_sharding_constraint(
-                    tree_util.cohort_scatter(table, cohort, new_c),
-                    row_sharding)
-            return (st, table), metrics
-
-        (state, client_table), metrics = jax.lax.scan(
-            step, (state, client_table),
-            (x_blk, mask_blk, w_blk, keys_blk, cohort_blk))
-        return state, metrics, client_table
-
-    return jax.jit(block_fn, donate_argnums=(0, 7) if donate else ())
-
-
-class MeshFedAvgAPI(FedAvgAPI):
-    """Same driver surface as the SP engine; rounds dispatch onto the mesh.
-
-    The accuracy curve is bitwise-comparable to the SP engine under the same
-    seed (same per-client keys, same batch schedule) — the §7 exit criterion.
-
-    ``args.update_sharding``: "replicated" | "scatter" | "auto" (default:
-    scatter whenever the mesh has more than one client shard).
-    ``args.async_staging`` (default True): double-buffer the host→device
-    cohort staging so round r+1's transfer overlaps round r's compute.
-    """
-
-    def __init__(self, args, device, dataset, model, mesh: Mesh = None):
-        self.mesh = mesh if mesh is not None else make_mesh(
-            client=int(getattr(args, "mesh_client", -1)),
-            data=int(getattr(args, "mesh_data", 1)),
-            model=int(getattr(args, "mesh_model", 1)),
-            seq=int(getattr(args, "mesh_seq", 1)))
-        self.n_shards = self.mesh.shape[CLIENT_AXIS]
-        mode = str(getattr(args, "update_sharding", "auto") or "auto").lower()
-        if mode == "auto":
-            mode = "scatter" if self.n_shards > 1 else "replicated"
-        if mode not in ("replicated", "scatter"):
-            raise ValueError(
-                f"update_sharding must be 'replicated', 'scatter' or "
-                f"'auto', got {mode!r}")
-        self.update_sharding = mode
-        super().__init__(args, device, dataset, model, client_mode="vmap")
-        self._data_sharding = NamedSharding(self.mesh, P(CLIENT_AXIS))
-        self._repl_sharding = NamedSharding(self.mesh, P())
-        if self.update_sharding == "scatter":
-            # mixed placement: flat aux state sharded over the client axis,
-            # params + round counter (+ scalar optimizer counters) replicated
-            self.state = jax.device_put(self.state, sharded_state_map(
-                self.state, self._repl_sharding, self._data_sharding))
-        elif self.collective_precision != "fp32":
-            # replicated layout with a quantized merge: only the per-shard
-            # EF residual rows (each chip quantizes its own local numerator)
-            # break full replication
-            self.state = jax.device_put(self.state, replicated_ef_state_map(
-                self.state, self._repl_sharding, self._data_sharding))
-        else:
-            self.state = jax.device_put(self.state, self._repl_sharding)
-        self._stager = AsyncCohortStager(
-            self._stage_cohort,
-            enabled=bool(getattr(args, "async_staging", True)))
-
-    def _build_round_fn(self, client_mode: str):
-        # device_data: True/"replicated" | "sharded" | False ("host")
-        mode = getattr(self.args, "device_data", True)
-        if isinstance(mode, str):
-            mode = mode.lower()
-        self._gather = mode not in (False, "host", "off")
-        self._sharded_data = mode == "sharded"
-        if self._gather:
-            if self._sharded_data:
-                # row-shard the dataset over the client axis: resident HBM
-                # per chip = |dataset|/n_shards (VERDICT r1 weak #8 — full
-                # replication broke exactly at the scale the engine is for)
-                n = self.mesh.shape[CLIENT_AXIS]
-                spec = NamedSharding(self.mesh, P(CLIENT_AXIS))
-                tx, ty = self.dataset.train_x, self.dataset.train_y
-                pad = (-len(tx)) % n
-                if pad:  # row count must divide evenly; padded rows are
-                    # never indexed (cohort indices < len(tx))
-                    tx = np.concatenate([tx, np.zeros_like(tx[:pad])])
-                    ty = np.concatenate([ty, np.zeros_like(ty[:pad])])
-                self._dev_data = (
-                    jax.device_put(jnp.asarray(tx), spec),
-                    jax.device_put(jnp.asarray(ty), spec))
-            else:
-                repl = NamedSharding(self.mesh, P())
-                self._dev_data = (
-                    jax.device_put(jnp.asarray(self.dataset.train_x), repl),
-                    jax.device_put(jnp.asarray(self.dataset.train_y), repl))
-        if self.update_sharding == "scatter":
-            # re-init server aux state into its permanent shard-resident
-            # flat layout (FedAvgAPI.__init__ built the replicated one)
-            self.state = self.server_opt.init_sharded(
-                self.state.global_params, self.n_shards,
-                collective_precision=self.collective_precision)
-        return make_mesh_round_fn(self.trainer, self.server_opt, self.mesh,
-                                  gather=self._gather,
-                                  sharded_data=self._sharded_data,
-                                  update_sharding=self.update_sharding,
-                                  state_template=self.state,
-                                  donate=self.DONATE_STATE,
-                                  collective_precision=self.collective_precision,
-                                  quant_block=self.quant_block)
-
-    def _init_server_state(self, params):
-        """Replicated-layout init for the mesh: one EF residual row PER
-        SHARD (each chip quantizes its own local numerator), and no
-        master/broadcast split — the replicated merge mode has no
-        post-update all_gather, so global_params stay fp32 and only the
-        numerator all-reduce is quantized.  Scatter mode replaces this
-        state wholesale in ``_build_round_fn`` via ``init_sharded``."""
-        return self.server_opt.init(
-            params, collective_precision=self.collective_precision,
-            ef_shards=self.n_shards, quantized_broadcast=False)
-
-    def _init_client_table(self):
-        """Client-state table rows padded to a multiple of the shard count
-        and sharded over the client axis: each chip permanently owns
-        ``rows/n_shards`` clients' SCAFFOLD/FedDyn state; cohort rows move
-        by gather/scatter collectives inside the compiled round."""
-        self._table_rows = -(-self.dataset.num_clients
-                             // self.n_shards) * self.n_shards
-        table = tree_util.client_table_init(self.state.global_params,
-                                            self._table_rows)
-        return jax.device_put(table,
-                              NamedSharding(self.mesh, P(CLIENT_AXIS)))
-
-    def _build_block_fn(self):
-        if not self._gather:
-            raise ValueError(
-                "round_block fusion on the mesh engine needs "
-                "device-resident data (device_data=True or 'sharded'): "
-                "staging a block must ship index tensors, not cohorts")
-        inner = make_mesh_block_fn(self.trainer, self.server_opt, self.mesh,
-                                   gather=self._gather,
-                                   sharded_data=self._sharded_data,
-                                   update_sharding=self.update_sharding,
-                                   state_template=self.state,
-                                   donate=self.DONATE_STATE,
-                                   collective_precision=self.collective_precision,
-                                   quant_block=self.quant_block)
-        dev_data = self._dev_data
-
-        def call(state, idx, mask, w, keys, cohort, table):
-            return inner(state, idx, dev_data, mask, w, keys, cohort, table)
-
-        return call
-
-    def _stage_block(self, start_round: int):
-        """Mesh block staging: stacked index/mask/weight tensors sharded
-        over the client axis (leading round axis replicated), cohort ids
-        padded with the out-of-range sentinel so pad rows never touch the
-        client-state table.  Pure function of ``start_round``."""
-        k = min(self._round_block, self.comm_rounds - start_round)
-        rounds = range(start_round, start_round + k)
-        per = []
-        for r in rounds:
-            clients = self._client_sampling(r)
-            idx, mask, w = self.dataset.cohort_indices(
-                clients, self.batch_size, self.seed, r, self.epochs)
-            per.append((clients, idx, mask, w))
-        n = per[0][1].shape[0]
-        n_padded = -(-n // self.n_shards) * self.n_shards
-        steps = next_pow2(max(p[1].shape[1] for p in per))
-        sentinel = getattr(self, "_table_rows", self.dataset.num_clients)
-        idx_blk = np.zeros((k, n_padded, steps, self.batch_size), np.int32)
-        mask_blk = np.zeros((k, n_padded, steps), np.float32)
-        w_blk = np.zeros((k, n_padded), np.float32)
-        cohort_blk = np.full((k, n_padded), sentinel, np.int32)
-        for i, (clients, idx, mask, w) in enumerate(per):
-            s = idx.shape[1]
-            idx_blk[i, :n, :s] = idx
-            mask_blk[i, :n, :s] = mask
-            w_blk[i, :n] = w
-            cohort_blk[i, :n] = clients
-        root = rng_util.root_key(self.seed)
-        keys_blk = np.stack([np.asarray(rng_util.round_key(root, r))
-                             for r in rounds])
-        shard = NamedSharding(self.mesh, P(None, CLIENT_AXIS))
-        put = lambda a: jax.device_put(jnp.asarray(a), shard)
-        repl = lambda a: jax.device_put(jnp.asarray(a), self._repl_sharding)
-        return (k, steps, put(idx_blk), put(mask_blk), put(w_blk),
-                repl(keys_blk), repl(cohort_blk))
-
-    def _stage_cohort(self, round_idx: int):
-        """Build + device_put one round's cohort tensors.  Pure function of
-        the round index (sampling and batching are seed-derived), so the
-        stager may run it ahead of time on a worker thread."""
-        clients = self._client_sampling(round_idx)
-        n = len(clients)
-        n_padded = -(-n // self.n_shards) * self.n_shards
-        pad_c = n_padded - n
-        if self._gather:
-            idx, mask, w = self.dataset.cohort_indices(
-                clients, self.batch_size, self.seed, round_idx, self.epochs)
-            steps = next_pow2(idx.shape[1])
-            pad_s = steps - idx.shape[1]
-            if pad_s or pad_c:
-                idx = np.pad(idx, [(0, pad_c), (0, pad_s), (0, 0)])
-                mask = np.pad(mask, [(0, pad_c), (0, pad_s)])
-                w = np.pad(w, (0, pad_c))
-            data_x, data_y = idx, self._dev_data
-        else:
-            x, y, mask, w = self.dataset.cohort_batches(
-                clients, self.batch_size, self.seed, round_idx, self.epochs)
-            steps = next_pow2(x.shape[1])
-            pad_s = steps - x.shape[1]
-            if pad_s or pad_c:
-                x = np.pad(x, [(0, pad_c), (0, pad_s)] + [(0, 0)] * (x.ndim - 2))
-                y = np.pad(y, [(0, pad_c), (0, pad_s)] + [(0, 0)] * (y.ndim - 2))
-                mask = np.pad(mask, [(0, pad_c), (0, pad_s)])
-                w = np.pad(w, (0, pad_c))
-            data_x, data_y = x, y
-        put = lambda a: jax.device_put(jnp.asarray(a), self._data_sharding)
-        dy = data_y if self._gather else put(data_y)
-        return clients, pad_c, put(data_x), dy, put(mask), put(w)
-
-    def train_one_round(self, round_idx: int):
-        nxt = round_idx + 1 if round_idx + 1 < self.comm_rounds else None
-        clients, pad_c, data_x, data_y, mask, w = self._stager.get(
-            round_idx, prefetch=nxt)
-        key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
-        # per-client state rows gather/scatter on DEVICE against the
-        # client-axis-sharded table (the host-dict era device_got the whole
-        # stacked cohort state back every round); pad rows use the
-        # out-of-range sentinel so their writes drop
-        cohort = None
-        c_stacked = None
-        if self.client_table is not None:
-            cohort = np.concatenate(
-                [np.asarray(clients, np.int32),
-                 np.full(pad_c, self._table_rows, np.int32)])
-            c_stacked = self._gather_c(cohort)
-        self.state, metrics, new_c = self.round_fn(
-            self.state, data_x, data_y, mask, w, key, c_stacked)
-        self._scatter_c(cohort, new_c)
-        return metrics
+from .collectives import psum_wavg as _psum_wavg  # noqa: F401
+from .engine import (AsyncCohortStager, MeshFedAvgAPI,  # noqa: F401
+                     make_mesh_block_fn, make_mesh_round_fn)
+from .layout import MeshLayout  # noqa: F401

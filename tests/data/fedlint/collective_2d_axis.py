@@ -1,0 +1,18 @@
+"""Fixture: 2-D ``(client, model)`` meshes (docs/MESH_2D.md) — tuple axis
+declarations and multi-axis collectives must both resolve."""
+import jax
+
+CLIENT_AXIS = "client"
+MODEL_AXIS = "model"
+
+# 2-tuple mesh via the positional axis_names form
+mesh2d = jax.make_mesh((4, 2), (CLIENT_AXIS, MODEL_AXIS))
+
+
+def merge(x):
+    both = jax.lax.psum(x, (CLIENT_AXIS, MODEL_AXIS))    # ok: multi-axis
+    col = jax.lax.psum_scatter(x, CLIENT_AXIS)           # ok: one of two
+    row = jax.lax.all_gather(x, axis_name=("model",))    # ok: 1-tuple
+    bad = jax.lax.psum(x, ("client", "tensor"))          # 'tensor' undeclared
+    worse = jax.lax.pmean(x, "replica")                  # undeclared
+    return both, col, row, bad, worse

@@ -109,6 +109,36 @@ def test_bench_comms_quick(monkeypatch):
     assert out["int8_quant_error_norm"] > out["bf16_quant_error_norm"]
 
 
+def test_bench_mesh2d_quick(monkeypatch):
+    """bench.py --mesh2d smoke: the 1-D (8,1) vs 2-D (4,2) comparison runs
+    green at a fixed 8-chip count, the per-axis ObsCarry byte split is
+    plumbed through (model-axis bytes appear exactly on the 2-D layout),
+    layout parity is visible in the round-1 losses, and the LLM_SCALE row
+    names a model that fits the 2-D layout but exceeds one chip on 1-D
+    (ISSUE 6 acceptance; docs/MESH_2D.md)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_MESH2D_QUICK", "1")
+    out = bench.bench_mesh2d()
+    assert out["quick"] is True
+    assert out["mesh1d_shape"] == [8, 1]
+    assert out["mesh2d_shape"] == [4, 2]
+    assert out["mesh1d_s_per_round"] > 0
+    assert out["mesh2d_s_per_round"] > 0
+    # client-axis merge payload is layout-independent; model-axis traffic
+    # exists exactly on the 2-D layout
+    assert out["mesh2d_client_bytes_per_round"] == \
+        out["mesh1d_client_bytes_per_round"] > 0
+    assert out["mesh1d_model_bytes_per_round"] == 0
+    assert out["mesh2d_model_bytes_per_round"] > 0
+    # same seed, same cohort: the layouts train the same model
+    assert abs(out["mesh1d_round1_loss"] - out["mesh2d_round1_loss"]) < 2e-5
+    ls = out["llm_scale"]
+    assert ls["mesh1d_fits"] is False and ls["mesh2d_fits"] is True
+    assert ls["n_params"] >= 1e9          # a >=1B model the 1-D mesh cannot run
+    assert ls["mesh1d_per_chip_gib"] > ls["hbm_per_chip_gib"]
+    assert ls["mesh2d_per_chip_gib"] <= ls["hbm_per_chip_gib"]
+
+
 def test_probe_verdict_cache_ttl_semantics(tmp_path, monkeypatch):
     """The accelerator liveness-probe verdict is cached in a side file so a
     wedged tunnel costs one 120s hang per boot, not one per bench/test

@@ -260,6 +260,10 @@ def test_fedtrace_golden_values_are_hand_checkable():
     assert s["compile_count"] == 1 and s["compile_s"] == 0.05
     assert s["collective_bytes_per_round"] == 30000.0
     assert s["collective_bytes_total"] == 60000.0
+    # per-axis split (docs/MESH_2D.md): 30000+15000 client, 10000+5000
+    # model — the two axis averages sum to the total average
+    assert s["collective_bytes_client_per_round"] == 22500.0
+    assert s["collective_bytes_model_per_round"] == 7500.0
     assert s["quant_error_norm_last"] == 0.01
 
 

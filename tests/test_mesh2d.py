@@ -1,0 +1,366 @@
+"""2-D ``client × model`` mesh (ISSUE 6): ``args.mesh_shape =
+(n_client_shards, n_model_shards)`` runs client train steps model-parallel
+(params sharded per ``MeshLayout.param_spec``) while the FedAvg merge keeps
+its ``psum_scatter`` along ``client`` and the flat server state (opt
+moments, EF rows, fp32 master) shards along BOTH axes — docs/MESH_2D.md.
+
+Pinned here:
+
+- parity: sp ≡ 1-D ``(8, 1)`` ≡ 2-D ``(4, 2)`` to 2e-5 for
+  fedavg/fedopt/scaffold, incl. the ``round_block=8`` ragged tail (fused ≡
+  unfused bitwise within a layout) and int8+EF (cross-layout to the loose
+  int8 tolerance — different shard counts draw different stochastic-
+  rounding streams);
+- layout: flat aux vectors chunk over BOTH axes, EF rows keep rows on
+  ``client`` / columns on ``model``, matrix params shard over ``model``;
+- orbax round-trip of the dual-axis-sharded opt_state/EF/master, resuming
+  onto the uninterrupted curve;
+- ``JaxRuntimeAudit``: ZERO steady-state recompiles on the 2-D layout,
+  per-round and fused;
+- ``core/memory_estimate.py``: the per-chip HBM estimate divides the
+  model-dependent terms by ``n_model_shards`` and prices the acceptance
+  config (a >=1B model that exceeds one v5e chip on 1-D but fits 2-D).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.core import tree as tree_util
+from fedml_tpu.core.flatmodel import FlatSpec
+from fedml_tpu.core.memory_estimate import (GIB, HBM_PER_CHIP,
+                                            MeshStateLayout,
+                                            estimate_mesh_state_memory,
+                                            largest_runnable_params,
+                                            mesh_state_fits)
+from fedml_tpu.core.mesh import (CLIENT_AXIS, MODEL_AXIS, make_mesh2d,
+                                 parse_mesh_shape)
+
+ALGS = ["FedAvg", "FedOpt", "SCAFFOLD"]
+#: FedOpt's toy-default server_lr=1.0 amplifies ulp noise chaotically
+#: (test_collective_precision precedent) — parity runs at a sane 0.03
+SANE = {"FedOpt": {"server_lr": 0.03}}
+
+
+def args_for(rounds=3, **over):
+    args = load_arguments()
+    args.update(
+        dataset="synthetic", num_classes=10, input_shape=(28, 28, 1),
+        train_size=1024, test_size=256, model="lr",
+        client_num_in_total=16, client_num_per_round=8, comm_round=rounds,
+        epochs=1, batch_size=16, learning_rate=0.1, random_seed=7,
+        partition_method="homo", frequency_of_the_test=10 ** 9,
+    )
+    args.update(**over)
+    return args
+
+
+def make_api(backend, rounds=3, **over):
+    from fedml_tpu import data as data_mod, model as model_mod
+
+    args = fedml_tpu.init(args_for(rounds=rounds, **over))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    if backend == "sp":
+        from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+        return FedAvgAPI(args, None, dataset, model)
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+    return MeshFedAvgAPI(args, None, dataset, model)
+
+
+def run_rounds(api, rounds):
+    return [float(api.train_one_round(r)["train_loss"])
+            for r in range(rounds)]
+
+
+def assert_tree_close(a, b, atol, rtol=1e-4, msg=""):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=atol, rtol=rtol, err_msg=msg)
+
+
+# -- mesh_shape plumbing -----------------------------------------------------
+
+def test_parse_mesh_shape_forms():
+    assert parse_mesh_shape(None) is None
+    assert parse_mesh_shape("auto") is None
+    assert parse_mesh_shape("4,2") == (4, 2)
+    assert parse_mesh_shape("4x2") == (4, 2)
+    assert parse_mesh_shape((2, 4)) == (2, 4)
+    assert parse_mesh_shape([-1, 2]) == (-1, 2)
+    with pytest.raises(ValueError, match="mesh_shape"):
+        parse_mesh_shape("8")
+    with pytest.raises(ValueError, match="n_model_shards"):
+        parse_mesh_shape("4,0")
+
+
+def test_make_mesh2d_axes():
+    mesh = make_mesh2d("4,2")
+    assert int(mesh.shape[CLIENT_AXIS]) == 4
+    assert int(mesh.shape[MODEL_AXIS]) == 2
+    # -1 absorbs the remaining devices given the model factor
+    mesh = make_mesh2d((-1, 2))
+    assert int(mesh.shape[CLIENT_AXIS]) == jax.device_count() // 2
+
+
+# -- parity: sp ≡ 1-D ≡ 2-D -------------------------------------------------
+
+@pytest.mark.parametrize("opt", ALGS)
+def test_parity_sp_1d_2d(opt):
+    """ISSUE 6 acceptance: the 2-D layout computes the SAME federated
+    round — losses and final params within 2e-5 of both the sp engine and
+    the historical 1-D mesh."""
+    over = SANE.get(opt, {})
+    runs = {}
+    for name, backend, shape in (("sp", "sp", None),
+                                 ("mesh1d", "mesh", "8,1"),
+                                 ("mesh2d", "mesh", "4,2")):
+        kw = dict(over)
+        if shape is not None:
+            kw["mesh_shape"] = shape
+        api = make_api(backend, rounds=4, federated_optimizer=opt, **kw)
+        if name == "mesh2d":
+            assert api.n_model_shards == 2 and api.n_shards == 4
+        runs[name] = (run_rounds(api, 4), api.state.global_params)
+
+    sp_losses, sp_params = runs["sp"]
+    for name in ("mesh1d", "mesh2d"):
+        losses, params = runs[name]
+        np.testing.assert_allclose(losses, sp_losses, atol=2e-5,
+                                   err_msg=f"{opt}/{name} loss curve")
+        assert_tree_close(params, sp_params, atol=2e-5,
+                          msg=f"{opt}/{name} params")
+
+
+@pytest.mark.parametrize("opt", ["FedAvg", "SCAFFOLD"])
+def test_parity_2d_fused_ragged(opt):
+    """round_block=8 over 10 rounds (8 + ragged 2) on the 2-D layout: the
+    scan body IS the per-round body, so fused ≡ unfused bitwise — incl.
+    SCAFFOLD's dual-axis-sharded client-state table riding the carry."""
+    ref = make_api("mesh", rounds=10, federated_optimizer=opt,
+                   mesh_shape="4,2", round_block=1)
+    ref_losses = run_rounds(ref, 10)
+    fused = make_api("mesh", rounds=10, federated_optimizer=opt,
+                     mesh_shape="4,2", round_block=8)
+    losses, r = [], 0
+    while r < 10:
+        k, ms = fused.train_block(r)
+        losses += [float(x) for x in np.asarray(ms["train_loss"])]
+        r += k
+    assert losses == ref_losses
+    assert_tree_close(ref.state.global_params, fused.state.global_params,
+                      atol=0, rtol=0, msg="2-D fused params drifted")
+
+
+def test_parity_2d_int8_ef():
+    """int8+EF on the 2-D layout: fused ≡ unfused bitwise WITHIN the
+    layout (same shard count, same stochastic-rounding streams), and the
+    loss curve tracks the 1-D int8 run at the loose cross-layout
+    tolerance (different shard counts draw different rounding noise —
+    test_collective_precision precedent)."""
+    ref = make_api("mesh", rounds=10, federated_optimizer="SCAFFOLD",
+                   mesh_shape="4,2", collective_precision="int8",
+                   round_block=1)
+    ref_losses = run_rounds(ref, 10)
+    fused = make_api("mesh", rounds=10, federated_optimizer="SCAFFOLD",
+                     mesh_shape="4,2", collective_precision="int8",
+                     round_block=8)
+    losses, r = [], 0
+    while r < 10:
+        k, ms = fused.train_block(r)
+        losses += [float(x) for x in np.asarray(ms["train_loss"])]
+        r += k
+    assert losses == ref_losses
+    np.testing.assert_array_equal(np.asarray(ref.state.ef_num),
+                                  np.asarray(fused.state.ef_num))
+
+    one_d = make_api("mesh", rounds=10, federated_optimizer="SCAFFOLD",
+                     mesh_shape="8,1", collective_precision="int8")
+    np.testing.assert_allclose(ref_losses[:4], run_rounds(one_d, 4),
+                               atol=1e-2)
+
+
+# -- layout: dual-axis sharding ---------------------------------------------
+
+def test_2d_state_layout():
+    """Flat aux state chunks over BOTH axes (each chip owns 1/(c*m)), EF
+    rows keep rows on ``client`` / columns on ``model``, matrix params
+    shard over ``model``, and the flat pad multiple is c*m so client
+    chunks subdivide evenly over the model axis."""
+    api = make_api("mesh", rounds=1, federated_optimizer="FedOpt",
+                   mesh_shape="4,2", update_sharding="scatter",
+                   collective_precision="int8")
+    api.train_one_round(0)
+    st = api.state
+    assert api.layout.flat_multiple == 8
+    flat_len = tree_util.padded_flat_size(st.global_params, 8)
+    assert st.master_flat.shape == (flat_len,)
+    assert st.master_flat.sharding.spec == P((CLIENT_AXIS, MODEL_AXIS))
+    assert st.ef_bcast.sharding.spec == P((CLIENT_AXIS, MODEL_AXIS))
+    assert st.ef_num.shape == (api.n_shards, flat_len)
+    assert st.ef_num.sharding.spec == P(CLIENT_AXIS, MODEL_AXIS)
+    for leaf in jax.tree_util.tree_leaves(st.opt_state):
+        if np.ndim(leaf) >= 1:
+            assert leaf.sharding.spec == P((CLIENT_AXIS, MODEL_AXIS))
+    # matrix params shard over model, vector/scalar leaves replicate
+    specs = {tuple(np.shape(l)): l.sharding.spec
+             for l in jax.tree_util.tree_leaves(st.global_params)}
+    assert any(MODEL_AXIS in str(s) for shape, s in specs.items()
+               if len(shape) >= 2)
+    assert all(s == P() for shape, s in specs.items() if len(shape) < 2)
+
+
+def test_2d_obs_byte_split():
+    """ObsCarry's per-axis byte split: client + model == total, and the
+    model share appears exactly on the 2-D layout."""
+    api = make_api("mesh", rounds=1, mesh_shape="4,2")
+    obs = api.train_one_round(0)["obs"]
+    c = float(np.asarray(obs.collective_bytes_client))
+    m = float(np.asarray(obs.collective_bytes_model))
+    assert m > 0
+    assert c + m == float(np.asarray(obs.collective_bytes))
+    one_d = make_api("mesh", rounds=1, mesh_shape="8,1")
+    obs1 = one_d.train_one_round(0)["obs"]
+    assert float(np.asarray(obs1.collective_bytes_model)) == 0.0
+
+
+# -- checkpoint: dual-axis-sharded state round-trips -------------------------
+
+def test_2d_checkpoint_roundtrip(tmp_path):
+    """The dual-axis-sharded opt_state/EF/master ride the existing orbax
+    path byte-exactly, and the restored run continues on the
+    uninterrupted curve."""
+    ck = str(tmp_path / "ck")
+    api = make_api("mesh", federated_optimizer="FedOpt",
+                   mesh_shape="4,2", collective_precision="int8",
+                   checkpoint_dir=ck, checkpoint_freq=1)
+    run_rounds(api, 2)
+    api.maybe_checkpoint(1)
+
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    args = fedml_tpu.init(args_for(federated_optimizer="FedOpt",
+                                   mesh_shape="4,2",
+                                   collective_precision="int8",
+                                   checkpoint_dir=ck, checkpoint_freq=1))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api2 = MeshFedAvgAPI(args, None, dataset, model)
+    assert api2.maybe_resume() == 2
+    for field in ("ef_num", "master_flat", "ef_bcast"):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(getattr(api.state, field))),
+            np.asarray(jax.device_get(getattr(api2.state, field))),
+            err_msg=f"restored {field} differs")
+    assert_tree_close(api.state.opt_state, api2.state.opt_state, atol=0,
+                      rtol=0, msg="restored opt_state differs")
+    uninterrupted = make_api("mesh", federated_optimizer="FedOpt",
+                             mesh_shape="4,2",
+                             collective_precision="int8")
+    run_rounds(uninterrupted, 3)
+    api2.train_one_round(2)
+    assert_tree_close(uninterrupted.state.global_params,
+                      api2.state.global_params, atol=2e-5)
+
+
+# -- runtime contract: zero steady-state recompiles on 2-D -------------------
+
+def test_2d_round_compiles_once():
+    """ISSUE 6 acceptance: the 2-D round is ONE compiled program —
+    steady-state rounds add ZERO XLA compiles (sync staging: worker-thread
+    device_puts race the audit window, as in test_collective_precision)."""
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+
+    api = make_api("mesh", rounds=6, federated_optimizer="SCAFFOLD",
+                   mesh_shape="4,2", collective_precision="int8",
+                   async_staging=False)
+    api.train_one_round(0)
+    api.train_one_round(1)
+    with JaxRuntimeAudit() as audit:
+        for r in (2, 3, 4):
+            api.train_one_round(r)
+    assert audit.compilations == 0, (
+        f"steady-state 2-D rounds recompiled {audit.compilations}x: "
+        f"{audit.compiled}")
+
+
+def test_2d_fused_block_compiles_once():
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+
+    api = make_api("mesh", rounds=12, federated_optimizer="SCAFFOLD",
+                   mesh_shape="4,2", round_block=4, async_staging=False)
+    api.train_block(0)
+    api.train_block(4)
+    with JaxRuntimeAudit() as audit:
+        api.train_block(8)
+    assert audit.compilations == 0, (
+        f"steady-state 2-D block recompiled {audit.compilations}x: "
+        f"{audit.compiled}")
+
+
+# -- memory estimate ---------------------------------------------------------
+
+def test_mesh_state_memory_estimate_axis_division():
+    """The model-dependent terms divide by n_model_shards: at a fixed
+    8-chip count the 2-D layout's per-chip total is strictly below 1-D,
+    the broadcast params copy halves exactly, and the flat aux state
+    divides by c*m (layout-independent at fixed chips)."""
+    kw = dict(n_params=1e9, clients_per_round=8, algorithm="fedopt",
+              collective_precision="int8", param_bytes=2)
+    e1 = estimate_mesh_state_memory(MeshStateLayout(mesh_shape=(8, 1), **kw))
+    e2 = estimate_mesh_state_memory(MeshStateLayout(mesh_shape=(4, 2), **kw))
+    assert e2["total"] < e1["total"]
+    assert e2["params_bcast"] == pytest.approx(e1["params_bcast"] / 2)
+    assert e2["opt_state_flat"] == pytest.approx(e1["opt_state_flat"])
+    assert e2["ef_rows"] == pytest.approx(e1["ef_rows"] / 2)
+    # quantization adds the master/broadcast-EF slots + the EF rows
+    fp = estimate_mesh_state_memory(MeshStateLayout(
+        mesh_shape=(4, 2), **{**kw, "collective_precision": "fp32"}))
+    assert fp["ef_rows"] == 0.0
+    assert fp["opt_state_flat"] < e2["opt_state_flat"]
+
+
+def test_mesh_state_memory_estimate_acceptance_config():
+    """The ISSUE 6 acceptance config priced: the 1.075B BASELINE flagship
+    exceeds one v5e chip on the 1-D 8-chip layout but fits a 2-D
+    factorization of the SAME chips — and largest_runnable_params picks
+    it from the candidate list."""
+    budget = HBM_PER_CHIP["v5e"]
+    kw = dict(clients_per_round=8, algorithm="fedopt",
+              collective_precision="int8", param_bytes=2)
+    flagship = 1.075e9
+    assert not mesh_state_fits(MeshStateLayout(
+        n_params=flagship, mesh_shape=(8, 1), **kw), budget)
+    assert mesh_state_fits(MeshStateLayout(
+        n_params=flagship, mesh_shape=(2, 4), **kw), budget)
+    got = largest_runnable_params(
+        budget, (2, 4), [0.5e9, flagship, 3e9], **kw)
+    assert got == flagship
+    assert largest_runnable_params(1 * GIB, (2, 4), [flagship], **kw) == 0.0
+
+
+def test_flat_spec_matches_legacy_helpers():
+    """FlatSpec (the first-class flatten-concat-pad view) interoperates
+    bitwise with the legacy core.tree helpers all three consumers used to
+    re-derive — scatter, quantize, checkpoint paths now share it."""
+    tree = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((5,), jnp.bfloat16)}
+    spec = FlatSpec.of(tree, multiple=8)
+    assert spec.n_params == 17
+    assert spec.padded_size == 24
+    assert spec.chunk_size == 3
+    vec = spec.flatten(tree)
+    np.testing.assert_array_equal(
+        np.asarray(vec), np.asarray(tree_util.tree_flatten_padded(tree, 8)))
+    back = spec.unflatten(vec)
+    assert back["b"].dtype == jnp.bfloat16
+    assert_tree_close(back, tree, atol=0, rtol=0)
+    np.testing.assert_array_equal(
+        np.asarray(spec.chunk(vec, 1, 8)),
+        np.asarray(tree_util.flat_chunk(vec, 1, 8)))

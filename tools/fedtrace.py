@@ -173,6 +173,15 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         if cb:
             out["collective_bytes_per_round"] = round(sum(cb) / len(cb), 1)
             out["collective_bytes_total"] = round(sum(cb), 1)
+        # per-mesh-axis split (docs/MESH_2D.md): merge/broadcast payload on
+        # the ``client`` axis vs model-parallel traffic on ``model`` (only
+        # 2-D ``mesh_shape`` layouts report a nonzero model share)
+        for axis in ("client", "model"):
+            vals = [float(r[f"collective_bytes_{axis}"]) for r in recs
+                    if f"collective_bytes_{axis}" in r]
+            if vals:
+                out[f"collective_bytes_{axis}_per_round"] = round(
+                    sum(vals) / len(vals), 1)
         qe = [float(r["quant_error_norm"]) for r in recs
               if "quant_error_norm" in r]
         if qe:
@@ -206,9 +215,15 @@ def _render_summary(s: Dict[str, Any]) -> str:
              f"round wall-clock: {s['round_time_total_s']:.4f}s   "
              f"compiles: {s['compile_count']} ({s['compile_s']:.2f}s)"]
     if "collective_bytes_per_round" in s:
+        axis = ""
+        if "collective_bytes_client_per_round" in s:
+            axis = (f" (client "
+                    f"{s['collective_bytes_client_per_round']:.0f}"
+                    f" + model "
+                    f"{s.get('collective_bytes_model_per_round', 0.0):.0f})")
         lines.append(
             f"collective bytes/round: "
-            f"{s['collective_bytes_per_round']:.0f}   "
+            f"{s['collective_bytes_per_round']:.0f}{axis}   "
             f"quant error norm (last): "
             f"{s.get('quant_error_norm_last', 0.0):g}")
     lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")

@@ -34,6 +34,12 @@ records the LLM_SCALE row the 2-D layout unlocks: the largest model whose
 per-chip HBM estimate fits ``(4, 2)`` but exceeds one chip on the 1-D
 layout (``core/memory_estimate.py``), one json line.
 
+``python bench.py --population`` compares a P-member hyperparameter sweep
+run as ONE vmapped-population dispatch (``args.population_axes``,
+docs/PRIMITIVES.md) against P sequential single-config runs at P in
+{1, 4, 16} — total wall-clock (incl. per-config compile) and steady-state
+s/round-per-config, one json line.
+
 ``python bench.py --trace`` measures the fedtrace observability plane:
 steady-state s/round untraced vs. traced (acceptance: <5% overhead) plus the
 ``tools/fedtrace.py summarize`` per-phase round breakdown folded into the
@@ -615,6 +621,104 @@ def bench_round_fusion(rounds: int | None = None,
             round(dt, 5)
     out["fused_speedup"] = round(
         out["unfused_s_per_round"] / out["fused_s_per_round"], 3)
+    return out
+
+
+# -- vmapped experiment populations (--population) ---------------------------
+def bench_population(rounds: int | None = None,
+                     clients_per_round: int | None = None,
+                     sizes=(1, 4, 16)) -> dict:
+    """--population: a whole hyperparameter sweep as ONE fused dispatch
+    (``args.population_axes``, docs/PRIMITIVES.md) vs the same sweep as P
+    sequential runs, on the 256-client MNIST-LR config.
+
+    For each P the population path builds ONE api whose round is the
+    ``vmap``-over-members program (one compile, one staging stream) and
+    times a full cold run — construction + compile + ``timed_rounds``
+    rounds; the sequential path builds P single-config apis (one per
+    member's client_lr) and runs each the same way, summing their
+    wall-clocks.  Total wall-clock is the honest comparison: the per-config
+    compile and staging the population amortizes IS the cost a sweep pays.
+    Steady-state s/round-per-config is also reported (compile excluded).
+    FEDML_POPULATION_QUICK=1 shrinks the cohort + sizes for smoke tests."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    quick = os.environ.get("FEDML_POPULATION_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (3 if quick else ROUNDS_TIMED)
+    sizes = (1, 2) if quick else tuple(sizes)
+    out = {"clients_per_round": cpr, "rounds": timed_rounds,
+           "sizes": list(sizes), "quick": quick}
+
+    def member_lrs(p):
+        # distinct member configs: a client-lr grid around the default
+        return [round(0.02 + 0.03 * i / max(p - 1, 1), 5) for i in range(p)]
+
+    def make_api(axes, lr=0.03):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total, client_num_per_round=cpr,
+            comm_round=10 ** 6, epochs=1, batch_size=BATCH,
+            learning_rate=lr, partition_method="homo",
+            frequency_of_the_test=10 ** 9, random_seed=0)
+        if axes is not None:
+            args.update(population_axes=axes)
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        return FedAvgAPI(args, None, dataset, model, client_mode="vmap")
+
+    def cold_run(axes, lr=0.03):
+        """Construction + compile + timed_rounds rounds, wall-clock."""
+        t0 = time.time()
+        api = make_api(axes, lr)
+        for r in range(timed_rounds):
+            api.train_one_round(r)
+        _readback(api.state.global_params)
+        return time.time() - t0, api
+
+    rtt = measure_rtt() if not quick else 0.0
+    # one throwaway cold run so process-wide first-touch costs (data gen,
+    # import, XLA warmup) don't land on whichever variant runs first
+    warm_s, warm_api = cold_run(None)
+    out["warmup_s"] = round(warm_s, 3)
+    del warm_api
+    for p in sizes:
+        lrs = member_lrs(p)
+        # population: ONE api, one compiled vmapped round for all members
+        pop_s, api = cold_run({"client_lr": lrs} if p > 1 else None)
+        rounds_done = [timed_rounds]
+
+        def run_rounds(n):
+            for _ in range(n):
+                api.train_one_round(rounds_done[0])
+                rounds_done[0] += 1
+
+        steady = _timed_chain(run_rounds,
+                              lambda: _readback(api.state.global_params),
+                              min_total_s=0.5 if quick else 2.0,
+                              n0=timed_rounds, rtt=rtt)
+        # sequential: P fresh apis, one per member config — each pays its
+        # own construction, compile and staging stream
+        seq_s = 0.0
+        for lr in lrs:
+            dt, seq_api = cold_run(None, lr=lr)
+            seq_s += dt
+            del seq_api
+        out[f"p{p}_pop_wallclock_s"] = round(pop_s, 3)
+        out[f"p{p}_seq_wallclock_s"] = round(seq_s, 3)
+        out[f"p{p}_pop_vs_seq"] = round(pop_s / seq_s, 3)
+        out[f"p{p}_steady_s_per_round"] = round(steady, 5)
+        out[f"p{p}_steady_s_per_round_per_config"] = round(steady / p, 5)
+        del api
+    largest = max(sizes)
+    out["value_pop_vs_seq_p%d" % largest] = out[f"p{largest}_pop_vs_seq"]
     return out
 
 
@@ -1246,6 +1350,20 @@ def main():
             "value": result["trace_overhead_pct"],
             "unit": "pct_overhead_traced_vs_untraced",
             "vs_baseline": None,
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--population" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_population()
+        largest = max(result["sizes"])
+        result.update({
+            "metric": "population_vmap_vs_sequential_sweep",
+            "value": result[f"p{largest}_pop_wallclock_s"],
+            "unit": "s_total_wallclock",
+            "vs_baseline": result[f"p{largest}_pop_vs_seq"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

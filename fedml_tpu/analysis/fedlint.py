@@ -272,7 +272,9 @@ def index_module(path: str, source: str) -> Optional[ModuleIndex]:
         if fn in ("pmap", "shard_map", "xmap", "vmap", "make_mesh",
                   "Mesh", "AbstractMesh"):
             for kw in node.keywords:
-                if kw.arg in ("axis_name", "axis_names"):
+                # spmd_axis_name: vmap's named batch axis over a mesh —
+                # collectives inside a vmapped round may reduce over it
+                if kw.arg in ("axis_name", "axis_names", "spmd_axis_name"):
                     note_axes(_const_value(kw.value, constants))
     return ModuleIndex(path=path, tree=tree, lines=source.splitlines(),
                        constants=constants, imports=imports,
@@ -559,8 +561,105 @@ def _rng_uses_in(call: ast.Call, key: str) -> Optional[str]:
     return "opaque"
 
 
+def _fn_params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs} | \
+        ({a.vararg.arg} if a.vararg else set()) | \
+        ({a.kwarg.arg} if a.kwarg else set())
+
+
+def _param_tainted_names(fn: ast.AST) -> Set[str]:
+    """Names inside ``fn`` whose values (may) derive from its parameters —
+    a two-pass fixpoint over simple assignments, enough for the
+    ``k = fold_in(key, i); sample(k)`` idiom."""
+    tainted = set(_fn_params(fn))
+    for _ in range(2):
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                val = getattr(stmt, "value", None)
+                if val is None:
+                    continue
+                used = {n.id for n in ast.walk(val)
+                        if isinstance(n, ast.Name)}
+                if used & tainted:
+                    tainted |= _stmt_assigned_names(stmt)
+    return tainted
+
+
+def _check_vmap_member_keys(mv: ModuleView, out: List[Finding]):
+    """Population/member pattern (docs/PRIMITIVES.md): a function mapped by
+    ``jax.vmap`` that consumes a PRNG key NOT derived from any of its own
+    (mapped) parameters gives every member the SAME stream — e.g.
+    ``vmap(lambda i: fold_in(key, 0))`` or sampling a closed-over key.
+    ``fold_in(key, member_idx)`` is the clean form."""
+    sev = RULES["rng-key-reuse"].severity
+    local: Dict[str, ast.AST] = {}
+    for node in ast.walk(mv.mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local[node.name] = node
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Lambda):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    local[t.id] = node.value
+
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call) or \
+                last_attr(node.func) != "vmap" or not node.args:
+            continue
+        mapped = node.args[0]
+        if isinstance(mapped, ast.Name):
+            mapped = local.get(mapped.id)
+        if not isinstance(mapped, (ast.Lambda, ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+            continue
+        tainted = _param_tainted_names(mapped)
+        for sub in ast.walk(mapped):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = last_attr(sub.func)
+            d = dotted_name(sub.func) or ""
+            if not ("random" in d or f in _RNG_DERIVERS
+                    | _RNG_LOCAL_PRODUCERS):
+                continue
+            if f in ("PRNGKey", "key"):
+                if sub.args and all(isinstance(a, ast.Constant)
+                                    for a in sub.args):
+                    out.append(Finding(
+                        "rng-key-reuse", sev, mv.mod.path, sub.lineno,
+                        sub.col_offset,
+                        "PRNGKey with a constant seed inside a vmapped "
+                        "function — every member draws the SAME stream; "
+                        "fold_in the member index instead"))
+                continue
+            if f in _RNG_DERIVERS | _RNG_LOCAL_PRODUCERS:
+                # a deriver is member-distinct if ANY argument depends on
+                # the mapped params: fold_in(key, member_idx) is the clean
+                # form even though the key itself is closed over
+                exprs = list(sub.args) + [kw.value for kw in sub.keywords]
+            else:
+                # a sampler is member-distinct only through its KEY
+                exprs = [sub.args[0]] if sub.args else []
+                for kw in sub.keywords:
+                    if kw.arg == "key":
+                        exprs = [kw.value]
+            if not exprs:
+                continue
+            names = {n.id for e in exprs for n in ast.walk(e)
+                     if isinstance(n, ast.Name)}
+            if names and not (names & tainted):
+                out.append(Finding(
+                    "rng-key-reuse", sev, mv.mod.path, sub.lineno,
+                    sub.col_offset,
+                    f"{f}() consumes a member-independent key inside a "
+                    "vmapped function — every member draws the SAME "
+                    "stream; derive it from the mapped argument "
+                    "(fold_in(key, member_idx))"))
+
+
 def check_rng_key_reuse(mv: ModuleView, out: List[Finding]):
     sev = RULES["rng-key-reuse"].severity
+    _check_vmap_member_keys(mv, out)
 
     # (b) PRNGKey(...) built inside a loop body
     for node in ast.walk(mv.mod.tree):

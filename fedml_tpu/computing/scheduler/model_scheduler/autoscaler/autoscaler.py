@@ -45,11 +45,8 @@ class Autoscaler:
         now = time.time()
         window = policy.ewm_mins * 60.0
         if policy.metric == "ewm_latency":
-            pts = [(t, l) for t, l in
-                   zip(self.cache.request_timestamps(endpoint),
-                       [l for _, l in self.cache._metrics[endpoint]])
-                   if now - t <= window]
-            values = [l for _, l in pts]
+            values = [l for t, l in self.cache.request_records(endpoint)
+                      if now - t <= window]
         else:  # qps per 1s bucket
             ts = [t for t in self.cache.request_timestamps(endpoint)
                   if now - t <= window]

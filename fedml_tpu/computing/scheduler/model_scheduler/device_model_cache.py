@@ -97,6 +97,13 @@ class FedMLModelCache:
     def request_timestamps(self, endpoint: str) -> List[float]:
         return [t for t, _ in self._metrics[endpoint]]
 
+    def request_records(self, endpoint: str) -> List[Tuple[float, float]]:
+        """(timestamp, latency_s) pairs — the series the EWM-latency
+        autoscaler policy consumes (it needs latencies WITH their times to
+        window them; ``request_timestamps``/``avg_latency`` each drop one
+        half)."""
+        return list(self._metrics[endpoint])
+
     def clear(self, endpoint: Optional[str] = None) -> None:
         with self._mtx:
             if endpoint is None:

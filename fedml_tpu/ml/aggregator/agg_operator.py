@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ...core import federated
 from ...core import tree as tree_util
 
 
@@ -115,15 +116,33 @@ def replicated_ef_state_map(state: ServerState, repl, shard) -> ServerState:
     return marked
 
 class ServerOptimizer:
-    """Builds jittable server-update functions per algorithm."""
+    """Builds jittable server-update functions per algorithm.
+
+    Stage-1 aggregates are declared per algorithm in the
+    ``core.federated`` spec registry (:attr:`spec`) and built by
+    :func:`core.federated.build_aggregates` with each engine's reducer;
+    stage-2 transitions live here for the built-in zoo (they touch
+    layout-specific optax state) or in ``spec.update`` for registered
+    algorithms like q-FedAvg.  Every transition accepts an optional
+    :class:`~fedml_tpu.core.federated.HParams` whose swept fields
+    (``server_lr``, ``feddyn_alpha``...) override the static args values
+    as traced scalars — the population vmap path (docs/PRIMITIVES.md)."""
 
     def __init__(self, args):
         self.args = args
         self.algorithm = str(getattr(args, "federated_optimizer", "FedAvg")).lower()
+        self.spec = (federated.get_spec(self.algorithm)
+                     if federated.has_spec(self.algorithm)
+                     else federated.get_spec("fedavg"))
         self.server_lr = float(getattr(args, "server_lr", 1.0))
         self.server_momentum = float(getattr(args, "server_momentum", 0.9))
         self.feddyn_alpha = float(getattr(args, "feddyn_alpha", 0.01))
         self.total_clients = int(getattr(args, "client_num_in_total", 10))
+        # q-FedAvg (core/federated.py QFEDAVG spec): fairness exponent and
+        # the Lipschitz-estimate lr its Δ/h terms are scaled by
+        self.qfed_q = float(getattr(args, "qfed_q", 1.0))
+        self.qfed_lr = float(getattr(args, "qfed_lr", 0.0)
+                             or getattr(args, "learning_rate", 0.03))
         opt_name = str(getattr(args, "server_optimizer", "adam")).lower()
         if self.algorithm in ("fedopt", "fedopt_seq"):
             if opt_name == "sgd":
@@ -203,41 +222,24 @@ class ServerOptimizer:
         return st
 
     # -- stage 1: cross-client reductions ---------------------------------
-    # Computed either over a stacked client axis (sp/vmap engines) or inside
-    # shard_map where each reduction becomes a `psum` over the `client` mesh
-    # axis (mesh engine) — the TPU-native form of the reference's pre-scaled
-    # `dist.reduce(SUM)` (simulation/nccl/base_framework/common.py:196-228).
+    # Declared per algorithm in core/federated.py (AlgorithmSpec) and built
+    # by build_aggregates with this engine's reducer: a stacked tensordot
+    # here, a `psum`/`psum_scatter` over the `client` mesh axis inside the
+    # mesh engine's shard_map — the TPU-native form of the reference's
+    # pre-scaled `dist.reduce(SUM)` (nccl/base_framework/common.py:196-228).
     def compute_aggregates(self, state: ServerState, client_params_stacked: Any,
-                           weights: jnp.ndarray, aux: Optional[dict] = None
-                           ) -> dict:
+                           weights: jnp.ndarray, aux: Optional[dict] = None,
+                           hp=None) -> dict:
         """aux (stacked over clients): "delta_c" (SCAFFOLD), "tau"+"grad_sum"
-        (FedNova), "grad_sum" (Mime/FedSGD)."""
+        (FedNova), "grad_sum" (Mime/FedSGD), "loss" (q-FedAvg)."""
+        import types
         aux = aux or {}
-        alg = self.algorithm
-        agg = {
-            "avg_params": tree_util.stacked_weighted_average(
-                client_params_stacked, weights),
-            # count REAL clients only: padded zero-weight rows (bucketed /
-            # mesh-padded cohorts) must not inflate SCAFFOLD's and FedDyn's
-            # |S|/N fraction (the mesh path already counted w > 0)
-            "n_sampled": jnp.sum((weights > 0).astype(jnp.float32)),
-        }
-        if alg == "scaffold":
-            agg["mean_delta_c"] = tree_util.stacked_weighted_average(
-                aux["delta_c"], jnp.ones_like(weights))
-        if alg == "fednova":
-            tau = aux["tau"]
-            p = weights / jnp.sum(weights)
-            deltas = jax.tree_util.tree_map(
-                lambda yi, x: (x[None] - yi) / jnp.maximum(
-                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
-                client_params_stacked, state.global_params)
-            agg["nova_d"] = tree_util.stacked_weighted_average(deltas, weights)
-            agg["tau_eff"] = jnp.sum(p * tau)
-        if alg in ("mime", "fedsgd"):
-            agg["avg_grad"] = tree_util.stacked_weighted_average(
-                aux["grad_sum"], weights)
-        return agg
+        outs = types.SimpleNamespace(
+            params=client_params_stacked, delta_c=aux.get("delta_c"),
+            tau=aux.get("tau"), grad_sum=aux.get("grad_sum"),
+            loss=aux.get("loss"))
+        return federated.build_aggregates(self.spec, federated.StackedReducer(),
+                                          self, state, outs, weights, hp)
 
     def merge_aggregates(self, aggs, total_ws) -> dict:
         """Combine per-bucket aggregates (see
@@ -259,9 +261,20 @@ class ServerOptimizer:
                 "n_sampled": sum(a["n_sampled"] for a in aggs)}
 
     # -- stage 2: server state transition (replicated) --------------------
-    def update_from_aggregates(self, state: ServerState, agg: dict
-                               ) -> ServerState:
+    def update_from_aggregates(self, state: ServerState, agg: dict,
+                               hp=None) -> ServerState:
+        """``hp`` (core.federated.HParams) overrides the static server
+        hyperparameters with traced scalars — the population vmap sweeps
+        them per member; ``None`` keeps the historical constants."""
         alg = self.algorithm
+
+        if self.spec.update is not None:
+            # registered spec (e.g. q-FedAvg): one pure elementwise
+            # transition shared with the scatter path
+            new_params, fields = self.spec.update(state.global_params, agg,
+                                                  hp, self)
+            return state.replace(round_idx=state.round_idx + 1,
+                                 global_params=new_params, **fields)
         avg = agg["avg_params"]
 
         if alg in ("fedopt", "fedopt_seq"):
@@ -270,14 +283,18 @@ class ServerOptimizer:
             pseudo_grad = tree_util.tree_sub(state.global_params, avg)
             updates, new_opt = self.server_tx.update(
                 pseudo_grad, state.opt_state, state.global_params)
+            ratio = federated.lr_ratio(hp, "server_lr", self.server_lr)
+            if ratio is not None:
+                updates = tree_util.tree_scale(updates, ratio)
             new_params = optax.apply_updates(state.global_params, updates)
             return state.replace(round_idx=state.round_idx + 1,
                                  global_params=new_params, opt_state=new_opt)
 
         if alg == "scaffold":
             # x ← x + lr_g·(avg − x);  c ← c + (|S|/N)·mean(Δc)
+            lr = federated.resolve(hp, "server_lr", self.server_lr)
             new_params = tree_util.tree_axpy(
-                self.server_lr, tree_util.tree_sub(avg, state.global_params),
+                lr, tree_util.tree_sub(avg, state.global_params),
                 state.global_params)
             frac = agg["n_sampled"] / self.total_clients
             new_c = tree_util.tree_axpy(frac, agg["mean_delta_c"], state.c_server)
@@ -293,10 +310,11 @@ class ServerOptimizer:
 
         if alg == "feddyn":
             # h ← h − α·(avg − x)·|S|/N ; x ← avg − h/α
+            alpha = federated.resolve(hp, "feddyn_alpha", self.feddyn_alpha)
             frac = agg["n_sampled"] / self.total_clients
             diff = tree_util.tree_sub(avg, state.global_params)
-            new_h = tree_util.tree_axpy(-self.feddyn_alpha * frac, diff, state.h)
-            new_params = tree_util.tree_axpy(-1.0 / self.feddyn_alpha, new_h, avg)
+            new_h = tree_util.tree_axpy(-alpha * frac, diff, state.h)
+            new_params = tree_util.tree_axpy(-1.0 / alpha, new_h, avg)
             return state.replace(round_idx=state.round_idx + 1,
                                  global_params=new_params, h=new_h)
 
@@ -310,7 +328,8 @@ class ServerOptimizer:
                                  global_params=avg, momentum=new_mom)
 
         if alg == "fedsgd":
-            new_params = tree_util.tree_axpy(-self.server_lr, agg["avg_grad"],
+            lr = federated.resolve(hp, "server_lr", self.server_lr)
+            new_params = tree_util.tree_axpy(-lr, agg["avg_grad"],
                                              state.global_params)
             return state.replace(round_idx=state.round_idx + 1,
                                  global_params=new_params)
@@ -320,7 +339,7 @@ class ServerOptimizer:
 
     # -- stage 2 on a flat parameter SHARD (scatter mode) ------------------
     def update_shard(self, state: ServerState, gshard: jnp.ndarray,
-                     agg: dict) -> Tuple[jnp.ndarray, dict]:
+                     agg: dict, hp=None) -> Tuple[jnp.ndarray, dict]:
         """Same state transitions as :meth:`update_from_aggregates`, but on
         this chip's contiguous flat chunk of the model: ``gshard`` is the
         current global params' chunk, ``agg`` values are reduce-scattered
@@ -331,16 +350,25 @@ class ServerOptimizer:
         is |model|/n_shards FLOPs and HBM instead of the replicated path's
         N-way redundant full-model update."""
         alg = self.algorithm
+
+        if self.spec.update is not None:
+            # registered specs transition elementwise, so the same function
+            # runs on the flat chunk (tree_map treats an array as one leaf)
+            return self.spec.update(gshard, agg, hp, self)
         avg = agg["avg_params"]
 
         if alg in ("fedopt", "fedopt_seq"):
             pseudo_grad = gshard - avg
             updates, new_opt = self.server_tx.update(
                 pseudo_grad, state.opt_state, gshard)
+            ratio = federated.lr_ratio(hp, "server_lr", self.server_lr)
+            if ratio is not None:
+                updates = tree_util.tree_scale(updates, ratio)
             return optax.apply_updates(gshard, updates), {"opt_state": new_opt}
 
         if alg == "scaffold":
-            new_g = gshard + self.server_lr * (avg - gshard)
+            lr = federated.resolve(hp, "server_lr", self.server_lr)
+            new_g = gshard + lr * (avg - gshard)
             frac = agg["n_sampled"] / self.total_clients
             new_c = state.c_server + frac * agg["mean_delta_c"]
             return new_g, {"c_server": new_c}
@@ -349,9 +377,10 @@ class ServerOptimizer:
             return gshard - agg["tau_eff"] * agg["nova_d"], {}
 
         if alg == "feddyn":
+            alpha = federated.resolve(hp, "feddyn_alpha", self.feddyn_alpha)
             frac = agg["n_sampled"] / self.total_clients
-            new_h = state.h - self.feddyn_alpha * frac * (avg - gshard)
-            return avg - new_h / self.feddyn_alpha, {"h": new_h}
+            new_h = state.h - alpha * frac * (avg - gshard)
+            return avg - new_h / alpha, {"h": new_h}
 
         if alg == "mime":
             b = self.server_momentum
@@ -359,12 +388,15 @@ class ServerOptimizer:
             return avg, {"momentum": new_mom}
 
         if alg == "fedsgd":
-            return gshard - self.server_lr * agg["avg_grad"], {}
+            lr = federated.resolve(hp, "server_lr", self.server_lr)
+            return gshard - lr * agg["avg_grad"], {}
 
         return avg, {}
 
     def update(self, state: ServerState, client_params_stacked: Any,
-               weights: jnp.ndarray, aux: Optional[dict] = None) -> ServerState:
+               weights: jnp.ndarray, aux: Optional[dict] = None,
+               hp=None) -> ServerState:
         """One server round step over stacked client outputs; jit/pjit-safe."""
-        agg = self.compute_aggregates(state, client_params_stacked, weights, aux)
-        return self.update_from_aggregates(state, agg)
+        agg = self.compute_aggregates(state, client_params_stacked, weights,
+                                      aux, hp)
+        return self.update_from_aggregates(state, agg, hp)

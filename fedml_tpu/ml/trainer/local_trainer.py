@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import optax
 
 from ...core import tree as tree_util
+from ...core.federated import lr_ratio, resolve
 from ...core.state import make_client_optimizer
 from ...models.base import FlaxModel
 
@@ -48,6 +49,10 @@ class ServerCtx:
     global_params: Any = None
     c_server: Any = None          # SCAFFOLD server control variate
     server_momentum: Any = None   # Mime server momentum
+    #: trace-time-dynamic knobs (core.federated.HParams): swept fields
+    #: (client_lr, prox_mu, feddyn_alpha...) arrive as traced scalars when
+    #: a population vmaps the round; None keeps the static args constants
+    hparams: Any = None
 
 
 @flax.struct.dataclass
@@ -116,6 +121,7 @@ class LocalTrainer:
         # itself keys retraces on argument shapes, so one cached callable
         # suffices for any number of distinct eval shapes
         self._eval_run = None
+        self._eval_members_run = None
 
     # -- loss --------------------------------------------------------------
     def loss_fn(self, params, batch, rng, ctx: ServerCtx, client_state=None):
@@ -131,11 +137,13 @@ class LocalTrainer:
             loss = cross_entropy_loss(logits, y)
             acc = accuracy(logits, y)
         if self.algorithm == "fedprox" and ctx.global_params is not None:
+            mu = resolve(ctx.hparams, "prox_mu", self.prox_mu)
             diff = tree_util.tree_sub(params, ctx.global_params)
-            loss = loss + 0.5 * self.prox_mu * tree_util.tree_sq_norm(diff)
+            loss = loss + 0.5 * mu * tree_util.tree_sq_norm(diff)
         if self.algorithm == "feddyn" and ctx.global_params is not None:
+            alpha = resolve(ctx.hparams, "feddyn_alpha", self.feddyn_alpha)
             diff = tree_util.tree_sub(params, ctx.global_params)
-            loss = loss + 0.5 * self.feddyn_alpha * tree_util.tree_sq_norm(diff)
+            loss = loss + 0.5 * alpha * tree_util.tree_sq_norm(diff)
             if client_state is not None:
                 loss = loss - tree_util.tree_dot(client_state, params)
         return loss, acc
@@ -160,6 +168,11 @@ class LocalTrainer:
             step_grads = jax.tree_util.tree_map(
                 lambda g, m: (1 - b) * g + b * m, grads, ctx.server_momentum)
         updates, new_opt = self.tx.update(step_grads, opt_state, params)
+        # swept client lr (population vmap): every client chain ends in
+        # scale(-lr), so post-scaling by swept/static is the swept-lr step
+        ratio = lr_ratio(ctx.hparams, "client_lr", self.lr)
+        if ratio is not None:
+            updates = tree_util.tree_scale(updates, ratio)
         new_params = optax.apply_updates(params, updates)
         # a padded step must be a TRUE no-op: weight decay / momentum /
         # optimizer counters all frozen, not just the gradient zeroed
@@ -200,16 +213,19 @@ class LocalTrainer:
             if self.algorithm == "scaffold":
                 # c_i⁺ = c_i − c + (x − y_i)/(K·lr)  (SCAFFOLD eq. 4, option II)
                 K = jnp.maximum(nsteps, 1.0)
+                lr = resolve(ctx.hparams, "client_lr", self.lr)
                 diff = tree_util.tree_sub(global_params, params)
                 c_plus = jax.tree_util.tree_map(
-                    lambda cc, cs, d: cc - cs + d / (K * self.lr),
+                    lambda cc, cs, d: cc - cs + d / (K * lr),
                     client_state, ctx.c_server, diff)
                 delta_c = tree_util.tree_sub(c_plus, client_state)
                 new_client_state = c_plus
             elif self.algorithm == "feddyn":
                 # ∇̂_i⁺ = ∇̂_i − α·(θ_i − θ_global)  (FedDyn client residual)
+                alpha = resolve(ctx.hparams, "feddyn_alpha",
+                                self.feddyn_alpha)
                 new_client_state = jax.tree_util.tree_map(
-                    lambda g, p, gp: g - self.feddyn_alpha * (p - gp),
+                    lambda g, p, gp: g - alpha * (p - gp),
                     client_state, params, global_params)
 
             tau = nsteps if self.algorithm == "fednova" else None
@@ -259,19 +275,39 @@ class LocalTrainer:
         (simulation/sp/fedavg/fedavg_api.py:176) without its re-tracing.
         """
         if self._eval_run is None:
-            eval_step = self.make_eval_step()
-
-            @jax.jit
-            def run(params, xb, yb, mb):
-                def body(carry, batch):
-                    l, c, n = eval_step(params, *batch)
-                    return (carry[0] + l, carry[1] + c, carry[2] + n), None
-                (l, c, n), _ = jax.lax.scan(
-                    body, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(())),
-                    (xb, yb, mb))
-                return l / n, c / n
-
-            self._eval_run = run
+            self._eval_run = jax.jit(self._make_eval_run())
         loss, acc = self._eval_run(params, jnp.asarray(xb), jnp.asarray(yb),
                                    jnp.asarray(mb))
         return float(loss), float(acc)
+
+    def _make_eval_run(self):
+        """Pure (params, xb, yb, mb) -> (loss, acc) over pre-batched data;
+        the unit :meth:`evaluate` jits and :meth:`evaluate_members` vmaps."""
+        eval_step = self.make_eval_step()
+
+        def run(params, xb, yb, mb):
+            def body(carry, batch):
+                l, c, n = eval_step(params, *batch)
+                return (carry[0] + l, carry[1] + c, carry[2] + n), None
+            (l, c, n), _ = jax.lax.scan(
+                body, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(())),
+                (xb, yb, mb))
+            return l / n, c / n
+
+        return run
+
+    def _build_members_run(self):
+        return jax.jit(jax.vmap(self._make_eval_run(),
+                                in_axes=(0, None, None, None)))
+
+    def evaluate_members(self, params_stacked, xb, yb, mb):
+        """Population eval: the member-stacked params scored against one
+        shared test set in a single vmapped dispatch.  Returns host
+        ``(P,)`` loss/accuracy arrays."""
+        import numpy as np
+        if self._eval_members_run is None:
+            self._eval_members_run = self._build_members_run()
+        loss, acc = self._eval_members_run(
+            params_stacked, jnp.asarray(xb), jnp.asarray(yb),
+            jnp.asarray(mb))
+        return np.asarray(loss), np.asarray(acc)

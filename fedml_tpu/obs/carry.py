@@ -147,6 +147,51 @@ def obs_host(carry: ObsCarry) -> Dict[str, float]:
                 np.asarray(carry.collective_bytes_model))
 
 
+def obs_population_rows(carry: ObsCarry, losses) -> List[Dict[str, float]]:
+    """Materialize a population-stacked ObsCarry into per-round rows.
+
+    ``carry`` leaves are ``(P,)`` (one round, P members) or ``(P, K)``
+    (fused block); ``losses`` matches.  Float fields that are identical
+    across members (steps/clients/examples, the static byte models)
+    collapse trivially under the member mean; ``update_norm`` /
+    ``quant_error_norm`` genuinely differ per member and report the mean.
+    Each row additionally carries the member count and the best / worst /
+    mean member loss — the population-sweep signal ``fedtrace summarize``
+    surfaces (docs/PRIMITIVES.md)."""
+    losses = np.asarray(losses)
+    fused = losses.ndim == 2   # (P, K) block leaves vs (P,) single round
+    if not fused:
+        losses = losses[:, None]
+    p, k = losses.shape
+
+    def col(a, j):
+        a = np.asarray(a)
+        if fused:   # (P, K, ...) -> this round's (P, ...) slice
+            a = a[:, j]
+        return a.mean(axis=0)
+
+    rows = []
+    for j in range(k):
+        row = _row(col(carry.steps, j), col(carry.clients, j),
+                   col(carry.examples, j), col(carry.update_norm, j),
+                   col(carry.phase_flops, j), col(carry.collective_bytes, j),
+                   col(carry.quant_error_norm, j),
+                   col(carry.collective_bytes_client, j),
+                   col(carry.collective_bytes_model, j))
+        row["members"] = float(p)
+        row["member_loss_best"] = float(losses[:, j].min())
+        row["member_loss_worst"] = float(losses[:, j].max())
+        row["member_loss_mean"] = float(losses[:, j].mean())
+        # byte models are trace-time statics shared by every member (one
+        # compiled program); a nonzero spread means members somehow traced
+        # different programs — fedtrace pins this at 0
+        cb = np.asarray(carry.collective_bytes)
+        cb = cb[:, j] if fused else cb
+        row["member_bytes_spread"] = float(cb.max() - cb.min())
+        rows.append(row)
+    return rows
+
+
 def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
     """Materialize a block-stacked ``(K,)`` ObsCarry into K row dicts
     (one host copy per field, then pure indexing)."""

@@ -11,6 +11,14 @@ within each client's batches).  The whole round — local SGD for all
 clients on all chips + global merge + server optimizer step — is ONE
 ``jit(shard_map(...))`` dispatch.
 
+WHICH aggregates the merge computes is no longer written here: both
+merge bodies build them from the algorithm's declarative spec
+(``core/federated.py`` ``AlgorithmSpec`` + ``build_aggregates``) with
+this engine's reducers — ``PsumReducer`` for the replicated layout,
+``ScatterReducer`` for the reduce-scatter layout — so the SP engine and
+both mesh layouts share one definition of every algorithm
+(docs/PRIMITIVES.md; registered specs like q-FedAvg run here unchanged).
+
 The FedAvg merge + server update runs in one of two layouts
 (``args.update_sharding``):
 
@@ -47,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...core import federated
 from ...core import rng as rng_util
 from ...core import tree as tree_util
 from ...core.compression import blockscale
@@ -110,6 +119,7 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
     (:func:`make_mesh_block_fn`)."""
     local_train = trainer.make_local_train()
     alg = server_opt.algorithm
+    spec = server_opt.spec
     layout = MeshLayout(mesh)
     n_shards = layout.n_client_shards
     scatter = update_sharding == "scatter"
@@ -122,6 +132,10 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         raise ValueError("collective_precision needs a state_template "
                          "carrying the EF buffers (ServerOptimizer.init/"
                          "init_sharded with collective_precision set)")
+    if quantized and not spec.avg_params:
+        raise ValueError(
+            f"collective_precision={precision!r} quantizes the avg_params "
+            f"merge numerator, which the {alg!r} spec does not use")
     from ..round_engine import make_server_ctx
 
     use_ingather = gather and not sharded_data
@@ -192,14 +206,21 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
     def merge_replicated(state: ServerState, outs, w, qrow):
         # merge + server update on this client shard's slice of the cohort
         # outputs (outs leaves arrive (c_local, ...) per the P(client)
-        # in-spec); runs manual over ``client``, auto over ``model``
+        # in-spec); runs manual over ``client``, auto over ``model``.
+        # Which aggregates exist is the algorithm's declarative spec
+        # (core/federated.py); HOW each reduces here is the PsumReducer
+        # (local weighted partials + psum per leaf).
         qrow = qrow[0]  # (1, key) in-spec slice -> this shard's base key
+        red = federated.PsumReducer(CLIENT_AXIS)
         quant_err_sq = None
         if quantized:
             # EF-quantized merge numerator: each shard adds its residual
             # row, quantizes its LOCAL flat contribution to the average,
             # and the all-reduce moves the low-precision payload; the
-            # residual goes back into this shard's ef_num row
+            # residual goes back into this shard's ef_num row.  Auxiliary
+            # spec aggregates stay full-precision.
+            agg = federated.build_aggregates(spec, red, server_opt, state,
+                                             outs, w, include_avg=False)
             num = jax.tree_util.tree_map(
                 lambda l: jnp.tensordot(w, l.astype(jnp.float32), axes=1),
                 outs.params)
@@ -210,29 +231,11 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             new_ef_num = (v - deq)[None]
             summed = jax.lax.psum(coll.wire_cast(deq, precision),
                                   CLIENT_AXIS).astype(jnp.float32)
-            avg = tree_util.tree_unflatten_1d(summed, state.global_params)
+            agg["avg_params"] = tree_util.tree_unflatten_1d(
+                summed, state.global_params)
         else:
-            avg = coll.psum_wavg(outs.params, w, CLIENT_AXIS)
-        agg = {
-            "avg_params": avg,
-            "n_sampled": jax.lax.psum(
-                jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
-        }
-        if alg == "scaffold":
-            real = (w > 0).astype(jnp.float32)
-            agg["mean_delta_c"] = coll.psum_wavg(outs.delta_c, real,
-                                                 CLIENT_AXIS)
-        if alg == "fednova":
-            tau = outs.tau
-            deltas = jax.tree_util.tree_map(
-                lambda yi, gx: (gx[None] - yi) / jnp.maximum(
-                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
-                outs.params, state.global_params)
-            agg["nova_d"] = coll.psum_wavg(deltas, w, CLIENT_AXIS)
-            wsum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-            agg["tau_eff"] = jax.lax.psum(jnp.sum(w * tau), CLIENT_AXIS) / wsum
-        if alg in ("mime", "fedsgd"):
-            agg["avg_grad"] = coll.psum_wavg(outs.grad_sum, w, CLIENT_AXIS)
+            agg = federated.build_aggregates(spec, red, server_opt, state,
+                                             outs, w)
 
         new_state = server_opt.update_from_aggregates(state, agg)
         if quantized:
@@ -240,20 +243,12 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         return new_state, raw_metrics(outs, w, quant_err_sq)
 
     def merge_scatter(state: ServerState, outs, w, qrow, gchunk):
+        # spec-declared aggregates through the ScatterReducer: tree
+        # aggregates flatten into ONE padded vector and reduce-scatter so
+        # each chip receives only its contiguous chunk of the cohort-summed
+        # numerator instead of the full all-reduced model
         qrow = qrow[0]  # (1, key) in-spec slice -> this shard's base key
-        den = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-
-        def scatter_wavg(stacked, ww, dd):
-            # local client-weighted partial sums per leaf, flattened into
-            # ONE padded vector, then reduce-scattered: each chip receives
-            # only its contiguous chunk of the cohort-summed numerator
-            # instead of the full all-reduced model
-            num = jax.tree_util.tree_map(
-                lambda l: jnp.tensordot(ww, l.astype(jnp.float32), axes=1),
-                stacked)
-            return jax.lax.psum_scatter(flat.flatten(num), CLIENT_AXIS,
-                                        scatter_dimension=0, tiled=True) / dd
-
+        red = federated.ScatterReducer(flat, CLIENT_AXIS)
         quant_err_sq = None
         if quantized:
             # EF-quantized reduce-scatter of the FedAvg numerator: the
@@ -262,6 +257,9 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             # param-delta units across rounds) plus this shard's residual
             # row, block-scaled/stochastically rounded, reduce-scattered
             # at the wire precision
+            agg = federated.build_aggregates(spec, red, server_opt, state,
+                                             outs, w, include_avg=False)
+            den = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
             num = jax.tree_util.tree_map(
                 lambda l: jnp.tensordot(w, l.astype(jnp.float32), axes=1),
                 outs.params)
@@ -269,30 +267,12 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             deq, quant_err_sq = coll.quantize_ef(
                 v, precision, coll.slot_key(qrow, 0), quant_block)
             new_ef_num = (v - deq)[None]
-            avg_chunk = jax.lax.psum_scatter(
+            agg["avg_params"] = jax.lax.psum_scatter(
                 coll.wire_cast(deq, precision), CLIENT_AXIS,
                 scatter_dimension=0, tiled=True).astype(jnp.float32)
         else:
-            avg_chunk = scatter_wavg(outs.params, w, den)
-        agg = {
-            "avg_params": avg_chunk,
-            "n_sampled": jax.lax.psum(
-                jnp.sum((w > 0).astype(jnp.float32)), CLIENT_AXIS),
-        }
-        if alg == "scaffold":
-            real = (w > 0).astype(jnp.float32)
-            real_den = jax.lax.psum(jnp.sum(real), CLIENT_AXIS)
-            agg["mean_delta_c"] = scatter_wavg(outs.delta_c, real, real_den)
-        if alg == "fednova":
-            tau = outs.tau
-            deltas = jax.tree_util.tree_map(
-                lambda yi, gx: (gx[None] - yi) / jnp.maximum(
-                    tau.reshape((-1,) + (1,) * (yi.ndim - 1)), 1.0),
-                outs.params, state.global_params)
-            agg["nova_d"] = scatter_wavg(deltas, w, den)
-            agg["tau_eff"] = jax.lax.psum(jnp.sum(w * tau), CLIENT_AXIS) / den
-        if alg in ("mime", "fedsgd"):
-            agg["avg_grad"] = scatter_wavg(outs.grad_sum, w, den)
+            agg = federated.build_aggregates(spec, red, server_opt, state,
+                                             outs, w)
 
         # this chip's chunk of the current global params, then the sharded
         # stage-2 transition on 1/n_shards of the model.  With quantized

@@ -17,6 +17,14 @@ server merge — is a single jitted function over a *cohort tensor*:
   over the ``client`` axis and merges with ``psum`` — the TPU-native form of
   the NCCL simulation's pre-scaled ``dist.reduce(SUM)``
   (``simulation/nccl/base_framework/common.py:196-228``).
+
+Since ISSUE 7 the round is COMPOSED, not hand-rolled: the primitives and
+per-algorithm aggregate specs live in ``core/federated.py``
+(``broadcast ∘ client_map ∘ weighted_reduce`` + ``AlgorithmSpec``,
+docs/PRIMITIVES.md), the round is a pure function of ``(state, cohort,
+HParams)``, and :func:`make_population_round_fn` /
+:func:`make_population_block_fn` vmap it over a stacked HParams batch so
+a P-member hyperparameter sweep executes as ONE compiled dispatch.
 """
 
 from __future__ import annotations
@@ -28,6 +36,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..core import federated
 from ..core import tree as tree_util
 from ..core.compression import blockscale
 from ..ml.aggregator.agg_operator import ServerOptimizer, ServerState
@@ -40,44 +49,29 @@ from ..obs.carry import OPT_FLOPS, round_obs
 QUANT_KEY_TAG = 0x5C41E
 
 
-def _client_body(local_train, server_opt: ServerOptimizer):
-    """Per-client closure: returns stacked-friendly outputs."""
-
-    def body(global_params, ctx, xb, yb, mask, rng, c_client):
-        out: ClientOut = local_train(global_params, xb, yb, mask, rng, ctx,
-                                     c_client)
-        return out
-
-    return body
-
-
-def make_server_ctx(trainer: LocalTrainer, state: ServerState) -> ServerCtx:
+def make_server_ctx(trainer: LocalTrainer, state: ServerState,
+                    hp=None) -> ServerCtx:
     return ServerCtx(
         global_params=state.global_params,
         c_server=state.c_server,
         server_momentum=state.momentum,
+        hparams=hp,
     )
 
 
 def make_run_clients(trainer: LocalTrainer, server_opt: ServerOptimizer,
                      mode: str = "scan") -> Callable:
-    """Shared cohort executor: (state, x, y, mask, rngs, c_clients) →
-    stacked ClientOut (vmap or scan over the client axis)."""
+    """Shared cohort executor: (state, x, y, mask, rngs, c_clients[, hp]) →
+    stacked ClientOut — ``broadcast ∘ client_map`` over the client axis
+    (core/federated.py primitives; vmap or scan)."""
     local_train = trainer.make_local_train()
-    body = _client_body(local_train, server_opt)
 
-    def run_clients(state, x, y, mask, rngs, c_clients):
-        ctx = make_server_ctx(trainer, state)
-        fn = lambda xb, yb, mb, rng, cc: body(state.global_params, ctx, xb, yb,
-                                              mb, rng, cc)
-        if mode == "vmap":
-            return jax.vmap(fn)(x, y, mask, rngs, c_clients)
-        # scan mode: sequential over the client axis
-        def scan_body(carry, inp):
-            xb, yb, mb, rng, cc = inp
-            return carry, fn(xb, yb, mb, rng, cc)
-        _, outs = jax.lax.scan(scan_body, 0, (x, y, mask, rngs, c_clients))
-        return outs  # ClientOut with leading client axis
+    def run_clients(state, x, y, mask, rngs, c_clients, hp=None):
+        ctx = make_server_ctx(trainer, state, hp)
+        g = federated.broadcast(state.global_params)
+        fn = lambda xb, yb, mb, rng, cc: local_train(g, xb, yb, mb, rng,
+                                                     ctx, cc)
+        return federated.client_map(fn, mode)(x, y, mask, rngs, c_clients)
 
     return run_clients
 
@@ -85,11 +79,20 @@ def make_run_clients(trainer: LocalTrainer, server_opt: ServerOptimizer,
 def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                   mode: str = "scan", collective_precision: str = "fp32",
                   quant_block: int = blockscale.DEFAULT_BLOCK) -> Callable:
-    """Build round_fn(state, x, y, mask, weights, key, c_clients) ->
+    """Build round_fn(state, x, y, mask, weights, key, c_clients, hp) ->
     (new_state, metrics, new_client_state).  All client-axis inputs are
     stacked; ``key`` is the single round key (split per client inside the
     jit); ``c_clients`` is None unless the algorithm keeps per-client state
     (SCAFFOLD/FedDyn).
+
+    The round is the primitive composition of core/federated.py — one
+    :class:`~fedml_tpu.core.federated.RoundProgram` instance: ``broadcast``
+    the server params, ``client_map`` the local-SGD body, spec-declared
+    ``weighted_reduce`` aggregates, then the server transition.  ``hp`` is
+    an optional :class:`~fedml_tpu.core.federated.HParams`: swept fields
+    become traced scalars and the WHOLE round is a pure function of
+    ``(state, cohort, hp)`` — what lets a population ``vmap`` it
+    (:func:`make_population_round_fn`, docs/PRIMITIVES.md).
 
     ``collective_precision != "fp32"`` applies the SAME quantize →
     accumulate-EF math the mesh engine's collective layer runs
@@ -100,17 +103,23 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     ``state.master_flat``, and ``state.global_params`` becomes the
     low-precision broadcast copy the next round's clients train from."""
     alg = server_opt.algorithm
+    spec = server_opt.spec
     precision = collective_precision
-    run_clients = make_run_clients(trainer, server_opt, mode)
+    program = federated.RoundProgram(spec, trainer.make_local_train(),
+                                     server_opt, mode)
+    if precision != "fp32" and not spec.avg_params:
+        raise ValueError(
+            f"collective_precision={precision!r} quantizes the avg_params "
+            f"merge numerator, which the {alg!r} spec does not use")
 
-    def quantized_update(state: ServerState, outs: ClientOut, weights, aux,
-                         qkey):
-        # stage 1 with the EF-quantized numerator: the aggregate's
-        # avg_params is rebuilt from the flat quantized contribution;
-        # auxiliary aggregates (delta_c / nova_d / grad_sum) stay fp32,
-        # exactly as on the mesh
-        agg = server_opt.compute_aggregates(state, outs.params, weights,
-                                            aux)
+    def quantized_update(state: ServerState, outs: ClientOut, weights, qkey,
+                         hp):
+        # stage 1 with the EF-quantized numerator: avg_params is rebuilt
+        # from the flat quantized contribution; auxiliary spec aggregates
+        # (delta_c / nova_d / grad_sum) stay fp32, exactly as on the mesh
+        agg = federated.build_aggregates(spec, program.reducer, server_opt,
+                                         state, outs, weights, hp,
+                                         include_avg=False)
         num = jax.tree_util.tree_map(
             lambda l: jnp.tensordot(weights, l.astype(jnp.float32),
                                     axes=1), outs.params)
@@ -124,11 +133,11 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             deq, state.global_params)
         # stage 2 transitions the fp32 MASTER (global_params is the
         # broadcast copy the clients just trained from; deltas inside
-        # compute_aggregates reference it, matching the mesh)
+        # the spec aggregates reference it, matching the mesh)
         master = tree_util.tree_unflatten_1d(state.master_flat,
                                              state.global_params)
         new_state = server_opt.update_from_aggregates(
-            state.replace(global_params=master), agg)
+            state.replace(global_params=master), agg, hp)
         new_master = tree_util.tree_flatten_1d(new_state.global_params)
         send, new_ef_bcast, berr_sq = blockscale.quantize_broadcast(
             new_master, state.ef_bcast, precision,
@@ -144,6 +153,8 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     # (trace-time static; 0 would hide the fp32 baseline, so fp32 reports
     # its own dense payload and --comms ratios stay meaningful)
     def _bytes_model(n_flat: int) -> float:
+        # static arithmetic on Python ints (the modeled byte count)
+        # fedlint: disable-next-line=jit-host-sync -- not a tracer
         return float(
             blockscale.collective_payload_nbytes(n_flat, precision,
                                                  quant_block)
@@ -151,26 +162,24 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                                                    quant_block))
 
     def round_fn(state: ServerState, x, y, mask, weights, key,
-                 c_clients=None):
-        # split INSIDE the compiled round: a host-side split is a full
-        # device roundtrip per round (measured ~18ms through the TPU tunnel)
+                 c_clients=None, hp=None):
+        # member-distinct stream when a population sweeps seeds, then split
+        # INSIDE the compiled round: a host-side split is a full device
+        # roundtrip per round (measured ~18ms through the TPU tunnel)
+        key = federated.fold_seed(key, hp)
         rngs = jax.random.split(key, mask.shape[0])
-        outs: ClientOut = run_clients(state, x, y, mask, rngs, c_clients)
-        aux = {}
-        if alg == "scaffold":
-            aux["delta_c"] = outs.delta_c
-        if alg == "fednova":
-            aux["tau"] = outs.tau
-            aux["grad_sum"] = outs.grad_sum
-        if alg in ("mime", "fedsgd"):
-            aux["grad_sum"] = outs.grad_sum
+        outs: ClientOut = program.run_clients(state, x, y, mask, rngs,
+                                              c_clients, hp)
         if precision == "fp32":
-            new_state = server_opt.update(state, outs.params, weights, aux)
+            agg = federated.build_aggregates(spec, program.reducer,
+                                             server_opt, state, outs,
+                                             weights, hp)
+            new_state = server_opt.update_from_aggregates(state, agg, hp)
             quant_err = jnp.zeros((), jnp.float32)
         else:
             qkey = jax.random.fold_in(key, QUANT_KEY_TAG)
             new_state, quant_err = quantized_update(state, outs, weights,
-                                                    aux, qkey)
+                                                    qkey, hp)
         total_steps = jnp.sum(outs.num_steps)
         metrics = {
             "train_loss": jnp.sum(outs.loss * weights) / jnp.sum(weights),
@@ -212,10 +221,10 @@ def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                           quant_block=quant_block)
 
     def round_fn(state: ServerState, idx, mask, weights, key,
-                 c_clients=None):
+                 c_clients=None, hp=None):
         x = jnp.take(train_x, idx, axis=0)   # (C, S, B, ...)
         y = jnp.take(train_y, idx, axis=0)
-        return inner(state, x, y, mask, weights, key, c_clients)
+        return inner(state, x, y, mask, weights, key, c_clients, hp)
 
     return round_fn
 
@@ -244,15 +253,15 @@ def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode,
                                  collective_precision=collective_precision,
                                  quant_block=quant_block)
-    has_table = server_opt.algorithm in ("scaffold", "feddyn")
+    has_table = server_opt.spec.client_state
 
     def block_fn(state: ServerState, idx_blk, mask_blk, w_blk, keys_blk,
-                 cohort_blk, client_table=None):
+                 cohort_blk, client_table=None, hp=None):
         def step(carry, inp):
             st, table = carry
             idx, mask, w, key, cohort = inp
             c = tree_util.cohort_gather(table, cohort) if has_table else None
-            st, metrics, new_c = inner(st, idx, mask, w, key, c)
+            st, metrics, new_c = inner(st, idx, mask, w, key, c, hp)
             if has_table:
                 table = tree_util.cohort_scatter(table, cohort, new_c)
             return (st, table), metrics
@@ -263,6 +272,50 @@ def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
         return state, metrics, client_table
 
     return block_fn
+
+
+# -- vmapped experiment populations (ISSUE 7 tentpole) -----------------------
+# Because the round is a pure function of (state, cohort, hp), vmap over a
+# stacked HParams batch executes P experiments as ONE dispatch: members
+# share the cohort tensors / round keys (in_axes=None — the sweep isolates
+# the hparam effect; sweep ``seed`` for member-distinct rng, folded inside
+# the round), while ServerState, the per-client state table, and HParams
+# stack on a leading (P,) member axis.  Metrics leaves come back (P,)
+# ((P, K) under the fused block scan).  See docs/PRIMITIVES.md.
+
+def make_population_round_fn(trainer: LocalTrainer,
+                             server_opt: ServerOptimizer,
+                             train_x, train_y, mode: str = "vmap",
+                             collective_precision: str = "fp32",
+                             quant_block: int = blockscale.DEFAULT_BLOCK
+                             ) -> Callable:
+    """``pop_fn(states, idx, mask, w, key, c_stacked, hps)`` — the gather
+    round vmapped over the member axis of ``states`` / ``c_stacked`` /
+    ``hps``; cohort inputs broadcast."""
+    inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode,
+                                 collective_precision=collective_precision,
+                                 quant_block=quant_block)
+    has_table = server_opt.spec.client_state
+    table_ax = 0 if has_table else None
+    return jax.vmap(inner, in_axes=(0, None, None, None, None, table_ax, 0))
+
+
+def make_population_block_fn(trainer: LocalTrainer,
+                             server_opt: ServerOptimizer,
+                             train_x, train_y, mode: str = "vmap",
+                             collective_precision: str = "fp32",
+                             quant_block: int = blockscale.DEFAULT_BLOCK
+                             ) -> Callable:
+    """The fused K-round block vmapped over the member axis: P experiments
+    × K rounds in ONE compiled dispatch (``vmap`` over ``jit(lax.scan)``'s
+    body composes — metrics stack to ``(P, K)``)."""
+    inner = make_block_round_fn(trainer, server_opt, train_x, train_y, mode,
+                                collective_precision=collective_precision,
+                                quant_block=quant_block)
+    has_table = server_opt.spec.client_state
+    table_ax = 0 if has_table else None
+    return jax.vmap(inner,
+                    in_axes=(0, None, None, None, None, None, table_ax, 0))
 
 
 def next_pow2(n: int) -> int:

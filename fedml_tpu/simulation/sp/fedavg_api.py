@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core import federated
 from ...core import rng as rng_util
 from ...core import tree as tree_util
 from ...core.compression.blockscale import DEFAULT_BLOCK
@@ -31,7 +32,7 @@ from ...ml.aggregator.agg_operator import ServerOptimizer
 from ...ml.trainer.local_trainer import LocalTrainer
 from ...mlops import event, log_round_info
 from ...obs import get_tracer
-from ...obs.carry import obs_host, obs_host_rows
+from ...obs.carry import obs_host, obs_host_rows, obs_population_rows
 from ..round_engine import make_round_fn, next_pow2
 from ..staging import AsyncCohortStager
 
@@ -69,6 +70,17 @@ class FedAvgAPI:
 
         self.trainer = LocalTrainer(model, args)
         self.server_opt = ServerOptimizer(args)
+        # vmapped experiment population (ISSUE 7, docs/PRIMITIVES.md):
+        # args.population / population_axes turn the round into a batch of
+        # P hparam variants sharing one dispatch and one staging stream
+        self.population = federated.parse_population(args)
+        if self.population and \
+                type(self).train_one_round is not FedAvgAPI.train_one_round:
+            # a subclass with its own round loop would silently mis-handle
+            # the (P,)-stacked state/metrics
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support population vmap "
+                "(SP engine only for now — docs/PRIMITIVES.md)")
         # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
         # resolved against the engine's shard count (the mesh subclass sets
         # n_shards before super().__init__, so "auto" sees the real mesh)
@@ -89,6 +101,10 @@ class FedAvgAPI:
             # merge collective to quantize against one EF buffer
             raise ValueError(
                 "collective_precision requires the unbucketed cohort path")
+        if self._bucketing and self.population:
+            raise ValueError(
+                "population vmap needs the unbucketed cohort path (bucket "
+                "shapes are data-dependent per member)")
         if self._bucketing and \
                 type(self).train_one_round is not FedAvgAPI.train_one_round:
             # a subclass with its own round loop would silently ignore the
@@ -118,6 +134,11 @@ class FedAvgAPI:
         key = rng_util.root_key(self.seed)
         params = model.init(rng_util.purpose_key(key, "init"))
         self.state = self._init_server_state(params)
+        if self.population:
+            # every member starts from the SAME model init; states diverge
+            # per member inside the vmapped round as hparams differ
+            self.state = federated.stack_member_states(
+                self.state, self.population.size)
         self.round_fn = self._build_round_fn(client_mode)
         # Per-client algorithm state (SCAFFOLD control variates c_i / FedDyn
         # lagrangian residuals ∇̂_i) lives DEVICE-resident between rounds as
@@ -126,7 +147,10 @@ class FedAvgAPI:
         # device_get + tree_stack every round (ISSUE 3 tentpole).
         self.client_table = (
             self._init_client_table()
-            if self.server_opt.algorithm in ("scaffold", "feddyn") else None)
+            if self.server_opt.spec.client_state else None)
+        if self.population and self.client_table is not None:
+            self.client_table = federated.stack_member_states(
+                self.client_table, self.population.size)
         self.metrics_history = []
 
     #: donate the ServerState buffers into the round (in-place update on
@@ -151,12 +175,26 @@ class FedAvgAPI:
             # dataset device-resident once; rounds ship only index tensors
             self._dev_x = jnp.asarray(self.dataset.train_x)
             self._dev_y = jnp.asarray(self.dataset.train_y)
+            if self.population:
+                # P experiments, ONE dispatch: the gather round vmapped
+                # over the member axis of (state, table, hparams); cohort
+                # tensors broadcast (docs/PRIMITIVES.md)
+                from ..round_engine import make_population_round_fn
+                return jax.jit(make_population_round_fn(
+                    self.trainer, self.server_opt, self._dev_x, self._dev_y,
+                    mode=client_mode,
+                    collective_precision=self.collective_precision,
+                    quant_block=self.quant_block), donate_argnums=donate)
             from ..round_engine import make_gather_round_fn
             return jax.jit(make_gather_round_fn(
                 self.trainer, self.server_opt, self._dev_x, self._dev_y,
                 mode=client_mode,
                 collective_precision=self.collective_precision,
                 quant_block=self.quant_block), donate_argnums=donate)
+        if self.population:
+            raise ValueError(
+                "population vmap needs the device-gather cohort path "
+                "(device_data=True): members share one staged cohort")
         return jax.jit(make_round_fn(
             self.trainer, self.server_opt, mode=client_mode,
             collective_precision=self.collective_precision,
@@ -174,17 +212,27 @@ class FedAvgAPI:
         ``get(c, zeros)`` default).  The mesh engine overrides this to pad
         the row count and shard the rows over the client axis."""
         self._table_rows = self.dataset.num_clients
-        return tree_util.client_table_init(self.state.global_params,
-                                           self._table_rows)
+        params = self.state.global_params
+        if self.population:
+            # rows are shaped like ONE member's params; the driver stacks
+            # the finished table onto the member axis afterwards
+            params = federated.population_member(params, 0)
+        return tree_util.client_table_init(params, self._table_rows)
 
     def _table_ops(self):
         """Jitted cohort gather/scatter over the client-state table, built
         once per API instance; the scatter donates the old table buffers so
         the update is in-place on device."""
         if self._ct_ops is None:
+            gather, scatter = tree_util.cohort_gather, tree_util.cohort_scatter
+            if self.population:
+                # member-stacked table: one shared cohort id vector indexes
+                # every member's rows
+                gather = jax.vmap(gather, in_axes=(0, None))
+                scatter = jax.vmap(scatter, in_axes=(0, None, 0))
             self._ct_ops = (
-                jax.jit(tree_util.cohort_gather),
-                jax.jit(tree_util.cohort_scatter, donate_argnums=(0,)))
+                jax.jit(gather),
+                jax.jit(scatter, donate_argnums=(0,)))
         return self._ct_ops
 
     def _gather_c(self, cohort):
@@ -284,8 +332,13 @@ class FedAvgAPI:
                     mask = np.pad(mask, [(0, 0), (0, pad)])
                 idx, mask, w = (jnp.asarray(idx), jnp.asarray(mask),
                                 jnp.asarray(w))
-            self.state, metrics, new_c = self.round_fn(
-                self.state, idx, mask, w, key, c_stacked)
+            if self.population:
+                self.state, metrics, new_c = self.round_fn(
+                    self.state, idx, mask, w, key, c_stacked,
+                    self.population.hparams)
+            else:
+                self.state, metrics, new_c = self.round_fn(
+                    self.state, idx, mask, w, key, c_stacked)
         else:
             with self._tracer.span("staging", cat="staging",
                                    round=round_idx):
@@ -319,8 +372,17 @@ class FedAvgAPI:
                 "round_block fusion needs the device-gather cohort path "
                 "(device_data=True): pre-staging a block is cheap only "
                 "when rounds ship index tensors, not data")
-        from ..round_engine import make_block_round_fn
         donate = (0, 6) if self.DONATE_STATE else ()
+        if self.population:
+            # P members × K rounds as ONE dispatch: vmap over the member
+            # axis of the fused block scan (metrics stack to (P, K))
+            from ..round_engine import make_population_block_fn
+            return jax.jit(make_population_block_fn(
+                self.trainer, self.server_opt, self._dev_x, self._dev_y,
+                mode=self._client_mode,
+                collective_precision=self.collective_precision,
+                quant_block=self.quant_block), donate_argnums=donate)
+        from ..round_engine import make_block_round_fn
         return jax.jit(make_block_round_fn(
             self.trainer, self.server_opt, self._dev_x, self._dev_y,
             mode=self._client_mode,
@@ -376,8 +438,13 @@ class FedAvgAPI:
         nxt = start_round + self._round_block
         k, steps, idx, mask, w, keys, cohort = self._block_stager.get(
             start_round, prefetch=nxt if nxt < self.comm_rounds else None)
-        self.state, metrics, self.client_table = self._block_fn(
-            self.state, idx, mask, w, keys, cohort, self.client_table)
+        if self.population:
+            self.state, metrics, self.client_table = self._block_fn(
+                self.state, idx, mask, w, keys, cohort, self.client_table,
+                self.population.hparams)
+        else:
+            self.state, metrics, self.client_table = self._block_fn(
+                self.state, idx, mask, w, keys, cohort, self.client_table)
         metrics = dict(metrics)
         metrics["allocated_steps"] = np.full(
             k, idx.shape[1] * steps, np.int64)
@@ -386,6 +453,14 @@ class FedAvgAPI:
     def evaluate(self):
         with self._tracer.span("eval", cat="eval"):
             xb, yb, mb = self.dataset.test_batches()
+            if self.population:
+                # one vmapped dispatch scores every member; the scalar
+                # return keeps the driver/record surface unchanged while
+                # the per-member arrays land on ``member_eval``
+                losses, accs = self.trainer.evaluate_members(
+                    self.state.global_params, xb, yb, mb)
+                self.member_eval = {"loss": losses, "acc": accs}
+                return float(losses.mean()), float(accs.mean())
             return self.trainer.evaluate(self.state.global_params, xb, yb,
                                          mb)
 
@@ -491,18 +566,33 @@ class FedAvgAPI:
         host and device; ISSUE 3 satellite)."""
         while pending:
             round_idx, metrics, dt = pending.pop(0)
-            train_loss = float(metrics["train_loss"])
+            member_losses = None
+            if self.population:
+                # (P,) member losses: ONE materialization, then host math
+                member_losses = np.asarray(metrics["train_loss"])
+                train_loss = float(member_losses.mean())
+            else:
+                train_loss = float(metrics["train_loss"])
             if self._tracer.enabled and isinstance(metrics, dict) \
                     and metrics.get("obs") is not None:
                 # piggyback the existing sync: the float() above already
                 # blocked on this round's program, so materializing the
                 # device-carry scalars here adds no new sync point
-                self._tracer.round_obs(round_idx, dt,
-                                       obs_host(metrics["obs"]))
+                if self.population:
+                    self._tracer.round_obs(round_idx, dt, obs_population_rows(
+                        metrics["obs"], member_losses)[0])
+                else:
+                    self._tracer.round_obs(round_idx, dt,
+                                           obs_host(metrics["obs"]))
             record = {"round": round_idx, "train_loss": train_loss,
                       "round_time": dt,
                       "dataset_provenance": getattr(self.dataset,
                                                     "provenance", "unknown")}
+            if member_losses is not None:
+                record.update(
+                    members=self.population.size,
+                    member_train_loss_best=float(member_losses.min()),
+                    member_train_loss_worst=float(member_losses.max()))
             if self._is_log_round(round_idx):
                 # flush is called AT the log round, so self.state is this
                 # round's state and the eval matches the old cadence
@@ -529,9 +619,15 @@ class FedAvgAPI:
                 losses = np.asarray(ms["train_loss"])
             block_dt = time.time() - t0
             event("train", started=False, round_idx=r)
+            member_losses = None
+            if self.population:
+                member_losses = losses          # (P, k)
+                losses = member_losses.mean(axis=0)
             if self._tracer.enabled and ms.get("obs") is not None:
                 # stacked (k,) device-carry rows ride the block's ONE sync
-                for j, row in enumerate(obs_host_rows(ms["obs"])):
+                rows = (obs_population_rows(ms["obs"], member_losses)
+                        if self.population else obs_host_rows(ms["obs"]))
+                for j, row in enumerate(rows):
                     self._tracer.round_obs(r + j, block_dt / k, row)
             eval_due = any(self._is_log_round(ri) for ri in range(r, r + k))
             for j in range(k):
@@ -540,6 +636,13 @@ class FedAvgAPI:
                           "round_time": block_dt / k,
                           "dataset_provenance": getattr(
                               self.dataset, "provenance", "unknown")}
+                if member_losses is not None:
+                    record.update(
+                        members=self.population.size,
+                        member_train_loss_best=float(
+                            member_losses[:, j].min()),
+                        member_train_loss_worst=float(
+                            member_losses[:, j].max()))
                 if j == k - 1 and eval_due:
                     test_loss, test_acc = self.evaluate()
                     record.update(test_loss=test_loss, test_acc=test_acc)

@@ -1,0 +1,30 @@
+"""Fixture: collectives inside a VMAPPED round still resolve against the
+package's declared mesh axes (the population pattern wraps the round in
+``jax.vmap``; its collectives keep reducing over the mesh axes), and a
+``vmap(..., spmd_axis_name=...)`` declaration itself counts as an axis."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+CLIENT_AXIS = "client"
+
+mesh = Mesh(jax.devices(), (CLIENT_AXIS,))
+
+
+def round_body(x, w):
+    # mesh-declared axis, reached through the population vmap: clean
+    return jax.lax.psum(x * w, CLIENT_AXIS)
+
+
+def population_round(xs, w):
+    return jax.vmap(round_body, in_axes=(0, None))(xs, w)
+
+
+def member_batched(xs):
+    # the vmap batch axis itself is declared via spmd_axis_name: clean
+    f = jax.vmap(lambda x: jax.lax.pmean(x, "member"), spmd_axis_name="member")
+    return f(xs)
+
+
+def bad_axis_inside_vmap(xs):
+    return jax.vmap(lambda x: jax.lax.psum(x, "population"))(xs)

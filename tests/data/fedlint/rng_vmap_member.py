@@ -1,0 +1,33 @@
+"""Fixture: PRNG keys across vmapped population members
+(docs/PRIMITIVES.md).  A member-independent key inside a vmapped body
+gives every member the SAME stream; ``fold_in(key, member_idx)`` is the
+clean derivation."""
+import jax
+import jax.numpy as jnp
+
+
+def bad_same_key_every_member(key, members):
+    # the fold value is a constant: every member derives the SAME key
+    return jax.vmap(lambda i: jax.random.fold_in(key, 0))(members)
+
+
+def bad_sample_closed_over_key(key, members):
+    # sampling a closed-over key: member-independent streams
+    return jax.vmap(lambda i: jax.random.normal(key, (4,)))(members)
+
+
+def bad_constant_prngkey(members):
+    return jax.vmap(lambda i: jax.random.PRNGKey(7))(members)
+
+
+def ok_fold_member_index(key, p):
+    # the canonical member-distinct derivation (core/federated.fold_seed)
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(p, dtype=jnp.uint32))
+
+
+def ok_derived_local_key(key, members):
+    def member(i):
+        k = jax.random.fold_in(key, i)
+        return jax.random.normal(k, (4,))     # k is member-tainted
+    return jax.vmap(member)(members)

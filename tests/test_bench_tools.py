@@ -84,6 +84,26 @@ def test_bench_round_fusion_quick(monkeypatch):
     assert out["fused_speedup"] > 0
 
 
+def test_bench_population_quick(monkeypatch):
+    """bench.py --population smoke: the vmapped-population-vs-sequential
+    sweep comparison runs green through the bench harness (tier-1
+    exercises the population round end-to-end; the <=0.5x P=16 acceptance
+    number comes from the full-size run, not this trimmed cohort)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_POPULATION_QUICK", "1")
+    out = bench.bench_population()
+    assert out["quick"] is True
+    assert out["sizes"] == [1, 2]
+    for p in (1, 2):
+        assert out[f"p{p}_pop_wallclock_s"] > 0
+        assert out[f"p{p}_seq_wallclock_s"] > 0
+        assert out[f"p{p}_steady_s_per_round_per_config"] > 0
+    # amortization direction: per-config steady-state cost must shrink
+    # as members share the dispatch
+    assert out["p2_steady_s_per_round_per_config"] < \
+        out["p1_steady_s_per_round"] * 1.1
+
+
 def test_bench_comms_quick(monkeypatch):
     """bench.py --comms smoke: the collective-precision comparison runs
     green on the 8-virtual-device scatter mesh and reports the modeled

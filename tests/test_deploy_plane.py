@@ -83,6 +83,21 @@ def test_autoscaler_policies():
                               queries_per_replica=1, window_size_secs=60)
     assert scaler.scale_operation_endpoint(c, "ep") >= 2
 
+    # EWM latency policy reads the public (ts, latency) record series:
+    # a latency spike vs the window mean scales up by one replica
+    lat = EWMPolicy(current_replicas=2, min_replicas=1, max_replicas=8,
+                    metric="ewm_latency", ewm_mins=15.0, ewm_alpha=0.9,
+                    ub_threshold=0.5, lb_threshold=0.5,
+                    scaledown_delay_secs=0.0)
+    cache_l = FedMLModelCache()
+    scaler_l = Autoscaler(cache_l)
+    for i in range(20):
+        cache_l.record_request("lat", 0.05, ts=now - 40 + i)
+    for i in range(5):                       # recent 10x latency spike
+        cache_l.record_request("lat", 0.50, ts=now - 5 + i)
+    assert scaler_l.scale_operation_endpoint(lat, "lat") == 3
+    assert cache_l.request_records("lat")[0] == (now - 40, 0.05)
+
     # idle endpoint → falls back to min replicas
     cache2 = FedMLModelCache()
     scaler2 = Autoscaler(cache2)

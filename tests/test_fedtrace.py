@@ -265,6 +265,14 @@ def test_fedtrace_golden_values_are_hand_checkable():
     assert s["collective_bytes_client_per_round"] == 22500.0
     assert s["collective_bytes_model_per_round"] == 7500.0
     assert s["quant_error_norm_last"] == 0.01
+    # vmapped population fields (docs/PRIMITIVES.md): the member-loss
+    # envelope comes from the last round's record; the byte models are
+    # trace-time statics shared by every member of the ONE compiled
+    # program, so their cross-member spread is pinned to exactly 0
+    assert s["population_members"] == 4
+    assert s["member_loss_best_last"] == 0.8
+    assert s["member_loss_worst_last"] == 1.6
+    assert s["member_bytes_spread_max"] == 0.0
 
 
 def _run_cli(*args):

@@ -186,6 +186,20 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
               if "quant_error_norm" in r]
         if qe:
             out["quant_error_norm_last"] = round(qe[-1], 6)
+        # vmapped experiment population (docs/PRIMITIVES.md): per-member
+        # loss envelope of the (P,)-stacked ObsCarry, plus the pinned
+        # bytes-identical-across-members invariant (a nonzero spread means
+        # members traced different programs)
+        mem = [r for r in recs if "members" in r]
+        if mem:
+            out["population_members"] = int(float(mem[-1]["members"]))
+            out["member_loss_best_last"] = round(
+                float(mem[-1]["member_loss_best"]), 6)
+            out["member_loss_worst_last"] = round(
+                float(mem[-1]["member_loss_worst"]), 6)
+            out["member_bytes_spread_max"] = round(
+                max(float(r.get("member_bytes_spread", 0.0)) for r in mem),
+                6)
     return out
 
 
@@ -226,6 +240,13 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"{s['collective_bytes_per_round']:.0f}{axis}   "
             f"quant error norm (last): "
             f"{s.get('quant_error_norm_last', 0.0):g}")
+    if "population_members" in s:
+        lines.append(
+            f"population: {s['population_members']} members   "
+            f"member loss best/worst (last): "
+            f"{s['member_loss_best_last']:g}/"
+            f"{s['member_loss_worst_last']:g}   "
+            f"bytes spread: {s['member_bytes_spread_max']:g}")
     lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")
     total = sum(s["phases"].values()) or 1.0
     for p in PHASES:

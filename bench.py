@@ -722,6 +722,152 @@ def bench_population(rounds: int | None = None,
     return out
 
 
+# -- paged client-state store benchmark (--store) ----------------------------
+def _rss_mb() -> float:
+    """Current (not peak) resident set of this process in MiB."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def bench_store(rounds: int | None = None) -> dict:
+    """--store: the paged million-client state plane (fedml_tpu/store,
+    docs/CLIENT_STORE.md) vs today's dense device client table.
+
+    Two SCAFFOLD configs with EQUAL per-round work (same total client
+    steps, same samples/round): the dense baseline (small registered
+    population, dense device table, 256-client cohorts of 8 steps) and
+    the store row (1M registered client ids — an id space whose DENSE
+    table cannot be allocated at all — paged sparse host store, 2k-client
+    cohorts of 1 step).  Reports steady-state s/round, the host-RSS delta
+    across each run, the store's actual resident bytes, the modeled dense
+    table bytes at 1M registered, and steady-state recompile counts
+    (pinned 0).  FEDML_STORE_QUICK=1 shrinks everything for the tier-1
+    smoke."""
+    import gc
+
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    quick = os.environ.get("FEDML_STORE_QUICK") == "1"
+    registered = 50_000 if quick else 1_000_000
+    # three configs, equal samples/round throughout: the ANCHOR (today's
+    # dense-table config: small cohort, more steps each), a SAME-SHAPE
+    # dense run (big cohort, 1 step — isolates the cohort-shape effect),
+    # and the STORE row (same shape as the second, but the id space is
+    # `registered` and the state plane is the paged store — the delta vs
+    # same-shape dense is the true cost of paging)
+    dense_cohort, dense_steps = (32, 4) if quick else (256, 8)
+    store_cohort = dense_cohort * dense_steps
+    timed_rounds = rounds or (3 if quick else ROUNDS_TIMED)
+
+    def make_api(over):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            test_size=256, model="lr", comm_round=10 ** 6, epochs=1,
+            batch_size=BATCH, learning_rate=0.1, partition_method="homo",
+            federated_optimizer="SCAFFOLD",
+            frequency_of_the_test=10 ** 9, random_seed=0)
+        args.update(**over)
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        return FedAvgAPI(args, None, dataset, model, client_mode="vmap")
+
+    def run_config(over):
+        gc.collect()
+        rss0 = _rss_mb()
+        api = make_api(over)
+        for r in range(2):                      # compile + warm
+            api.train_one_round(r)
+        _readback(api.state.global_params)
+        with JaxRuntimeAudit() as audit:
+            t0 = time.time()
+            for r in range(2, 2 + timed_rounds):
+                api.train_one_round(r)
+            _readback(api.state.global_params)
+            dt = (time.time() - t0) / timed_rounds
+        rss1 = _rss_mb()
+        return api, dt, rss1 - rss0, audit.compilations
+
+    anchor_over = dict(
+        client_num_in_total=dense_cohort, client_num_per_round=dense_cohort,
+        train_size=dense_cohort * dense_steps * BATCH)
+    api_a, anchor_s, anchor_rss, anchor_compiles = run_config(anchor_over)
+    del api_a
+    shape_over = dict(
+        client_num_in_total=store_cohort, client_num_per_round=store_cohort,
+        train_size=store_cohort * BATCH)
+    api_d, shape_s, shape_rss, shape_compiles = run_config(shape_over)
+    del api_d
+    store_over = dict(shape_over, client_store=True,
+                      registered_clients=registered, store_page_size=512)
+    api_s, store_s, store_rss, store_compiles = run_config(store_over)
+    stats = api_s._pager.stats()
+    # LRU cap + spill: the RSS-FLAT configuration — resident rows bounded
+    # at max_pages * page_size no matter how many clients build history;
+    # finer pages keep the random repeat-id reloads cheap
+    import tempfile
+    spill = tempfile.mkdtemp(prefix="fedstore_bench_")
+    capped_over = dict(shape_over, client_store=True,
+                       registered_clients=registered,
+                       store_page_size=64 if quick else 128,
+                       store_max_pages=8 if quick else 96,
+                       store_spill_dir=spill)
+    api_c, capped_s, capped_rss, capped_compiles = run_config(capped_over)
+    cstats = api_c._pager.stats()
+    del api_c
+    out = {
+        "quick": quick, "rounds": timed_rounds,
+        "registered_clients": registered,
+        "anchor_cohort": dense_cohort,
+        "anchor_steps_per_client": dense_steps,
+        "store_cohort": store_cohort, "store_steps_per_client": 1,
+        "anchor_dense_s_per_round": round(anchor_s, 5),
+        "sameshape_dense_s_per_round": round(shape_s, 5),
+        "store_s_per_round": round(store_s, 5),
+        # the acceptance ratio: 1M-registered store round vs today's
+        # 256-client dense config at equal samples/round
+        "store_vs_anchor_round": round(store_s / anchor_s, 3),
+        # the isolated state-plane cost: identical cohort shape, dense
+        # device table vs paged host store
+        "store_vs_dense_sameshape": round(store_s / shape_s, 3),
+        "anchor_rss_delta_mb": round(anchor_rss, 1),
+        "sameshape_rss_delta_mb": round(shape_rss, 1),
+        "store_rss_delta_mb": round(store_rss, 1),
+        "store_resident_mb": round(stats["resident_bytes"] / 2 ** 20, 2),
+        "store_touched_rows": stats["touched_rows"],
+        "store_page_hit_rate": round(stats["page_hit_rate"], 4),
+        # the RSS-flat row: LRU cap + spill bounds residency for ANY
+        # horizon at the cost of spill I/O on the overlapped threads
+        "capped_s_per_round": round(capped_s, 5),
+        "capped_vs_dense_sameshape": round(capped_s / shape_s, 3),
+        "capped_resident_mb": round(cstats["resident_bytes"] / 2 ** 20, 2),
+        "capped_spills": cstats["spills"],
+        "capped_loads": cstats["loads"],
+        "steady_compiles_capped": capped_compiles,
+        # the allocation the dense table would need at this population —
+        # the number that cannot exist on the host
+        "dense_table_at_registered_gib": round(
+            api_s._store.dense_nbytes() / 2 ** 30, 2),
+        "steady_compiles_anchor": anchor_compiles,
+        "steady_compiles_sameshape": shape_compiles,
+        "steady_compiles_store": store_compiles,
+    }
+    del api_s
+    return out
+
+
 # -- fedtrace overhead + breakdown benchmark (--trace) -----------------------
 def _import_fedtrace():
     sys.path.insert(0, os.path.join(os.path.dirname(
@@ -1350,6 +1496,19 @@ def main():
             "value": result["trace_overhead_pct"],
             "unit": "pct_overhead_traced_vs_untraced",
             "vs_baseline": None,
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--store" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_store()
+        result.update({
+            "metric": "client_store_1m_registered_vs_dense",
+            "value": result["store_s_per_round"],
+            "unit": "s/round",
+            "vs_baseline": result["store_vs_dense_sameshape"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

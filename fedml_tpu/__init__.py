@@ -104,6 +104,8 @@ def init(args: Optional[Arguments] = None, check_env: bool = True,
     host seeding matters only for numpy-side sampling."""
     if args is None:
         args = load_arguments(_global_training_type, _global_comm_backend)
+    from .arguments import validate_args
+    validate_args(args)
     seed = int(getattr(args, "random_seed", 0))
     random.seed(seed)
     np.random.seed(seed)

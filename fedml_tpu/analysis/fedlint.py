@@ -191,6 +191,12 @@ _HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
 _HOST_SYNC_NP = {"asarray", "array", "copy", "save", "savez", "allclose",
                  "array_equal", "asnumpy"}
 _HOST_SYNC_ATTRS = {"item", "tolist", "to_py"}
+# host client-state store access (fedml_tpu/store, docs/CLIENT_STORE.md):
+# method calls on a *store-named* receiver that read/write host-paged rows
+# — a Python-dict/page lookup inside a traced round body either fails to
+# trace or silently closes over ONE round's rows at trace time
+_HOST_STORE_ATTRS = {"get", "gather", "scatter", "page_in", "write_back",
+                     "lookup", "load"}
 
 _RNG_DERIVERS = {"split", "fold_in", "clone", "key_data", "wrap_key_data",
                  "key_impl"}
@@ -479,11 +485,38 @@ def _is_staticish(node: ast.AST) -> bool:
     return False
 
 
+def _receiver_name(node: ast.AST) -> Optional[str]:
+    """Last identifier of a call/subscript receiver (``page_store`` in
+    ``page_store.get(...)``, ``client_store`` in ``self.client_store[c]``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_store_name(name: Optional[str]) -> bool:
+    return name is not None and "store" in name.lower()
+
+
 def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
     for node in ast.walk(mv.mod.tree):
-        if not isinstance(node, ast.Call):
+        if not isinstance(node, (ast.Call, ast.Subscript)):
             continue
         if not mv.reach.in_reachable(node):
+            continue
+        if isinstance(node, ast.Subscript):
+            # host client-state store indexed inside traced code: the
+            # lookup happens ONCE at trace time (or fails on a traced id)
+            if _is_store_name(_receiver_name(node.value)):
+                out.append(Finding(
+                    "jit-host-sync", RULES["jit-host-sync"].severity,
+                    mv.mod.path, node.lineno, node.col_offset,
+                    "host client-state store subscript inside "
+                    f"jit-reachable "
+                    f"'{func_name(mv.reach.innermost_fn(node))}' — page "
+                    "rows in on the host and pass the gathered cohort "
+                    "stack into the round (docs/CLIENT_STORE.md)"))
             continue
         fn = node.func
         msg = None
@@ -509,6 +542,14 @@ def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
                 msg = (f".{fn.attr}() inside jit-reachable "
                        f"'{func_name(mv.reach.innermost_fn(node))}' blocks "
                        "on device and breaks under tracing")
+            elif fn.attr in _HOST_STORE_ATTRS and \
+                    _is_store_name(_receiver_name(fn.value)):
+                msg = (f"host client-state store access "
+                       f"(.{fn.attr}()) inside jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}' — "
+                       "page rows in on the host and pass the gathered "
+                       "cohort stack into the round "
+                       "(docs/CLIENT_STORE.md)")
             elif d == "jax.device_get":
                 msg = ("jax.device_get inside a jit-reachable function "
                        "forces a device→host transfer")

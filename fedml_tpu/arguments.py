@@ -132,6 +132,22 @@ _DEFAULTS: Dict[str, Any] = dict(
     update_sharding="auto",
     # double-buffered host->device cohort staging (mesh engine)
     async_staging=True,
+    # prefetch depth of the cohort stager / store pager: how many future
+    # rounds (or fused blocks) stay in flight on the worker thread
+    staging_depth=1,
+    # fedstore (docs/CLIENT_STORE.md): paged sparse host-side per-client
+    # state instead of the dense device table — only the active cohort's
+    # rows are ever device-resident.  registered_clients widens the client
+    # ID SPACE past the dataset's client count (ids map to data modulo);
+    # store_max_pages caps resident pages (LRU, spilled to
+    # store_spill_dir); num_silos>1 turns on two-tier silo->server
+    # aggregation in the hierarchical driver
+    client_store=False,
+    registered_clients=0,
+    store_page_size=256,
+    store_max_pages=0,
+    store_spill_dir=None,
+    num_silos=0,
     # low-precision collective layer (docs/COLLECTIVE_PRECISION.md):
     # fp32 | bf16 | int8 | auto (auto = bf16 whenever the client axis has
     # > 1 shard); quant_block is the per-absmax-scale chunk of the int8
@@ -145,6 +161,41 @@ _DEFAULTS: Dict[str, Any] = dict(
     compute_dtype="float32",
     clients_per_device=1,
 )
+
+
+def validate_args(args) -> None:
+    """Cross-flag validation, run by ``fedml_tpu.init``.
+
+    Catches knob combinations that previously failed LATE (deep in an
+    engine constructor, after dataset/model build) or silently (a
+    subclass ignoring the flag) and raises ONE error naming the
+    incompatible flags while the config is still the only thing built.
+    """
+    pop = int(getattr(args, "population", 0) or 0)
+    axes = getattr(args, "population_axes", None) or {}
+    has_pop = pop > 1 or bool(axes)
+    if not has_pop:
+        return
+    pop_flag = "population_axes" if axes else "population"
+    if bool(getattr(args, "cohort_bucketing", False)):
+        raise ValueError(
+            f"incompatible flags: {pop_flag} + cohort_bucketing — vmapped "
+            "experiment members share ONE compiled cohort shape, while "
+            "bucketing makes shapes data-dependent per member "
+            "(docs/PRIMITIVES.md); drop one of the two")
+    backend = str(getattr(args, "backend", "") or "").lower()
+    if backend in ("mesh", "mpi", "nccl"):
+        raise ValueError(
+            f"incompatible flags: {pop_flag} + backend="
+            f"{getattr(args, 'backend', None)!r} — population vmap is "
+            "SP-engine only for now (docs/PRIMITIVES.md); use backend: sp "
+            "or drop the population")
+    if bool(getattr(args, "client_store", False)):
+        raise ValueError(
+            f"incompatible flags: {pop_flag} + client_store — the paged "
+            "store holds ONE experiment's per-client rows; population "
+            "sweeps need the dense member-stacked client table "
+            "(docs/CLIENT_STORE.md)")
 
 
 def load_arguments(training_type: Optional[str] = None,

@@ -26,6 +26,11 @@ class RoundCheckpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
                                                  create=True),
+            # pre-register the standard handler so a FRESH process can read
+            # item_metadata() of an existing checkpoint before any
+            # save/restore (the legacy dense-table -> sparse-store
+            # migration rebuilds its restore template from metadata)
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     @staticmethod
@@ -36,6 +41,29 @@ class RoundCheckpointer:
         return isinstance(client_state, dict) and (
             not client_state
             or all(isinstance(k, int) for k in client_state))
+
+    @staticmethod
+    def _is_store(client_state) -> bool:
+        """Paged sparse store (fedml_tpu/store): duck-typed so this module
+        never imports the store package."""
+        return (hasattr(client_state, "to_checkpoint")
+                and hasattr(client_state, "load_checkpoint"))
+
+    def _store_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"store_{int(step)}.npz")
+
+    def _prune_store_sidecars(self):
+        """Drop sparse-store sidecars whose orbax step was retired by
+        max_to_keep, so the directory's footprint tracks the manager's."""
+        import glob
+        keep = {int(s) for s in (self.mngr.all_steps() or [])}
+        for p in glob.glob(os.path.join(self.directory, "store_*.npz")):
+            try:
+                step = int(os.path.basename(p)[len("store_"):-len(".npz")])
+            except ValueError:
+                continue
+            if step not in keep:
+                os.remove(p)
 
     def _composite(self, state: Any, client_state) -> dict:
         composite = {"state": state}
@@ -53,13 +81,21 @@ class RoundCheckpointer:
              client_state: Optional[Any] = None, force: bool = False):
         """state: any pytree (ServerState); client_state: the dense
         per-client state table (pytree with a leading client-row axis —
-        orbax persists its sharding like any other leaf) or the legacy
-        host dict of per-client pytrees."""
+        orbax persists its sharding like any other leaf), a
+        :class:`~fedml_tpu.store.ClientStateStore` (saved SPARSE — only
+        touched rows — as an ``.npz`` sidecar next to the orbax step), or
+        the legacy host dict of per-client pytrees."""
+        store = client_state if self._is_store(client_state) else None
+        if store is not None:
+            client_state = None
         self.mngr.save(round_idx,
                        args=ocp.args.StandardSave(
                            self._composite(state, client_state)),
                        force=force)
         self.mngr.wait_until_finished()
+        if store is not None:
+            np.savez(self._store_path(round_idx), **store.to_checkpoint())
+        self._prune_store_sidecars()
 
     def latest_round(self) -> Optional[int]:
         return self.mngr.latest_step()
@@ -68,10 +104,15 @@ class RoundCheckpointer:
                 template: Optional[Any] = None):
         """Returns (state, client_state) or None if no checkpoint;
         ``client_state`` is the dense table pytree when one was saved,
-        else the legacy int-keyed dict (``{}`` when absent)."""
+        else the legacy int-keyed dict (``{}`` when absent).  When the
+        template carries a sparse store, the store is loaded IN PLACE and
+        returned — from its own sparse sidecar, or by migrating a legacy
+        dense ``client_table`` / host-dict checkpoint into it."""
         step = round_idx if round_idx is not None else self.mngr.latest_step()
         if step is None:
             return None
+        if template is not None and self._is_store(template[1]):
+            return self._restore_into_store(step, template[0], template[1])
         if template is not None:
             restored = self.mngr.restore(
                 step, args=ocp.args.StandardRestore(
@@ -83,6 +124,39 @@ class RoundCheckpointer:
         client_state = {
             int(k): v for k, v in restored.get("client_state", {}).items()}
         return restored["state"], client_state
+
+    def _restore_into_store(self, step: int, state_template: Any, store):
+        """Store-backed restore: the ServerState comes from orbax against
+        its template; the per-client rows come from the sparse ``.npz``
+        sidecar, or — legacy checkpoints — from the saved dense
+        ``client_table`` / host-dict item, rebuilt from the step's orbax
+        METADATA (shapes/dtypes) so the caller never has to materialize a
+        dense template itself."""
+        sidecar = self._store_path(step)
+        comp = {"state": state_template}
+        legacy_key = None
+        if not os.path.exists(sidecar):
+            meta = self.mngr.item_metadata(step)
+            for key in ("client_table", "client_state"):
+                if isinstance(meta, dict) and key in meta:
+                    legacy_key = key
+                    comp[key] = jax.tree_util.tree_map(
+                        lambda m: np.zeros(m.shape, m.dtype), meta[key])
+                    break
+        restored = self.mngr.restore(
+            step, args=ocp.args.StandardRestore(comp))
+        if os.path.exists(sidecar):
+            with np.load(sidecar) as z:
+                store.load_checkpoint({k: z[k] for k in z.files})
+        elif legacy_key == "client_table":
+            store.load_dense(restored["client_table"])
+        elif legacy_key == "client_state":
+            for cid, row in restored["client_state"].items():
+                store.scatter(
+                    np.asarray([int(cid)], np.int64),
+                    jax.tree_util.tree_map(lambda x: np.asarray(x)[None],
+                                           row))
+        return restored["state"], store
 
     def close(self):
         self.mngr.close()

@@ -156,6 +156,57 @@ class ScatterReducer:
         return jax.lax.psum(jnp.sum(vec), self.axis)
 
 
+class PartialReducer:
+    """Silo tier of the two-tier hierarchical aggregation
+    (arXiv:2604.10859): every weighted reduction returns its *unfinished*
+    ``{num, den}`` pair instead of the finished average, so S silo
+    partials combine EXACTLY at the server —
+    ``sum(nums) / sum(dens)`` is the flat cohort average up to float
+    reassociation.  ``sum``-kind aggregates are already associative and
+    stay plain.  Feed the result dicts to
+    :func:`combine_partial_aggregates`."""
+
+    def wavg(self, stacked: Pytree, w: jnp.ndarray) -> Dict[str, Any]:
+        num = jax.tree_util.tree_map(
+            lambda l: jnp.tensordot(jnp.asarray(w, jnp.float32),
+                                    l.astype(jnp.float32), axes=1), stacked)
+        return {"num": num, "den": jnp.sum(jnp.asarray(w, jnp.float32))}
+
+    def wavg_scalar(self, vec: jnp.ndarray, w: jnp.ndarray
+                    ) -> Dict[str, Any]:
+        return {"num": jnp.sum(w * vec), "den": jnp.sum(w)}
+
+    def sum_scalar(self, vec: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(vec)
+
+
+def combine_partial_aggregates(spec: "AlgorithmSpec", partials
+                               ) -> Dict[str, Any]:
+    """Server tier: combine S per-silo partial-aggregate dicts (each built
+    by :func:`build_aggregates` with a :class:`PartialReducer`) into the
+    single finished aggregate dict
+    ``ServerOptimizer.update_from_aggregates`` consumes.  Pure jnp math —
+    safe to jit over a tuple of partials, or to run host-side on partials
+    shipped over the cross-silo message path."""
+
+    def finish(key):
+        den = sum(p[key]["den"] for p in partials)
+        num = jax.tree_util.tree_map(
+            lambda *ls: sum(ls), *[p[key]["num"] for p in partials])
+        return jax.tree_util.tree_map(lambda l: l / den, num)
+
+    agg: Dict[str, Any] = {
+        "n_sampled": sum(p["n_sampled"] for p in partials)}
+    if spec.avg_params:
+        agg["avg_params"] = finish("avg_params")
+    for a in spec.aggregates:
+        if a.kind in ("wavg", "scalar"):
+            agg[a.name] = finish(a.name)
+        else:  # sum — already associative
+            agg[a.name] = sum(p[a.name] for p in partials)
+    return agg
+
+
 # --------------------------------------------------------------------------
 # trace-time-dynamic hyperparameters
 # --------------------------------------------------------------------------

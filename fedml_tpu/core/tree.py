@@ -184,3 +184,67 @@ def cohort_scatter(table: Pytree, cohort, new_rows: Pytree) -> Pytree:
     return jax.tree_util.tree_map(
         lambda t, n: t.at[cohort].set(n.astype(t.dtype), mode="drop"),
         table, new_rows)
+
+
+def client_table_nbytes(params: Pytree, rows: int) -> int:
+    """Host/HBM bytes a DENSE ``rows``-client state table would occupy —
+    the number the sparse store (``fedml_tpu/store``) exists to avoid
+    allocating: at production populations (10^6 registered users) this is
+    tens of GiB for even a small model, while only the active cohort's
+    rows are ever needed."""
+    return rows * sum(x.size * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(params))
+
+
+# -- sparse host-side row ops (fedml_tpu/store) ------------------------------
+# The paged client-state store keeps rows as numpy pages on the HOST keyed
+# by client id; these are the gather/scatter primitives it composes —
+# numpy twins of cohort_gather/cohort_scatter with the same out-of-range
+# semantics (reads fill zero, writes drop), so a sparse-backed round sees
+# bitwise the dense table's cohort stack.
+
+def page_groups(ids, page_size: int, n_rows: int):
+    """Group the in-range entries of ``ids`` by page: yields
+    ``(page_id, in_page_rows, cohort_positions)`` so a paged gather or
+    scatter touches each page exactly once.  Ids outside ``[0, n_rows)``
+    (the padded-cohort sentinel) are skipped — the sparse twin of
+    ``mode="fill"`` / ``mode="drop"`` above."""
+    import numpy as np
+    ids = np.asarray(ids, np.int64)
+    pos_all = np.nonzero((ids >= 0) & (ids < n_rows))[0]
+    pids = ids[pos_all] // page_size
+    for pid in np.unique(pids):
+        pos = pos_all[pids == pid]
+        yield int(pid), ids[pos] - int(pid) * page_size, pos
+
+
+def rows_gather_np(pages_get, ids, template: Pytree, n_rows: int,
+                   page_size: int):
+    """Stack rows ``ids`` from a paged host store into one numpy pytree
+    with a leading cohort axis.  ``pages_get(page_id)`` returns the page's
+    per-leaf ``(page_size, ...)`` numpy list (materializing it if needed);
+    ``template`` fixes per-row shapes/dtypes.  Out-of-range ids (padded
+    cohort sentinel) read as zero rows, matching ``cohort_gather``."""
+    import numpy as np
+    ids = np.asarray(ids, np.int64)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out = [np.zeros((len(ids),) + tuple(l.shape), l.dtype) for l in leaves]
+    for pid, rows, pos in page_groups(ids, page_size, n_rows):
+        page = pages_get(pid)
+        for leaf_out, leaf_page in zip(out, page):
+            leaf_out[pos] = leaf_page[rows]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def rows_scatter_np(pages_get, ids, new_rows: Pytree, n_rows: int,
+                    page_size: int):
+    """Write cohort-stacked ``new_rows`` back into the paged host store.
+    Ids outside ``[0, n_rows)`` (the padded-cohort sentinel) drop, matching
+    ``cohort_scatter(mode="drop")``."""
+    import numpy as np
+    leaves = jax.tree_util.tree_leaves(new_rows)
+    for pid, rows, pos in page_groups(ids, page_size, n_rows):
+        page = pages_get(pid)
+        for leaf_page, leaf_new in zip(page, leaves):
+            leaf_page[rows] = np.asarray(leaf_new)[pos].astype(
+                leaf_page.dtype)

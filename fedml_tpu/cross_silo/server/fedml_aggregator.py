@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
+from ...core import federated
 from ...core import rng as rng_util
 from ...core import tree as tree_util
 from ...core.dp.fedml_differential_privacy import FedMLDifferentialPrivacy
@@ -37,6 +38,7 @@ class FedMLAggregator:
         params = model.init(rng_util.purpose_key(key, "init"))
         self.state = self.server_opt.init(params)
         self.model_dict: Dict[int, Any] = {}
+        self.partial_dict: Dict[int, Any] = {}
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {
             i: False for i in range(self.client_num)}
@@ -53,6 +55,32 @@ class FedMLAggregator:
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True
+
+    # -- two-tier silo->server aggregation (docs/CLIENT_STORE.md) ----------
+    def add_local_partial_aggregate(self, index: int, partial,
+                                    sample_num):
+        """Hierarchical upload path (arXiv:2604.10859): silo ``index``
+        ships the PARTIAL aggregate of its whole cohort slice
+        (``ServerOptimizer.compute_partial_aggregates``) instead of raw
+        per-client models — the server-side payload scales with the silo
+        count, not the cohort size.  Rides the same received-flag
+        round-barrier as raw uploads."""
+        self.partial_dict[index] = partial
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def aggregate_partials(self):
+        """Combine the buffered silo partials exactly
+        (``federated.combine_partial_aggregates``) and run the unchanged
+        server transition.  Matches :meth:`aggregate` over the union of
+        the silos' clients to float-reassociation error."""
+        idxs = sorted(self.partial_dict.keys())
+        partials = [self.partial_dict[i] for i in idxs]
+        agg = federated.combine_partial_aggregates(self.server_opt.spec,
+                                                   partials)
+        self.state = self.server_opt.update_from_aggregates(self.state, agg)
+        self.partial_dict.clear()
+        return self.state.global_params
 
     def check_whether_all_receive(self) -> bool:
         if not all(self.flag_client_model_uploaded_dict.values()):
@@ -73,6 +101,11 @@ class FedMLAggregator:
     user_aggregator = None
 
     def aggregate(self):
+        if self.partial_dict and not self.model_dict:
+            # hierarchical round: every buffered upload was a silo partial
+            # — the server manager's existing all-received -> aggregate()
+            # flow needs no changes to run the two-tier topology
+            return self.aggregate_partials()
         idxs = sorted(self.model_dict.keys())
         raw_list = [(self.sample_num_dict[i], self.model_dict[i]) for i in idxs]
         if self.user_aggregator is not None:

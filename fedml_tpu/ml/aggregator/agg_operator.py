@@ -241,6 +241,29 @@ class ServerOptimizer:
         return federated.build_aggregates(self.spec, federated.StackedReducer(),
                                           self, state, outs, weights, hp)
 
+    def compute_partial_aggregates(self, state: ServerState,
+                                   client_params_stacked: Any,
+                                   weights: jnp.ndarray,
+                                   aux: Optional[dict] = None,
+                                   hp=None) -> dict:
+        """Silo tier of the two-tier hierarchical aggregation
+        (docs/CLIENT_STORE.md): same spec-declared aggregates as
+        :meth:`compute_aggregates`, but reduced with a
+        ``core.federated.PartialReducer`` so every weighted entry stays an
+        unfinished ``{num, den}`` pair — S silo partials then combine
+        EXACTLY at the server via
+        ``federated.combine_partial_aggregates`` before ONE
+        :meth:`update_from_aggregates`."""
+        import types
+        aux = aux or {}
+        outs = types.SimpleNamespace(
+            params=client_params_stacked, delta_c=aux.get("delta_c"),
+            tau=aux.get("tau"), grad_sum=aux.get("grad_sum"),
+            loss=aux.get("loss"))
+        return federated.build_aggregates(
+            self.spec, federated.PartialReducer(), self, state, outs,
+            weights, hp)
+
     def merge_aggregates(self, aggs, total_ws) -> dict:
         """Combine per-bucket aggregates (see
         ``round_engine.make_bucket_agg_fn``) into one cohort aggregate.

@@ -501,7 +501,9 @@ class MeshFedAvgAPI(FedAvgAPI):
             quantized=self.collective_precision != "fp32"))
         self._stager = AsyncCohortStager(
             self._stage_cohort,
-            enabled=bool(getattr(args, "async_staging", True)))
+            enabled=bool(getattr(args, "async_staging", True)),
+            depth=int(getattr(args, "staging_depth", 1) or 1),
+            limit=self.comm_rounds)
 
     def _build_round_fn(self, client_mode: str):
         # device_data: True/"replicated" | "sharded" | False ("host")
@@ -565,10 +567,22 @@ class MeshFedAvgAPI(FedAvgAPI):
         the model axis (row contents): each chip permanently owns its
         slice of the SCAFFOLD/FedDyn state; cohort rows move by
         gather/scatter collectives inside the compiled round."""
-        self._table_rows = -(-self.dataset.num_clients
+        self._table_rows = -(-self.registered_clients
                              // self.n_shards) * self.n_shards
         table = tree_util.client_table_init(self.state.global_params,
                                             self._table_rows)
+        return jax.device_put(table, self.layout.table_sharding(table))
+
+    def _put_rows(self, rows):
+        """Host cohort-row stack from the paged store -> device with the
+        leading cohort axis sharded over ``client`` (the same resting
+        placement the dense table's jitted gather produced)."""
+        return jax.device_put(rows, NamedSharding(self.mesh, P(CLIENT_AXIS)))
+
+    def _put_table(self, table):
+        """Fused-block store path: the block's mini-table takes the dense
+        table's sharding (rows over ``client``, contents over ``model`` on
+        2-D layouts)."""
         return jax.device_put(table, self.layout.table_sharding(table))
 
     def _build_block_fn(self):
@@ -603,12 +617,13 @@ class MeshFedAvgAPI(FedAvgAPI):
         for r in rounds:
             clients = self._client_sampling(r)
             idx, mask, w = self.dataset.cohort_indices(
-                clients, self.batch_size, self.seed, r, self.epochs)
+                self._data_ids(clients), self.batch_size, self.seed, r,
+                self.epochs)
             per.append((clients, idx, mask, w))
         n = per[0][1].shape[0]
         n_padded = -(-n // self.n_shards) * self.n_shards
         steps = next_pow2(max(p[1].shape[1] for p in per))
-        sentinel = getattr(self, "_table_rows", self.dataset.num_clients)
+        sentinel = getattr(self, "_table_rows", self.registered_clients)
         idx_blk = np.zeros((k, n_padded, steps, self.batch_size), np.int32)
         mask_blk = np.zeros((k, n_padded, steps), np.float32)
         w_blk = np.zeros((k, n_padded), np.float32)
@@ -638,7 +653,8 @@ class MeshFedAvgAPI(FedAvgAPI):
         pad_c = n_padded - n
         if self._gather:
             idx, mask, w = self.dataset.cohort_indices(
-                clients, self.batch_size, self.seed, round_idx, self.epochs)
+                self._data_ids(clients), self.batch_size, self.seed,
+                round_idx, self.epochs)
             steps = next_pow2(idx.shape[1])
             pad_s = steps - idx.shape[1]
             if pad_s or pad_c:
@@ -648,7 +664,8 @@ class MeshFedAvgAPI(FedAvgAPI):
             data_x, data_y = idx, self._dev_data
         else:
             x, y, mask, w = self.dataset.cohort_batches(
-                clients, self.batch_size, self.seed, round_idx, self.epochs)
+                self._data_ids(clients), self.batch_size, self.seed,
+                round_idx, self.epochs)
             steps = next_pow2(x.shape[1])
             pad_s = steps - x.shape[1]
             if pad_s or pad_c:
@@ -672,12 +689,12 @@ class MeshFedAvgAPI(FedAvgAPI):
         # out-of-range sentinel so their writes drop
         cohort = None
         c_stacked = None
-        if self.client_table is not None:
+        if self.client_table is not None or self._pager is not None:
             cohort = np.concatenate(
                 [np.asarray(clients, np.int32),
                  np.full(pad_c, self._table_rows, np.int32)])
-            c_stacked = self._gather_c(cohort)
+            c_stacked = self._gather_c(cohort, round_idx=round_idx)
         self.state, metrics, new_c = self.round_fn(
             self.state, data_x, data_y, mask, w, key, c_stacked)
-        self._scatter_c(cohort, new_c)
+        self._scatter_c(cohort, new_c, round_idx=round_idx)
         return metrics

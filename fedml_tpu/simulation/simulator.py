@@ -47,6 +47,13 @@ class SimulatorSingleProcess:
             from .sp.fedgan import FedGANAPI
             idxs = [dataset.client_idxs[c] for c in range(dataset.num_clients)]
             self.fl_trainer = FedGANAPI(args, dataset.train_x, idxs)
+        elif int(getattr(args, "num_silos", 0) or 0) > 1:
+            # two-tier silo→server aggregation (docs/CLIENT_STORE.md):
+            # works for ANY registered AlgorithmSpec, so it's selected by
+            # topology (num_silos), not by optimizer name
+            from ..store import HierarchicalSiloAPI
+            self.fl_trainer = HierarchicalSiloAPI(args, device, dataset,
+                                                  model, client_mode=mode)
         else:
             # FedAvg / FedProx / FedOpt / SCAFFOLD / FedNova / FedDyn / Mime /
             # FedSGD — all branches of the jitted round engine

@@ -139,15 +139,43 @@ class FedAvgAPI:
             # per member inside the vmapped round as hparams differ
             self.state = federated.stack_member_states(
                 self.state, self.population.size)
+        # Registered-population sampling (fedstore, docs/CLIENT_STORE.md):
+        # the client ID SPACE may exceed the dataset's client count —
+        # cohorts sample from ``registered_clients`` ids, per-client STATE
+        # is keyed by the full id, and data/weights come from the dataset
+        # client ``id % num_clients``.  Default (0) = the historical
+        # one-id-per-dataset-client behavior, bitwise unchanged.
+        self.registered_clients = (
+            int(getattr(args, "registered_clients", 0) or 0)
+            or self.dataset.num_clients)
+        if self.registered_clients < self.dataset.num_clients:
+            raise ValueError(
+                f"registered_clients={self.registered_clients} < dataset "
+                f"client count {self.dataset.num_clients}")
         self.round_fn = self._build_round_fn(client_mode)
         # Per-client algorithm state (SCAFFOLD control variates c_i / FedDyn
         # lagrangian residuals ∇̂_i) lives DEVICE-resident between rounds as
         # a dense (num_clients, ...) table gathered/scattered by cohort ids
         # inside the compiled program — the old host dict forced a
-        # device_get + tree_stack every round (ISSUE 3 tentpole).
-        self.client_table = (
-            self._init_client_table()
-            if self.server_opt.spec.client_state else None)
+        # device_get + tree_stack every round (ISSUE 3 tentpole).  With
+        # ``args.client_store`` the dense table is replaced by the paged
+        # host-side sparse store (fedml_tpu/store): only the active
+        # cohort's rows are ever device-resident, page-in overlaps compute
+        # through the AsyncCohortStager double buffer, and updated rows
+        # write back asynchronously after each round/block.
+        self._store = None
+        self._pager = None
+        self.client_table = None
+        if self.server_opt.spec.client_state:
+            if bool(getattr(args, "client_store", False)):
+                if self.population:
+                    raise ValueError(
+                        "incompatible flags: client_store pages ONE "
+                        "experiment's rows; population/population_axes "
+                        "needs the dense member-stacked table")
+                self._init_client_store()
+            else:
+                self.client_table = self._init_client_table()
         if self.population and self.client_table is not None:
             self.client_table = federated.stack_member_states(
                 self.client_table, self.population.size)
@@ -203,21 +231,74 @@ class FedAvgAPI:
     # -- round pieces ------------------------------------------------------
     def _client_sampling(self, round_idx: int) -> np.ndarray:
         return rng_util.sample_clients(self.seed, round_idx,
-                                       self.dataset.num_clients,
+                                       self.registered_clients,
                                        self.clients_per_round)
+
+    def _data_ids(self, clients) -> np.ndarray:
+        """Dataset client ids backing a cohort of REGISTERED ids: identity
+        in the historical case, modulo fold when the registered population
+        exceeds the dataset's client count (docs/CLIENT_STORE.md)."""
+        clients = np.asarray(clients)
+        if self.registered_clients == self.dataset.num_clients:
+            return clients
+        return clients % self.dataset.num_clients
 
     def _init_client_table(self):
         """Dense per-client state table: row ``c`` is client ``c``'s
         SCAFFOLD c_i / FedDyn ∇̂_i, zero-initialized (the dict semantics'
         ``get(c, zeros)`` default).  The mesh engine overrides this to pad
         the row count and shard the rows over the client axis."""
-        self._table_rows = self.dataset.num_clients
+        self._table_rows = self.registered_clients
         params = self.state.global_params
         if self.population:
             # rows are shaped like ONE member's params; the driver stacks
             # the finished table onto the member axis afterwards
             params = federated.population_member(params, 0)
         return tree_util.client_table_init(params, self._table_rows)
+
+    def _init_client_store(self):
+        """Paged sparse host store replacing the dense table
+        (fedml_tpu/store, docs/CLIENT_STORE.md): host RSS scales with the
+        TOUCHED id set (LRU-capped with spill), not the registered
+        population, and the traced round is unchanged — the pager hands
+        the round the same cohort-stacked rows the dense gather did."""
+        from ...store import ClientStateStore, CohortStatePager
+        args = self.args
+        self._table_rows = self.registered_clients  # mesh pad sentinel
+        row_t = jax.tree_util.tree_map(
+            lambda p: np.zeros(p.shape, p.dtype), self.state.global_params)
+        self._store = ClientStateStore(
+            row_t, self.registered_clients,
+            page_size=int(getattr(args, "store_page_size", 256) or 256),
+            max_resident_pages=int(getattr(args, "store_max_pages", 0)
+                                   or 0),
+            spill_dir=getattr(args, "store_spill_dir", None))
+        self._pager = CohortStatePager(
+            self._store, self._cohort_ids_for,
+            depth=int(getattr(args, "staging_depth", 1) or 1),
+            stride=self._round_block, limit=self.comm_rounds,
+            enabled=bool(getattr(args, "async_staging", True)))
+
+    def _cohort_ids_for(self, round_idx: int) -> np.ndarray:
+        """State ids round (or fused block starting at) ``round_idx``
+        touches — pure in the round index, so the pager's worker thread
+        may page them in ahead of time."""
+        if self._round_block > 1:
+            k = min(self._round_block, self.comm_rounds - round_idx)
+            return np.unique(np.concatenate(
+                [self._client_sampling(r)
+                 for r in range(round_idx, round_idx + k)]))
+        return self._client_sampling(round_idx)
+
+    def _put_rows(self, rows):
+        """Host cohort-row stack -> device (the mesh engine shards the
+        leading cohort axis)."""
+        return jax.tree_util.tree_map(jnp.asarray, rows)
+
+    def _put_table(self, table):
+        """Host mini-table -> device, for the fused-block store path (the
+        mesh engine applies its table sharding)."""
+        return jax.tree_util.tree_map(jnp.asarray, table)
 
     def _table_ops(self):
         """Jitted cohort gather/scatter over the client-state table, built
@@ -235,15 +316,33 @@ class FedAvgAPI:
                 jax.jit(scatter, donate_argnums=(0,)))
         return self._ct_ops
 
-    def _gather_c(self, cohort):
+    def _gather_c(self, cohort, round_idx=None):
         """Stack the cohort's per-client state rows — an HBM→HBM gather on
-        the device table (no host dict, no per-round tree_stack)."""
+        the device table (no host dict, no per-round tree_stack), or a
+        host-store page-in + gather when the paged store is enabled (the
+        pager prefetches the NEXT round's pages on its worker thread)."""
+        if self._pager is not None:
+            r = int(round_idx or 0)
+            nxt = r + self._round_block
+            rows = self._pager.gather(
+                r, cohort,
+                prefetch=nxt if nxt < self.comm_rounds else None)
+            return self._put_rows(rows)
         if self.client_table is None:
             return None
         return self._table_ops()[0](self.client_table, cohort)
 
-    def _scatter_c(self, cohort, new_state_stacked):
-        if self.client_table is None or new_state_stacked is None:
+    def _scatter_c(self, cohort, new_state_stacked, round_idx=None):
+        if new_state_stacked is None:
+            return
+        if self._pager is not None:
+            # asynchronous write-back: the device→host materialization and
+            # store scatter run on the pager's writer thread; the next
+            # gather drains it before reading
+            self._pager.write_back(int(round_idx or 0), cohort,
+                                   new_state_stacked)
+            return
+        if self.client_table is None:
             return
         self.client_table = self._table_ops()[1](self.client_table, cohort,
                                                  new_state_stacked)
@@ -256,7 +355,7 @@ class FedAvgAPI:
         splits; gated to the stateless weighted-average algorithms."""
         from ..round_engine import make_bucket_agg_fn
 
-        clients = self._client_sampling(round_idx)
+        clients = self._data_ids(self._client_sampling(round_idx))
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
         per = [self.dataset.client_batches(int(c), self.batch_size, self.seed,
                                            round_idx, self.epochs)
@@ -317,13 +416,13 @@ class FedAvgAPI:
         clients = self._client_sampling(round_idx)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
         cohort = np.asarray(clients, dtype=np.int32)
-        c_stacked = self._gather_c(cohort)
+        c_stacked = self._gather_c(cohort, round_idx=round_idx)
         if hasattr(self, "_dev_x"):
             with self._tracer.span("staging", cat="staging",
                                    round=round_idx):
                 idx, mask, w = self.dataset.cohort_indices(
-                    clients, self.batch_size, self.seed, round_idx,
-                    self.epochs)
+                    self._data_ids(clients), self.batch_size, self.seed,
+                    round_idx, self.epochs)
                 # pad steps to pow2 buckets → bounded recompile count
                 steps = next_pow2(idx.shape[1])
                 if steps != idx.shape[1]:
@@ -343,8 +442,8 @@ class FedAvgAPI:
             with self._tracer.span("staging", cat="staging",
                                    round=round_idx):
                 x, y, mask, w = self.dataset.cohort_batches(
-                    clients, self.batch_size, self.seed, round_idx,
-                    self.epochs)
+                    self._data_ids(clients), self.batch_size, self.seed,
+                    round_idx, self.epochs)
                 steps = next_pow2(x.shape[1])
                 if steps != x.shape[1]:
                     pad = steps - x.shape[1]
@@ -357,7 +456,7 @@ class FedAvgAPI:
                                  jnp.asarray(mask), jnp.asarray(w))
             self.state, metrics, new_c = self.round_fn(
                 self.state, x, y, mask, w, key, c_stacked)
-        self._scatter_c(cohort, new_c)
+        self._scatter_c(cohort, new_c, round_idx=round_idx)
         metrics = dict(metrics)
         metrics["allocated_steps"] = len(clients) * steps
         return metrics
@@ -403,7 +502,8 @@ class FedAvgAPI:
         for r in rounds:
             clients = self._client_sampling(r)
             idx, mask, w = self.dataset.cohort_indices(
-                clients, self.batch_size, self.seed, r, self.epochs)
+                self._data_ids(clients), self.batch_size, self.seed, r,
+                self.epochs)
             per.append((clients, idx, mask, w))
         steps = next_pow2(max(p[1].shape[1] for p in per))
         n = per[0][1].shape[0]
@@ -434,7 +534,9 @@ class FedAvgAPI:
         if self._block_stager is None:
             self._block_stager = AsyncCohortStager(
                 self._stage_block,
-                enabled=bool(getattr(self.args, "async_staging", True)))
+                enabled=bool(getattr(self.args, "async_staging", True)),
+                depth=int(getattr(self.args, "staging_depth", 1) or 1),
+                stride=self._round_block, limit=self.comm_rounds)
         nxt = start_round + self._round_block
         k, steps, idx, mask, w, keys, cohort = self._block_stager.get(
             start_round, prefetch=nxt if nxt < self.comm_rounds else None)
@@ -442,6 +544,9 @@ class FedAvgAPI:
             self.state, metrics, self.client_table = self._block_fn(
                 self.state, idx, mask, w, keys, cohort, self.client_table,
                 self.population.hparams)
+        elif self._pager is not None:
+            metrics = self._train_block_store(start_round, idx, mask, w,
+                                              keys, cohort)
         else:
             self.state, metrics, self.client_table = self._block_fn(
                 self.state, idx, mask, w, keys, cohort, self.client_table)
@@ -449,6 +554,42 @@ class FedAvgAPI:
         metrics["allocated_steps"] = np.full(
             k, idx.shape[1] * steps, np.int64)
         return k, metrics
+
+    def _train_block_store(self, start_round: int, idx, mask, w, keys,
+                           cohort):
+        """Fused K-round block against the paged store: the block's
+        TOUCHED rows page into a device mini-table whose slot count is the
+        block's cohort capacity (a trace-time static, so steady-state
+        blocks reuse one compiled program), cohort ids remap to slots, and
+        the whole mini-table writes back asynchronously after the ONE
+        dispatch — same compiled block the dense table runs, different
+        backing plane."""
+        cohort_np = np.asarray(cohort)
+        sentinel = self._table_rows
+        real = np.unique(cohort_np)
+        real = real[real < sentinel]
+        shards = int(getattr(self, "n_shards", 1))
+        n_slots = -(-cohort_np.size // shards) * shards
+        local = np.searchsorted(real, cohort_np)
+        local = np.where(cohort_np < sentinel, local, n_slots).astype(
+            np.int32).reshape(cohort_np.shape)
+        nxt = start_round + self._round_block
+        rows = self._pager.gather(
+            start_round, real,
+            prefetch=nxt if nxt < self.comm_rounds else None)
+        mini = jax.tree_util.tree_map(
+            lambda r: np.concatenate(
+                [r, np.zeros((n_slots - r.shape[0],) + r.shape[1:],
+                             r.dtype)]), rows)
+        self.state, metrics, table = self._block_fn(
+            self.state, idx, mask, w, keys, jnp.asarray(local),
+            self._put_table(mini))
+        # padded id vector (fixed length, sentinel-dropped writes) so the
+        # write-back path never shape-specializes on the touched-row count
+        ids = np.full(n_slots, self.registered_clients, np.int64)
+        ids[:len(real)] = real
+        self._pager.write_back(start_round, ids, table)
+        return metrics
 
     def evaluate(self):
         with self._tracer.span("eval", cat="eval"):
@@ -534,9 +675,12 @@ class FedAvgAPI:
         if ckpt is None or ckpt.latest_round() is None:
             return 0
         state, client_state = ckpt.restore(
-            template=(self.state, self.client_table))
+            template=(self.state,
+                      self._store if self._store is not None
+                      else self.client_table))
         self.state = state
-        if self.client_table is not None and client_state is not None:
+        if self.client_table is not None and client_state is not None \
+                and client_state is not self._store:
             self.client_table = client_state
         return int(ckpt.latest_round()) + 1
 
@@ -551,7 +695,12 @@ class FedAvgAPI:
         due = (round_idx == self.comm_rounds - 1
                or any((round_idx - j) % freq == 0 for j in range(window)))
         if due:
-            ckpt.save(round_idx, self.state, self.client_table)
+            if self._pager is not None:
+                # a checkpoint must capture every completed round's rows
+                self._pager.drain_writebacks()
+            ckpt.save(round_idx, self.state,
+                      self._store if self._store is not None
+                      else self.client_table)
 
     # -- main loop (reference fedavg_api.py:66 train) ----------------------
     def _is_log_round(self, round_idx: int) -> bool:
@@ -675,6 +824,11 @@ class FedAvgAPI:
                 self.maybe_checkpoint(round_idx)
             self._flush_round_records(pending)
         total = time.time() - t_start
+        if self._pager is not None:
+            # the training loop is done: make the store consistent with the
+            # final round before anyone reads/checkpoints it
+            self._pager.drain_writebacks()
+            log.info("fedstore: %s", self._pager.stats())
         log.info("finished %d rounds in %.1fs (%.3fs/round)",
                  self.comm_rounds, total, total / max(self.comm_rounds, 1))
         if self._tracer.enabled and self._tracer.path:

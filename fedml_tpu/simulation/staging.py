@@ -6,13 +6,26 @@ program for round/block ``r`` runs, a single worker thread builds and stages
 ``r+1`` so host work overlaps device compute instead of serializing in front
 of every dispatch.  Both the per-round mesh path and the fused round-block
 drivers (``args.round_block``) stage through this class — fused blocks key
-the stager by the block's first round index.
+the stager by the block's first round index.  The client-state store's pager
+(``store/pager.py``) rides the same class: its "build" is a page-in of the
+round's cohort rows, so host paging overlaps device compute exactly like
+cohort staging does.
+
+``depth`` (``args.staging_depth``) sets how many future rounds stay in
+flight: ``get(r, prefetch=nxt)`` schedules ``nxt, nxt+stride, ...`` up to
+``depth`` pending builds (``stride`` is the round-block size for fused
+drivers, 1 otherwise; ``limit`` caps scheduling at the last real round).
+``stats()`` reports prefetch hits / synchronous misses / worker restarts —
+counters the store's pager re-exports as paging telemetry.
 
 Failure semantics (hardened in ISSUE 3): a ``build`` exception on the worker
 thread re-raises at the NEXT ``get()`` regardless of which round it was
 speculatively built for, stale pending futures for already-passed rounds are
 dropped, and ``close()`` is idempotent (a closed stager degrades to
-synchronous builds instead of raising on a shut-down executor).
+synchronous builds instead of raising on a shut-down executor).  After a
+delivered failure the worker pool is torn down and rebuilt (counted in
+``stats()["worker_restarts"]``) so a poisoned thread never serves the next
+speculative build.
 """
 
 from __future__ import annotations
@@ -34,13 +47,20 @@ class AsyncCohortStager:
     single attribute check when tracing is off.
     """
 
-    def __init__(self, build, enabled: bool = True):
+    def __init__(self, build, enabled: bool = True, depth: int = 1,
+                 stride: int = 1, limit=None):
         self._build = build
         self._enabled = enabled
+        self._depth = max(int(depth), 1)
+        self._stride = max(int(stride), 1)
+        self._limit = limit
         self._pool = ThreadPoolExecutor(max_workers=1) if enabled else None
         self._pending = {}
         self._failed = None   # first uncollected worker-thread exception
         self._closed = False
+        self._hits = 0
+        self._misses = 0
+        self._restarts = 0
 
     def _traced_build(self, round_idx: int):
         tr = get_tracer()
@@ -57,6 +77,21 @@ class AsyncCohortStager:
                 self._failed = e
             raise
 
+    def _restart_pool(self):
+        """Tear down and rebuild the worker after a delivered failure so a
+        poisoned speculative build never serves the next round.  Every
+        pending speculative future belonged to the old pool — cancel and
+        drop them (the driver rebuilds those rounds synchronously) so a
+        later ``get()`` never surfaces a bare ``CancelledError``."""
+        if not self._enabled or self._closed:
+            return
+        for f in self._pending.values():
+            f.cancel()
+        self._pending.clear()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._restarts += 1
+
     def get(self, round_idx: int, prefetch=None):
         # a pending future for an already-passed round can never be
         # consumed — drop it so it neither leaks nor masks a failure
@@ -71,6 +106,7 @@ class AsyncCohortStager:
             for f in self._pending.values():
                 f.cancel()
             self._pending.clear()
+            self._restart_pool()
             raise err
         if fut is not None:
             try:
@@ -79,17 +115,33 @@ class AsyncCohortStager:
                 # this failure is being delivered right here; don't
                 # re-deliver it on the next get()
                 self._failed = None
+                self._restart_pool()
                 raise
+            self._hits += 1
         else:
             staged = self._traced_build(round_idx)
-        if self._enabled and not self._closed and prefetch is not None \
-                and prefetch not in self._pending:
-            self._pending[prefetch] = self._pool.submit(
-                self._worker_build, prefetch)
+            self._misses += 1
+        if self._enabled and not self._closed and prefetch is not None:
+            for i in range(self._depth):
+                nxt = prefetch + i * self._stride
+                if self._limit is not None and nxt >= self._limit:
+                    break
+                if nxt not in self._pending:
+                    self._pending[nxt] = self._pool.submit(
+                        self._worker_build, nxt)
         tr = get_tracer()
         if tr.enabled:
             tr.counter("staging.queue_depth", len(self._pending))
         return staged
+
+    def stats(self) -> dict:
+        """Prefetch effectiveness counters: ``hits`` (served from a
+        speculative worker build), ``misses`` (built synchronously in front
+        of the dispatch), ``worker_restarts`` (pool rebuilds after a
+        delivered build failure), ``pending`` (builds in flight)."""
+        return {"hits": self._hits, "misses": self._misses,
+                "worker_restarts": self._restarts,
+                "pending": len(self._pending)}
 
     def close(self):
         if self._closed:

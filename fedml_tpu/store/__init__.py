@@ -1,0 +1,28 @@
+"""fedstore — the paged million-client state plane (docs/CLIENT_STORE.md).
+
+The dense device-resident ``client_table`` (``core/tree.py``) allocates
+``registered × |row|`` whether or not a client was ever sampled — fine at
+256 simulated clients, impossible at production populations (10^6
+registered users × a 7850-param LR row ≈ 29 GiB).  This package keeps
+per-client algorithm state (SCAFFOLD control variates, FedDyn residuals)
+in a host-side sparse store instead: rows live in fixed-size pages keyed
+by client id, pages materialize lazily on first touch, an LRU cap spills
+cold pages to disk, and only the active cohort's rows are ever
+device-resident.  Page-in rides the ``AsyncCohortStager`` double buffer so
+paging overlaps device compute, and write-back is asynchronous — the
+traced round sees the exact same gathered-row pytree the dense table
+produced, so the compiled program never changes.
+
+Also here: the two-tier silo→server aggregation built on the PR 7 round
+algebra (``core/federated.py`` :class:`PartialReducer` /
+:func:`combine_partial_aggregates`) — each silo reduces its cohort slice
+to a weighted partial aggregate and the server combines S partials, in
+process (:class:`HierarchicalSiloAPI`) or over the cross-silo message
+path (``cross_silo/server/fedml_aggregator.py``).
+"""
+
+from .clientstore import ClientStateStore
+from .pager import CohortStatePager
+from .hierarchy import HierarchicalSiloAPI
+
+__all__ = ["ClientStateStore", "CohortStatePager", "HierarchicalSiloAPI"]

@@ -1,0 +1,239 @@
+"""ClientStateStore — host-side sparse, hash-paged per-client state.
+
+Layout: a hash map assigns each client id a dense SLOT on first write
+(``{client_id -> slot}``); slot ``s`` lives in page ``s // page_size`` at
+row ``s % page_size``, and a page is a list of per-leaf numpy arrays
+shaped ``(page_size,) + row_shape`` mirroring the row template pytree.
+Because slots are assigned in touch order, pages pack densely no matter
+how sparsely the ids scatter over the registered range — 2k random ids
+out of 10^6 occupy 8 pages, not 2k — and a client never written reads as
+a zero row WITHOUT allocating anything (the dict era's ``get(c, zeros)``
+default).  Host RSS therefore scales with the WRITTEN id set, not the
+registered population.  An optional LRU cap (``max_resident_pages``)
+bounds resident pages further by spilling cold pages to ``spill_dir`` as
+``.npz`` files and reloading them on demand — RSS then stays flat no
+matter how many clients have history.
+
+Thread-safety: one re-entrant lock around every page/slot-map mutation —
+the pager's worker thread pages in for round r+1 while the main thread
+gathers round r and the write-back thread applies round r-1
+(``store/pager.py`` sequences the value-visibility hazards; the lock only
+protects the maps themselves).
+
+Telemetry: page hits/misses/spills/loads plus cumulative paged-in bytes;
+when the global fedtrace tracer is enabled the store emits
+``store.page_in_bytes`` counters and ``store.page_in`` spans that
+``tools/fedtrace.py summarize`` surfaces (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core import tree as tree_util
+from ..obs import get_tracer
+
+Pytree = Any
+
+
+class ClientStateStore:
+    """Sparse hash-paged host store of per-client state rows.
+
+    ``row_template`` is ONE client's state pytree (shapes/dtypes; values
+    ignored); ``registered`` is the id space size.  ``gather``/``scatter``
+    have the dense table's exact out-of-range semantics (reads fill zero,
+    writes drop), so the device-facing cohort stack is interchangeable
+    with ``core.tree.cohort_gather``'s.
+    """
+
+    def __init__(self, row_template: Pytree, registered: int,
+                 page_size: int = 256, max_resident_pages: int = 0,
+                 spill_dir: Optional[str] = None):
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(
+            jax.tree_util.tree_map(np.asarray, row_template))
+        self.registered = int(registered)
+        self.page_size = max(int(page_size), 1)
+        self.max_resident_pages = int(max_resident_pages or 0)
+        self.spill_dir = spill_dir
+        if self.max_resident_pages and not spill_dir:
+            raise ValueError(
+                "max_resident_pages needs a spill_dir — evicting a page "
+                "without spill would drop client state")
+        # client id -> dense slot, assigned on first WRITE (a gather of a
+        # never-written id is a zero row and allocates nothing)
+        self._slot: Dict[int, int] = {}
+        # page id -> per-leaf (page_size, ...) arrays; OrderedDict in LRU
+        # order (most recently touched last)
+        self._pages: "OrderedDict[int, List[np.ndarray]]" = OrderedDict()
+        self._spilled: set = set()
+        self._lock = threading.RLock()
+        self.row_nbytes = sum(l.size * l.dtype.itemsize
+                              for l in self._leaves)
+        self._stats = {"page_hits": 0, "page_misses": 0, "spills": 0,
+                       "loads": 0, "page_in_bytes": 0}
+
+    # -- templates ---------------------------------------------------------
+    @property
+    def row_template(self) -> Pytree:
+        return jax.tree_util.tree_unflatten(self._treedef, self._leaves)
+
+    def _zeros_page(self) -> List[np.ndarray]:
+        return [np.zeros((self.page_size,) + tuple(l.shape), l.dtype)
+                for l in self._leaves]
+
+    def _slots_of(self, ids, create: bool) -> np.ndarray:
+        """Map client ids to dense slots; unknown or out-of-range ids map
+        to -1 (the zero-fill / drop sentinel of ``core.tree.page_groups``)
+        unless ``create`` allocates them in touch order."""
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.full(len(ids), -1, np.int64)
+        slot = self._slot
+        for i, c in enumerate(ids.tolist()):
+            if c < 0 or c >= self.registered:
+                continue
+            s = slot.get(c)
+            if s is None and create:
+                s = len(slot)
+                slot[c] = s
+            if s is not None:
+                out[i] = s
+        return out
+
+    # -- paging ------------------------------------------------------------
+    def _spill_path(self, pid: int) -> str:
+        return os.path.join(self.spill_dir, f"page_{pid}.npz")
+
+    def _page(self, pid: int) -> List[np.ndarray]:
+        """The page's leaf arrays, materializing (zeros) or reloading from
+        spill as needed; touches LRU order and hit/miss counters."""
+        with self._lock:
+            page = self._pages.get(pid)
+            if page is not None:
+                self._pages.move_to_end(pid)
+                self._stats["page_hits"] += 1
+                return page
+            self._stats["page_misses"] += 1
+            if pid in self._spilled:
+                with np.load(self._spill_path(pid)) as z:
+                    page = [np.ascontiguousarray(z[f"leaf_{i}"])
+                            for i in range(len(self._leaves))]
+                self._spilled.discard(pid)
+                self._stats["loads"] += 1
+            else:
+                page = self._zeros_page()
+            self._stats["page_in_bytes"] += \
+                self.page_size * self.row_nbytes
+            self._pages[pid] = page
+            self._evict_over_cap()
+            tr = get_tracer()
+            if tr.enabled:
+                tr.add_bytes("store.page_in_bytes",
+                             self.page_size * self.row_nbytes)
+            return page
+
+    def _evict_over_cap(self):
+        if not self.max_resident_pages:
+            return
+        while len(self._pages) > self.max_resident_pages:
+            pid, page = self._pages.popitem(last=False)  # LRU head
+            os.makedirs(self.spill_dir, exist_ok=True)
+            np.savez(self._spill_path(pid),
+                     **{f"leaf_{i}": l for i, l in enumerate(page)})
+            self._spilled.add(pid)
+            self._stats["spills"] += 1
+
+    def page_in(self, ids) -> int:
+        """Make every page holding an already-written row of ``ids``
+        resident (the pager calls this on the stager's worker thread so
+        disk loads overlap device compute).  Never-written ids need no
+        page — they gather as zeros.  Returns the pages touched."""
+        with self._lock:
+            slots = self._slots_of(ids, create=False)
+            slots = slots[slots >= 0]
+            pids = np.unique(slots // self.page_size)
+        tr = get_tracer()
+        if tr.enabled:
+            with tr.span("store.page_in", cat="staging",
+                         pages=int(len(pids))):
+                for pid in pids:
+                    self._page(int(pid))
+        else:
+            for pid in pids:
+                self._page(int(pid))
+        return len(pids)
+
+    # -- the device-facing cohort ops -------------------------------------
+    def gather(self, ids) -> Pytree:
+        """Cohort-stacked numpy rows for ``ids`` — same shapes, dtypes and
+        out-of-range zero-fill as the dense table's ``cohort_gather``
+        (never-written ids read zero without allocating)."""
+        with self._lock:
+            slots = self._slots_of(ids, create=False)
+            return tree_util.rows_gather_np(
+                self._page, slots, self.row_template, len(self._slot),
+                self.page_size)
+
+    def scatter(self, ids, new_rows: Pytree):
+        """Write cohort-stacked rows back, allocating slots for
+        first-seen ids; out-of-range ids drop (the padded-cohort
+        sentinel)."""
+        with self._lock:
+            slots = self._slots_of(ids, create=True)
+            tree_util.rows_scatter_np(self._page, slots, new_rows,
+                                      len(self._slot), self.page_size)
+
+    # -- accounting --------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            s = dict(self._stats)
+            s["resident_pages"] = len(self._pages)
+            s["spilled_pages"] = len(self._spilled)
+            s["touched_rows"] = len(self._slot)
+            s["resident_bytes"] = \
+                len(self._pages) * self.page_size * self.row_nbytes
+            total = s["page_hits"] + s["page_misses"]
+            s["page_hit_rate"] = s["page_hits"] / total if total else 0.0
+        return s
+
+    def dense_nbytes(self) -> int:
+        """What the dense table this store replaces would allocate."""
+        return tree_util.client_table_nbytes(self.row_template,
+                                             self.registered)
+
+    # -- checkpoint / migration -------------------------------------------
+    def to_checkpoint(self) -> Dict[str, np.ndarray]:
+        """Flat npz-able payload: the written rows (ids + per-leaf stacked
+        arrays) — sparse on disk exactly as in memory."""
+        with self._lock:
+            ids = np.array(sorted(self._slot), np.int64)
+            rows = self.gather(ids)
+        payload = {"ids": ids,
+                   "registered": np.asarray(self.registered, np.int64)}
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(rows)):
+            payload[f"leaf_{i}"] = leaf
+        return payload
+
+    def load_checkpoint(self, payload: Dict[str, np.ndarray]):
+        ids = np.asarray(payload["ids"], np.int64)
+        leaves = [payload[f"leaf_{i}"] for i in range(len(self._leaves))]
+        rows = jax.tree_util.tree_unflatten(self._treedef, leaves)
+        self.scatter(ids, rows)
+
+    def load_dense(self, table: Pytree):
+        """Migrate a legacy dense ``client_table`` pytree (leading row
+        axis) into the store — the checkpoint-compat path: old dense
+        checkpoints restore into a store-backed run unchanged."""
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(table)]
+        rows = leaves[0].shape[0]
+        if rows > self.registered:
+            raise ValueError(
+                f"dense table has {rows} rows but the store registers "
+                f"{self.registered} clients")
+        stacked = jax.tree_util.tree_unflatten(self._treedef, leaves)
+        self.scatter(np.arange(rows, dtype=np.int64), stacked)

@@ -1,0 +1,161 @@
+"""Two-tier silo→server aggregation — the in-process simulation driver.
+
+arXiv:2604.10859 ("Understanding Communication Backends in Cross-Silo
+FL") motivates the topology: a flat server ingesting every client update
+saturates long before the population does, while a silo tier that
+pre-reduces its own cohort slice ships S partial aggregates upward
+instead of C client updates.  PR 7's round algebra makes the silo tier
+nearly free to express: each silo runs the SAME spec-driven
+``build_aggregates`` the flat engines use, just with a
+:class:`~fedml_tpu.core.federated.PartialReducer` so its reductions stay
+unfinished ``{num, den}`` pairs; the server combines S partials with
+:func:`~fedml_tpu.core.federated.combine_partial_aggregates` and applies
+the unchanged ``ServerOptimizer`` transition.  Because weighted averages
+are associative in their numerators, the hierarchical round matches flat
+aggregation to float-reassociation error (pinned to 2e-5 in
+``tests/test_client_store.py``) for EVERY registered AlgorithmSpec —
+q-FedAvg included.
+
+The distributed twin of this driver is the partial-aggregate path on
+``cross_silo/server/fedml_aggregator.py`` (silos ship partials over the
+existing message plane); this class is the same math in one process, S
+compiled silo dispatches + 1 combine dispatch per round.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import federated
+from ..core import rng as rng_util
+from ..simulation.round_engine import make_run_clients, next_pow2
+from ..simulation.sp.fedavg_api import FedAvgAPI
+
+log = logging.getLogger(__name__)
+
+
+class HierarchicalSiloAPI(FedAvgAPI):
+    """FedAvgAPI with the round split across ``args.num_silos`` silos.
+
+    Each round: the cohort is sliced into S equal contiguous silo cohorts;
+    one jitted silo program (shared — same shapes per slice, so ONE
+    compile) reduces each slice to a partial aggregate; one jitted combine
+    program finishes the averages and runs the server transition.  Client
+    sampling, per-client rng streams, batch schedules and weights are
+    bitwise the flat engine's, so the only divergence from flat
+    aggregation is float reassociation in the summed numerators.
+    """
+
+    # the silo loop reuses state buffers across S dispatches per round
+    DONATE_STATE = False
+
+    def __init__(self, args, device, dataset, model,
+                 client_mode: str = "vmap"):
+        super().__init__(args, device, dataset, model, client_mode)
+        self.num_silos = int(getattr(args, "num_silos", 0) or 2)
+        if self.clients_per_round % self.num_silos:
+            raise ValueError(
+                f"client_num_per_round={self.clients_per_round} must "
+                f"divide evenly into num_silos={self.num_silos} silo "
+                "slices")
+        if self.collective_precision != "fp32":
+            raise ValueError(
+                "hierarchical silo aggregation combines fp32 partial "
+                "aggregates; collective_precision must stay 'fp32'")
+        self._silo_fn = None
+        self._combine_fn = None
+
+    def _build_silo_fns(self):
+        server_opt = self.server_opt
+        spec = server_opt.spec
+        run_clients = make_run_clients(self.trainer, server_opt,
+                                       self._client_mode)
+        red = federated.PartialReducer()
+        gather = hasattr(self, "_dev_x")
+        dev = (self._dev_x, self._dev_y) if gather else None
+
+        def silo_fn(state, x, y, mask, w, rngs, c):
+            if gather:
+                x, y = jnp.take(dev[0], x, axis=0), jnp.take(dev[1], x,
+                                                             axis=0)
+            outs = run_clients(state, x, y, mask, rngs, c)
+            partial = federated.build_aggregates(spec, red, server_opt,
+                                                 state, outs, w)
+            return (partial, jnp.sum(outs.loss * w),
+                    jnp.sum(outs.num_steps), outs.new_client_state)
+
+        def combine_fn(state, partials):
+            agg = federated.combine_partial_aggregates(spec, partials)
+            return server_opt.update_from_aggregates(state, agg)
+
+        self._silo_fn = jax.jit(silo_fn)
+        self._combine_fn = jax.jit(combine_fn)
+
+    def train_one_round(self, round_idx: int):
+        clients = self._client_sampling(round_idx)
+        cohort = np.asarray(clients, np.int32)
+        key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
+        with self._tracer.span("staging", cat="staging", round=round_idx):
+            if hasattr(self, "_dev_x"):
+                idx, mask, w = self.dataset.cohort_indices(
+                    self._data_ids(clients), self.batch_size, self.seed,
+                    round_idx, self.epochs)
+                steps = next_pow2(idx.shape[1])
+                if steps != idx.shape[1]:
+                    pad = steps - idx.shape[1]
+                    idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
+                    mask = np.pad(mask, [(0, 0), (0, pad)])
+                x = y = None
+            else:
+                x, y, mask, w = self.dataset.cohort_batches(
+                    self._data_ids(clients), self.batch_size, self.seed,
+                    round_idx, self.epochs)
+                steps = next_pow2(x.shape[1])
+                if steps != x.shape[1]:
+                    pad = steps - x.shape[1]
+                    x = np.pad(x, [(0, 0), (0, pad)]
+                               + [(0, 0)] * (x.ndim - 2))
+                    y = np.pad(y, [(0, 0), (0, pad)]
+                               + [(0, 0)] * (y.ndim - 2))
+                    mask = np.pad(mask, [(0, 0), (0, pad)])
+                idx = None
+        if self._silo_fn is None:
+            self._build_silo_fns()
+        # identical per-client streams to the flat round: ONE split of the
+        # round key over the whole cohort, then sliced per silo
+        rngs = np.asarray(jax.random.split(key, len(clients)))
+        c_stacked = self._gather_c(cohort, round_idx=round_idx)
+
+        s = self.num_silos
+        per = len(clients) // s
+        partials, new_cs = [], []
+        loss_w = steps_total = 0.0
+        for i in range(s):
+            sl = slice(i * per, (i + 1) * per)
+            xs = jnp.asarray(idx[sl] if idx is not None else x[sl])
+            ys = None if y is None else jnp.asarray(y[sl])
+            c_s = (None if c_stacked is None else
+                   jax.tree_util.tree_map(lambda t: t[sl], c_stacked))
+            partial, lw, ts, new_c = self._silo_fn(
+                self.state, xs, ys, jnp.asarray(mask[sl]),
+                jnp.asarray(w[sl]), jnp.asarray(rngs[sl]), c_s)
+            partials.append(partial)
+            new_cs.append(new_c)
+            loss_w = loss_w + lw
+            steps_total = steps_total + ts
+        self.state = self._combine_fn(self.state, tuple(partials))
+        if new_cs and new_cs[0] is not None:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs), *new_cs)
+            self._scatter_c(cohort, stacked, round_idx=round_idx)
+        metrics = {
+            "train_loss": loss_w / float(np.sum(w)),
+            "total_steps": steps_total,
+            "silos": s,
+            "allocated_steps": len(clients) * steps,
+        }
+        return metrics

@@ -1,0 +1,130 @@
+"""CohortStatePager — overlap store paging with device compute.
+
+Sequencing contract (what makes the sparse round bitwise the dense one):
+
+- **Page-in is speculative, value reads are not.**  The pager's
+  ``AsyncCohortStager`` build for round ``r+1`` only makes pages RESIDENT
+  (disk load / zero materialization — the expensive part); the actual row
+  values are read synchronously at ``gather(r+1)``, which happens after
+  round ``r``'s write-back has been applied.  A speculative page-in can
+  therefore never serve stale rows, no matter how cohorts overlap.
+- **Write-back is asynchronous but ordered.**  ``write_back`` enqueues the
+  device→host materialization + store scatter on a single writer thread
+  and returns immediately — the host never blocks on the round's outputs.
+  ``gather`` drains pending write-backs first, so reads always see every
+  completed round.  The drain is usually free: the writer finished while
+  the next round's compiled program ran.
+
+Telemetry: ``store.page_hit_rate`` (stager prefetch hits over total
+builds) and ``store.writeback_lag_rounds`` (write-backs still pending at
+gather time) ride the fedtrace counter plane next to the store's
+``store.page_in_bytes`` (docs/OBSERVABILITY.md; surfaced by
+``tools/fedtrace.py summarize``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..obs import get_tracer
+from ..simulation.staging import AsyncCohortStager
+from .clientstore import ClientStateStore
+
+Pytree = Any
+
+
+class CohortStatePager:
+    """Double-buffered page-in + deferred write-back for a
+    :class:`ClientStateStore`.
+
+    ``cohort_ids_fn(round_idx)`` must be a pure function of the round
+    index returning the client ids whose state that round touches (for a
+    fused block: the union of the block's cohorts) — the same purity
+    contract the cohort stager's ``build`` has, so the page-in may run
+    ahead on the worker thread.
+    """
+
+    def __init__(self, store: ClientStateStore,
+                 cohort_ids_fn: Callable[[int], np.ndarray],
+                 depth: int = 1, stride: int = 1,
+                 limit: Optional[int] = None, enabled: bool = True):
+        self.store = store
+        self._cohort_ids_fn = cohort_ids_fn
+        self._stager = AsyncCohortStager(self._page_in, enabled=enabled,
+                                         depth=depth, stride=stride,
+                                         limit=limit)
+        self._writer = ThreadPoolExecutor(max_workers=1)
+        self._pending_wb = deque()   # (round_idx, future)
+        self._wb_lock = threading.Lock()
+        self._closed = False
+
+    def _page_in(self, round_idx: int):
+        return self.store.page_in(self._cohort_ids_fn(round_idx))
+
+    # -- round-facing API --------------------------------------------------
+    def gather(self, round_idx: int, ids,
+               prefetch: Optional[int] = None) -> Pytree:
+        """Cohort-stacked host rows for ``ids``, with round ``round_idx``'s
+        pages resident (prefetched, else paged in synchronously) and every
+        pending write-back applied first."""
+        lag = self.drain_writebacks()
+        self._stager.get(round_idx, prefetch=prefetch)
+        rows = self.store.gather(ids)
+        tr = get_tracer()
+        if tr.enabled:
+            st = self._stager.stats()
+            total = st["hits"] + st["misses"]
+            tr.counter("store.page_hit_rate",
+                       st["hits"] / total if total else 0.0)
+            tr.counter("store.writeback_lag_rounds", lag)
+        return rows
+
+    def write_back(self, round_idx: int, ids, new_rows: Pytree):
+        """Queue the round's updated rows for asynchronous write-back.
+        ``new_rows`` may be device arrays — the device→host materialization
+        happens on the writer thread, off the dispatch path."""
+        ids = np.asarray(ids, np.int64)
+
+        def apply():
+            host_rows = jax.tree_util.tree_map(np.asarray, new_rows)
+            self.store.scatter(ids, host_rows)
+
+        with self._wb_lock:
+            if self._closed:
+                self.store.scatter(
+                    ids, jax.tree_util.tree_map(np.asarray, new_rows))
+                return
+            self._pending_wb.append(
+                (round_idx, self._writer.submit(apply)))
+
+    def drain_writebacks(self) -> int:
+        """Apply every queued write-back (re-raising the first failure);
+        returns how many were still pending — the write-back lag."""
+        with self._wb_lock:
+            pending = list(self._pending_wb)
+            self._pending_wb.clear()
+        lag = sum(1 for _, f in pending if not f.done())
+        for _, f in pending:
+            f.result()
+        return lag
+
+    def stats(self) -> dict:
+        s = self.store.stats()
+        s.update({f"stager_{k}": v for k, v in
+                  self._stager.stats().items()})
+        with self._wb_lock:
+            s["writebacks_pending"] = len(self._pending_wb)
+        return s
+
+    def close(self):
+        self.drain_writebacks()
+        with self._wb_lock:
+            self._closed = True
+        self._stager.close()
+        self._writer.shutdown(wait=True)

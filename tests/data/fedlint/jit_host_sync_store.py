@@ -1,0 +1,32 @@
+"""Positive fixture: host client-state store access reachable from a
+jitted round body (fedstore, docs/CLIENT_STORE.md).
+
+The paged store is a HOST object — a dict of numpy pages.  Touching it
+inside traced code either fails on a traced client id or, worse, silently
+bakes ONE round's rows into the compiled program as constants.  The rows
+must be gathered on the host and passed into the round as a cohort stack.
+"""
+
+import jax
+import jax.numpy as jnp
+
+page_store = {}
+
+
+@jax.jit
+def round_body(params, cohort):
+    rows = page_store.get(int(cohort[0]))     # store .get() in traced code
+    cached = page_store[0]                    # store subscript, ditto
+    return jax.tree_util.tree_map(
+        lambda p: p + jnp.asarray(rows) + jnp.asarray(cached), params)
+
+
+def _gather(client_store, cohort):
+    # reachable from the jitted body below -> still flagged
+    return client_store.gather(cohort)
+
+
+@jax.jit
+def fused_block(params, store, cohort):
+    c = _gather(store, cohort)
+    return params, c

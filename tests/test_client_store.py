@@ -1,0 +1,490 @@
+"""fedstore — the paged million-client state plane (docs/CLIENT_STORE.md).
+
+Pinned here:
+
+- store unit semantics: zero-default reads without allocation, dense-packed
+  hash paging, out-of-range fill/drop parity with ``core.tree``'s dense
+  table ops, LRU eviction + disk-spill round-trip;
+- sparse ≡ dense parity to 2e-5 for BOTH table-backed algorithms
+  (SCAFFOLD, FedDyn) on the SP engine and the 8-shard mesh, per-round and
+  fused-block paths;
+- registered-id sampling: a 1M-client id space over a small dataset runs
+  with host residency proportional to TOUCHED rows, not the population;
+- checkpoint save/restore of the sparse store, including restoring a
+  LEGACY dense ``client_table`` checkpoint into a store-backed run;
+- JaxRuntimeAudit: zero steady-state recompiles with paging enabled;
+- two-tier silo→server aggregation (``HierarchicalSiloAPI`` + the
+  cross-silo aggregator's partial path) matches flat aggregation to 2e-5;
+- satellite contracts: ``validate_args`` raises ONE clear error for
+  incompatible flag pairs; ``AsyncCohortStager`` depth/stats; fedtrace
+  paging telemetry on a real traced run; the ``bench.py --store`` smoke.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments, validate_args
+from fedml_tpu.core import tree as tree_util
+from fedml_tpu.store import ClientStateStore, HierarchicalSiloAPI
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOL = 2e-5
+
+
+def base_args(**over):
+    args = load_arguments()
+    args.update(
+        dataset="synthetic", num_classes=10, input_shape=(14, 14, 1),
+        train_size=512, test_size=128, model="lr",
+        client_num_in_total=12, client_num_per_round=8, comm_round=4,
+        epochs=1, batch_size=16, learning_rate=0.1, random_seed=5,
+        frequency_of_the_test=100,
+    )
+    args.update(**over)
+    return args
+
+
+def make_api(backend="sp", **over):
+    from fedml_tpu import data as data_mod, model as model_mod
+
+    args = fedml_tpu.init(base_args(**over), should_init_logs=False)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    if backend == "mesh":
+        from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+        return MeshFedAvgAPI(args, None, dataset, model)
+    if backend == "hier":
+        return HierarchicalSiloAPI(args, None, dataset, model)
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+    return FedAvgAPI(args, None, dataset, model)
+
+
+def max_diff(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+               for x, y in zip(la, lb))
+
+
+def row_template():
+    return {"w": np.zeros((3, 2), np.float32), "b": np.zeros((2,),
+                                                             np.float32)}
+
+
+# -- store unit semantics ---------------------------------------------------
+
+def test_store_reads_zero_without_allocating_and_roundtrips():
+    store = ClientStateStore(row_template(), registered=1000, page_size=4)
+    ids = np.array([7, 999, 500])
+    rows = store.gather(ids)
+    assert all(float(np.abs(l).max()) == 0.0
+               for l in jax.tree_util.tree_leaves(rows))
+    # a pure read allocates NOTHING — that's what makes 1M ids free
+    assert store.stats()["touched_rows"] == 0
+    assert store.stats()["resident_pages"] == 0
+
+    new = {"w": np.full((3, 3, 2), 2.5, np.float32),
+           "b": np.stack([np.arange(2, dtype=np.float32)] * 3)}
+    store.scatter(ids, new)
+    got = store.gather(np.array([500, 7, 999]))
+    assert float(got["w"].min()) == 2.5
+    assert got["b"].shape == (3, 2)
+    # hash paging packs 3 sparse ids into ONE dense page of 4 slots
+    assert store.stats()["touched_rows"] == 3
+    assert store.stats()["resident_pages"] == 1
+
+    # out-of-range semantics match the dense table: reads fill zero,
+    # writes drop (the padded-cohort sentinel)
+    sentinel = np.array([1000, -1])
+    z = store.gather(sentinel)
+    assert float(np.abs(z["w"]).max()) == 0.0
+    store.scatter(sentinel, {"w": np.ones((2, 3, 2), np.float32),
+                             "b": np.ones((2, 2), np.float32)})
+    assert store.stats()["touched_rows"] == 3
+
+
+def test_store_matches_dense_table_ops():
+    """Sparse gather/scatter is semantically interchangeable with the
+    dense ``cohort_gather``/``cohort_scatter`` pair, sentinel included."""
+    rng = np.random.default_rng(0)
+    template = row_template()
+    n = 20
+    table = jax.tree_util.tree_map(
+        lambda l: jnp.zeros((n,) + l.shape, l.dtype), template)
+    store = ClientStateStore(template, registered=n, page_size=3)
+    for step in range(3):
+        cohort = np.concatenate([rng.choice(n, 5, replace=False),
+                                 [n]]).astype(np.int32)  # + sentinel
+        new = jax.tree_util.tree_map(
+            lambda l: rng.normal(size=(len(cohort),) + l.shape).astype(
+                l.dtype), template)
+        table = tree_util.cohort_scatter(table, jnp.asarray(cohort), new)
+        store.scatter(cohort, new)
+    ids = np.concatenate([np.arange(n), [n]])
+    dense_rows = tree_util.cohort_gather(table, jnp.asarray(ids))
+    assert max_diff(dense_rows, store.gather(ids)) == 0.0
+
+
+def test_store_lru_eviction_and_spill_roundtrip(tmp_path):
+    store = ClientStateStore(row_template(), registered=64, page_size=2,
+                             max_resident_pages=2,
+                             spill_dir=str(tmp_path))
+    ids = np.arange(10)
+    vals = {"w": np.arange(10 * 6, dtype=np.float32).reshape(10, 3, 2),
+            "b": np.arange(20, dtype=np.float32).reshape(10, 2)}
+    store.scatter(ids, vals)
+    st = store.stats()
+    assert st["resident_pages"] == 2           # LRU cap enforced
+    assert st["spilled_pages"] == 3            # 5 pages of 2 rows total
+    assert st["spills"] >= 3
+    assert len(list(tmp_path.glob("page_*.npz"))) >= 3
+    # reading everything back reloads spilled pages losslessly
+    got = store.gather(ids)
+    assert max_diff(got, vals) == 0.0
+    assert store.stats()["loads"] >= 3
+    assert store.stats()["resident_pages"] == 2
+
+    # missing spill_dir with a cap is a config error, not silent data loss
+    with pytest.raises(ValueError, match="spill_dir"):
+        ClientStateStore(row_template(), 8, max_resident_pages=1)
+
+
+# -- sparse == dense engine parity ------------------------------------------
+
+@pytest.mark.parametrize("opt", ["SCAFFOLD", "FedDyn"])
+@pytest.mark.parametrize("backend", ["sp", "mesh"])
+def test_sparse_dense_parity(backend, opt):
+    """The paged store must reproduce the dense table's training run for
+    both table-backed algorithms on both engines (8-shard mesh via
+    conftest's forced device count)."""
+    dense = make_api(backend, federated_optimizer=opt)
+    dense.train()
+    sparse = make_api(backend, federated_optimizer=opt, client_store=True,
+                      store_page_size=4)
+    sparse.train()
+    assert max_diff(dense.state.global_params,
+                    sparse.state.global_params) <= TOL
+    ids = np.arange(dense.dataset.num_clients)
+    dense_rows = jax.tree_util.tree_map(lambda t: np.asarray(t)[ids],
+                                        dense.client_table)
+    assert max_diff(dense_rows, sparse._store.gather(ids)) <= TOL
+    assert sparse._store.stats()["touched_rows"] > 0
+
+
+def test_sparse_dense_parity_fused_block():
+    """round_block fusion with paging: the block's touched rows run as a
+    device mini-table; parity with the dense fused run holds."""
+    dense = make_api("sp", federated_optimizer="SCAFFOLD", round_block=2,
+                     comm_round=5)
+    dense.train()
+    sparse = make_api("sp", federated_optimizer="SCAFFOLD", round_block=2,
+                      comm_round=5, client_store=True, store_page_size=4)
+    sparse.train()
+    assert max_diff(dense.state.global_params,
+                    sparse.state.global_params) <= TOL
+    ids = np.arange(dense.dataset.num_clients)
+    dense_rows = jax.tree_util.tree_map(lambda t: np.asarray(t)[ids],
+                                        dense.client_table)
+    assert max_diff(dense_rows, sparse._store.gather(ids)) <= TOL
+
+
+def test_registered_million_ids_stay_sparse():
+    """A 10^6-client id space over a 12-client dataset: the run samples
+    cohorts from the full range, keeps state keyed by REGISTERED id, and
+    the host pays only for touched rows — while the dense table this
+    replaces would need GiBs that were never allocated."""
+    api = make_api("sp", federated_optimizer="SCAFFOLD", client_store=True,
+                   registered_clients=1_000_000, store_page_size=64,
+                   comm_round=3)
+    api.train()
+    clients = np.unique(np.concatenate(
+        [api._client_sampling(r) for r in range(3)]))
+    assert clients.max() >= api.dataset.num_clients, \
+        "sampling never left the dataset id range"
+    st = api._store.stats()
+    assert st["touched_rows"] == len(clients)
+    assert st["resident_bytes"] < 2 ** 22          # a few pages, not GiBs
+    assert api._store.dense_nbytes() > 2 ** 30     # the impossible table
+    # written rows are nonzero for the sampled REGISTERED ids
+    rows = api._store.gather(clients)
+    assert max(float(np.abs(l).max())
+               for l in jax.tree_util.tree_leaves(rows)) > 0
+
+
+# -- checkpoint / resume ----------------------------------------------------
+
+def test_store_checkpoint_roundtrip(tmp_path):
+    a = make_api("sp", federated_optimizer="SCAFFOLD", client_store=True,
+                 checkpoint_dir=str(tmp_path / "ck"), checkpoint_freq=2)
+    a.train()
+    # resume into a FRESH store-backed api: state + rows must round-trip
+    b = make_api("sp", federated_optimizer="SCAFFOLD", client_store=True,
+                 checkpoint_dir=str(tmp_path / "ck"), checkpoint_freq=2)
+    start = b.maybe_resume()
+    assert start == a.comm_rounds
+    assert max_diff(a.state.global_params, b.state.global_params) == 0.0
+    ids = np.arange(a.dataset.num_clients)
+    assert max_diff(a._store.gather(ids), b._store.gather(ids)) == 0.0
+    # sparse sidecars are pruned alongside orbax's max_to_keep
+    sidecars = list((tmp_path / "ck").glob("store_*.npz"))
+    assert 0 < len(sidecars) <= 3
+
+
+def test_legacy_dense_checkpoint_restores_into_store(tmp_path):
+    """A checkpoint written by the DENSE-table era must restore into a
+    store-backed run — the orbax metadata rebuilds the dense template,
+    and the rows migrate into the sparse store."""
+    dense = make_api("sp", federated_optimizer="SCAFFOLD",
+                     checkpoint_dir=str(tmp_path / "ck"),
+                     checkpoint_freq=2)
+    dense.train()
+    sparse = make_api("sp", federated_optimizer="SCAFFOLD",
+                      client_store=True,
+                      checkpoint_dir=str(tmp_path / "ck"),
+                      checkpoint_freq=2)
+    start = sparse.maybe_resume()
+    assert start == dense.comm_rounds
+    assert max_diff(dense.state.global_params,
+                    sparse.state.global_params) == 0.0
+    ids = np.arange(dense.dataset.num_clients)
+    dense_rows = jax.tree_util.tree_map(lambda t: np.asarray(t)[ids],
+                                        dense.client_table)
+    assert max_diff(dense_rows, sparse._store.gather(ids)) == 0.0
+
+
+# -- zero steady-state recompiles with paging on ----------------------------
+
+def test_zero_steady_state_recompiles_with_paging():
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+
+    api = make_api("mesh", federated_optimizer="SCAFFOLD",
+                   client_store=True, comm_round=100,
+                   registered_clients=10_000, store_page_size=64)
+    for r in range(2):                       # compile + warm
+        api.train_one_round(r)
+    np.asarray(jax.tree_util.tree_leaves(api.state.global_params)[0])
+    with JaxRuntimeAudit() as audit:
+        for r in range(2, 6):
+            api.train_one_round(r)
+        np.asarray(jax.tree_util.tree_leaves(api.state.global_params)[0])
+    assert audit.compilations == 0, (
+        f"paging-enabled steady-state rounds recompiled "
+        f"{audit.compilations}x: {audit.compiled}")
+
+
+# -- two-tier silo -> server aggregation ------------------------------------
+
+@pytest.mark.parametrize("opt", ["FedAvg", "SCAFFOLD", "qFedAvg"])
+def test_hierarchical_4silo_matches_flat(opt):
+    over = dict(federated_optimizer=opt)
+    if opt == "qFedAvg":
+        over.update(qfed_q=0.5)
+    flat = make_api("sp", **over)
+    flat.train()
+    hier = make_api("hier", num_silos=4, **over)
+    hier.train()
+    assert max_diff(flat.state.global_params,
+                    hier.state.global_params) <= TOL
+    if opt == "SCAFFOLD":
+        ids = np.arange(flat.dataset.num_clients)
+        flat_rows = jax.tree_util.tree_map(lambda t: np.asarray(t)[ids],
+                                           flat.client_table)
+        hier_rows = jax.tree_util.tree_map(lambda t: np.asarray(t)[ids],
+                                           hier.client_table)
+        assert max_diff(flat_rows, hier_rows) <= TOL
+
+
+def test_run_simulation_dispatches_num_silos():
+    """``num_silos > 1`` selects the hierarchical driver at the public
+    ``run_simulation`` boundary (topology knob, not an optimizer name)."""
+    from fedml_tpu.simulation.simulator import SimulatorSingleProcess
+    from fedml_tpu import data as data_mod, model as model_mod
+
+    args = fedml_tpu.init(base_args(num_silos=4), should_init_logs=False)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    sim = SimulatorSingleProcess(args, None, dataset, model)
+    assert isinstance(sim.fl_trainer, HierarchicalSiloAPI)
+    assert sim.fl_trainer.num_silos == 4
+
+
+def test_hierarchical_store_combo():
+    """The full tentpole stack at once: paged store + silo tier."""
+    flat = make_api("sp", federated_optimizer="SCAFFOLD")
+    flat.train()
+    hier = make_api("hier", federated_optimizer="SCAFFOLD", num_silos=2,
+                    client_store=True, store_page_size=4)
+    hier.train()
+    assert max_diff(flat.state.global_params,
+                    hier.state.global_params) <= TOL
+
+
+def test_cross_silo_aggregator_partials_match_flat():
+    """Distributed twin: silo partials shipped through FedMLAggregator
+    combine to the same model as raw per-client uploads."""
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.cross_silo.server.fedml_aggregator import FedMLAggregator
+
+    args = fedml_tpu.init(base_args(federated_optimizer="FedAvg"),
+                          should_init_logs=False)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    rng = np.random.default_rng(1)
+
+    def client_params(template, i):
+        return jax.tree_util.tree_map(
+            lambda l: jnp.asarray(
+                rng.normal(size=l.shape).astype(np.float32)), template)
+
+    flat_agg = FedMLAggregator(args, model, dataset, client_num=4)
+    hier_agg = FedMLAggregator(args, model, dataset, client_num=2)
+    hier_agg.set_global_model_params(flat_agg.get_global_model_params())
+    params = [client_params(flat_agg.get_global_model_params(), i)
+              for i in range(4)]
+    weights = [10.0, 20.0, 30.0, 40.0]
+    for i, (p, w) in enumerate(zip(params, weights)):
+        flat_agg.add_local_trained_result(i, p, w)
+    flat_params = flat_agg.aggregate()
+
+    # two silos of two clients each ship partial aggregates instead
+    for s in range(2):
+        stacked = tree_util.tree_stack(params[2 * s: 2 * s + 2])
+        w = jnp.asarray(weights[2 * s: 2 * s + 2], jnp.float32)
+        partial = hier_agg.server_opt.compute_partial_aggregates(
+            hier_agg.state, stacked, w)
+        hier_agg.add_local_partial_aggregate(s, partial, float(w.sum()))
+    assert hier_agg.check_whether_all_receive()
+    hier_params = hier_agg.aggregate()
+    assert max_diff(flat_params, hier_params) <= TOL
+
+
+# -- satellite: one clear error for incompatible flags ----------------------
+
+def test_validate_args_incompatible_flags():
+    cases = [
+        (dict(population=4, cohort_bucketing=True),
+         ["population", "cohort_bucketing"]),
+        (dict(population_axes={"client_lr": [0.1, 0.2]},
+              cohort_bucketing=True),
+         ["population_axes", "cohort_bucketing"]),
+        (dict(population=4, backend="mesh"), ["population", "mesh"]),
+        (dict(population=4, backend="MPI"), ["population", "MPI"]),
+        (dict(population=4, client_store=True),
+         ["population", "client_store"]),
+    ]
+    for over, words in cases:
+        args = base_args(**over)
+        with pytest.raises(ValueError) as ei:
+            validate_args(args)
+        for word in words:
+            assert word in str(ei.value), (over, str(ei.value))
+    # fedml_tpu.init runs the same validation — the error fires BEFORE any
+    # dataset/model/engine construction
+    with pytest.raises(ValueError, match="cohort_bucketing"):
+        fedml_tpu.init(base_args(population=4, cohort_bucketing=True),
+                       should_init_logs=False)
+    # compatible configs pass through untouched
+    validate_args(base_args(population=4))
+    validate_args(base_args(cohort_bucketing=True))
+    validate_args(base_args(client_store=True))
+
+
+# -- satellite: stager depth + stats ----------------------------------------
+
+def test_stager_depth_and_stats():
+    from fedml_tpu.simulation.staging import AsyncCohortStager
+
+    import threading
+    builds = []
+    gate = threading.Event()
+
+    def build(r):
+        gate.wait(timeout=5)
+        builds.append(r)
+        return r * 10
+
+    st = AsyncCohortStager(build, depth=2, stride=1, limit=4)
+    gate.set()
+    assert st.get(0, prefetch=1) == 0          # synchronous miss
+    s = st.stats()
+    assert s["misses"] == 1 and s["hits"] == 0
+    assert st.get(1, prefetch=2) == 10         # served by the prefetch
+    assert st.get(2, prefetch=3) == 20
+    assert st.get(3, prefetch=4) == 30         # 4 >= limit: not scheduled
+    s = st.stats()
+    assert s["hits"] == 3 and s["misses"] == 1
+    assert s["pending"] == 0                   # limit capped scheduling
+    assert s["worker_restarts"] == 0
+    st.close()
+
+    # a failed speculative build restarts the worker pool (counted)
+    def flaky(r):
+        if r == 1:
+            raise RuntimeError("boom")
+        return r
+
+    st = AsyncCohortStager(flaky, depth=1)
+    assert st.get(0, prefetch=1) == 0
+    with pytest.raises(RuntimeError, match="boom"):
+        st.get(1)
+    assert st.stats()["worker_restarts"] == 1
+    assert st.get(2) == 2                      # pool usable after restart
+    st.close()
+
+    # depth is honored: two pending speculative builds after one get
+    slow_gate = threading.Event()
+    st = AsyncCohortStager(lambda r: slow_gate.wait(timeout=5) or r,
+                           depth=2)
+    st.get(0, prefetch=1)
+    assert st.stats()["pending"] == 2          # rounds 1 and 2 in flight
+    slow_gate.set()
+    st.close()
+
+
+# -- satellite: fedtrace paging telemetry on a real run ---------------------
+
+def test_traced_store_run_emits_paging_telemetry():
+    from fedml_tpu import obs
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import fedtrace
+
+    obs.configure(enabled=False)
+    obs.get_tracer().reset()
+    try:
+        api = make_api("sp", federated_optimizer="SCAFFOLD",
+                       client_store=True, trace=True)
+        api.train()
+        trace = obs.get_tracer().export_chrome()
+        s = fedtrace.summarize(trace)
+        assert s["page_in_bytes"] > 0
+        assert 0.0 <= s["page_hit_rate"] <= 1.0
+        assert s["writeback_lag_rounds"] >= 0.0
+        assert s["spans"]["store.page_in"]["count"] > 0
+    finally:
+        obs.configure(enabled=False)
+        obs.get_tracer().reset()
+
+
+# -- satellite: bench smoke -------------------------------------------------
+
+def test_bench_store_quick(monkeypatch):
+    monkeypatch.setenv("FEDML_STORE_QUICK", "1")
+    sys.path.insert(0, REPO)
+    import bench
+
+    out = bench.bench_store(rounds=2)
+    assert out["quick"] is True
+    assert out["store_s_per_round"] > 0
+    assert out["steady_compiles_store"] == 0
+    assert out["store_touched_rows"] > 0
+    # the store's actual residency is orders of magnitude under the dense
+    # table the registered population would have required
+    assert (out["store_resident_mb"] / 1024.0
+            < out["dense_table_at_registered_gib"] / 10.0)

@@ -273,6 +273,14 @@ def test_fedtrace_golden_values_are_hand_checkable():
     assert s["member_loss_best_last"] == 0.8
     assert s["member_loss_worst_last"] == 1.6
     assert s["member_bytes_spread_max"] == 0.0
+    # paged client-state store telemetry (fedstore, docs/CLIENT_STORE.md):
+    # cumulative page-in bytes (8192 then 16384), final prefetch hit rate
+    # (0.5 -> 0.75), write-back lag drained to 0, and the two page-in
+    # host-plane spans (0.04s + 0.02s) inside the staging windows
+    assert s["page_in_bytes"] == 16384.0
+    assert s["page_hit_rate"] == 0.75
+    assert s["writeback_lag_rounds"] == 0.0
+    assert s["spans"]["store.page_in"] == {"count": 2, "total_s": 0.06}
 
 
 def _run_cli(*args):

@@ -363,7 +363,10 @@ def test_population_eval_and_records():
 def test_population_rejected_on_mesh_and_host_data():
     from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
 
-    with pytest.raises(NotImplementedError):
+    # population + mesh now fails EARLY in fedml_tpu.init (arguments.py
+    # validate_args) with one error naming both flags, instead of a
+    # NotImplementedError deep inside the engine after dataset/model build
+    with pytest.raises(ValueError, match="population.*mesh"):
         make_api(MeshFedAvgAPI, backend="mesh", population=2,
                  client_num_in_total=16, client_num_per_round=8)
     with pytest.raises(ValueError):

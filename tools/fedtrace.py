@@ -200,6 +200,17 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
             out["member_bytes_spread_max"] = round(
                 max(float(r.get("member_bytes_spread", 0.0)) for r in mem),
                 6)
+    # paged client-state store (fedstore, docs/CLIENT_STORE.md): the
+    # host-plane paging counters the store/pager emit — cumulative bytes
+    # paged in, the final prefetch hit rate, and the write-back lag
+    # (write-backs still pending when the last gather ran)
+    counters = out["counters"]
+    if "store.page_in_bytes" in counters:
+        out["page_in_bytes"] = counters["store.page_in_bytes"]
+    if "store.page_hit_rate" in counters:
+        out["page_hit_rate"] = round(counters["store.page_hit_rate"], 6)
+    if "store.writeback_lag_rounds" in counters:
+        out["writeback_lag_rounds"] = counters["store.writeback_lag_rounds"]
     return out
 
 
@@ -247,6 +258,11 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"{s['member_loss_best_last']:g}/"
             f"{s['member_loss_worst_last']:g}   "
             f"bytes spread: {s['member_bytes_spread_max']:g}")
+    if "page_in_bytes" in s or "page_hit_rate" in s:
+        lines.append(
+            f"store paging: {s.get('page_in_bytes', 0.0):.0f} B paged in   "
+            f"hit rate {s.get('page_hit_rate', 0.0):g}   "
+            f"writeback lag {s.get('writeback_lag_rounds', 0.0):g} rounds")
     lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")
     total = sum(s["phases"].values()) or 1.0
     for p in PHASES:

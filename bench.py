@@ -1427,6 +1427,119 @@ def serve_bench(on_accelerator: bool) -> dict:
     return result
 
 
+# -- multi-tenant serving benchmark (--serve-mt) -----------------------------
+def serve_mt_bench() -> dict:
+    """ONE engine serving N registered LoRA adapters against one shared
+    base (ISSUE 9): aggregate tokens/s vs an adapter-blind engine at the
+    same slot count, a JaxRuntimeAudit pin of zero steady-state recompiles
+    across adapter switches (incl. a hot-swap registration mid-audit), and
+    the closed-loop load harness (tools/serve_load.py) latency envelope at
+    a target RPS over a Zipf adapter mix with heavy-tailed prompts."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu.llm.fedllm import lora_init
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import ContinuousBatchingEngine
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from serve_load import run_load
+
+    quick = os.environ.get("FEDML_SERVE_MT_QUICK") == "1"
+    slots = 4
+    n_adapters = 3 if quick else 32
+    n_new = 6 if quick else 24
+    n_req = 8 if quick else 64
+    buf = 128
+    base_cfg = LlamaConfig(vocab_size=258, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=4, ffn_dim=128, max_seq_len=buf,
+                           dtype=jnp.float32, lora_rank=0)
+    mt_cfg = dataclasses.replace(base_cfg, lora_rank=8)
+    base_model, mt_model = LlamaLM(base_cfg), LlamaLM(mt_cfg)
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    base_params = base_model.init(jax.random.PRNGKey(0), dummy)["params"]
+    variables = mt_model.init(jax.random.PRNGKey(0), dummy)
+
+    result = {"quick": quick, "slots": slots, "adapters": n_adapters,
+              "max_new_tokens": n_new, "requests": n_req}
+
+    def _row(name, value):
+        result[name] = value
+        print(f"[serve-mt-row] {name}={value} t={time.perf_counter():.0f}",
+              flush=True)
+
+    mt = ContinuousBatchingEngine(mt_model, variables["params"], slots=slots,
+                                  buf_len=buf,
+                                  adapter_slots=n_adapters + 2)
+    single = ContinuousBatchingEngine(base_model, base_params, slots=slots,
+                                      buf_len=buf)
+    try:
+        names = []
+        for i in range(n_adapters):
+            name = f"cohort{i}"
+            mt.registry.register(name, lora_init(
+                jax.random.PRNGKey(100 + i), variables["lora"]))
+            names.append(name)
+
+        # warm every compiled program off-clock: adapter + base admission
+        # and the batched MT step, plus the plain engine's pair
+        mt.generate([5, 17, 42], max_new_tokens=2, adapter=names[0])
+        mt.generate([5, 17, 42], max_new_tokens=2)
+        single.generate([5, 17, 42], max_new_tokens=2)
+
+        # acceptance pin: adapter switches (every registered adapter +
+        # base + a mid-audit hot-swap registration) reuse the ONE program
+        with JaxRuntimeAudit() as audit:
+            mt.registry.register("hot", lora_init(
+                jax.random.PRNGKey(999), variables["lora"]))
+            mix = [None, "hot"] + names
+            qs = [mt.submit([i + 1, i + 2, i + 3], max_new_tokens=4,
+                            adapter=mix[i % len(mix)])
+                  for i in range(max(8, len(mix)))]
+            for q in qs:
+                while q.get(timeout=120) is not None:
+                    pass
+        _row("steady_state_recompiles", audit.compilations)
+
+        # aggregate tokens/s: the same request battery through the
+        # adapter-blind engine (the one-engine-per-adapter world's best
+        # case: zero lora math) and the MT engine with requests spread
+        # over every adapter
+        def agg_tok_s(engine, cycle):
+            t0 = time.perf_counter()
+            qs = [engine.submit([i + 1, i + 2, i + 3],
+                                max_new_tokens=n_new,
+                                adapter=cycle[i % len(cycle)])
+                  for i in range(n_req)]
+            total = 0
+            for q in qs:
+                while q.get(timeout=300) is not None:
+                    total += 1
+            return round(total / (time.perf_counter() - t0), 1)
+
+        _row("single_adapter_tok_s", agg_tok_s(single, [None]))
+        _row("mt_tok_s", agg_tok_s(mt, names + [None]))
+        _row("mt_vs_single_ratio",
+             round(result["mt_tok_s"] / result["single_adapter_tok_s"], 3))
+
+        # closed-loop load at target RPS (Zipf adapter mix, heavy-tailed
+        # prompt lengths) — p50/p99 latency + queue depth for the BENCH row
+        rps = 20.0 if quick else 40.0
+        result["load"] = run_load(
+            mt, target_rps=rps, n_requests=n_req,
+            adapters=[None] + names, max_new_tokens=n_new,
+            vocab=base_cfg.vocab_size, seed=0)
+        _row("latency_p50_ms", result["load"]["latency_p50_ms"])
+        _row("latency_p99_ms", result["load"]["latency_p99_ms"])
+        _row("load_tokens_per_s", result["load"]["tokens_per_s"])
+        result["registry_stats"] = dict(mt.registry.stats)
+        result["serve_stats_requests"] = len(mt.serve_stats["requests"])
+    finally:
+        mt.stop()
+        single.stop()
+    return result
+
+
 def main():
     if "--agg" in sys.argv:
         # the scatter-vs-replicated comparison needs a multi-shard mesh;
@@ -1536,6 +1649,19 @@ def main():
             "value": result["fused_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["fused_speedup"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--serve-mt" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = serve_mt_bench()
+        result.update({
+            "metric": "serve_mt_multi_tenant_lora",
+            "value": result["mt_tok_s"],
+            "unit": f"tok_s_aggregate_{result['adapters']}_adapters",
+            "vs_baseline": result["mt_vs_single_ratio"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

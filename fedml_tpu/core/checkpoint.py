@@ -125,6 +125,26 @@ class RoundCheckpointer:
             int(k): v for k, v in restored.get("client_state", {}).items()}
         return restored["state"], client_state
 
+    def restore_state(self, round_idx: Optional[int] = None):
+        """Restore ONLY the saved state pytree, with the template rebuilt
+        from the step's orbax metadata (shapes/dtypes) — so a consumer
+        that was not the writer (e.g. the serving
+        :class:`~fedml_tpu.serving.adapters.AdapterRegistry` pulling a
+        LoRA delta, possibly population-stacked, out of a fine-tune run)
+        never has to materialize or even know the full state structure.
+        Returns ``None`` when no checkpoint round exists."""
+        step = round_idx if round_idx is not None else self.mngr.latest_step()
+        if step is None:
+            return None
+        meta = self.mngr.item_metadata(step)
+        if not (isinstance(meta, dict) and "state" in meta):
+            return None
+        template = jax.tree_util.tree_map(
+            lambda m: np.zeros(m.shape, m.dtype), meta["state"])
+        restored = self.mngr.restore(
+            step, args=ocp.args.StandardRestore({"state": template}))
+        return restored["state"]
+
     def _restore_into_store(self, step: int, state_template: Any, store):
         """Store-backed restore: the ServerState comes from orbax against
         its template; the per-client rows come from the sparse ``.npz``

@@ -131,7 +131,13 @@ class LoRADense(nn.Module):
     """Dense with an optional low-rank adapter in the "lora" collection:
     y = x·W + (α/r)·(x·A)·B.  W lives in "params" (frozen for FedLoRA);
     A, B live in "lora" so a cohort of clients can vmap over adapters while
-    sharing one copy of W."""
+    sharing one copy of W.
+
+    Grouped apply: adapter leaves carrying one EXTRA leading axis aligned
+    with x's batch — A (B, in, r), B (B, r, out), e.g. a per-sample gather
+    out of the serving adapter bank (``gather(bank, slot_adapter_ids)``) —
+    run as a pair of batched einsums, so a mixed-adapter batch costs one
+    grouped matmul instead of per-adapter dispatches."""
 
     features: int
     rank: int
@@ -153,8 +159,14 @@ class LoRADense(nn.Module):
                 "lora", "B",
                 lambda: jnp.zeros((self.rank, self.features), jnp.float32))
             scale = self.alpha / self.rank
-            y = y + (x.astype(jnp.float32) @ a.value @ b.value
-                     * scale).astype(y.dtype)
+            av, bv = a.value, b.value
+            xf = x.astype(jnp.float32)
+            if av.ndim == 3:
+                delta = jnp.einsum("b...i,bir->b...r", xf, av)
+                delta = jnp.einsum("b...r,bro->b...o", delta, bv)
+            else:
+                delta = xf @ av @ bv
+            y = y + (delta * scale).astype(y.dtype)
         return y
 
 

@@ -2,10 +2,12 @@
 inference runner, federated serving client/server, OpenAI-compatible
 template."""
 
+from .adapters import AdapterRegistry, BankFullError
 from .fedml_client import FedMLModelServingClient
 from .fedml_inference_runner import FedMLInferenceRunner
 from .fedml_predictor import FedMLPredictor
 from .fedml_server import FedMLModelServingServer
 
-__all__ = ["FedMLInferenceRunner", "FedMLModelServingClient",
-           "FedMLModelServingServer", "FedMLPredictor"]
+__all__ = ["AdapterRegistry", "BankFullError", "FedMLInferenceRunner",
+           "FedMLModelServingClient", "FedMLModelServingServer",
+           "FedMLPredictor"]

@@ -1,0 +1,239 @@
+"""Multi-tenant LoRA serving: the adapter bank + registry.
+
+The end state of federated fine-tuning is serving each cohort's (or each
+user's) LoRA delta back to the population that trained it.  Per-adapter
+engines don't scale — every one would carry its own copy of the shared
+base — so the bank keeps N adapters **stacked on a leading adapter axis,
+device-resident next to ONE shared base**: the batched decode step gathers
+``bank[slot_adapter_ids]`` inside the compiled program and the vmapped
+:class:`~fedml_tpu.llm.model.LoRADense` layers run the low-rank matmuls as
+slot-batched (grouped) einsums.  Bank *capacity* is static (one compiled
+program); *membership* is data — registering, evicting, or re-pointing an
+adapter never recompiles anything.
+
+Concurrency contract (the registry is shared between request threads and
+the engine's decode thread):
+
+- Row writes go through one jitted donated ``.at[row].set`` under
+  ``self.lock``; the engine snapshots ``self.bank`` (and dispatches) under
+  the same lock, so a donated-away buffer can never race a dispatch.
+- Rows referenced by in-flight requests are **pinned**.  Re-registering a
+  pinned name is copy-on-write: the name moves to a fresh row, the old row
+  becomes a *zombie* that frees when its pins drain — an in-flight stream
+  finishes on exactly the weights it started with.  Evicting a pinned name
+  likewise only unroutes it; the row's bytes survive until the last
+  reader finishes.
+- Row 0 is the reserved **zero adapter** (A = B = 0 — the exact base
+  model): requests without an adapter ride the same gathered program, so
+  base and personalized traffic share one batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class BankFullError(RuntimeError):
+    """Every non-reserved bank row is registered or still pinned by an
+    in-flight request — evict something (or wait for a drain) first."""
+
+
+class _Row:
+    __slots__ = ("name", "pins", "zombie", "token")
+
+    def __init__(self):
+        self.name: Optional[str] = None
+        self.pins = 0
+        self.zombie = False
+        # identity token, refreshed per registration: prefix-cache keying
+        # compares it by ``is`` so KV computed under one adapter version
+        # can never serve another (templates/openai_compat.PrefixCache)
+        self.token: object = object()
+
+
+class AdapterRegistry:
+    """Name → bank-row routing over a device-resident stacked LoRA bank.
+
+    ``capacity`` counts bank rows *including* the reserved zero row, so a
+    capacity-``N`` registry serves up to ``N - 1`` named adapters plus
+    base traffic.  All public methods are thread-safe.
+    """
+
+    def __init__(self, model, capacity: int = 8, dtype=jnp.float32):
+        if getattr(getattr(model, "cfg", None), "lora_rank", 0) <= 0:
+            raise ValueError("AdapterRegistry requires a lora_rank>0 model "
+                             "config (LoRADense layers)")
+        capacity = int(capacity)
+        if capacity < 2:
+            raise ValueError(f"capacity={capacity}: need >= 2 (row 0 is the "
+                             "reserved zero adapter)")
+        self.capacity = capacity
+        # eval_shape + zeros, NOT model.init: init would materialize a full
+        # base-parameter tree just to read the lora collection's structure
+        shapes = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
+            jax.random.PRNGKey(0))["lora"]
+        self.bank = jax.tree_util.tree_map(
+            lambda s: jnp.zeros((capacity,) + s.shape, dtype), shapes)
+        self._row_struct = shapes
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def set_row(bank, tree, row):
+            return jax.tree_util.tree_map(
+                lambda b, t: b.at[row].set(t.astype(b.dtype)), bank, tree)
+
+        @jax.jit
+        def gather_row(bank, row):
+            return jax.tree_util.tree_map(lambda b: b[row], bank)
+
+        self._set_row = set_row
+        self._gather_row = gather_row
+        self.lock = threading.RLock()
+        self._names: Dict[str, int] = {}
+        self._rows = [_Row() for _ in range(capacity)]
+        self._free: List[int] = list(range(1, capacity))
+        self.stats = {"registered": 0, "evicted": 0, "copy_on_write": 0,
+                      "rows_reclaimed": 0}
+
+    # -- routing -----------------------------------------------------------
+    def names(self) -> List[str]:
+        with self.lock:
+            return sorted(self._names)
+
+    def __contains__(self, name: str) -> bool:
+        with self.lock:
+            return name in self._names
+
+    def acquire(self, name: Optional[str]):
+        """Resolve ``name`` to ``(row, token)`` and pin the row for the
+        lifetime of one request (``None`` → the zero row, never pinned —
+        it cannot be evicted or rewritten).  Raises ``KeyError`` for
+        unknown names."""
+        with self.lock:
+            if name is None:
+                return 0, self._rows[0].token
+            if name not in self._names:
+                raise KeyError(
+                    f"unknown adapter {name!r}; have {sorted(self._names)}")
+            row = self._names[name]
+            self._rows[row].pins += 1
+            return row, self._rows[row].token
+
+    def release(self, row: int) -> None:
+        """Drop one pin; a zombie row whose pins drain returns to the free
+        list."""
+        if row == 0:
+            return
+        with self.lock:
+            r = self._rows[row]
+            r.pins = max(r.pins - 1, 0)
+            if r.zombie and r.pins == 0:
+                r.zombie = False
+                self._free.append(row)
+                self.stats["rows_reclaimed"] += 1
+
+    def lora_for_row(self, row: int):
+        """Gathered single-adapter tree for one row (prefill-time use)."""
+        with self.lock:
+            return self._gather_row(self.bank, jnp.int32(row))
+
+    # -- membership --------------------------------------------------------
+    def _check_tree(self, lora_tree) -> None:
+        got_def = jax.tree_util.tree_structure(lora_tree)
+        want_def = jax.tree_util.tree_structure(self._row_struct)
+        if got_def != want_def:
+            raise ValueError(
+                "lora tree does not match the bank's row structure "
+                f"(model lora config mismatch): got {got_def}, "
+                f"want {want_def}")
+        for got, want in zip(jax.tree_util.tree_leaves(lora_tree),
+                             jax.tree_util.tree_leaves(self._row_struct)):
+            if tuple(got.shape) != tuple(want.shape):
+                raise ValueError(
+                    "lora leaf shape mismatch vs the bank row: got "
+                    f"{tuple(got.shape)}, want {tuple(want.shape)} "
+                    "(model lora_rank/config mismatch)")
+
+    def register(self, name: str, lora_tree) -> int:
+        """Write ``lora_tree`` into a bank row and route ``name`` to it.
+
+        A re-register of an *unpinned* name rewrites its row in place; a
+        *pinned* name moves to a fresh row (copy-on-write) so in-flight
+        requests keep decoding against the weights they started with.
+        Raises :class:`BankFullError` when no row is free."""
+        name = str(name)
+        self._check_tree(lora_tree)
+        with self.lock:
+            row = self._names.get(name)
+            if row is not None and self._rows[row].pins > 0:
+                # copy-on-write: the old row keeps serving its readers
+                self._rows[row].zombie = True
+                self._rows[row].name = None
+                self.stats["copy_on_write"] += 1
+                row = None
+            if row is None:
+                if not self._free:
+                    raise BankFullError(
+                        f"adapter bank full ({self.capacity - 1} rows; "
+                        f"registered={sorted(self._names)}, zombies="
+                        f"{sum(r.zombie for r in self._rows)}) — evict an "
+                        "adapter or wait for in-flight requests to drain")
+                row = self._free.pop()
+            self.bank = self._set_row(self.bank, lora_tree, jnp.int32(row))
+            r = self._rows[row]
+            r.name = name
+            r.zombie = False
+            r.token = object()
+            self._names[name] = row
+            self.stats["registered"] += 1
+            return row
+
+    def evict(self, name: str) -> None:
+        """Unroute ``name``.  New requests for it fail immediately; a row
+        still pinned by in-flight requests survives as a zombie until they
+        drain, then frees."""
+        with self.lock:
+            row = self._names.pop(str(name), None)
+            if row is None:
+                raise KeyError(f"unknown adapter {name!r}")
+            r = self._rows[row]
+            r.name = None
+            self.stats["evicted"] += 1
+            if r.pins > 0:
+                r.zombie = True
+            else:
+                self._free.append(row)
+
+    # -- federated handoff -------------------------------------------------
+    def register_from_checkpoint(self, name: str, directory: str,
+                                 round_idx: Optional[int] = None,
+                                 member: Optional[int] = None) -> int:
+        """Register a LoRA delta straight out of a federated orbax
+        checkpoint — a fine-tune run's output becomes servable without a
+        restart.  The saved state may be the bare lora tree, any dict
+        carrying a ``"lora"`` key, or a population-stacked run (pass
+        ``member`` to extract one experiment via
+        :func:`fedml_tpu.core.federated.population_member`)."""
+        from ..core.checkpoint import RoundCheckpointer
+        ckpt = RoundCheckpointer(directory)
+        try:
+            state = ckpt.restore_state(round_idx)
+        finally:
+            ckpt.close()
+        if state is None:
+            raise FileNotFoundError(
+                f"no checkpoint round in {directory!r}")
+        tree = state["lora"] if isinstance(state, dict) and "lora" in state \
+            else state
+        if member is not None:
+            from ..core.federated import population_member
+            tree = population_member(tree, int(member))
+        return self.register(name, tree)
+
+
+__all__ = ["AdapterRegistry", "BankFullError"]

@@ -16,6 +16,14 @@ exactly two programs compile: the per-slot prefill and the batched step.
 Per-slot KV caches live stacked on a leading slot axis and are inserted at
 admission with a donated ``.at[slot].set``.
 
+Multi-tenant LoRA (``adapter_slots``/``adapter_registry``, see
+:mod:`fedml_tpu.serving.adapters` and docs/SERVING.md): N adapters live
+stacked in a device-resident bank next to the ONE shared base; each slot
+carries an ``adapter_id`` and the batched step computes ``base(x) +
+gather(bank, slot_adapter_ids) @ x`` via grouped (slot-batched) adapter
+einsums — bank capacity is static, membership is data, so serving a new
+or different adapter never recompiles.
+
 Greedy (temp=0) output is bit-identical to the single-request
 :func:`fedml_tpu.serving.templates.openai_compat.generate` path (tested);
 the per-request threefry key splits follow the same sequence as that path,
@@ -34,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import get_tracer
+from .adapters import AdapterRegistry
 from .templates.openai_compat import (TAIL_BLOCK, PrefixCache,
                                       _build_cached_decode,
                                       _replay_tail, _sample_live)
@@ -48,7 +58,8 @@ def _unwrap_params(params):
 
 
 class _Slot:
-    __slots__ = ("live", "q", "pos", "remaining", "eos_id", "cur_tok")
+    __slots__ = ("live", "q", "pos", "remaining", "eos_id", "cur_tok",
+                 "adapter_row")
 
     def __init__(self):
         self.live = False
@@ -57,6 +68,7 @@ class _Slot:
         self.remaining = 0
         self.eos_id: Optional[int] = None
         self.cur_tok = 0
+        self.adapter_row = 0
 
 
 class ContinuousBatchingEngine:
@@ -66,13 +78,25 @@ class ContinuousBatchingEngine:
     def __init__(self, model, params, slots: int = 4, buf_len: int = 256,
                  top_k: int = 0, top_p: float = 1.0, horizon: int = 1,
                  prefix_cache_slots: int = 0,
-                 prefix_max_tail: int = TAIL_BLOCK):
+                 prefix_max_tail: int = TAIL_BLOCK,
+                 adapter_registry: Optional[AdapterRegistry] = None,
+                 adapter_slots: int = 0):
         self.model = model
         self.raw_params = _unwrap_params(params)
         self.n_slots = int(slots)
         self.buf_len = int(buf_len)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        # multi-tenant LoRA (serving/adapters.py): an adapter bank stacked
+        # on a leading axis next to the ONE shared base; each slot carries
+        # an adapter_id and the batched step gathers its bank row inside
+        # the compiled program — bank capacity is static, membership is
+        # data, so requests landing on different adapters never recompile.
+        # ``adapter_slots=N`` builds a capacity-N registry; passing
+        # ``adapter_registry`` shares one bank across engines.
+        self.registry = adapter_registry
+        if adapter_slots and self.registry is None:
+            self.registry = AdapterRegistry(model, capacity=int(adapter_slots))
         # decode horizon: tokens generated per device dispatch.  horizon=1 is
         # token-granularity admission (lowest queueing latency); horizon=H
         # runs H steps as one lax.scan on-device so per-token host round-trip
@@ -125,7 +149,39 @@ class ContinuousBatchingEngine:
             # hist: (horizon, n_slots) → host iterates per-slot rows
             return hist.T, caches, keys
 
-        self._step = batched_step
+        @jax.jit
+        def batched_step_mt(params, bank, caches, toks, poss, keys, temps,
+                            aids):
+            params = dequantize_params(params, wdtype)
+            # gather(bank, slot_adapter_ids) — one batched gather per lora
+            # leaf; the vmapped apply then runs the adapter matmuls
+            # slot-batched against the shared base (grouped einsums after
+            # vmap batching).  bank + aids are traced arguments: any
+            # request→adapter assignment reuses this one program.
+            lora_slots = jax.tree_util.tree_map(lambda b: b[aids], bank)
+
+            def one(cache, tok, pos, key, temp, lora):
+                logits, mut = model.apply(
+                    {"params": params, "lora": lora, "cache": cache},
+                    tok[None, None], decode=True, start_pos=pos,
+                    mutable=["cache"])
+                key, sub = jax.random.split(key)
+                nxt = _sample_live(logits[0, 0], sub, temp, self.top_k,
+                                   self.top_p)
+                return nxt, mut["cache"], key
+
+            def body(carry, _):
+                caches, toks, poss, keys = carry
+                toks, caches, keys = jax.vmap(one)(
+                    caches, toks, poss, keys, temps, lora_slots)
+                return (caches, toks, poss + 1, keys), toks
+
+            (caches, toks, poss, keys), hist = jax.lax.scan(
+                body, (caches, toks, poss, keys), None, length=self.horizon)
+            return hist.T, caches, keys
+
+        self._step = batched_step if self.registry is None \
+            else batched_step_mt
 
         @partial(jax.jit, donate_argnums=(0,))
         def insert_cache(caches, cache, slot):
@@ -135,8 +191,12 @@ class ContinuousBatchingEngine:
         self._insert = insert_cache
 
         # materialize the stacked cache template from one dummy prefill
+        # (MT engines pass the zero bank row — a lora_rank>0 model can't
+        # apply without its "lora" collection)
         dummy = jnp.zeros((1, self.buf_len), jnp.int32)
-        _, cache0 = self._prefill(self.raw_params, None, dummy,
+        dummy_lora = (self.registry.lora_for_row(0)
+                      if self.registry is not None else None)
+        _, cache0 = self._prefill(self.raw_params, dummy_lora, dummy,
                                   jnp.int32(1), jax.random.PRNGKey(0),
                                   jnp.float32(0.0))
         self._caches = jax.tree_util.tree_map(
@@ -146,6 +206,7 @@ class ContinuousBatchingEngine:
         self._toks = np.zeros(self.n_slots, np.int32)
         self._poss = np.zeros(self.n_slots, np.int32)
         self._temps = np.zeros(self.n_slots, np.float32)
+        self._aids = np.zeros(self.n_slots, np.int32)
         self._keys = np.stack(
             [np.asarray(jax.random.PRNGKey(i)) for i in range(self.n_slots)])
         self._waiting: "queue.Queue[dict]" = queue.Queue()
@@ -155,32 +216,63 @@ class ContinuousBatchingEngine:
         # thread once live slots drain (admission pauses meanwhile)
         self._pending_params = None
         self._ticks = 0  # batched steps executed (observability)
+        # host-side serving telemetry (always maintained; mirrored onto
+        # fedtrace counters when tracing is on — host ints only, the
+        # engine never adds a device sync for observability)
+        self.serve_stats: Dict[str, Any] = {
+            "admits": 0, "tokens": 0, "requests": {}}
+        self._tok_window = [time.monotonic(), 0]
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # -- public api --------------------------------------------------------
     def submit(self, prompt_ids: List[int], max_new_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> "queue.Queue":
+               eos_id: Optional[int] = None,
+               adapter: Optional[str] = None) -> "queue.Queue":
         """Enqueue a request; returns a queue yielding token ids then
-        ``None``."""
+        ``None``.  ``adapter`` names a registered bank row (multi-tenant
+        engines only; ``KeyError`` for unknown names) — the row is pinned
+        until the request finishes, so an eviction or re-registration
+        mid-stream can never change the weights under an in-flight slot."""
         out: "queue.Queue" = queue.Queue()
+        row, atok = 0, None
+        if self.registry is not None:
+            # resolve at submit so unknown adapters fail the caller, not
+            # the engine thread; the pin travels with the request
+            row, atok = self.registry.acquire(adapter)
+        elif adapter:
+            raise ValueError("engine built without an adapter registry "
+                             f"(adapter_slots=0) — cannot route {adapter!r}")
         # the put happens under _cond so it cannot interleave with the
         # shutdown/crash drain (which also holds _cond): either the request
         # lands before the drain and receives its sentinel, or the stopped
         # flag is already visible here and we raise
-        with self._cond:
-            if self._stopped or not self._thread.is_alive():
-                raise RuntimeError("engine stopped")
-            self._waiting.put({
-                "prompt_ids": list(prompt_ids)[-(self.buf_len - 1):],
-                "max_new_tokens": int(max_new_tokens),
-                "temperature": float(temperature),
-                "seed": int(seed),
-                "eos_id": eos_id,
-                "q": out,
-            })
-            self._cond.notify()
+        try:
+            with self._cond:
+                if self._stopped or not self._thread.is_alive():
+                    raise RuntimeError("engine stopped")
+                self._waiting.put({
+                    "prompt_ids": list(prompt_ids)[-(self.buf_len - 1):],
+                    "max_new_tokens": int(max_new_tokens),
+                    "temperature": float(temperature),
+                    "seed": int(seed),
+                    "eos_id": eos_id,
+                    "adapter_row": row,
+                    "adapter_token": atok,
+                    "q": out,
+                })
+                name = adapter if adapter is not None else "base"
+                reqs = self.serve_stats["requests"]
+                reqs[name] = reqs.get(name, 0) + 1
+                tracer = get_tracer()
+                if tracer.enabled:
+                    tracer.counter(f"serve.requests.{name}", reqs[name])
+                self._cond.notify()
+        except BaseException:
+            if self.registry is not None:
+                self.registry.release(row)
+            raise
         return out
 
     def generate(self, prompt_ids: List[int], **kw) -> List[int]:
@@ -249,6 +341,9 @@ class ContinuousBatchingEngine:
         if s.q is not None:
             s.q.put(None)
         s.q = None
+        if self.registry is not None and s.adapter_row:
+            self.registry.release(s.adapter_row)
+            s.adapter_row = 0
 
     def _emit(self, i: int, tok: int) -> bool:
         """Deliver one sampled token; returns False when the slot is done
@@ -263,6 +358,8 @@ class ContinuousBatchingEngine:
         s.q.put(tok)
         s.remaining -= 1
         s.cur_tok = tok
+        self.serve_stats["tokens"] += 1
+        self._tok_window[1] += 1
         return s.remaining > 0 and s.pos < self.buf_len
 
     def _admit(self, req: dict, slot: int):
@@ -272,7 +369,18 @@ class ContinuousBatchingEngine:
         buf[0, :n] = ids
         key = jax.random.PRNGKey(req["seed"])
         temp = jnp.float32(req["temperature"])
-        hit_len, hit_cache = (self.prefix_cache.lookup(ids, self.raw_params)
+        # multi-tenant: prefill against the request's gathered bank row
+        # (row 0 = the zero adapter for base traffic, so the lora arg is
+        # ALWAYS a tree on MT engines — one compiled prefill).  The prefix
+        # cache keys on the registration token, not the gathered tree
+        # (fresh identity per gather): KV computed under one adapter
+        # version can never serve another.
+        row = req.get("adapter_row", 0)
+        atok = req.get("adapter_token")
+        lora = (self.registry.lora_for_row(row)
+                if self.registry is not None else None)
+        hit_len, hit_cache = (self.prefix_cache.lookup(ids, self.raw_params,
+                                                       atok)
                               if self.prefix_cache is not None and n > 0
                               else (0, None))
         if hit_cache is not None:
@@ -284,16 +392,16 @@ class ContinuousBatchingEngine:
             max_seq = getattr(getattr(self.model, "cfg", None),
                               "max_seq_len", self.buf_len)
             tok, cache, key = _replay_tail(
-                partial(self._tail_step, self.raw_params, None),
-                partial(self._tail_block, self.raw_params, None),
+                partial(self._tail_step, self.raw_params, lora),
+                partial(self._tail_block, self.raw_params, lora),
                 cache, jnp.asarray(buf), ids, start, n, max_seq, key, temp)
         else:
             key, sub = jax.random.split(key)
-            tok, cache = self._prefill(self.raw_params, None,
+            tok, cache = self._prefill(self.raw_params, lora,
                                        jnp.asarray(buf), jnp.int32(n),
                                        sub, temp)
         if self.prefix_cache is not None and n > 0:
-            self.prefix_cache.insert(ids, cache, self.raw_params)
+            self.prefix_cache.insert(ids, cache, self.raw_params, atok)
         self._caches = self._insert(self._caches, cache, jnp.int32(slot))
         s = self._slots[slot]
         s.live = True
@@ -301,10 +409,21 @@ class ContinuousBatchingEngine:
         s.pos = n
         s.remaining = req["max_new_tokens"]
         s.eos_id = req["eos_id"]
+        s.adapter_row = row
+        self._aids[slot] = row
         self._temps[slot] = req["temperature"]
         self._keys[slot] = np.asarray(key)
         if not self._emit(slot, int(tok)):
             self._finish(slot)
+
+    def _drain_waiting(self):
+        """Fail-open every queued request (caller holds ``_cond``),
+        dropping its adapter pin so evicted rows can still reclaim."""
+        while not self._waiting.empty():
+            req = self._waiting.get()
+            req["q"].put(None)
+            if self.registry is not None and req.get("adapter_row"):
+                self.registry.release(req["adapter_row"])
 
     def _run(self):
         try:
@@ -318,8 +437,7 @@ class ContinuousBatchingEngine:
                 for i, s in enumerate(self._slots):
                     if s.live:
                         self._finish(i)
-                while not self._waiting.empty():
-                    self._waiting.get()["q"].put(None)
+                self._drain_waiting()
                 self._cond.notify_all()  # wake update_params waiters
 
     def _run_loop(self):
@@ -333,8 +451,7 @@ class ContinuousBatchingEngine:
                     for i, s in enumerate(self._slots):
                         if s.live:
                             self._finish(i)
-                    while not self._waiting.empty():
-                        self._waiting.get()["q"].put(None)
+                    self._drain_waiting()
                     self._cond.notify_all()
                     return
                 # apply a staged weight swap once live slots drain; the
@@ -354,17 +471,32 @@ class ContinuousBatchingEngine:
             # admit waiting requests into free slots (token-granularity
             # join) — paused while a swap waits for the drain, so no
             # request straddles the weight boundary
+            tracer = get_tracer()
             while not swap_pending and not self._waiting.empty():
                 slot = self._free_slot()
                 if slot is None:
                     break
-                self._admit(self._waiting.get(), slot)
+                req = self._waiting.get()
+                with tracer.span("serve.admit", cat="serve", slot=slot,
+                                 adapter_row=req.get("adapter_row", 0)):
+                    self._admit(req, slot)
+                self.serve_stats["admits"] += 1
+            if tracer.enabled:
+                tracer.counter("serve.queue_depth", self._waiting.qsize())
 
             live = [i for i, s in enumerate(self._slots) if s.live]
             if not live:
                 continue
             self._dispatch(live)
             self._ticks += 1
+            if tracer.enabled:
+                t0, ntok = self._tok_window
+                now = time.monotonic()
+                if now - t0 >= 0.5:
+                    tracer.counter("serve.tokens_per_s", ntok / (now - t0))
+                    tracer.counter("serve.tokens_total",
+                                   self.serve_stats["tokens"])
+                    self._tok_window = [now, 0]
 
     def _dispatch(self, live):
         """One device tick for the live slots (overridden by the
@@ -372,10 +504,22 @@ class ContinuousBatchingEngine:
         for i in live:
             self._toks[i] = self._slots[i].cur_tok
             self._poss[i] = self._slots[i].pos
-        toks, self._caches, keys = self._step(
-            self.raw_params, self._caches, jnp.asarray(self._toks),
-            jnp.asarray(self._poss), jnp.asarray(self._keys),
-            jnp.asarray(self._temps))
+        if self.registry is not None:
+            # snapshot + dispatch under the registry lock so a concurrent
+            # register()'s donated row write cannot invalidate the bank
+            # buffer between the read and the launch (the dispatch itself
+            # is async and fast; registration is the rare path)
+            with self.registry.lock:
+                toks, self._caches, keys = self._step(
+                    self.raw_params, self.registry.bank, self._caches,
+                    jnp.asarray(self._toks), jnp.asarray(self._poss),
+                    jnp.asarray(self._keys), jnp.asarray(self._temps),
+                    jnp.asarray(self._aids))
+        else:
+            toks, self._caches, keys = self._step(
+                self.raw_params, self._caches, jnp.asarray(self._toks),
+                jnp.asarray(self._poss), jnp.asarray(self._keys),
+                jnp.asarray(self._temps))
         toks_host = np.asarray(toks)  # (n_slots, horizon)
         self._keys = np.array(keys)  # writable copy (admit mutates rows)
         for i in live:
@@ -488,13 +632,17 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             self._pending_draft = None
 
     def submit(self, prompt_ids, max_new_tokens: int = 64,
-               temperature: float = 0.0, seed: int = 0, eos_id=None):
+               temperature: float = 0.0, seed: int = 0, eos_id=None,
+               adapter: Optional[str] = None):
         if float(temperature) != 0.0:
             raise ValueError("SpeculativeBatchingEngine is greedy-only "
                              "(temperature 0); use ContinuousBatchingEngine "
                              "for sampled requests")
+        # single-tenant: the base class rejects non-None adapters (no
+        # registry), so the kwarg just rides through for signature parity
         return super().submit(prompt_ids, max_new_tokens=max_new_tokens,
-                              temperature=0.0, seed=seed, eos_id=eos_id)
+                              temperature=0.0, seed=seed, eos_id=eos_id,
+                              adapter=adapter)
 
     def _admit(self, req, slot):
         self._hist[slot] = list(req["prompt_ids"])

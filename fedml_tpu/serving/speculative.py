@@ -34,7 +34,17 @@ import numpy as np
 from ..llm.quantization import dequantize_params, weight_dtype
 
 
-def propose_block(model, params, cache, sync, slen, fd, m):
+def _vars(params, lora):
+    """Variable dict with an optional "lora" collection — ``None`` is a
+    trace-time constant, so adapter-blind callers compile the exact
+    pre-lora programs."""
+    v = {"params": params}
+    if lora is not None:
+        v["lora"] = lora
+    return v
+
+
+def propose_block(model, params, cache, sync, slen, fd, m, lora=None):
     """Un-jitted fused draft round: catch-up sync + m-token greedy
     proposal — the single source of truth for the draft-side cache
     position logic, shared by :func:`speculative_generate` (jitted per
@@ -47,7 +57,7 @@ def propose_block(model, params, cache, sync, slen, fd, m):
     ``fd + slen + j``.
     """
     logits, mut = model.apply(
-        {"params": params, "cache": cache}, sync[None, :], decode=True,
+        {**_vars(params, lora), "cache": cache}, sync[None, :], decode=True,
         start_pos=fd, mutable=["cache"])
     cache = mut["cache"]
     pos = fd + slen - 1                  # last canonical position
@@ -57,7 +67,7 @@ def propose_block(model, params, cache, sync, slen, fd, m):
     def body(carry, j):
         tok, cache = carry               # tok sits at position pos+j
         lg, mut = model.apply(
-            {"params": params, "cache": cache}, tok[None, None],
+            {**_vars(params, lora), "cache": cache}, tok[None, None],
             decode=True, start_pos=pos + j, mutable=["cache"])
         nxt = jnp.argmax(lg[0, 0]).astype(jnp.int32)
         return (nxt, mut["cache"]), nxt
@@ -72,12 +82,12 @@ def propose_block(model, params, cache, sync, slen, fd, m):
     return first[None], cache
 
 
-def verify_greedy_block(model, params, cache, block, pos):
+def verify_greedy_block(model, params, cache, block, pos, lora=None):
     """Un-jitted target verify: ``block`` (k,) tokens written at positions
     ``pos..pos+k-1``; returns the target's greedy prediction for each next
     position.  ``params`` must already be dequantized."""
     logits, mut = model.apply(
-        {"params": params, "cache": cache}, block[None, :], decode=True,
+        {**_vars(params, lora), "cache": cache}, block[None, :], decode=True,
         start_pos=pos, mutable=["cache"])
     return jnp.argmax(logits[0], axis=-1).astype(jnp.int32), mut["cache"]
 
@@ -85,36 +95,41 @@ def verify_greedy_block(model, params, cache, block, pos):
 @functools.lru_cache(maxsize=16)
 def _build_spec_fns(model):
     # not k-specialized: verify_block handles any block length via jit
-    # retracing, so the cache keys on the model alone
+    # retracing, so the cache keys on the model alone.  Every function
+    # takes ``lora`` as its second argument — a LoRA tree for per-request
+    # personalization (traced, so one compiled program serves every
+    # adapter of a given shape) or None for adapter-blind decode — the
+    # same convention as openai_compat._build_cached_decode.
     wdtype = weight_dtype(model)
 
     @jax.jit
-    def prefill(params, buf, n):
+    def prefill(params, lora, buf, n):
         logits, mut = model.apply(
-            {"params": dequantize_params(params, wdtype)}, buf, decode=True,
+            _vars(dequantize_params(params, wdtype), lora), buf, decode=True,
             start_pos=jnp.zeros((), jnp.int32), mutable=["cache"])
         live = jax.lax.dynamic_index_in_dim(logits[0], n - 1, axis=0,
                                             keepdims=False)
         return jnp.argmax(live).astype(jnp.int32), mut["cache"]
 
     @jax.jit
-    def step(params, cache, tok, pos):
+    def step(params, lora, cache, tok, pos):
         logits, mut = model.apply(
-            {"params": dequantize_params(params, wdtype), "cache": cache},
+            {**_vars(dequantize_params(params, wdtype), lora),
+             "cache": cache},
             tok[None, None], decode=True, start_pos=pos, mutable=["cache"])
         return jnp.argmax(logits[0, 0]).astype(jnp.int32), mut["cache"]
 
     @jax.jit
-    def verify_block(params, cache, block, pos):
+    def verify_block(params, lora, cache, block, pos):
         return verify_greedy_block(model, dequantize_params(params, wdtype),
-                                   cache, block, pos)
+                                   cache, block, pos, lora)
 
     @functools.partial(jax.jit, static_argnames=("m",))
-    def propose(params, cache, sync_buf, sync_len, start, m):
+    def propose(params, lora, cache, sync_buf, sync_len, start, m):
         """Fused draft round: catch-up sync + m-token proposal, ONE
         dispatch (body shared with the batched engine: propose_block)."""
         return propose_block(model, dequantize_params(params, wdtype),
-                             cache, sync_buf, sync_len, start, m)
+                             cache, sync_buf, sync_len, start, m, lora)
 
     return prefill, step, verify_block, propose
 
@@ -123,7 +138,8 @@ def speculative_generate(model, params, draft_model, draft_params,
                          prompt_ids: List[int], max_new_tokens: int = 64,
                          buf_len: int = 256, k: int = 4,
                          eos_id: Optional[int] = None,
-                         on_token=None, adaptive_k: bool = True
+                         on_token=None, adaptive_k: bool = True,
+                         lora=None, draft_lora=None
                          ) -> Tuple[List[int], Dict[str, float]]:
     """Greedy decode of ``max_new_tokens`` with draft-model speculation.
 
@@ -138,6 +154,13 @@ def speculative_generate(model, params, draft_model, draft_params,
     draft stops burning draft forwards while an aligned one still reaches
     the full depth.  Output is unaffected (verified: any depth schedule
     yields the target-greedy stream).
+
+    ``lora`` applies a LoRA adapter tree to the TARGET's prefill and
+    verify (same argument the cached-decode builders take), so the output
+    is bit-identical to ``generate(..., lora=lora)`` at temperature 0 —
+    speculative + LoRA serves the adapter, not the base.  ``draft_lora``
+    optionally personalizes the draft too; leaving the draft adapter-blind
+    only lowers the acceptance rate, never changes output.
     """
     raw = params.get("params", params) if isinstance(params, dict) else params
     draw = draft_params.get("params", draft_params) \
@@ -153,8 +176,8 @@ def speculative_generate(model, params, draft_model, draft_params,
 
     # both models prefill the prompt; target's greedy next-token is the
     # first "cur" (identical to generate()'s prefill output at temp 0)
-    cur, t_cache = t_prefill(raw, buf_j, jnp.int32(n))
-    _, d_cache = d_prefill(draw, buf_j, jnp.int32(n))
+    cur, t_cache = t_prefill(raw, lora, buf_j, jnp.int32(n))
+    _, d_cache = d_prefill(draw, draft_lora, buf_j, jnp.int32(n))
     pos = n
     out: List[int] = []
     f_d = n  # draft CONFIRMED frontier: positions < f_d hold canonical K/V
@@ -201,7 +224,8 @@ def speculative_generate(model, params, draft_model, draft_params,
             assert len(sync) <= k + 1, (len(sync), k)  # f_d trails pos by <= k
             sync_buf = np.zeros(k + 1, np.int32)
             sync_buf[:len(sync)] = sync
-            d_jax, d_cache = d_propose(draw, d_cache, jnp.asarray(sync_buf),
+            d_jax, d_cache = d_propose(draw, draft_lora, d_cache,
+                                       jnp.asarray(sync_buf),
                                        jnp.int32(len(sync)), jnp.int32(f_d),
                                        block_k - 1)
             stats["draft_forwards"] += block_k - 1
@@ -212,7 +236,7 @@ def speculative_generate(model, params, draft_model, draft_params,
 
         # one target forward verifies cur + all proposals
         block = jnp.asarray([cur] + d_tokens, jnp.int32)
-        greedy, t_cache = t_verify(raw, t_cache, block, jnp.int32(pos))
+        greedy, t_cache = t_verify(raw, lora, t_cache, block, jnp.int32(pos))
         stats["target_forwards"] += 1
         greedy_host = np.asarray(greedy)
 

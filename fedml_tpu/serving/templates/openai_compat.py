@@ -454,7 +454,7 @@ class OpenAICompatServer:
                  decode_horizon: int = 1, spec_k: int = 4,
                  prefix_cache_slots: int = 0,
                  prefix_max_tail: int = TAIL_BLOCK,
-                 adapters=None):
+                 adapters=None, adapter_slots: int = 0):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -502,41 +502,52 @@ class OpenAICompatServer:
                                             max_tail=int(prefix_max_tail))
         # adapters: {name: LoRA tree} over ONE shared base — per-request
         # personalization for federated clients (request field
-        # {"adapter": name}; no field = the zero adapter = base behavior).
-        # Requires a lora_rank>0 model config; one compiled program
-        # serves every adapter (the tree is a traced argument).  The
-        # reference serves one full model copy per personalized endpoint.
+        # {"adapter": name} or {"model": name}; neither = the zero adapter
+        # = base behavior).  Requires a lora_rank>0 model config; one
+        # compiled program serves every adapter (the tree is a traced
+        # argument).  With ``batch_slots`` the adapters live in a
+        # device-resident bank (serving/adapters.AdapterRegistry) of
+        # ``adapter_slots`` rows and requests for DIFFERENT adapters share
+        # one batched decode program; without an engine each request
+        # carries its tree through the single-request path.  The reference
+        # serves one full model copy per personalized endpoint.
         self.adapters = None
         self._zero_lora = None
-        if adapters is not None:
+        self.registry = None
+        if adapters is not None or adapter_slots:
             if model is None:
                 raise ValueError("adapters require `model` (KV-cached "
                                  "decode carries the lora collection)")
             if getattr(getattr(model, "cfg", None), "lora_rank", 0) <= 0:
                 raise ValueError("adapters require a lora_rank>0 model "
                                  "config (LoRADense layers)")
+            if batch_slots and draft_model is not None:
+                raise ValueError(
+                    "adapters and the speculative batching engine are "
+                    "incompatible (it is single-tenant greedy) — drop "
+                    "draft_model or batch_slots")
             if batch_slots:
-                raise ValueError(
-                    "adapters serve the single-request path; the batched "
-                    "engine applies no lora collection — drop batch_slots")
-            if draft_model is not None:
-                raise ValueError(
-                    "adapters and draft_model are incompatible: the "
-                    "speculative path applies no lora collection (a "
-                    "greedy request would crash or silently serve base "
-                    "output) — drop one")
-            self.adapters = dict(adapters)
-            # zero A/B -> the adapter term vanishes: base behavior.
-            # eval_shape + zeros, NOT model.init: init would materialize
-            # a full base-parameter tree (and trace a forward) just to
-            # read the lora collection — a transient full-model
-            # allocation a box sized for int8-quantized weights may not
-            # survive
-            shapes = jax.eval_shape(
-                lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
-                jax.random.PRNGKey(0))["lora"]
-            self._zero_lora = jax.tree_util.tree_map(
-                lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+                from ..adapters import AdapterRegistry
+                cap = int(adapter_slots) or len(adapters or {}) + 8
+                self.registry = AdapterRegistry(model, capacity=cap)
+                for name, tree in (adapters or {}).items():
+                    self.registry.register(name, tree)
+            else:
+                # (draft_model + adapters is fine here: greedy requests
+                # route through speculative_generate, which carries the
+                # lora tree — parity-tested)
+                self.adapters = dict(adapters or {})
+                # zero A/B -> the adapter term vanishes: base behavior.
+                # eval_shape + zeros, NOT model.init: init would
+                # materialize a full base-parameter tree (and trace a
+                # forward) just to read the lora collection — a transient
+                # full-model allocation a box sized for int8-quantized
+                # weights may not survive
+                shapes = jax.eval_shape(
+                    lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32)),
+                    jax.random.PRNGKey(0))["lora"]
+                self._zero_lora = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes)
         self._engine = None
         self._engine_greedy_only = False
         if batch_slots:
@@ -569,7 +580,8 @@ class OpenAICompatServer:
                     model, params, slots=int(batch_slots), buf_len=buf_len,
                     horizon=int(decode_horizon),
                     prefix_cache_slots=int(prefix_cache_slots),
-                    prefix_max_tail=int(prefix_max_tail))
+                    prefix_max_tail=int(prefix_max_tail),
+                    adapter_registry=self.registry)
                 self.prefix_cache = self._engine.prefix_cache
         self._server: Optional[ThreadingHTTPServer] = None
 
@@ -594,9 +606,21 @@ class OpenAICompatServer:
                 on_text(clean[sent:])
                 sent = len(clean)
 
+        # adapter routing: an explicit {"adapter": name} field, or —
+        # multi-tenant OpenAI convention — {"model": name} naming anything
+        # other than the server's base model id (so a federated client
+        # points its stock OpenAI SDK at its own cohort's adapter)
         adapter_name = req.get("adapter")
+        if not adapter_name:
+            m = req.get("model")
+            if (isinstance(m, str) and m and m != self.model_name
+                    and (self.adapters is not None
+                         or self.registry is not None)):
+                adapter_name = m
         lora = None
-        if self.adapters is not None:
+        if self.registry is not None:
+            pass  # resolved (and pinned) per-path below
+        elif self.adapters is not None:
             if adapter_name:
                 if adapter_name not in self.adapters:
                     raise RequestError(
@@ -625,12 +649,19 @@ class OpenAICompatServer:
                          and (req_top_k > 0 or req_top_p < 1.0))
         if self._engine is not None and not wants_filters and not (
                 self._engine_greedy_only and temp != 0.0):
-            q = self._engine.submit(
-                tok.encode(prompt),
-                max_new_tokens=int(req.get("max_tokens", 64)),
-                temperature=temp,
-                seed=int(req.get("seed", 0)),
-                eos_id=getattr(tok, "eos_id", None))
+            try:
+                q = self._engine.submit(
+                    tok.encode(prompt),
+                    max_new_tokens=int(req.get("max_tokens", 64)),
+                    temperature=temp,
+                    seed=int(req.get("seed", 0)),
+                    eos_id=getattr(tok, "eos_id", None),
+                    adapter=adapter_name)
+            except KeyError as e:
+                # unknown adapter — resolved at submit so the 404 happens
+                # before any slot/queue state is touched
+                raise RequestError(str(e.args[0] if e.args else e),
+                                   status=404)
             out = []
             while True:
                 try:
@@ -642,30 +673,47 @@ class OpenAICompatServer:
                 out.append(t)
                 if on_text:
                     emit(t)
-        elif self.draft_model is not None and temp == 0.0:
-            from ..speculative import speculative_generate
-            out, _spec_stats = speculative_generate(
-                self.model, self.params, self.draft_model,
-                self.draft_params, tok.encode(prompt),
-                max_new_tokens=int(req.get("max_tokens", 64)),
-                buf_len=self.buf_len,
-                eos_id=getattr(tok, "eos_id", None),
-                on_token=emit if on_text else None)
         else:
-            out = generate(
-                self.apply_fn, self.params, tok.encode(prompt),
-                max_new_tokens=int(req.get("max_tokens", 64)),
-                temperature=temp,
-                top_k=req_top_k,
-                top_p=min(max(req_top_p, 0.0), 1.0),
-                seed=int(req.get("seed", 0)),
-                buf_len=self.buf_len,
-                eos_id=getattr(tok, "eos_id", None),
-                on_token=emit if on_text else None,
-                model=self.model,
-                prefix_cache=(self.prefix_cache if self._engine is None
-                              else None),
-                lora=lora)
+            release_row = None
+            if self.registry is not None:
+                # fall-through around the MT engine (per-request
+                # top_k/top_p filters): pin the bank row for the whole
+                # generation so an eviction can't reclaim it mid-request
+                try:
+                    release_row, _atok = self.registry.acquire(adapter_name)
+                except KeyError as e:
+                    raise RequestError(str(e.args[0] if e.args else e),
+                                       status=404)
+                lora = self.registry.lora_for_row(release_row)
+            try:
+                if self.draft_model is not None and temp == 0.0:
+                    from ..speculative import speculative_generate
+                    out, _spec_stats = speculative_generate(
+                        self.model, self.params, self.draft_model,
+                        self.draft_params, tok.encode(prompt),
+                        max_new_tokens=int(req.get("max_tokens", 64)),
+                        buf_len=self.buf_len,
+                        eos_id=getattr(tok, "eos_id", None),
+                        on_token=emit if on_text else None,
+                        lora=lora)
+                else:
+                    out = generate(
+                        self.apply_fn, self.params, tok.encode(prompt),
+                        max_new_tokens=int(req.get("max_tokens", 64)),
+                        temperature=temp,
+                        top_k=req_top_k,
+                        top_p=min(max(req_top_p, 0.0), 1.0),
+                        seed=int(req.get("seed", 0)),
+                        buf_len=self.buf_len,
+                        eos_id=getattr(tok, "eos_id", None),
+                        on_token=emit if on_text else None,
+                        model=self.model,
+                        prefix_cache=(self.prefix_cache
+                                      if self._engine is None else None),
+                        lora=lora)
+            finally:
+                if release_row is not None:
+                    self.registry.release(release_row)
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail
@@ -685,9 +733,14 @@ class OpenAICompatServer:
 
             def do_GET(self):
                 if self.path == "/v1/models":
+                    names = [outer.model_name]
+                    if outer.registry is not None:
+                        names += outer.registry.names()
+                    elif outer.adapters is not None:
+                        names += sorted(outer.adapters)
                     self._send_json(200, {"object": "list", "data": [
-                        {"id": outer.model_name, "object": "model",
-                         "owned_by": "fedml_tpu"}]})
+                        {"id": n, "object": "model",
+                         "owned_by": "fedml_tpu"} for n in names]})
                 elif self.path in ("/ready", "/health"):
                     self._send_json(200, {"ready": True})
                 else:
@@ -765,11 +818,28 @@ class OpenAICompatServer:
     def add_adapter(self, name: str, lora_tree) -> None:
         """Register/replace a personalization adapter (e.g. a client's
         trained LoRA from a federated round).  No recompile: the adapter
-        tree is a traced argument of the shared decode program."""
+        tree is a traced argument of the shared decode program.  In
+        multi-tenant engine mode this hot-swaps a bank row (in-flight
+        requests on the old version finish on it — copy-on-write)."""
+        if self.registry is not None:
+            self.registry.register(str(name), lora_tree)
+            return
         if self.adapters is None:
             raise ValueError("server built without adapters= — construct "
-                             "with adapters={} to enable personalization")
+                             "with adapters={} (or batch_slots + "
+                             "adapter_slots) to enable personalization")
         self.adapters[str(name)] = lora_tree
+
+    def evict_adapter(self, name: str) -> None:
+        """Stop routing ``name``.  Engine mode delegates to the registry
+        (in-flight requests drain on their pinned row); dict mode just
+        drops the entry."""
+        if self.registry is not None:
+            self.registry.evict(str(name))
+            return
+        if self.adapters is None or str(name) not in self.adapters:
+            raise KeyError(f"unknown adapter {name!r}")
+        del self.adapters[str(name)]
 
     def update_params(self, params, draft_params=None,
                       timeout: float = 60.0) -> None:

@@ -1,0 +1,32 @@
+"""Fixture: the serving-plane recompile anti-pattern — per-request python
+scalars (temperature / top_p / the adapter set) baked into the jitted
+decode step's signature instead of passed as traced data, so every
+distinct request shape compiles a fresh program."""
+import jax
+
+decode = jax.jit(lambda params, tok, sampler: tok,
+                 static_argnames=("sampler",))
+
+
+def serve_requests(params, requests):
+    outs = []
+    for req in requests:
+        temp, top_p = req["temperature"], req["top_p"]
+        # fresh jit per request: temp/top_p close over the step, so every
+        # distinct request pays a compile
+        step = jax.jit(lambda p, t: t / temp + top_p)
+        outs.append(step(params, req["tok"]))
+    return outs
+
+
+def serve_with_adapters(params, tok, adapters):
+    # adapter-count baked in as an unhashable static: each request's
+    # adapter list is a new cache entry (or a TypeError)
+    return decode(params, tok, sampler={"adapters": adapters})
+
+
+@jax.jit
+def sample(logits, temp):
+    if temp > 0:            # Python branch on the traced temperature
+        return logits / temp
+    return logits

@@ -129,6 +129,28 @@ def test_bench_comms_quick(monkeypatch):
     assert out["int8_quant_error_norm"] > out["bf16_quant_error_norm"]
 
 
+def test_bench_serve_mt_quick(monkeypatch):
+    """bench.py --serve-mt smoke: the multi-tenant LoRA serving benchmark
+    runs green — N adapters + base through ONE engine with zero
+    steady-state recompiles across adapter switches, an adapter-blind
+    baseline ratio, and the closed-loop load harness envelope (the
+    >=0.8x / N>=32 acceptance numbers come from the full-size run, not
+    this trimmed battery)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_SERVE_MT_QUICK", "1")
+    out = bench.serve_mt_bench()
+    assert out["quick"] is True
+    assert out["adapters"] == 3
+    assert out["steady_state_recompiles"] == 0
+    assert out["single_adapter_tok_s"] > 0
+    assert out["mt_tok_s"] > 0
+    assert out["mt_vs_single_ratio"] > 0
+    load = out["load"]
+    assert load["completed"] == load["requests"] and load["failed"] == 0
+    assert load["latency_p99_ms"] >= load["latency_p50_ms"] > 0
+    assert load["tokens_per_s"] > 0
+
+
 def test_bench_mesh2d_quick(monkeypatch):
     """bench.py --mesh2d smoke: the 1-D (8,1) vs 2-D (4,2) comparison runs
     green at a fixed 8-chip count, the per-axis ObsCarry byte split is

@@ -1,0 +1,389 @@
+"""Multi-tenant LoRA serving (ISSUE 9): the adapter bank/registry, the
+grouped-adapter continuous-batching engine, checkpoint hot-swap, server
+routing, and the closed-loop load harness.
+
+The engine contracts pinned here:
+
+- greedy multi-tenant output is BIT-IDENTICAL per slot to the
+  single-request ``generate(..., lora=...)`` path;
+- adapter switches (including a hot-swap registration mid-traffic) add
+  ZERO steady-state recompiles — bank capacity is static, membership is
+  data;
+- eviction/re-registration can never corrupt an in-flight slot (pinned
+  rows survive as zombies until their readers drain).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+from fedml_tpu.serving.adapters import AdapterRegistry, BankFullError
+from fedml_tpu.serving.batching import ContinuousBatchingEngine
+from fedml_tpu.serving.templates.openai_compat import generate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+BUF = 48
+
+
+def rand_lora(seed, lora_zeros, scale=0.5):
+    """A saturated (A AND B nonzero) adapter — ``lora_init`` keeps B zero
+    (PEFT identity init), which would make every adapter ≡ base and let a
+    wrong-row bank gather pass parity silently.  Distinct seeds must
+    produce distinct greedy streams."""
+    flat, treedef = jax.tree_util.tree_flatten(lora_zeros)
+    leaves = [scale * jax.random.normal(
+        jax.random.fold_in(jax.random.PRNGKey(seed), i), l.shape, l.dtype)
+        for i, l in enumerate(flat)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@pytest.fixture(scope="module")
+def mt_setup():
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=BUF,
+                      dtype=jnp.float32, attn_impl="blockwise", lora_rank=4)
+    model = LlamaLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    loras = {f"a{i}": rand_lora(10 + i, variables["lora"])
+             for i in range(3)}
+    zero = jax.tree_util.tree_map(jnp.zeros_like, variables["lora"])
+    return model, variables["params"], variables["lora"], loras, zero
+
+
+def _drain(q):
+    return [t for t in iter(q.get, None)]
+
+
+def _apply(model):
+    return lambda p, t: model.apply({"params": p}, t)
+
+
+def test_multi_tenant_engine_greedy_parity(mt_setup):
+    """Concurrent requests on 3 different adapters + base through ONE
+    engine: every slot's greedy stream equals its single-request
+    ``generate(..., lora=...)`` bit-for-bit."""
+    model, params, _, loras, zero = mt_setup
+    eng = ContinuousBatchingEngine(model, params, slots=3, buf_len=BUF,
+                                   adapter_slots=8)
+    try:
+        for n, t in loras.items():
+            eng.registry.register(n, t)
+        prompts = [[5, 17, 42], [7, 7], [1, 2, 3, 4], [60], [33, 9]]
+        adapters = ["a0", "a1", None, "a2", "a0"]
+        budgets = [8, 5, 9, 6, 7]
+        qs = [eng.submit(p, max_new_tokens=b, adapter=a)
+              for p, a, b in zip(prompts, adapters, budgets)]
+        outs = [_drain(q) for q in qs]
+        for p, a, b, got in zip(prompts, adapters, budgets, outs):
+            want = generate(_apply(model), params, p, max_new_tokens=b,
+                            buf_len=BUF, model=model,
+                            lora=loras[a] if a else zero)
+            assert got == want, (p, a, got, want)
+        assert eng.serve_stats["requests"] == {
+            "a0": 2, "a1": 1, "a2": 1, "base": 1}
+    finally:
+        eng.stop()
+
+
+def test_adapter_switches_zero_recompiles(mt_setup):
+    """Once warm, traffic hopping across every registered adapter — plus
+    a hot-swap registration mid-audit — reuses the ONE compiled batched
+    step (bank + adapter_ids are traced data, capacity is static)."""
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    model, params, lora_zeros, loras, _ = mt_setup
+    eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=BUF,
+                                   adapter_slots=8)
+    try:
+        for n, t in loras.items():
+            eng.registry.register(n, t)
+        # warm: adapter + base admission and the batched step
+        eng.generate([5, 17], max_new_tokens=2, adapter="a0")
+        eng.generate([5, 17], max_new_tokens=2)
+        with JaxRuntimeAudit() as audit:
+            eng.registry.register("hot", rand_lora(77, lora_zeros))
+            mix = ["a0", None, "a1", "hot", "a2", "a0"]
+            qs = [eng.submit([i + 1, i + 2], max_new_tokens=4, adapter=a)
+                  for i, a in enumerate(mix)]
+            for q in qs:
+                _drain(q)
+        assert audit.compilations == 0, audit.compiled
+    finally:
+        eng.stop()
+
+
+def test_bank_full_and_evict_reuse(mt_setup):
+    """capacity=4 → 3 user rows; the 4th registration raises
+    BankFullError, and evicting an idle adapter frees its row for
+    immediate reuse."""
+    model, _, lora_zeros, loras, _ = mt_setup
+    reg = AdapterRegistry(model, capacity=4)
+    for n, t in loras.items():
+        reg.register(n, t)
+    extra = rand_lora(50, lora_zeros)
+    with pytest.raises(BankFullError):
+        reg.register("overflow", extra)
+    reg.evict("a1")
+    assert "a1" not in reg
+    row = reg.register("overflow", extra)
+    assert 1 <= row < 4 and "overflow" in reg
+    assert sorted(reg.names()) == ["a0", "a2", "overflow"]
+    with pytest.raises(KeyError):
+        reg.acquire("a1")
+    # shape mismatch must fail loudly, not corrupt a row
+    bad = jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape + (2,)), extra)
+    with pytest.raises(ValueError):
+        reg.register("bad", bad)
+
+
+def test_evict_while_slot_live_preserves_in_flight(mt_setup):
+    """Evicting an adapter while a slot still references it: new submits
+    404 immediately, the in-flight stream finishes bit-identical on the
+    OLD weights (pinned zombie row), and the row reclaims afterwards."""
+    model, params, _, loras, _ = mt_setup
+    eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=BUF,
+                                   adapter_slots=4)
+    try:
+        for n, t in loras.items():
+            if n != "a2":
+                eng.registry.register(n, t)
+        q = eng.submit([5, 17, 42], max_new_tokens=20, adapter="a0")
+        eng.registry.evict("a0")
+        with pytest.raises(KeyError):
+            eng.submit([1], adapter="a0")
+        got = _drain(q)
+        want = generate(_apply(model), params, [5, 17, 42],
+                        max_new_tokens=20, buf_len=BUF, model=model,
+                        lora=loras["a0"])
+        assert got == want, "eviction corrupted an in-flight slot"
+        assert eng.registry.stats["rows_reclaimed"] >= 1
+        # the zombie row is free again: a new adapter can take it
+        eng.registry.register("fresh", loras["a2"])
+    finally:
+        eng.stop()
+
+
+def test_reregister_pinned_name_copy_on_write(mt_setup):
+    """Hot-swapping an adapter name that an in-flight request is pinned
+    to: the stream finishes on the OLD weights; the NEXT request serves
+    the new ones."""
+    model, params, lora_zeros, loras, _ = mt_setup
+    eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=BUF,
+                                   adapter_slots=4)
+    try:
+        eng.registry.register("a1", loras["a1"])
+        q = eng.submit([7, 7], max_new_tokens=18, adapter="a1")
+        new_tree = rand_lora(99, lora_zeros)
+        eng.registry.register("a1", new_tree)   # pinned → fresh row
+        assert eng.registry.stats["copy_on_write"] == 1
+        got_old = _drain(q)
+        want_old = generate(_apply(model), params, [7, 7],
+                            max_new_tokens=18, buf_len=BUF, model=model,
+                            lora=loras["a1"])
+        assert got_old == want_old, "copy-on-write broke the old stream"
+        got_new = eng.generate([7, 7], max_new_tokens=8, adapter="a1")
+        want_new = generate(_apply(model), params, [7, 7],
+                            max_new_tokens=8, buf_len=BUF, model=model,
+                            lora=new_tree)
+        assert got_new == want_new, "re-registered weights not served"
+    finally:
+        eng.stop()
+
+
+def test_int8_base_with_fp_lora_bank(mt_setup):
+    """int8 weight-only quantized base + full-precision adapter bank:
+    the engine's in-trace dequant composes with the bank gather — output
+    equals the single-request int8+lora path bit-for-bit."""
+    from fedml_tpu.llm.quantization import quantize_params_int8
+    model, params, _, loras, _ = mt_setup
+    qtree, _stats = quantize_params_int8(params)
+    eng = ContinuousBatchingEngine(model, qtree, slots=2, buf_len=BUF,
+                                   adapter_slots=4)
+    try:
+        eng.registry.register("a0", loras["a0"])
+        got = eng.generate([5, 17, 42], max_new_tokens=10, adapter="a0")
+        want = generate(_apply(model), qtree, [5, 17, 42],
+                        max_new_tokens=10, buf_len=BUF, model=model,
+                        lora=loras["a0"])
+        assert got == want
+    finally:
+        eng.stop()
+
+
+def test_register_from_checkpoint_population_member(mt_setup, tmp_path):
+    """A federated fine-tune's orbax checkpoint becomes servable without
+    a restart: bare lora-tree states and population-stacked states (via
+    population_member) both load into a bank row equal to the source."""
+    from fedml_tpu.core.checkpoint import RoundCheckpointer
+    model, params, _, loras, _ = mt_setup
+    stacked = jax.tree_util.tree_map(
+        lambda a, b: jnp.stack([a, b]), loras["a0"], loras["a1"])
+    c = RoundCheckpointer(str(tmp_path / "bare"))
+    c.save(5, loras["a2"])
+    c.close()
+    c = RoundCheckpointer(str(tmp_path / "pop"))
+    c.save(2, {"lora": stacked})
+    c.close()
+
+    eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=BUF,
+                                   adapter_slots=6)
+    try:
+        eng.registry.register_from_checkpoint("bare", str(tmp_path / "bare"))
+        eng.registry.register_from_checkpoint("m1", str(tmp_path / "pop"),
+                                              member=1)
+        for name, src in (("bare", loras["a2"]), ("m1", loras["a1"])):
+            got = eng.generate([5, 17, 42], max_new_tokens=8, adapter=name)
+            want = generate(_apply(model), params, [5, 17, 42],
+                            max_new_tokens=8, buf_len=BUF, model=model,
+                            lora=src)
+            assert got == want, name
+    finally:
+        eng.stop()
+    with pytest.raises(FileNotFoundError):
+        AdapterRegistry(model, capacity=2).register_from_checkpoint(
+            "missing", str(tmp_path / "empty"))
+
+
+def test_grouped_lora_dense_matches_per_sample_loop(mt_setup):
+    """LoRADense grouped apply (adapter leaves with a leading batch axis —
+    the bank-gather layout) equals applying each sample's adapter
+    separately."""
+    model, params, _, loras, _ = mt_setup
+    toks = jnp.asarray(np.random.default_rng(0).integers(1, 97, (3, 6)),
+                       jnp.int32)
+    trees = [loras["a0"], loras["a1"], loras["a2"]]
+    grouped = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    out_grouped = model.apply({"params": params, "lora": grouped}, toks)
+    for i, tree in enumerate(trees):
+        out_i = model.apply({"params": params, "lora": tree}, toks[i:i + 1])
+        np.testing.assert_allclose(np.asarray(out_grouped[i:i + 1]),
+                                   np.asarray(out_i), atol=1e-5, rtol=1e-5)
+
+
+def test_openai_server_adapter_model_routing(mt_setup):
+    """HTTP e2e through the MT engine: ``model=<adapter>`` and
+    ``adapter=`` both route onto bank rows; unknown names 404;
+    /v1/models lists the adapters; add_adapter/evict_adapter hot-swap
+    live."""
+    import http.client
+    import json as json_mod
+    from fedml_tpu.serving.templates.openai_compat import (ByteTokenizer,
+                                                           OpenAICompatServer)
+    model, params, _, loras, _ = mt_setup
+    srv = OpenAICompatServer(_apply(model), params, model=model, buf_len=BUF,
+                             batch_slots=2,
+                             adapters={"a0": loras["a0"]}, adapter_slots=6)
+    port = srv.start()
+
+    def post(payload):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/completions", json_mod.dumps(payload),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        body = json_mod.loads(r.read())
+        conn.close()
+        return r.status, body
+
+    tok = ByteTokenizer()
+    try:
+        srv.add_adapter("a1", loras["a1"])
+        for route in ({"model": "a1"}, {"adapter": "a1"}):
+            code, body = post({"prompt": "hi", "max_tokens": 6, **route})
+            want = tok.decode(generate(
+                _apply(model), params, tok.encode("hi"), max_new_tokens=6,
+                buf_len=BUF, model=model, lora=loras["a1"]))
+            assert code == 200 and body["choices"][0]["text"] == want, route
+        code, _ = post({"prompt": "hi", "model": "nope"})
+        assert code == 404
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/v1/models")
+        models = [m["id"] for m in
+                  json_mod.loads(conn.getresponse().read())["data"]]
+        conn.close()
+        assert set(models) >= {"fedml-tpu-llm", "a0", "a1"}, models
+        srv.evict_adapter("a0")
+        code, _ = post({"prompt": "hi", "model": "a0"})
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_engine_serving_counters_in_fedtrace(mt_setup):
+    """With tracing on, the engine emits serve.admit spans plus
+    queue-depth/tokens/per-adapter counters (host ints only), and
+    ``fedtrace summarize`` surfaces them."""
+    import fedtrace
+    from fedml_tpu import obs
+    model, params, _, loras, _ = mt_setup
+    tracer = obs.configure(enabled=True, reset=True, jax_hooks=False)
+    try:
+        eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=BUF,
+                                       adapter_slots=4)
+        try:
+            eng.registry.register("a0", loras["a0"])
+            eng.generate([5, 17], max_new_tokens=4, adapter="a0")
+            eng.generate([5, 17], max_new_tokens=4)
+        finally:
+            eng.stop()
+        summary = fedtrace.summarize(tracer.export_chrome())
+    finally:
+        obs.configure(enabled=False)
+    assert summary["serve_admits"] == 2
+    assert summary["serve_adapter_requests"] == {"a0": 1, "base": 1}
+    assert "serve.queue_depth" in summary["counters"]
+
+
+def test_serve_load_harness_reports_latency_envelope(mt_setup):
+    """Closed-loop load harness: drives the MT engine at a target RPS
+    with a Zipf adapter mix and heavy-tailed prompts; the report carries
+    a sane latency/throughput/queue envelope."""
+    from serve_load import run_load, zipf_weights
+    w = zipf_weights(4, 1.2)
+    assert w[0] > w[1] > w[3] and abs(w.sum() - 1.0) < 1e-12
+    model, params, _, loras, _ = mt_setup
+    eng = ContinuousBatchingEngine(model, params, slots=2, buf_len=BUF,
+                                   adapter_slots=4)
+    try:
+        eng.registry.register("a0", loras["a0"])
+        eng.generate([5, 17], max_new_tokens=2, adapter="a0")  # warm
+        rep = run_load(eng, target_rps=50.0, n_requests=10,
+                       adapters=[None, "a0"], max_new_tokens=4,
+                       vocab=97, seed=0)
+    finally:
+        eng.stop()
+    assert rep["completed"] == 10 and rep["failed"] == 0
+    assert rep["latency_p99_ms"] >= rep["latency_p50_ms"] > 0
+    assert rep["ttft_p50_ms"] <= rep["latency_p50_ms"]
+    assert rep["tokens_total"] == 40 and rep["tokens_per_s"] > 0
+    assert rep["queue_depth_max"] >= 0
+    assert sum(rep["adapter_request_counts"].values()) == 10
+
+
+def test_plain_engine_rejects_adapter_and_registry_validates(mt_setup):
+    """Routing guards: an adapter-less engine refuses adapter submits;
+    the registry refuses non-lora models and capacity < 2."""
+    model, _, _, _, _ = mt_setup
+    dense_cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=1, n_heads=2,
+                            n_kv_heads=2, ffn_dim=64, max_seq_len=BUF,
+                            dtype=jnp.float32, attn_impl="blockwise",
+                            lora_rank=0)
+    dense = LlamaLM(dense_cfg)
+    dense_params = dense.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = ContinuousBatchingEngine(dense, dense_params, slots=2, buf_len=BUF)
+    try:
+        with pytest.raises(ValueError):
+            eng.submit([1], adapter="a0")
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError):
+        AdapterRegistry(dense, capacity=4)
+    with pytest.raises(ValueError):
+        AdapterRegistry(model, capacity=1)

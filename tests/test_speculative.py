@@ -79,6 +79,52 @@ def test_aligned_draft_cuts_target_forwards():
     assert stats["target_forwards"] <= 2 + (n_new - 1 + k - 1) // k, stats
 
 
+def test_speculative_lora_parity():
+    """speculative + LoRA serves the ADAPTER, not the base: output is
+    bit-identical to the non-speculative ``generate(..., lora=...)`` path
+    for an arbitrary draft, and an aligned draft (same model, same
+    adapter via draft_lora) still accepts everything."""
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=64,
+                      dtype=jnp.float32, attn_impl="blockwise", lora_rank=4)
+    target = LlamaLM(cfg)
+    variables = target.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    tparams = variables["params"]
+    # saturated adapter (A AND B nonzero — lora_init's PEFT identity init
+    # keeps B zero, which would make the adapter ≡ base and hide an
+    # adapter-blind decode path)
+    flat, treedef = jax.tree_util.tree_flatten(variables["lora"])
+    lora = jax.tree_util.tree_unflatten(treedef, [
+        0.5 * jax.random.normal(jax.random.fold_in(jax.random.PRNGKey(3), i),
+                                l.shape, l.dtype)
+        for i, l in enumerate(flat)])
+    draft, dparams = _model(1, dim=32, layers=1)
+    apply_fn = lambda p, t: target.apply({"params": p}, t)
+
+    prompt = [5, 17, 42]
+    want = generate(apply_fn, tparams, prompt, max_new_tokens=16,
+                    buf_len=64, model=target, lora=lora)
+    got, _ = speculative_generate(target, tparams, draft, dparams, prompt,
+                                  max_new_tokens=16, buf_len=64, k=4,
+                                  lora=lora)
+    assert got == want, (got, want)
+    # regression for the adapter-blind bug: with the lora the output must
+    # actually DIFFER from base decode (a silently-dropped adapter would
+    # reproduce the base stream)
+    zero = jax.tree_util.tree_map(jnp.zeros_like, variables["lora"])
+    base = generate(apply_fn, tparams, prompt, max_new_tokens=16,
+                    buf_len=64, model=target, lora=zero)
+    assert got != base, "lora made no difference — adapter likely dropped"
+    # aligned draft carrying the same adapter: full acceptance, same text
+    got_a, stats = speculative_generate(target, tparams, target, tparams,
+                                        prompt, max_new_tokens=16,
+                                        buf_len=64, k=4, adaptive_k=False,
+                                        lora=lora, draft_lora=lora)
+    assert got_a == want
+    assert stats["acceptance_rate"] == 1.0
+
+
 def test_openai_server_speculative_matches_plain():
     """HTTP e2e: a server with a draft model returns the same greedy text
     as a plain server."""

@@ -211,6 +211,24 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         out["page_hit_rate"] = round(counters["store.page_hit_rate"], 6)
     if "store.writeback_lag_rounds" in counters:
         out["writeback_lag_rounds"] = counters["store.writeback_lag_rounds"]
+    # multi-tenant serving plane (docs/SERVING.md): admission spans and
+    # the batching engine's host counters — admission-queue depth,
+    # windowed tokens/s, and per-adapter request counts ("base" is
+    # adapterless traffic on the zero bank row)
+    if "serve.admit" in out["spans"]:
+        out["serve_admits"] = out["spans"]["serve.admit"]["count"]
+    if "serve.queue_depth" in counters:
+        out["serve_queue_depth_last"] = counters["serve.queue_depth"]
+    if "serve.tokens_per_s" in counters:
+        out["serve_tokens_per_s_last"] = round(
+            counters["serve.tokens_per_s"], 6)
+    if "serve.tokens_total" in counters:
+        out["serve_tokens_total"] = counters["serve.tokens_total"]
+    adapter_reqs = {k[len("serve.requests."):]: int(v)
+                    for k, v in counters.items()
+                    if k.startswith("serve.requests.")}
+    if adapter_reqs:
+        out["serve_adapter_requests"] = adapter_reqs
     return out
 
 
@@ -263,6 +281,13 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"store paging: {s.get('page_in_bytes', 0.0):.0f} B paged in   "
             f"hit rate {s.get('page_hit_rate', 0.0):g}   "
             f"writeback lag {s.get('writeback_lag_rounds', 0.0):g} rounds")
+    if "serve_admits" in s or "serve_adapter_requests" in s:
+        ad = s.get("serve_adapter_requests", {})
+        lines.append(
+            f"serving: {s.get('serve_admits', 0)} admits   "
+            f"queue depth (last) {s.get('serve_queue_depth_last', 0.0):g}   "
+            f"tokens/s (last) {s.get('serve_tokens_per_s_last', 0.0):g}   "
+            f"{len(ad)} adapters / {sum(ad.values())} requests")
     lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")
     total = sum(s["phases"].values()) or 1.0
     for p in PHASES:

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""serve_load — closed-loop load harness for the multi-tenant serving
+engine (ISSUE 9 / ROADMAP open item 4: the serving plane had never been
+load-tested).
+
+Drives a :class:`~fedml_tpu.serving.batching.ContinuousBatchingEngine` at
+a **target RPS** with the traffic shape production LoRA serving actually
+sees:
+
+- **Poisson arrivals** at ``--rps`` (exponential inter-arrival gaps) —
+  open-loop admission, so a saturated engine shows up as admission-queue
+  depth and latency growth rather than a silently throttled driver;
+- **heavy-tailed prompt lengths** (log-normal, clipped to the engine's
+  buffer) — the short-request-behind-long-request case continuous
+  batching exists for;
+- **Zipf adapter popularity** over the registered adapters plus base
+  traffic — a few hot cohorts, a long cold tail, every request landing
+  on the ONE shared batched program.
+
+Each request's **latency** is measured from its scheduled arrival to its
+completion (so scheduler lag and queueing both count, like a client would
+experience), **TTFT** to its first emitted token.  The report carries
+p50/p99 of both, aggregate tokens/s, achieved admission RPS vs target,
+and the admission-queue depth envelope — the numbers ``bench.py
+--serve-mt`` folds into the BENCH json.
+
+Usage (self-contained tiny-model demo):
+    python tools/serve_load.py [--rps 20] [--requests 64] [--adapters 8]
+Writes SERVE_LOAD.json at the repo root; ``run_load`` is importable for
+driving any engine in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if os.environ.get("FEDML_TPU_PLATFORM") is None:
+    os.environ["FEDML_TPU_PLATFORM"] = "cpu"   # tunnel discipline
+
+
+def _percentile(vals: Sequence[float], q: float) -> float:
+    return float(np.percentile(np.asarray(vals, np.float64), q)) \
+        if len(vals) else 0.0
+
+
+def zipf_weights(n: int, a: float = 1.2) -> np.ndarray:
+    """Zipf popularity over n choices: rank r gets mass ∝ 1/r^a."""
+    w = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** a
+    return w / w.sum()
+
+
+def run_load(engine, *, target_rps: float, n_requests: int,
+             adapters: Sequence[Optional[str]] = (None,),
+             zipf_a: float = 1.2, prompt_len_mean: float = 8.0,
+             prompt_len_sigma: float = 0.8, max_new_tokens: int = 16,
+             vocab: int = 256, seed: int = 0,
+             timeout_s: float = 300.0) -> Dict:
+    """Drive ``engine`` at ``target_rps`` and report the latency/throughput
+    envelope.  ``adapters`` lists the routing choices in popularity order
+    (``None`` = base traffic); the Zipf mix makes the first entries hot.
+
+    The caller should warm the engine's compiled programs first (one
+    request per distinct program) — this harness measures serving, not
+    XLA compilation.
+    """
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / float(target_rps), n_requests)
+    arrival = np.cumsum(gaps)
+    weights = zipf_weights(len(adapters), zipf_a)
+    choice = rng.choice(len(adapters), size=n_requests, p=weights)
+    lens = np.clip(rng.lognormal(np.log(prompt_len_mean), prompt_len_sigma,
+                                 n_requests).astype(np.int64),
+                   1, max(1, engine.buf_len - max_new_tokens - 1))
+    prompts = [rng.integers(2, vocab, int(n)).tolist() for n in lens]
+
+    lat: List[float] = [0.0] * n_requests
+    ttft: List[float] = [0.0] * n_requests
+    toks: List[int] = [0] * n_requests
+    failed: List[int] = []
+    queue_depths: List[int] = []
+    lock = threading.Lock()
+
+    def collect(i: int, q, t_sched: float):
+        first = None
+        count = 0
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                t = q.get(timeout=max(deadline - time.monotonic(), 0.001))
+            except Exception:  # queue.Empty — engine wedged
+                with lock:
+                    failed.append(i)
+                return
+            now = time.monotonic()
+            if first is None:
+                first = now
+            if t is None:
+                break
+            count += 1
+        with lock:
+            lat[i] = now - t_sched
+            ttft[i] = first - t_sched
+            toks[i] = count
+
+    threads = []
+    t0 = time.monotonic()
+    adapter_counts: Dict[str, int] = {}
+    behind_s = 0.0
+    for i in range(n_requests):
+        t_sched = t0 + arrival[i]
+        now = time.monotonic()
+        if now < t_sched:
+            time.sleep(t_sched - now)
+        else:
+            behind_s = max(behind_s, now - t_sched)
+        name = adapters[int(choice[i])]
+        adapter_counts[name or "base"] = \
+            adapter_counts.get(name or "base", 0) + 1
+        q = engine.submit(prompts[i], max_new_tokens=max_new_tokens,
+                          adapter=name) if name is not None else \
+            engine.submit(prompts[i], max_new_tokens=max_new_tokens)
+        queue_depths.append(engine._waiting.qsize())
+        th = threading.Thread(target=collect, args=(i, q, t_sched),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    t_last_submit = time.monotonic()
+    for th in threads:
+        th.join(timeout=timeout_s)
+    t_end = time.monotonic()
+
+    ok = [i for i in range(n_requests) if i not in set(failed)]
+    lat_ok = [lat[i] for i in ok]
+    ttft_ok = [ttft[i] for i in ok]
+    total_toks = sum(toks[i] for i in ok)
+    makespan = max(t_end - t0, 1e-9)
+    return {
+        "target_rps": float(target_rps),
+        "requests": n_requests,
+        "completed": len(ok),
+        "failed": len(failed),
+        "achieved_admission_rps": round(
+            n_requests / max(t_last_submit - t0, 1e-9), 2),
+        "driver_max_lag_s": round(behind_s, 4),
+        "latency_p50_ms": round(_percentile(lat_ok, 50) * 1e3, 2),
+        "latency_p99_ms": round(_percentile(lat_ok, 99) * 1e3, 2),
+        "ttft_p50_ms": round(_percentile(ttft_ok, 50) * 1e3, 2),
+        "ttft_p99_ms": round(_percentile(ttft_ok, 99) * 1e3, 2),
+        "tokens_total": int(total_toks),
+        "tokens_per_s": round(total_toks / makespan, 1),
+        "queue_depth_max": int(max(queue_depths, default=0)),
+        "queue_depth_mean": round(float(np.mean(queue_depths))
+                                  if queue_depths else 0.0, 2),
+        "adapter_request_counts": adapter_counts,
+        "prompt_len_mean_actual": round(float(np.mean(lens)), 1),
+        "prompt_len_max_actual": int(np.max(lens)),
+        "makespan_s": round(makespan, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--rps", type=float, default=20.0)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--adapters", type=int, default=8,
+                    help="registered LoRA adapters (plus base traffic)")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_LOAD.json"))
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import fedml_tpu  # noqa: F401 (backend pin)
+    from fedml_tpu.llm.fedllm import lora_init
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import ContinuousBatchingEngine
+
+    buf_len = 128
+    cfg = LlamaConfig(vocab_size=258, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=4, ffn_dim=128, max_seq_len=buf_len,
+                      dtype=jnp.float32, lora_rank=8)
+    model = LlamaLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    engine = ContinuousBatchingEngine(
+        model, variables["params"], slots=args.slots, buf_len=buf_len,
+        adapter_slots=args.adapters + 2)
+    names = []
+    for i in range(args.adapters):
+        name = f"cohort{i}"
+        engine.registry.register(
+            name, lora_init(jax.random.PRNGKey(100 + i), variables["lora"]))
+        names.append(name)
+    try:
+        # warm both compiled programs (prefill + batched step) off-clock
+        engine.generate([5, 17, 42], max_new_tokens=2, adapter=names[0])
+        report = run_load(
+            engine, target_rps=args.rps, n_requests=args.requests,
+            adapters=[None] + names, max_new_tokens=args.max_new_tokens,
+            vocab=cfg.vocab_size, seed=args.seed)
+    finally:
+        engine.stop()
+    report["engine"] = {"slots": args.slots, "buf_len": buf_len,
+                        "adapters_registered": len(names)}
+    print(json.dumps(report))
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -435,6 +435,46 @@ def bench_comms(rounds: int | None = None,
 
 
 # -- 2-D client × model mesh benchmark (--mesh2d) ----------------------------
+def bench_verify() -> dict:
+    """--verify: the fedverify census as a BENCH row (ISSUE 10,
+    docs/FEDVERIFY.md) — every canonical program AOT-lowers + compiles
+    on the host and the row records, per program, the compiled
+    collective census (count/kind/axis), the payload bytes it moves per
+    round next to the ObsCarry model's prediction, the per-chip
+    argument+temp HBM footprint against the estimator's bound, and the
+    distinct-signature (recompile-surface) count; plus the headline
+    ``violations`` (unsuppressed contract failures — the tier-1 gate
+    pins this at 0).  No step executes: the whole row is static
+    analysis of what XLA compiles.  FEDML_VERIFY_QUICK=1 restricts to
+    the three cheapest programs for smoke tests."""
+    from fedml_tpu.analysis import fedverify as fv
+
+    quick = os.environ.get("FEDML_VERIFY_QUICK") == "1"
+    names = (["sp_round", "mesh1d_scatter", "serving_insert_cache"]
+             if quick else None)
+    findings, reports = fv.verify_programs(names)
+    active = [f for f in findings if not f.suppressed]
+    out = {"quick": quick, "violations": len(active),
+           "suppressed": sum(1 for f in findings if f.suppressed),
+           "programs": {}}
+    for rep in reports:
+        out["programs"][rep.name] = {
+            "collectives": rep.collective_counts(),
+            "census_bytes": {k: round(v) for k, v in
+                             rep.census_bytes().items()},
+            "modeled_bytes": {k: round(v) for k, v in
+                              rep.modeled_bytes.items() if v},
+            "hbm_per_chip": round(rep.per_chip_total()),
+            "hbm_estimate": round(rep.estimate_bytes),
+            "distinct_signatures": len(set(rep.signatures)),
+            "num_partitions": rep.num_partitions,
+        }
+    if active:
+        out["violation_lines"] = [
+            f"{f.path}: {f.rule}: {f.message}" for f in active]
+    return out
+
+
 def bench_mesh2d(rounds: int | None = None,
                  clients_per_round: int | None = None) -> dict:
     """--mesh2d: the 1-D ``(8, 1)`` vs 2-D ``(4, 2)`` layout
@@ -1596,6 +1636,26 @@ def main():
             "value": result["mesh2d_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["mesh2d_vs_1d_round"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--verify" in sys.argv:
+        # lowering the mesh programs needs the 8-virtual-device host
+        # mesh, like --agg/--comms/--mesh2d
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        info = _platform_info(measure_peak=False)
+        result = bench_verify()
+        mesh = result["programs"].get("mesh1d_scatter", {})
+        result.update({
+            "metric": "fedverify_lowering_contract_census",
+            "value": result["violations"],
+            "unit": "unsuppressed_violations",
+            "vs_baseline": mesh.get("census_bytes", {}).get("client"),
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -99,6 +99,13 @@ RULES: Dict[str, Rule] = {
         Rule("pytree-order", WARNING,
              "iterating an unordered dict into tree_map/flatten/stack "
              "makes leaf order process-dependent"),
+        Rule("eval-shape-safety", ERROR,
+             "concrete-array construction on a data-dependent shape "
+             "(jnp.zeros(x.max()), int()/.item() coercions in a shape "
+             "position) or jax.device_put of a traced value inside a "
+             "jit-reachable function — works on concrete test inputs "
+             "but breaks AOT lowering on eval_shape abstractions, the "
+             "contract fedverify relies on (docs/FEDVERIFY.md)"),
     ]
 }
 
@@ -1220,6 +1227,122 @@ def check_pytree_order(mv: ModuleView, out: List[Finding]):
 
 
 # --------------------------------------------------------------------------
+# rule: eval-shape-safety
+# --------------------------------------------------------------------------
+
+#: array constructors whose first/``shape=`` argument is a shape
+_SHAPE_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange",
+                       "linspace", "eye", "tri", "zeros_like_shape"}
+#: data reductions that make a shape expression value-dependent
+_DATA_REDUCERS = {"max", "min", "sum", "item", "argmax", "argmin",
+                  "count_nonzero", "nonzero", "prod"}
+
+
+def _shape_expr_data_dependent(node: ast.AST, tainted: Set[str]) -> bool:
+    """A shape expression whose VALUE depends on traced data: a
+    ``.max()``-style reduction of a parameter-tainted name, an
+    ``int()``/``float()`` coercion of a non-static argument, or an
+    ``np.asarray`` of a tainted name.  Plain ``x.shape[0]`` / ``len(x)``
+    chains are static under tracing and stay exempt."""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = last_attr(n.func)
+        if f in _DATA_REDUCERS:
+            root = (dotted_name(n.func) or "").split(".")[0]
+            if root in ("jnp", "np", "numpy", "jax"):
+                # jnp.max(x) form: a tainted name in the reduced operand
+                for a in n.args:
+                    names = {m.id for m in ast.walk(a)
+                             if isinstance(m, ast.Name)}
+                    if names & tainted and not _is_staticish(a):
+                        return True
+            elif isinstance(n.func, ast.Attribute) and \
+                    not _is_staticish(n.func.value):
+                # x.max() form; x.shape-chains stay static
+                names = {m.id for m in ast.walk(n.func.value)
+                         if isinstance(m, ast.Name)}
+                if names & tainted:
+                    return True
+        elif isinstance(n.func, ast.Name) and n.func.id in ("int", "float"):
+            if n.args and not _is_staticish(n.args[0]):
+                return True
+        elif f in ("asarray", "array") and n.args:
+            names = {m.id for m in ast.walk(n.args[0])
+                     if isinstance(m, ast.Name)}
+            if names & tainted:
+                return True
+    return False
+
+
+def _data_valued_names(fn: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Names assigned from a data-dependent expression (``n_live =
+    jnp.sum(mask)``) — using one in a shape position is the same bug one
+    assignment later."""
+    out: Set[str] = set()
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            val = getattr(stmt, "value", None)
+            if val is not None and _shape_expr_data_dependent(val, tainted):
+                out |= _stmt_assigned_names(stmt)
+    return out
+
+
+def check_eval_shape_safety(mv: ModuleView, out: List[Finding]):
+    sev = RULES["eval-shape-safety"].severity
+    taint_cache: Dict[ast.AST, tuple] = {}
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not mv.reach.in_reachable(node):
+            continue
+        fn = mv.reach.innermost_fn(node)
+        if fn is None:
+            continue
+        if fn not in taint_cache:
+            t = _param_tainted_names(fn)
+            taint_cache[fn] = (t, _data_valued_names(fn, t))
+        tainted, data_valued = taint_cache[fn]
+        d = dotted_name(node.func) or ""
+        f = last_attr(node.func) or ""
+        root = d.split(".")[0]
+        if f in _SHAPE_CONSTRUCTORS and root in ("jnp", "jax", "np",
+                                                 "numpy"):
+            shape_args = list(node.args[:1]) + [
+                kw.value for kw in node.keywords if kw.arg == "shape"]
+
+            def bad(a):
+                if _shape_expr_data_dependent(a, tainted):
+                    return True
+                names = {m.id for m in ast.walk(a)
+                         if isinstance(m, ast.Name)}
+                return bool(names & data_valued)
+
+            if any(bad(a) for a in shape_args):
+                out.append(Finding(
+                    "eval-shape-safety", sev, mv.mod.path, node.lineno,
+                    node.col_offset,
+                    f"{d or f}() builds a concrete array whose shape "
+                    f"depends on traced data inside jit-reachable "
+                    f"'{func_name(fn)}' — the shape must be a "
+                    "trace-time static so fedverify can lower the "
+                    "program on eval_shape abstractions "
+                    "(pad to a static bound instead)"))
+        elif d == "jax.device_put" and node.args:
+            names = {m.id for m in ast.walk(node.args[0])
+                     if isinstance(m, ast.Name)}
+            if names & tainted:
+                out.append(Finding(
+                    "eval-shape-safety", sev, mv.mod.path, node.lineno,
+                    node.col_offset,
+                    "jax.device_put of a (possibly traced) value inside "
+                    f"jit-reachable '{func_name(fn)}' — placement is a "
+                    "host-side effect that cannot lower abstractly; use "
+                    "jax.lax.with_sharding_constraint inside the "
+                    "program"))
+
+
+# --------------------------------------------------------------------------
 # suppression + driver
 # --------------------------------------------------------------------------
 
@@ -1248,6 +1371,7 @@ ALL_CHECKS = [
     check_donation_after_use,
     check_recompile_hazard,
     check_pytree_order,
+    check_eval_shape_safety,
 ]
 
 
@@ -1328,7 +1452,8 @@ def analyze_source(source: str, path: str = "<string>",
 
 
 def render_findings(findings: Sequence[Finding],
-                    show_suppressed: bool = False) -> str:
+                    show_suppressed: bool = False,
+                    tool: str = "fedlint") -> str:
     lines = []
     for f in findings:
         if f.suppressed and not show_suppressed:
@@ -1340,7 +1465,7 @@ def render_findings(findings: Sequence[Finding],
     errs = sum(1 for f in active if f.severity == ERROR)
     warns = sum(1 for f in active if f.severity == WARNING)
     sup = sum(1 for f in findings if f.suppressed)
-    lines.append(f"fedlint: {errs} error(s), {warns} warning(s), "
+    lines.append(f"{tool}: {errs} error(s), {warns} warning(s), "
                  f"{sup} suppressed")
     return "\n".join(lines)
 

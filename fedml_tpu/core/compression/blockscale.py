@@ -153,15 +153,24 @@ def quantize_broadcast(master: jnp.ndarray, ef, precision: str, key=None,
 
 def collective_payload_nbytes(n: int, precision: str,
                               block: int = DEFAULT_BLOCK) -> int:
-    """Wire bytes of one n-element payload at ``precision`` (int8 counts
-    the per-chunk f32 scale arrays — the same fix
-    ``compressors.payload_nbytes`` applies to the host path)."""
+    """Wire bytes of one n-element payload at ``precision``.
+
+    int8 counts the per-chunk f32 scale arrays AND the block padding:
+    :func:`blockscale_quantize` materializes ``q`` padded to a whole
+    number of ``block``-element chunks (``_pad_to_block``), so the wire
+    format really ships ``ceil(n/block) * block`` int8 values — the
+    fedverify census cross-check caught the model under-counting by the
+    padding rows whenever ``n % block != 0`` (ISSUE 10 satellite;
+    ``tests/test_collective_precision.py::test_wire_model_matches_
+    materialized_payload`` pins the parity against the quantizer's
+    actual arrays)."""
     if precision == "fp32":
         return 4 * n
     if precision == "bf16":
         return 2 * n
     if precision == "int8":
-        return n + 4 * math.ceil(n / block)
+        nb = math.ceil(n / block)
+        return nb * block + 4 * nb
     raise ValueError(f"unknown collective precision {precision!r}")
 
 

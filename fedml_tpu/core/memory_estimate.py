@@ -205,6 +205,73 @@ def mesh_state_fits(lo: MeshStateLayout, hbm_bytes: float) -> bool:
     return estimate_mesh_state_memory(lo)["total"] <= hbm_bytes
 
 
+def estimate_round_footprint(lo: MeshStateLayout, *,
+                             data_bytes: float = 0.0,
+                             cohort_bytes: float = 0.0,
+                             members: int = 1,
+                             rounds_fused: int = 1) -> Dict[str, float]:
+    """Per-chip HBM upper bound for ONE lowered federated-round program
+    — the number fedverify's HBM-fit contract reconciles against the
+    compiled module's argument+temp footprint (ISSUE 10,
+    docs/FEDVERIFY.md).
+
+    ``estimate_mesh_state_memory`` prices the persistent state plane;
+    a lowered round additionally holds its *data plane* (device-resident
+    dataset + staged cohort index/mask/weight tensors — ``data_bytes``,
+    exact per-chip bytes from the staged input avals) and the round's
+    working set, modeled as 3x the gathered cohort tensors
+    (``cohort_bytes``: forward batch + label pair per resident client) —
+    forward residuals, gradients, and the gather scratch of the vmapped
+    local step.  ``members`` scales the state/work planes for a
+    population-vmapped program (the data plane is shared).
+
+    ``rounds_fused > 1`` (a ``round_block`` scan) additionally prices
+    one gathered cohort per fused round: XLA hoists the loop-invariant
+    dataset gather out of the scan, materializing every round's cohort
+    tensors at once (fedverify's census of the compiled block pinned
+    this — the block's temp plane is ~K cohorts, not 1).  Errs high by
+    the layout's ``safety`` like every estimate here."""
+    st = estimate_mesh_state_memory(lo)
+    k = max(1, int(rounds_fused))
+    work = (2.0 + float(k)) * float(cohort_bytes) * lo.safety
+    members = max(1, int(members))
+    total = members * (st["total"] + work) + float(data_bytes)
+    return {
+        "state": st["total"],
+        "round_work": work,
+        "data_plane": float(data_bytes),
+        "members": members,
+        "total": total,
+        "total_gib": total / GIB,
+    }
+
+
+def estimate_serving_memory(*, n_params: float, n_slots: int,
+                            cache_bytes: float, vocab_size: int,
+                            horizon: int = 1, param_bytes: int = 4,
+                            bank_bytes: float = 0.0,
+                            safety: float = 1.25) -> Dict[str, float]:
+    """Per-chip HBM upper bound for the continuous-batching engine's
+    batched decode step (fedverify's serving HBM-fit contract): the
+    weights, the stacked KV caches (``cache_bytes`` — exact, from the
+    engine's materialized cache template), the adapter bank, and a
+    working set of one cache copy (the functionalized in-place update)
+    plus per-slot logits across the decode horizon."""
+    params = float(n_params) * param_bytes
+    logits = float(n_slots) * vocab_size * 4.0 * max(1, int(horizon))
+    work = float(cache_bytes) + logits + params * 0.25
+    total = (params + float(cache_bytes) + float(bank_bytes)
+             + work) * safety
+    return {
+        "params": params,
+        "kv_caches": float(cache_bytes),
+        "adapter_bank": float(bank_bytes),
+        "step_work": work,
+        "total": total,
+        "total_gib": total / GIB,
+    }
+
+
 def largest_runnable_params(hbm_bytes: float, mesh_shape: tuple,
                             candidates, **layout_kw) -> float:
     """Largest ``n_params`` among ``candidates`` whose per-chip estimate

@@ -328,6 +328,30 @@ class ContinuousBatchingEngine:
             self._cond.notify()
         self._thread.join(timeout=10)
 
+    def step_programs(self):
+        """fedverify hook (ISSUE 10, docs/FEDVERIFY.md): the engine's
+        compiled programs as ``(name, jitted_fn, args, donate_argnums)``
+        on their resting buffer shapes, so the contract checker can
+        AOT-lower them without serving a request.  ``decode_step`` is the
+        per-tick batched decode ``_dispatch`` launches; ``insert_cache``
+        is admission's donated slot write."""
+        toks = jnp.asarray(self._toks)
+        poss = jnp.asarray(self._poss)
+        keys = jnp.asarray(self._keys)
+        temps = jnp.asarray(self._temps)
+        if self.registry is not None:
+            step_args = (self.raw_params, self.registry.bank, self._caches,
+                         toks, poss, keys, temps, jnp.asarray(self._aids))
+        else:
+            step_args = (self.raw_params, self._caches, toks, poss, keys,
+                         temps)
+        cache0 = jax.tree_util.tree_map(lambda c: c[0], self._caches)
+        return [
+            ("decode_step", self._step, step_args, ()),
+            ("insert_cache", self._insert,
+             (self._caches, cache0, jnp.int32(0)), (0,)),
+        ]
+
     # -- engine loop -------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):

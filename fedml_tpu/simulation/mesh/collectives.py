@@ -94,15 +94,31 @@ def client_axis_bytes(n_flat: int, n_client_shards: int, precision: str,
 
 
 def model_axis_bytes(n_flat: int, n_model_shards: int,
-                     param_bytes: int = 4) -> float:
-    """Payload bytes/round crossing the ``model`` axis on the 2-D layout:
-    the post-update params assembly each model rank is missing
-    ``(m-1)/m`` of (the all-gather GSPMD inserts rebuilding the full
-    broadcast copy from model-sharded chunks).  A modeled lower bound —
-    per-op activation reductions inside the model-parallel train step are
-    workload-dependent and not priced here (docs/MESH_2D.md).  Zero on
-    the 1-D layout."""
-    if n_model_shards <= 1:
+                     param_bytes: int = 4,
+                     mode: str = "scatter") -> float:
+    """Payload bytes/round crossing the ``model`` axis on the 2-D layout.
+
+    ``scatter``: TWO flat-view moves per round — the pre-merge
+    replication of the model-sharded params into the flat ``gflat``
+    vector the ``P(client)`` in-spec slices (fp32 path), and the
+    post-update flat→tree assembly where each model rank is missing
+    ``(m-1)/m`` of the client-gathered chunks its param slices live in.
+    Each moves ``(m-1)/m`` of the flat length along ``model``
+    (fedverify's compiled-module census measures ~1.8x this model on
+    the canonical (4,2) config — auxiliary-state gathers ride on top).
+
+    ``replicated``: ZERO.  The per-leaf psum merge reduces each rank's
+    local ``model`` shard along ``client`` only, and since the PR 6
+    resting-placement contract params *stay* model-sharded on round exit
+    — the full broadcast copy this model historically priced is never
+    rebuilt.  (The census caught the stale pricing: the compiled module
+    moves ~0.08x the old model's bytes, all of it replicated
+    vector-leaf noise.  Drift fixed under ISSUE 10.)
+
+    A modeled lower bound either way — per-op activation reductions
+    inside the model-parallel train step are workload-dependent and not
+    priced here (docs/MESH_2D.md).  Zero on the 1-D layout."""
+    if n_model_shards <= 1 or mode != "scatter":
         return 0.0
-    return float(n_flat) * (n_model_shards - 1) / n_model_shards \
+    return 2.0 * float(n_flat) * (n_model_shards - 1) / n_model_shards \
         * float(param_bytes)

@@ -410,27 +410,38 @@ class FedAvgAPI:
                 # (the padding-waste metric bucketing exists to shrink)
                 "allocated_steps": allocated}
 
+    def _stage_round_arrays(self, round_idx: int):
+        """Gather-mode staged cohort arrays for one round — the index
+        tensor, step mask and client weights with steps padded to the
+        pow2 class (the PR 2 bounded-recompile contract).  Pure function
+        of ``round_idx``; shared by the round loop and the fedverify
+        lowering/signature hooks (docs/FEDVERIFY.md)."""
+        clients = self._client_sampling(round_idx)
+        idx, mask, w = self.dataset.cohort_indices(
+            self._data_ids(clients), self.batch_size, self.seed,
+            round_idx, self.epochs)
+        # pad steps to pow2 buckets → bounded recompile count
+        steps = next_pow2(idx.shape[1])
+        if steps != idx.shape[1]:
+            pad = steps - idx.shape[1]
+            idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
+            mask = np.pad(mask, [(0, 0), (0, pad)])
+        return clients, idx, mask, w, steps
+
     def train_one_round(self, round_idx: int):
         if self._bucketing:
             return self._train_one_round_bucketed(round_idx)
-        clients = self._client_sampling(round_idx)
-        key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
-        cohort = np.asarray(clients, dtype=np.int32)
-        c_stacked = self._gather_c(cohort, round_idx=round_idx)
         if hasattr(self, "_dev_x"):
             with self._tracer.span("staging", cat="staging",
                                    round=round_idx):
-                idx, mask, w = self.dataset.cohort_indices(
-                    self._data_ids(clients), self.batch_size, self.seed,
-                    round_idx, self.epochs)
-                # pad steps to pow2 buckets → bounded recompile count
-                steps = next_pow2(idx.shape[1])
-                if steps != idx.shape[1]:
-                    pad = steps - idx.shape[1]
-                    idx = np.pad(idx, [(0, 0), (0, pad), (0, 0)])
-                    mask = np.pad(mask, [(0, 0), (0, pad)])
+                clients, idx, mask, w, steps = self._stage_round_arrays(
+                    round_idx)
                 idx, mask, w = (jnp.asarray(idx), jnp.asarray(mask),
                                 jnp.asarray(w))
+            key = rng_util.round_key(rng_util.root_key(self.seed),
+                                     round_idx)
+            cohort = np.asarray(clients, dtype=np.int32)
+            c_stacked = self._gather_c(cohort, round_idx=round_idx)
             if self.population:
                 self.state, metrics, new_c = self.round_fn(
                     self.state, idx, mask, w, key, c_stacked,
@@ -439,6 +450,11 @@ class FedAvgAPI:
                 self.state, metrics, new_c = self.round_fn(
                     self.state, idx, mask, w, key, c_stacked)
         else:
+            clients = self._client_sampling(round_idx)
+            key = rng_util.round_key(rng_util.root_key(self.seed),
+                                     round_idx)
+            cohort = np.asarray(clients, dtype=np.int32)
+            c_stacked = self._gather_c(cohort, round_idx=round_idx)
             with self._tracer.span("staging", cat="staging",
                                    round=round_idx):
                 x, y, mask, w = self.dataset.cohort_batches(
@@ -590,6 +606,53 @@ class FedAvgAPI:
         ids[:len(real)] = real
         self._pager.write_back(start_round, ids, table)
         return metrics
+
+    # -- fedverify hooks (ISSUE 10, docs/FEDVERIFY.md) ---------------------
+    def round_program(self, round_idx: int = 0):
+        """Expose the exact jitted round program + one round's staged
+        arguments + the donated argnums, so ``analysis/fedverify.py`` can
+        AOT-lower it on abstract shapes (no step runs).  Gather-mode
+        (device-resident data) only — the same precondition the fused
+        block has."""
+        if self._bucketing or not hasattr(self, "_dev_x"):
+            raise NotImplementedError(
+                "fedverify lowers the device-gather round program "
+                "(device_data=True, cohort_bucketing off)")
+        clients, idx, mask, w, _ = self._stage_round_arrays(round_idx)
+        key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
+        cohort = np.asarray(clients, dtype=np.int32)
+        c_stacked = self._gather_c(cohort, round_idx=round_idx)
+        args = (self.state, jnp.asarray(idx), jnp.asarray(mask),
+                jnp.asarray(w), key, c_stacked)
+        if self.population:
+            args = args + (self.population.hparams,)
+        return self.round_fn, args, (0,) if self.DONATE_STATE else ()
+
+    def round_signature(self, round_idx: int) -> str:
+        """jit-cache signature of one round's staged cohort inputs —
+        the jit keys on (shape, dtype) per leaf, so the distinct set of
+        these strings over a run IS the program's recompile surface
+        (fedverify contract 5; PR 2 pinned it dynamically, this pins it
+        statically)."""
+        _, idx, mask, w, steps = self._stage_round_arrays(round_idx)
+        return repr([(a.shape, str(a.dtype)) for a in (idx, mask, w)])
+
+    def block_program(self, start_round: int = 0):
+        """:meth:`round_program` for the fused ``round_block`` scan."""
+        if self._block_fn is None:
+            self._block_fn = self._build_block_fn()
+        k, steps, idx, mask, w, keys, cohort = self._stage_block(
+            start_round)
+        args = (self.state, idx, mask, w, keys, cohort, self.client_table)
+        if self.population:
+            args = args + (self.population.hparams,)
+        return self._block_fn, args, (0, 6) if self.DONATE_STATE else ()
+
+    def block_signature(self, start_round: int) -> str:
+        k, steps, idx, mask, w, keys, cohort = self._stage_block(
+            start_round)
+        return repr([(a.shape, str(a.dtype))
+                     for a in (idx, mask, w, keys, cohort)])
 
     def evaluate(self):
         with self._tracer.span("eval", cat="eval"):

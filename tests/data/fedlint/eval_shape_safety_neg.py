@@ -1,0 +1,42 @@
+"""Negative fixture: eval-shape-safety — the static-shape idioms the rule
+must NOT flag.  Shapes built from ``.shape`` chains, ``len()``, closure
+constants, or plain parameter names (static config ints like a shard
+count) are trace-time statics; host-side numpy staging outside any
+jit-reachable function is the normal data path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_SHARDS = 8
+
+
+@jax.jit
+def padded_round(x, mask):
+    # .shape / len() chains are static under tracing AND under eval_shape
+    buf = jnp.zeros(x.shape[0])
+    keys = jnp.zeros((len(mask), 2), jnp.uint32)
+    lanes = jnp.arange(mask.shape[1])
+    return buf, keys, lanes
+
+
+def shard_keys(qkey, n_shards):
+    # a plain int parameter (static config) in a shape position is fine —
+    # only data REDUCTIONS make a shape value-dependent
+    return jax.vmap(lambda i: jax.random.fold_in(qkey, i))(
+        jnp.arange(n_shards, dtype=jnp.uint32))
+
+
+def stage_cohort(idx):
+    # host staging (not jit-reachable): concrete numpy is the point
+    n = int(idx.max()) + 1
+    rows = np.zeros((n, 4), np.float32)
+    return jax.device_put(rows)
+
+
+@jax.jit
+def masked_total(x, w):
+    # data reductions are fine as VALUES — only shape positions matter
+    total = jnp.sum(x * w)
+    return total / jnp.maximum(jnp.sum(w), 1.0)

@@ -1,0 +1,44 @@
+"""Positive fixture: eval-shape-safety (ISSUE 10 satellite).
+
+fedverify AOT-lowers every registered program on ``eval_shape``
+abstractions — shapes without values.  Code that derives a *shape* from
+traced *data* passes concrete unit tests (the tracer happens to hold real
+numbers) but breaks the abstract lowering, so the contract checker can
+never cover it.  The fix is always the same: pad to a trace-time static
+bound and mask.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def ragged_gather(table, idx):
+    # shape from a data reduction: idx.max() has no value under
+    # eval_shape (nor under plain jit tracing)
+    out = jnp.zeros(idx.max() + 1)
+    return out.at[idx].add(table[idx])
+
+
+@jax.jit
+def live_rows(mask, rows):
+    n_live = jnp.sum(mask)           # data-valued scalar...
+    buf = jnp.zeros((n_live, 4))     # ...used one assignment later
+    return buf, rows
+
+
+@jax.jit
+def coerced_shape(weights):
+    # int() of a traced reduction in a shape position (the host read the
+    # rule's doc names; jit-host-sync flags the int() itself too)
+    k = jnp.empty(int(jnp.count_nonzero(weights)))
+    return k
+
+
+@jax.jit
+def staged_put(params, x):
+    # placement is a host-side effect — cannot lower abstractly; use
+    # with_sharding_constraint inside the program instead
+    y = jax.device_put(x)
+    return jax.tree_util.tree_map(lambda p: p + jnp.sum(y), params)

@@ -151,6 +151,32 @@ def test_bench_serve_mt_quick(monkeypatch):
     assert load["tokens_per_s"] > 0
 
 
+def test_bench_verify_quick(monkeypatch):
+    """bench.py --verify smoke: the fedverify census row runs green —
+    programs lower+compile, zero unsuppressed contract violations, and
+    the row carries the census fields (collectives, bytes vs the
+    ObsCarry model, per-chip HBM vs the estimator, signature counts)
+    the BENCH json archives (ISSUE 10; docs/FEDVERIFY.md)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_VERIFY_QUICK", "1")
+    out = bench.bench_verify()
+    assert out["quick"] is True
+    assert out["violations"] == 0
+    progs = out["programs"]
+    assert set(progs) == {"sp_round", "mesh1d_scatter",
+                          "serving_insert_cache"}
+    mesh = progs["mesh1d_scatter"]
+    assert mesh["num_partitions"] == 8
+    assert mesh["collectives"]["reduce-scatter.client"] == 1
+    assert mesh["census_bytes"]["client"] > 0
+    assert mesh["modeled_bytes"]["client"] > 0
+    assert 0 < mesh["hbm_per_chip"] <= mesh["hbm_estimate"]
+    assert mesh["distinct_signatures"] == 1
+    # single-partition programs carry no collectives
+    assert progs["sp_round"]["collectives"] == {}
+    assert progs["sp_round"]["num_partitions"] == 1
+
+
 def test_bench_mesh2d_quick(monkeypatch):
     """bench.py --mesh2d smoke: the 1-D (8,1) vs 2-D (4,2) comparison runs
     green at a fixed 8-chip count, the per-axis ObsCarry byte split is

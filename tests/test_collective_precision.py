@@ -132,12 +132,18 @@ def test_collective_quantize_identity_and_residual():
 
 def test_wire_size_model():
     """bench.py --comms acceptance rests on this model: bf16 exactly
-    halves fp32; int8 = 1 byte/elem + one f32 scale per chunk."""
+    halves fp32; int8 = 1 byte per PADDED element (q ships whole
+    block-chunks — the fedverify census caught the pre-fix model
+    dropping the padding rows) + one f32 scale per chunk."""
     n = 10_000
     assert blockscale.collective_payload_nbytes(n, "fp32") == 4 * n
     assert blockscale.collective_payload_nbytes(n, "bf16") == 2 * n
+    # 40 chunks of 256 = 10240 padded int8 elements + 40 f32 scales
     assert blockscale.collective_payload_nbytes(n, "int8", block=256) == \
-        n + 4 * 40
+        40 * 256 + 4 * 40
+    # an exact multiple of the block pads nothing
+    assert blockscale.collective_payload_nbytes(2 * 256, "int8", 256) == \
+        2 * 256 + 4 * 2
     # scatter mode: merge (reduce-scatter) + broadcast (all-gather of
     # n_shards independently-scaled chunks)
     merge = blockscale.collective_payload_nbytes(n, "int8", 256)
@@ -147,6 +153,21 @@ def test_wire_size_model():
     ratio = (blockscale.modeled_collective_bytes(n, 8, "fp32")
              / blockscale.modeled_collective_bytes(n, 8, "int8"))
     assert ratio >= 3.5
+
+
+def test_wire_model_matches_materialized_payload():
+    """Byte-model/quantizer parity (the ISSUE 10 cross-check): the int8
+    wire model must equal the bytes of the arrays
+    ``blockscale_quantize`` actually materializes — q (block-padded
+    int8) plus the f32 scales.  The pre-fix model counted ``n``
+    unpadded q bytes, drifting by the padding rows whenever
+    ``n % block != 0``."""
+    for n, block in ((10_000, 256), (982, 256), (512, 256), (7, 4)):
+        x = jnp.asarray(np.random.default_rng(n).normal(size=n)
+                        .astype(np.float32))
+        q, scales = blockscale.blockscale_quantize(x, bits=8, block=block)
+        assert blockscale.collective_payload_nbytes(n, "int8", block) == \
+            q.nbytes + scales.nbytes, (n, block)
 
 
 def test_quantize_broadcast_ef_algebra():

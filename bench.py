@@ -992,11 +992,34 @@ def bench_trace(rounds: int | None = None,
         api.comm_rounds = 4 if quick else 8
         api.eval_freq = 2
         api.train()
+        # fedscope measured device time: run the out-of-band phase probe
+        # so the BENCH row archives how far the FLOP-proxy attribution
+        # sits from measured reality (FEDML_TRACE_DEVICE=0 opts out)
+        if os.environ.get("FEDML_TRACE_DEVICE") != "0":
+            from fedml_tpu.obs.devicetime import measure_device_phases
+            measure_device_phases(api)
         trace = obs.get_tracer().export_chrome()
-        summary = _import_fedtrace().summarize(trace)
+        fedtrace = _import_fedtrace()
+        summary = fedtrace.summarize(trace)
         out["phases"] = summary["phases"]
         out["trace_rounds"] = summary["rounds"]
         out["trace_events"] = len(trace["traceEvents"])
+        for k in ("device_phase_source", "device_phases_measured_s",
+                  "device_phase_delta"):
+            if k in summary:
+                out[k] = summary[k]
+        # perf-regression gate (tools/fedtrace.py regress): score THIS
+        # row against the committed BENCH trajectory + tolerance bands
+        repo = os.path.dirname(os.path.abspath(__file__))
+        try:
+            r = fedtrace.regress(
+                out, fedtrace.load_bands(
+                    os.path.join(repo, fedtrace.DEFAULT_BANDS_FILE)),
+                fedtrace.load_trajectory(repo))
+            out["regress"] = {"ok": r["ok"], "checked": r["checked"],
+                              "regressions": r["regressions"]}
+        except (OSError, ValueError, KeyError) as e:
+            out["regress"] = {"error": str(e)}
         tp = os.environ.get("FEDML_TRACE_OUT")
         if tp:
             obs.get_tracer().export_chrome(tp)

@@ -202,6 +202,13 @@ _HOST_SYNC_ATTRS = {"item", "tolist", "to_py"}
 # method calls on a *store-named* receiver that read/write host-paged rows
 # — a Python-dict/page lookup inside a traced round body either fails to
 # trace or silently closes over ONE round's rows at trace time
+# value-carrying tracer sinks (fedscope, docs/OBSERVABILITY.md): feeding
+# a traced/device value into one inside a jitted region forces a host
+# sync at that exact line — the sanctioned pattern returns the value
+# through the round's outputs (ObsCarry) and feeds the tracer at the
+# driver's existing sync point
+_TRACER_SINK_ATTRS = {"counter", "add_bytes", "round_obs"}
+
 _HOST_STORE_ATTRS = {"get", "gather", "scatter", "page_in", "write_back",
                      "lookup", "load"}
 
@@ -506,6 +513,18 @@ def _is_store_name(name: Optional[str]) -> bool:
     return name is not None and "store" in name.lower()
 
 
+def _is_tracer_receiver(node: ast.AST) -> bool:
+    """``tracer.counter(...)`` / ``self._tracer.add_bytes(...)`` /
+    ``get_tracer().round_obs(...)`` — receivers that name the fedtrace
+    tracer either lexically or through the accessor call."""
+    name = _receiver_name(node)
+    if name is not None and "tracer" in name.lower():
+        return True
+    if isinstance(node, ast.Call):
+        return last_attr(node.func) == "get_tracer"
+    return False
+
+
 def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
     for node in ast.walk(mv.mod.tree):
         if not isinstance(node, (ast.Call, ast.Subscript)):
@@ -549,6 +568,18 @@ def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
                 msg = (f".{fn.attr}() inside jit-reachable "
                        f"'{func_name(mv.reach.innermost_fn(node))}' blocks "
                        "on device and breaks under tracing")
+            elif fn.attr in _TRACER_SINK_ATTRS and \
+                    _is_tracer_receiver(fn.value) and \
+                    any(not _is_staticish(a) for a in
+                        list(node.args[1:])
+                        + [kw.value for kw in node.keywords]):
+                msg = (f"tracer sink .{fn.attr}() fed a (possibly traced) "
+                       "value inside jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}' — a "
+                       "host sync at this line; return the value through "
+                       "the round's outputs (ObsCarry) and feed the "
+                       "tracer at the driver's sync point "
+                       "(docs/OBSERVABILITY.md)")
             elif fn.attr in _HOST_STORE_ATTRS and \
                     _is_store_name(_receiver_name(fn.value)):
                 msg = (f"host client-state store access "

@@ -155,9 +155,21 @@ _DEFAULTS: Dict[str, Any] = dict(
     collective_precision="fp32",
     quant_block=256,
     # fedtrace round-telemetry plane (docs/OBSERVABILITY.md): trace=True
-    # enables the global tracer; trace_path sets the Chrome-trace output
+    # enables the global tracer; trace_path sets the Chrome-trace output.
+    # trace_device=True additionally runs the out-of-band measured
+    # device-phase probe (obs/devicetime.py) once at train start, whose
+    # device.<phase>_s counters replace the FLOP-proxy attribution in
+    # `fedtrace summarize`; trace_profile_dir wraps the probe in a
+    # jax.profiler capture for an XLA-level timeline on disk.
     trace=False,
     trace_path=None,
+    trace_device=False,
+    trace_profile_dir=None,
+    # fedscope straggler injection for the multi-process two-tier driver
+    # (store/hierarchy.py::run_silo_federation): hold silo
+    # `silo_slow_rank`'s round open by `silo_slow_s` seconds
+    silo_slow_rank=0,
+    silo_slow_s=0.0,
     compute_dtype="float32",
     clients_per_device=1,
 )

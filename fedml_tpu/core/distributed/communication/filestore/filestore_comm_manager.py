@@ -17,6 +17,8 @@ import time
 import threading
 from typing import List
 
+from .....obs import context as obs_context
+from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message, encode_tree, decode_tree
 
@@ -35,13 +37,25 @@ class FileStoreCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message):
         self._seq += 1
+        tracer = get_tracer()
+        tier = obs_context.comm_tier(msg.get_sender_id(),
+                                     msg.get_receiver_id())
         name = f"{time.time_ns()}_{self._seq:06d}_{msg.get_sender_id()}_to_{msg.get_receiver_id()}"
-        blob = encode_tree(msg.get_params())
-        tmp = os.path.join(self.dir, name + ".tmp")
-        final = os.path.join(self.dir, name + ".msg")
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.rename(tmp, final)  # atomic publish (the "MQTT notify" moment)
+        span = tracer.span("comm.send", cat="comm", backend="filestore",
+                           dst=msg.get_receiver_id(), tier=tier,
+                           round=msg.get("round_idx"))
+        with span:
+            obs_context.inject(msg.get_params(), tracer)
+            blob = encode_tree(msg.get_params())
+            tmp = os.path.join(self.dir, name + ".tmp")
+            final = os.path.join(self.dir, name + ".msg")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.rename(tmp, final)  # atomic publish (the "MQTT notify" moment)
+        if tracer.enabled:
+            tracer.add_bytes(f"comm.bytes.{tier}", len(blob))
+            if span.duration_s is not None:
+                tracer.counter(f"comm.rtt.{tier}", span.duration_s)
 
     def add_observer(self, observer: Observer):
         self._observers.append(observer)

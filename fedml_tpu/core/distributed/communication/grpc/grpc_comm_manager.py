@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 import grpc
 
+from .....obs import context as obs_context
 from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message, encode_tree, decode_tree
@@ -95,14 +96,25 @@ class GRPCCommManager(BaseCommunicationManager):
                               response_deserializer=lambda b: b)
 
     def send_message(self, msg: Message):
-        data = _serialize_message(msg)
+        tracer = get_tracer()
+        tier = obs_context.comm_tier(msg.get_sender_id(),
+                                     msg.get_receiver_id())
         # fedtrace RTT span: the unary call blocks until the receiver acks,
-        # so the span duration IS the message round-trip
-        with get_tracer().span("comm.send", cat="comm", backend="grpc",
-                               dst=msg.get_receiver_id(),
-                               nbytes=len(data)):
+        # so the span duration IS the message round-trip.  Serialization
+        # happens INSIDE the span, after context injection, so the wire
+        # blob carries the span's own id as the receiver's parent.
+        span = tracer.span("comm.send", cat="comm", backend="grpc",
+                           dst=msg.get_receiver_id(), tier=tier,
+                           round=msg.get("round_idx"))
+        with span:
+            obs_context.inject(msg.get_params(), tracer)
+            data = _serialize_message(msg)
             self._stub(msg.get_receiver_id())(data, wait_for_ready=True,
                                               timeout=300)
+        if tracer.enabled:
+            tracer.add_bytes(f"comm.bytes.{tier}", len(data))
+            if span.duration_s is not None:
+                tracer.counter(f"comm.rtt.{tier}", span.duration_s)
 
     # -- loop --------------------------------------------------------------
     def add_observer(self, observer: Observer):

@@ -15,6 +15,7 @@ import threading
 from collections import defaultdict
 from typing import Dict, List
 
+from .....obs import context as obs_context
 from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message
@@ -40,12 +41,28 @@ class LocalCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message):
         receiver = msg.get_receiver_id()
-        with get_tracer().span("comm.send", cat="comm", backend="local",
-                               dst=receiver):
+        tracer = get_tracer()
+        tier = obs_context.comm_tier(msg.get_sender_id(), receiver)
+        # in-memory transport never serializes; price the payload from the
+        # array leaves so the per-tier byte counters stay comparable with
+        # the wire backends (only computed when tracing is on)
+        nbytes = None
+        if tracer.enabled:
+            from .....obs.jaxhooks import tree_nbytes
+            nbytes = tree_nbytes(list(msg.get_params().values()))
+        span = tracer.span("comm.send", cat="comm", backend="local",
+                           dst=receiver, tier=tier, nbytes=nbytes,
+                           round=msg.get("round_idx"))
+        with span:
+            obs_context.inject(msg.get_params(), tracer)
             with _REGISTRY_LOCK:
                 q = _REGISTRY[self.run_id].setdefault(receiver,
                                                       queue.Queue())
             q.put(msg)
+        if nbytes:
+            tracer.add_bytes(f"comm.bytes.{tier}", nbytes)
+        if span.duration_s is not None:
+            tracer.counter(f"comm.rtt.{tier}", span.duration_s)
 
     def add_observer(self, observer: Observer):
         self._observers.append(observer)

@@ -22,6 +22,7 @@ import time
 import uuid
 from typing import List
 
+from .....obs import context as obs_context
 from .....obs import get_tracer
 from ..base_com_manager import BaseCommunicationManager, Observer
 from ..message import Message, encode_tree, decode_tree, MSG_ARG_KEY_MODEL_PARAMS
@@ -92,17 +93,39 @@ class MqttS3CommManager(BaseCommunicationManager):
 
     # -- BaseCommunicationManager -----------------------------------------
     def send_message(self, msg: Message):
+        tracer = get_tracer()
+        tier = obs_context.comm_tier(msg.get_sender_id(),
+                                     msg.get_receiver_id())
         # fedtrace span covers the blob store write + broker publish (the
-        # two wire legs of the reference's split transport)
-        with get_tracer().span("comm.send", cat="comm", backend="mqtt",
-                               dst=msg.get_receiver_id()):
+        # two wire legs of the reference's split transport); the injected
+        # context rides the control JSON, so the receiver's handler span
+        # links back here even though the tensor payload detours via blobs
+        span = tracer.span("comm.send", cat="comm", backend="mqtt",
+                           dst=msg.get_receiver_id(), tier=tier,
+                           round=msg.get("round_idx"))
+        nbytes = 0
+        with span:
             params = dict(msg.get_params())
+            obs_context.inject(params, tracer)
             model = params.pop(MSG_ARG_KEY_MODEL_PARAMS, None)
             if model is not None:
-                params["model_params_key"] = self._put_blob(model)
+                key = self._put_blob(model)
+                params["model_params_key"] = key
+                if tracer.enabled:
+                    try:
+                        nbytes += os.path.getsize(
+                            os.path.join(self.store_dir, key))
+                    except OSError:
+                        pass
+            control = json.dumps(params, default=float)
+            nbytes += len(control)
             self._client.publish(
                 self._topic(msg.get_sender_id(), msg.get_receiver_id()),
-                json.dumps(params, default=float), qos=2)
+                control, qos=2)
+        if tracer.enabled:
+            tracer.add_bytes(f"comm.bytes.{tier}", nbytes)
+            if span.duration_s is not None:
+                tracer.counter(f"comm.rtt.{tier}", span.duration_s)
 
     def _on_message(self, client, userdata, mqtt_msg):
         params = json.loads(mqtt_msg.payload)

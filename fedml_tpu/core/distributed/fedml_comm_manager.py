@@ -14,10 +14,18 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict
 
+from ...obs import context as obs_context
+from ...obs import get_tracer
 from .communication.base_com_manager import BaseCommunicationManager, Observer
 from .communication.message import Message
 
 log = logging.getLogger(__name__)
+
+#: message-params key every round-scoped protocol uses for its round index
+#: (cross_silo ``MyMessage.MSG_ARG_KEY_ROUND_IDX`` and the hierarchy
+#: driver agree on it) — the recv span tags rounds with it so merged
+#: timelines group cross-process work per round
+MSG_KEY_ROUND_IDX = "round_idx"
 
 
 def _norm_msg_key(msg_type):
@@ -59,7 +67,33 @@ class FedMLCommManager(Observer):
                 log.warning("rank %d: no handler for msg_type %s",
                             self.rank, msg_type)
             return
-        handler(msg_params)
+        tracer = get_tracer()
+        if not tracer.enabled:
+            handler(msg_params)
+            return
+        # fedscope (docs/OBSERVABILITY.md): the receiver half of the
+        # cross-process span link — the sender's comm.send span id rides
+        # the message (obs.context.inject) and lands here as parent_span,
+        # which `fedtrace critical-path` walks across process boundaries
+        ctx = obs_context.extract(msg_params)
+        try:
+            src = msg_params.get_sender_id()
+            dst = msg_params.get_receiver_id()
+        except (KeyError, TypeError, ValueError):
+            src = dst = None
+        tier = obs_context.comm_tier(src, dst)
+        kw = {"backend": self.backend, "src": src, "tier": tier,
+              "msg_type": str(msg_type),
+              "round": msg_params.get(MSG_KEY_ROUND_IDX)}
+        if ctx is not None:
+            kw.update(parent_span=ctx["span_id"],
+                      remote_trace=ctx["trace_id"],
+                      remote_host=ctx["host"], remote_pid=ctx["pid"])
+        with tracer.span("comm.recv", cat="comm", **kw):
+            handler(msg_params)
+        from ...obs.jaxhooks import tree_nbytes
+        tracer.add_bytes(f"comm.bytes_recv.{tier}",
+                         tree_nbytes(list(msg_params.get_params().values())))
 
     def send_message(self, message: Message):
         self.com_manager.send_message(message)

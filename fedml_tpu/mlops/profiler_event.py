@@ -47,10 +47,12 @@ class MLOpsProfilerEvent:
             return cls._instance
 
     def __init__(self, trace_dir: Optional[str] = None):
-        # name -> LIFO stack of start times (reentrant spans pair
-        # innermost-first; the old single-slot dict silently dropped the
-        # outer start on reentry)
-        self._open: Dict[str, List[float]] = {}
+        # name -> LIFO stack of (start time, fedscope span id) — reentrant
+        # spans pair innermost-first; the old single-slot dict silently
+        # dropped the outer start on reentry.  Span ids ride the emitted
+        # records so cross-process consumers see PARENTAGE, not bare
+        # names (fedscope, docs/OBSERVABILITY.md).
+        self._open: Dict[str, List[tuple]] = {}
         self.trace_dir = trace_dir
         self._tracing = False
 
@@ -60,12 +62,16 @@ class MLOpsProfilerEvent:
     def log_event_started(self, event_name: str,
                           event_value: Optional[str] = None,
                           event_edge_id: Optional[int] = None) -> None:
-        self._open.setdefault(event_name, []).append(time.time())
-        get_tracer().begin(event_name, cat="mlops", value=event_value,
-                           edge_id=event_edge_id)
+        tracer = get_tracer()
+        parent_id = tracer.current_span_id()
+        span_id = tracer.begin(event_name, cat="mlops", value=event_value,
+                               edge_id=event_edge_id)
+        self._open.setdefault(event_name, []).append((time.time(), span_id))
         _emit({"kind": "span", "event_type": EVENT_TYPE_STARTED,
                "name": event_name, "value": event_value,
-               "edge_id": event_edge_id})
+               "edge_id": event_edge_id,
+               "trace_id": tracer.trace_id if tracer.enabled else None,
+               "span_id": span_id, "parent_id": parent_id})
         if self.trace_dir and not self._tracing:
             try:
                 import jax
@@ -77,11 +83,13 @@ class MLOpsProfilerEvent:
     def log_event_ended(self, event_name: str,
                         event_value: Optional[str] = None,
                         event_edge_id: Optional[int] = None) -> float:
+        tracer = get_tracer()
         stack = self._open.get(event_name)
+        span_id = None
         if stack:
-            t0 = stack.pop()
+            t0, span_id = stack.pop()
             dur = time.time() - t0
-            get_tracer().end(event_name)
+            tracer.end(event_name)
         else:
             # unmatched (or over-popped reentrant) end: explicit, once
             if event_name not in _warned_unmatched:
@@ -93,7 +101,9 @@ class MLOpsProfilerEvent:
             dur = 0.0
         _emit({"kind": "span", "event_type": EVENT_TYPE_ENDED,
                "name": event_name, "value": event_value,
-               "edge_id": event_edge_id, "duration_s": dur})
+               "edge_id": event_edge_id, "duration_s": dur,
+               "trace_id": tracer.trace_id if tracer.enabled else None,
+               "span_id": span_id})
         if self.trace_dir and self._tracing and not self._any_open():
             try:
                 import jax

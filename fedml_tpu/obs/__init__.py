@@ -27,6 +27,7 @@ how-to.
 
 from __future__ import annotations
 
+from . import context  # noqa: F401  (fedscope trace-context propagation)
 from .tracer import (  # noqa: F401
     DEVICE_PHASES,
     PHASES,
@@ -42,8 +43,8 @@ from .tracer import (  # noqa: F401
 _CARRY_EXPORTS = ("ObsCarry", "OPT_FLOPS", "obs_host", "obs_host_rows",
                   "param_count", "round_obs")
 
-__all__ = ["DEVICE_PHASES", "PHASES", "Tracer", "configure", "get_tracer",
-           "trace_enabled", *_CARRY_EXPORTS]
+__all__ = ["DEVICE_PHASES", "PHASES", "Tracer", "configure", "context",
+           "get_tracer", "trace_enabled", *_CARRY_EXPORTS]
 
 
 def __getattr__(name):

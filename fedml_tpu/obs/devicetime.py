@@ -1,0 +1,138 @@
+"""Measured per-phase device time — the out-of-band ``trace_device``
+probe (fedscope, docs/OBSERVABILITY.md).
+
+``fedtrace summarize``'s default device-phase breakdown apportions each
+round's wall-clock by the FLOP weights the round carries on device
+(:mod:`.carry`) — a *model*, chosen because the compiled round cannot be
+host-timed per phase without breaking the zero-sync contract.  This
+module adds the measured alternative: split the round into its four
+phase sub-programs (gather / client_steps / merge / server_update) built
+from the ENGINE'S OWN pieces (the same ``run_clients`` /
+``build_aggregates`` / ``update_from_aggregates`` the fused round
+composes), jit each, and time them with ``block_until_ready`` on the
+real staged cohort.  The probe runs ONCE, out of band — behind
+``args.trace_device``, never on the steady-state round path — so the
+PR 4 overhead contract (zero extra syncs/compiles on traced rounds)
+stands untouched; the audit-equality tests run with the probe off and
+the probe's own compiles happen before the audited window.
+
+Results land as ``device.<phase>_s`` counters in the trace;
+``fedtrace summarize`` prefers them over the FLOP proxy when all four
+are present and reports the measured-vs-modeled share deltas
+(``bench.py --trace`` archives those into the BENCH json).
+
+Optionally wraps the timed section in a ``jax.profiler`` capture
+(``args.trace_profile_dir``) so an XLA-level timeline lands on disk next
+to the fedtrace spans for offline inspection.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tracer import DEVICE_PHASES, get_tracer
+
+log = logging.getLogger(__name__)
+
+
+def _timed(fn, *args, repeats: int = 3) -> float:
+    """min-of-N wall-clock of ``fn(*args)`` with a warmup call (the
+    warmup pays the compile; min filters host scheduling noise)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_device_phases(api, round_idx: int = 0, repeats: int = 3,
+                          profile_dir: Optional[str] = None
+                          ) -> Optional[Dict[str, float]]:
+    """Measure real per-phase device durations for one round of an SP
+    engine (``FedAvgAPI`` on the device-gather path).
+
+    Returns ``{phase: seconds}`` (and emits the ``device.<phase>_s``
+    counters) or None when the engine shape isn't measurable this way
+    (mesh backends, populations, quantized collectives — those keep the
+    FLOP proxy)."""
+    from ..core import federated
+    from ..core import rng as rng_util
+    from ..simulation.round_engine import make_run_clients
+
+    if not hasattr(api, "_dev_x"):
+        log.warning("trace_device: needs the device-gather cohort path "
+                    "(device_data=True); keeping the FLOP proxy")
+        return None
+    if getattr(api, "population", None) or \
+            getattr(api, "collective_precision", "fp32") != "fp32":
+        log.warning("trace_device: population/quantized rounds keep the "
+                    "FLOP proxy")
+        return None
+
+    trainer, server_opt = api.trainer, api.server_opt
+    spec = server_opt.spec
+    run_clients = make_run_clients(trainer, server_opt, api._client_mode)
+    red = federated.StackedReducer()
+
+    clients, idx, mask, w, _steps = api._stage_round_arrays(round_idx)
+    cohort = np.asarray(clients, np.int32)
+    c_stacked = api._gather_c(cohort, round_idx=round_idx)
+    key = rng_util.round_key(rng_util.root_key(api.seed), round_idx)
+    rngs = jax.random.split(key, len(clients))
+    idx, mask, w = jnp.asarray(idx), jnp.asarray(mask), jnp.asarray(w)
+    dev_x, dev_y = api._dev_x, api._dev_y
+
+    gather_fn = jax.jit(lambda i: (jnp.take(dev_x, i, axis=0),
+                                   jnp.take(dev_y, i, axis=0)))
+    client_fn = jax.jit(lambda st, x, y, m, r, c:
+                        run_clients(st, x, y, m, r, c))
+    merge_fn = jax.jit(lambda st, outs, ww: federated.build_aggregates(
+        spec, red, server_opt, st, outs, ww))
+    update_fn = jax.jit(
+        lambda st, agg: server_opt.update_from_aggregates(st, agg))
+
+    prof = None
+    if profile_dir:
+        try:
+            jax.profiler.start_trace(profile_dir)
+            prof = profile_dir
+        except Exception:   # profiler availability differs per backend
+            log.warning("trace_device: jax.profiler capture unavailable",
+                        exc_info=True)
+
+    try:
+        seconds: Dict[str, float] = {}
+        seconds["gather"] = _timed(gather_fn, idx, repeats=repeats)
+        x, y = gather_fn(idx)
+        seconds["client_steps"] = _timed(
+            client_fn, api.state, x, y, mask, rngs, c_stacked,
+            repeats=repeats)
+        outs = client_fn(api.state, x, y, mask, rngs, c_stacked)
+        seconds["merge"] = _timed(merge_fn, api.state, outs, w,
+                                  repeats=repeats)
+        agg = merge_fn(api.state, outs, w)
+        seconds["server_update"] = _timed(update_fn, api.state, agg,
+                                          repeats=repeats)
+    finally:
+        if prof is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+    tracer = get_tracer()
+    for phase in DEVICE_PHASES:
+        tracer.counter(f"device.{phase}_s", seconds[phase],
+                       source="measured", round=round_idx)
+    log.info("trace_device: measured phases %s",
+             {p: round(s, 6) for p, s in seconds.items()})
+    return seconds

@@ -22,11 +22,15 @@ Design constraints (the whole point of this module):
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import socket
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from . import context as trace_context
 
 #: device phases attributed from the ObsCarry FLOP weights, in the order
 #: they appear in ``ObsCarry.phase_flops``
@@ -44,6 +48,8 @@ class _NullSpan:
     """Shared no-op context manager returned when tracing is disabled."""
 
     __slots__ = ()
+    span_id = None       # mirror _SpanCtx so call sites read them freely
+    duration_s = None
 
     def __enter__(self):
         return self
@@ -56,20 +62,24 @@ _NULL_SPAN = _NullSpan()
 
 
 class _SpanCtx:
-    __slots__ = ("_tracer", "_name", "_cat", "_args")
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "span_id",
+                 "duration_s")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
         self._tracer = tracer
         self._name = name
         self._cat = cat
         self._args = args
+        self.span_id: Optional[str] = None
+        self.duration_s: Optional[float] = None
 
     def __enter__(self):
-        self._tracer.begin(self._name, cat=self._cat, **self._args)
+        self.span_id = self._tracer.begin(self._name, cat=self._cat,
+                                          **self._args)
         return self
 
     def __exit__(self, *exc):
-        self._tracer.end(self._name)
+        self.duration_s = self._tracer.end(self._name)
         return False
 
 
@@ -79,7 +89,8 @@ class Tracer:
     def __init__(self):
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
-        # tid -> stack of (name, ts_us) for B/E pairing
+        # tid -> stack of (name, ts_us, span_id) for B/E pairing and the
+        # thread's current-span parentage (fedscope ids)
         self._open: Dict[int, List[tuple]] = {}
         # name -> [count, total_seconds] for the prometheus aggregate
         self._span_agg: Dict[str, List[float]] = {}
@@ -88,7 +99,34 @@ class Tracer:
         self.path: Optional[str] = None
         self.dropped_ends = 0
         self._origin = time.perf_counter()
+        # wall-clock anchor captured at the SAME instant as the perf
+        # origin: ``fedtrace merge`` maps every process's relative ts onto
+        # unix time through it before the handshake refinement
+        self._origin_unix_us = time.time() * 1e6
         self._pid = os.getpid()
+        self.host = socket.gethostname()
+        #: human label for the merged timeline ("server" / "silo2" ...)
+        self.label: Optional[str] = None
+        #: W3C 128-bit trace id — one per process session; adopted ids
+        #: would arrive through configure(trace_id=...)
+        self.trace_id = trace_context.new_trace_id()
+        self._dirty = False
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def pid(self) -> int:
+        return self._pid
+
+    def current_span_id(self) -> Optional[str]:
+        """Span id of the innermost open span on the calling thread (the
+        parent every injected outbound context names)."""
+        with self._lock:
+            stack = self._open.get(threading.get_ident())
+            return stack[-1][2] if stack else None
+
+    def current_traceparent(self) -> str:
+        return trace_context.format_traceparent(
+            self.trace_id, self.current_span_id() or "0" * 16)
 
     # -- clock -------------------------------------------------------------
     def _ts(self) -> float:
@@ -103,21 +141,34 @@ class Tracer:
             self._counters.clear()
             self.dropped_ends = 0
             self._origin = time.perf_counter()
+            self._origin_unix_us = time.time() * 1e6
+            self.trace_id = trace_context.new_trace_id()
+            self._dirty = False
 
     # -- spans -------------------------------------------------------------
-    def begin(self, name: str, cat: str = "host", **args):
+    def begin(self, name: str, cat: str = "host", **args) -> Optional[str]:
+        """Open a span; returns its fedscope span id.  The B event is
+        tagged with pid/host plus ``span_id`` / ``parent`` args so a
+        merged multi-process timeline keeps full parentage."""
         if not self.enabled:
-            return
+            return None
         ts = self._ts()
         tid = threading.get_ident()
+        span_id = trace_context.new_span_id()
         ev: Dict[str, Any] = {"name": name, "ph": "B", "ts": ts,
-                              "pid": self._pid, "tid": tid, "cat": cat}
+                              "pid": self._pid, "tid": tid, "cat": cat,
+                              "host": self.host}
         clean = {k: v for k, v in args.items() if v is not None}
-        if clean:
-            ev["args"] = clean
+        clean["span_id"] = span_id
         with self._lock:
+            stack = self._open.setdefault(tid, [])
+            if stack:
+                clean.setdefault("parent", stack[-1][2])
+            ev["args"] = clean
             self._events.append(ev)
-            self._open.setdefault(tid, []).append((name, ts))
+            self._dirty = True
+            stack.append((name, ts, span_id))
+        return span_id
 
     def end(self, name: str, **args) -> Optional[float]:
         """Close the most recent open span named ``name`` on this thread;
@@ -131,16 +182,18 @@ class Tracer:
             stack = self._open.get(tid, [])
             for i in range(len(stack) - 1, -1, -1):
                 if stack[i][0] == name:
-                    _, t0 = stack.pop(i)
+                    _, t0, _sid = stack.pop(i)
                     break
             else:
                 self.dropped_ends += 1
                 return None
             ev: Dict[str, Any] = {"name": name, "ph": "E", "ts": ts,
-                                  "pid": self._pid, "tid": tid}
+                                  "pid": self._pid, "tid": tid,
+                                  "host": self.host}
             if args:
                 ev["args"] = dict(args)
             self._events.append(ev)
+            self._dirty = True
             dur = (ts - t0) / 1e6
             agg = self._span_agg.setdefault(name, [0, 0.0])
             agg[0] += 1
@@ -161,14 +214,16 @@ class Tracer:
             return
         ts1 = self._ts()
         ts0 = max(ts1 - float(duration_s) * 1e6, 0.0)
-        base = {"name": name, "pid": self._pid, "tid": tid, "cat": cat}
+        base = {"name": name, "pid": self._pid, "tid": tid, "cat": cat,
+                "host": self.host}
         b: Dict[str, Any] = {**base, "ph": "B", "ts": ts0}
-        if args:
-            b["args"] = dict(args)
+        b["args"] = dict(args, span_id=trace_context.new_span_id())
         e: Dict[str, Any] = {"name": name, "ph": "E", "ts": ts1,
-                             "pid": self._pid, "tid": tid}
+                             "pid": self._pid, "tid": tid,
+                             "host": self.host}
         with self._lock:
             self._events.extend((b, e))
+            self._dirty = True
             agg = self._span_agg.setdefault(name, [0, 0.0])
             agg[0] += 1
             agg[1] += float(duration_s)
@@ -181,9 +236,10 @@ class Tracer:
         a: Dict[str, Any] = {"value": value}
         a.update(args)
         ev = {"name": name, "ph": "C", "ts": self._ts(), "pid": self._pid,
-              "tid": threading.get_ident(), "args": a}
+              "tid": threading.get_ident(), "host": self.host, "args": a}
         with self._lock:
             self._events.append(ev)
+            self._dirty = True
             try:
                 self._counters[name] = float(value)
             except (TypeError, ValueError):
@@ -194,12 +250,13 @@ class Tracer:
         if not self.enabled:
             return
         ev = {"name": name, "ph": "C", "ts": self._ts(), "pid": self._pid,
-              "tid": threading.get_ident()}
+              "tid": threading.get_ident(), "host": self.host}
         with self._lock:
             total = self._counters.get(name, 0.0) + float(n)
             self._counters[name] = total
             ev["args"] = {"value": total}
             self._events.append(ev)
+            self._dirty = True
 
     def round_obs(self, round_idx: int, round_time_s: float,
                   obs: Dict[str, float]):
@@ -213,9 +270,11 @@ class Tracer:
         for k, v in obs.items():
             args[k] = float(v)
         ev = {"name": "obs.round", "ph": "C", "ts": self._ts(),
-              "pid": self._pid, "tid": threading.get_ident(), "args": args}
+              "pid": self._pid, "tid": threading.get_ident(),
+              "host": self.host, "args": args}
         with self._lock:
             self._events.append(ev)
+            self._dirty = True
 
     # -- export ------------------------------------------------------------
     def events(self) -> List[Dict[str, Any]]:
@@ -227,31 +286,62 @@ class Tracer:
                          if st}
         ts = self._ts()
         for tid, stack in open_copy.items():
-            for name, _t0 in reversed(stack):
+            for name, _t0, _sid in reversed(stack):
                 evs.append({"name": name, "ph": "E", "ts": ts,
                             "pid": self._pid, "tid": tid,
+                            "host": self.host,
                             "args": {"synthesized_end": True}})
         evs.sort(key=lambda e: e.get("ts", 0.0))
         return evs
 
+    def process_label(self) -> str:
+        return self.label or f"{self.host}:{self._pid}"
+
     def export_chrome(self, path: Optional[str] = None) -> Dict[str, Any]:
         """Chrome trace-event JSON object; written to ``path`` (or the
-        configured default path) when one is given."""
+        configured default path) when one is given.  ``otherData``
+        carries the process identity + the unix clock anchor ``fedtrace
+        merge`` aligns multi-process captures on."""
         trace = {
             "traceEvents": [
+                {"name": "process_name", "ph": "M", "ts": 0.0,
+                 "pid": self._pid, "tid": 0,
+                 "args": {"name": self.process_label()}},
                 {"name": "thread_name", "ph": "M", "ts": 0.0,
                  "pid": self._pid, "tid": COMPILE_TID,
                  "args": {"name": "xla-compile"}},
             ] + self.events(),
             "displayTimeUnit": "ms",
             "otherData": {"exporter": "fedml_tpu.obs",
-                          "dropped_ends": self.dropped_ends},
+                          "dropped_ends": self.dropped_ends,
+                          "host": self.host, "pid": self._pid,
+                          "label": self.process_label(),
+                          "trace_id": self.trace_id,
+                          "origin_unix_us": self._origin_unix_us},
         }
         path = path or self.path
         if path:
             with open(path, "w") as fh:
                 json.dump(trace, fh)
+            with self._lock:
+                self._dirty = False
         return trace
+
+    def close(self):
+        """Flush the trace to ``path`` if anything new was recorded.
+        Idempotent — safe from ``atexit``, a crash handler, AND a normal
+        driver exit in any order; a silo process that dies mid-round
+        still leaves a mergeable partial trace (open spans get
+        synthesized ends)."""
+        if not self.path:
+            return
+        with self._lock:
+            if not self._dirty:
+                return
+        try:
+            self.export_chrome(self.path)
+        except OSError:  # interpreter teardown may have lost the dir
+            pass
 
     def summary(self) -> Dict[str, Any]:
         with self._lock:
@@ -285,6 +375,7 @@ class Tracer:
 # -- global tracer ---------------------------------------------------------
 _TRACER = Tracer()
 _jax_uninstall = None
+_atexit_registered = False
 
 
 def get_tracer() -> Tracer:
@@ -296,7 +387,8 @@ def trace_enabled() -> bool:
 
 
 def configure(enabled: Optional[bool] = None, path: Optional[str] = None,
-              reset: bool = False, jax_hooks: bool = True) -> Tracer:
+              reset: bool = False, jax_hooks: bool = True,
+              label: Optional[str] = None) -> Tracer:
     """Configure the global tracer.
 
     Enabling subscribes the tracer to the shared jax monitoring hub
@@ -304,17 +396,28 @@ def configure(enabled: Optional[bool] = None, path: Optional[str] = None,
     byte counters (:mod:`.jaxhooks`); disabling restores both.  The hooks
     never add a transfer, a sync, or a compile — the CI smoke pins
     ``JaxRuntimeAudit`` counter equality between traced and untraced runs.
+
+    ``label`` names this process's lane on a merged multi-process
+    timeline ("server", "silo2", ...).  Enabling with a ``path`` also
+    registers an (idempotent) atexit flush, so a process that exits —
+    cleanly or via an uncaught exception — still leaves a mergeable
+    trace file behind.
     """
-    global _jax_uninstall
+    global _jax_uninstall, _atexit_registered
     tr = _TRACER
     if path is not None:
         tr.path = path
+    if label is not None:
+        tr.label = label
     if reset:
         tr.reset()
     if enabled is None:
         return tr
     if enabled and not tr.enabled:
         tr.enabled = True
+        if not _atexit_registered:
+            atexit.register(tr.close)
+            _atexit_registered = True
         if jax_hooks and _jax_uninstall is None:
             from . import jaxhooks
             _jax_uninstall = jaxhooks.install_tracer_hooks(tr)

@@ -870,6 +870,21 @@ class FedAvgAPI:
     def train(self):
         t_start = time.time()
         start_round = self.maybe_resume()
+        if self._tracer.enabled and \
+                bool(getattr(self.args, "trace_device", False)):
+            # fedscope measured device time (docs/OBSERVABILITY.md): one
+            # out-of-band per-phase probe BEFORE the round loop — its own
+            # compiles/syncs never touch the steady-state path, and its
+            # device.<phase>_s counters replace the FLOP proxy downstream
+            from ...obs.devicetime import measure_device_phases
+            try:
+                measure_device_phases(
+                    self, round_idx=start_round,
+                    profile_dir=getattr(self.args, "trace_profile_dir",
+                                        None))
+            except Exception:
+                log.warning("trace_device probe failed; keeping the "
+                            "FLOP-proxy attribution", exc_info=True)
         if self._round_block > 1:
             self._train_fused(start_round)
         else:

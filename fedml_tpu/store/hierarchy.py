@@ -25,6 +25,9 @@ compiled silo dispatches + 1 combine dispatch per round.
 from __future__ import annotations
 
 import logging
+import queue
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +35,7 @@ import numpy as np
 
 from ..core import federated
 from ..core import rng as rng_util
+from ..obs import get_tracer
 from ..simulation.round_engine import make_run_clients, next_pow2
 from ..simulation.sp.fedavg_api import FedAvgAPI
 
@@ -68,6 +72,11 @@ class HierarchicalSiloAPI(FedAvgAPI):
                 "aggregates; collective_precision must stay 'fp32'")
         self._silo_fn = None
         self._combine_fn = None
+        # one-round staging cache: the distributed driver calls
+        # silo_partial() for a single slice, but staging is a pure
+        # function of round_idx — stage the full cohort once per round
+        self._staged_round = None
+        self._staged = None
 
     def _build_silo_fns(self):
         server_opt = self.server_opt
@@ -95,7 +104,14 @@ class HierarchicalSiloAPI(FedAvgAPI):
         self._silo_fn = jax.jit(silo_fn)
         self._combine_fn = jax.jit(combine_fn)
 
-    def train_one_round(self, round_idx: int):
+    def _stage_round(self, round_idx: int):
+        """Stage the FULL cohort for one round (host arrays) — pure
+        function of ``round_idx``, cached so the distributed driver's
+        per-silo :meth:`silo_partial` calls pay one staging per round.
+        Returns ``(clients, cohort, idx, x, y, mask, w, rngs, steps,
+        c_stacked)``."""
+        if self._staged_round == round_idx:
+            return self._staged
         clients = self._client_sampling(round_idx)
         cohort = np.asarray(clients, np.int32)
         key = rng_util.round_key(rng_util.root_key(self.seed), round_idx)
@@ -123,31 +139,58 @@ class HierarchicalSiloAPI(FedAvgAPI):
                                + [(0, 0)] * (y.ndim - 2))
                     mask = np.pad(mask, [(0, 0), (0, pad)])
                 idx = None
-        if self._silo_fn is None:
-            self._build_silo_fns()
         # identical per-client streams to the flat round: ONE split of the
         # round key over the whole cohort, then sliced per silo
         rngs = np.asarray(jax.random.split(key, len(clients)))
         c_stacked = self._gather_c(cohort, round_idx=round_idx)
+        self._staged = (clients, cohort, idx, x, y, mask, w, rngs, steps,
+                        c_stacked)
+        self._staged_round = round_idx
+        return self._staged
 
+    def silo_partial(self, round_idx: int, silo_idx: int):
+        """Run ONE silo's slice of the round: reduce its cohort slice to
+        an unfinished partial aggregate.  Returns ``(partial, silo_w,
+        loss_w, steps, new_c)`` — everything a silo process ships (or the
+        in-process loop consumes directly).  Math is identical to the
+        flat engine's slice, so S of these combine exactly."""
+        (clients, _cohort, idx, x, y, mask, w, rngs, _steps,
+         c_stacked) = self._stage_round(round_idx)
+        if self._silo_fn is None:
+            self._build_silo_fns()
+        per = len(clients) // self.num_silos
+        sl = slice(silo_idx * per, (silo_idx + 1) * per)
+        xs = jnp.asarray(idx[sl] if idx is not None else x[sl])
+        ys = None if y is None else jnp.asarray(y[sl])
+        c_s = (None if c_stacked is None else
+               jax.tree_util.tree_map(lambda t: t[sl], c_stacked))
+        partial, lw, ts, new_c = self._silo_fn(
+            self.state, xs, ys, jnp.asarray(mask[sl]),
+            jnp.asarray(w[sl]), jnp.asarray(rngs[sl]), c_s)
+        return partial, float(np.sum(w[sl])), lw, ts, new_c
+
+    def apply_partials(self, partials):
+        """Server tier: combine S partial aggregates (device trees OR
+        decoded wire dicts — ``combine_partial_aggregates`` is pure jnp
+        math over either) and run the unchanged server transition."""
+        if self._combine_fn is None:
+            self._build_silo_fns()
+        self.state = self._combine_fn(self.state, tuple(partials))
+        return self.state
+
+    def train_one_round(self, round_idx: int):
         s = self.num_silos
-        per = len(clients) // s
         partials, new_cs = [], []
         loss_w = steps_total = 0.0
         for i in range(s):
-            sl = slice(i * per, (i + 1) * per)
-            xs = jnp.asarray(idx[sl] if idx is not None else x[sl])
-            ys = None if y is None else jnp.asarray(y[sl])
-            c_s = (None if c_stacked is None else
-                   jax.tree_util.tree_map(lambda t: t[sl], c_stacked))
-            partial, lw, ts, new_c = self._silo_fn(
-                self.state, xs, ys, jnp.asarray(mask[sl]),
-                jnp.asarray(w[sl]), jnp.asarray(rngs[sl]), c_s)
+            partial, _sw, lw, ts, new_c = self.silo_partial(round_idx, i)
             partials.append(partial)
             new_cs.append(new_c)
             loss_w = loss_w + lw
             steps_total = steps_total + ts
-        self.state = self._combine_fn(self.state, tuple(partials))
+        (clients, cohort, _idx, _x, _y, _mask, w, _rngs, steps,
+         _c) = self._stage_round(round_idx)
+        self.apply_partials(partials)
         if new_cs and new_cs[0] is not None:
             stacked = jax.tree_util.tree_map(
                 lambda *xs: jnp.concatenate(xs), *new_cs)
@@ -159,3 +202,191 @@ class HierarchicalSiloAPI(FedAvgAPI):
             "allocated_steps": len(clients) * steps,
         }
         return metrics
+
+
+# ---------------------------------------------------------------------------
+# multi-process two-tier federation (fedscope, docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+#
+# The in-process HierarchicalSiloAPI above proves the MATH of two-tier
+# aggregation; this driver proves the TOPOLOGY: rank 0 (combine tier) and
+# ranks 1..S (one process per silo) exchange partial aggregates and state
+# syncs over any real comm backend (filestore / GRPC / MQTT_S3).  Every
+# message rides the FedMLCommManager path, so fedscope's comm.send /
+# comm.recv spans + injected trace context land on the measured path and
+# ``tools/fedtrace.py merge`` can stitch the per-process captures into one
+# timeline whose ``critical-path`` names the gating silo.
+
+#: protocol message types (disjoint from cross_silo MyMessage's range)
+MSG_TYPE_SILO_PARTIAL = 601
+MSG_TYPE_STATE_SYNC = 602
+MSG_TYPE_FINISH = 603
+
+
+class _SiloEndpoint:
+    """Queue-backed endpoint over the real FedMLCommManager receive path
+    (handlers run on the comm loop thread and enqueue; the driver's round
+    loop consumes from the queue)."""
+
+    def __init__(self, args, rank: int, size: int, backend: str):
+        from ..core.distributed.fedml_comm_manager import FedMLCommManager
+
+        self.inbox: "queue.Queue" = queue.Queue()
+        inbox = self.inbox
+
+        class _Mgr(FedMLCommManager):
+            def register_message_receive_handlers(self):
+                for t in (MSG_TYPE_SILO_PARTIAL, MSG_TYPE_STATE_SYNC,
+                          MSG_TYPE_FINISH):
+                    self.register_message_receive_handler(
+                        t, lambda m: inbox.put(m))
+
+        self._mgr = _Mgr(args, rank=rank, size=size, backend=backend)
+        self._thread = threading.Thread(target=self._mgr.run, daemon=True)
+        self._thread.start()
+
+    def send(self, msg):
+        self._mgr.send_message(msg)
+
+    def recv(self, timeout_s: float = 120.0):
+        return self.inbox.get(timeout=timeout_s)
+
+    def close(self):
+        self._mgr.finish()
+        self._thread.join(timeout=5.0)
+
+
+def run_silo_federation(args, device, dataset, model):
+    """Drive ONE process of the multi-process two-tier topology.
+
+    ``args.rank`` 0 is the combine tier (server); ranks ``1..num_silos``
+    each own one silo slice of every round's cohort.  All processes share
+    ``random_seed``, so cohort sampling / rng streams / batch schedules
+    are bitwise the in-process :class:`HierarchicalSiloAPI`'s; the only
+    divergence from the flat round is float reassociation in the combined
+    numerators (same contract as the in-process driver).
+
+    Straggler injection for the fedscope acceptance run:
+    ``args.silo_slow_rank`` / ``args.silo_slow_s`` hold one silo's round
+    open by a fixed sleep INSIDE its ``silo.round`` span, so ``fedtrace
+    critical-path`` on the merged timeline must name that silo as the
+    round-gating chain.
+
+    Returns the server's per-round metrics list on rank 0, None on silos.
+    """
+    import flax.serialization as fser
+
+    from ..core.distributed.communication.message import Message
+
+    rank = int(getattr(args, "rank", 0))
+    num_silos = int(getattr(args, "num_silos", 0) or 2)
+    rounds = int(getattr(args, "comm_round", 1))
+    backend = str(getattr(args, "backend", "filestore"))
+    tracer = get_tracer()
+    if bool(getattr(args, "trace", False)) or tracer.enabled:
+        from ..obs import configure
+        configure(label="server" if rank == 0 else f"silo{rank}")
+        tracer = get_tracer()
+
+    api = HierarchicalSiloAPI(args, device, dataset, model)
+    if api.client_table is not None or getattr(api, "_store", None) \
+            is not None:
+        raise ValueError(
+            "distributed silo federation supports stateless-client "
+            "algorithms for now (SCAFFOLD/FedDyn rows would go stale "
+            "across silo processes; run those in-process)")
+
+    ep = _SiloEndpoint(args, rank, num_silos + 1, backend)
+    try:
+        if rank == 0:
+            return _run_combine_tier(api, ep, num_silos, rounds, tracer)
+        _run_silo_tier(api, ep, rank, rounds, args, tracer)
+        return None
+    finally:
+        ep.close()
+        tracer.close()   # flush this process's mergeable trace
+
+
+def _run_combine_tier(api, ep, num_silos, rounds, tracer):
+    import flax.serialization as fser
+
+    from ..core.distributed.communication.message import Message
+
+    history = []
+    for r in range(rounds):
+        t0 = time.time()
+        with tracer.span("round", cat="round", round=r):
+            got = {}
+            while len(got) < num_silos:
+                msg = ep.recv()
+                if msg.get_type() != MSG_TYPE_SILO_PARTIAL:
+                    continue
+                if int(msg.get("round_idx")) != r:
+                    log.warning("server: dropping stale round-%s partial",
+                                msg.get("round_idx"))
+                    continue
+                got[int(msg.get("silo"))] = msg
+            with tracer.span("combine", cat="round", round=r):
+                partials = [got[s + 1].get("partial")
+                            for s in range(num_silos)]
+                api.apply_partials(partials)
+                jax.block_until_ready(api.state.global_params)
+            state_dict = fser.to_state_dict(api.state)
+            for s in range(num_silos):
+                sync = Message(MSG_TYPE_STATE_SYNC, 0, s + 1)
+                sync.add_params("round_idx", r)
+                sync.add_params("state", state_dict)
+                ep.send(sync)
+        loss_w = sum(float(np.asarray(got[s + 1].get("loss_w")))
+                     for s in range(num_silos))
+        w_total = sum(float(got[s + 1].get("silo_w"))
+                      for s in range(num_silos))
+        history.append({"round": r, "train_loss": loss_w / max(w_total, 1e-9),
+                        "round_time": time.time() - t0,
+                        "silos": num_silos})
+        log.info("server round %d: train_loss=%.4f (%.2fs)", r,
+                 history[-1]["train_loss"], history[-1]["round_time"])
+    for s in range(num_silos):
+        ep.send(Message(MSG_TYPE_FINISH, 0, s + 1))
+    return history
+
+
+def _run_silo_tier(api, ep, rank, rounds, args, tracer):
+    import flax.serialization as fser
+
+    from ..core.distributed.communication.message import Message
+
+    slow_rank = int(getattr(args, "silo_slow_rank", 0) or 0)
+    slow_s = float(getattr(args, "silo_slow_s", 0.0) or 0.0)
+    for r in range(rounds):
+        with tracer.span("silo.round", cat="round", round=r, silo=rank):
+            partial, silo_w, loss_w, _steps, _new_c = api.silo_partial(
+                r, rank - 1)
+            # materialize before the span closes so the span covers the
+            # silo's real device compute, not just the dispatch
+            jax.block_until_ready(partial)
+            if slow_rank == rank and slow_s > 0:
+                time.sleep(slow_s)   # injected straggler
+        up = Message(MSG_TYPE_SILO_PARTIAL, rank, 0)
+        up.add_params("round_idx", r)
+        up.add_params("silo", rank)
+        up.add_params("partial", fser.to_state_dict(partial))
+        up.add_params("silo_w", silo_w)
+        up.add_params("loss_w", np.asarray(loss_w))
+        ep.send(up)
+        while True:
+            msg = ep.recv()
+            if msg.get_type() == MSG_TYPE_FINISH:
+                return
+            if msg.get_type() == MSG_TYPE_STATE_SYNC \
+                    and int(msg.get("round_idx")) == r:
+                api.state = fser.from_state_dict(api.state,
+                                                 msg.get("state"))
+                break
+    # drain the finish marker so the server's send never blocks
+    try:
+        while True:
+            if ep.recv(timeout_s=10.0).get_type() == MSG_TYPE_FINISH:
+                break
+    except queue.Empty:
+        pass

@@ -1,0 +1,42 @@
+"""Fixture: a fedscope tracer SINK fed a traced/device value inside the
+compiled round (the new anti-pattern of the span-id plane).
+
+``tracer.counter(...)`` / ``get_tracer().add_bytes(...)`` are host-side
+recorders — handing them a traced array inside a jitted region forces a
+blocking device→host sync at that exact line (or a trace error), exactly
+the failure mode the ObsCarry device-carry design exists to avoid.  The
+clean form returns the scalar through the round's outputs and feeds the
+tracer at the HOST driver's existing sync point; static values (a
+literal queue depth) are fine anywhere.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def get_tracer():
+    """Stand-in for fedml_tpu.obs.get_tracer (host-side recorder)."""
+
+
+tracer = get_tracer()
+
+
+@jax.jit
+def round_leaky(state, grads):
+    update_norm = jnp.sqrt(jnp.sum(grads * grads))
+    tracer.counter("update_norm", update_norm)       # traced value -> sync
+    get_tracer().add_bytes("grad_bytes", grads * 4)  # same, via accessor
+    return state - grads
+
+
+@jax.jit
+def round_clean(state, grads):
+    update_norm = jnp.sqrt(jnp.sum(grads * grads))
+    tracer.counter("block_depth", 2)        # static literal: no sync
+    return state - grads, {"update_norm": update_norm}
+
+
+def driver(state, grads):
+    state, obs = round_clean(state, grads)
+    # host boundary AFTER the dispatch — the sanctioned sink point
+    tracer.counter("update_norm", float(obs["update_norm"]))
+    return state

@@ -207,6 +207,63 @@ def test_bench_mesh2d_quick(monkeypatch):
     assert ls["mesh2d_per_chip_gib"] <= ls["hbm_per_chip_gib"]
 
 
+def test_fedtrace_regress_smoke(tmp_path, monkeypatch):
+    """FEDML_TRACE_REGRESS smoke (ISSUE 11): the perf-regression gate
+    runs green over the committed BENCH trajectory + tolerance bands,
+    and a mutated (slowed) row makes it exit nonzero — the tier-1 wire
+    that stops a PR from silently regressing a pinned headline."""
+    import subprocess
+
+    monkeypatch.setenv("FEDML_TRACE_REGRESS", "1")
+    cli = os.path.join(REPO, "tools", "fedtrace.py")
+
+    def run(*args):
+        return subprocess.run([sys.executable, cli, "regress", *args],
+                              cwd=REPO, capture_output=True, text=True)
+
+    # every committed row passes its own bands (rows of other archetypes
+    # skip bands whose metric they don't carry)
+    import glob
+
+    for row_path in sorted(glob.glob(os.path.join(REPO,
+                                                  "BENCH_r*.json"))):
+        r = run(row_path, "--json")
+        assert r.returncode == 0, (row_path, r.stdout, r.stderr)
+        out = json.loads(r.stdout)
+        assert out["ok"], row_path
+    # at least one band actually fired somewhere in the trajectory
+    checked_total = sum(
+        json.loads(run(p, "--json").stdout)["checked"]
+        for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert checked_total >= 4
+
+    # a slowed headline must FAIL the gate with the distinct exit code
+    with open(os.path.join(REPO, "BENCH_r02.json")) as fh:
+        row = json.load(fh)
+    row["parsed"]["value"] *= 3.0            # 3x slower s/round
+    bad = tmp_path / "slowed.json"
+    bad.write_text(json.dumps(row))
+    r = run(str(bad), "--baseline-dir", REPO, "--json")
+    assert r.returncode == 3, r.stdout
+    out = json.loads(r.stdout)
+    assert [x["metric"] for x in out["regressions"]] == ["parsed.value"]
+
+
+def test_bench_trace_records_device_phase_deltas(monkeypatch):
+    """bench.py --trace (quick) archives the fedscope measured-vs-modeled
+    device-phase deltas and the regress verdict into the BENCH row."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_TRACE_QUICK", "1")
+    out = bench.bench_trace()
+    assert out["device_phase_source"] == "measured"
+    assert set(out["device_phase_delta"]) == {
+        "gather", "client_steps", "merge", "server_update"}
+    # shares: deltas sum to ~0 (both sides are normalized shares)
+    assert abs(sum(out["device_phase_delta"].values())) < 1e-3
+    assert all(v > 0 for v in out["device_phases_measured_s"].values())
+    assert out["regress"]["ok"] is True
+
+
 def test_probe_verdict_cache_ttl_semantics(tmp_path, monkeypatch):
     """The accelerator liveness-probe verdict is cached in a side file so a
     wedged tunnel costs one 120s hang per boot, not one per bench/test

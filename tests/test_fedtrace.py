@@ -42,12 +42,16 @@ import fedtrace  # noqa: E402
 @pytest.fixture
 def clean_tracer():
     """Tracing off + empty buffers before and after every tracer test —
-    the tracer is process-global."""
+    the tracer is process-global (path/label too, since fedscope tests
+    configure them)."""
     obs.configure(enabled=False)
     obs.get_tracer().reset()
     yield obs.get_tracer()
     obs.configure(enabled=False)
-    obs.get_tracer().reset()
+    tr = obs.get_tracer()
+    tr.reset()
+    tr.path = None
+    tr.label = None
 
 
 def args_for(rounds=4, **over):
@@ -326,3 +330,410 @@ def test_bench_trace_quick(monkeypatch, clean_tracer):
     assert out["trace_rounds"] >= 3
     assert out["phases"]["client_steps"] > 0
     assert not obs.trace_enabled(), "bench must disable tracing on exit"
+
+
+# -- fedscope: span ids, cross-process propagation, merge/critical-path -----
+
+SRV = os.path.join(REPO, "tests", "data", "fedtrace", "two_proc_server.json")
+SILO1 = os.path.join(REPO, "tests", "data", "fedtrace",
+                     "two_proc_silo1.json")
+SILO2 = os.path.join(REPO, "tests", "data", "fedtrace",
+                     "two_proc_silo2.json")
+CP_GOLDEN = os.path.join(REPO, "tests", "data", "fedtrace",
+                         "two_proc_critical_path.json")
+
+
+def test_tracer_span_ids_parentage_and_traceparent(clean_tracer):
+    import re
+
+    obs.configure(enabled=True, jax_hooks=False)
+    tr = clean_tracer
+    assert re.fullmatch(r"[0-9a-f]{32}", tr.trace_id)
+    assert tr.current_span_id() is None
+    with tr.span("outer") as outer:
+        assert re.fullmatch(r"[0-9a-f]{16}", outer.span_id)
+        assert tr.current_span_id() == outer.span_id
+        assert tr.current_traceparent() == \
+            f"00-{tr.trace_id}-{outer.span_id}-01"
+        with tr.span("inner") as inner:
+            assert tr.current_span_id() == inner.span_id
+    assert outer.duration_s is not None and outer.duration_s >= 0
+    trace = tr.export_chrome()
+    b = {e["name"]: e for e in trace["traceEvents"] if e.get("ph") == "B"}
+    # every span B event carries its id; nesting carries parentage
+    assert b["outer"]["args"]["span_id"] == outer.span_id
+    assert b["inner"]["args"]["parent"] == outer.span_id
+    # pid/host tags on every event; identity + clock anchor in otherData
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") != "M":
+            assert "pid" in ev and "host" in ev, ev
+    od = trace["otherData"]
+    assert od["trace_id"] == tr.trace_id
+    assert od["pid"] == os.getpid() and od["host"]
+    assert od["origin_unix_us"] > 0
+
+
+def test_context_inject_extract_and_tiers(clean_tracer):
+    from fedml_tpu.obs import context as ctx
+
+    # disabled tracer: inject is a no-op (zero extra wire bytes)
+    carrier = {}
+    ctx.inject(carrier)
+    assert carrier == {}
+    assert ctx.extract({"x": 1}) is None
+    assert ctx.parse_traceparent("junk") is None
+
+    obs.configure(enabled=True, jax_hooks=False)
+    tr = clean_tracer
+    with tr.span("comm.send") as sp:
+        ctx.inject(carrier)
+    got = ctx.extract(carrier)
+    assert got["trace_id"] == tr.trace_id
+    assert got["span_id"] == sp.span_id
+    assert got["host"] == tr.host and got["pid"] == os.getpid()
+
+    # rank-0 edge = silo→server DCN tier, everything else intra-silo
+    assert ctx.comm_tier(0, 3) == "silo_server"
+    assert ctx.comm_tier(3, 0) == "silo_server"
+    assert ctx.comm_tier(2, 3) == "intra_silo"
+
+
+def test_tracer_close_flushes_and_is_idempotent(tmp_path, clean_tracer):
+    """A crashed/exiting process must leave a mergeable partial trace:
+    close() (the atexit hook) writes the file with synthesized ends and
+    a second close() without new events rewrites nothing."""
+    path = tmp_path / "partial.json"
+    obs.configure(enabled=True, jax_hooks=False, path=str(path),
+                  label="silo7")
+    tr = clean_tracer
+    tr.begin("left_open")
+    tr.close()
+    first = path.read_text()
+    trace = json.loads(first)
+    assert fedtrace.validate_events(trace["traceEvents"]) == []
+    assert trace["otherData"]["label"] == "silo7"
+    ends = [e for e in trace["traceEvents"]
+            if e["name"] == "left_open" and e["ph"] == "E"]
+    assert ends and ends[0]["args"]["synthesized_end"] is True
+
+    path.write_text(first + " ")        # sentinel: rewrite would drop it
+    tr.close()                          # nothing new -> no rewrite
+    assert path.read_text() == first + " "
+    tr.counter("c", 1)
+    tr.close()                          # new event -> flushed again
+    assert "\"c\"" in path.read_text() and path.read_text() != first + " "
+    tr.end("left_open")
+
+
+def _wait_for(pred, timeout_s=10.0):
+    import time as _time
+
+    t0 = _time.time()
+    while _time.time() - t0 < timeout_s:
+        if pred():
+            return True
+        _time.sleep(0.01)
+    return False
+
+
+def _assert_send_recv_linked(tr, backend, expect_round=3):
+    """Shared asserts for the comm-manager propagation tests: paired
+    send/recv spans, the recv's parent_span naming the send's span id,
+    and per-tier byte/rtt counters."""
+    evs = tr.export_chrome()["traceEvents"]
+    sends = [e for e in evs if e.get("ph") == "B"
+             and e["name"] == "comm.send"
+             and e["args"].get("backend") == backend]
+    recvs = [e for e in evs if e.get("ph") == "B"
+             and e["name"] == "comm.recv"
+             and e["args"].get("msg_type") == "42"]
+    assert sends and recvs, (backend, [e["name"] for e in evs])
+    send, recv = sends[-1], recvs[-1]
+    assert recv["args"]["parent_span"] == send["args"]["span_id"]
+    assert recv["args"]["remote_pid"] == os.getpid()
+    assert recv["args"]["round"] == expect_round
+    assert send["args"]["tier"] == recv["args"]["tier"] == "silo_server"
+    counters = tr.summary()["counters"]
+    assert counters.get("comm.bytes.silo_server", 0) > 0
+    assert counters.get("comm.bytes_recv.silo_server", 0) > 0
+    assert "comm.rtt.silo_server" in counters
+    # schema stays valid with the comm spans in
+    assert fedtrace.validate_events(evs) == []
+
+
+def _mk_fsm(args, rank, size, backend, sink):
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        FedMLCommManager)
+
+    class _FSM(FedMLCommManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                42, lambda m: sink.append(m))
+
+    return _FSM(args, rank=rank, size=size, backend=backend)
+
+
+def test_local_comm_propagates_context_and_tier_counters(clean_tracer):
+    import threading
+    import types
+
+    import numpy as np
+
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    obs.configure(enabled=True, jax_hooks=False)
+    args = types.SimpleNamespace(run_id="fedscope_local")
+    got = []
+    srv = _mk_fsm(args, 0, 2, "local", got)
+    cli = _mk_fsm(args, 1, 2, "local", [])
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    msg = Message(42, 1, 0)
+    msg.add_params("round_idx", 3)
+    msg.add_params("w", np.zeros(64, np.float32))
+    cli.send_message(msg)
+    assert _wait_for(lambda: got)
+    srv.finish()
+    cli.finish()
+    t.join(timeout=5)
+    # the wire really carried the context
+    assert "fedscope.traceparent" in got[0].get_params()
+    _assert_send_recv_linked(clean_tracer, "local")
+
+
+def test_grpc_comm_propagates_context_and_tier_counters(clean_tracer):
+    import socket
+    import threading
+    import types
+
+    import numpy as np
+
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    ports = []
+    socks = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    obs.configure(enabled=True, jax_hooks=False)
+    ip = {0: f"127.0.0.1:{ports[0]}", 1: f"127.0.0.1:{ports[1]}"}
+    args = types.SimpleNamespace(run_id="fedscope_grpc", grpc_ipconfig=ip)
+    got = []
+    srv = _mk_fsm(args, 0, 2, "GRPC", got)
+    cli = _mk_fsm(args, 1, 2, "GRPC", [])
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    msg = Message(42, 1, 0)
+    msg.add_params("round_idx", 3)
+    msg.add_params("w", np.arange(32, dtype=np.float32))
+    cli.send_message(msg)
+    assert _wait_for(lambda: got)
+    srv.finish()
+    cli.finish()
+    t.join(timeout=5)
+    _assert_send_recv_linked(clean_tracer, "grpc")
+    # grpc prices the REAL serialized blob, and the unary span is the RTT
+    counters = clean_tracer.summary()["counters"]
+    assert counters["comm.bytes.silo_server"] >= 32 * 4
+    assert counters["comm.rtt.silo_server"] > 0
+
+
+def test_mqtt_comm_propagates_context_and_tier_counters(
+        clean_tracer, tmp_path, monkeypatch):
+    import types
+
+    import numpy as np
+
+    from tests import fake_paho
+
+    fake_paho.install(monkeypatch)
+    fake_paho.BROKER.__init__()
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    obs.configure(enabled=True, jax_hooks=False)
+    args = types.SimpleNamespace(run_id="fedscope_mqtt",
+                                 store_dir=str(tmp_path),
+                                 mqtt_config={"host": "fake", "port": 1883})
+    got = []
+    srv = _mk_fsm(args, 0, 2, "MQTT_S3", got)
+    # the fake broker delivers synchronously through the observer — no
+    # receive loop needed, but the FSM handlers must be registered
+    srv.register_message_receive_handlers()
+    _cli = _mk_fsm(args, 1, 2, "MQTT_S3", [])
+    msg = Message(42, 1, 0)
+    msg.add_params("round_idx", 3)
+    msg.add_params("model_params",
+                   {"w": np.arange(128, dtype=np.float32)})
+    _cli.send_message(msg)   # fake broker delivers synchronously
+    assert _wait_for(lambda: got)
+    _assert_send_recv_linked(clean_tracer, "mqtt")
+    # context rode the control JSON; the tensor went via the blob store,
+    # and the tier counter priced blob + control
+    assert "fedscope.traceparent" in got[0].get_params()
+    counters = clean_tracer.summary()["counters"]
+    assert counters["comm.bytes.silo_server"] >= 128 * 4
+
+
+# -- merge + critical-path (committed two-process goldens) -------------------
+
+def test_fedtrace_merge_offsets_are_hand_checkable(tmp_path):
+    """The committed fixtures encode EXACT clock errors: every process's
+    local ts equals the true time offset, while the unix anchors are
+    wrong by +30ms (silo1) and -50ms (silo2); transport is a symmetric
+    2ms each way.  The NTP-style handshake interval is therefore
+    [-32ms, -28ms] for silo1 and [+48ms, +52ms] for silo2, whose
+    midpoints are exactly the injected errors."""
+    out = tmp_path / "merged.json"
+    r = _run_cli("merge", "--out", str(out), SRV, SILO1, SILO2, "--json")
+    assert r.returncode == 0, r.stderr
+    info = json.loads(r.stdout)
+    offsets = {p["label"]: p["offset_us"] for p in info["processes"]}
+    assert offsets == {"server": 0.0, "silo1": -30000.0, "silo2": 50000.0}
+    methods = {p["label"]: p["offset_method"] for p in info["processes"]}
+    assert methods == {"server": "reference", "silo1": "handshake",
+                       "silo2": "handshake"}
+
+    merged = fedtrace.load_trace(str(out))
+    assert fedtrace.validate_events(merged["traceEvents"]) == []
+    # pids remapped to input order; every process keeps one named lane
+    labels = fedtrace._proc_labels(merged)
+    assert labels == {0: "server", 1: "silo1", 2: "silo2"}
+    # corrected clock: silo2's partial-upload send lands BEFORE the
+    # server's recv of it on the merged timeline (causality restored —
+    # with the raw -50ms anchor error it would appear 48ms late)
+    spans = fedtrace._paired_spans(merged["traceEvents"])
+    send = next(s for s in spans if s["args"].get("span_id")
+                == "s2_send_r0")
+    recv = next(s for s in spans if s["args"].get("parent_span")
+                == "s2_send_r0")
+    assert send["t0"] < recv["t0"] < send["t1"]
+
+
+def test_fedtrace_critical_path_names_slow_silo_golden(tmp_path):
+    """Acceptance lens: the slow silo (silo2's 0.35s round vs silo1's
+    0.1s) must be named as the round-gating chain — server round ←
+    combine ← recv(partial) ← silo2 send ← silo2 silo.round — and lead
+    the straggler ranking.  Pinned against the committed golden."""
+    out = tmp_path / "merged.json"
+    assert _run_cli("merge", "--out", str(out), SRV, SILO1,
+                    SILO2).returncode == 0
+    r = _run_cli("critical-path", str(out), "--json")
+    assert r.returncode == 0, r.stderr
+    got = json.loads(r.stdout)
+    with open(CP_GOLDEN) as fh:
+        want = json.load(fh)
+    assert got == want, ("critical-path drifted from the committed "
+                         f"golden\n got: {got}\n want: {want}")
+    # the load-bearing facts, independent of the golden's formatting
+    assert got["gating_process_overall"] == "silo2"
+    round0 = got["rounds"][0]
+    assert round0["gating_process"] == "silo2"
+    chain = [(c["process"], c["name"]) for c in round0["chain"]]
+    assert chain[0] == ("server", "round")
+    assert ("silo2", "silo.round") in chain
+    assert ("silo1", "silo.round") not in chain
+    assert round0["stragglers"][0]["process"] == "silo2"
+    assert round0["stragglers"][0]["lag_s"] == pytest.approx(0.25)
+
+    # --round filter
+    r = _run_cli("critical-path", str(out), "--round", "7", "--json")
+    assert json.loads(r.stdout)["rounds"] == []
+
+
+# -- regress: the perf-regression gate ---------------------------------------
+
+def test_fedtrace_regress_contract(tmp_path):
+    """Committed trajectory passes its own bands; a slowed row fails
+    with exit 3; structural counters (violations) are zero-tolerance."""
+    r = _run_cli("regress", os.path.join(REPO, "BENCH_r08.json"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REGRESSION" not in r.stdout
+
+    import copy
+
+    with open(os.path.join(REPO, "BENCH_r08.json")) as fh:
+        row = json.load(fh)
+    bad = copy.deepcopy(row)
+    bad["mt_tok_s"] *= 0.5               # a halved-throughput serving row
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    r = _run_cli("regress", str(bad_path), "--baseline-dir", REPO,
+                 "--json")
+    assert r.returncode == 3
+    out = json.loads(r.stdout)
+    assert not out["ok"]
+    assert [x["metric"] for x in out["regressions"]] == ["mt_tok_s"]
+
+    # zero-tolerance structural band: ONE fedverify violation fails
+    with open(os.path.join(REPO, "BENCH_r09.json")) as fh:
+        verify_row = json.load(fh)
+    assert _run_cli("regress", os.path.join(REPO, "BENCH_r09.json")
+                    ).returncode == 0
+    verify_row["violations"] = 1
+    vp = tmp_path / "verify.json"
+    vp.write_text(json.dumps(verify_row))
+    assert _run_cli("regress", str(vp), "--baseline-dir",
+                    REPO).returncode == 3
+
+    # usable errors: missing bands file is a CLI error, not a crash
+    assert _run_cli("regress", str(bad_path), "--bands",
+                    "/no/such/bands.json").returncode == 1
+
+
+# -- measured device phases (trace_device) -----------------------------------
+
+def _obs_round(ts, rt, **flops):
+    args = {"round": 0, "round_time_s": rt}
+    args.update(flops)
+    return {"name": "obs.round", "ph": "C", "ts": ts, "pid": 1, "tid": 1,
+            "args": args}
+
+
+def _counter(name, ts, v):
+    return {"name": name, "ph": "C", "ts": ts, "pid": 1, "tid": 1,
+            "args": {"value": v}}
+
+
+def test_summarize_prefers_measured_device_phases():
+    """With all four device.<p>_s counters present the attribution uses
+    MEASURED weights (here 1/2/1/0.5 ms ⇒ shares 2/9, 4/9, 2/9, 1/9 of
+    the 0.9s round) and reports the proxy deltas; with a partial counter
+    set it falls back to the FLOP proxy."""
+    flops = dict(flops_gather=10.0, flops_client_steps=70.0,
+                 flops_merge=10.0, flops_server_update=10.0)
+    events = [_obs_round(1000, 0.9, **flops),
+              _counter("device.gather_s", 2000, 0.001),
+              _counter("device.client_steps_s", 2100, 0.002),
+              _counter("device.merge_s", 2200, 0.001),
+              _counter("device.server_update_s", 2300, 0.0005)]
+    s = fedtrace.summarize({"traceEvents": events})
+    assert s["device_phase_source"] == "measured"
+    assert s["phases"]["gather"] == pytest.approx(0.9 * 2 / 9)
+    assert s["phases"]["client_steps"] == pytest.approx(0.9 * 4 / 9)
+    assert s["phases"]["server_update"] == pytest.approx(0.9 * 1 / 9)
+    # measured share − modeled share: client_steps was over-weighted by
+    # the proxy (0.7) vs measured (4/9)
+    assert s["device_phase_delta"]["client_steps"] == pytest.approx(
+        4 / 9 - 0.7, abs=1e-6)
+    assert s["device_phases_measured_s"]["merge"] == 0.001
+
+    partial = events[:-1]   # server_update counter missing
+    s2 = fedtrace.summarize({"traceEvents": partial})
+    assert "device_phase_source" not in s2
+    assert s2["phases"]["client_steps"] == pytest.approx(0.9 * 0.7)
+
+
+def test_trace_device_probe_emits_measured_counters(clean_tracer):
+    """args.trace_device: the out-of-band probe runs once at train start
+    and its counters flip `fedtrace summarize` to measured attribution."""
+    obs.configure(enabled=True, reset=True)
+    api = make_api("sp", rounds=2, trace_device=True)
+    api.train()
+    s = fedtrace.summarize(obs.get_tracer().export_chrome())
+    assert s["device_phase_source"] == "measured"
+    assert all(v > 0 for v in s["device_phases_measured_s"].values())
+    assert set(s["device_phase_delta"]) == set(fedtrace.DEVICE_PHASES)

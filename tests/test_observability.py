@@ -27,6 +27,40 @@ def test_profiler_event_spans():
         assert ("agg", 0) in kinds and ("agg", 1) in kinds
 
 
+def test_profiler_event_records_carry_span_parentage():
+    """ISSUE 11 satellite: MLOpsProfilerEvent rides the fedscope span-id
+    plane — captured records carry trace/span/parent ids (not bare
+    names), nested spans name their parent, and the ended record names
+    the SAME span its started record opened."""
+    from fedml_tpu import mlops, obs
+    from fedml_tpu.mlops.profiler_event import MLOpsProfilerEvent
+
+    obs.configure(enabled=True, jax_hooks=False, reset=True)
+    try:
+        tr = obs.get_tracer()
+        ev = MLOpsProfilerEvent()
+        with mlops.capture_events() as records:
+            ev.log_event_started("outer")
+            ev.log_event_started("inner")
+            ev.log_event_ended("inner")
+            ev.log_event_ended("outer")
+        spans = [r for r in records if r.get("kind") == "span"]
+        started = {r["name"]: r for r in spans if r["event_type"] == 0}
+        ended = {r["name"]: r for r in spans if r["event_type"] == 1}
+        assert started["outer"]["trace_id"] == tr.trace_id
+        assert started["outer"]["span_id"] and \
+            started["outer"]["parent_id"] is None
+        # nesting carries parentage instead of bare names
+        assert started["inner"]["parent_id"] == \
+            started["outer"]["span_id"]
+        # the ended record closes the SAME span (reentrancy-safe ids)
+        for name in ("outer", "inner"):
+            assert ended[name]["span_id"] == started[name]["span_id"]
+    finally:
+        obs.configure(enabled=False)
+        obs.get_tracer().reset()
+
+
 def test_exporter_lifecycle():
     """ISSUE 4 satellite: unregister_exporter + the capture_events scoped
     exporter (replacing the old manual ``_state["exporters"].remove``

@@ -8,28 +8,43 @@ Pure stdlib (runs without jax installed, like ``tools/fedlint.py``):
   server_update) round-time breakdown.
 - ``fedtrace.py diff A.json B.json [--json]`` — per-phase comparison of
   two traces (e.g. fused vs. unfused, or two commits).
+- ``fedtrace.py merge --out M.json A.json B.json ...`` — align N
+  per-process captures of one federation run on a handshake-estimated
+  clock offset into ONE Perfetto-loadable timeline (fedscope).
+- ``fedtrace.py critical-path MERGED.json [--round R]`` — walk each
+  round's span DAG (cross-process edges via the propagated span ids)
+  and report the gating chain + per-silo straggler ranking.
+- ``fedtrace.py regress CURRENT.json [--bands F] [--baseline-dir D]`` —
+  per-metric tolerance gate of a bench row against the committed
+  ``BENCH_r*.json`` trajectory; exit 3 on regression.
 
 Attribution model (docs/OBSERVABILITY.md): ``staging`` is measured
 directly from host spans; the four device phases are apportioned from
 each round's measured wall-clock (the ``obs.round`` counter's
 ``round_time_s``) proportionally to the per-phase FLOP weights the
-compiled round records on device (``ObsCarry.phase_flops``) — the device
-side of a fused ``jit(lax.scan(round))`` dispatch cannot be host-timed
-per phase without breaking the zero-sync contract, so the breakdown is a
-flop-weighted attribution, not a per-phase stopwatch.
+compiled round records on device (``ObsCarry.phase_flops``) — unless the
+trace carries MEASURED per-phase device durations (the ``device.<p>_s``
+counters the ``trace_device`` probe emits), which replace the FLOP proxy.
 
-Exit codes: 0 ok, 1 malformed trace, 2 usage error.
+Exit codes: 0 ok, 1 malformed trace / bad input, 2 usage error,
+3 regression detected (``regress`` only).
 """
 
 from __future__ import annotations
 
 import argparse
+import fnmatch
+import glob as glob_mod
 import json
+import os
 import sys
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 DEVICE_PHASES = ("gather", "client_steps", "merge", "server_update")
 PHASES = ("staging",) + DEVICE_PHASES
+
+#: counter names of the measured device-phase probe (obs/devicetime.py)
+MEASURED_PHASE_COUNTERS = {p: f"device.{p}_s" for p in DEVICE_PHASES}
 
 
 def load_trace(path: str) -> Dict[str, Any]:
@@ -121,27 +136,49 @@ def round_records(events: List[dict]) -> List[dict]:
             if ev.get("ph") == "C" and ev.get("name") == "obs.round"]
 
 
+def measured_phase_seconds(events: List[dict]) -> Optional[Dict[str, float]]:
+    """Measured per-phase device durations from the ``device.<p>_s``
+    counters (the ``trace_device`` probe, obs/devicetime.py) — present
+    only when the run opted into the out-of-band measurement.  Requires
+    ALL four phases so the attribution never mixes measured and modeled
+    weights."""
+    counters = counter_last(events)
+    out = {}
+    for p, name in MEASURED_PHASE_COUNTERS.items():
+        v = counters.get(name)
+        if not isinstance(v, float) or v <= 0:
+            return None
+        out[p] = v
+    return out
+
+
 def phase_breakdown(events: List[dict],
                     spans: Optional[Dict[str, Dict[str, float]]] = None
                     ) -> Dict[str, Any]:
     """Per-phase seconds: staging measured from spans; device phases
-    attributed from per-round wall-clock × on-device FLOP weights."""
+    attributed from per-round wall-clock × on-device FLOP weights — or,
+    when the trace carries the measured device-phase counters, × the
+    MEASURED per-phase durations (proxy kept as fallback)."""
     spans = spans if spans is not None else span_totals(events)
     rounds = round_records(events)
+    measured = measured_phase_seconds(events)
     phases = {p: 0.0 for p in PHASES}
     phases["staging"] = spans.get("staging", {}).get("total_s", 0.0)
     total_round_s = 0.0
     for rec in rounds:
         rt = float(rec.get("round_time_s", 0.0))
         total_round_s += rt
-        weights = [max(float(rec.get(f"flops_{p}", 0.0)), 0.0)
-                   for p in DEVICE_PHASES]
+        if measured is not None:
+            weights = [measured[p] for p in DEVICE_PHASES]
+        else:
+            weights = [max(float(rec.get(f"flops_{p}", 0.0)), 0.0)
+                       for p in DEVICE_PHASES]
         wsum = sum(weights)
         if wsum <= 0:
             continue
         for p, w in zip(DEVICE_PHASES, weights):
             phases[p] += rt * (w / wsum)
-    return {
+    out = {
         "phases": {p: round(v, 6) for p, v in phases.items()},
         "rounds": len(rounds),
         "round_time_total_s": round(total_round_s, 6),
@@ -149,6 +186,27 @@ def phase_breakdown(events: List[dict],
                            6),
         "compile_count": int(spans.get("xla_compile", {}).get("count", 0)),
     }
+    if measured is not None:
+        out["device_phase_source"] = "measured"
+        out["device_phases_measured_s"] = {p: round(v, 6)
+                                           for p, v in measured.items()}
+        # measured-vs-modeled share deltas: how far the FLOP proxy was off
+        # (bench.py --trace archives these into the BENCH json)
+        modeled = {p: 0.0 for p in DEVICE_PHASES}
+        for rec in rounds:
+            w = [max(float(rec.get(f"flops_{p}", 0.0)), 0.0)
+                 for p in DEVICE_PHASES]
+            ws = sum(w)
+            if ws <= 0:
+                continue
+            for p, v in zip(DEVICE_PHASES, w):
+                modeled[p] += v / ws
+        n = max(len(rounds), 1)
+        msum = sum(measured.values())
+        out["device_phase_delta"] = {
+            p: round(measured[p] / msum - modeled[p] / n, 6)
+            for p in DEVICE_PHASES}
+    return out
 
 
 def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
@@ -253,6 +311,359 @@ def diff(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# fedscope: multi-process merge (clock alignment) + critical path + regress
+# ---------------------------------------------------------------------------
+
+def _proc_meta(trace: Dict[str, Any], idx: int) -> Dict[str, Any]:
+    od = trace.get("otherData") or {}
+    return {
+        "host": od.get("host", f"host{idx}"),
+        "pid": int(od.get("pid", idx)),
+        "label": od.get("label") or f"proc{idx}",
+        "origin_unix_us": float(od.get("origin_unix_us", 0.0)),
+        "trace_id": od.get("trace_id"),
+    }
+
+
+def _comm_pairs(events_a: List[dict], events_b: List[dict]
+                ) -> List[Tuple[float, float, str]]:
+    """Matched (send_ts, recv_ts, direction) pairs between two processes'
+    RAW (per-process clock) events, linked exactly by the propagated span
+    ids: a ``comm.recv`` B event's ``parent_span`` names the sender's
+    ``comm.send`` span id.  direction is "a2b" or "b2a"."""
+    def sends(evs):
+        return {e["args"]["span_id"]: e["ts"] for e in evs
+                if e.get("ph") == "B" and e.get("name") == "comm.send"
+                and isinstance(e.get("args"), dict)
+                and "span_id" in e["args"]}
+
+    def recvs(evs):
+        return [(e["args"].get("parent_span"), e["ts"]) for e in evs
+                if e.get("ph") == "B" and e.get("name") == "comm.recv"
+                and isinstance(e.get("args"), dict)]
+
+    pairs = []
+    sa, sb = sends(events_a), sends(events_b)
+    for parent, ts in recvs(events_b):
+        if parent in sa:
+            pairs.append((sa[parent], ts, "a2b"))
+    for parent, ts in recvs(events_a):
+        if parent in sb:
+            pairs.append((sb[parent], ts, "b2a"))
+    return pairs
+
+
+def _handshake_offset(meta_ref, events_ref, meta_p, events_p
+                      ) -> Tuple[float, str]:
+    """Residual clock offset ``d`` (µs) to ADD to process p's unix-mapped
+    timestamps so they line up with the reference process.
+
+    NTP-style bound from message causality (send happens-before recv):
+    for p→ref messages ``d ≤ recv_ref − send_p``; for ref→p messages
+    ``d ≥ send_ref − recv_p``; both in unix µs after applying each
+    process's own wall-clock anchor.  The midpoint of the feasible
+    interval is the estimate; with traffic in only one direction the
+    single bound is used; with none, the raw unix anchors stand."""
+    pairs = _comm_pairs(events_p, events_ref)   # a=p, b=ref
+    o_p, o_ref = meta_p["origin_unix_us"], meta_ref["origin_unix_us"]
+    hi, lo = [], []
+    for send_ts, recv_ts, direction in pairs:
+        if direction == "a2b":      # p sent, ref received
+            hi.append((recv_ts + o_ref) - (send_ts + o_p))
+        else:                       # ref sent, p received
+            lo.append((send_ts + o_ref) - (recv_ts + o_p))
+    if hi and lo:
+        return (max(lo) + min(hi)) / 2.0, "handshake"
+    if hi:
+        return min(hi), "one_way_upper"
+    if lo:
+        return max(lo), "one_way_lower"
+    return 0.0, "unix_clock"
+
+
+def merge(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge N per-process captures into one timeline.
+
+    Process 0 of the input list is the clock reference (pass the server's
+    trace first).  Every process's events are mapped to unix time via its
+    exported ``origin_unix_us`` anchor, then refined by the handshake
+    estimate above; pids are remapped to the input order so Perfetto
+    shows one stable lane per process."""
+    procs = []
+    for i, tr in enumerate(traces):
+        meta = _proc_meta(tr, i)
+        evs = [e for e in tr["traceEvents"] if e.get("ph") != "M"]
+        procs.append((meta, evs))
+    ref_meta, ref_evs = procs[0]
+    offsets, methods = [0.0], ["reference"]
+    for meta, evs in procs[1:]:
+        off, how = _handshake_offset(ref_meta, ref_evs, meta, evs)
+        offsets.append(off)
+        methods.append(how)
+
+    # merged clock zero = earliest corrected event
+    t0 = None
+    for (meta, evs), off in zip(procs, offsets):
+        for e in evs:
+            t = e["ts"] + meta["origin_unix_us"] + off
+            t0 = t if t0 is None or t < t0 else t0
+    t0 = t0 or 0.0
+
+    merged_events: List[dict] = []
+    proc_rows = []
+    for i, ((meta, evs), off) in enumerate(zip(procs, offsets)):
+        merged_events.append({
+            "name": "process_name", "ph": "M", "ts": 0.0, "pid": i,
+            "tid": 0, "args": {"name": meta["label"]}})
+        for e in evs:
+            ne = dict(e)
+            ne["ts"] = e["ts"] + meta["origin_unix_us"] + off - t0
+            ne["pid"] = i
+            merged_events.append(ne)
+        proc_rows.append({"label": meta["label"], "host": meta["host"],
+                          "pid": meta["pid"],
+                          "offset_us": round(offsets[i], 3),
+                          "offset_method": methods[i],
+                          "trace_id": meta["trace_id"]})
+    merged_events.sort(key=lambda e: (e.get("ph") != "M",
+                                      e.get("ts", 0.0)))
+    return {
+        "traceEvents": merged_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"exporter": "fedtrace merge",
+                      "fedscope_merge": {"processes": proc_rows,
+                                         "t0_unix_us": round(t0, 3)}},
+    }
+
+
+def _paired_spans(events: List[dict]) -> List[dict]:
+    """Complete spans (B/E paired per pid+tid) with the B event's args."""
+    open_: Dict[Any, List[dict]] = {}
+    spans: List[dict] = []
+    for ev in events:
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            open_.setdefault(key, []).append(ev)
+        elif ph == "E":
+            stack = open_.get(key, [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i]["name"] == ev["name"]:
+                    b = stack.pop(i)
+                    spans.append({
+                        "pid": ev.get("pid"), "tid": ev.get("tid"),
+                        "name": ev["name"], "t0": b["ts"], "t1": ev["ts"],
+                        "args": dict(b.get("args") or {})})
+                    break
+    return spans
+
+
+def _proc_labels(trace: Dict[str, Any]) -> Dict[Any, str]:
+    labels: Dict[Any, str] = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            labels[e.get("pid")] = (e.get("args") or {}).get(
+                "name", str(e.get("pid")))
+    return labels
+
+
+def critical_path(trace: Dict[str, Any],
+                  round_idx: Optional[int] = None) -> Dict[str, Any]:
+    """Walk each round's span DAG on a merged timeline and name the chain
+    that gated the round — phase × process — plus a per-process straggler
+    ranking.
+
+    Edges: (1) cross-process ``comm.recv → comm.send`` links from the
+    propagated span ids; (2) same-process precedence inside the round
+    (the latest span ending inside, or immediately before, the current
+    one).  The walk starts at the round's last-finishing span (the server
+    combine/round close) and repeatedly follows the predecessor with the
+    latest end time — by construction the time-critical chain."""
+    events = trace["traceEvents"]
+    spans = _paired_spans(events)
+    labels = _proc_labels(trace)
+    by_id = {s["args"]["span_id"]: s for s in spans
+             if "span_id" in s["args"]}
+
+    all_rounds = sorted({int(s["args"]["round"]) for s in spans
+                         if isinstance(s["args"].get("round"), (int, float))})
+    if round_idx is not None:
+        all_rounds = [r for r in all_rounds if r == int(round_idx)]
+
+    def label(s):
+        return labels.get(s["pid"], str(s["pid"]))
+
+    out_rounds = []
+    for r in all_rounds:
+        rs = [s for s in spans if s["args"].get("round") == r]
+        if not rs:
+            continue
+        # terminal = the round's completion span: prefer the driver's
+        # "round" span (the combine tier's close); the post-round state
+        # sync can land on a silo AFTER it, but that tail is bookkeeping,
+        # not the gating chain
+        round_spans = [s for s in rs if s["name"] == "round"]
+        terminal = max(round_spans or rs, key=lambda s: s["t1"])
+        chain, seen = [], set()
+        cur = terminal
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            chain.append(cur)
+            nxt = None
+            parent = cur["args"].get("parent_span")
+            if parent in by_id and id(by_id[parent]) not in seen:
+                nxt = by_id[parent]
+            else:
+                # latest same-process round-r span ending inside cur …
+                cands = [s for s in rs
+                         if s["pid"] == cur["pid"] and id(s) not in seen
+                         and cur["t0"] <= s["t1"] <= cur["t1"]]
+                if not cands:
+                    # … or immediately before it
+                    cands = [s for s in rs
+                             if s["pid"] == cur["pid"]
+                             and id(s) not in seen and s["t1"] <= cur["t0"]]
+                if cands:
+                    nxt = max(cands, key=lambda s: s["t1"])
+            cur = nxt
+        chain_rows = [{
+            "process": label(s), "name": s["name"],
+            "start_s": round(s["t0"] / 1e6, 6),
+            "end_s": round(s["t1"] / 1e6, 6),
+            "dur_s": round((s["t1"] - s["t0"]) / 1e6, 6),
+        } for s in chain]
+        gating = next((row["process"] for row in chain_rows
+                       if row["process"] != chain_rows[0]["process"]), None)
+        # straggler ranking: when does each process finish its OWN
+        # round-r work on the merged clock — comm.recv spans are excluded
+        # (receiving the post-round sync is waiting, not working), and so
+        # is the combine tier itself (it closes every round by
+        # construction; the ranking is about who it WAITED for)
+        finish: Dict[str, float] = {}
+        for s in rs:
+            if s["name"] == "comm.recv" or label(s) == label(terminal):
+                continue
+            lb = label(s)
+            finish[lb] = max(finish.get(lb, s["t1"]), s["t1"])
+        if not finish:      # single-process trace: rank everyone
+            for s in rs:
+                lb = label(s)
+                finish[lb] = max(finish.get(lb, s["t1"]), s["t1"])
+        fastest = min(finish.values())
+        stragglers = sorted(
+            ({"process": lb, "finish_s": round(t / 1e6, 6),
+              "lag_s": round((t - fastest) / 1e6, 6)}
+             for lb, t in finish.items()),
+            key=lambda row: -row["finish_s"])
+        out_rounds.append({"round": r, "chain": chain_rows,
+                           "gating_process": gating,
+                           "stragglers": stragglers})
+    gate_counts: Dict[str, int] = {}
+    for row in out_rounds:
+        if row["gating_process"]:
+            gate_counts[row["gating_process"]] = \
+                gate_counts.get(row["gating_process"], 0) + 1
+    overall = max(gate_counts, key=gate_counts.get) if gate_counts else None
+    return {"rounds": out_rounds, "gating_process_overall": overall}
+
+
+# -- perf-regression gate ----------------------------------------------------
+
+DEFAULT_BANDS_FILE = "BENCH_TOLERANCES.json"
+
+
+def _dig(obj: Any, path: str) -> Optional[float]:
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def regress(current: Dict[str, Any], bands: List[Dict[str, Any]],
+            trajectory: List[Tuple[str, Dict[str, Any]]]
+            ) -> Dict[str, Any]:
+    """Compare ``current`` (one bench row) against the committed BENCH
+    trajectory under per-metric tolerance bands.
+
+    Each band: ``{"metric": dotted.path, "files": glob,
+    "direction": "lower"|"higher", "rel_tol": float,
+    "mode": "best"|"last"}``.  A band applies only when the current row
+    CARRIES the metric (rows of different archetypes skip each other's
+    bands).  Baseline = best (default) or most recent committed value
+    among trajectory files matching the glob."""
+    results, regressions = [], []
+    for band in bands:
+        metric = band["metric"]
+        cur = _dig(current, metric)
+        if cur is None:
+            results.append({"metric": metric, "status": "skipped",
+                            "reason": "metric absent from current row"})
+            continue
+        direction = band.get("direction", "lower")
+        rel_tol = float(band.get("rel_tol", 0.2))
+        mode = band.get("mode", "best")
+        pat = band.get("files", "BENCH_r*.json")
+        vals = [(name, _dig(row, metric)) for name, row in trajectory
+                if fnmatch.fnmatch(os.path.basename(name), pat)]
+        vals = [(n, v) for n, v in vals if v is not None]
+        if not vals:
+            results.append({"metric": metric, "status": "skipped",
+                            "reason": f"no committed row matches "
+                                      f"{pat!r} with this metric"})
+            continue
+        if mode == "last":
+            base_name, base = vals[-1]
+        elif direction == "higher":
+            base_name, base = max(vals, key=lambda nv: nv[1])
+        else:
+            base_name, base = min(vals, key=lambda nv: nv[1])
+        if direction == "higher":
+            bound = base * (1.0 - rel_tol)
+            ok = cur >= bound
+        else:
+            bound = base * (1.0 + rel_tol)
+            ok = cur <= bound
+        row = {"metric": metric, "status": "ok" if ok else "REGRESSION",
+               "current": cur, "baseline": base,
+               "baseline_file": os.path.basename(base_name),
+               "bound": round(bound, 6), "direction": direction,
+               "rel_tol": rel_tol}
+        results.append(row)
+        if not ok:
+            regressions.append(row)
+    return {"checked": sum(1 for r in results if r["status"] != "skipped"),
+            "results": results, "regressions": regressions,
+            "ok": not regressions}
+
+
+def load_bands(path: str) -> List[Dict[str, Any]]:
+    with open(path) as fh:
+        data = json.load(fh)
+    bands = data["bands"] if isinstance(data, dict) else data
+    if not isinstance(bands, list):
+        raise ValueError(f"{path}: expected a list (or {{'bands': [...]}})")
+    return bands
+
+
+def load_trajectory(baseline_dir: str
+                    ) -> List[Tuple[str, Dict[str, Any]]]:
+    rows = []
+    for name in sorted(glob_mod.glob(
+            os.path.join(baseline_dir, "BENCH_r*.json"))):
+        try:
+            with open(name) as fh:
+                rows.append((name, json.load(fh)))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return rows
+
+
 def _render_summary(s: Dict[str, Any]) -> str:
     lines = [f"rounds: {s['rounds']}   "
              f"round wall-clock: {s['round_time_total_s']:.4f}s   "
@@ -288,6 +699,12 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"queue depth (last) {s.get('serve_queue_depth_last', 0.0):g}   "
             f"tokens/s (last) {s.get('serve_tokens_per_s_last', 0.0):g}   "
             f"{len(ad)} adapters / {sum(ad.values())} requests")
+    if s.get("device_phase_source") == "measured":
+        lines.append("device phases: MEASURED (trace_device probe; "
+                     "FLOP proxy deltas "
+                     + ", ".join(f"{p} {d:+.3f}"
+                                 for p, d in s["device_phase_delta"]
+                                 .items()) + ")")
     lines.append(f"{'phase':<16}{'seconds':>12}{'share':>9}")
     total = sum(s["phases"].values()) or 1.0
     for p in PHASES:
@@ -315,6 +732,39 @@ def _render_diff(d: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _render_critical_path(cp: Dict[str, Any]) -> str:
+    lines = []
+    for row in cp["rounds"]:
+        lines.append(f"round {row['round']}: gated by "
+                     f"{row['gating_process'] or '(single process)'}")
+        for link in row["chain"]:
+            lines.append(f"  <- {link['process']:<10}{link['name']:<14}"
+                         f"{link['dur_s']:>10.4f}s  "
+                         f"(ends {link['end_s']:.4f}s)")
+        lines.append("  stragglers: " + "  ".join(
+            f"{s['process']}+{s['lag_s']:.4f}s"
+            for s in row["stragglers"]))
+    lines.append(f"gating process overall: "
+                 f"{cp['gating_process_overall'] or '-'}")
+    return "\n".join(lines)
+
+
+def _render_regress(r: Dict[str, Any]) -> str:
+    lines = [f"{'metric':<42}{'status':<12}{'current':>12}{'baseline':>12}"
+             f"{'bound':>12}"]
+    for row in r["results"]:
+        if row["status"] == "skipped":
+            lines.append(f"{row['metric']:<42}{'skipped':<12}  "
+                         f"({row['reason']})")
+        else:
+            lines.append(f"{row['metric']:<42}{row['status']:<12}"
+                         f"{row['current']:>12.4f}{row['baseline']:>12.4f}"
+                         f"{row['bound']:>12.4f}")
+    lines.append(f"{r['checked']} checked, {len(r['regressions'])} "
+                 f"regression(s)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="fedtrace", description=__doc__,
@@ -328,6 +778,27 @@ def main(argv=None) -> int:
     p_diff.add_argument("trace_a")
     p_diff.add_argument("trace_b")
     p_diff.add_argument("--json", action="store_true")
+    p_merge = sub.add_parser(
+        "merge", help="align N per-process captures into one timeline "
+                      "(pass the server's trace first — it is the clock "
+                      "reference)")
+    p_merge.add_argument("traces", nargs="+")
+    p_merge.add_argument("--out", required=True)
+    p_merge.add_argument("--json", action="store_true")
+    p_cp = sub.add_parser(
+        "critical-path", help="per-round gating chain + straggler "
+                              "ranking of a merged timeline")
+    p_cp.add_argument("trace")
+    p_cp.add_argument("--round", type=int, default=None)
+    p_cp.add_argument("--json", action="store_true")
+    p_reg = sub.add_parser(
+        "regress", help="tolerance-band gate of a bench row vs the "
+                        "committed BENCH_r*.json trajectory (exit 3 on "
+                        "regression)")
+    p_reg.add_argument("current")
+    p_reg.add_argument("--bands", default=None)
+    p_reg.add_argument("--baseline-dir", default=None)
+    p_reg.add_argument("--json", action="store_true")
     args = parser.parse_args(argv)
 
     if args.cmd is None:
@@ -337,9 +808,39 @@ def main(argv=None) -> int:
         if args.cmd == "summarize":
             s = summarize(load_trace(args.trace))
             print(json.dumps(s) if args.json else _render_summary(s))
-        else:
+        elif args.cmd == "diff":
             d = diff(load_trace(args.trace_a), load_trace(args.trace_b))
             print(json.dumps(d) if args.json else _render_diff(d))
+        elif args.cmd == "merge":
+            merged = merge([load_trace(p) for p in args.traces])
+            with open(args.out, "w") as fh:
+                json.dump(merged, fh)
+            info = merged["otherData"]["fedscope_merge"]
+            if args.json:
+                print(json.dumps(info))
+            else:
+                for row in info["processes"]:
+                    print(f"{row['label']:<12}{row['host']}:{row['pid']}"
+                          f"  offset {row['offset_us']:+.1f}us "
+                          f"({row['offset_method']})")
+                print(f"wrote {args.out}")
+        elif args.cmd == "critical-path":
+            cp = critical_path(load_trace(args.trace),
+                               round_idx=args.round)
+            print(json.dumps(cp) if args.json else
+                  _render_critical_path(cp))
+        else:  # regress
+            base_dir = args.baseline_dir or os.path.dirname(
+                os.path.abspath(args.current)) or "."
+            bands_path = args.bands or os.path.join(base_dir,
+                                                    DEFAULT_BANDS_FILE)
+            with open(args.current) as fh:
+                current = json.load(fh)
+            r = regress(current, load_bands(bands_path),
+                        load_trajectory(base_dir))
+            print(json.dumps(r) if args.json else _render_regress(r))
+            if not r["ok"]:
+                return 3
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"fedtrace: {e}", file=sys.stderr)
         return 1

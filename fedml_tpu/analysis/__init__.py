@@ -13,6 +13,15 @@ buffers, double-buffered staging) — every one of those patterns fails
 - :mod:`fedml_tpu.analysis.runtime` — a context manager that counts XLA
   backend compilations and explicit host↔device transfers through jax's
   monitoring hooks, so tests can pin "the mesh round compiles exactly once".
+- :mod:`fedml_tpu.analysis.fedproto` — the message-FSM plane's checker:
+  extracts each manager family's protocol (handlers, sends + params,
+  handler reads, finish reachability), checks coverage / param contracts /
+  liveness against the manifest pinned in
+  ``tests/data/fedproto/protocols.json``, and replays fedscope comm spans
+  against the same manifest (``check-trace``).  Exposed as
+  ``tools/fedproto.py`` and enforced in tier-1 by ``tests/test_fedproto.py``.
+- :mod:`fedml_tpu.analysis.fedverify` — AOT lowering-level contract checks
+  over the canonical program registry (``tools/fedverify.py``).
 """
 
 from .fedlint import (  # noqa: F401
@@ -23,6 +32,7 @@ from .fedlint import (  # noqa: F401
     render_findings,
     findings_to_json,
 )
+from . import fedproto  # noqa: F401  (pure stdlib, like fedlint)
 
 __all__ = [
     "Finding",
@@ -31,4 +41,5 @@ __all__ = [
     "analyze_source",
     "render_findings",
     "findings_to_json",
+    "fedproto",
 ]

@@ -106,6 +106,12 @@ RULES: Dict[str, Rule] = {
              "jit-reachable function — works on concrete test inputs "
              "but breaks AOT lowering on eval_shape abstractions, the "
              "contract fedverify relies on (docs/FEDVERIFY.md)"),
+        Rule("raw-msg-type", ERROR,
+             "Message(<literal>, ...) constructions and "
+             "register_message_receive_handler(<literal>, ...) call "
+             "sites bypass the MyMessage-family constants — fedproto "
+             "cannot pair the send with its handler, and a typo'd int "
+             "is a silent protocol fork (docs/FEDPROTO.md)"),
     ]
 }
 
@@ -1374,6 +1380,45 @@ def check_eval_shape_safety(mv: ModuleView, out: List[Finding]):
 
 
 # --------------------------------------------------------------------------
+# rule: raw-msg-type
+# --------------------------------------------------------------------------
+
+def _is_raw_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and \
+        isinstance(node.value, (int, str)) and \
+        not isinstance(node.value, bool)
+
+
+def check_raw_msg_type(mv: ModuleView, out: List[Finding]):
+    """The message-FSM plane keys everything on msg_type constants
+    (``MyMessage.MSG_TYPE_*``-family classes, module-level ``MSG_*``
+    names).  A literal at a ``Message(...)`` construction or a
+    ``register_message_receive_handler(...)`` registration site is
+    invisible to fedproto's protocol pairing and one typo away from a
+    handler that never fires (docs/FEDPROTO.md)."""
+    sev = RULES["raw-msg-type"].severity
+    for node in ast.walk(mv.mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = last_attr(node.func)
+        if f == "Message" and _is_raw_literal(node.args[0]):
+            out.append(Finding(
+                "raw-msg-type", sev, mv.mod.path, node.lineno,
+                node.col_offset,
+                f"Message({node.args[0].value!r}, ...) constructed from a "
+                "raw literal — use a MyMessage-family msg_type constant "
+                "so fedproto can pair the send with its handler"))
+        elif f == "register_message_receive_handler" and \
+                _is_raw_literal(node.args[0]):
+            out.append(Finding(
+                "raw-msg-type", sev, mv.mod.path, node.lineno,
+                node.col_offset,
+                f"handler registered for raw literal msg_type "
+                f"{node.args[0].value!r} — use a MyMessage-family "
+                "constant shared with the sender"))
+
+
+# --------------------------------------------------------------------------
 # suppression + driver
 # --------------------------------------------------------------------------
 
@@ -1403,6 +1448,7 @@ ALL_CHECKS = [
     check_recompile_hazard,
     check_pytree_order,
     check_eval_shape_safety,
+    check_raw_msg_type,
 ]
 
 

@@ -1,0 +1,1618 @@
+"""fedproto — static protocol checker for the distributed message-FSM plane.
+
+The WAN half of this system is an actor-style message loop (reference
+``FedMLCommManager`` FSMs, PAPER.md L1/L2): ~10 hand-wired manager families
+exchange :class:`Message` objects whose types and params are plain constants.
+Nothing type-checks that plane: a sent ``msg_type`` with no registered
+handler on the other side is a silent hang (``receive_message`` logs a
+warning and drops it), and a handler ``msg_params.get(KEY)`` whose sender
+never ``add_params``-set that key is a silent ``None`` that surfaces three
+frames later as a numeric crash — arXiv:2604.10859 shows the comm layer
+dominates cross-silo behavior, yet fedlint covers source idioms and
+fedverify covers compiled HLO while the message plane had no checker.
+
+fedproto closes the gap with the same architecture as its siblings:
+
+- **Pure stdlib.** Only ``ast``; extraction needs no jax and never executes
+  the target code (``tools/fedproto.py`` loads this module by file path).
+- **Extraction.** Per manager class (or module-level driver function), the
+  protocol: registered handlers (``register_message_receive_handler(TYPE,
+  fn)``, loop-expanded tuples, lambda handlers, and ``receive_message``
+  observer ``==``-dispatch), send sites (``Message(TYPE, src, dst)``
+  constructions tracked to their ``send_message``/``send`` call with every
+  ``add_params`` key attached, parametric broadcast helpers resolved at
+  their intra-class call sites), handler-internal reads (``msg.get(KEY)``
+  required vs ``msg.get(KEY, default)`` optional vs ``msg.require(KEY)``),
+  and ``finish()`` reachability over the intra-class call graph (including
+  ``threading.Timer`` callback edges).  ``MyMessage``-style constants
+  resolve cross-module through imports (including package ``__init__``
+  re-export chains) and class-attribute tables.
+- **Four check families** (see :data:`PROTO_RULES`): coverage
+  (``unhandled-send`` / ``orphan-handler``), param contract
+  (``missing-param``), liveness (``no-finish-path``: a ``finish()``-bearing
+  handler must be reachable from the protocol entry, and no handler cycle
+  may be unable to reach one), and runtime conformance
+  (:func:`check_trace`: replay fedscope's merged ``comm.send``/``comm.recv``
+  span sequences against the same extracted protocol).
+- **Manifest.** Extracted protocols pin in
+  ``tests/data/fedproto/protocols.json`` (``--update-manifest`` refreshes
+  measured fields, preserves suppressions; the git diff is the review
+  surface — the fedverify pattern).
+- **Suppression.** ``# fedproto: disable=rule`` /
+  ``disable-next-line=rule`` source comments for site-anchored findings,
+  plus manifest-level ``{"family", "rule", "reason"}`` suppressions for
+  family-level findings — both should carry a reason.
+
+See ``docs/FEDPROTO.md`` for the full model and its limits.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+try:  # normal package import (tests, fedml_tpu.analysis)
+    from .fedlint import (ERROR, WARNING, Finding, Rule, dotted_name,
+                          exit_code, findings_to_json, iter_py_files,
+                          last_attr, render_findings)
+except ImportError:  # file-path load from tools/fedproto.py (no package)
+    from fedlint import (ERROR, WARNING, Finding, Rule, dotted_name,  # type: ignore
+                         exit_code, findings_to_json, iter_py_files,
+                         last_attr, render_findings)
+
+__all__ = [
+    "PROTO_RULES", "PROTOCOL_FAMILIES", "extract_protocols",
+    "check_protocols", "check_trace", "load_manifest", "update_manifest",
+    "protocols_to_manifest", "render_findings", "findings_to_json",
+    "exit_code", "DEFAULT_MANIFEST",
+]
+
+# --------------------------------------------------------------------------
+# rule catalog
+# --------------------------------------------------------------------------
+
+PROTO_RULES: Dict[str, Rule] = {
+    r.name: r
+    for r in [
+        Rule("unhandled-send", ERROR,
+             "a sent msg_type has no registered handler on the destination "
+             "role — the message is logged and dropped at runtime, usually "
+             "a hang"),
+        Rule("orphan-handler", ERROR,
+             "a registered handler's msg_type is never sent by any family "
+             "member — dead protocol state (or the sender was deleted)"),
+        Rule("missing-param", ERROR,
+             "a handler requires a msg_params key that at least one sender "
+             "of that msg_type never add_params-sets — a silent None at "
+             "the read site"),
+        Rule("no-finish-path", ERROR,
+             "liveness: no finish()-bearing handler is reachable from the "
+             "protocol entry, or a handler cycle cannot reach one — a "
+             "hang candidate"),
+        Rule("manifest-drift", ERROR,
+             "the extracted protocol differs from the pinned manifest "
+             "(tests/data/fedproto/protocols.json) — review the diff and "
+             "refresh with --update-manifest"),
+        Rule("manifest-missing", WARNING,
+             "a protocol family has no manifest entry yet — run "
+             "--update-manifest to pin it"),
+        Rule("unresolved-protocol", WARNING,
+             "a msg_type or params key at this call site could not be "
+             "resolved statically — the checkers skip it; prefer "
+             "MyMessage-family constants"),
+        # runtime conformance (check-trace) findings
+        Rule("trace-unknown-type", ERROR,
+             "an observed comm.send/comm.recv span carries a msg_type the "
+             "extracted protocol does not know"),
+        Rule("trace-message-loss", ERROR,
+             "a comm.send span has no matching comm.recv on any captured "
+             "process — dropped in transit, or delivered to a rank with "
+             "no handler (coverage gap in the observed sequence)"),
+        Rule("trace-duplicate-delivery", ERROR,
+             "one logical message (fedscope.msg_id) produced more than one "
+             "comm.recv span — re-delivery the FSM must be idempotent "
+             "against"),
+        Rule("trace-observed-drop", ERROR,
+             "the fault-injection layer recorded a comm.drop for this "
+             "message — it was never delivered"),
+    ]
+}
+
+#: message-params keys every Message carries by construction
+IMPLICIT_KEYS = {"msg_type", "sender", "receiver"}
+#: runtime-injected context keys (obs/context.py) — never a handler contract
+CONTEXT_KEY_PREFIX = "fedscope."
+#: constant-name suffix of the runtime-emitted readiness message: handlers
+#: for it are entry points, never orphans, and nobody "sends" it
+CONNECTION_READY_SUFFIX = "MSG_TYPE_CONNECTION_IS_READY"
+
+# --------------------------------------------------------------------------
+# protocol family table — the reviewed grouping of manager classes into
+# paired-role FSMs.  ``members`` maps a class/function name to (role, path
+# suffix); ``sources`` lists the modules whose msg-type constants belong to
+# the family (everything else a member sends/handles — e.g. the bridge's
+# global-plane traffic inside a regional family — is filtered out).
+# ``queue_style`` families consume messages from a driver loop instead of
+# per-type handlers, so param attribution and handler liveness don't apply.
+# --------------------------------------------------------------------------
+
+PROTOCOL_FAMILIES: Dict[str, Dict[str, Any]] = {
+    "cross_silo": {
+        "members": {
+            "FedMLServerManager":
+                ("server", "cross_silo/server/fedml_server_manager.py"),
+            "ClientMasterManager":
+                ("client", "cross_silo/client/fedml_client_master_manager.py"),
+        },
+        "sources": ("cross_silo/message_define.py",),
+    },
+    "cross_silo_async": {
+        "members": {
+            "AsyncFedMLServerManager":
+                ("server", "cross_silo/server/async_server_manager.py"),
+            "ClientMasterManager":
+                ("client", "cross_silo/client/fedml_client_master_manager.py"),
+        },
+        "sources": ("cross_silo/message_define.py",),
+    },
+    "secagg": {
+        "members": {
+            "SAServerManager":
+                ("server", "cross_silo/secagg/sa_fedml_server_manager.py"),
+            "SAClientManager":
+                ("client", "cross_silo/secagg/sa_fedml_client_manager.py"),
+        },
+        "sources": ("cross_silo/secagg/sa_message_define.py",),
+    },
+    "lightsecagg": {
+        "members": {
+            "LSAServerManager":
+                ("server", "cross_silo/lightsecagg/lsa_fedml_server_manager.py"),
+            "LSAClientManager":
+                ("client", "cross_silo/lightsecagg/lsa_fedml_client_manager.py"),
+        },
+        "sources": ("cross_silo/lightsecagg/lsa_message_define.py",),
+    },
+    "vertical": {
+        "members": {
+            "VflGuestManager": ("server", "cross_silo/vertical_manager.py"),
+            "VflHostManager": ("client", "cross_silo/vertical_manager.py"),
+        },
+        "sources": ("cross_silo/vertical_manager.py",),
+    },
+    "decentralized": {
+        "members": {
+            "DecentralizedWorkerManager":
+                ("peer", "cross_silo/decentralized_manager.py"),
+        },
+        "sources": ("cross_silo/decentralized_manager.py",),
+    },
+    "fa_cross_silo": {
+        "members": {
+            "FACrossSiloServer": ("server", "fa/cross_silo/fa_managers.py"),
+            "FACrossSiloClient": ("client", "fa/cross_silo/fa_managers.py"),
+        },
+        "sources": ("fa/cross_silo/fa_managers.py",),
+    },
+    "cross_cloud_global": {
+        "members": {
+            "GlobalCoordinator": ("server", "cross_cloud/hierarchy.py"),
+            "CloudBridgeManager": ("client", "cross_cloud/hierarchy.py"),
+        },
+        "sources": ("cross_cloud/hierarchy.py",),
+    },
+    # the bridge's REGIONAL plane: CloudBridgeManager acts as the
+    # cross-silo server toward its own clients (handlers inherited from
+    # FedMLServerManager, round close overridden to escalate upward; the
+    # SYNC fan-out runs from the global-sync callback, which is an entry
+    # context for this family)
+    "cross_silo_bridge": {
+        "members": {
+            "CloudBridgeManager": ("server", "cross_cloud/hierarchy.py"),
+            "ClientMasterManager":
+                ("client", "cross_silo/client/fedml_client_master_manager.py"),
+        },
+        "sources": ("cross_silo/message_define.py",),
+    },
+    "store_hierarchy": {
+        "members": {
+            "_run_combine_tier": ("server", "store/hierarchy.py"),
+            "_run_silo_tier": ("client", "store/hierarchy.py"),
+        },
+        # the queue endpoint registers one handler per protocol type for
+        # BOTH roles (the driver loops consume from the inbox)
+        "shared_members": {"_Mgr": "store/hierarchy.py"},
+        "sources": ("store/hierarchy.py",),
+        "queue_style": True,
+    },
+}
+
+
+# --------------------------------------------------------------------------
+# records
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MsgConst:
+    """A resolved msg-type constant: its value, canonical name, and the
+    path of the module that DEFINES it (the family-source filter key)."""
+    value: Any
+    name: Optional[str]
+    source: str
+
+    @property
+    def key(self) -> str:
+        return str(self.value)
+
+    @property
+    def is_connection_ready(self) -> bool:
+        return bool(self.name) and \
+            self.name.endswith(CONNECTION_READY_SUFFIX)
+
+
+@dataclasses.dataclass
+class SendSite:
+    msg: MsgConst
+    params: List[str]              # resolved add_params keys (sorted)
+    unresolved_params: int         # count of keys that didn't resolve
+    dst_is_server: Optional[bool]  # receiver expr resolved to literal 0?
+    scope: str                     # class/function name
+    method: str
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class HandlerReg:
+    msg: MsgConst
+    handler: str                   # method name or "<lambda>"
+    lambda_node: Optional[ast.AST]
+    scope: str
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class ScopeProtocol:
+    """Everything extracted from one class (inheritance-resolved) or one
+    module-level driver function."""
+    name: str
+    path: str
+    line: int
+    handlers: List[HandlerReg]
+    sends: List[SendSite]
+    #: method -> set of transitively self-called methods (incl. itself)
+    closures: Dict[str, Set[str]]
+    #: method -> does its body contain a .finish() call
+    finishing: Dict[str, bool]
+    #: handler method -> {key: required} reads of the msg parameter
+    reads: Dict[str, Dict[str, bool]]
+    warnings: List[Finding]
+
+    def closure_of(self, method: str) -> Set[str]:
+        return self.closures.get(method, {method})
+
+    def handler_finishes(self, reg: HandlerReg) -> bool:
+        if reg.lambda_node is not None:
+            return any(isinstance(n, ast.Call)
+                       and last_attr(n.func) == "finish"
+                       for n in ast.walk(reg.lambda_node))
+        return any(self.finishing.get(m, False)
+                   for m in self.closure_of(reg.handler))
+
+    def handler_sends(self, reg: HandlerReg) -> List[SendSite]:
+        if reg.lambda_node is not None:
+            return []
+        cl = self.closure_of(reg.handler)
+        return [s for s in self.sends if s.method in cl]
+
+
+# --------------------------------------------------------------------------
+# pass 1 — module indexing (constants, class tables, imports)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PModule:
+    path: str
+    tree: ast.AST
+    lines: List[str]
+    constants: Dict[str, Any]                    # module-level NAME -> int|str
+    class_tables: Dict[str, Dict[str, Any]]      # ClassName -> {attr: value}
+    class_defs: Dict[str, ast.ClassDef]          # ClassName -> node
+    func_defs: Dict[str, ast.FunctionDef]        # top-level functions
+    imports: Dict[str, Tuple[int, str, str]]     # local -> (level, mod, orig)
+    aliases: Dict[str, str]                      # alias -> Name it was bound to
+
+
+def _literal(node: ast.AST) -> Optional[Any]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, str)) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def index_module(path: str, source: str) -> Optional[PModule]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    constants: Dict[str, Any] = {}
+    imports: Dict[str, Tuple[int, str, str]] = {}
+    aliases: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            val = _literal(node.value)
+            if val is not None:
+                constants[node.targets[0].id] = val
+            elif isinstance(node.value, ast.Name):
+                aliases[node.targets[0].id] = node.value.id
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                imports[alias.asname or alias.name] = (
+                    node.level, node.module or "", alias.name)
+    class_tables: Dict[str, Dict[str, Any]] = {}
+    class_defs: Dict[str, ast.ClassDef] = {}
+    func_defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            table: Dict[str, Any] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    val = _literal(stmt.value)
+                    if val is None and isinstance(stmt.value, ast.Name):
+                        # class-attr alias of a module constant (the
+                        # Message class re-exports MSG_ARG_KEY_* this way)
+                        val = constants.get(stmt.value.id)
+                    if val is not None:
+                        table[stmt.targets[0].id] = val
+            class_tables.setdefault(node.name, table)
+            class_defs.setdefault(node.name, node)
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            func_defs[node.name] = node
+    return PModule(path=path, tree=tree, lines=source.splitlines(),
+                   constants=constants, class_tables=class_tables,
+                   class_defs=class_defs, func_defs=func_defs,
+                   imports=imports, aliases=aliases)
+
+
+class PackageView:
+    """Cross-module resolution: constants, class tables, base classes —
+    following imports (absolute by dotted-suffix match, relative by
+    filesystem walk) including ``__init__`` re-export chains."""
+
+    def __init__(self, modules: Sequence[PModule]):
+        self.modules = list(modules)
+        self.by_path = {m.path: m for m in modules}
+        self._norm = {os.path.normpath(m.path): m for m in modules}
+
+    # -- import-target lookup ---------------------------------------------
+    def _module_for_import(self, importer: PModule, level: int,
+                           module: str) -> Optional[PModule]:
+        if level > 0:
+            base = os.path.dirname(importer.path)
+            for _ in range(level - 1):
+                base = os.path.dirname(base)
+            rel = module.replace(".", os.sep) if module else ""
+            cands = [os.path.normpath(os.path.join(base, rel + ".py")),
+                     os.path.normpath(os.path.join(base, rel,
+                                                   "__init__.py"))]
+            for c in cands:
+                if c in self._norm:
+                    return self._norm[c]
+            return None
+        suffix = module.replace(".", os.sep)
+        for m in self.modules:
+            norm = os.path.normpath(m.path)
+            if norm.endswith(suffix + ".py") or \
+                    norm.endswith(os.path.join(suffix, "__init__.py")):
+                return m
+        return None
+
+    def resolve_name(self, mod: PModule, name: str, seen=None
+                     ) -> Optional[Tuple[PModule, str]]:
+        """Follow aliases + import chains until ``name`` lands on a module
+        that defines it (class, function, or module constant)."""
+        seen = seen or set()
+        if (mod.path, name) in seen:
+            return None
+        seen.add((mod.path, name))
+        if name in mod.class_defs or name in mod.func_defs or \
+                name in mod.constants:
+            return mod, name
+        if name in mod.aliases:
+            return self.resolve_name(mod, mod.aliases[name], seen)
+        if name in mod.imports:
+            level, module, orig = mod.imports[name]
+            target = self._module_for_import(mod, level, module)
+            if target is not None:
+                return self.resolve_name(target, orig, seen)
+        return None
+
+    def class_table(self, mod: PModule, name: str
+                    ) -> Optional[Tuple[Dict[str, Any], str]]:
+        hit = self.resolve_name(mod, name)
+        if hit is None:
+            return None
+        dmod, dname = hit
+        if dname in dmod.class_tables:
+            return dmod.class_tables[dname], dmod.path
+        return None
+
+    def class_def(self, mod: PModule, name: str
+                  ) -> Optional[Tuple[ast.ClassDef, PModule]]:
+        hit = self.resolve_name(mod, name)
+        if hit is None:
+            return None
+        dmod, dname = hit
+        if dname in dmod.class_defs:
+            return dmod.class_defs[dname], dmod
+        return None
+
+    # -- constant resolution ----------------------------------------------
+    def resolve_const(self, mod: PModule, node: ast.AST,
+                      local_aliases: Optional[Dict[str, str]] = None
+                      ) -> Optional[MsgConst]:
+        """Resolve a msg-type / params-key expression to a MsgConst."""
+        lit = _literal(node)
+        if lit is not None:
+            return MsgConst(lit, None, mod.path)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            base = node.value.id
+            if local_aliases and base in local_aliases:
+                base = local_aliases[base]
+            hit = self.class_table(mod, base)
+            if hit is not None:
+                table, dpath = hit
+                if node.attr in table:
+                    canon = self.resolve_name(mod, base)
+                    cname = canon[1] if canon else base
+                    return MsgConst(table[node.attr],
+                                    f"{cname}.{node.attr}", dpath)
+            return None
+        if isinstance(node, ast.Name):
+            name = node.id
+            if local_aliases and name in local_aliases:
+                # alias of a class, not a constant
+                return None
+            if name in mod.constants:
+                return MsgConst(mod.constants[name], name, mod.path)
+            hit = self.resolve_name(mod, name)
+            if hit is not None:
+                dmod, dname = hit
+                if dname in dmod.constants:
+                    return MsgConst(dmod.constants[dname], dname, dmod.path)
+        return None
+
+
+# --------------------------------------------------------------------------
+# pass 2 — per-scope extraction
+# --------------------------------------------------------------------------
+
+def _method_aliases(fn: ast.AST) -> Dict[str, str]:
+    """Local ``M = MyMessage``-style aliases inside one method body."""
+    out: Dict[str, str] = {}
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Name):
+            out[stmt.targets[0].id] = stmt.value.id
+    return out
+
+
+def _fn_param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _for_binding(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                 name: str) -> Optional[ast.For]:
+    cur: Optional[ast.AST] = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.For) and isinstance(cur.target, ast.Name) \
+                and cur.target.id == name:
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+class ScopeExtractor:
+    """Extract one class's (or driver function's) protocol surface."""
+
+    def __init__(self, pkg: PackageView, mod: PModule, name: str,
+                 node: ast.AST):
+        self.pkg = pkg
+        self.mod = mod
+        self.name = name
+        self.node = node
+        self.warnings: List[Finding] = []
+        # method table (inheritance-resolved for classes; single entry
+        # for module functions)
+        self.methods: Dict[str, Tuple[PModule, ast.FunctionDef]] = {}
+        if isinstance(node, ast.ClassDef):
+            self._build_method_table(mod, node, set())
+        else:
+            self.methods[name] = (mod, node)
+
+    # -- inheritance -------------------------------------------------------
+    def _build_method_table(self, mod: PModule, cls: ast.ClassDef,
+                            seen: Set[str]):
+        if cls.name in seen:
+            return
+        seen.add(cls.name)
+        for stmt in cls.body:
+            if isinstance(stmt, ast.FunctionDef):
+                self.methods.setdefault(stmt.name, (mod, stmt))
+        for base in cls.bases:
+            bname = last_attr(base)
+            if not bname:
+                continue
+            hit = self.pkg.class_def(mod, bname)
+            if hit is not None:
+                bcls, bmod = hit
+                self._build_method_table(bmod, bcls, seen)
+
+    # -- warnings ----------------------------------------------------------
+    def _warn(self, mod: PModule, node: ast.AST, msg: str):
+        self.warnings.append(Finding(
+            "unresolved-protocol", PROTO_RULES["unresolved-protocol"]
+            .severity, mod.path, node.lineno, node.col_offset, msg))
+
+    # -- registrations -----------------------------------------------------
+    def extract_handlers(self) -> List[HandlerReg]:
+        out: List[HandlerReg] = []
+        for mname, (mod, fn) in sorted(self.methods.items()):
+            aliases = _method_aliases(fn)
+            parents = {c: p for p in ast.walk(fn)
+                       for c in ast.iter_child_nodes(p)}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        last_attr(node.func) == \
+                        "register_message_receive_handler" and \
+                        len(node.args) >= 2:
+                    for msg in self._msg_values(mod, node.args[0], aliases,
+                                                parents, node):
+                        hname, lam = self._handler_target(node.args[1])
+                        out.append(HandlerReg(
+                            msg, hname, lam, self.name, mod.path,
+                            node.lineno))
+            if mname == "receive_message" and \
+                    self.name != "FedMLCommManager":
+                out.extend(self._observer_dispatch(mod, fn))
+        # observer classes nested inside a member's methods (the
+        # cross_cloud ``_Obs`` idiom: an inner class whose
+        # ``receive_message`` ==-dispatches onto the outer manager's
+        # methods) — their dispatch belongs to THIS scope's protocol
+        if isinstance(self.node, ast.ClassDef):
+            for sub in ast.walk(self.node):
+                if isinstance(sub, ast.ClassDef) and sub is not self.node:
+                    for stmt in sub.body:
+                        if isinstance(stmt, ast.FunctionDef) and \
+                                stmt.name == "receive_message":
+                            out.extend(self._observer_dispatch(
+                                self.mod, stmt))
+        return out
+
+    def _msg_values(self, mod: PModule, expr: ast.AST, aliases, parents,
+                    site: ast.AST) -> List[MsgConst]:
+        c = self.pkg.resolve_const(mod, expr, aliases)
+        if c is not None:
+            return [c]
+        if isinstance(expr, ast.Name):
+            loop = _for_binding(expr, parents, expr.id)
+            if loop is not None and isinstance(loop.iter,
+                                               (ast.Tuple, ast.List)):
+                vals = [self.pkg.resolve_const(mod, e, aliases)
+                        for e in loop.iter.elts]
+                if all(v is not None for v in vals):
+                    return vals  # loop-expanded registration
+        self._warn(mod, site, f"{self.name}: msg_type expression at this "
+                   "call site did not resolve to a constant")
+        return []
+
+    @staticmethod
+    def _handler_target(expr: ast.AST) -> Tuple[str, Optional[ast.AST]]:
+        if isinstance(expr, ast.Lambda):
+            return "<lambda>", expr
+        name = last_attr(expr)
+        return (name or "<unknown>"), None
+
+    def _observer_dispatch(self, mod: PModule, fn: ast.FunctionDef
+                           ) -> List[HandlerReg]:
+        """``def receive_message(self, mtype, msg)`` observer classes
+        dispatching with ``if mtype == CONST: self.x._handler(msg)`` —
+        the hand-rolled twin of handler registration (cross_cloud's
+        global-plane observer)."""
+        params = _fn_param_names(fn)
+        if len(params) < 2:
+            return []
+        mtype_p, msg_p = params[0], params[1]
+        aliases = _method_aliases(fn)
+        out: List[HandlerReg] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            t = node.test
+            if not (isinstance(t, ast.Compare) and len(t.ops) == 1 and
+                    isinstance(t.ops[0], ast.Eq) and
+                    isinstance(t.left, ast.Name) and t.left.id == mtype_p):
+                continue
+            msg = self.pkg.resolve_const(mod, t.comparators[0], aliases)
+            if msg is None:
+                self._warn(mod, node, f"{self.name}: receive_message "
+                           "dispatch compares against an unresolvable "
+                           "constant")
+                continue
+            for sub in node.body:
+                for call in ast.walk(sub):
+                    if isinstance(call, ast.Call) and any(
+                            isinstance(a, ast.Name) and a.id == msg_p
+                            for a in call.args):
+                        out.append(HandlerReg(
+                            msg, last_attr(call.func) or "<unknown>",
+                            None, self.name, mod.path, node.lineno))
+                        break
+        return out
+
+    # -- sends -------------------------------------------------------------
+    def extract_sends(self) -> List[SendSite]:
+        out: List[SendSite] = []
+        for mname, (mod, fn) in sorted(self.methods.items()):
+            out.extend(self._sends_in_method(mod, mname, fn))
+        return out
+
+    def _sends_in_method(self, mod: PModule, mname: str,
+                         fn: ast.FunctionDef) -> List[SendSite]:
+        aliases = _method_aliases(fn)
+        events: List[Tuple[int, str, Any]] = []   # (line, kind, payload)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = last_attr(node.func)
+            if f == "Message" and node.args:
+                events.append((node.lineno, "construct", node))
+            elif f in ("add_params", "add") and len(node.args) >= 2 and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name):
+                events.append((node.lineno, "add", node))
+            elif f in ("send_message", "send") and node.args:
+                events.append((node.lineno, "send", node))
+        # construct-var bindings, in statement order
+        binds: Dict[str, dict] = {}
+        out: List[SendSite] = []
+        # map Message-construct node -> assigned name (if any)
+        assign_of: Dict[ast.AST, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and \
+                    last_attr(node.value.func) == "Message" and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                assign_of[node.value] = node.targets[0].id
+        for line, kind, node in sorted(events, key=lambda e: e[0]):
+            if kind == "construct":
+                var = assign_of.get(node)
+                rec = {"node": node, "params": [], "unresolved": 0,
+                       "line": line}
+                if var is not None:
+                    binds[var] = rec
+                else:
+                    rec["inline"] = True
+                    binds.setdefault("<inline>", rec)
+            elif kind == "add":
+                var = node.func.value.id
+                rec = binds.get(var)
+                if rec is None:
+                    continue
+                key = self.pkg.resolve_const(mod, node.args[0], aliases)
+                if key is None:
+                    rec["unresolved"] += 1
+                    self._warn(mod, node, f"{self.name}.{mname}: "
+                               "add_params key did not resolve")
+                else:
+                    rec["params"].append(str(key.value))
+            elif kind == "send":
+                arg = node.args[0]
+                rec = None
+                if isinstance(arg, ast.Name):
+                    rec = binds.get(arg.id)
+                elif isinstance(arg, ast.Call) and \
+                        last_attr(arg.func) == "Message":
+                    rec = {"node": arg, "params": [], "unresolved": 0,
+                           "line": line}
+                if rec is None:
+                    continue
+                out.extend(self._finish_send(mod, mname, fn, rec, aliases))
+        return out
+
+    def _finish_send(self, mod: PModule, mname: str, fn: ast.FunctionDef,
+                     rec: dict, aliases) -> List[SendSite]:
+        ctor: ast.Call = rec["node"]
+        type_expr = ctor.args[0]
+        dst = None
+        if len(ctor.args) >= 3:
+            lit = _literal(ctor.args[2])
+            dst = (lit == 0) if lit is not None else None
+        msgs: List[Tuple[Optional[MsgConst], str]] = [
+            (self.pkg.resolve_const(mod, type_expr, aliases), mname)]
+        if msgs[0][0] is None:
+            # local binding: mtype = (FINISH if done else SYNC) — resolve
+            # every arm of the assigned expression
+            local = self._resolve_local_binding(mod, fn, type_expr, aliases)
+            if local is not None:
+                msgs = [(m, mname) for m in local]
+        if msgs[0][0] is None:
+            # parametric constructor: resolve the parameter at intra-scope
+            # call sites of this method (the _broadcast/_dispatch idiom);
+            # each resolved send is attributed to its CALLER so entry /
+            # handler-edge classification sees the real context
+            msgs = self._resolve_parametric(mod, mname, fn, type_expr)
+        if not msgs:
+            return []
+        out = []
+        for m, attributed in msgs:
+            if m is None:
+                self._warn(mod, ctor, f"{self.name}.{mname}: Message "
+                           "msg_type did not resolve to a constant")
+                continue
+            out.append(SendSite(
+                m, sorted(set(rec["params"])), rec["unresolved"], dst,
+                self.name, attributed, mod.path, rec["line"]))
+        return out
+
+    def _resolve_local_binding(self, mod: PModule, fn: ast.FunctionDef,
+                               type_expr: ast.AST, aliases
+                               ) -> Optional[List[Optional[MsgConst]]]:
+        if not isinstance(type_expr, ast.Name):
+            return None
+        if type_expr.id in _fn_param_names(fn):
+            return None
+
+        def arms(expr: ast.AST) -> List[ast.AST]:
+            if isinstance(expr, ast.IfExp):
+                return arms(expr.body) + arms(expr.orelse)
+            return [expr]
+
+        vals: List[Optional[MsgConst]] = []
+        seen: Set[str] = set()
+        found = False
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    stmt.targets[0].id == type_expr.id:
+                found = True
+                for arm in arms(stmt.value):
+                    c = self.pkg.resolve_const(mod, arm, aliases)
+                    if c is None:
+                        vals.append(None)
+                    elif c.key not in seen:
+                        seen.add(c.key)
+                        vals.append(c)
+        if not found or not vals:
+            return None
+        return vals
+
+    def _resolve_parametric(self, mod: PModule, mname: str,
+                            fn: ast.FunctionDef, type_expr: ast.AST
+                            ) -> List[Tuple[Optional[MsgConst], str]]:
+        if not isinstance(type_expr, ast.Name):
+            return [(None, mname)]
+        params = _fn_param_names(fn)
+        if type_expr.id not in params:
+            return [(None, mname)]
+        pos = params.index(type_expr.id)
+        resolved: List[Tuple[Optional[MsgConst], str]] = []
+        seen_vals: Set[Tuple[str, str]] = set()
+        found_call = False
+        for cname, (cmod, cfn) in sorted(self.methods.items()):
+            caliases = _method_aliases(cfn)
+            for node in ast.walk(cfn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if last_attr(node.func) != mname:
+                    continue
+                found_call = True
+                arg: Optional[ast.AST] = None
+                if pos < len(node.args):
+                    arg = node.args[pos]
+                for kw in node.keywords:
+                    if kw.arg == type_expr.id:
+                        arg = kw.value
+                if arg is None:
+                    continue
+                c = self.pkg.resolve_const(cmod, arg, caliases)
+                if c is None:
+                    resolved.append((None, cname))
+                elif (cname, c.key) not in seen_vals:
+                    seen_vals.add((cname, c.key))
+                    resolved.append((c, cname))
+        if not found_call:
+            return [(None, mname)]
+        return resolved
+
+    # -- reads -------------------------------------------------------------
+    _READ_ATTRS = {"get": False, "require": True, "get_required": True}
+
+    def extract_reads(self) -> Dict[str, Dict[str, bool]]:
+        """method -> {key: required} reads of the method's first (message)
+        parameter, with one-level propagation into helpers the message is
+        passed to."""
+        out: Dict[str, Dict[str, bool]] = {}
+        for mname, (mod, fn) in sorted(self.methods.items()):
+            params = _fn_param_names(fn)
+            if not params:
+                continue
+            out[mname] = self._reads_of(mod, fn, params[0], depth=2)
+        return out
+
+    def _reads_of(self, mod: PModule, fn: ast.FunctionDef, pname: str,
+                  depth: int) -> Dict[str, bool]:
+        reads: Dict[str, bool] = {}
+        aliases = _method_aliases(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = last_attr(node.func)
+            if f in self._READ_ATTRS and isinstance(node.func,
+                                                    ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == pname and node.args:
+                key = self.pkg.resolve_const(mod, node.args[0], aliases)
+                if key is None:
+                    self._warn(mod, node, f"{self.name}: msg params key "
+                               "read did not resolve")
+                    continue
+                required = self._READ_ATTRS[f] or (
+                    len(node.args) == 1 and not node.keywords)
+                k = str(key.value)
+                reads[k] = reads.get(k, False) or required
+            elif depth > 0 and isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                # one-level helper propagation: self.helper(..., msg, ...)
+                helper = self.methods.get(node.func.attr)
+                if helper is None:
+                    continue
+                hmod, hfn = helper
+                hparams = _fn_param_names(hfn)
+                for i, a in enumerate(node.args):
+                    if isinstance(a, ast.Name) and a.id == pname and \
+                            i < len(hparams):
+                        sub = self._reads_of(hmod, hfn, hparams[i],
+                                             depth - 1)
+                        for k, req in sub.items():
+                            reads[k] = reads.get(k, False) or req
+        return reads
+
+    # -- call graph / finish -----------------------------------------------
+    def extract_callgraph(self) -> Tuple[Dict[str, Set[str]],
+                                         Dict[str, bool]]:
+        direct: Dict[str, Set[str]] = {}
+        finishing: Dict[str, bool] = {}
+        for mname, (_mod, fn) in self.methods.items():
+            calls: Set[str] = set()
+            fin = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = last_attr(node.func)
+                if f == "finish":
+                    fin = True
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        node.func.attr in self.methods:
+                    calls.add(node.func.attr)
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in self.methods:
+                    calls.add(node.func.id)
+                # callback registration edges: threading.Timer(delay,
+                # self._cb) — the armed timeout path sends too
+                if f in ("Timer", "Thread"):
+                    cb = None
+                    if f == "Timer" and len(node.args) >= 2:
+                        cb = node.args[1]
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            cb = kw.value
+                    if isinstance(cb, ast.Attribute) and \
+                            isinstance(cb.value, ast.Name) and \
+                            cb.value.id == "self" and \
+                            cb.attr in self.methods:
+                        calls.add(cb.attr)
+            direct[mname] = calls
+            finishing[mname] = fin
+        closures: Dict[str, Set[str]] = {}
+        for mname in self.methods:
+            seen: Set[str] = set()
+            stack = [mname]
+            while stack:
+                cur = stack.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(direct.get(cur, ()))
+            closures[mname] = seen
+        return closures, finishing
+
+    def extract(self) -> ScopeProtocol:
+        closures, finishing = self.extract_callgraph()
+        return ScopeProtocol(
+            name=self.name, path=self.mod.path, line=self.node.lineno,
+            handlers=self.extract_handlers(), sends=self.extract_sends(),
+            closures=closures, finishing=finishing,
+            reads=self.extract_reads(), warnings=self.warnings)
+
+
+# --------------------------------------------------------------------------
+# family assembly
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FamilyProtocol:
+    name: str
+    config: Dict[str, Any]
+    #: role -> [ScopeProtocol] (family-filtered views share scope objects)
+    roles: Dict[str, List[ScopeProtocol]]
+    shared: List[ScopeProtocol]
+    warnings: List[Finding]
+
+    @property
+    def queue_style(self) -> bool:
+        return bool(self.config.get("queue_style"))
+
+    def source_ok(self, msg: MsgConst) -> bool:
+        if msg.is_connection_ready:
+            return True
+        norm = os.path.normpath(msg.source)
+        return any(norm.endswith(os.path.normpath(s))
+                   for s in self.config["sources"])
+
+    def counterpart(self, role: str) -> str:
+        if "peer" in self.roles:
+            return "peer"
+        return "client" if role == "server" else "server"
+
+    def dst_role(self, send: SendSite, sender_role: str) -> str:
+        if "peer" in self.roles:
+            return "peer"
+        if send.dst_is_server is True:
+            return "server"
+        if send.dst_is_server is False:
+            return "client"
+        return self.counterpart(sender_role)
+
+    # -- family-filtered views --------------------------------------------
+    def role_handlers(self, role: str) -> List[Tuple[ScopeProtocol,
+                                                     HandlerReg]]:
+        out = []
+        scopes = list(self.roles.get(role, ()))
+        for sp in scopes + self.shared:
+            for reg in sp.handlers:
+                if self.source_ok(reg.msg):
+                    out.append((sp, reg))
+        return out
+
+    def role_sends(self, role: str) -> List[Tuple[ScopeProtocol, SendSite]]:
+        out = []
+        for sp in self.roles.get(role, ()):
+            for s in sp.sends:
+                if self.source_ok(s.msg):
+                    out.append((sp, s))
+        return out
+
+
+def _scope_index(pkg: PackageView) -> Dict[Tuple[str, str],
+                                           Tuple[PModule, ast.AST]]:
+    """(name, normalized path) -> definition node, for classes at any
+    nesting depth plus top-level functions."""
+    out: Dict[Tuple[str, str], Tuple[PModule, ast.AST]] = {}
+    for mod in pkg.modules:
+        norm = os.path.normpath(mod.path)
+        for name, node in mod.class_defs.items():
+            out[(name, norm)] = (mod, node)
+        for name, node in mod.func_defs.items():
+            out.setdefault((name, norm), (mod, node))
+    return out
+
+
+def extract_protocols(paths: Iterable[str],
+                      families: Optional[Dict[str, Dict[str, Any]]] = None
+                      ) -> Tuple[Dict[str, FamilyProtocol], List[Finding]]:
+    """Index every .py under ``paths`` and assemble each protocol family's
+    extracted surface.  Returns ``(families, warnings)`` — warnings cover
+    unresolvable call sites and missing members."""
+    families = families if families is not None else PROTOCOL_FAMILIES
+    modules: List[PModule] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        mod = index_module(path, src)
+        if mod is not None:
+            modules.append(mod)
+    pkg = PackageView(modules)
+    scopes = _scope_index(pkg)
+
+    def find_scope(name: str, suffix: str):
+        suffix = os.path.normpath(suffix)
+        for (n, p), hit in scopes.items():
+            if n == name and p.endswith(suffix):
+                return hit
+        return None
+
+    out: Dict[str, FamilyProtocol] = {}
+    warnings: List[Finding] = []
+    extracted_cache: Dict[Tuple[str, str], ScopeProtocol] = {}
+
+    def extract_scope(name: str, suffix: str) -> Optional[ScopeProtocol]:
+        hit = find_scope(name, suffix)
+        if hit is None:
+            return None
+        mod, node = hit
+        key = (name, os.path.normpath(mod.path))
+        if key not in extracted_cache:
+            extracted_cache[key] = ScopeExtractor(pkg, mod, name,
+                                                  node).extract()
+        return extracted_cache[key]
+
+    for fname, cfg in families.items():
+        roles: Dict[str, List[ScopeProtocol]] = {}
+        fwarn: List[Finding] = []
+        any_member = False
+        for member, (role, suffix) in cfg["members"].items():
+            sp = extract_scope(member, suffix)
+            if sp is None:
+                continue
+            any_member = True
+            roles.setdefault(role, []).append(sp)
+            fwarn.extend(sp.warnings)
+        shared: List[ScopeProtocol] = []
+        for member, suffix in cfg.get("shared_members", {}).items():
+            sp = extract_scope(member, suffix)
+            if sp is not None:
+                shared.append(sp)
+                fwarn.extend(sp.warnings)
+        if not any_member:
+            continue  # family's modules not under the analyzed paths
+        missing = [m for m, (r, sfx) in cfg["members"].items()
+                   if extract_scope(m, sfx) is None]
+        for m in missing:
+            fwarn.append(Finding(
+                "unresolved-protocol",
+                PROTO_RULES["unresolved-protocol"].severity,
+                cfg["members"][m][1], 1, 0,
+                f"family {fname}: member {m} not found under the analyzed "
+                "paths"))
+        fam = FamilyProtocol(fname, cfg, roles, shared, fwarn)
+        warnings.extend(fwarn)
+        out[fname] = fam
+    # de-dup warnings (same scope shared by several families)
+    seen: Set[Tuple] = set()
+    deduped = []
+    for w in warnings:
+        if w.key() not in seen:
+            seen.add(w.key())
+            deduped.append(w)
+    return out, deduped
+
+
+# --------------------------------------------------------------------------
+# the four static check families
+# --------------------------------------------------------------------------
+
+def _mk(rule: str, path: str, line: int, msg: str) -> Finding:
+    return Finding(rule, PROTO_RULES[rule].severity, path, line, 0, msg)
+
+
+def check_coverage(fam: FamilyProtocol, out: List[Finding]):
+    for role in fam.roles:
+        handled_by: Dict[str, Set[str]] = {}
+        for r2 in fam.roles:
+            handled_by[r2] = {reg.msg.key
+                              for _sp, reg in fam.role_handlers(r2)}
+        for sp, send in fam.role_sends(role):
+            dst = fam.dst_role(send, role)
+            if send.msg.key not in handled_by.get(dst, set()):
+                out.append(_mk(
+                    "unhandled-send", send.path, send.line,
+                    f"[{fam.name}] {sp.name}.{send.method} sends "
+                    f"{send.msg.name or send.msg.key} (type "
+                    f"{send.msg.key}) to role '{dst}' which registers no "
+                    "handler for it — delivered messages are logged and "
+                    "dropped"))
+    # orphan handlers: registered types nobody in the family sends
+    sent_all = {s.msg.key for role in fam.roles
+                for _sp, s in fam.role_sends(role)}
+    for role in fam.roles:
+        for sp, reg in fam.role_handlers(role):
+            if reg.msg.is_connection_ready:
+                continue  # runtime-emitted on channel startup
+            if reg.msg.key not in sent_all:
+                out.append(_mk(
+                    "orphan-handler", reg.path, reg.line,
+                    f"[{fam.name}] {sp.name} registers "
+                    f"'{reg.handler}' for "
+                    f"{reg.msg.name or reg.msg.key} (type {reg.msg.key}) "
+                    "but no family member ever sends it"))
+
+
+def handler_required_reads(sp: ScopeProtocol, reg: HandlerReg
+                           ) -> Dict[str, bool]:
+    if reg.lambda_node is not None:
+        return {}
+    return sp.reads.get(reg.handler, {})
+
+
+def check_param_contract(fam: FamilyProtocol, out: List[Finding]):
+    if fam.queue_style:
+        return  # driver-loop reads aren't attributable per msg type
+    for role in fam.roles:
+        for sp, reg in fam.role_handlers(role):
+            reads = handler_required_reads(sp, reg)
+            required = {k for k, req in reads.items()
+                        if req and k not in IMPLICIT_KEYS
+                        and not k.startswith(CONTEXT_KEY_PREFIX)}
+            if not required:
+                continue
+            for r2 in fam.roles:
+                for sp2, send in fam.role_sends(r2):
+                    if send.msg.key != reg.msg.key:
+                        continue
+                    if fam.dst_role(send, r2) != role:
+                        continue
+                    if send.unresolved_params:
+                        continue  # can't prove the key set — skip site
+                    missing = sorted(required - set(send.params))
+                    for key in missing:
+                        out.append(_mk(
+                            "missing-param", send.path, send.line,
+                            f"[{fam.name}] handler {sp.name}."
+                            f"{reg.handler} requires params key "
+                            f"{key!r} of {send.msg.name or send.msg.key}, "
+                            f"but sender {sp2.name}.{send.method} never "
+                            "add_params-sets it — the read returns None"))
+
+
+def check_liveness(fam: FamilyProtocol, out: List[Finding]):
+    if fam.queue_style:
+        # bounded driver loops, not handler FSMs: liveness is the loop
+        # bound + the FINISH drain, checked by the runtime conformance pass
+        return
+    # nodes: (role, type); node data: handler regs
+    nodes: Dict[Tuple[str, str], List[Tuple[ScopeProtocol, HandlerReg]]] = {}
+    for role in fam.roles:
+        for sp, reg in fam.role_handlers(role):
+            nodes.setdefault((role, reg.msg.key), []).append((sp, reg))
+    edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {
+        n: set() for n in nodes}
+    entry_nodes: Set[Tuple[str, str]] = set()
+    finish_nodes: Set[Tuple[str, str]] = set()
+    for (role, key), regs in nodes.items():
+        for sp, reg in regs:
+            if reg.msg.is_connection_ready:
+                entry_nodes.add((role, key))
+            if sp.handler_finishes(reg):
+                finish_nodes.add((role, key))
+            for send in sp.handler_sends(reg):
+                if not fam.source_ok(send.msg):
+                    continue
+                dst = fam.dst_role(send, role)
+                tgt = (dst, send.msg.key)
+                if tgt in nodes:
+                    edges[(role, key)].add(tgt)
+    # entry sends: family-typed sends from (a) methods outside every
+    # handler closure — run(), __init__ — and (b) methods inside the
+    # closure of a handler registered for ANOTHER protocol plane (the
+    # cross_cloud bridge: the regional upload handler's round close sends
+    # the first global-plane partial)
+    for role in fam.roles:
+        handler_methods: Set[str] = set()
+        other_plane_methods: Set[str] = set()
+        for sp in fam.roles.get(role, []):
+            for reg in sp.handlers:
+                if reg.lambda_node is not None:
+                    continue
+                if fam.source_ok(reg.msg):
+                    handler_methods |= sp.closure_of(reg.handler)
+                else:
+                    other_plane_methods |= sp.closure_of(reg.handler)
+        for sp, send in fam.role_sends(role):
+            if send.method in handler_methods and \
+                    send.method not in other_plane_methods:
+                continue
+            tgt = (fam.dst_role(send, role), send.msg.key)
+            if tgt in nodes:
+                entry_nodes.add(tgt)
+
+    def reachable_from(starts: Set[Tuple[str, str]]) -> Set[Tuple[str, str]]:
+        seen: Set[Tuple[str, str]] = set()
+        stack = list(starts)
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(edges.get(cur, ()))
+        return seen
+
+    if not nodes:
+        return
+    anchor_sp = next(iter(fam.roles.values()))[0]
+    live = reachable_from(entry_nodes)
+    if not (live & finish_nodes):
+        out.append(_mk(
+            "no-finish-path", anchor_sp.path, anchor_sp.line,
+            f"[{fam.name}] no finish()-bearing handler is reachable from "
+            f"the protocol entry (entries: {sorted(entry_nodes)}; finish "
+            f"nodes: {sorted(finish_nodes)}) — the federation cannot "
+            "terminate cleanly"))
+    # cycle check: any node in a cycle that cannot reach a finish node
+    can_finish: Set[Tuple[str, str]] = set()
+    rev: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {n: set()
+                                                        for n in nodes}
+    for n, tgts in edges.items():
+        for t in tgts:
+            rev[t].add(n)
+    stack = list(finish_nodes)
+    while stack:
+        cur = stack.pop()
+        if cur in can_finish:
+            continue
+        can_finish.add(cur)
+        stack.extend(rev.get(cur, ()))
+    for n in sorted(nodes):
+        in_cycle = n in edges.get(n, set()) or any(
+            n in reachable_from({t}) for t in edges.get(n, ()))
+        if in_cycle and n not in can_finish:
+            sp, reg = nodes[n][0]
+            out.append(_mk(
+                "no-finish-path", reg.path, reg.line,
+                f"[{fam.name}] handler cycle through ({n[0]}, type "
+                f"{n[1]}, {sp.name}.{reg.handler}) has no exit edge to "
+                "any finish()-bearing handler — a hang once entered"))
+
+
+# --------------------------------------------------------------------------
+# manifest
+# --------------------------------------------------------------------------
+
+DEFAULT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "data", "fedproto",
+    "protocols.json")
+
+
+def family_to_manifest(fam: FamilyProtocol) -> Dict[str, Any]:
+    roles_out: Dict[str, List[str]] = {
+        role: sorted(sp.name for sp in sps)
+        for role, sps in fam.roles.items()}
+    handlers: Dict[str, Dict[str, str]] = {}
+    requires: Dict[str, Dict[str, List[str]]] = {}
+    finish_roles: List[str] = []
+    for role in sorted(fam.roles):
+        h: Dict[str, str] = {}
+        req: Dict[str, List[str]] = {}
+        fin = False
+        for sp, reg in fam.role_handlers(role):
+            h[reg.msg.key] = reg.handler
+            reads = handler_required_reads(sp, reg)
+            keys = sorted(k for k, r in reads.items()
+                          if r and k not in IMPLICIT_KEYS
+                          and not k.startswith(CONTEXT_KEY_PREFIX))
+            if keys:
+                req[reg.msg.key] = keys
+            fin = fin or sp.handler_finishes(reg)
+        handlers[role] = dict(sorted(h.items()))
+        if req:
+            requires[role] = dict(sorted(req.items()))
+        if fin:
+            finish_roles.append(role)
+    sends: Dict[str, Dict[str, Any]] = {}
+    for role in sorted(fam.roles):
+        srow: Dict[str, Any] = {}
+        for sp, s in fam.role_sends(role):
+            entry = srow.setdefault(s.msg.key, {
+                "dst": fam.dst_role(s, role), "name": s.msg.name,
+                "sites": []})
+            method = s.method if sp.name == s.method else \
+                f"{sp.name}.{s.method}"
+            site = {"method": method, "params": list(s.params)}
+            if site not in entry["sites"]:
+                entry["sites"].append(site)
+        for entry in srow.values():
+            entry["sites"].sort(key=lambda x: x["method"])
+        sends[role] = dict(sorted(srow.items()))
+    return {"roles": roles_out, "handlers": handlers, "sends": sends,
+            "requires": requires, "finish_roles": sorted(finish_roles),
+            "queue_style": fam.queue_style}
+
+
+def protocols_to_manifest(fams: Dict[str, FamilyProtocol]
+                          ) -> Dict[str, Any]:
+    return {"version": 1,
+            "families": {n: family_to_manifest(f)
+                         for n, f in sorted(fams.items())},
+            "suppressions": []}
+
+
+def load_manifest(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = path or DEFAULT_MANIFEST
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def update_manifest(fams: Dict[str, FamilyProtocol],
+                    path: Optional[str] = None) -> Dict[str, Any]:
+    """Write the extracted protocols, PRESERVING the policy half (the
+    suppressions list) of any existing manifest — the diff of the measured
+    half is the review surface (the fedverify pattern)."""
+    path = path or DEFAULT_MANIFEST
+    old = load_manifest(path)
+    fresh = protocols_to_manifest(fams)
+    if old is not None:
+        fresh["suppressions"] = old.get("suppressions", [])
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(fresh, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return fresh
+
+
+def _diff_paths(a: Any, b: Any, prefix: str = "") -> List[str]:
+    if isinstance(a, dict) and isinstance(b, dict):
+        out: List[str] = []
+        for k in sorted(set(a) | set(b)):
+            p = f"{prefix}.{k}" if prefix else str(k)
+            if k not in a:
+                out.append(f"+{p}")
+            elif k not in b:
+                out.append(f"-{p}")
+            else:
+                out.extend(_diff_paths(a[k], b[k], p))
+        return out
+    if a != b:
+        return [f"~{prefix}: {json.dumps(b)} -> {json.dumps(a)}"]
+    return []
+
+
+def check_manifest(fams: Dict[str, FamilyProtocol],
+                   manifest: Optional[Dict[str, Any]],
+                   out: List[Finding]):
+    if manifest is None:
+        for fam in fams.values():
+            sp = next(iter(fam.roles.values()))[0]
+            out.append(_mk("manifest-missing", sp.path, sp.line,
+                           f"[{fam.name}] no manifest pinned yet — run "
+                           "tools/fedproto.py --update-manifest"))
+        return
+    pinned = manifest.get("families", {})
+    for name, fam in fams.items():
+        sp = next(iter(fam.roles.values()))[0]
+        if name not in pinned:
+            out.append(_mk("manifest-missing", sp.path, sp.line,
+                           f"[{name}] family has no manifest entry — run "
+                           "tools/fedproto.py --update-manifest"))
+            continue
+        got = family_to_manifest(fam)
+        if got != pinned[name]:
+            diffs = _diff_paths(got, pinned[name])
+            shown = "; ".join(diffs[:6])
+            more = f" (+{len(diffs) - 6} more)" if len(diffs) > 6 else ""
+            out.append(_mk(
+                "manifest-drift", sp.path, sp.line,
+                f"[{name}] extracted protocol drifted from the pinned "
+                f"manifest: {shown}{more} — review and refresh with "
+                "--update-manifest"))
+
+
+# --------------------------------------------------------------------------
+# suppression + driver
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedproto:\s*(disable|disable-next-line)\s*=\s*"
+    r"([A-Za-z0-9_,\-]+|all)")
+
+
+def _line_suppressions(path: str) -> Dict[int, Set[str]]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}
+    supp: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        which, rules = m.groups()
+        names = {r.strip() for r in rules.split(",") if r.strip()}
+        target = i + 1 if which == "disable-next-line" else i
+        supp.setdefault(target, set()).update(names)
+    return supp
+
+
+_FAMILY_TAG_RE = re.compile(r"^\[([A-Za-z0-9_\-]+)\]")
+
+
+def apply_suppressions(findings: List[Finding],
+                       manifest: Optional[Dict[str, Any]]) -> List[Finding]:
+    """Source-comment suppressions by (path, line); manifest-level
+    ``{"family", "rule", "reason"}`` suppressions match the family tag
+    every fedproto message leads with."""
+    by_path: Dict[str, Dict[int, Set[str]]] = {}
+    man_sup = (manifest or {}).get("suppressions", [])
+    for f in findings:
+        if f.path not in by_path:
+            by_path[f.path] = _line_suppressions(f.path)
+        marked = by_path[f.path].get(f.line, set())
+        if "all" in marked or f.rule in marked:
+            f.suppressed = True
+            continue
+        m = _FAMILY_TAG_RE.match(f.message)
+        fam = m.group(1) if m else None
+        for sup in man_sup:
+            if sup.get("rule") == f.rule and \
+                    sup.get("family") in (fam, "*"):
+                f.suppressed = True
+                break
+    return findings
+
+
+def check_protocols(fams: Dict[str, FamilyProtocol],
+                    manifest: Optional[Dict[str, Any]] = None,
+                    warnings: Optional[List[Finding]] = None,
+                    rules: Optional[Set[str]] = None) -> List[Finding]:
+    out: List[Finding] = list(warnings or [])
+    for fam in fams.values():
+        check_coverage(fam, out)
+        check_param_contract(fam, out)
+        check_liveness(fam, out)
+    check_manifest(fams, manifest, out)
+    if rules is not None:
+        out = [f for f in out if f.rule in rules]
+    seen: Set[Tuple] = set()
+    deduped: List[Finding] = []
+    for f in sorted(out, key=lambda f: (f.path, f.line, f.rule,
+                                        f.message)):
+        k = (f.path, f.line, f.rule, f.message)
+        if k in seen:
+            continue
+        seen.add(k)
+        deduped.append(f)
+    return apply_suppressions(deduped, manifest)
+
+
+# --------------------------------------------------------------------------
+# runtime conformance — replay a fedscope capture against the protocol
+# --------------------------------------------------------------------------
+
+def _trace_events(trace: Any) -> List[dict]:
+    if isinstance(trace, dict):
+        return trace.get("traceEvents", [])
+    return list(trace)
+
+
+def check_trace(traces: Sequence[Any], family: str,
+                manifest: Optional[Dict[str, Any]] = None,
+                fams: Optional[Dict[str, FamilyProtocol]] = None
+                ) -> List[Finding]:
+    """Validate observed ``comm.send`` / ``comm.recv`` / ``comm.drop``
+    spans (one or more fedscope captures, raw or merged) against the
+    pinned protocol of ``family``.
+
+    The static pass proves the protocol CAN run; this proves a given run
+    DID follow it: every send delivered exactly once (matching by the
+    propagated span link, falling back to the stamped ``fedscope.msg_id``
+    so duplicated deliveries don't read as losses), every observed type
+    known to the protocol, every fault-injection drop surfaced."""
+    if manifest is not None:
+        entry = manifest.get("families", {}).get(family)
+    elif fams is not None and family in fams:
+        entry = family_to_manifest(fams[family])
+    else:
+        entry = None
+    if entry is None:
+        return [_mk("manifest-missing", f"<trace:{family}>", 1,
+                    f"[{family}] no pinned protocol to replay the trace "
+                    "against — run --update-manifest first")]
+    known_handled: Set[str] = set()
+    for row in entry.get("handlers", {}).values():
+        known_handled |= set(row)
+    known_sent: Set[str] = set()
+    for row in entry.get("sends", {}).values():
+        known_sent |= set(row)
+
+    sends: List[dict] = []
+    recvs: List[dict] = []
+    drops: List[dict] = []
+    for trace in traces:
+        for e in _trace_events(trace):
+            if e.get("ph") != "B":
+                continue
+            args = e.get("args") or {}
+            rec = {"span_id": args.get("span_id"),
+                   "parent_span": args.get("parent_span"),
+                   "msg_type": args.get("msg_type"),
+                   "msg_id": args.get("msg_id"),
+                   "ts": e.get("ts", 0.0)}
+            if e.get("name") == "comm.send":
+                sends.append(rec)
+            elif e.get("name") == "comm.recv":
+                recvs.append(rec)
+            elif e.get("name") == "comm.drop":
+                drops.append(rec)
+
+    out: List[Finding] = []
+    tpath = f"<trace:{family}>"
+
+    def maybe_type(rec) -> Optional[str]:
+        t = rec.get("msg_type")
+        return str(t) if t is not None else None
+
+    # unknown types
+    for rec in recvs:
+        t = maybe_type(rec)
+        if t is not None and t not in known_handled:
+            out.append(_mk(
+                "trace-unknown-type", tpath, 1,
+                f"[{family}] observed comm.recv of msg_type {t} which the "
+                "pinned protocol registers no handler for"))
+    for rec in sends:
+        t = maybe_type(rec)
+        if t is not None and t not in known_sent:
+            out.append(_mk(
+                "trace-unknown-type", tpath, 1,
+                f"[{family}] observed comm.send of msg_type {t} which the "
+                "pinned protocol never sends"))
+    # delivery: every send matched by span link or msg_id
+    recv_parents = {r["parent_span"] for r in recvs
+                    if r.get("parent_span")}
+    recv_msg_ids = [r["msg_id"] for r in recvs if r.get("msg_id")]
+    recv_id_set = set(recv_msg_ids)
+    for rec in sends:
+        delivered = (rec.get("span_id") in recv_parents or
+                     (rec.get("msg_id") and rec["msg_id"] in recv_id_set))
+        if not delivered:
+            t = maybe_type(rec) or "?"
+            out.append(_mk(
+                "trace-message-loss", tpath, 1,
+                f"[{family}] comm.send of msg_type {t} (span "
+                f"{rec.get('span_id')}) has no matching comm.recv on any "
+                "captured process — lost in transit or delivered to a "
+                "rank with no handler"))
+    # duplicates: one msg_id, >1 recv
+    counts: Dict[str, int] = {}
+    for mid in recv_msg_ids:
+        counts[mid] = counts.get(mid, 0) + 1
+    dup_types = {}
+    for rec in recvs:
+        mid = rec.get("msg_id")
+        if mid and counts.get(mid, 0) > 1:
+            dup_types.setdefault(mid, maybe_type(rec))
+    for mid, t in sorted(dup_types.items()):
+        out.append(_mk(
+            "trace-duplicate-delivery", tpath, 1,
+            f"[{family}] message {mid} (msg_type {t}) was delivered "
+            f"{counts[mid]} times — re-delivery the FSM must tolerate"))
+    # observed fault-injection drops
+    for rec in drops:
+        t = maybe_type(rec) or "?"
+        out.append(_mk(
+            "trace-observed-drop", tpath, 1,
+            f"[{family}] fault injection dropped a message of msg_type "
+            f"{t} (msg {rec.get('msg_id')}) — never delivered"))
+    return apply_suppressions(out, manifest)

@@ -254,6 +254,7 @@ def diagnosis(check_backend: bool = True) -> Dict[str, Any]:
                 def receive_message(self, t, m):
                     got["msg"] = t
             m0.add_observer(_Obs())
+            # fedlint: disable-next-line=raw-msg-type -- loopback echo probe, not a protocol message
             msg = Message(42, 0, 0)
             m0.send_message(msg)
             m0._dispatch(m0._q.get(timeout=5))

@@ -74,6 +74,18 @@ class FaultInjectingCommManager(BaseCommunicationManager):
             log.info("chaos: DROPPING msg type=%s %s->%s",
                      msg.get_type(), msg.get_sender_id(),
                      msg.get_receiver_id())
+            # surface the drop on the trace plane: a dropped message never
+            # reaches the backend, so no comm.send span exists — without
+            # this marker the loss is invisible to `fedproto check-trace`
+            from ....obs import context as obs_context
+            from ....obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                with tracer.span("comm.drop", cat="comm",
+                                 msg_type=str(msg.get_type()),
+                                 dst=msg.get_receiver_id(),
+                                 msg_id=msg.get(obs_context.KEY_MSG_ID)):
+                    pass
             return
         copies = 1
         if p_dup < self.dup_prob:

@@ -43,6 +43,8 @@ class FileStoreCommManager(BaseCommunicationManager):
         name = f"{time.time_ns()}_{self._seq:06d}_{msg.get_sender_id()}_to_{msg.get_receiver_id()}"
         span = tracer.span("comm.send", cat="comm", backend="filestore",
                            dst=msg.get_receiver_id(), tier=tier,
+                           msg_type=str(msg.get_type()),
+                           msg_id=msg.get(obs_context.KEY_MSG_ID),
                            round=msg.get("round_idx"))
         with span:
             obs_context.inject(msg.get_params(), tracer)

@@ -105,6 +105,8 @@ class GRPCCommManager(BaseCommunicationManager):
         # blob carries the span's own id as the receiver's parent.
         span = tracer.span("comm.send", cat="comm", backend="grpc",
                            dst=msg.get_receiver_id(), tier=tier,
+                           msg_type=str(msg.get_type()),
+                           msg_id=msg.get(obs_context.KEY_MSG_ID),
                            round=msg.get("round_idx"))
         with span:
             obs_context.inject(msg.get_params(), tracer)

@@ -52,6 +52,8 @@ class LocalCommManager(BaseCommunicationManager):
             nbytes = tree_nbytes(list(msg.get_params().values()))
         span = tracer.span("comm.send", cat="comm", backend="local",
                            dst=receiver, tier=tier, nbytes=nbytes,
+                           msg_type=str(msg.get_type()),
+                           msg_id=msg.get(obs_context.KEY_MSG_ID),
                            round=msg.get("round_idx"))
         with span:
             obs_context.inject(msg.get_params(), tracer)

@@ -88,6 +88,20 @@ class Message:
     def get(self, key: str, default=None):
         return self.msg_params.get(key, default)
 
+    def require(self, key: str):
+        """Read a REQUIRED protocol param.  A missing key raises a
+        ``KeyError`` naming the msg_type and sender instead of handing the
+        caller a silent ``None`` that detonates frames later — the runtime
+        twin of fedproto's static ``missing-param`` contract
+        (``docs/FEDPROTO.md``); fedproto counts ``require()`` reads as
+        required when checking senders."""
+        if key not in self.msg_params:
+            raise KeyError(
+                f"message type {self.get_type()} from sender "
+                f"{self.msg_params.get(MSG_ARG_KEY_SENDER)} is missing "
+                f"required param {key!r} — no sender add_params-set it")
+        return self.msg_params[key]
+
     def __repr__(self):
         keys = {k: type(v).__name__ for k, v in self.msg_params.items()}
         return f"Message({keys})"

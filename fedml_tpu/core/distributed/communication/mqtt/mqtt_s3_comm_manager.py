@@ -102,6 +102,8 @@ class MqttS3CommManager(BaseCommunicationManager):
         # links back here even though the tensor payload detours via blobs
         span = tracer.span("comm.send", cat="comm", backend="mqtt",
                            dst=msg.get_receiver_id(), tier=tier,
+                           msg_type=str(msg.get_type()),
+                           msg_id=msg.get(obs_context.KEY_MSG_ID),
                            round=msg.get("round_idx"))
         nbytes = 0
         with span:

@@ -84,6 +84,7 @@ class FedMLCommManager(Observer):
         tier = obs_context.comm_tier(src, dst)
         kw = {"backend": self.backend, "src": src, "tier": tier,
               "msg_type": str(msg_type),
+              "msg_id": msg_params.get(obs_context.KEY_MSG_ID),
               "round": msg_params.get(MSG_KEY_ROUND_IDX)}
         if ctx is not None:
             kw.update(parent_span=ctx["span_id"],
@@ -96,6 +97,14 @@ class FedMLCommManager(Observer):
                          tree_nbytes(list(msg_params.get_params().values())))
 
     def send_message(self, message: Message):
+        tracer = get_tracer()
+        if tracer.enabled and \
+                obs_context.KEY_MSG_ID not in message.get_params():
+            # stamped ABOVE the backend (and above chaos fault injection)
+            # so duplicated deliveries of one logical send share the id —
+            # fedproto check-trace's duplicate/loss matching key
+            message.add_params(obs_context.KEY_MSG_ID,
+                               obs_context.new_span_id())
         self.com_manager.send_message(message)
 
     def register_message_receive_handler(self, msg_type,

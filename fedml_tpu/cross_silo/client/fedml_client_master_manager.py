@@ -54,8 +54,10 @@ class ClientMasterManager(FedMLCommManager):
         self.send_message(msg)
 
     def _train_and_send(self, msg_params):
-        params = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
-        data_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        # require(): a model sync missing its payload raises a KeyError
+        # naming the msg_type and sender instead of training on None
+        params = msg_params.require(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        data_idx = int(msg_params.require(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0))
         log_training_status("TRAINING")
         self.trainer_adapter.announce_round(round_idx, params, data_idx)

@@ -64,6 +64,9 @@ class LSAClientManager(FedMLCommManager):
 
     # -- round body --------------------------------------------------------
     def _handle_init(self, msg: Message):
+        # adopt the server's round index on init too (it broadcasts it on
+        # both paths) so the round-bound upload always matches
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or 0)
         params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         self._round(params)
 
@@ -97,6 +100,9 @@ class LSAClientManager(FedMLCommManager):
         m = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         m.add_params(MyMessage.MSG_ARG_KEY_MASKED_PARAMS, masked)
         m.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, num_samples)
+        # round-bind the masked upload like the aggregate-share path: the
+        # mask z_i is per-round, so a stale upload can never be unmasked
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
         self.send_message(m)
 
     def _handle_encoded_mask(self, msg: Message):

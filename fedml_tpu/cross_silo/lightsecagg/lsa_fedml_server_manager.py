@@ -79,6 +79,11 @@ class LSAServerManager(FedMLCommManager):
 
     # -- aggregation -------------------------------------------------------
     def _handle_model(self, msg: Message):
+        # same stale-round guard the aggregate-share path has: a delayed
+        # round-r masked upload carries round r's z_i mask and can never
+        # be unmasked by round r+1's decoded mask sum
+        if int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or 0) != self.round_idx:
+            return
         sender = msg.get_sender_id()
         self._masked[sender] = np.asarray(
             msg.get(MyMessage.MSG_ARG_KEY_MASKED_PARAMS), dtype=np.int64)

@@ -123,6 +123,10 @@ class SAClientManager(FedMLCommManager):
         m = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         m.add_params(MyMessage.MSG_ARG_KEY_MASKED_PARAMS, y)
         m.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, num_samples)
+        # round-bind the masked upload like the reveal path does: a
+        # chaos-delayed/duplicated round-r upload must not land in round
+        # r+1's sum (fedproto surfaced the asymmetry vs _handle_reveal)
+        m.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
         self.send_message(m)
 
     def _handle_ss_others(self, msg: Message):

@@ -87,6 +87,11 @@ class SAServerManager(FedMLCommManager):
 
     # -- masked uploads ----------------------------------------------------
     def _handle_model(self, msg: Message):
+        # same stale-round guard the reveal path has: pairwise masks only
+        # cancel within ONE round's cohort — a delayed round-r upload
+        # summed into round r+1 can never be unmasked
+        if int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) or 0) != self.round_idx:
+            return
         self._masked[msg.get_sender_id()] = np.asarray(
             msg.get(MyMessage.MSG_ARG_KEY_MASKED_PARAMS), dtype=np.int64)
         self._weights[msg.get_sender_id()] = float(

@@ -172,8 +172,10 @@ class FedMLServerManager(FedMLCommManager):
 
     def handle_message_receive_model_from_client(self, msg_params):
         sender = msg_params.get_sender_id()
-        raw = msg_params.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
-        n = msg_params.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        # require(): a malformed upload fails HERE naming msg_type+sender
+        # instead of propagating None into decompress/aggregate
+        raw = msg_params.require(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        n = msg_params.require(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
         # stale-check + base snapshot under the lock, but run the (per-leaf
         # scatter/reshape) decompression OUTSIDE it so concurrent uploads
         # don't serialize and the timeout handler isn't blocked

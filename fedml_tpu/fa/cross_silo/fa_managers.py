@@ -109,6 +109,16 @@ class FACrossSiloServer(FedMLCommManager):
 
     def _handle_submission(self, msg_params):
         sender = int(msg_params.get(Message.MSG_ARG_KEY_SENDER))
+        # round-bind submissions (fedproto: the training FSMs all guard
+        # staleness, this one didn't): a duplicated or delayed round-r
+        # submission must not count toward — or overwrite data in —
+        # round r+1's quorum
+        msg_round = msg_params.get(FAMessage.ARG_ROUND)
+        if msg_round is not None and int(msg_round) != self.round_idx:
+            log.warning("fa server: dropping stale round-%s submission "
+                        "from client %d (now at round %d)", msg_round,
+                        sender, self.round_idx)
+            return
         self._submissions[sender] = (
             float(msg_params.get(FAMessage.ARG_SAMPLE_NUM, 1.0)),
             msg_params.get(FAMessage.ARG_SUBMISSION))
@@ -156,6 +166,10 @@ class FACrossSiloClient(FedMLCommManager):
         msg.add_params(FAMessage.ARG_SUBMISSION,
                        self.analyzer.get_client_submission())
         msg.add_params(FAMessage.ARG_SAMPLE_NUM, float(len(self.train_data)))
+        # echo the round we are answering so the server can drop stale
+        # or duplicated submissions
+        msg.add_params(FAMessage.ARG_ROUND,
+                       int(msg_params.get(FAMessage.ARG_ROUND, 0)))
         self.send_message(msg)
 
     def _handle_finish(self, msg_params):

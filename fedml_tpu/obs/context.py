@@ -34,6 +34,11 @@ from typing import Any, Dict, Mapping, Optional
 KEY_TRACEPARENT = "fedscope.traceparent"
 KEY_HOST = "fedscope.host"
 KEY_PID = "fedscope.pid"
+#: one id per LOGICAL message, stamped by FedMLCommManager.send_message
+#: ABOVE the backend (and above fault injection), so every duplicated
+#: delivery of one send carries the same id — ``fedproto check-trace``
+#: matches sends to recvs through it and flags re-deliveries
+KEY_MSG_ID = "fedscope.msg_id"
 
 _TRACEPARENT_RE = re.compile(
     r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")

@@ -1,0 +1,27 @@
+"""Fixture: msg-FSM call sites keyed on raw literals instead of
+MyMessage-family constants (docs/FEDPROTO.md)."""
+from somewhere import Message
+
+
+class MyMessage:
+    MSG_TYPE_S2C_INIT = 1
+    MSG_ARG_KEY_MODEL = "model_params"
+
+
+class BadManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(1, self.handle_init)
+        self.register_message_receive_handler("flowish", self.handle_flow)
+
+    def send_init(self):
+        msg = Message(1, 0, 1)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL, {})
+        self.send_message(msg)
+        # fedlint: disable-next-line=raw-msg-type -- fixture: suppressed form
+        self.send_message(Message(7, 0, 1))
+
+    def handle_init(self, msg):
+        pass
+
+    def handle_flow(self, msg):
+        pass

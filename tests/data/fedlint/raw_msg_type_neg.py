@@ -1,0 +1,27 @@
+"""Negative fixture: every msg-FSM call site keyed on a named constant —
+zero raw-msg-type findings expected."""
+from somewhere import Message
+
+MSG_TYPE_P2P = 601
+
+
+class MyMessage:
+    MSG_TYPE_S2C_INIT = 1
+
+
+class GoodManager:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT, self.handle_init)
+        self.register_message_receive_handler(MSG_TYPE_P2P, self.handle_p2p)
+
+    def send_init(self, mtype):
+        self.send_message(Message(MyMessage.MSG_TYPE_S2C_INIT, 0, 1))
+        self.send_message(Message(mtype, 0, 1))   # parametric is fine
+        self.send_message(Message(MSG_TYPE_P2P, 0, 1))
+
+    def handle_init(self, msg):
+        pass
+
+    def handle_p2p(self, msg):
+        pass

@@ -1,0 +1,62 @@
+"""Golden mini protocol family for the fedproto mutation tests.
+
+A clean two-role FSM exercising every construct the extractor models:
+constant-keyed registrations, a request/response cycle with a finish exit
+edge, a parametric broadcast helper, required vs optional reads, and
+``finish()`` reachability.  ``tests/test_fedproto.py`` text-mutates single
+lines of this file (delete a handler / drop an add_params / cut the finish
+edge) and asserts the matching check family MUST fail.
+"""
+
+
+class MiniMsg:
+    MSG_TYPE_S2C_WORK = 1
+    MSG_TYPE_C2S_RESULT = 2
+    MSG_TYPE_S2C_FINISH = 3
+    ARG_PAYLOAD = "payload"
+    ARG_WEIGHT = "weight"
+    ARG_ROUND = "round_idx"
+
+
+class MiniServer:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MiniMsg.MSG_TYPE_C2S_RESULT, self._on_result)
+
+    def run(self):
+        self._broadcast(MiniMsg.MSG_TYPE_S2C_WORK)
+
+    def _broadcast(self, mtype):
+        msg = Message(mtype, 0, 1)
+        msg.add_params(MiniMsg.ARG_PAYLOAD, {})
+        msg.add_params(MiniMsg.ARG_ROUND, self.round_idx)
+        self.send_message(msg)
+
+    def _on_result(self, msg):
+        weight = msg.get(MiniMsg.ARG_WEIGHT)
+        payload = msg.get(MiniMsg.ARG_PAYLOAD)
+        self.round_idx += 1
+        if self.round_idx >= self.rounds:
+            self.send_message(Message(MiniMsg.MSG_TYPE_S2C_FINISH, 0, 1))
+            self.finish()
+        else:
+            self._broadcast(MiniMsg.MSG_TYPE_S2C_WORK)
+
+
+class MiniClient:
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MiniMsg.MSG_TYPE_S2C_WORK, self._on_work)
+        self.register_message_receive_handler(
+            MiniMsg.MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_work(self, msg):
+        payload = msg.get(MiniMsg.ARG_PAYLOAD)
+        rnd = msg.get(MiniMsg.ARG_ROUND, 0)
+        out = Message(MiniMsg.MSG_TYPE_C2S_RESULT, 1, 0)
+        out.add_params(MiniMsg.ARG_PAYLOAD, payload)
+        out.add_params(MiniMsg.ARG_WEIGHT, 1.0)
+        self.send_message(out)
+
+    def _on_finish(self, msg):
+        self.finish()

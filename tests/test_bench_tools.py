@@ -371,3 +371,53 @@ def test_serve_quick_filter_keeps_kvint8_and_a_headline_row():
     headline_eligible = [n for n in kept
                          if n.startswith("batched") and "int8" not in n]
     assert headline_eligible  # main()'s max() never sees an empty dict
+
+
+def test_fedproto_cli_smoke(tmp_path):
+    """FEDML_PROTO_QUICK smoke (ISSUE 12): the fedproto CLI contract —
+    `check --json` exits 0 with every family extracted, an
+    `--update-manifest` round-trip to a fresh path reproduces the
+    committed pin byte-for-byte, a tampered manifest exits 1, and bad
+    usage exits 2.  Pure stdlib (no jax import in the CLI)."""
+    import subprocess
+
+    cli = os.path.join(REPO, "tools", "fedproto.py")
+
+    r = subprocess.run([sys.executable, cli, "check", "--json"], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert [f for f in payload["findings"] if not f["suppressed"]] == []
+    committed = json.load(open(os.path.join(
+        REPO, "tests", "data", "fedproto", "protocols.json")))
+    assert set(payload["families"]) == set(committed["families"])
+
+    # --update-manifest round-trip: fresh pin == committed pin
+    fresh = str(tmp_path / "protocols.json")
+    r = subprocess.run([sys.executable, cli, "check", "--manifest", fresh,
+                        "--update-manifest"], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = json.load(open(fresh))
+    assert got["families"] == committed["families"]
+
+    # tampered pin = reviewed-diff failure (exit 1, manifest-drift named)
+    got["families"]["secagg"]["handlers"]["server"].pop("7")
+    with open(fresh, "w") as fh:
+        json.dump(got, fh)
+    r = subprocess.run([sys.executable, cli, "check", "--manifest", fresh],
+                       cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1 and "manifest-drift" in r.stdout
+
+    # usage errors exit 2
+    r = subprocess.run([sys.executable, cli], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 2
+    r = subprocess.run([sys.executable, cli, "check", "--families",
+                        "no-such-family"], cwd=REPO, capture_output=True,
+                       text=True)
+    assert r.returncode == 2
+    r = subprocess.run([sys.executable, cli, "check-trace",
+                        str(tmp_path / "missing.json")], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 2

@@ -1,0 +1,495 @@
+"""fedproto — the enforced message-FSM protocol gate (ISSUE 12).
+
+Four layers:
+
+1. extraction units — the real package's extracted surface contains the
+   constructs the extractor must model (parametric broadcasts, loop
+   registrations, observer dispatch, inherited handlers, require() reads);
+2. the tier-1 GATE — every protocol family extracts, checks clean against
+   the manifest pinned in ``tests/data/fedproto/protocols.json``, with
+   zero unsuppressed findings (the fedlint/fedverify pattern);
+3. mutation tests — each static check family MUST fail when its invariant
+   is broken in the golden mini family (delete a handler / drop an
+   add_params / cut the finish edge), and check-trace MUST reject a
+   tampered trace (type flip, deleted recv, duplicate, observed drop);
+4. runtime conformance — a REAL run over the local backend with seeded
+   fault injection produces traces check-trace classifies (drop →
+   observed-drop, duplicate → flagged re-delivery, delay → clean), plus
+   the ``Message.require()`` hardening contract.
+"""
+
+import json
+import os
+import threading
+import types
+
+import pytest
+
+from fedml_tpu import obs
+from fedml_tpu.analysis import fedproto as fp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fedml_tpu")
+FIXDIR = os.path.join(REPO, "tests", "data", "fedproto")
+MINI_FIXTURE = os.path.join(FIXDIR, "mini_family.py")
+
+MINI_FAMILY = {
+    "mini": {
+        "members": {"MiniServer": ("server", "mini_family.py"),
+                    "MiniClient": ("client", "mini_family.py")},
+        "sources": ("mini_family.py",),
+    }
+}
+
+
+def _errors(findings):
+    return [f for f in findings if not f.suppressed
+            and f.severity == fp.ERROR]
+
+
+def _rules(findings, unsuppressed_only=True):
+    return sorted({f.rule for f in findings
+                   if not (unsuppressed_only and f.suppressed)})
+
+
+# -- 1. extraction units (over the real package) ----------------------------
+
+@pytest.fixture(scope="module")
+def extracted():
+    fams, warnings = fp.extract_protocols([PKG])
+    return fams, warnings
+
+
+def test_every_family_extracts(extracted):
+    fams, _ = extracted
+    assert set(fams) == set(fp.PROTOCOL_FAMILIES)
+    for fam in fams.values():
+        # every family really has handlers AND sends on some role —
+        # the checks must never pass vacuously
+        assert any(fam.role_handlers(r) for r in fam.roles), fam.name
+        assert any(fam.role_sends(r) for r in fam.roles), fam.name
+
+
+def test_parametric_broadcast_resolves(extracted):
+    """The _broadcast(msg_type)/_dispatch(rank, mtype) idiom: the send's
+    type resolves at the helper's call sites."""
+    fams, _ = extracted
+    m = fp.family_to_manifest(fams["cross_silo_async"])
+    assert set(m["sends"]["server"]) == {"1", "2", "7"}
+    # parametric sends are attributed to their CALLER (_on_status fans
+    # out INIT through _dispatch), so FSM edges see the real context
+    assert m["sends"]["server"]["1"]["sites"][0]["method"] == \
+        "AsyncFedMLServerManager._on_status"
+    # secagg: one helper serves INIT and SYNC with identical params
+    sa = fp.family_to_manifest(fams["secagg"])
+    for t in ("1", "2"):
+        assert sa["sends"]["server"][t]["sites"][0]["params"] == \
+            ["model_params", "round_idx"]
+
+
+def test_loop_registration_and_queue_family(extracted):
+    """store_hierarchy: the endpoint registers one handler per type from
+    a ``for t in (...)`` loop; both driver roles share them."""
+    fams, _ = extracted
+    m = fp.family_to_manifest(fams["store_hierarchy"])
+    assert m["queue_style"] is True
+    for role in ("server", "client"):
+        assert set(m["handlers"][role]) == {"601", "602", "603"}
+    assert set(m["sends"]["client"]["601"]["sites"][0]["params"]) >= \
+        {"partial", "round_idx", "silo", "silo_w", "loss_w"}
+
+
+def test_observer_dispatch_and_inheritance(extracted):
+    """cross_cloud: the bridge's global-plane handlers live in a nested
+    observer class (==-dispatch), its regional plane inherits the
+    cross-silo server's handlers with the overridden round close."""
+    fams, _ = extracted
+    g = fp.family_to_manifest(fams["cross_cloud_global"])
+    assert g["handlers"]["client"] == {"502": "_on_global_sync",
+                                      "503": "_on_global_sync"}
+    assert set(g["sends"]["server"]) == {"502", "503"}
+    b = fp.family_to_manifest(fams["cross_silo_bridge"])
+    assert b["handlers"]["server"]["3"] == \
+        "handle_message_receive_model_from_client"
+    assert b["sends"]["server"]["2"]["sites"][0]["method"] == \
+        "CloudBridgeManager._on_global_sync"
+    assert b["finish_roles"] == ["client", "server"]
+
+
+def test_round_binding_required_after_sweep_fixes(extracted):
+    """The sweep's true positives stay fixed: masked uploads (secagg /
+    lightsecagg) and FA submissions are round-bound — the handler
+    REQUIRES round_idx and every sender sets it."""
+    fams, _ = extracted
+    sa = fp.family_to_manifest(fams["secagg"])
+    assert "round_idx" in sa["requires"]["server"]["7"]
+    assert "round_idx" in sa["sends"]["client"]["7"]["sites"][0]["params"]
+    lsa = fp.family_to_manifest(fams["lightsecagg"])
+    assert "round_idx" in lsa["requires"]["server"]["6"]
+    assert "round_idx" in lsa["sends"]["client"]["6"]["sites"][0]["params"]
+    fa = fp.family_to_manifest(fams["fa_cross_silo"])
+    assert "fa_round_idx" in fa["requires"]["server"]["102"]
+    assert "fa_round_idx" in \
+        fa["sends"]["client"]["102"]["sites"][0]["params"]
+
+
+def test_require_reads_count_as_required(extracted):
+    """Message.require() hardening is visible to the static contract."""
+    fams, _ = extracted
+    cs = fp.family_to_manifest(fams["cross_silo"])
+    assert set(cs["requires"]["server"]["3"]) >= \
+        {"model_params", "num_samples"}
+    assert set(cs["requires"]["client"]["1"]) >= \
+        {"model_params", "client_idx"}
+
+
+# -- 2. the tier-1 gate -----------------------------------------------------
+
+def test_package_protocol_gate(extracted):
+    """The enforced gate (ISSUE 12 acceptance): every manager family's
+    protocol extracts and checks clean — coverage, param contracts,
+    liveness, manifest pin — with zero unsuppressed findings."""
+    fams, warnings = extracted
+    manifest = fp.load_manifest()
+    assert manifest is not None, "protocols.json missing"
+    findings = fp.check_protocols(fams, manifest, warnings)
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n" + fp.render_findings(findings,
+                                                   tool="fedproto")
+    assert fp.exit_code(findings) == 0
+
+
+def test_manifest_pins_every_family(extracted):
+    manifest = fp.load_manifest()
+    assert set(manifest["families"]) == set(fp.PROTOCOL_FAMILIES)
+    for name, entry in manifest["families"].items():
+        assert entry["handlers"], name
+        assert entry["sends"], name
+
+
+# -- 3. mutation tests ------------------------------------------------------
+
+def _mini_check(tmp_path, mutate=None, manifest="self"):
+    src = open(MINI_FIXTURE).read()
+    if mutate:
+        old, new = mutate
+        assert old in src, f"mutation anchor missing: {old!r}"
+        src = src.replace(old, new)
+    p = tmp_path / "mini_family.py"
+    p.write_text(src)
+    fams, warnings = fp.extract_protocols([str(tmp_path)], MINI_FAMILY)
+    assert "mini" in fams
+    if manifest == "self":
+        manifest = {"families": {"mini": fp.family_to_manifest(
+            fams["mini"])}, "suppressions": []}
+    return fp.check_protocols(fams, manifest, warnings)
+
+
+def test_mini_family_clean(tmp_path):
+    assert _mini_check(tmp_path) == []
+
+
+def test_mutant_deleted_handler_fails(tmp_path):
+    fs = _mini_check(tmp_path, mutate=(
+        "        self.register_message_receive_handler(\n"
+        "            MiniMsg.MSG_TYPE_S2C_WORK, self._on_work)\n", ""))
+    assert "unhandled-send" in _rules(fs)
+    assert fp.exit_code(fs) == 1
+
+
+def test_mutant_dropped_add_params_fails(tmp_path):
+    fs = _mini_check(tmp_path, mutate=(
+        "        out.add_params(MiniMsg.ARG_WEIGHT, 1.0)\n", ""))
+    assert "missing-param" in _rules(fs)
+    [f] = [f for f in fs if f.rule == "missing-param"]
+    assert "weight" in f.message and "_on_result" in f.message
+
+
+def test_mutant_cut_finish_edge_fails(tmp_path):
+    fs = _mini_check(tmp_path, mutate=(
+        "            self.send_message(Message(MiniMsg.MSG_TYPE_S2C_FINISH"
+        ", 0, 1))\n            self.finish()",
+        "            self._broadcast(MiniMsg.MSG_TYPE_S2C_WORK)"))
+    assert "no-finish-path" in _rules(fs)
+    msgs = [f.message for f in fs if f.rule == "no-finish-path"]
+    assert any("cycle" in m for m in msgs)
+
+
+def test_mutant_deleted_send_orphans_handler(tmp_path):
+    fs = _mini_check(tmp_path, mutate=(
+        "        self.send_message(out)\n", ""))
+    assert "orphan-handler" in _rules(fs)
+
+
+def test_mutant_drifts_from_pinned_manifest(tmp_path):
+    """Any protocol mutation against the CLEAN pin is a reviewed diff."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    clean = _mini_check(tmp_path / "a")  # builds the clean extraction
+    assert clean == []
+    fams, _ = fp.extract_protocols([str(tmp_path / "a")], MINI_FAMILY)
+    pinned = {"families": {"mini": fp.family_to_manifest(fams["mini"])},
+              "suppressions": []}
+    fs = _mini_check(tmp_path / "b", mutate=(
+        "        out.add_params(MiniMsg.ARG_WEIGHT, 1.0)\n", ""),
+        manifest=pinned)
+    assert "manifest-drift" in _rules(fs)
+
+
+def test_fedproto_suppression_forms(tmp_path):
+    src = open(MINI_FIXTURE).read().replace(
+        "        out.add_params(MiniMsg.ARG_WEIGHT, 1.0)\n", "")
+    # suppress the missing-param finding at the send site — findings
+    # anchor at the Message construction line, where the param set lives
+    src = src.replace(
+        "        out = Message(MiniMsg.MSG_TYPE_C2S_RESULT, 1, 0)",
+        "        out = Message(MiniMsg.MSG_TYPE_C2S_RESULT, 1, 0)  "
+        "# fedproto: disable=missing-param -- fixture: tolerated")
+    (tmp_path / "mini_family.py").write_text(src)
+    fams, warnings = fp.extract_protocols([str(tmp_path)], MINI_FAMILY)
+    manifest = {"families": {"mini": fp.family_to_manifest(fams["mini"])},
+                "suppressions": []}
+    fs = fp.check_protocols(fams, manifest, warnings)
+    sup = [f for f in fs if f.rule == "missing-param"]
+    assert sup and all(f.suppressed for f in sup)
+    assert fp.exit_code(fs) == 0
+    # manifest-level suppression knocks out family-level rules
+    fs2 = fp.check_protocols(fams, {
+        "families": {}, "suppressions": [
+            {"family": "mini", "rule": "manifest-missing",
+             "reason": "fixture"}]}, [])
+    assert all(f.suppressed for f in fs2
+               if f.rule == "manifest-missing")
+
+
+def test_update_manifest_preserves_suppressions(tmp_path, extracted):
+    fams, _ = extracted
+    path = str(tmp_path / "protocols.json")
+    fp.update_manifest(fams, path)
+    m = fp.load_manifest(path)
+    m["suppressions"] = [{"family": "secagg", "rule": "manifest-drift",
+                          "reason": "test"}]
+    with open(path, "w") as fh:
+        json.dump(m, fh)
+    fp.update_manifest(fams, path)
+    m2 = fp.load_manifest(path)
+    assert m2["suppressions"] == m["suppressions"]
+    assert m2["families"] == m["families"]
+
+
+# -- check-trace: synthetic traces ------------------------------------------
+
+def _send_ev(sid, mtype, mid):
+    return {"name": "comm.send", "ph": "B", "ts": 1.0,
+            "args": {"span_id": sid, "msg_type": mtype, "msg_id": mid}}
+
+
+def _recv_ev(parent, mtype, mid):
+    return {"name": "comm.recv", "ph": "B", "ts": 2.0,
+            "args": {"span_id": "r" + (parent or "x"),
+                     "parent_span": parent, "msg_type": mtype,
+                     "msg_id": mid}}
+
+
+MINI_TRACE_MANIFEST = {
+    "families": {"mini": {
+        "handlers": {"server": {"2": "_on_result"},
+                     "client": {"1": "_on_work", "3": "_on_finish"}},
+        "sends": {"server": {"1": {}, "3": {}}, "client": {"2": {}}},
+    }},
+    "suppressions": [],
+}
+
+
+def _tr(*events):
+    return {"traceEvents": list(events)}
+
+
+def test_check_trace_clean_run_passes():
+    t = _tr(_send_ev("s1", "1", "m1"), _recv_ev("s1", "1", "m1"),
+            _send_ev("s2", "2", "m2"), _recv_ev("s2", "2", "m2"))
+    assert fp.check_trace([t], "mini", MINI_TRACE_MANIFEST) == []
+
+
+def test_check_trace_rejects_type_flip():
+    t = _tr(_send_ev("s1", "1", "m1"), _recv_ev("s1", "99", "m1"))
+    fs = fp.check_trace([t], "mini", MINI_TRACE_MANIFEST)
+    assert "trace-unknown-type" in _rules(fs)
+
+
+def test_check_trace_flags_message_loss():
+    t = _tr(_send_ev("s1", "1", "m1"))   # recv deleted / never happened
+    fs = fp.check_trace([t], "mini", MINI_TRACE_MANIFEST)
+    assert _rules(fs) == ["trace-message-loss"]
+
+
+def test_check_trace_flags_duplicate_delivery():
+    t = _tr(_send_ev("s1", "1", "m1"), _recv_ev("s1", "1", "m1"),
+            _recv_ev("s1", "1", "m1"))
+    fs = fp.check_trace([t], "mini", MINI_TRACE_MANIFEST)
+    assert _rules(fs) == ["trace-duplicate-delivery"]
+
+
+def test_check_trace_flags_observed_drop():
+    drop = {"name": "comm.drop", "ph": "B", "ts": 1.0,
+            "args": {"msg_type": "2", "msg_id": "m9"}}
+    fs = fp.check_trace([_tr(drop)], "mini", MINI_TRACE_MANIFEST)
+    assert _rules(fs) == ["trace-observed-drop"]
+
+
+def test_check_trace_spans_multiple_captures():
+    """Send and recv on DIFFERENT per-process captures still pair."""
+    a = _tr(_send_ev("s1", "1", "m1"))
+    b = _tr(_recv_ev("s1", "1", "m1"))
+    assert fp.check_trace([a, b], "mini", MINI_TRACE_MANIFEST) == []
+    assert "trace-message-loss" in _rules(
+        fp.check_trace([a], "mini", MINI_TRACE_MANIFEST))
+
+
+# -- 4. runtime conformance: real fault-injected runs -----------------------
+
+@pytest.fixture
+def clean_tracer():
+    obs.configure(enabled=False)
+    obs.get_tracer().reset()
+    yield obs.get_tracer()
+    obs.configure(enabled=False)
+    tr = obs.get_tracer()
+    tr.reset()
+    tr.path = None
+    tr.label = None
+
+
+def _wait_for(pred, timeout_s=10.0):
+    import time
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _mk_fsm(args, rank, size, sink):
+    from fedml_tpu.core.distributed.fedml_comm_manager import (
+        FedMLCommManager)
+
+    class _FSM(FedMLCommManager):
+        def register_message_receive_handlers(self):
+            # fedproto-mini runtime twin: type 2 = C2S result
+            self.register_message_receive_handler(
+                2, lambda m: sink.append(m))
+
+    return _FSM(args, rank=rank, size=size, backend="local")
+
+
+def _run_chaos_exchange(clean_tracer, run_id, **chaos):
+    """One client→server message over the local backend with seeded
+    fault injection; returns (sink, trace dict)."""
+    from fedml_tpu.core.distributed.communication.local import (
+        local_comm_manager)
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    obs.configure(enabled=True, jax_hooks=False)
+    args = types.SimpleNamespace(run_id=run_id, **chaos)
+    sink = []
+    srv = _mk_fsm(args, 0, 2, sink)
+    cli = _mk_fsm(args, 1, 2, [])
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    msg = Message(2, 1, 0)
+    msg.add_params("payload", [1, 2, 3])
+    cli.send_message(msg)
+    dropped = chaos.get("chaos_drop_prob", 0) >= 1.0
+    if not dropped:
+        assert _wait_for(lambda: sink)
+    else:
+        assert not _wait_for(lambda: sink, timeout_s=0.3)
+    srv.finish()
+    cli.finish()
+    t.join(timeout=5)
+    local_comm_manager.reset_run(run_id)
+    return sink, clean_tracer.export_chrome()
+
+
+def test_fault_injection_drop_classified(clean_tracer):
+    """chaos_drop: the message never arrives; without the comm.drop
+    marker the loss would be invisible (no comm.send span exists below
+    the chaos layer) — check-trace must classify it, not pass silently."""
+    sink, trace = _run_chaos_exchange(
+        clean_tracer, "fedproto_drop", chaos_seed=3,
+        chaos_drop_prob=1.0, chaos_droppable_types=[2])
+    assert sink == []
+    drops = [e for e in trace["traceEvents"]
+             if e.get("ph") == "B" and e["name"] == "comm.drop"]
+    assert drops and drops[0]["args"]["msg_type"] == "2"
+    assert drops[0]["args"].get("msg_id")   # stamped above the chaos layer
+    fs = fp.check_trace([trace], "mini", MINI_TRACE_MANIFEST)
+    assert "trace-observed-drop" in _rules(fs)
+    assert fp.exit_code(fs) == 1
+
+
+def test_fault_injection_duplicate_classified(clean_tracer):
+    """chaos_dup: QoS-1 re-delivery — two comm.recv spans share one
+    fedscope.msg_id, and neither send reads as a loss (msg_id fallback
+    matching)."""
+    sink, trace = _run_chaos_exchange(
+        clean_tracer, "fedproto_dup", chaos_seed=3, chaos_dup_prob=1.0)
+    assert _wait_for(lambda: len(sink) >= 2)
+    trace = obs.get_tracer().export_chrome()
+    fs = fp.check_trace([trace], "mini", MINI_TRACE_MANIFEST)
+    assert "trace-duplicate-delivery" in _rules(fs)
+    assert "trace-message-loss" not in _rules(fs)
+
+
+def test_fault_injection_delay_is_clean(clean_tracer):
+    """chaos_delay reorders but still delivers exactly once — a delayed
+    run must replay clean (delay is not a protocol violation)."""
+    sink, trace = _run_chaos_exchange(
+        clean_tracer, "fedproto_delay", chaos_seed=3,
+        chaos_delay_prob=1.0, chaos_max_delay_s=0.02)
+    assert len(sink) == 1
+    fs = fp.check_trace([trace], "mini", MINI_TRACE_MANIFEST)
+    assert fs == [], fp.render_findings(fs, tool="fedproto")
+
+
+# -- Message.require() hardening --------------------------------------------
+
+def test_require_raises_keyerror_naming_type_and_sender():
+    from fedml_tpu.core.distributed.communication.message import Message
+
+    msg = Message(3, 5, 0)
+    msg.add_params("model_params", {"w": 1})
+    assert msg.require("model_params") == {"w": 1}
+    with pytest.raises(KeyError) as ei:
+        msg.require("num_samples")
+    s = str(ei.value)
+    assert "num_samples" in s and "type 3" in s and "sender 5" in s
+
+
+def test_server_handler_rejects_malformed_upload():
+    """The hardened cross-silo handlers fail FAST on a malformed message
+    instead of propagating None into aggregation."""
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.server.fedml_server_manager import (
+        FedMLServerManager)
+
+    mgr = FedMLServerManager.__new__(FedMLServerManager)  # no comm setup
+    msg = Message(3, 1, 0)
+    msg.add_params("num_samples", 4.0)      # model_params missing
+    with pytest.raises(KeyError) as ei:
+        mgr.handle_message_receive_model_from_client(msg)
+    assert "model_params" in str(ei.value)
+
+
+def test_client_handler_rejects_malformed_sync():
+    from fedml_tpu.core.distributed.communication.message import Message
+    from fedml_tpu.cross_silo.client.fedml_client_master_manager import (
+        ClientMasterManager)
+
+    mgr = ClientMasterManager.__new__(ClientMasterManager)
+    msg = Message(2, 0, 1)
+    msg.add_params("model_params", {})      # client_idx missing
+    with pytest.raises(KeyError) as ei:
+        mgr._train_and_send(msg)
+    assert "client_idx" in str(ei.value)

@@ -160,3 +160,40 @@ def test_two_tier_multiprocess_merged_critical_path(tmp_path):
     # intra-silo tier stays silent in this topology
     assert all(last_counter(t, "comm.bytes.intra_silo") == 0
                for t in traces)
+
+    # -- fedproto runtime conformance (ISSUE 12 acceptance) ----------------
+    # the REAL 3-process run must replay clean against the same manifest
+    # the static pass pins: every send delivered exactly once, every
+    # observed type known to the store_hierarchy protocol
+    FEDPROTO_CLI = os.path.join(REPO, "tools", "fedproto.py")
+    r = subprocess.run(
+        [sys.executable, FEDPROTO_CLI, "check-trace", merged_path,
+         "--family", "store_hierarchy"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # ... and reject a TAMPERED trace: (a) a type flip the protocol does
+    # not know, (b) a deleted delivery (recv span removed = the observed
+    # sequence has a coverage gap)
+    merged = json.load(open(merged_path))
+    flipped = json.loads(json.dumps(merged))
+    for e in flipped["traceEvents"]:
+        if e.get("ph") == "B" and e.get("name") == "comm.recv":
+            e["args"]["msg_type"] = "999"
+            break
+    flip_path = str(tmp_path / "tampered_type.json")
+    json.dump(flipped, open(flip_path, "w"))
+    r = subprocess.run(
+        [sys.executable, FEDPROTO_CLI, "check-trace", flip_path,
+         "--family", "store_hierarchy"], capture_output=True, text=True)
+    assert r.returncode == 1 and "trace-unknown-type" in r.stdout
+
+    lost = json.loads(json.dumps(merged))
+    cut = next(e for e in lost["traceEvents"]
+               if e.get("ph") == "B" and e.get("name") == "comm.recv")
+    lost["traceEvents"].remove(cut)
+    lost_path = str(tmp_path / "tampered_loss.json")
+    json.dump(lost, open(lost_path, "w"))
+    r = subprocess.run(
+        [sys.executable, FEDPROTO_CLI, "check-trace", lost_path,
+         "--family", "store_hierarchy"], capture_output=True, text=True)
+    assert r.returncode == 1 and "trace-message-loss" in r.stdout

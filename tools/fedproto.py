@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""fedproto CLI — static protocol checks + runtime trace conformance for
+the distributed message-FSM plane (docs/FEDPROTO.md).
+
+Usage:
+    python tools/fedproto.py check                       # whole package
+    python tools/fedproto.py check --families secagg,vertical
+    python tools/fedproto.py check --json
+    python tools/fedproto.py check --update-manifest     # refresh pins
+    python tools/fedproto.py check-trace TRACE.json [...] \
+        --family store_hierarchy
+    python tools/fedproto.py --list-rules
+    python tools/fedproto.py --list-families
+
+Exit codes mirror fedlint/fedverify: 0 = no unsuppressed errors, 1 = at
+least one (or any unsuppressed finding with --strict), 2 = usage error.
+
+Pure stdlib like ``tools/fedlint.py``: the analyzer is loaded by file path
+(fedlint first, then fedproto, which imports it), so protocol checking
+needs no jax install — it runs on CI lint shards and pre-commit hooks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_fedproto():
+    """Load fedlint + fedproto directly, bypassing fedml_tpu/__init__
+    (which imports jax and initializes a backend)."""
+    analysis = os.path.join(REPO, "fedml_tpu", "analysis")
+
+    def load(name, fname):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(analysis, fname))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    load("fedlint", "fedlint.py")   # fedproto's ImportError fallback name
+    return load("fedproto", "fedproto.py")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fedproto", description="static protocol checker + runtime "
+        "conformance for the message-FSM plane (coverage, param "
+        "contracts, liveness, trace replay)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--list-families", action="store_true",
+                    help="print the protocol family table and exit")
+    sub = ap.add_subparsers(dest="cmd")
+
+    chk = sub.add_parser("check", help="extract + statically check the "
+                         "protocol families")
+    chk.add_argument("paths", nargs="*", default=None,
+                     help="files/dirs to analyze (default: fedml_tpu/)")
+    chk.add_argument("--families", default=None,
+                     help="comma-separated subset of families")
+    chk.add_argument("--json", action="store_true", dest="as_json")
+    chk.add_argument("--strict", action="store_true",
+                     help="exit 1 on warnings too")
+    chk.add_argument("--show-suppressed", action="store_true")
+    chk.add_argument("--manifest", default=None,
+                     help="protocols.json path (default: "
+                          "tests/data/fedproto/protocols.json)")
+    chk.add_argument("--update-manifest", action="store_true",
+                     help="rewrite the manifest's extracted protocols "
+                          "(suppressions are preserved); the git diff is "
+                          "the review surface")
+
+    trc = sub.add_parser("check-trace", help="replay fedscope comm spans "
+                         "against a pinned protocol")
+    trc.add_argument("traces", nargs="+", help="fedscope capture(s) — "
+                     "per-process or merged Chrome trace JSON")
+    trc.add_argument("--family", default="store_hierarchy",
+                     help="protocol family to validate against")
+    trc.add_argument("--manifest", default=None)
+    trc.add_argument("--json", action="store_true", dest="as_json")
+    trc.add_argument("--strict", action="store_true")
+    trc.add_argument("--show-suppressed", action="store_true")
+
+    args = ap.parse_args(argv)
+    fp = _load_fedproto()
+
+    if args.list_rules:
+        for r in fp.PROTO_RULES.values():
+            print(f"{r.name:26s} [{r.severity}] {r.doc}")
+        return 0
+    if args.list_families:
+        for name, cfg in fp.PROTOCOL_FAMILIES.items():
+            roles = {}
+            for member, (role, _path) in cfg["members"].items():
+                roles.setdefault(role, []).append(member)
+            desc = "; ".join(f"{role}: {', '.join(ms)}"
+                             for role, ms in sorted(roles.items()))
+            print(f"{name:20s} {desc}")
+        return 0
+    if args.cmd is None:
+        ap.print_usage(sys.stderr)
+        print("fedproto: error: choose a subcommand (check | check-trace)",
+              file=sys.stderr)
+        return 2
+
+    if args.cmd == "check":
+        paths = args.paths or [os.path.join(REPO, "fedml_tpu")]
+        families = fp.PROTOCOL_FAMILIES
+        if args.families:
+            names = [n.strip() for n in args.families.split(",")
+                     if n.strip()]
+            unknown = set(names) - set(families)
+            if unknown:
+                print(f"fedproto: unknown family(ies): "
+                      f"{', '.join(sorted(unknown))}", file=sys.stderr)
+                return 2
+            families = {n: families[n] for n in names}
+        fams, warnings = fp.extract_protocols(paths, families)
+        if args.update_manifest:
+            fp.update_manifest(fams, args.manifest)
+        manifest = fp.load_manifest(args.manifest)
+        findings = fp.check_protocols(fams, manifest, warnings)
+        if args.as_json:
+            print(json.dumps({
+                "findings": json.loads(fp.findings_to_json(findings)),
+                "families": {n: fp.family_to_manifest(f)
+                             for n, f in sorted(fams.items())},
+            }, indent=2))
+        else:
+            print(fp.render_findings(
+                findings, show_suppressed=args.show_suppressed,
+                tool="fedproto"))
+        return fp.exit_code(findings, strict=args.strict)
+
+    # check-trace
+    traces = []
+    for path in args.traces:
+        try:
+            with open(path) as fh:
+                traces.append(json.load(fh))
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"fedproto: cannot read trace {path}: {exc}",
+                  file=sys.stderr)
+            return 2
+    manifest = fp.load_manifest(args.manifest)
+    if manifest is None:
+        print("fedproto: no manifest to replay against (run "
+              "'check --update-manifest' first)", file=sys.stderr)
+        return 2
+    findings = fp.check_trace(traces, args.family, manifest)
+    if args.as_json:
+        print(fp.findings_to_json(findings))
+    else:
+        print(fp.render_findings(findings,
+                                 show_suppressed=args.show_suppressed,
+                                 tool="fedproto"))
+    return fp.exit_code(findings, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -908,6 +908,167 @@ def bench_store(rounds: int | None = None) -> dict:
     return out
 
 
+def bench_async(max_rounds: int | None = None) -> dict:
+    """--async: buffered-async fedbuff vs sync FedAvg under a
+    heavy-tailed client-latency distribution (docs/ASYNC.md).
+
+    Equal samples per aggregation: both engines run the same cohorts
+    (same seed → same sampling/staging/rng), C clients × the same local
+    steps; one fedbuff buffer apply consumes K = C updates, one sync
+    round consumes its lockstep cohort.  The wall-clock axis is the
+    VIRTUAL clock of the shared arrival model (simulation/async_sim.py —
+    log-normal latency, sigma 1.6, persistent stragglers): a sync round
+    costs the MAX of its cohort's latency draws (the straggler gates the
+    lockstep), while fedbuff's applies advance at arrival rate with
+    staleness-discounted mixing.  Headline: sim-wall-clock to the target
+    test accuracy, plus rounds/applies-to-target, the staleness
+    envelope, and the JaxRuntimeAudit steady-state recompile pin (0 —
+    buffer occupancy/staleness are traced data).
+    FEDML_ASYNC_QUICK=1 shrinks everything for the tier-1 smoke."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu.simulation.async_engine import FedBuffAPI
+    from fedml_tpu.simulation.async_sim import ArrivalSimulator
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    quick = os.environ.get("FEDML_ASYNC_QUICK") == "1"
+    cohort = 8 if quick else 32
+    total_clients = 64 if quick else 256
+    rounds_cap = max_rounds or (12 if quick else 80)
+    # full mode slows the optimizer so the to-target trajectory spans
+    # ~17 sync rounds (measured) — enough straggler-gated rounds for the
+    # wall-clock comparison to mean something; quick mode keeps the fast
+    # lr so the tier-1 smoke stays cheap
+    target_acc = 0.55 if quick else 0.95
+    lr = 0.1 if quick else 0.003
+    lat = dict(latency_median_s=5.0, latency_sigma=1.6, speed_sigma=0.5)
+
+    def make_args(**over):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total_clients * 40, test_size=512, model="lr",
+            client_num_in_total=total_clients,
+            client_num_per_round=cohort, comm_round=rounds_cap,
+            epochs=1, batch_size=BATCH, learning_rate=lr,
+            partition_method="hetero", partition_alpha=0.3,
+            frequency_of_the_test=10 ** 9, random_seed=0)
+        args.update(**over)
+        return fedml_tpu.init(args, should_init_logs=False)
+
+    def make_api(cls, **over):
+        args = make_args(**over)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        return cls(args, None, dataset, model)
+
+    # -- sync FedAvg: lockstep rounds gated by the cohort max latency ----
+    sync = make_api(FedAvgAPI, federated_optimizer="FedAvg")
+    lat_model = ArrivalSimulator(seed=0, **lat)
+    sync_clock = 0.0
+    sync_rounds = sync_to_target = None
+    sync_accs = []
+    t0 = time.time()
+    for r in range(rounds_cap):
+        sync.train_one_round(r)
+        draws, _ = lat_model.draw_latencies(
+            r, sync._client_sampling(r))
+        sync_clock += float(np.max(draws))   # the straggler gates the round
+        _, acc = sync.evaluate()
+        sync_accs.append(round(float(acc), 4))
+        if acc >= target_acc:
+            sync_rounds, sync_to_target = r + 1, sync_clock
+            break
+    sync_host_s = time.time() - t0
+    del sync
+
+    # -- fedbuff: event-driven applies over the SAME latency model -------
+    # concurrency = inflight_gens × cohort: under a heavy tail the
+    # pipeline needs enough in-flight work that stragglers don't drain
+    # it between applies (measured: 2 gens → 1.4x, 4 → 2.7x, 6 → 3.7x
+    # with staleness p99 spiking to ~24; 4 is the balanced headline)
+    ab = make_api(FedBuffAPI, federated_optimizer="fedbuff",
+                  async_inflight_gens=2 if quick else 4, **{
+                      "async_latency_median_s": lat["latency_median_s"],
+                      "async_latency_sigma": lat["latency_sigma"],
+                      "async_speed_sigma": lat["speed_sigma"]})
+    fb_applies = fb_to_target = None
+    fb_accs = []
+    stale_p50 = stale_p99 = 0.0
+    t0 = time.time()
+    for r in range(rounds_cap):
+        m = ab.train_one_round(r)
+        stale_p50, stale_p99 = m["staleness_p50"], m["staleness_p99"]
+        _, acc = ab.evaluate()
+        fb_accs.append(round(float(acc), 4))
+        if acc >= target_acc:
+            fb_applies, fb_to_target = r + 1, float(m["sim_time_s"])
+            break
+    fb_host_s = time.time() - t0
+
+    # steady-state dispatch cost + the zero-recompile pin, off the
+    # to-target clock.  Under the hetero partition, cohorts pad to pow2
+    # step classes (the PR 2 bounded-recompile contract) and arrival
+    # interleaving decides when each class / the atomic-cohort fast path
+    # first fires — warm every class in the horizon explicitly so the
+    # audit window measures true steady state (both programs are pure;
+    # results are discarded)
+    import jax as _jax
+    import jax.numpy as _jnp
+    from fedml_tpu.core import rng as _rng
+    extra = 3 if quick else 5
+    horizon = rounds_cap + extra + 4 * ab.inflight_gens
+    classes: dict = {}
+    for g in range(ab._next_gen, horizon):
+        classes.setdefault(ab.dispatch_signature(g), g)
+    for g in classes.values():
+        _clients, _idx, _mask, _w, _s = ab._stage_round_arrays(g)
+        _key = _rng.round_key(_rng.root_key(ab.seed), g)
+        _c = ab._gather_c(np.asarray(_clients, np.int32), round_idx=g)
+        _args = (ab.state, _jnp.asarray(_idx), _jnp.asarray(_mask),
+                 _jnp.asarray(_w), _key, _c)
+        _jax.block_until_ready(ab.round_fn(*_args)[0])
+        _jax.block_until_ready(ab._dispatch_fn(*_args)[0])
+    _readback(ab.state.global_params)
+    with JaxRuntimeAudit() as audit:
+        t0 = time.time()
+        for r in range(rounds_cap, rounds_cap + extra):
+            ab.train_one_round(r)
+        _readback(ab.state.global_params)
+        steady_s = (time.time() - t0) / extra
+    out = {
+        "quick": quick, "cohort": cohort, "buffer_k": ab.buffer_k,
+        "total_clients": total_clients, "target_acc": target_acc,
+        "latency_median_s": lat["latency_median_s"],
+        "latency_sigma": lat["latency_sigma"],
+        "speed_sigma": lat["speed_sigma"],
+        "rounds_cap": rounds_cap,
+        "sync_rounds_to_target": sync_rounds,
+        "sync_sim_wallclock_to_target_s": round(sync_to_target, 2)
+        if sync_to_target else None,
+        "sync_final_acc": sync_accs[-1],
+        "fedbuff_applies_to_target": fb_applies,
+        "fedbuff_sim_wallclock_to_target_s": round(fb_to_target, 2)
+        if fb_to_target else None,
+        "fedbuff_final_acc": fb_accs[-1],
+        # the headline: straggler-gated lockstep vs arrival-rate applies
+        "async_wallclock_speedup": round(sync_to_target / fb_to_target, 3)
+        if sync_to_target and fb_to_target else None,
+        "fedbuff_staleness_p50_last": stale_p50,
+        "fedbuff_staleness_p99_last": stale_p99,
+        "fedbuff_updates_dropped": ab.updates_dropped,
+        "fedbuff_clients_dispatched": ab.clients_dispatched,
+        "fedbuff_fastpath_applies": ab.fastpath_applies,
+        "fedbuff_steady_host_s_per_apply": round(steady_s, 5),
+        "sync_host_s_total": round(sync_host_s, 2),
+        "fedbuff_host_s_total": round(fb_host_s, 2),
+        "steady_compiles_async": audit.compilations,
+    }
+    return out
+
+
 # -- fedtrace overhead + breakdown benchmark (--trace) -----------------------
 def _import_fedtrace():
     sys.path.insert(0, os.path.join(os.path.dirname(
@@ -1705,6 +1866,19 @@ def main():
             "value": result["store_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["store_vs_dense_sameshape"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--async" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_async()
+        result.update({
+            "metric": "fedbuff_vs_sync_wallclock_to_target",
+            "value": result["fedbuff_sim_wallclock_to_target_s"],
+            "unit": "sim_s_to_target_acc",
+            "vs_baseline": result["async_wallclock_speedup"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

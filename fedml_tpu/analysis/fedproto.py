@@ -229,6 +229,18 @@ PROTOCOL_FAMILIES: Dict[str, Dict[str, Any]] = {
         "sources": ("store/hierarchy.py",),
         "queue_style": True,
     },
+    # buffered-async federation (docs/ASYNC.md): the server buffers
+    # staleness-discounted worker partials and applies at K; the same
+    # queue-endpoint idiom as store_hierarchy
+    "async_buffered": {
+        "members": {
+            "_run_async_server": ("server", "simulation/async_driver.py"),
+            "_run_async_worker": ("client", "simulation/async_driver.py"),
+        },
+        "shared_members": {"_Mgr": "simulation/async_driver.py"},
+        "sources": ("simulation/async_driver.py",),
+        "queue_style": True,
+    },
 }
 
 

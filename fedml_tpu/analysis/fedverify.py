@@ -966,6 +966,40 @@ def build_population_p4() -> ProgramReport:
     return _build_sp("population_p4", population=4)
 
 
+def _make_async_api():
+    from ..simulation.async_engine import FedBuffAPI
+    args = _canonical_args(backend="sp", federated_optimizer="fedbuff")
+    from .. import data as data_mod, device as device_mod, model as model_mod
+    dev = device_mod.get_device(args)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    return FedBuffAPI(args, dev, dataset, model)
+
+
+def build_async_dispatch() -> ProgramReport:
+    """The buffered-async engine's generation dispatch (docs/ASYNC.md):
+    client phase + per-client unreduced aggregate rows, staged exactly
+    like the sync round."""
+    api = _make_async_api()
+    fn, args, donate = api.dispatch_program(0)
+    sigs = [api.dispatch_signature(g) for g in range(SIGNATURE_ROUNDS)]
+    est = _mesh_round_estimate(api, args, steps=int(args[1].shape[1]))
+    return lower_program("async_dispatch", fn, args, donate,
+                         mesh_shape=(1, 1), estimate_bytes=est,
+                         signatures=sigs)
+
+
+def build_async_apply() -> ProgramReport:
+    """The buffered-async engine's buffer apply: finish the size-K row
+    buffer (occupancy/staleness as traced data) + server transition,
+    with the buffer donated for the in-place reset."""
+    api = _make_async_api()
+    fn, args, donate = api.buffer_program()
+    est = _mesh_round_estimate(api, args, steps=1)
+    return lower_program("async_buffer_apply", fn, args, donate,
+                         mesh_shape=(1, 1), estimate_bytes=est)
+
+
 def _build_mesh(name: str, mesh_shape: str, update_sharding: str,
                 alg: str = "FedAvg", block: int = 1,
                 precision: str = "fp32") -> ProgramReport:
@@ -1097,6 +1131,8 @@ PROGRAMS = {
     "mesh2d_scatter": build_mesh2d_scatter,
     "mesh_block8": build_mesh_block8,
     "population_p4": build_population_p4,
+    "async_dispatch": build_async_dispatch,
+    "async_buffer_apply": build_async_apply,
     "serving_decode_step": build_serving_step,
     "serving_insert_cache": build_serving_insert,
 }

@@ -165,6 +165,35 @@ _DEFAULTS: Dict[str, Any] = dict(
     trace_path=None,
     trace_device=False,
     trace_profile_dir=None,
+    # fedbuff buffered-async aggregation (docs/ASYNC.md):
+    # federated_optimizer=fedbuff selects the buffered-async engine;
+    # async_base_optimizer picks the underlying AlgorithmSpec; the buffer
+    # applies at async_buffer_k landed updates (0 = clients_per_round)
+    # with staleness discount s(tau) = 1/(1+tau)^async_alpha; updates
+    # staler than async_max_staleness drop (0 = unbounded);
+    # async_inflight_gens dispatch generations stay in flight.  Arrival
+    # model (simulation/async_sim.py): log-normal latency
+    # (median/sigma), persistent per-client slowness (speed_sigma),
+    # dropout, and busy-client availability waits.
+    async_base_optimizer="fedavg",
+    async_buffer_k=0,
+    # atomic-cohort fast path: when a whole fresh generation fills the
+    # empty buffer at zero staleness, run the sync round program instead
+    # of K buffer adds (bitwise the sync engine; off only for tests that
+    # exercise the buffered path under zero latency)
+    async_fastpath=True,
+    async_alpha=0.5,
+    async_max_staleness=0,
+    async_inflight_gens=1,
+    async_latency_median_s=0.0,
+    async_latency_sigma=1.5,
+    async_dropout=0.0,
+    async_speed_sigma=0.0,
+    async_unavailable_p=0.0,
+    async_unavailable_mean_s=0.0,
+    # worker-pool size of the multi-process async driver
+    # (simulation/async_driver.py::run_async_federation)
+    async_workers=0,
     # fedscope straggler injection for the multi-process two-tier driver
     # (store/hierarchy.py::run_silo_federation): hold silo
     # `silo_slow_rank`'s round open by `silo_slow_s` seconds
@@ -183,6 +212,26 @@ def validate_args(args) -> None:
     subclass ignoring the flag) and raises ONE error naming the
     incompatible flags while the config is still the only thing built.
     """
+    alg = str(getattr(args, "federated_optimizer", "") or "").lower()
+    if alg == "fedbuff":
+        # buffered-async engine (docs/ASYNC.md): event-driven applies are
+        # incompatible with the lockstep-only knobs — fail while the
+        # config is the only thing built
+        bad = [flag for flag, on in (
+            ("round_block", int(getattr(args, "round_block", 1) or 1) > 1),
+            ("cohort_bucketing",
+             bool(getattr(args, "cohort_bucketing", False))),
+            ("population", int(getattr(args, "population", 0) or 0) > 1
+             or bool(getattr(args, "population_axes", None))),
+            ("backend=mesh", str(getattr(args, "backend", "") or ""
+                                 ).lower() in ("mesh", "mpi", "nccl")),
+        ) if on]
+        if bad:
+            raise ValueError(
+                "incompatible flags: federated_optimizer=fedbuff + "
+                f"{' + '.join(bad)} — the buffered-async driver applies "
+                "the update buffer event-by-event on the sp engine "
+                "(docs/ASYNC.md)")
     pop = int(getattr(args, "population", 0) or 0)
     axes = getattr(args, "population_axes", None) or {}
     has_pop = pop > 1 or bool(axes)

@@ -208,6 +208,169 @@ def combine_partial_aggregates(spec: "AlgorithmSpec", partials
 
 
 # --------------------------------------------------------------------------
+# buffered-async aggregation (FedBuff-style, docs/ASYNC.md)
+# --------------------------------------------------------------------------
+#
+# The synchronous round reduces one cohort in lockstep; the buffered-async
+# engine (simulation/async_engine.py) instead lands each client's COMPLETED
+# update in a size-K on-device row buffer and finishes the reduction the
+# moment occupancy hits K, discounting stale rows by s(τ) = 1/(1+τ)^α
+# (τ = server model versions elapsed since the client's dispatch).  The
+# pieces live here because they are pure spec-driven algebra:
+#
+# - :func:`client_update_rows` evaluates every spec aggregate's per-client
+#   SOURCE rows at dispatch time (against the dispatch-version state, which
+#   is what the client actually trained from) without reducing them;
+# - :func:`update_buffer_zeros` / :func:`update_buffer_add` maintain the
+#   K-row buffer with occupancy, per-row staleness and discount as traced
+#   DATA (scatter at a traced slot vector; slot K is the padding sentinel
+#   XLA drops) — ONE compiled program serves every occupancy;
+# - :func:`update_buffer_apply` finishes the buffer with the SAME stacked
+#   reductions the sync engines run (StackedReducer math), so a K=cohort,
+#   zero-latency apply reproduces the synchronous round BITWISE;
+# - :func:`scale_partial` staleness-discounts a PartialReducer partial, so
+#   the distributed async driver (simulation/async_driver.py) can ship
+#   dispatch-time partials and combine them at the server through the
+#   unchanged :func:`combine_partial_aggregates` path.
+
+def staleness_discount(tau, alpha: float) -> jnp.ndarray:
+    """FedBuff staleness discount ``s(τ) = 1/(1+τ)^α``.
+
+    ``τ = 0`` gives exactly 1.0 (``1^x`` is exact in IEEE), which is what
+    makes the bounded-staleness parity contract *bitwise*: a fresh update's
+    discounted weight ``1.0 * w`` is ``w``."""
+    return jnp.power(1.0 + jnp.asarray(tau, jnp.float32), -float(alpha))
+
+
+def client_update_rows(spec: "AlgorithmSpec", opt, state, outs, w,
+                       hp: Optional[HParams] = None) -> Dict[str, Any]:
+    """Per-client UNREDUCED aggregate rows, evaluated at DISPATCH time.
+
+    Every spec source runs against the state the clients were dispatched
+    with (FedNova/q-FedAvg deltas reference ``state.global_params`` — the
+    model version the client trained from, not whatever the server holds
+    when the update finally lands).  Entries keep the stacked source and
+    its per-client weight vector separate so the buffer can re-weight rows
+    by staleness at apply time:
+
+    - ``n_rows``: the real-client mask (``w > 0``),
+    - wavg/scalar aggregates: ``{"src": stacked, "w": (C,)}``,
+    - sum aggregates: ``{"src": src * ww}`` (pre-weighted, summed later).
+    """
+    rows: Dict[str, Any] = {"n_rows": _real(opt, outs, w)}
+    if spec.avg_params:
+        rows["avg_params"] = {"src": outs.params,
+                              "w": jnp.asarray(w, jnp.float32)}
+    for a in spec.aggregates:
+        src = a.source(opt, state, outs, hp)
+        ww = a.weights(opt, outs, w, hp)
+        if a.kind in ("wavg", "scalar"):
+            rows[a.name] = {"src": src, "w": ww}
+        else:  # sum
+            rows[a.name] = {"src": src * ww}
+    return rows
+
+
+def update_buffer_zeros(spec: "AlgorithmSpec", rows: Dict[str, Any],
+                        k: int) -> Dict[str, Any]:
+    """A zeroed size-``k`` row buffer shaped like ``rows`` with the
+    leading client axis resized to ``k``, plus the per-row discount /
+    staleness lanes and the traced occupancy counter."""
+    def resize(l):
+        return jnp.zeros((int(k),) + tuple(l.shape[1:]), l.dtype)
+
+    return {
+        "rows": jax.tree_util.tree_map(resize, rows),
+        "s": jnp.zeros((int(k),), jnp.float32),      # discount per row
+        "tau": jnp.zeros((int(k),), jnp.float32),    # staleness per row
+        "occupancy": jnp.zeros((), jnp.float32),
+        "version": jnp.zeros((), jnp.float32),       # server model version
+    }
+
+
+def update_buffer_add(buf: Dict[str, Any], rows: Dict[str, Any],
+                      idx, slots, s, tau) -> Dict[str, Any]:
+    """Land ≤K arrivals in the buffer — all-traced-data, ONE compiled
+    program for every occupancy/batch size.
+
+    ``idx``/``slots``/``s``/``tau`` are (K,)-padded lanes: lane j takes
+    source row ``idx[j]`` of ``rows`` (a dispatch generation's stacked
+    outputs) into buffer slot ``slots[j]`` with discount ``s[j]``.
+    Padding lanes carry ``slots[j] = K`` — out-of-bounds scatter indices
+    DROP under XLA's default mode, the same sentinel trick the cohort
+    scatter and the adapter bank use, so occupancy never becomes a shape.
+    """
+    idx = jnp.asarray(idx, jnp.int32)
+    slots = jnp.asarray(slots, jnp.int32)
+    s = jnp.asarray(s, jnp.float32)
+    tau = jnp.asarray(tau, jnp.float32)
+    sel = jax.tree_util.tree_map(lambda l: l[idx], rows)
+    new_rows = jax.tree_util.tree_map(
+        lambda d, sl: d.at[slots].set(sl.astype(d.dtype)), buf["rows"], sel)
+    k = buf["s"].shape[0]
+    landed = jnp.sum((slots < k).astype(jnp.float32))
+    return {
+        "rows": new_rows,
+        "s": buf["s"].at[slots].set(s),
+        "tau": buf["tau"].at[slots].set(tau),
+        "occupancy": buf["occupancy"] + landed,
+        "version": buf["version"],
+    }
+
+
+def update_buffer_apply(spec: "AlgorithmSpec", opt, state, buf,
+                        hp: Optional[HParams] = None):
+    """Finish the buffer into one aggregate dict and run the unchanged
+    server transition.
+
+    The reductions are the synchronous engines' own stacked forms
+    (:class:`StackedReducer` math) over the buffered rows with per-row
+    staleness-discounted weights ``s_i · w_i`` — with every ``s_i = 1``
+    and the buffer holding one cohort in dispatch order, this is
+    *bitwise* the synchronous round's merge (the parity pin in
+    tests/test_async_engine.py).  Returns ``(new_state, agg,
+    reset_buffer)`` with the buffer re-zeroed and its version bumped, so
+    the engine can donate the buffer through one jitted apply."""
+    s = buf["s"]
+    red = StackedReducer()
+    agg: Dict[str, Any] = {"n_sampled": jnp.sum(s * buf["rows"]["n_rows"])}
+    if spec.avg_params:
+        e = buf["rows"]["avg_params"]
+        agg["avg_params"] = red.wavg(e["src"], s * e["w"])
+    for a in spec.aggregates:
+        e = buf["rows"][a.name]
+        if a.kind == "wavg":
+            agg[a.name] = red.wavg(e["src"], s * e["w"])
+        elif a.kind == "scalar":
+            agg[a.name] = red.wavg_scalar(e["src"], s * e["w"])
+        else:  # sum — rows arrived pre-weighted
+            agg[a.name] = jnp.sum(s * e["src"])
+    new_state = opt.update_from_aggregates(state, agg, hp)
+    fresh = jax.tree_util.tree_map(jnp.zeros_like, buf)
+    fresh["version"] = buf["version"] + 1.0
+    return new_state, agg, fresh
+
+
+def scale_partial(spec: "AlgorithmSpec", partial: Dict[str, Any],
+                  s) -> Dict[str, Any]:
+    """Staleness-discount a :class:`PartialReducer` partial by ``s``:
+    every numerator AND denominator scales, so ``combine_partial_
+    aggregates`` over discounted partials is the staleness-weighted
+    average — the FedBuff weight applied server-side against a partial
+    computed at dispatch (the distributed async driver's wire path)."""
+    s = jnp.asarray(s, jnp.float32)
+
+    def scale_entry(v):
+        if isinstance(v, dict) and set(v) == {"num", "den"}:
+            return {"num": jax.tree_util.tree_map(lambda l: s * l,
+                                                  v["num"]),
+                    "den": s * v["den"]}
+        return jax.tree_util.tree_map(lambda l: s * l, v)
+
+    return {k: scale_entry(v) for k, v in partial.items()}
+
+
+# --------------------------------------------------------------------------
 # trace-time-dynamic hyperparameters
 # --------------------------------------------------------------------------
 
@@ -381,6 +544,15 @@ for _name in ("mime", "fedsgd"):
         aggregates=(AggSpec("avg_grad",
                             source=lambda opt, state, outs, hp:
                             outs.grad_sum),)))
+
+# fedbuff (docs/ASYNC.md): buffered-async FedAvg — the round SHAPE is plain
+# FedAvg (one weighted params average), but the driver is the buffered-async
+# engine: ``federated_optimizer: fedbuff`` selects
+# simulation/async_engine.py::FedBuffAPI, which lands completed updates in
+# a size-K buffer with staleness-discounted weights instead of waiting for
+# a lockstep cohort.  ``args.async_base_optimizer`` swaps the underlying
+# spec (any registered algorithm whose aggregates are spec-declared).
+register_algorithm(AlgorithmSpec("fedbuff"))
 
 
 # -- q-FedAvg (arXiv:1905.10497): fair aggregation as a pure spec -----------

@@ -1,0 +1,62 @@
+"""Shared traffic-shape generators (ROADMAP-sanctioned refactor).
+
+The serving load harness (``tools/serve_load.py``) and the event-driven
+client-arrival simulator (``simulation/async_sim.py``) model the same
+physical phenomena — open-loop arrivals, a few hot entities with a long
+cold tail, and heavy-tailed sizes/latencies — so the distributions live
+here once, pure numpy over caller-supplied ``np.random.Generator``
+streams (``core/hostrng.py`` gives deterministic per-purpose streams).
+
+Numerics contract: these functions consume the generator EXACTLY the way
+serve_load's inlined draws did (one ``exponential`` vector, one
+``lognormal`` vector...), so extracting them changed no committed load
+numbers and ``tests/test_serving_mt.py`` pins the harness unmodified.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def zipf_weights(n: int, a: float = 1.2) -> np.ndarray:
+    """Zipf popularity over n choices: rank r gets mass ∝ 1/r^a — a few
+    hot entities (adapters, client cohorts) and a long cold tail."""
+    w = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** a
+    return w / w.sum()
+
+
+def poisson_arrivals(rng: np.random.Generator, rate: float,
+                     n: int) -> np.ndarray:
+    """Cumulative arrival times of a Poisson process at ``rate``/s —
+    exponential inter-arrival gaps, the open-loop admission model."""
+    gaps = rng.exponential(1.0 / float(rate), n)
+    return np.cumsum(gaps)
+
+
+def lognormal_sizes(rng: np.random.Generator, mean: float, sigma: float,
+                    n: int, lo: int = 1,
+                    hi: Optional[int] = None) -> np.ndarray:
+    """Heavy-tailed integer sizes (prompt lengths): log-normal with the
+    given linear-space ``mean`` (median, strictly — serve_load's
+    historical parameterization ``lognormal(log(mean), sigma)``), clipped
+    to ``[lo, hi]``."""
+    vals = rng.lognormal(np.log(mean), sigma, n).astype(np.int64)
+    return np.clip(vals, lo, hi if hi is not None else np.iinfo(np.int64).max)
+
+
+def lognormal_latencies(rng: np.random.Generator, median_s: float,
+                        sigma: float, n: int) -> np.ndarray:
+    """Heavy-tailed client latencies in seconds: log-normal with median
+    ``median_s`` and shape ``sigma``.  At sigma >= 1.5 the p99/p50 ratio
+    exceeds 30x — the cross-device regime where one straggler gates a
+    synchronous round (docs/ASYNC.md)."""
+    return rng.lognormal(np.log(median_s), sigma, n)
+
+
+def bernoulli(rng: np.random.Generator, p: float, n: int) -> np.ndarray:
+    """n independent coin flips at probability ``p`` (dropout draws)."""
+    if p <= 0.0:
+        return np.zeros(n, bool)
+    return rng.random(n) < p

@@ -1,0 +1,266 @@
+"""Multi-process buffered-async federation — the message-plane twin of
+:class:`~fedml_tpu.simulation.async_engine.FedBuffAPI` (docs/ASYNC.md).
+
+The in-process engine proves the MATH of buffered-async aggregation;
+this driver proves the TOPOLOGY: rank 0 (the buffering server) and ranks
+1..W (one process per worker pool) exchange dispatch / update messages
+over any real comm backend (local / filestore / grpc / mqtt_s3), riding
+the FedMLCommManager path so fedscope's comm.send/comm.recv spans and
+fedproto's protocol checks (family ``async_buffered`` in
+``tests/data/fedproto/protocols.json``) gate the plane like every other
+message FSM in the repo.
+
+Protocol: the server seeds every worker with one DISPATCH (generation id
++ model version + state dict); each worker stages that generation's
+cohort, reduces it to an UNFINISHED partial aggregate
+(:class:`~fedml_tpu.core.federated.PartialReducer` — the PR 8 silo-tier
+math), optionally sleeps an injected heavy-tailed latency, and sends the
+partial UP.  The server staleness-discounts each arriving partial with
+:func:`~fedml_tpu.core.federated.scale_partial` (``s(τ) = 1/(1+τ)^α``
+against the version the worker dispatched from), buffers it, and the
+moment K partials have landed combines them through the UNCHANGED
+:func:`~fedml_tpu.core.federated.combine_partial_aggregates` path +
+``ServerOptimizer`` transition — then re-dispatches the sender at the
+new version.  FINISH fans out after ``comm_round`` applies.
+
+Stateless-client algorithms only (the same constraint as the silo
+driver: SCAFFOLD/FedDyn rows would go stale across worker processes).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import federated
+from ..core import hostrng
+from ..core import rng as rng_util
+from ..core import traffic
+from ..obs import get_tracer
+from .round_engine import make_run_clients
+from .sp.fedavg_api import FedAvgAPI
+
+log = logging.getLogger(__name__)
+
+#: protocol message types (disjoint from cross_silo MyMessage's range and
+#: the store-hierarchy 601..603 block)
+MSG_TYPE_ASYNC_DISPATCH = 701
+MSG_TYPE_ASYNC_UPDATE = 702
+MSG_TYPE_ASYNC_FINISH = 703
+
+#: hostrng purpose tag of the per-(worker, generation) latency sleeps
+WORKER_LATENCY_TAG = 0xA51D1
+
+
+class _AsyncEndpoint:
+    """Queue-backed endpoint over the real FedMLCommManager receive path
+    (handlers run on the comm loop thread and enqueue; the driver loops
+    consume from the queue)."""
+
+    def __init__(self, args, rank: int, size: int, backend: str):
+        from ..core.distributed.fedml_comm_manager import FedMLCommManager
+
+        self.inbox: "queue.Queue" = queue.Queue()
+        inbox = self.inbox
+
+        class _Mgr(FedMLCommManager):
+            def register_message_receive_handlers(self):
+                for t in (MSG_TYPE_ASYNC_DISPATCH, MSG_TYPE_ASYNC_UPDATE,
+                          MSG_TYPE_ASYNC_FINISH):
+                    self.register_message_receive_handler(
+                        t, lambda m: inbox.put(m))
+
+        self._mgr = _Mgr(args, rank=rank, size=size, backend=backend)
+        self._thread = threading.Thread(target=self._mgr.run, daemon=True)
+        self._thread.start()
+
+    def send(self, msg):
+        self._mgr.send_message(msg)
+
+    def recv(self, timeout_s: float = 120.0):
+        return self.inbox.get(timeout=timeout_s)
+
+    def close(self):
+        self._mgr.finish()
+        self._thread.join(timeout=5.0)
+
+
+def run_async_federation(args, device, dataset, model):
+    """Drive ONE process of the multi-process buffered-async topology.
+
+    ``args.rank`` 0 is the buffering server; ranks ``1..async_workers``
+    each run dispatch generations.  All processes share ``random_seed``,
+    so cohort sampling / rng streams / batch schedules are bitwise the
+    in-process engine's.  Returns the server's per-apply metrics list on
+    rank 0, None on workers.
+    """
+    rank = int(getattr(args, "rank", 0))
+    workers = int(getattr(args, "async_workers", 0) or 2)
+    backend = str(getattr(args, "backend", "local"))
+    tracer = get_tracer()
+    if bool(getattr(args, "trace", False)) or tracer.enabled:
+        from ..obs import configure
+        configure(label="server" if rank == 0 else f"worker{rank}")
+        tracer = get_tracer()
+
+    # the worker-side staging/trainer plane; ALSO validates the config
+    # (stateless algorithms only — same constraint as the silo driver)
+    base = str(getattr(args, "async_base_optimizer", "") or "fedavg")
+    if str(getattr(args, "federated_optimizer", "")).lower() == "fedbuff":
+        args.federated_optimizer = base
+    api = FedAvgAPI(args, device, dataset, model)
+    if api.server_opt.spec.client_state:
+        raise ValueError(
+            "distributed async federation supports stateless-client "
+            "algorithms (SCAFFOLD/FedDyn rows would go stale across "
+            "worker processes; run those in-process)")
+
+    ep = _AsyncEndpoint(args, rank, workers + 1, backend)
+    try:
+        if rank == 0:
+            return _run_async_server(api, ep, workers, args, tracer)
+        _run_async_worker(api, ep, rank, args, tracer)
+        return None
+    finally:
+        ep.close()
+        tracer.close()   # flush this process's mergeable trace
+
+
+def _run_async_server(api, ep, workers, args, tracer):
+    """Rank 0: buffer staleness-discounted partials, apply at K through
+    combine_partial_aggregates, re-dispatch the sender at the new
+    version."""
+    import flax.serialization as fser
+
+    from ..core.distributed.communication.message import Message
+
+    spec = api.server_opt.spec
+    rounds = int(getattr(args, "comm_round", 1))
+    k = int(getattr(args, "async_buffer_k", 0) or 0) or workers
+    alpha = float(getattr(args, "async_alpha", 0.5))
+    max_staleness = int(getattr(args, "async_max_staleness", 0) or 0)
+    combine = jax.jit(lambda st, parts: api.server_opt.
+                      update_from_aggregates(
+                          st, federated.combine_partial_aggregates(
+                              spec, parts)))
+
+    def dispatch(worker: int, gen: int, version: int):
+        msg = Message(MSG_TYPE_ASYNC_DISPATCH, 0, worker)
+        msg.add_params("gen", gen)
+        msg.add_params("version", version)
+        msg.add_params("state", fser.to_state_dict(api.state))
+        ep.send(msg)
+
+    version = 0
+    gen = 0
+    for w in range(1, workers + 1):
+        dispatch(w, gen, version)
+        gen += 1
+
+    history = []
+    buffered, loss_w, w_sum, stales = [], 0.0, 0.0, []
+    applies = 0
+    dropped = 0
+    t0 = time.time()
+    while applies < rounds:
+        msg = ep.recv()
+        if msg.get_type() != MSG_TYPE_ASYNC_UPDATE:
+            continue
+        sender = int(msg.get("worker"))
+        tau = version - int(msg.get("version"))
+        if max_staleness and tau > max_staleness:
+            dropped += 1
+        else:
+            s = float((1.0 + tau) ** (-alpha))
+            buffered.append(federated.scale_partial(
+                spec, msg.get("partial"), s))
+            loss_w += s * float(np.asarray(msg.get("loss_w")))
+            w_sum += s * float(msg.get("w_sum"))
+            stales.append(tau)
+        if len(buffered) >= k:
+            with tracer.span("async.apply", cat="round", version=version):
+                api.state = combine(api.state, tuple(buffered))
+                jax.block_until_ready(api.state.global_params)
+            history.append({
+                "round": applies, "train_loss": loss_w / max(w_sum, 1e-9),
+                "round_time": time.time() - t0,
+                "staleness_p50": float(np.percentile(stales, 50))
+                if stales else 0.0,
+                "updates_dropped": dropped})
+            log.info("async server apply %d: train_loss=%.4f", applies,
+                     history[-1]["train_loss"])
+            buffered, loss_w, w_sum, stales = [], 0.0, 0.0, []
+            version += 1
+            applies += 1
+            t0 = time.time()
+        if applies < rounds:
+            dispatch(sender, gen, version)
+            gen += 1
+    for w in range(1, workers + 1):
+        ep.send(Message(MSG_TYPE_ASYNC_FINISH, 0, w))
+    return history
+
+
+def _run_async_worker(api, ep, rank, args, tracer):
+    """Ranks 1..W: stage the dispatched generation's cohort, reduce it to
+    an unfinished partial, sleep the injected heavy-tailed latency, send
+    the update up, wait for the next dispatch."""
+    import flax.serialization as fser
+
+    from ..core.distributed.communication.message import Message
+
+    spec = api.server_opt.spec
+    server_opt = api.server_opt
+    run_clients = make_run_clients(api.trainer, server_opt,
+                                   api._client_mode)
+    red = federated.PartialReducer()
+    dev = (api._dev_x, api._dev_y)
+
+    @jax.jit
+    def partial_fn(state, idx, mask, w, key):
+        x = jnp.take(dev[0], idx, axis=0)
+        y = jnp.take(dev[1], idx, axis=0)
+        rngs = jax.random.split(key, mask.shape[0])
+        outs = run_clients(state, x, y, mask, rngs, None)
+        partial = federated.build_aggregates(spec, red, server_opt, state,
+                                             outs, w)
+        return partial, jnp.sum(outs.loss * w), jnp.sum(w)
+
+    lat_median = float(getattr(args, "async_latency_median_s", 0.0) or 0.0)
+    lat_sigma = float(getattr(args, "async_latency_sigma", 1.5) or 1.5)
+    seed = int(getattr(args, "random_seed", 0))
+    while True:
+        msg = ep.recv()
+        if msg.get_type() == MSG_TYPE_ASYNC_FINISH:
+            return
+        if msg.get_type() != MSG_TYPE_ASYNC_DISPATCH:
+            continue
+        gen = int(msg.get("gen"))
+        version = int(msg.get("version"))
+        api.state = fser.from_state_dict(api.state, msg.get("state"))
+        with tracer.span("async.worker_round", cat="round", gen=gen,
+                         worker=rank):
+            _clients, idx, mask, w, _steps = api._stage_round_arrays(gen)
+            key = rng_util.round_key(rng_util.root_key(api.seed), gen)
+            partial, lw, ws = partial_fn(api.state, jnp.asarray(idx),
+                                         jnp.asarray(mask),
+                                         jnp.asarray(w), key)
+            jax.block_until_ready(partial)
+            if lat_median > 0:
+                rng = hostrng.gen(seed, WORKER_LATENCY_TAG, rank, gen)
+                time.sleep(float(traffic.lognormal_latencies(
+                    rng, lat_median, lat_sigma, 1)[0]))
+        up = Message(MSG_TYPE_ASYNC_UPDATE, rank, 0)
+        up.add_params("gen", gen)
+        up.add_params("version", version)
+        up.add_params("worker", rank)
+        up.add_params("partial", fser.to_state_dict(partial))
+        up.add_params("loss_w", np.asarray(lw))
+        up.add_params("w_sum", float(ws))
+        ep.send(up)

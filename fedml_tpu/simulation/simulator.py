@@ -27,6 +27,13 @@ class SimulatorSingleProcess:
             from .sp.hierarchical_fl import HierarchicalFedAvgAPI
             self.fl_trainer = HierarchicalFedAvgAPI(args, device, dataset,
                                                     model, client_mode=mode)
+        elif alg == "fedbuff":
+            # buffered-async aggregation (docs/ASYNC.md): size-K update
+            # buffer + staleness discount over the event-driven arrival
+            # simulator; async_base_optimizer picks the underlying spec
+            from .async_engine import FedBuffAPI
+            self.fl_trainer = FedBuffAPI(args, device, dataset, model,
+                                         client_mode=mode)
         elif alg in ("async_fedavg", "fedasync"):
             from .sp.async_fedavg import AsyncFedAvgAPI
             self.fl_trainer = AsyncFedAvgAPI(args, device, dataset, model,

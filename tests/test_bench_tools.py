@@ -151,6 +151,28 @@ def test_bench_serve_mt_quick(monkeypatch):
     assert load["tokens_per_s"] > 0
 
 
+def test_bench_async_quick(monkeypatch):
+    """bench.py --async smoke: fedbuff vs sync FedAvg under the shared
+    heavy-tailed latency model runs green — both engines reach the (easy
+    quick-mode) target accuracy, the sim-wall-clock speedup is reported,
+    and steady state is pinned at zero recompiles with buffer occupancy
+    and staleness varying as traced data (the >=1x full-size headline
+    comes from BENCH_r10, not this trimmed cohort)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_ASYNC_QUICK", "1")
+    out = bench.bench_async()
+    assert out["quick"] is True
+    assert out["buffer_k"] == out["cohort"] == 8
+    assert out["sync_rounds_to_target"] is not None
+    assert out["fedbuff_applies_to_target"] is not None
+    assert out["sync_sim_wallclock_to_target_s"] > 0
+    assert out["fedbuff_sim_wallclock_to_target_s"] > 0
+    # the lockstep round is gated by its straggler; arrivals are not
+    assert out["async_wallclock_speedup"] > 1.0
+    assert out["steady_compiles_async"] == 0
+    assert out["fedbuff_steady_host_s_per_apply"] > 0
+
+
 def test_bench_verify_quick(monkeypatch):
     """bench.py --verify smoke: the fedverify census row runs green —
     programs lower+compile, zero unsuppressed contract violations, and

@@ -285,6 +285,16 @@ def test_fedtrace_golden_values_are_hand_checkable():
     assert s["page_hit_rate"] == 0.75
     assert s["writeback_lag_rounds"] == 0.0
     assert s["spans"]["store.page_in"] == {"count": 2, "total_s": 0.06}
+    # buffered-async telemetry (fedbuff, docs/ASYNC.md): the K=8 apply's
+    # occupancy, the 1/3 staleness envelope of its landed rows, 2 dropped
+    # updates, the 12.5s virtual clock, and the dispatch (0.03s) + two
+    # arrival (0.001s each) spans
+    assert s["buffer_occupancy_last"] == 8.0
+    assert s["staleness_p50"] == 1.0 and s["staleness_p99"] == 3.0
+    assert s["async_updates_dropped"] == 2.0
+    assert s["async_sim_time_s"] == 12.5
+    assert s["spans"]["async.dispatch"] == {"count": 1, "total_s": 0.03}
+    assert s["spans"]["async.arrival"] == {"count": 2, "total_s": 0.002}
 
 
 def _run_cli(*args):

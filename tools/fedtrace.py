@@ -269,6 +269,19 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         out["page_hit_rate"] = round(counters["store.page_hit_rate"], 6)
     if "store.writeback_lag_rounds" in counters:
         out["writeback_lag_rounds"] = counters["store.writeback_lag_rounds"]
+    # buffered-async plane (fedbuff, docs/ASYNC.md): last-apply buffer
+    # occupancy, the per-apply staleness envelope, and the cumulative
+    # dropped-update count the engine emits at every buffer apply
+    if "async.buffer_occupancy" in counters:
+        out["buffer_occupancy_last"] = counters["async.buffer_occupancy"]
+    if "async.staleness_p50" in counters:
+        out["staleness_p50"] = round(counters["async.staleness_p50"], 6)
+    if "async.staleness_p99" in counters:
+        out["staleness_p99"] = round(counters["async.staleness_p99"], 6)
+    if "async.updates_dropped" in counters:
+        out["async_updates_dropped"] = counters["async.updates_dropped"]
+    if "async.sim_time_s" in counters:
+        out["async_sim_time_s"] = round(counters["async.sim_time_s"], 6)
     # multi-tenant serving plane (docs/SERVING.md): admission spans and
     # the batching engine's host counters — admission-queue depth,
     # windowed tokens/s, and per-adapter request counts ("base" is
@@ -692,6 +705,14 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"store paging: {s.get('page_in_bytes', 0.0):.0f} B paged in   "
             f"hit rate {s.get('page_hit_rate', 0.0):g}   "
             f"writeback lag {s.get('writeback_lag_rounds', 0.0):g} rounds")
+    if "buffer_occupancy_last" in s:
+        lines.append(
+            f"async buffer: occupancy (last) "
+            f"{s['buffer_occupancy_last']:g}   staleness p50/p99 "
+            f"{s.get('staleness_p50', 0.0):g}/"
+            f"{s.get('staleness_p99', 0.0):g}   dropped "
+            f"{s.get('async_updates_dropped', 0.0):g}   sim clock "
+            f"{s.get('async_sim_time_s', 0.0):g}s")
     if "serve_admits" in s or "serve_adapter_requests" in s:
         ad = s.get("serve_adapter_requests", {})
         lines.append(

@@ -48,16 +48,16 @@ sys.path.insert(0, REPO)
 if os.environ.get("FEDML_TPU_PLATFORM") is None:
     os.environ["FEDML_TPU_PLATFORM"] = "cpu"   # tunnel discipline
 
+# the traffic shapes are shared with the async arrival simulator
+# (fedml_tpu/core/traffic.py, docs/ASYNC.md); zipf_weights stays re-exported
+# here so `from serve_load import zipf_weights` keeps working
+from fedml_tpu.core.traffic import (  # noqa: E402
+    lognormal_sizes, poisson_arrivals, zipf_weights)
+
 
 def _percentile(vals: Sequence[float], q: float) -> float:
     return float(np.percentile(np.asarray(vals, np.float64), q)) \
         if len(vals) else 0.0
-
-
-def zipf_weights(n: int, a: float = 1.2) -> np.ndarray:
-    """Zipf popularity over n choices: rank r gets mass ∝ 1/r^a."""
-    w = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** a
-    return w / w.sum()
 
 
 def run_load(engine, *, target_rps: float, n_requests: int,
@@ -75,13 +75,12 @@ def run_load(engine, *, target_rps: float, n_requests: int,
     XLA compilation.
     """
     rng = np.random.default_rng(seed)
-    gaps = rng.exponential(1.0 / float(target_rps), n_requests)
-    arrival = np.cumsum(gaps)
+    arrival = poisson_arrivals(rng, target_rps, n_requests)
     weights = zipf_weights(len(adapters), zipf_a)
     choice = rng.choice(len(adapters), size=n_requests, p=weights)
-    lens = np.clip(rng.lognormal(np.log(prompt_len_mean), prompt_len_sigma,
-                                 n_requests).astype(np.int64),
-                   1, max(1, engine.buf_len - max_new_tokens - 1))
+    lens = lognormal_sizes(rng, prompt_len_mean, prompt_len_sigma,
+                           n_requests,
+                           hi=max(1, engine.buf_len - max_new_tokens - 1))
     prompts = [rng.integers(2, vocab, int(n)).tolist() for n in lens]
 
     lat: List[float] = [0.0] * n_requests

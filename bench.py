@@ -46,6 +46,14 @@ steady-state s/round untraced vs. traced (acceptance: <5% overhead) plus the
 json line (docs/OBSERVABILITY.md); FEDML_TRACE_OUT=path keeps the Chrome
 trace.
 
+``python bench.py --health`` runs the fedmon federation-health plane
+(docs/OBSERVABILITY.md) on a label-flip injection scenario: 10% flipped
+clients detected by the robust per-client anomaly detector
+(precision/recall pinned), the live /metrics + /healthz endpoint scraped
+mid-run with a deliberately violated straggler SLO driving the
+ok→degraded transition, and steady-state overhead health-on vs health-off
+(acceptance ≤ 3%), one json line.
+
 ``vs_baseline``: the reference has no published numbers (BASELINE.md), so the
 ratio is measured against an in-process torch-CPU eager reimplementation of
 the reference's client loop (``my_model_trainer_classification.py``
@@ -1193,6 +1201,190 @@ def bench_trace(rounds: int | None = None,
     return out
 
 
+# -- fedmon federation-health benchmark (--health) ---------------------------
+def bench_health(rounds: int | None = None) -> dict:
+    """--health: the fedmon federation-health plane (ISSUE 14,
+    docs/OBSERVABILITY.md) on a LABEL-FLIP injection scenario.
+
+    Trains sp FedAvg with 10% of clients' labels flipped and ``health``
+    on, with the live ``/metrics`` + ``/healthz`` endpoint up for the
+    whole run: scrapes BOTH mid-run (prometheus parse of the health
+    gauges) and around a deliberately violated straggler SLO
+    (round-time bound of 1µs ⇒ ``/healthz`` must transition
+    ok→degraded), then scores the detector against the known flipped
+    set (acceptance: precision ≥ 0.9 AND recall ≥ 0.9) and times
+    steady-state rounds health-off vs health-on interleaved (acceptance:
+    ≤ 3% overhead — the per-client stat rows are a few reductions inside
+    the already-compiled round).  FEDML_HEALTH_QUICK=1 shrinks the run
+    for the tier-1 smoke (3 timed rounds, 64 clients)."""
+    import json as json_mod
+    import tempfile
+    import threading
+    import urllib.request
+
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod, obs
+    from fedml_tpu.obs.metricsd import parse_prometheus_text, prom_value
+    from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+
+    quick = os.environ.get("FEDML_HEALTH_QUICK") == "1"
+    total = 64 if quick else CLIENTS_PER_ROUND
+    cpr = 32 if quick else CLIENTS_PER_ROUND // 2
+    det_rounds = 6 if quick else 12
+    timed_rounds = rounds or (3 if quick else ROUNDS_TIMED)
+    n_flip = max(1, total // 10)
+    out = {"quick": quick, "clients": total, "clients_per_round": cpr,
+           "flipped_clients": n_flip, "detection_rounds": det_rounds}
+
+    def make_api(health, flip, **over):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="lr", client_num_in_total=total,
+            client_num_per_round=cpr, comm_round=10 ** 6, epochs=1,
+            batch_size=BATCH, learning_rate=0.03, partition_method="homo",
+            frequency_of_the_test=10 ** 9, random_seed=0, health=health,
+        )
+        args.update(**over)
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        flipped = []
+        if flip:
+            rng = np.random.default_rng(0)
+            flipped = sorted(rng.choice(total, size=n_flip,
+                                        replace=False).tolist())
+            for c in flipped:
+                idx = dataset.client_idxs[c]
+                dataset.train_y[idx] = (NUM_CLASSES - 1) \
+                    - dataset.train_y[idx]
+        model = model_mod.create(args, out_dim)
+        return FedAvgAPI(args, None, dataset, model,
+                         client_mode="vmap"), flipped
+
+    # -- overhead: health-off vs health-on, interleaved min-of-pairs -------
+    api_off, _ = make_api(health=False, flip=False)
+    api_on, _ = make_api(health=True, flip=False)
+    for api in (api_off, api_on):
+        api.train_one_round(0)   # compile
+        api.train_one_round(1)
+        _readback(api.state.global_params)
+    rtt = measure_rtt()
+    done = {id(api_off): [2], id(api_on): [2]}
+
+    def run_n_for(api):
+        def run_n(n):
+            for _ in range(n):
+                api.train_one_round(done[id(api)][0])
+                done[id(api)][0] += 1
+        return run_n
+
+    samples = {False: [], True: []}
+    for on in (False, True, False, True):
+        api = api_on if on else api_off
+        samples[on].append(_timed_chain(
+            run_n_for(api), lambda a=api: _readback(a.state.global_params),
+            min_total_s=0.5 if quick else 2.0, n0=timed_rounds, rtt=rtt))
+    out["plain_s_per_round"] = round(min(samples[False]), 5)
+    out["health_s_per_round"] = round(min(samples[True]), 5)
+    out["health_overhead_pct"] = round(
+        100.0 * (out["health_s_per_round"] / out["plain_s_per_round"]
+                 - 1.0), 2)
+
+    # -- detection scenario with the live endpoint up ----------------------
+    # deliberately-violated straggler SLO: any real round breaches 1µs,
+    # so /healthz must transition ok -> degraded once rounds flow
+    slo = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    slo.write("slos:\n"
+              "  - name: straggler_round_time\n"
+              "    metric: health.round_time_s\n"
+              "    max: 0.000001\n"
+              "  - name: anomaly_rate\n"
+              "    metric: health.anomaly_rate\n"
+              "    max: 0.5\n")
+    slo.close()
+    obs.configure(enabled=True, reset=True)
+    try:
+        # frequency_of_the_test=1: fedmon observes at the driver's flush,
+        # so a LIVE health run flushes every round (the overhead numbers
+        # above measure the deferred-flush steady state separately)
+        api, flipped = make_api(health=True, flip=True, metrics_port=0,
+                                health_slo_path=slo.name, trace=True,
+                                frequency_of_the_test=1)
+        api.comm_rounds = det_rounds
+        url = api.metrics_server.url
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            out["healthz_before"] = json_mod.loads(r.read())["status"]
+
+        mid: dict = {}
+
+        def scrape_mid():
+            # poll until the first flushed round's gauges appear (round 0
+            # includes the compile), then record the LIVE snapshot
+            deadline = time.time() + 60.0
+            try:
+                while time.time() < deadline:
+                    with urllib.request.urlopen(url + "/metrics",
+                                                timeout=10) as r:
+                        samples_ = parse_prometheus_text(r.read().decode())
+                    ro = prom_value(samples_, "fedmon_gauge",
+                                    name="health.rounds_observed")
+                    if ro:
+                        mid["rounds_observed"] = ro
+                        mid["anomaly_rate"] = prom_value(
+                            samples_, "fedmon_gauge",
+                            name="health.anomaly_rate")
+                        return
+                    time.sleep(0.05)
+                mid["error"] = "no fedmon gauges before deadline"
+            except Exception as e:
+                mid["error"] = repr(e)
+
+        scraper = threading.Thread(target=scrape_mid, daemon=True)
+        scraper.start()
+        api.train()
+        scraper.join(timeout=90.0)
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            hz = json_mod.loads(r.read())
+        out["healthz_after"] = hz["status"]
+        out["healthz_transition_ok"] = (out["healthz_before"] == "ok"
+                                        and hz["status"] == "degraded")
+        out["mid_run_scrape"] = mid
+        flagged = api.health_monitor.flagged()
+        tp = len(set(flagged) & set(flipped))
+        fp = len(set(flagged) - set(flipped))
+        out["detector_precision"] = round(tp / max(tp + fp, 1), 4)
+        out["detector_recall"] = round(tp / max(len(flipped), 1), 4)
+        out["flagged_count"] = len(flagged)
+        out["health_gauges"] = {k: round(v, 6) for k, v in
+                                api.health_monitor.gauges().items()}
+        # offline report parity: the captured trace replays to the same
+        # flagged set through tools/fedtrace.py health
+        fedtrace = _import_fedtrace()
+        h = fedtrace.health_report(obs.get_tracer().export_chrome())
+        out["offline_report_flagged_matches"] = \
+            h["flagged_clients"] == flagged
+        api.metrics_server.close()
+    finally:
+        obs.configure(enabled=False)
+        os.unlink(slo.name)
+
+    # perf-regression gate (tools/fedtrace.py regress) over this row
+    fedtrace = _import_fedtrace()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = fedtrace.regress(
+            out, fedtrace.load_bands(
+                os.path.join(repo, fedtrace.DEFAULT_BANDS_FILE)),
+            fedtrace.load_trajectory(repo))
+        out["regress"] = {"ok": r["ok"], "checked": r["checked"],
+                          "regressions": r["regressions"]}
+    except (OSError, ValueError, KeyError) as e:
+        out["regress"] = {"error": str(e)}
+    return out
+
+
 # -- LLM LoRA single-chip benchmark ------------------------------------------
 def bench_llm_lora(on_accelerator: bool, peak: float | None,
                    batch: int | None = None, remat: str | None = None,
@@ -1853,6 +2045,19 @@ def main():
             "value": result["trace_overhead_pct"],
             "unit": "pct_overhead_traced_vs_untraced",
             "vs_baseline": None,
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--health" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_health()
+        result.update({
+            "metric": "fedmon_labelflip_detection_and_overhead",
+            "value": result["detector_recall"],
+            "unit": "recall_at_10pct_flipped",
+            "vs_baseline": result["detector_precision"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -214,6 +214,12 @@ _HOST_SYNC_ATTRS = {"item", "tolist", "to_py"}
 # through the round's outputs (ObsCarry) and feeds the tracer at the
 # driver's existing sync point
 _TRACER_SINK_ATTRS = {"counter", "add_bytes", "round_obs"}
+# fedmon health sinks (docs/OBSERVABILITY.md): the HealthMonitor is a
+# host-side detector — feeding it a traced per-client stat inside a jitted
+# region forces the same sync the tracer sinks do; the sanctioned pattern
+# returns the stat rows through the metrics pytree and observes at the
+# driver's flush
+_HEALTH_SINK_ATTRS = {"observe", "observe_round", "flag"}
 
 _HOST_STORE_ATTRS = {"get", "gather", "scatter", "page_in", "write_back",
                      "lookup", "load"}
@@ -531,6 +537,15 @@ def _is_tracer_receiver(node: ast.AST) -> bool:
     return False
 
 
+def _is_health_receiver(node: ast.AST) -> bool:
+    """``health_monitor.observe_round(...)`` / ``self._health.flag(...)``
+    — receivers naming the fedmon monitor (the ``health``/``monitor``
+    lexical convention, like the store-name rule)."""
+    name = _receiver_name(node)
+    return name is not None and ("health" in name.lower()
+                                 or "monitor" in name.lower())
+
+
 def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
     for node in ast.walk(mv.mod.tree):
         if not isinstance(node, (ast.Call, ast.Subscript)):
@@ -586,6 +601,17 @@ def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
                        "the round's outputs (ObsCarry) and feed the "
                        "tracer at the driver's sync point "
                        "(docs/OBSERVABILITY.md)")
+            elif fn.attr in _HEALTH_SINK_ATTRS and \
+                    _is_health_receiver(fn.value) and \
+                    any(not _is_staticish(a) for a in
+                        list(node.args[1:])
+                        + [kw.value for kw in node.keywords]):
+                msg = (f"fedmon health sink .{fn.attr}() fed a (possibly "
+                       "traced) value inside jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}' — a "
+                       "host sync at this line; return the per-client "
+                       "stat rows through the metrics pytree and observe "
+                       "at the driver's flush (docs/OBSERVABILITY.md)")
             elif fn.attr in _HOST_STORE_ATTRS and \
                     _is_store_name(_receiver_name(fn.value)):
                 msg = (f"host client-state store access "

@@ -194,6 +194,22 @@ _DEFAULTS: Dict[str, Any] = dict(
     # worker-pool size of the multi-process async driver
     # (simulation/async_driver.py::run_async_federation)
     async_workers=0,
+    # fedmon federation-health plane (docs/OBSERVABILITY.md, ISSUE 14):
+    # health=True computes fixed-shape per-client stat rows IN-TRACE
+    # (update norm / cosine-to-cohort-mean / loss delta / async staleness)
+    # and runs the host-side anomaly+drift monitor over them at the
+    # existing log-round flush; metrics_port serves the live /metrics ·
+    # /healthz · /debug/health endpoint (0 = ephemeral port; multi-process
+    # drivers offset nonzero ports by rank); health_slo_path points at the
+    # declarative ok/degraded/unhealthy SLO rule YAML (obs/health.py —
+    # default rules apply when unset).  health_z / health_ewm_alpha /
+    # health_min_obs tune the robust-z detector (0 = built-in default).
+    health=False,
+    health_slo_path=None,
+    metrics_port=None,
+    health_z=0.0,
+    health_ewm_alpha=0.0,
+    health_min_obs=0,
     # fedscope straggler injection for the multi-process two-tier driver
     # (store/hierarchy.py::run_silo_federation): hold silo
     # `silo_slow_rank`'s round open by `silo_slow_s` seconds
@@ -232,11 +248,23 @@ def validate_args(args) -> None:
                 f"{' + '.join(bad)} — the buffered-async driver applies "
                 "the update buffer event-by-event on the sp engine "
                 "(docs/ASYNC.md)")
+    if bool(getattr(args, "health", False)) and \
+            bool(getattr(args, "cohort_bucketing", False)):
+        raise ValueError(
+            "incompatible flags: health + cohort_bucketing — the bucketed "
+            "round has no single per-client stat surface (bucket partials "
+            "merge host-side); drop one of the two")
     pop = int(getattr(args, "population", 0) or 0)
     axes = getattr(args, "population_axes", None) or {}
     has_pop = pop > 1 or bool(axes)
     if not has_pop:
         return
+    pop_flag0 = "population_axes" if axes else "population"
+    if bool(getattr(args, "health", False)):
+        raise ValueError(
+            f"incompatible flags: {pop_flag0} + health — per-client health "
+            "rows are single-experiment (the stat stream is keyed by "
+            "client id, not member); drop one of the two")
     pop_flag = "population_axes" if axes else "population"
     if bool(getattr(args, "cohort_bucketing", False)):
         raise ValueError(

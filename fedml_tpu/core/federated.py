@@ -371,6 +371,71 @@ def scale_partial(spec: "AlgorithmSpec", partial: Dict[str, Any],
 
 
 # --------------------------------------------------------------------------
+# fedmon per-client health stats (docs/OBSERVABILITY.md, ISSUE 14)
+# --------------------------------------------------------------------------
+
+#: stat lanes of the in-trace per-client health rows (the async engine
+#: appends a ``staleness`` lane at buffer-apply time)
+HEALTH_STAT_FIELDS = ("update_norm", "cosine", "loss_delta", "weight")
+
+
+def client_health_stats(old_params: Pytree, client_params: Pytree,
+                        ref_delta: Pytree, loss, weights
+                        ) -> Dict[str, jnp.ndarray]:
+    """Fixed-shape per-client health stat rows, computed IN-TRACE.
+
+    The fedmon contract (the PR 4 discipline extended): these are a few
+    extra reductions over data the round already holds — the stacked
+    per-client new params vs the broadcast ``old_params`` and a reference
+    direction ``ref_delta`` (the server update ``new − old`` on the sync
+    engines; the generation's weighted-mean delta on the async engine) —
+    returned through the SAME metrics pytree the loss rides, so health on
+    adds ZERO host syncs / explicit transfers / steady-state compiles.
+
+    Returns ``(C,)`` f32 lanes: ``update_norm`` = ‖Δ_i‖₂, ``cosine`` =
+    cos(Δ_i, ref_delta) (the label-flip signature is a strongly negative
+    cosine), ``loss_delta`` = loss_i − cohort weighted-mean loss, and the
+    real-client ``weight`` mask (mesh pad rows read 0 and are dropped by
+    the host-side monitor).  Under the mesh the cohort axis is GSPMD-
+    sharded over ``client`` and each lane reduces locally per client —
+    no new collectives beyond the one scalar mean."""
+    f32 = jnp.float32
+    w = jnp.asarray(weights, f32)
+
+    def leaf_stats(cp, op, rd):
+        c = cp.shape[0]
+        d = cp.astype(f32).reshape(c, -1) - op.astype(f32).reshape(1, -1)
+        r = rd.astype(f32).reshape(-1)
+        return jnp.sum(d * d, axis=1), d @ r, jnp.sum(r * r)
+
+    per_leaf = list(map(leaf_stats,
+                        jax.tree_util.tree_leaves(client_params),
+                        jax.tree_util.tree_leaves(old_params),
+                        jax.tree_util.tree_leaves(ref_delta)))
+    sq = sum(p[0] for p in per_leaf)        # (C,) ‖Δ_i‖²
+    dot = sum(p[1] for p in per_leaf)       # (C,) ⟨Δ_i, ref⟩
+    ref_sq = sum(p[2] for p in per_leaf)    # scalar ‖ref‖²
+    norm = jnp.sqrt(sq)
+    cosine = dot / jnp.maximum(norm * jnp.sqrt(ref_sq), 1e-12)
+    loss = jnp.asarray(loss, f32)
+    mean_loss = jnp.sum(w * loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return {"update_norm": norm, "cosine": cosine,
+            "loss_delta": loss - mean_loss, "weight": w}
+
+
+def cohort_mean_delta(old_params: Pytree, client_params: Pytree,
+                      weights) -> Pytree:
+    """Weighted cohort-mean update direction ``Σ w_i Δ_i / Σ w_i`` — the
+    reference direction when no post-update params exist yet (the async
+    engine computes health rows at DISPATCH, before any apply)."""
+    w = jnp.asarray(weights, jnp.float32)
+    den = jnp.maximum(jnp.sum(w), 1e-12)
+    return jax.tree_util.tree_map(
+        lambda cp, op: jnp.tensordot(w, cp.astype(jnp.float32), axes=1)
+        / den - op.astype(jnp.float32), client_params, old_params)
+
+
+# --------------------------------------------------------------------------
 # trace-time-dynamic hyperparameters
 # --------------------------------------------------------------------------
 

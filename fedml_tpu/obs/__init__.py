@@ -21,6 +21,12 @@ steady-state compiles on the round hot path — pinned by the
    into a per-phase (staging / gather / client steps / merge / server
    update) time breakdown; ``diff`` compares two traces.
 
+fedmon (ISSUE 14) extends the plane with federation-health observability:
+:mod:`.health` (robust per-client anomaly / drift detection + declarative
+SLO rules over the per-client stat rows the engines compute in-trace) and
+:mod:`.metricsd` (the threaded ``/metrics`` · ``/healthz`` ·
+``/debug/health`` endpoint behind ``args.metrics_port``).
+
 See ``docs/OBSERVABILITY.md`` for the attribution model and the Perfetto
 how-to.
 """
@@ -28,12 +34,21 @@ how-to.
 from __future__ import annotations
 
 from . import context  # noqa: F401  (fedscope trace-context propagation)
+from .health import (  # noqa: F401  (stdlib-only, like the tracer)
+    DEFAULT_SLO_RULES,
+    HealthConfig,
+    HealthMonitor,
+    evaluate_slos,
+    load_slo_rules,
+)
 from .tracer import (  # noqa: F401
     DEVICE_PHASES,
     PHASES,
     Tracer,
     configure,
+    escape_label_value,
     get_tracer,
+    sanitize_metric_name,
     trace_enabled,
 )
 
@@ -42,13 +57,22 @@ from .tracer import (  # noqa: F401
 #: pulls in jax + flax.
 _CARRY_EXPORTS = ("ObsCarry", "OPT_FLOPS", "obs_host", "obs_host_rows",
                   "param_count", "round_obs")
+#: :mod:`.metricsd` exports, lazy for the same reason (http.server)
+_METRICSD_EXPORTS = ("MetricsServer", "parse_prometheus_text",
+                     "prom_value", "start_from_args")
 
-__all__ = ["DEVICE_PHASES", "PHASES", "Tracer", "configure", "context",
-           "get_tracer", "trace_enabled", *_CARRY_EXPORTS]
+__all__ = ["DEVICE_PHASES", "PHASES", "DEFAULT_SLO_RULES", "HealthConfig",
+           "HealthMonitor", "Tracer", "configure", "context",
+           "escape_label_value", "evaluate_slos", "get_tracer",
+           "load_slo_rules", "sanitize_metric_name", "trace_enabled",
+           *_CARRY_EXPORTS, *_METRICSD_EXPORTS]
 
 
 def __getattr__(name):
     if name in _CARRY_EXPORTS:
         from . import carry
         return getattr(carry, name)
+    if name in _METRICSD_EXPORTS:
+        from . import metricsd
+        return getattr(metricsd, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

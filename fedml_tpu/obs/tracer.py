@@ -25,6 +25,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import re
 import socket
 import threading
 import time
@@ -42,6 +43,29 @@ PHASES = ("staging",) + DEVICE_PHASES
 #: duration arrives after the fact; emitting it on the caller thread would
 #: cross-nest with whatever span is open there)
 COMPILE_TID = -2
+
+_PROM_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """A legal Prometheus metric name: every reserved character folds to
+    ``_`` and a leading digit gains one (``serve.tokens/s`` →
+    ``serve_tokens_s``).  The historical dump interpolated raw names —
+    a counter or span named outside ``[a-zA-Z0-9_:]`` emitted a line a
+    Prometheus parser rejects."""
+    name = _PROM_NAME_BAD.sub("_", str(name))
+    if not name or not _PROM_NAME_OK.match(name):
+        name = "_" + name
+    return name
+
+
+def escape_label_value(value) -> str:
+    """Prometheus label-value escaping: backslash, double-quote and
+    newline (the three characters the text format reserves — adapter
+    names / span args containing ``"`` previously broke the dump)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class _NullSpan:
@@ -353,18 +377,29 @@ class Tracer:
             }
 
     def export_prometheus(self, path: Optional[str] = None) -> str:
-        """Prometheus text-format aggregate of span totals + counters."""
+        """Prometheus text-format aggregate of span totals + counters.
+
+        Span / counter names ride as label VALUES (escaped — names like
+        ``serve.requests.cohort-"1"`` are data here, not metric names),
+        and the metric names themselves pass ``sanitize_metric_name`` so
+        every emitted line survives a real Prometheus parser
+        (round-tripped in tests via
+        :func:`~fedml_tpu.obs.metricsd.parse_prometheus_text`)."""
         s = self.summary()
-        lines = ["# TYPE fedtrace_span_seconds_total counter",
-                 "# TYPE fedtrace_span_count counter",
-                 "# TYPE fedtrace_counter gauge"]
+        m_total = sanitize_metric_name("fedtrace_span_seconds_total")
+        m_count = sanitize_metric_name("fedtrace_span_count")
+        m_gauge = sanitize_metric_name("fedtrace_counter")
+        lines = [f"# TYPE {m_total} counter",
+                 f"# TYPE {m_count} counter",
+                 f"# TYPE {m_gauge} gauge"]
         for name, row in s["spans"].items():
-            lines.append(f'fedtrace_span_seconds_total{{name="{name}"}} '
+            lbl = escape_label_value(name)
+            lines.append(f'{m_total}{{name="{lbl}"}} '
                          f'{row["total_s"]:.9f}')
-            lines.append(f'fedtrace_span_count{{name="{name}"}} '
-                         f'{row["count"]}')
+            lines.append(f'{m_count}{{name="{lbl}"}} {row["count"]}')
         for name, v in sorted(s["counters"].items()):
-            lines.append(f'fedtrace_counter{{name="{name}"}} {v:g}')
+            lines.append(f'{m_gauge}{{name="{escape_label_value(name)}"}} '
+                         f'{v:g}')
         text = "\n".join(lines) + "\n"
         if path:
             with open(path, "w") as fh:

@@ -80,8 +80,17 @@ class ContinuousBatchingEngine:
                  prefix_cache_slots: int = 0,
                  prefix_max_tail: int = TAIL_BLOCK,
                  adapter_registry: Optional[AdapterRegistry] = None,
-                 adapter_slots: int = 0):
+                 adapter_slots: int = 0,
+                 metrics_port: Optional[int] = None):
         self.model = model
+        # fedmon live export (docs/OBSERVABILITY.md): metrics_port serves
+        # /metrics + /healthz over the global tracer's serve.* gauges
+        # (0 = ephemeral; None = off); closed by stop()
+        self.metrics_server = None
+        if metrics_port is not None:
+            from ..obs.metricsd import MetricsServer
+            self.metrics_server = MetricsServer(port=int(metrics_port))
+            self.metrics_server.start()
         self.raw_params = _unwrap_params(params)
         self.n_slots = int(slots)
         self.buf_len = int(buf_len)
@@ -327,6 +336,9 @@ class ContinuousBatchingEngine:
         with self._cond:
             self._cond.notify()
         self._thread.join(timeout=10)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
     def step_programs(self):
         """fedverify hook (ISSUE 10, docs/FEDVERIFY.md): the engine's

@@ -454,7 +454,8 @@ class OpenAICompatServer:
                  decode_horizon: int = 1, spec_k: int = 4,
                  prefix_cache_slots: int = 0,
                  prefix_max_tail: int = TAIL_BLOCK,
-                 adapters=None, adapter_slots: int = 0):
+                 adapters=None, adapter_slots: int = 0,
+                 metrics_port: Optional[int] = None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -472,6 +473,10 @@ class OpenAICompatServer:
         self.tokenizer = tokenizer or ByteTokenizer()
         self.model_name = model_name
         self.host, self.port = host, port
+        # fedmon live export: a sibling /metrics + /healthz endpoint over
+        # the tracer's serve.* gauges (started/stopped with the server)
+        self.metrics_port = metrics_port
+        self.metrics_server = None
         self.buf_len = buf_len
         self.model = model
         # speculative decode (requires model + a draft; greedy requests
@@ -887,6 +892,11 @@ class OpenAICompatServer:
         self.port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever,
                          daemon=True).start()
+        if self.metrics_port is not None and self.metrics_server is None:
+            from ...obs.metricsd import MetricsServer
+            self.metrics_server = MetricsServer(
+                port=int(self.metrics_port), host=self.host)
+            self.metrics_server.start()
         log.info("openai-compatible endpoint on %s:%d", self.host, self.port)
         return self.port
 
@@ -894,6 +904,9 @@ class OpenAICompatServer:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
         if self._engine is not None:
             self._engine.stop()
             self._engine = None

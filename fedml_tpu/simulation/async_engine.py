@@ -158,6 +158,9 @@ class FedBuffAPI(FedAvgAPI):
         self._next_gen = 0
         self._version = 0
         self._occ_host = 0           # host mirror of traced occupancy
+        # fedmon: host mirror of which client landed in each buffer slot
+        # (the apply's per-slot health lanes pair with these ids)
+        self._slot_clients = np.zeros(self.buffer_k, np.int64)
         self._staleness_window: list = []
         self.updates_dropped = 0
         self.clients_dispatched = 0
@@ -176,6 +179,8 @@ class FedBuffAPI(FedAvgAPI):
                                        self._client_mode)
         dev_x, dev_y = self._dev_x, self._dev_y
 
+        health = self._health
+
         def dispatch_fn(state, idx, mask, w, key, c_stacked):
             x = jnp.take(dev_x, idx, axis=0)
             y = jnp.take(dev_y, idx, axis=0)
@@ -189,6 +194,18 @@ class FedBuffAPI(FedAvgAPI):
                               "w": jnp.asarray(w, jnp.float32)}
             rows["__steps"] = {"src": jnp.asarray(outs.num_steps,
                                                   jnp.float32)}
+            if health:
+                # fedmon (ISSUE 14): per-client stat rows evaluated at
+                # DISPATCH against the generation's own cohort — the
+                # reference direction is the generation's weighted-mean
+                # delta (no post-apply params exist yet); rows land in
+                # the buffer like every other lane, staleness joins at
+                # apply from the buffer's tau lane
+                rows["__health"] = federated.client_health_stats(
+                    state.global_params, outs.params,
+                    federated.cohort_mean_delta(state.global_params,
+                                                outs.params, w),
+                    outs.loss, w)
             return rows, outs.new_client_state
 
         return jax.jit(dispatch_fn)
@@ -196,6 +213,7 @@ class FedBuffAPI(FedAvgAPI):
     def _build_apply_fn(self):
         spec = self.server_opt.spec
         server_opt = self.server_opt
+        health = self._health
 
         def apply_fn(state, buf):
             new_state, agg, fresh = federated.update_buffer_apply(
@@ -212,6 +230,12 @@ class FedBuffAPI(FedAvgAPI):
                 "buffer_occupancy": buf["occupancy"],
                 "model_version": buf["version"],
             }
+            if health:
+                # per-slot stat lanes landed at arrival + the buffer's own
+                # staleness lane; the driver pairs them with its host-side
+                # slot→client map
+                h = buf["rows"]["__health"]
+                metrics["health"] = dict(h, staleness=buf["tau"])
             return new_state, metrics, fresh
 
         # the buffer is donated (reset in place every apply); the state is
@@ -307,6 +331,7 @@ class FedBuffAPI(FedAvgAPI):
                                    latency_s=round(ev.latency_s, 6)):
                 self.buffer = self._add_fn(self.buffer, gen.rows, idx,
                                            slots, s, taus)
+            self._slot_clients[slots[0]] = ev.client
             self._occ_host += 1
             self.updates_buffered += 1
             self._staleness_window.append(tau)
@@ -362,6 +387,13 @@ class FedBuffAPI(FedAvgAPI):
             staleness_mean=0.0, staleness_max=0.0,
             buffer_occupancy=float(self.buffer_k),
             model_version=float(self._version))
+        if self._health and metrics.get("health") is not None:
+            # the sync round's stat rows are in cohort order with zero
+            # staleness by construction
+            metrics["health"] = dict(
+                metrics["health"],
+                staleness=np.zeros(self.buffer_k, np.float32))
+            metrics["health_clients"] = np.asarray(gen.cohort, np.int64)
         return metrics
 
     # -- the driver round ---------------------------------------------------
@@ -391,6 +423,9 @@ class FedBuffAPI(FedAvgAPI):
                 self.state, metrics, self.buffer = self._apply_fn(
                     self.state, self.buffer)
                 self._occ_host = 0
+                if self._health:
+                    metrics = dict(metrics)
+                    metrics["health_clients"] = self._slot_clients.copy()
         self._version += 1
         metrics = dict(metrics)
         window = self._staleness_window

@@ -80,7 +80,8 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        state_template: ServerState = None,
                        donate: bool = False,
                        collective_precision: str = "fp32",
-                       quant_block: int = blockscale.DEFAULT_BLOCK):
+                       quant_block: int = blockscale.DEFAULT_BLOCK,
+                       health: bool = False):
     """round_fn(state, x|idx, y|·, mask, weights, key, c_clients) with the
     client axis sharded over the mesh.  In gather mode the first data arg is
     the (C, S, B) index tensor and ``y`` is the device-resident dataset pair
@@ -104,7 +105,7 @@ def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     round_fn = _make_mesh_round_core(trainer, server_opt, mesh, gather,
                                      sharded_data, update_sharding,
                                      state_template, collective_precision,
-                                     quant_block)
+                                     quant_block, health)
     return jax.jit(round_fn, donate_argnums=(0,) if donate else ())
 
 
@@ -113,7 +114,8 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
                           update_sharding: str,
                           state_template: ServerState,
                           collective_precision: str = "fp32",
-                          quant_block: int = blockscale.DEFAULT_BLOCK):
+                          quant_block: int = blockscale.DEFAULT_BLOCK,
+                          health: bool = False):
     """Unjitted round body shared by the per-round jit
     (:func:`make_mesh_round_fn`) and the fused round-block scan
     (:func:`make_mesh_block_fn`)."""
@@ -407,6 +409,17 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         new_state = layout.constrain_state(new_state, scatter, quantized)
         metrics = assemble_metrics(mraw, old_params,
                                    new_state.global_params, x, y)
+        if health:
+            # fedmon (ISSUE 14): per-client stat rows assembled at the JIT
+            # level where old/new params coexist on both merge layouts —
+            # the cohort axis stays GSPMD-sharded over ``client``, each
+            # lane reduces per client, and the rows ride the metrics
+            # pytree under the PR 4 zero-sync contract
+            ref_delta = jax.tree_util.tree_map(
+                lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+                new_state.global_params, old_params)
+            metrics["health"] = federated.client_health_stats(
+                old_params, outs.params, ref_delta, outs.loss, w)
         return new_state, metrics, outs.new_client_state
 
     return round_fn
@@ -419,7 +432,8 @@ def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        state_template: ServerState = None,
                        donate: bool = False,
                        collective_precision: str = "fp32",
-                       quant_block: int = blockscale.DEFAULT_BLOCK):
+                       quant_block: int = blockscale.DEFAULT_BLOCK,
+                       health: bool = False):
     """Fused mesh round-block: K rounds as ONE ``jit(lax.scan(round))``
     dispatch (ISSUE 3 tentpole; same composition DrJAX builds from,
     arXiv:2403.07128).
@@ -437,7 +451,7 @@ def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     core = _make_mesh_round_core(trainer, server_opt, mesh, gather,
                                  sharded_data, update_sharding,
                                  state_template, collective_precision,
-                                 quant_block)
+                                 quant_block, health)
     has_table = server_opt.algorithm in ("scaffold", "feddyn")
     layout = MeshLayout(mesh)
     row_sharding = NamedSharding(mesh, P(CLIENT_AXIS))
@@ -553,7 +567,8 @@ class MeshFedAvgAPI(FedAvgAPI):
                                   state_template=self.state,
                                   donate=self.DONATE_STATE,
                                   collective_precision=self.collective_precision,
-                                  quant_block=self.quant_block)
+                                  quant_block=self.quant_block,
+                                  health=self._health)
 
     def _init_server_state(self, params):
         """Replicated-layout init for the mesh: one EF residual row PER
@@ -603,7 +618,8 @@ class MeshFedAvgAPI(FedAvgAPI):
                                    state_template=self.state,
                                    donate=self.DONATE_STATE,
                                    collective_precision=self.collective_precision,
-                                   quant_block=self.quant_block)
+                                   quant_block=self.quant_block,
+                                   health=self._health)
         # the jitted block program itself (the dev_data closure below is
         # plain Python): what fedverify AOT-lowers (block_program hook)
         self._block_inner = inner

@@ -78,7 +78,8 @@ def make_run_clients(trainer: LocalTrainer, server_opt: ServerOptimizer,
 
 def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                   mode: str = "scan", collective_precision: str = "fp32",
-                  quant_block: int = blockscale.DEFAULT_BLOCK) -> Callable:
+                  quant_block: int = blockscale.DEFAULT_BLOCK,
+                  health: bool = False) -> Callable:
     """Build round_fn(state, x, y, mask, weights, key, c_clients, hp) ->
     (new_state, metrics, new_client_state).  All client-axis inputs are
     stacked; ``key`` is the single round key (split per client inside the
@@ -199,6 +200,16 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
             collective_bytes=_bytes_model(
                 tree_util.num_params(state.global_params)),
             quant_error=quant_err)
+        if health:
+            # fedmon (ISSUE 14): fixed-shape per-client stat rows ride the
+            # metrics pytree under the same zero-sync contract as obs —
+            # materialized only at the driver's existing log-round flush
+            ref_delta = jax.tree_util.tree_map(
+                lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+                new_state.global_params, state.global_params)
+            metrics["health"] = federated.client_health_stats(
+                state.global_params, outs.params, ref_delta, outs.loss,
+                weights)
         # Return ONLY the per-client state (SCAFFOLD/FedDyn) — returning the
         # full stacked ``outs.params`` would force XLA to materialize a
         # C × |model| output buffer every round for data nothing consumes.
@@ -210,15 +221,15 @@ def make_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
 def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                          train_x, train_y, mode: str = "vmap",
                          collective_precision: str = "fp32",
-                         quant_block: int = blockscale.DEFAULT_BLOCK
-                         ) -> Callable:
+                         quant_block: int = blockscale.DEFAULT_BLOCK,
+                         health: bool = False) -> Callable:
     """Device-gather variant: the dataset lives on device once; the round
     takes only a (C, S, B) int32 index tensor from the host (KBs instead of
     the reference's per-round sample shipping).  The gather is HBM→HBM and
     fuses into the scanned step."""
     inner = make_round_fn(trainer, server_opt, mode,
                           collective_precision=collective_precision,
-                          quant_block=quant_block)
+                          quant_block=quant_block, health=health)
 
     def round_fn(state: ServerState, idx, mask, weights, key,
                  c_clients=None, hp=None):
@@ -232,8 +243,8 @@ def make_gather_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
 def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                         train_x, train_y, mode: str = "vmap",
                         collective_precision: str = "fp32",
-                        quant_block: int = blockscale.DEFAULT_BLOCK
-                        ) -> Callable:
+                        quant_block: int = blockscale.DEFAULT_BLOCK,
+                        health: bool = False) -> Callable:
     """Fused round-block: K federated rounds as ONE compiled program
     (``jit(lax.scan(round))`` — the DrJAX observation that rounds compose as
     pure JAX primitives, arXiv:2403.07128).
@@ -252,7 +263,7 @@ def make_block_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
     """
     inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode,
                                  collective_precision=collective_precision,
-                                 quant_block=quant_block)
+                                 quant_block=quant_block, health=health)
     has_table = server_opt.spec.client_state
 
     def block_fn(state: ServerState, idx_blk, mask_blk, w_blk, keys_blk,
@@ -287,14 +298,16 @@ def make_population_round_fn(trainer: LocalTrainer,
                              server_opt: ServerOptimizer,
                              train_x, train_y, mode: str = "vmap",
                              collective_precision: str = "fp32",
-                             quant_block: int = blockscale.DEFAULT_BLOCK
-                             ) -> Callable:
+                             quant_block: int = blockscale.DEFAULT_BLOCK,
+                             health: bool = False) -> Callable:
     """``pop_fn(states, idx, mask, w, key, c_stacked, hps)`` — the gather
     round vmapped over the member axis of ``states`` / ``c_stacked`` /
-    ``hps``; cohort inputs broadcast."""
+    ``hps``; cohort inputs broadcast.  ``health`` is accepted for builder
+    uniformity but rejected upstream (``validate_args``): per-client stat
+    rows are single-experiment."""
     inner = make_gather_round_fn(trainer, server_opt, train_x, train_y, mode,
                                  collective_precision=collective_precision,
-                                 quant_block=quant_block)
+                                 quant_block=quant_block, health=health)
     has_table = server_opt.spec.client_state
     table_ax = 0 if has_table else None
     return jax.vmap(inner, in_axes=(0, None, None, None, None, table_ax, 0))
@@ -304,14 +317,14 @@ def make_population_block_fn(trainer: LocalTrainer,
                              server_opt: ServerOptimizer,
                              train_x, train_y, mode: str = "vmap",
                              collective_precision: str = "fp32",
-                             quant_block: int = blockscale.DEFAULT_BLOCK
-                             ) -> Callable:
+                             quant_block: int = blockscale.DEFAULT_BLOCK,
+                             health: bool = False) -> Callable:
     """The fused K-round block vmapped over the member axis: P experiments
     × K rounds in ONE compiled dispatch (``vmap`` over ``jit(lax.scan)``'s
     body composes — metrics stack to ``(P, K)``)."""
     inner = make_block_round_fn(trainer, server_opt, train_x, train_y, mode,
                                 collective_precision=collective_precision,
-                                quant_block=quant_block)
+                                quant_block=quant_block, health=health)
     has_table = server_opt.spec.client_state
     table_ax = 0 if has_table else None
     return jax.vmap(inner,

@@ -67,6 +67,26 @@ class FedAvgAPI:
             _obs_configure(enabled=True,
                            path=getattr(args, "trace_path", None))
         self._tracer = get_tracer()
+        # fedmon (ISSUE 14, docs/OBSERVABILITY.md): args.health turns on
+        # the in-trace per-client stat rows (computed inside the compiled
+        # round, flushed at the existing log-round sync) + the host-side
+        # anomaly/drift monitor; args.metrics_port serves the live
+        # /metrics · /healthz · /debug/health endpoint over it
+        self._health = bool(getattr(args, "health", False))
+        self.health_monitor = None
+        self.metrics_server = None
+        if self._health:
+            if federated.parse_population(args) is not None:
+                raise ValueError(
+                    "incompatible flags: health + population — per-client "
+                    "health rows are single-experiment (the stat stream "
+                    "is keyed by client id, not member)")
+            from ...obs.health import HealthMonitor
+            self.health_monitor = HealthMonitor.from_args(args)
+        if getattr(args, "metrics_port", None) is not None:
+            from ...obs.metricsd import start_from_args
+            self.metrics_server = start_from_args(
+                args, monitor=self.health_monitor)
 
         self.trainer = LocalTrainer(model, args)
         self.server_opt = ServerOptimizer(args)
@@ -218,7 +238,8 @@ class FedAvgAPI:
                 self.trainer, self.server_opt, self._dev_x, self._dev_y,
                 mode=client_mode,
                 collective_precision=self.collective_precision,
-                quant_block=self.quant_block), donate_argnums=donate)
+                quant_block=self.quant_block, health=self._health),
+                donate_argnums=donate)
         if self.population:
             raise ValueError(
                 "population vmap needs the device-gather cohort path "
@@ -226,7 +247,8 @@ class FedAvgAPI:
         return jax.jit(make_round_fn(
             self.trainer, self.server_opt, mode=client_mode,
             collective_precision=self.collective_precision,
-            quant_block=self.quant_block), donate_argnums=donate)
+            quant_block=self.quant_block, health=self._health),
+            donate_argnums=donate)
 
     # -- round pieces ------------------------------------------------------
     def _client_sampling(self, round_idx: int) -> np.ndarray:
@@ -502,7 +524,8 @@ class FedAvgAPI:
             self.trainer, self.server_opt, self._dev_x, self._dev_y,
             mode=self._client_mode,
             collective_precision=self.collective_precision,
-            quant_block=self.quant_block), donate_argnums=donate)
+            quant_block=self.quant_block, health=self._health),
+            donate_argnums=donate)
 
     def _stage_block(self, start_round: int):
         """Build one block's stacked cohort tensors: every per-round input
@@ -765,6 +788,20 @@ class FedAvgAPI:
                       self._store if self._store is not None
                       else self.client_table)
 
+    def _observe_health(self, round_idx: int, metrics: dict, dt: float):
+        """Feed one round's materialized per-client stat rows to the
+        fedmon monitor (docs/OBSERVABILITY.md).  ``health_clients`` (the
+        async engine's slot→client map) wins over the round sampling;
+        stats arrays may be cohort-padded — the monitor trims to the id
+        list and drops weight-0 rows."""
+        ids = metrics.get("health_clients")
+        if ids is None:
+            ids = self._client_sampling(round_idx)
+        self.health_monitor.observe_round(
+            round_idx, np.asarray(ids),
+            {f: np.asarray(v) for f, v in metrics["health"].items()},
+            round_time_s=dt)
+
     # -- main loop (reference fedavg_api.py:66 train) ----------------------
     def _is_log_round(self, round_idx: int) -> bool:
         return (round_idx % self.eval_freq == 0
@@ -796,6 +833,14 @@ class FedAvgAPI:
                 else:
                     self._tracer.round_obs(round_idx, dt,
                                            obs_host(metrics["obs"]))
+            if self.health_monitor is not None and isinstance(metrics, dict) \
+                    and metrics.get("health") is not None:
+                # fedmon: the float() above already synced this round's
+                # program, so materializing the per-client stat rows here
+                # adds no new sync point; the sampled ids are a pure
+                # function of the round index (or the async engine's
+                # explicit slot→client map)
+                self._observe_health(round_idx, metrics, dt)
             record = {"round": round_idx, "train_loss": train_loss,
                       "round_time": dt,
                       "dataset_provenance": getattr(self.dataset,
@@ -841,6 +886,16 @@ class FedAvgAPI:
                         if self.population else obs_host_rows(ms["obs"]))
                 for j, row in enumerate(rows):
                     self._tracer.round_obs(r + j, block_dt / k, row)
+            if self.health_monitor is not None and \
+                    ms.get("health") is not None:
+                # fedmon: the (K, C) stat rows ride the block's one sync;
+                # one observe per round, ids re-derived from the sampling
+                h_np = {f: np.asarray(v) for f, v in ms["health"].items()}
+                for j in range(k):
+                    self.health_monitor.observe_round(
+                        r + j, self._client_sampling(r + j),
+                        {f: v[j] for f, v in h_np.items()},
+                        round_time_s=block_dt / k)
             eval_due = any(self._is_log_round(ri) for ri in range(r, r + k))
             for j in range(k):
                 ri = r + j

@@ -296,6 +296,12 @@ def run_silo_federation(args, device, dataset, model):
             "algorithms for now (SCAFFOLD/FedDyn rows would go stale "
             "across silo processes; run those in-process)")
 
+    if api.metrics_server is not None:
+        # fedmon: each rank serves its own /metrics + /healthz (nonzero
+        # base ports offset by rank in obs/metricsd.start_from_args)
+        log.info("fedmon: rank %d metrics endpoint on %s", rank,
+                 api.metrics_server.url)
+
     ep = _SiloEndpoint(args, rank, num_silos + 1, backend)
     try:
         if rank == 0:
@@ -304,6 +310,8 @@ def run_silo_federation(args, device, dataset, model):
         return None
     finally:
         ep.close()
+        if api.metrics_server is not None:
+            api.metrics_server.close()
         tracer.close()   # flush this process's mergeable trace
 
 

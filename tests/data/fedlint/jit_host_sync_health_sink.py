@@ -1,0 +1,48 @@
+"""Fixture: a fedmon HEALTH sink fed a traced/device value inside the
+compiled round (the per-client-stats sibling of the tracer-sink rule).
+
+``health_monitor.observe_round(...)`` / ``monitor.flag(...)`` are
+host-side detector entry points — handing them traced per-client stat
+arrays inside a jitted region forces a blocking device→host sync at that
+exact line (or a trace error).  The clean form computes the fixed-shape
+stat rows IN-TRACE (``federated.client_health_stats``), returns them
+through the round's metrics pytree, and observes at the HOST driver's
+existing flush (docs/OBSERVABILITY.md).
+"""
+import jax
+import jax.numpy as jnp
+
+
+class HealthMonitor:
+    """Stand-in for fedml_tpu.obs.health.HealthMonitor (host detector)."""
+
+    def observe_round(self, *a, **k):
+        pass
+
+    def flag(self, *a, **k):
+        pass
+
+
+health_monitor = HealthMonitor()
+
+
+@jax.jit
+def round_leaky(state, grads, weights):
+    norms = jnp.sqrt(jnp.sum(grads * grads, axis=1))
+    health_monitor.observe_round(0, [1, 2], norms)     # traced -> sync
+    health_monitor.flag(0, client=jnp.argmax(norms))   # same, kwarg
+    return state - jnp.mean(grads, axis=0)
+
+
+@jax.jit
+def round_clean(state, grads, weights):
+    norms = jnp.sqrt(jnp.sum(grads * grads, axis=1))
+    return state - jnp.mean(grads, axis=0), {"update_norm": norms}
+
+
+def driver(state, grads, weights, cohort):
+    state, health = round_clean(state, grads, weights)
+    # host boundary AFTER the dispatch — the sanctioned observe point
+    health_monitor.observe_round(0, cohort,
+                                 {"update_norm": health["update_norm"]})
+    return state

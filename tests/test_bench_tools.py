@@ -151,6 +151,32 @@ def test_bench_serve_mt_quick(monkeypatch):
     assert load["tokens_per_s"] > 0
 
 
+def test_bench_health_quick(monkeypatch):
+    """FEDML_HEALTH_QUICK smoke (ISSUE 14): bench.py --health runs the
+    fedmon plane green end-to-end — label-flip detection verdict on a
+    short run, live /metrics scraped mid-run, the deliberately violated
+    straggler SLO driving /healthz ok→degraded, and the offline
+    fedtrace-health report agreeing with the live monitor (the ≥0.9
+    precision/recall + ≤3% overhead acceptance numbers come from the
+    full-size BENCH_r11 run; quick still pins detection on its trimmed
+    cohort because the signature is scale-free)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_HEALTH_QUICK", "1")
+    out = bench.bench_health()
+    assert out["quick"] is True
+    assert out["plain_s_per_round"] > 0
+    assert out["health_s_per_round"] > 0
+    assert out["detector_precision"] >= 0.9
+    assert out["detector_recall"] >= 0.9
+    assert out["healthz_before"] == "ok"
+    assert out["healthz_after"] == "degraded"
+    assert out["healthz_transition_ok"] is True
+    assert out["mid_run_scrape"].get("rounds_observed", 0) >= 1
+    assert out["offline_report_flagged_matches"] is True
+    assert out["health_gauges"]["health.rounds_observed"] == \
+        out["detection_rounds"]
+
+
 def test_bench_async_quick(monkeypatch):
     """bench.py --async smoke: fedbuff vs sync FedAvg under the shared
     heavy-tailed latency model runs green — both engines reach the (easy

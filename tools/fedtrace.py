@@ -17,6 +17,10 @@ Pure stdlib (runs without jax installed, like ``tools/fedlint.py``):
 - ``fedtrace.py regress CURRENT.json [--bands F] [--baseline-dir D]`` —
   per-metric tolerance gate of a bench row against the committed
   ``BENCH_r*.json`` trajectory; exit 3 on regression.
+- ``fedtrace.py health TRACE.json [--json]`` — offline federation-health
+  report from a captured trace (fedmon, docs/OBSERVABILITY.md): the
+  per-round ``health.*`` counter trajectory, every flagged client with
+  its score/reason, and the drift envelope.
 
 Attribution model (docs/OBSERVABILITY.md): ``staging`` is measured
 directly from host spans; the four device phases are apportioned from
@@ -581,6 +585,83 @@ def critical_path(trace: Dict[str, Any],
     return {"rounds": out_rounds, "gating_process_overall": overall}
 
 
+# -- fedmon offline health report --------------------------------------------
+
+#: per-round fedmon counters replayed into trajectories by ``health``
+HEALTH_SERIES = ("health.anomaly_rate", "health.flagged_total",
+                 "health.drift_score", "health.round_time_s",
+                 "health.staleness_p99")
+
+
+def health_report(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Offline federation-health report from a captured trace.
+
+    Replays the ``health.*`` counter stream the monitor emitted at every
+    verdict (one sample per observed round) plus the ``health.flag``
+    events naming each newly flagged client — no jax, no re-detection:
+    the report renders what the live monitor concluded, so a silo's
+    post-mortem matches what ``/healthz`` served at the time."""
+    events = trace["traceEvents"]
+    series: Dict[str, List[float]] = {name: [] for name in HEALTH_SERIES}
+    flags: List[dict] = []
+    for ev in events:
+        if ev.get("ph") != "C":
+            continue
+        name = ev.get("name")
+        args = ev.get("args") or {}
+        if name in series:
+            v = args.get("value")
+            if isinstance(v, (int, float)):
+                series[name].append(float(v))
+        elif name == "health.flag":
+            flags.append({k: args[k] for k in
+                          ("client", "round", "score", "reason",
+                           "staleness") if k in args})
+    spans = span_totals(events)
+    verdicts = spans.get("health.verdict", {"count": 0, "total_s": 0.0})
+    if not (int(verdicts["count"]) or flags
+            or any(series[s] for s in series)):
+        raise ValueError("trace carries no fedmon events (run with "
+                         "health: true + trace: true)")
+    out: Dict[str, Any] = {
+        "rounds_observed": int(verdicts["count"]),
+        "verdict_overhead_s": round(verdicts["total_s"], 6),
+        "flags": flags,
+        "flagged_clients": sorted({int(f["client"]) for f in flags
+                                   if "client" in f}),
+    }
+    for name, vals in series.items():
+        key = name.split(".", 1)[1]
+        if vals:
+            out[f"{key}_last"] = round(vals[-1], 6)
+            out[f"{key}_max"] = round(max(vals), 6)
+    return out
+
+
+def _render_health(h: Dict[str, Any]) -> str:
+    lines = [f"rounds observed: {h['rounds_observed']}   "
+             f"anomaly rate (last/max): "
+             f"{h.get('anomaly_rate_last', 0.0):g}/"
+             f"{h.get('anomaly_rate_max', 0.0):g}   "
+             f"drift (last/max): {h.get('drift_score_last', 0.0):g}/"
+             f"{h.get('drift_score_max', 0.0):g}"]
+    if "round_time_s_last" in h:
+        lines.append(f"round time (last/max): "
+                     f"{h['round_time_s_last']:g}s/"
+                     f"{h['round_time_s_max']:g}s")
+    if "staleness_p99_last" in h:
+        lines.append(f"staleness p99 (last/max): "
+                     f"{h['staleness_p99_last']:g}/"
+                     f"{h['staleness_p99_max']:g}")
+    lines.append(f"flagged clients: {len(h['flagged_clients'])}")
+    for f in h["flags"]:
+        lines.append(f"  client {f.get('client', '?'):>8}  "
+                     f"round {f.get('round', '?'):>5}  "
+                     f"score {f.get('score', 0.0):>8.2f}  "
+                     f"{f.get('reason', '-')}")
+    return "\n".join(lines)
+
+
 # -- perf-regression gate ----------------------------------------------------
 
 DEFAULT_BANDS_FILE = "BENCH_TOLERANCES.json"
@@ -812,6 +893,11 @@ def main(argv=None) -> int:
     p_cp.add_argument("trace")
     p_cp.add_argument("--round", type=int, default=None)
     p_cp.add_argument("--json", action="store_true")
+    p_health = sub.add_parser(
+        "health", help="offline fedmon federation-health report from a "
+                       "captured trace")
+    p_health.add_argument("trace")
+    p_health.add_argument("--json", action="store_true")
     p_reg = sub.add_parser(
         "regress", help="tolerance-band gate of a bench row vs the "
                         "committed BENCH_r*.json trajectory (exit 3 on "
@@ -850,6 +936,9 @@ def main(argv=None) -> int:
                                round_idx=args.round)
             print(json.dumps(cp) if args.json else
                   _render_critical_path(cp))
+        elif args.cmd == "health":
+            h = health_report(load_trace(args.trace))
+            print(json.dumps(h) if args.json else _render_health(h))
         else:  # regress
             base_dir = args.baseline_dir or os.path.dirname(
                 os.path.abspath(args.current)) or "."

@@ -65,10 +65,21 @@ def run_load(engine, *, target_rps: float, n_requests: int,
              zipf_a: float = 1.2, prompt_len_mean: float = 8.0,
              prompt_len_sigma: float = 0.8, max_new_tokens: int = 16,
              vocab: int = 256, seed: int = 0,
-             timeout_s: float = 300.0) -> Dict:
+             timeout_s: float = 300.0,
+             scrape_url: Optional[str] = None,
+             scrape_rel_tol: float = 0.6) -> Dict:
     """Drive ``engine`` at ``target_rps`` and report the latency/throughput
     envelope.  ``adapters`` lists the routing choices in popularity order
     (``None`` = base traffic); the Zipf mix makes the first entries hot.
+
+    ``scrape_url`` (fedmon, docs/OBSERVABILITY.md): a live ``/metrics``
+    endpoint scraped MID-RUN (at ~60% of submissions, off the submit
+    thread).  The report then cross-checks the engine's own gauges
+    against this harness's independent measurements — ``serve.tokens_
+    total`` must sit inside the run's token envelope, ``serve.tokens_
+    per_s`` within ``scrape_rel_tol`` of the measured aggregate, and the
+    queue-depth gauge inside the observed envelope — the silent-counter-
+    drift canary (``report["scrape"]["ok"]``).
 
     The caller should warm the engine's compiled programs first (one
     request per distinct program) — this harness measures serving, not
@@ -89,6 +100,10 @@ def run_load(engine, *, target_rps: float, n_requests: int,
     failed: List[int] = []
     queue_depths: List[int] = []
     lock = threading.Lock()
+    # harness-side token clock (independent of the engine's counters):
+    # every received token bumps it, so the scrape can measure ITS OWN
+    # windowed tokens/s to compare against the engine's windowed gauge
+    tok_clock = [0]
 
     def collect(i: int, q, t_sched: float):
         first = None
@@ -107,15 +122,52 @@ def run_load(engine, *, target_rps: float, n_requests: int,
             if t is None:
                 break
             count += 1
+            with lock:
+                tok_clock[0] += 1
         with lock:
             lat[i] = now - t_sched
             ttft[i] = first - t_sched
             toks[i] = count
 
+    scrape: Dict[str, float] = {}
+
+    def do_scrape():
+        import urllib.request
+        from fedml_tpu.obs.metricsd import (parse_prometheus_text,
+                                            prom_value)
+        url = scrape_url.rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        try:
+            # harness-side windowed rate over the ~same window the
+            # engine's serve.tokens_per_s gauge integrates (0.5s+),
+            # measured from the independent token clock
+            with lock:
+                n0 = tok_clock[0]
+            w0 = time.monotonic()
+            time.sleep(0.8)
+            with lock:
+                n1 = tok_clock[0]
+            w1 = time.monotonic()
+            scrape["_harness_tokens_per_s"] = (n1 - n0) / max(w1 - w0,
+                                                              1e-9)
+            text = urllib.request.urlopen(url, timeout=10).read().decode()
+            samples = parse_prometheus_text(text)
+            for gauge in ("serve.tokens_per_s", "serve.tokens_total",
+                          "serve.queue_depth"):
+                v = prom_value(samples, "fedtrace_counter", name=gauge)
+                if v is not None:
+                    scrape[gauge] = v
+            scrape["_t"] = time.monotonic()
+        except Exception as e:   # a failed scrape is a result, not a crash
+            scrape["_error"] = repr(e)  # type: ignore[assignment]
+
     threads = []
     t0 = time.monotonic()
     adapter_counts: Dict[str, int] = {}
     behind_s = 0.0
+    scrape_at = max(1, int(0.6 * n_requests))
+    scrape_thread = None
     for i in range(n_requests):
         t_sched = t0 + arrival[i]
         now = time.monotonic()
@@ -130,6 +182,9 @@ def run_load(engine, *, target_rps: float, n_requests: int,
                           adapter=name) if name is not None else \
             engine.submit(prompts[i], max_new_tokens=max_new_tokens)
         queue_depths.append(engine._waiting.qsize())
+        if scrape_url and i == scrape_at:
+            scrape_thread = threading.Thread(target=do_scrape, daemon=True)
+            scrape_thread.start()
         th = threading.Thread(target=collect, args=(i, q, t_sched),
                               daemon=True)
         th.start()
@@ -138,12 +193,56 @@ def run_load(engine, *, target_rps: float, n_requests: int,
     for th in threads:
         th.join(timeout=timeout_s)
     t_end = time.monotonic()
+    if scrape_thread is not None:
+        scrape_thread.join(timeout=30.0)
 
     ok = [i for i in range(n_requests) if i not in set(failed)]
     lat_ok = [lat[i] for i in ok]
     ttft_ok = [ttft[i] for i in ok]
     total_toks = sum(toks[i] for i in ok)
     makespan = max(t_end - t0, 1e-9)
+    scrape_report = None
+    if scrape_url:
+        scrape_report = {"url": scrape_url}
+        if "_error" in scrape:
+            scrape_report.update(ok=False, error=scrape["_error"])
+        elif not scrape:
+            scrape_report.update(ok=False, error="scrape never ran "
+                                 "(fewer submissions than scrape point?)")
+        else:
+            measured_tps = total_toks / makespan
+            harness_tps = scrape.get("_harness_tokens_per_s", 0.0)
+            gauge_tps = scrape.get("serve.tokens_per_s")
+            gauge_total = scrape.get("serve.tokens_total")
+            gauge_depth = scrape.get("serve.queue_depth")
+            # like-for-like rate comparison: the engine gauge is a short
+            # windowed rate, so compare it against the harness's OWN
+            # windowed rate at scrape time; the bound allows rel_tol of
+            # the larger rate plus a small absolute floor (window phase
+            # offset between the two clocks)
+            tps_bound = (scrape_rel_tol * max(harness_tps, gauge_tps or 0.0)
+                         + 0.1 * max(measured_tps, 1.0))
+            checks = {
+                # mid-run cumulative total must sit inside [0, final]
+                "tokens_total_in_envelope": (
+                    gauge_total is None
+                    or 0.0 <= gauge_total <= total_toks),
+                "tokens_per_s_agree": (
+                    gauge_tps is None or harness_tps <= 0
+                    or abs(gauge_tps - harness_tps) <= tps_bound),
+                # the gauge can never exceed the worst depth we saw
+                "queue_depth_in_envelope": (
+                    gauge_depth is None
+                    or gauge_depth <= max(queue_depths, default=0) + 1),
+            }
+            scrape_report.update(
+                ok=all(checks.values()), checks=checks,
+                tokens_per_s_gauge=gauge_tps,
+                tokens_per_s_harness_window=round(harness_tps, 1),
+                tokens_per_s_measured=round(measured_tps, 1),
+                tokens_total_gauge=gauge_total,
+                queue_depth_gauge=gauge_depth,
+                rel_tol=scrape_rel_tol)
     return {
         "target_rps": float(target_rps),
         "requests": n_requests,
@@ -165,6 +264,7 @@ def run_load(engine, *, target_rps: float, n_requests: int,
         "prompt_len_mean_actual": round(float(np.mean(lens)), 1),
         "prompt_len_max_actual": int(np.max(lens)),
         "makespan_s": round(makespan, 3),
+        **({"scrape": scrape_report} if scrape_report is not None else {}),
     }
 
 
@@ -178,6 +278,12 @@ def main():
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=os.path.join(REPO, "SERVE_LOAD.json"))
+    ap.add_argument("--scrape-metrics", default=None, metavar="URL",
+                    help="scrape this live fedmon /metrics endpoint "
+                         "mid-run and cross-check the serve.* gauges "
+                         "against the harness's own measurements "
+                         "('self' starts an in-process endpoint over "
+                         "the engine's tracer)")
     args = ap.parse_args()
 
     import jax
@@ -204,15 +310,28 @@ def main():
         engine.registry.register(
             name, lora_init(jax.random.PRNGKey(100 + i), variables["lora"]))
         names.append(name)
+    scrape_url = args.scrape_metrics
+    metrics_server = None
+    if scrape_url == "self":
+        # the serve.* gauges only exist with the tracer on; an ephemeral
+        # endpoint over the global tracer is the self-contained demo
+        from fedml_tpu import obs
+        obs.configure(enabled=True, reset=True)
+        from fedml_tpu.obs.metricsd import MetricsServer
+        metrics_server = MetricsServer()
+        metrics_server.start()
+        scrape_url = metrics_server.url
     try:
         # warm both compiled programs (prefill + batched step) off-clock
         engine.generate([5, 17, 42], max_new_tokens=2, adapter=names[0])
         report = run_load(
             engine, target_rps=args.rps, n_requests=args.requests,
             adapters=[None] + names, max_new_tokens=args.max_new_tokens,
-            vocab=cfg.vocab_size, seed=args.seed)
+            vocab=cfg.vocab_size, seed=args.seed, scrape_url=scrape_url)
     finally:
         engine.stop()
+        if metrics_server is not None:
+            metrics_server.close()
     report["engine"] = {"slots": args.slots, "buf_len": buf_len,
                         "adapters_registered": len(names)}
     print(json.dumps(report))

@@ -1077,6 +1077,202 @@ def bench_async(max_rounds: int | None = None) -> dict:
     return out
 
 
+# -- fedguard chaos scenario matrix (--chaos) --------------------------------
+def bench_chaos(rounds: int | None = None) -> dict:
+    """--chaos: the fedguard fault-tolerance matrix over the REAL
+    multi-rank two-tier driver (docs/FAULT_TOLERANCE.md).  Four runs of
+    ``run_silo_federation`` (1 server + 3 silos on the message plane,
+    reliable delivery + heartbeat leases on):
+
+    - **clean** — no faults; the wall-clock and final-loss baseline,
+      checked for parity against the in-process ``HierarchicalSiloAPI``
+      (the wire adds serialization, not math);
+    - **crash_silo** — one silo dies mid-run; every remaining round
+      closes at quorum 2/3 within the deadline, and the final loss stays
+      within tolerance of clean (the missing silo's cohort slice is the
+      only divergence);
+    - **partition_heal** — a directional silo→server partition spans two
+      mid rounds, then heals; the quorum trajectory dips and recovers;
+    - **kill_rank0** — the coordinator is killed between rounds and
+      restarted; it resumes from checkpoint + applied-round WAL with
+      ZERO double-applied rounds.
+
+    Plus the compile-stability pin: quorum closes pad the arrived set
+    with zero partials, so the server combine keeps ONE compiled shape —
+    JaxRuntimeAudit must count 0 steady-state compiles across varying
+    quorum sizes.  FEDML_CHAOS_QUICK=1 shrinks rounds for the tier-1
+    smoke.  Ranks run as threads over the hermetic local backend — the
+    same comm/chaos/reliability stack as the OS-process runs in
+    ``tests/test_fedguard_chaos.py``, minus the fork cost."""
+    import tempfile
+    import threading
+
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu.core import federated
+    from fedml_tpu.core.distributed.communication.fault_injection import (
+        SiloCrashed)
+    from fedml_tpu.core.distributed.communication.local import (
+        local_comm_manager)
+    from fedml_tpu.core.distributed.reliability import RoundWAL
+    from fedml_tpu.store.hierarchy import (HierarchicalSiloAPI,
+                                           run_silo_federation)
+
+    quick = os.environ.get("FEDML_CHAOS_QUICK") == "1"
+    num_silos = 3
+    n_rounds = rounds or (5 if quick else 10)
+    crash_round = 2 if quick else 3
+    deadline_s = 1.0 if quick else 2.0
+    guard_args = dict(
+        reliable_delivery=True, quorum=2, quorum_deadline_s=deadline_s,
+        heartbeat_interval_s=0.2, lease_s=1.5,
+        retry_base_s=0.05, retry_deadline_s=5.0,
+        comm_recv_timeout_s=60.0)
+
+    def make_args(rank, run_id, **over):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=6 * 4 * BATCH, test_size=64, model="lr",
+            client_num_in_total=12, client_num_per_round=6,
+            comm_round=n_rounds, epochs=1, batch_size=BATCH,
+            learning_rate=0.1, random_seed=7, partition_method="homo",
+            num_silos=num_silos, frequency_of_the_test=10 ** 9,
+            rank=rank, backend="local", run_id=run_id)
+        args.update(**over)
+        return fedml_tpu.init(args, should_init_logs=False)
+
+    def run_rank(rank, run_id, out, **over):
+        args = make_args(rank, run_id, **over)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        try:
+            out[rank] = run_silo_federation(args, None, dataset, model)
+        except SiloCrashed as e:
+            out[f"crash{rank}"] = str(e)
+
+    def federate(run_id, server_over=None, silo_over=None,
+                 restart_rank0=None):
+        """One full federation: silos as threads, server in this thread;
+        ``restart_rank0`` re-runs the server with those overrides after
+        its first life crashes."""
+        out: dict = {}
+        ths = [threading.Thread(
+            target=run_rank, args=(r, run_id, out),
+            kwargs=dict(**guard_args, **(silo_over or {})), daemon=True)
+            for r in range(1, num_silos + 1)]
+        for t in ths:
+            t.start()
+        t0 = time.time()
+        run_rank(0, run_id, out, **guard_args, **(server_over or {}))
+        if restart_rank0 is not None:
+            assert "crash0" in out, "server did not crash as scheduled"
+            run_rank(0, run_id, out, **guard_args, **restart_rank0)
+        wall = time.time() - t0
+        for t in ths:
+            t.join(timeout=120)
+        local_comm_manager.reset_run(run_id)
+        return out, wall
+
+    # -- clean baseline + in-process parity ------------------------------
+    out, clean_wall = federate("chaos_clean")
+    clean_hist = out[0]
+    assert len(clean_hist) == n_rounds
+    clean_loss = clean_hist[-1]["train_loss"]
+    ref = make_args(0, "chaos_ref")
+    dataset, out_dim = data_mod.load(ref)
+    api = HierarchicalSiloAPI(ref, None, dataset,
+                              model_mod.create(ref, out_dim))
+    ref_loss = None
+    for r in range(n_rounds):
+        ref_loss = float(api.train_one_round(r)["train_loss"])
+    wire_vs_inprocess = abs(clean_loss - ref_loss)
+
+    # -- compile stability: ONE combine shape at every quorum size --------
+    # (zero partials pad the arrived set, so 3/3, 2/3 and 1/3 closes hit
+    # the same compiled program — warm once, then audit across sizes)
+    parts = [api.silo_partial(n_rounds, i)[0] for i in range(num_silos)]
+    host = [jax.tree_util.tree_map(np.asarray, p) for p in parts]
+    api.apply_partials(host)   # warm the S-ary combine
+    _readback(api.state.global_params)   # and the readback reduction
+    with JaxRuntimeAudit() as audit:
+        for q in (3, 2, 1, 2, 3):
+            got = host[:q]
+            pad = [federated.zero_like_partial(host[0])] * (num_silos - q)
+            api.apply_partials(got + pad)
+        _readback(api.state.global_params)
+    steady_compiles = audit.compilations
+
+    # -- scenario: crash one silo mid-run --------------------------------
+    out, crash_wall = federate(
+        "chaos_crash",
+        silo_over=dict(chaos_crash_rank=num_silos,
+                       chaos_crash_round=crash_round,
+                       chaos_crash_mode="raise"))
+    crash_hist = out[0]
+    assert f"crash{num_silos}" in out, "silo did not crash as scheduled"
+    crash_rounds_completed = len(crash_hist)
+    crash_quorums = [h["quorum"] for h in crash_hist]
+    crash_loss = crash_hist[-1]["train_loss"]
+
+    # -- scenario: partition-and-heal ------------------------------------
+    part_spec = f"1>0:{crash_round}-{crash_round + 1}"
+    out, part_wall = federate(
+        "chaos_part", silo_over=dict(chaos_partition=part_spec),
+        server_over=dict(chaos_partition=part_spec))
+    part_hist = out[0]
+    part_quorums = [h["quorum"] for h in part_hist]
+
+    # -- scenario: kill-and-restart rank 0 -------------------------------
+    ckpt_dir = tempfile.mkdtemp(prefix="fedguard_bench_wal_")
+    out, kill_wall = federate(
+        "chaos_kill",
+        server_over=dict(checkpoint_dir=ckpt_dir,
+                         chaos_crash_rank=0,
+                         chaos_crash_round=crash_round,
+                         chaos_crash_mode="raise"),
+        restart_rank0=dict(checkpoint_dir=ckpt_dir))
+    kill_hist = out[0]
+    wal_rounds = RoundWAL(ckpt_dir).rounds()
+    double_applied = len(wal_rounds) - len(set(wal_rounds))
+
+    return {
+        "quick": quick, "num_silos": num_silos, "rounds": n_rounds,
+        "quorum": guard_args["quorum"],
+        "quorum_deadline_s": deadline_s,
+        "crash_round": crash_round,
+        # clean + parity
+        "clean_wall_s": round(clean_wall, 2),
+        "clean_final_loss": round(clean_loss, 6),
+        "wire_vs_inprocess_loss_delta": round(wire_vs_inprocess, 8),
+        # crash-one-silo headline
+        "rounds_completed_under_chaos": crash_rounds_completed,
+        "crash_quorum_trajectory": crash_quorums,
+        "crash_final_loss": round(crash_loss, 6),
+        "crash_loss_delta_vs_clean": round(abs(crash_loss - clean_loss),
+                                           6),
+        "crash_wall_s": round(crash_wall, 2),
+        "wallclock_overhead_vs_clean": round(crash_wall / clean_wall, 3),
+        # partition-and-heal
+        "partition_spec": part_spec,
+        "partition_rounds_completed": len(part_hist),
+        "partition_quorum_trajectory": part_quorums,
+        "partition_healed": part_quorums[-1] == num_silos,
+        "partition_wall_s": round(part_wall, 2),
+        # kill-and-restart rank 0
+        "kill_rank0_resumed_rounds": [h["round"] for h in kill_hist],
+        "kill_rank0_wal_rounds": wal_rounds,
+        "kill_rank0_double_applied": double_applied,
+        "kill_rank0_wall_s": round(kill_wall, 2),
+        # compile stability across quorum sizes
+        "steady_compiles_quorum": steady_compiles,
+    }
+
+
 # -- fedtrace overhead + breakdown benchmark (--trace) -----------------------
 def _import_fedtrace():
     sys.path.insert(0, os.path.join(os.path.dirname(
@@ -2032,6 +2228,19 @@ def main():
             "value": result["violations"],
             "unit": "unsuppressed_violations",
             "vs_baseline": mesh.get("census_bytes", {}).get("client"),
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--chaos" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_chaos()
+        result.update({
+            "metric": "fedguard_chaos_fault_tolerance_matrix",
+            "value": result["wallclock_overhead_vs_clean"],
+            "unit": "x_wallclock_crash_vs_clean",
+            "vs_baseline": result["rounds_completed_under_chaos"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

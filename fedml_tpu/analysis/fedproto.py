@@ -126,6 +126,17 @@ PROTO_RULES: Dict[str, Rule] = {
 IMPLICIT_KEYS = {"msg_type", "sender", "receiver"}
 #: runtime-injected context keys (obs/context.py) — never a handler contract
 CONTEXT_KEY_PREFIX = "fedscope."
+#: transport-plane params keys (core/distributed/reliability.py) — like
+#: the fedscope context, below every FSM's param contract
+TRANSPORT_KEY_PREFIX = "fedguard."
+#: transport-plane message types the fedguard reliability layer
+#: exchanges BELOW every FSM (ack/retransmit + heartbeat leases,
+#: docs/FAULT_TOLERANCE.md).  Values mirror ``reliability.MSG_TYPE_ACK``
+#: / ``MSG_TYPE_HEARTBEAT`` (pinned in sync by tests/test_reliability.py);
+#: families flagged ``"transport": True`` in PROTOCOL_FAMILIES pin this
+#: block in their manifest and :func:`check_trace` accepts the types in
+#: both directions.
+TRANSPORT_TYPES = {"ack": "690", "heartbeat": "691"}
 #: constant-name suffix of the runtime-emitted readiness message: handlers
 #: for it are entry points, never orphans, and nobody "sends" it
 CONNECTION_READY_SUFFIX = "MSG_TYPE_CONNECTION_IS_READY"
@@ -228,6 +239,9 @@ PROTOCOL_FAMILIES: Dict[str, Dict[str, Any]] = {
         "shared_members": {"_Mgr": "store/hierarchy.py"},
         "sources": ("store/hierarchy.py",),
         "queue_style": True,
+        # fedguard reliable delivery rides below this FSM: ack/heartbeat
+        # transport types pin into the manifest (docs/FAULT_TOLERANCE.md)
+        "transport": True,
     },
     # buffered-async federation (docs/ASYNC.md): the server buffers
     # staleness-discounted worker partials and applies at K; the same
@@ -240,6 +254,8 @@ PROTOCOL_FAMILIES: Dict[str, Dict[str, Any]] = {
         "shared_members": {"_Mgr": "simulation/async_driver.py"},
         "sources": ("simulation/async_driver.py",),
         "queue_style": True,
+        # fedguard reliable delivery rides below this FSM too
+        "transport": True,
     },
 }
 
@@ -1321,7 +1337,8 @@ def family_to_manifest(fam: FamilyProtocol) -> Dict[str, Any]:
             reads = handler_required_reads(sp, reg)
             keys = sorted(k for k, r in reads.items()
                           if r and k not in IMPLICIT_KEYS
-                          and not k.startswith(CONTEXT_KEY_PREFIX))
+                          and not k.startswith(CONTEXT_KEY_PREFIX)
+                          and not k.startswith(TRANSPORT_KEY_PREFIX))
             if keys:
                 req[reg.msg.key] = keys
             fin = fin or sp.handler_finishes(reg)
@@ -1339,15 +1356,22 @@ def family_to_manifest(fam: FamilyProtocol) -> Dict[str, Any]:
                 "sites": []})
             method = s.method if sp.name == s.method else \
                 f"{sp.name}.{s.method}"
-            site = {"method": method, "params": list(s.params)}
+            site = {"method": method,
+                    "params": [p for p in s.params
+                               if not p.startswith(TRANSPORT_KEY_PREFIX)]}
             if site not in entry["sites"]:
                 entry["sites"].append(site)
         for entry in srow.values():
             entry["sites"].sort(key=lambda x: x["method"])
         sends[role] = dict(sorted(srow.items()))
-    return {"roles": roles_out, "handlers": handlers, "sends": sends,
-            "requires": requires, "finish_roles": sorted(finish_roles),
-            "queue_style": fam.queue_style}
+    out = {"roles": roles_out, "handlers": handlers, "sends": sends,
+           "requires": requires, "finish_roles": sorted(finish_roles),
+           "queue_style": fam.queue_style}
+    if fam.config.get("transport"):
+        # fedguard ack/heartbeat ride below this family's FSM — pin the
+        # transport types so check-trace knows them (both directions)
+        out["transport"] = dict(TRANSPORT_TYPES)
+    return out
 
 
 def protocols_to_manifest(fams: Dict[str, FamilyProtocol]
@@ -1547,10 +1571,17 @@ def check_trace(traces: Sequence[Any], family: str,
     known_sent: Set[str] = set()
     for row in entry.get("sends", {}).values():
         known_sent |= set(row)
+    # fedguard transport types (ack/heartbeat) ride below the FSM in
+    # both directions — known senders AND receivers on every role
+    transport_types = {str(v) for v in
+                       (entry.get("transport") or {}).values()}
+    known_handled |= transport_types
+    known_sent |= transport_types
 
     sends: List[dict] = []
     recvs: List[dict] = []
     drops: List[dict] = []
+    retries: List[dict] = []
     for trace in traces:
         for e in _trace_events(trace):
             if e.get("ph") != "B":
@@ -1567,6 +1598,8 @@ def check_trace(traces: Sequence[Any], family: str,
                 recvs.append(rec)
             elif e.get("name") == "comm.drop":
                 drops.append(rec)
+            elif e.get("name") == "comm.retry":
+                retries.append(rec)
 
     out: List[Finding] = []
     tpath = f"<trace:{family}>"
@@ -1606,20 +1639,34 @@ def check_trace(traces: Sequence[Any], family: str,
                 f"{rec.get('span_id')}) has no matching comm.recv on any "
                 "captured process — lost in transit or delivered to a "
                 "rank with no handler"))
-    # duplicates: one msg_id, >1 recv
+    # duplicates: one msg_id delivered more often than its DELIBERATE
+    # wire attempts.  fedguard retransmissions (docs/FAULT_TOLERANCE.md)
+    # share the logical msg_id and mark every re-send with a
+    # ``comm.retry`` span, so a message retried N times may legally
+    # produce up to 1+N deliveries — a retry surviving loss, not a
+    # duplicate-delivery fault.  Anything beyond that budget (broker
+    # QoS-1 re-delivery, chaos duplication) is flagged as before.
+    retry_counts: Dict[str, int] = {}
+    for rec in retries:
+        mid = rec.get("msg_id")
+        if mid:
+            retry_counts[mid] = retry_counts.get(mid, 0) + 1
     counts: Dict[str, int] = {}
     for mid in recv_msg_ids:
         counts[mid] = counts.get(mid, 0) + 1
     dup_types = {}
     for rec in recvs:
         mid = rec.get("msg_id")
-        if mid and counts.get(mid, 0) > 1:
+        if mid and counts.get(mid, 0) > 1 + retry_counts.get(mid, 0):
             dup_types.setdefault(mid, maybe_type(rec))
     for mid, t in sorted(dup_types.items()):
         out.append(_mk(
             "trace-duplicate-delivery", tpath, 1,
             f"[{family}] message {mid} (msg_type {t}) was delivered "
-            f"{counts[mid]} times — re-delivery the FSM must tolerate"))
+            f"{counts[mid]} times against a budget of "
+            f"{1 + retry_counts.get(mid, 0)} deliberate send(s) — "
+            "re-delivery the FSM must tolerate (fedguard "
+            "retransmissions sharing the msg_id are not flagged)"))
     # observed fault-injection drops
     for rec in drops:
         t = maybe_type(rec) or "?"

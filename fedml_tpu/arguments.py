@@ -215,6 +215,40 @@ _DEFAULTS: Dict[str, Any] = dict(
     # `silo_slow_rank`'s round open by `silo_slow_s` seconds
     silo_slow_rank=0,
     silo_slow_s=0.0,
+    # fedguard fault-tolerant delivery (docs/FAULT_TOLERANCE.md):
+    # reliable_delivery wraps every comm backend with ack/retransmit
+    # (exponential backoff retry_base_s * retry_multiplier^n capped at
+    # retry_max_backoff_s, +-retry_jitter deterministic jitter, per-
+    # message retry_deadline_s) and receiver-side dedupe; the drivers
+    # set reliable_types to their payload msg types.  Heartbeat leases
+    # (heartbeat_interval_s beacons, lease_s expiry) drive dead-rank
+    # exclusion.  Quorum rounds: rank 0 closes a silo round (and the
+    # async driver flushes its buffer) at quorum_deadline_s with >=
+    # `quorum` of S partials (0 = all ranks / K, i.e. quorum off);
+    # comm_recv_timeout_s bounds every blocking driver recv.
+    reliable_delivery=False,
+    reliable_types=None,
+    retry_base_s=0.0,
+    retry_multiplier=0.0,
+    retry_max_backoff_s=0.0,
+    retry_jitter=None,
+    retry_deadline_s=0.0,
+    heartbeat_interval_s=0.0,
+    lease_s=0.0,
+    quorum=0,
+    quorum_deadline_s=0.0,
+    comm_recv_timeout_s=120.0,
+    # chaos harness (communication/fault_injection.py): crash-at-round
+    # kills `chaos_crash_rank` when it reaches round `chaos_crash_round`
+    # (mode "exit" = os._exit, "raise" = SiloCrashed for in-thread
+    # tests); chaos_partition is a list of directional round-window
+    # specs "src>dst:lo-hi"; chaos_bandwidth_bps caps modeled link
+    # throughput by delaying delivery per payload byte.
+    chaos_crash_rank=-1,
+    chaos_crash_round=-1,
+    chaos_crash_mode="exit",
+    chaos_partition=None,
+    chaos_bandwidth_bps=0.0,
     compute_dtype="float32",
     clients_per_device=1,
 )

@@ -130,10 +130,16 @@ def create_comm_backend(args, rank: int, size: int,
     """Construct a bare communication backend (no observer attached) — used
     by the FSM above and by the scheduler plane's message centers.
     ``chaos_*`` args decorate the result with seeded fault injection
-    (``communication/fault_injection.py``)."""
+    (``communication/fault_injection.py``); ``reliable_delivery`` adds
+    the fedguard ack/retransmit + heartbeat-lease layer OUTSIDE chaos —
+    ``Reliable(Chaos(Raw))`` — so retransmissions traverse the injected
+    faults (``reliability.py``, docs/FAULT_TOLERANCE.md)."""
     from .communication.fault_injection import maybe_wrap_with_chaos
-    return maybe_wrap_with_chaos(
-        _create_raw_backend(args, rank, size, backend), args, rank)
+    from .reliability import maybe_wrap_reliable
+    return maybe_wrap_reliable(
+        maybe_wrap_with_chaos(
+            _create_raw_backend(args, rank, size, backend), args, rank),
+        args, rank, size)
 
 
 def _create_raw_backend(args, rank: int, size: int,

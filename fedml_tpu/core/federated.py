@@ -351,6 +351,30 @@ def update_buffer_apply(spec: "AlgorithmSpec", opt, state, buf,
     return new_state, agg, fresh
 
 
+def zero_like_partial(partial: Dict[str, Any]) -> Dict[str, Any]:
+    """A partial aggregate that contributes NOTHING to
+    :func:`combine_partial_aggregates`: every numerator, denominator,
+    sum-kind entry, and ``n_sampled`` is zero, so ``sum(num)/sum(den)``
+    over the padded tuple equals the average over the real partials
+    alone.  Quorum rounds (docs/FAULT_TOLERANCE.md) pad the arrived set
+    to the full silo count with these so the jitted combine keeps ONE
+    compiled shape regardless of how many silos made the deadline —
+    exact quorum math at zero steady-state recompiles.
+
+    Zeros preserve each leaf's ARRAY KIND (numpy stays numpy, device
+    stays device): the jit cache key sees identical argument signatures
+    for a padded and a full tuple, so quorum-size changes never split
+    the cache."""
+    import numpy as np
+
+    def zero(leaf):
+        if isinstance(leaf, jax.Array):
+            return jnp.zeros_like(leaf)
+        return np.zeros_like(np.asarray(leaf))
+
+    return jax.tree_util.tree_map(zero, partial)
+
+
 def scale_partial(spec: "AlgorithmSpec", partial: Dict[str, Any],
                   s) -> Dict[str, Any]:
     """Staleness-discount a :class:`PartialReducer` partial by ``s``:

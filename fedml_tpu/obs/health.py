@@ -327,6 +327,18 @@ class HealthMonitor:
 #: metric is absent from the gauge set are skipped (a train-only run is
 #: not "degraded" for lacking serving gauges)
 DEFAULT_SLO_RULES: List[Dict[str, Any]] = [
+    # fedguard reliability plane (docs/FAULT_TOLERANCE.md): a sustained
+    # retry storm degrades; any rank missing from the round degrades
+    # ("quorum below S"); a round that could not seat its quorum Q is
+    # unhealthy; lease-dead ranks degrade until they heal or are
+    # replaced
+    {"name": "comm_retry_rate", "metric": "comm.retry_rate",
+     "max": 0.25, "crit": 0.75},
+    {"name": "quorum_full", "metric": "comm.quorum_missing_ranks",
+     "max": 0.0},
+    {"name": "quorum_met", "metric": "comm.quorum_deficit",
+     "crit": 0.0},
+    {"name": "dead_ranks", "metric": "comm.dead_ranks", "max": 0.0},
     {"name": "round_time", "metric": "health.round_time_s",
      "max": 60.0, "crit": 600.0},
     {"name": "anomaly_rate", "metric": "health.anomaly_rate",

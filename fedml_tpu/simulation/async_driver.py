@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import logging
 import queue
-import threading
 import time
 
 import jax
@@ -42,6 +41,9 @@ from ..core import federated
 from ..core import hostrng
 from ..core import rng as rng_util
 from ..core import traffic
+from ..core.distributed.communication.fault_injection import (
+    maybe_crash_at_round)
+from ..core.distributed.reliability import ReliableEndpoint
 from ..obs import get_tracer
 from .round_engine import make_run_clients
 from .sp.fedavg_api import FedAvgAPI
@@ -58,16 +60,16 @@ MSG_TYPE_ASYNC_FINISH = 703
 WORKER_LATENCY_TAG = 0xA51D1
 
 
-class _AsyncEndpoint:
+class _AsyncEndpoint(ReliableEndpoint):
     """Queue-backed endpoint over the real FedMLCommManager receive path
     (handlers run on the comm loop thread and enqueue; the driver loops
-    consume from the queue)."""
+    consume from the queue).  ``recv`` raises :class:`TimeoutError`
+    naming rank/expected/elapsed — never a bare ``queue.Empty``."""
 
     def __init__(self, args, rank: int, size: int, backend: str):
         from ..core.distributed.fedml_comm_manager import FedMLCommManager
 
-        self.inbox: "queue.Queue" = queue.Queue()
-        inbox = self.inbox
+        inbox: "queue.Queue" = queue.Queue()
 
         class _Mgr(FedMLCommManager):
             def register_message_receive_handlers(self):
@@ -76,19 +78,8 @@ class _AsyncEndpoint:
                     self.register_message_receive_handler(
                         t, lambda m: inbox.put(m))
 
-        self._mgr = _Mgr(args, rank=rank, size=size, backend=backend)
-        self._thread = threading.Thread(target=self._mgr.run, daemon=True)
-        self._thread.start()
-
-    def send(self, msg):
-        self._mgr.send_message(msg)
-
-    def recv(self, timeout_s: float = 120.0):
-        return self.inbox.get(timeout=timeout_s)
-
-    def close(self):
-        self._mgr.finish()
-        self._thread.join(timeout=5.0)
+        super().__init__(_Mgr(args, rank=rank, size=size, backend=backend),
+                         inbox, rank)
 
 
 def run_async_federation(args, device, dataset, model):
@@ -103,6 +94,17 @@ def run_async_federation(args, device, dataset, model):
     rank = int(getattr(args, "rank", 0))
     workers = int(getattr(args, "async_workers", 0) or 2)
     backend = str(getattr(args, "backend", "local"))
+    if bool(getattr(args, "reliable_delivery", False)):
+        # fedguard (docs/FAULT_TOLERANCE.md): dispatch/update/finish get
+        # ack/retransmit; heartbeat leases drive dead-worker exclusion
+        if not getattr(args, "reliable_types", None):
+            args.reliable_types = [MSG_TYPE_ASYNC_DISPATCH,
+                                   MSG_TYPE_ASYNC_UPDATE,
+                                   MSG_TYPE_ASYNC_FINISH]
+        if not getattr(args, "heartbeat_interval_s", 0.0):
+            args.heartbeat_interval_s = 0.5
+        if not getattr(args, "lease_s", 0.0):
+            args.lease_s = 5.0
     tracer = get_tracer()
     if bool(getattr(args, "trace", False)) or tracer.enabled:
         from ..obs import configure
@@ -134,7 +136,8 @@ def run_async_federation(args, device, dataset, model):
         _run_async_worker(api, ep, rank, args, tracer)
         return None
     finally:
-        ep.close()
+        # rank 0 grants in-flight reliable FINISHes a short ack window
+        ep.close(flush_s=2.0 if rank == 0 else 0.0)
         if api.metrics_server is not None:
             api.metrics_server.close()
         tracer.close()   # flush this process's mergeable trace
@@ -143,7 +146,10 @@ def run_async_federation(args, device, dataset, model):
 def _run_async_server(api, ep, workers, args, tracer):
     """Rank 0: buffer staleness-discounted partials, apply at K through
     combine_partial_aggregates, re-dispatch the sender at the new
-    version."""
+    version.  fedguard: the buffer also flushes at
+    ``quorum_deadline_s`` with fewer than K partials (padded with zero
+    partials so the jitted combine keeps ONE compiled shape), and
+    lease-dead workers are excluded from re-dispatch until they heal."""
     import flax.serialization as fser
 
     from ..core.distributed.communication.message import Message
@@ -153,6 +159,12 @@ def _run_async_server(api, ep, workers, args, tracer):
     k = int(getattr(args, "async_buffer_k", 0) or 0) or workers
     alpha = float(getattr(args, "async_alpha", 0.5))
     max_staleness = int(getattr(args, "async_max_staleness", 0) or 0)
+    deadline_s = float(getattr(args, "quorum_deadline_s", 0.0) or 0.0)
+    recv_timeout_s = float(getattr(args, "comm_recv_timeout_s", 120.0)
+                           or 120.0)
+    guard = ep.guard
+    if guard is not None:
+        guard.start_heartbeats(expected_ranks=range(1, workers + 1))
     combine = jax.jit(lambda st, parts: api.server_opt.
                       update_from_aggregates(
                           st, federated.combine_partial_aggregates(
@@ -175,9 +187,66 @@ def _run_async_server(api, ep, workers, args, tracer):
     buffered, loss_w, w_sum, stales = [], 0.0, 0.0, []
     applies = 0
     dropped = 0
+    pending_redispatch = []
     t0 = time.time()
+    last_apply = time.monotonic()
+    last_arrival = time.monotonic()
+
+    def apply_buffer(flushed: bool):
+        nonlocal buffered, loss_w, w_sum, stales, version, applies, t0
+        parts = list(buffered)
+        if len(parts) < k:
+            # deadline flush: pad to K with zero partials — exact (zero
+            # num / zero den) and shape-stable under jit
+            parts += [federated.zero_like_partial(parts[0])] * \
+                (k - len(parts))
+        with tracer.span("async.apply", cat="round", version=version,
+                         quorum=len(buffered)):
+            api.state = combine(api.state, tuple(parts))
+            jax.block_until_ready(api.state.global_params)
+        tracer.counter("comm.quorum_size", float(len(buffered)))
+        tracer.counter("comm.quorum_deficit",
+                       float(k - len(buffered)) if flushed else 0.0)
+        history.append({
+            "round": applies, "train_loss": loss_w / max(w_sum, 1e-9),
+            "round_time": time.time() - t0,
+            "staleness_p50": float(np.percentile(stales, 50))
+            if stales else 0.0,
+            "updates_dropped": dropped,
+            "buffer_fill": len(buffered), "deadline_flush": flushed})
+        log.info("async server apply %d: train_loss=%.4f (%d/%d %s)",
+                 applies, history[-1]["train_loss"], len(buffered), k,
+                 "deadline-flush" if flushed else "full")
+        buffered, loss_w, w_sum, stales = [], 0.0, 0.0, []
+        version += 1
+        applies += 1
+        t0 = time.time()
+
     while applies < rounds:
-        msg = ep.recv()
+        if guard is not None:
+            dead = guard.dead_ranks()
+            tracer.counter("comm.dead_ranks", float(len(dead)))
+            if pending_redispatch:
+                # a healed worker (lease renewed) rejoins the dispatch
+                # rotation at the current version
+                for w in [w for w in pending_redispatch if w not in dead]:
+                    pending_redispatch.remove(w)
+                    dispatch(w, gen, version)
+                    gen += 1
+        msg = ep.poll(timeout_s=0.05)
+        if msg is None:
+            if deadline_s > 0 and buffered \
+                    and time.monotonic() - last_apply >= deadline_s:
+                apply_buffer(flushed=True)
+                last_apply = time.monotonic()
+            elif time.monotonic() - last_arrival > recv_timeout_s:
+                raise TimeoutError(
+                    f"rank 0: no MSG_TYPE_ASYNC_UPDATE within "
+                    f"{time.monotonic() - last_arrival:.1f}s at apply "
+                    f"{applies} (comm_recv_timeout_s={recv_timeout_s:g})"
+                    " — all workers dead or partitioned")
+            continue
+        last_arrival = time.monotonic()
         if msg.get_type() != MSG_TYPE_ASYNC_UPDATE:
             continue
         sender = int(msg.get("worker"))
@@ -192,24 +261,16 @@ def _run_async_server(api, ep, workers, args, tracer):
             w_sum += s * float(msg.get("w_sum"))
             stales.append(tau)
         if len(buffered) >= k:
-            with tracer.span("async.apply", cat="round", version=version):
-                api.state = combine(api.state, tuple(buffered))
-                jax.block_until_ready(api.state.global_params)
-            history.append({
-                "round": applies, "train_loss": loss_w / max(w_sum, 1e-9),
-                "round_time": time.time() - t0,
-                "staleness_p50": float(np.percentile(stales, 50))
-                if stales else 0.0,
-                "updates_dropped": dropped})
-            log.info("async server apply %d: train_loss=%.4f", applies,
-                     history[-1]["train_loss"])
-            buffered, loss_w, w_sum, stales = [], 0.0, 0.0, []
-            version += 1
-            applies += 1
-            t0 = time.time()
+            apply_buffer(flushed=False)
+            last_apply = time.monotonic()
         if applies < rounds:
-            dispatch(sender, gen, version)
-            gen += 1
+            if guard is not None and sender in guard.dead_ranks():
+                # declared dead: excluded from dispatch until its lease
+                # renews (the heal path above re-admits it)
+                pending_redispatch.append(sender)
+            else:
+                dispatch(sender, gen, version)
+                gen += 1
     for w in range(1, workers + 1):
         ep.send(Message(MSG_TYPE_ASYNC_FINISH, 0, w))
     return history
@@ -243,14 +304,28 @@ def _run_async_worker(api, ep, rank, args, tracer):
     lat_median = float(getattr(args, "async_latency_median_s", 0.0) or 0.0)
     lat_sigma = float(getattr(args, "async_latency_sigma", 1.5) or 1.5)
     seed = int(getattr(args, "random_seed", 0))
+    guard = ep.guard
+    if guard is not None:
+        guard.start_heartbeats()
+    recv_timeout_s = float(getattr(args, "comm_recv_timeout_s", 120.0)
+                           or 120.0)
+    dispatches = 0
     while True:
-        msg = ep.recv()
+        msg = ep.recv(timeout_s=recv_timeout_s,
+                      expect="MSG_TYPE_ASYNC_DISPATCH/"
+                             "MSG_TYPE_ASYNC_FINISH from rank 0")
         if msg.get_type() == MSG_TYPE_ASYNC_FINISH:
             return
         if msg.get_type() != MSG_TYPE_ASYNC_DISPATCH:
             continue
         gen = int(msg.get("gen"))
         version = int(msg.get("version"))
+        # crash-at-round chaos: dies on this worker's Nth dispatch
+        # (gen ids are assigned in arrival order, so the worker's own
+        # dispatch ordinal is the deterministic schedule key here) —
+        # the buffer must flush at the deadline without us
+        maybe_crash_at_round(args, rank, dispatches)
+        dispatches += 1
         api.state = fser.from_state_dict(api.state, msg.get("state"))
         with tracer.span("async.worker_round", cat="round", gen=gen,
                          worker=rank):

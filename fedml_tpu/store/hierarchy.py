@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import logging
 import queue
-import threading
 import time
 
 import jax
@@ -35,6 +34,10 @@ import numpy as np
 
 from ..core import federated
 from ..core import rng as rng_util
+from ..core.distributed.communication.fault_injection import (
+    maybe_crash_at_round)
+from ..core.distributed.reliability import (KEY_UNRELIABLE,
+                                             ReliableEndpoint, RoundWAL)
 from ..obs import get_tracer
 from ..simulation.round_engine import make_run_clients, next_pow2
 from ..simulation.sp.fedavg_api import FedAvgAPI
@@ -205,7 +208,8 @@ class HierarchicalSiloAPI(FedAvgAPI):
 
 
 # ---------------------------------------------------------------------------
-# multi-process two-tier federation (fedscope, docs/OBSERVABILITY.md)
+# multi-process two-tier federation (fedscope + fedguard,
+# docs/OBSERVABILITY.md, docs/FAULT_TOLERANCE.md)
 # ---------------------------------------------------------------------------
 #
 # The in-process HierarchicalSiloAPI above proves the MATH of two-tier
@@ -216,6 +220,18 @@ class HierarchicalSiloAPI(FedAvgAPI):
 # comm.recv spans + injected trace context land on the measured path and
 # ``tools/fedtrace.py merge`` can stitch the per-process captures into one
 # timeline whose ``critical-path`` names the gating silo.
+#
+# The protocol is DISPATCH-DRIVEN (fedguard): rank 0 opens round r by
+# fanning the current state out as STATE_SYNC(r); silos are purely
+# reactive — whatever round is dispatched, they compute and upload.
+# That makes both crash directions resumable: a restarted rank 0
+# re-dispatches from its WAL round, and a restarted silo simply answers
+# the next dispatch (the state rides every sync, so rejoin IS the sync
+# path).  With ``reliable_delivery`` the payload types below get
+# ack/retransmit + dedupe; ``quorum``/``quorum_deadline_s`` let rank 0
+# close a round with a subset of silos (exact — the partial algebra
+# carries its own denominators, and the arrived set is padded with
+# zero partials so the combine keeps one compiled shape).
 
 #: protocol message types (disjoint from cross_silo MyMessage's range)
 MSG_TYPE_SILO_PARTIAL = 601
@@ -223,16 +239,16 @@ MSG_TYPE_STATE_SYNC = 602
 MSG_TYPE_FINISH = 603
 
 
-class _SiloEndpoint:
+class _SiloEndpoint(ReliableEndpoint):
     """Queue-backed endpoint over the real FedMLCommManager receive path
     (handlers run on the comm loop thread and enqueue; the driver's round
-    loop consumes from the queue)."""
+    loop consumes from the queue).  ``recv`` raises :class:`TimeoutError`
+    naming rank/expected/elapsed — never a bare ``queue.Empty``."""
 
     def __init__(self, args, rank: int, size: int, backend: str):
         from ..core.distributed.fedml_comm_manager import FedMLCommManager
 
-        self.inbox: "queue.Queue" = queue.Queue()
-        inbox = self.inbox
+        inbox: "queue.Queue" = queue.Queue()
 
         class _Mgr(FedMLCommManager):
             def register_message_receive_handlers(self):
@@ -241,19 +257,8 @@ class _SiloEndpoint:
                     self.register_message_receive_handler(
                         t, lambda m: inbox.put(m))
 
-        self._mgr = _Mgr(args, rank=rank, size=size, backend=backend)
-        self._thread = threading.Thread(target=self._mgr.run, daemon=True)
-        self._thread.start()
-
-    def send(self, msg):
-        self._mgr.send_message(msg)
-
-    def recv(self, timeout_s: float = 120.0):
-        return self.inbox.get(timeout=timeout_s)
-
-    def close(self):
-        self._mgr.finish()
-        self._thread.join(timeout=5.0)
+        super().__init__(_Mgr(args, rank=rank, size=size, backend=backend),
+                         inbox, rank)
 
 
 def run_silo_federation(args, device, dataset, model):
@@ -264,7 +269,14 @@ def run_silo_federation(args, device, dataset, model):
     ``random_seed``, so cohort sampling / rng streams / batch schedules
     are bitwise the in-process :class:`HierarchicalSiloAPI`'s; the only
     divergence from the flat round is float reassociation in the combined
-    numerators (same contract as the in-process driver).
+    numerators (same contract as the in-process driver) — plus, under a
+    quorum close, the missing silos' cohort slices.
+
+    Fault tolerance (docs/FAULT_TOLERANCE.md): ``reliable_delivery``
+    adds ack/retransmit + heartbeat leases; ``quorum`` /
+    ``quorum_deadline_s`` close rounds without stragglers/dead silos;
+    ``checkpoint_dir`` arms per-round checkpoints plus the applied-round
+    WAL so a killed-and-restarted rank 0 resumes without double-applying.
 
     Straggler injection for the fedscope acceptance run:
     ``args.silo_slow_rank`` / ``args.silo_slow_s`` hold one silo's round
@@ -274,14 +286,20 @@ def run_silo_federation(args, device, dataset, model):
 
     Returns the server's per-round metrics list on rank 0, None on silos.
     """
-    import flax.serialization as fser
-
-    from ..core.distributed.communication.message import Message
-
     rank = int(getattr(args, "rank", 0))
     num_silos = int(getattr(args, "num_silos", 0) or 2)
     rounds = int(getattr(args, "comm_round", 1))
     backend = str(getattr(args, "backend", "filestore"))
+    if bool(getattr(args, "reliable_delivery", False)):
+        # the payload types below get ack/retransmit; heartbeat/lease
+        # defaults are driver-scoped (a silo round is sub-second here)
+        if not getattr(args, "reliable_types", None):
+            args.reliable_types = [MSG_TYPE_SILO_PARTIAL,
+                                   MSG_TYPE_STATE_SYNC, MSG_TYPE_FINISH]
+        if not getattr(args, "heartbeat_interval_s", 0.0):
+            args.heartbeat_interval_s = 0.5
+        if not getattr(args, "lease_s", 0.0):
+            args.lease_s = 5.0
     tracer = get_tracer()
     if bool(getattr(args, "trace", False)) or tracer.enabled:
         from ..obs import configure
@@ -305,68 +323,201 @@ def run_silo_federation(args, device, dataset, model):
     ep = _SiloEndpoint(args, rank, num_silos + 1, backend)
     try:
         if rank == 0:
-            return _run_combine_tier(api, ep, num_silos, rounds, tracer)
-        _run_silo_tier(api, ep, rank, rounds, args, tracer)
+            return _run_combine_tier(api, ep, num_silos, rounds, args,
+                                     tracer)
+        _run_silo_tier(api, ep, rank, args, tracer)
         return None
     finally:
-        ep.close()
+        # rank 0 grants in-flight reliable FINISHes a short ack window
+        ep.close(flush_s=2.0 if rank == 0 else 0.0)
         if api.metrics_server is not None:
             api.metrics_server.close()
         tracer.close()   # flush this process's mergeable trace
 
 
-def _run_combine_tier(api, ep, num_silos, rounds, tracer):
+def _collect_quorum(ep, guard, round_idx, expected, quorum, deadline_s,
+                    recv_timeout_s, tracer):
+    """Collect SILO_PARTIAL uploads for ``round_idx`` until every live
+    expected silo arrived, or — once ``deadline_s`` has elapsed — until
+    at least ``quorum`` have.  Lease-dead ranks leave the expected set
+    mid-wait (and re-enter next round if they heal).  Returns
+    ``(got, live)``; raises ``RuntimeError`` when the quorum can never
+    be met and ``TimeoutError`` when nothing arrives for
+    ``recv_timeout_s``."""
+    got = {}
+    live = set(expected)
+    t_open = time.monotonic()
+    last_arrival = time.monotonic()
+    while True:
+        if guard is not None:
+            live = set(expected) - guard.dead_ranks()
+        if len(live | set(got)) < quorum:
+            raise RuntimeError(
+                f"round {round_idx}: quorum {quorum} unreachable — "
+                f"arrived={sorted(got)}, live={sorted(live)}, "
+                f"dead={sorted(set(expected) - live)}")
+        waiting = live - set(got)
+        if not waiting:
+            break
+        if deadline_s > 0 and len(got) >= quorum \
+                and time.monotonic() - t_open >= deadline_s:
+            log.warning(
+                "round %d: quorum close at deadline with %d/%d silos "
+                "(missing %s)", round_idx, len(got), len(expected),
+                sorted(waiting))
+            break
+        msg = ep.poll(timeout_s=0.05)
+        if msg is None:
+            if time.monotonic() - last_arrival > recv_timeout_s:
+                raise TimeoutError(
+                    f"rank 0: no MSG_TYPE_SILO_PARTIAL for round "
+                    f"{round_idx} from ranks {sorted(waiting)} within "
+                    f"{time.monotonic() - last_arrival:.1f}s "
+                    f"(comm_recv_timeout_s={recv_timeout_s:g})")
+            continue
+        last_arrival = time.monotonic()
+        if msg.get_type() != MSG_TYPE_SILO_PARTIAL:
+            continue
+        if int(msg.get("round_idx")) != round_idx:
+            # round binding: late partials for a closed round drop here
+            log.warning("server: dropping stale round-%s partial",
+                        msg.get("round_idx"))
+            tracer.counter("comm.stale_partials", 1.0)
+            continue
+        got.setdefault(int(msg.get("silo")), msg)
+    return got, live
+
+
+def _run_combine_tier(api, ep, num_silos, rounds, args, tracer):
     import flax.serialization as fser
 
     from ..core.distributed.communication.message import Message
+    from ..obs import context as obs_context
+
+    guard = ep.guard
+    expected = list(range(1, num_silos + 1))
+    if guard is not None:
+        guard.start_heartbeats(expected_ranks=expected)
+    quorum = int(getattr(args, "quorum", 0) or 0) or num_silos
+    deadline_s = float(getattr(args, "quorum_deadline_s", 0.0) or 0.0)
+    recv_timeout_s = float(getattr(args, "comm_recv_timeout_s", 120.0)
+                           or 120.0)
+
+    # crash-resume: per-round orbax checkpoint + applied-round WAL —
+    # restart restores round c, backfills a torn journal entry, and
+    # resumes dispatch at c + 1 (reliability.RoundWAL write protocol)
+    wal = None
+    start_round = 0
+    if getattr(args, "checkpoint_dir", None):
+        args.checkpoint_freq = 1
+        start_round = api.maybe_resume()
+        wal = RoundWAL(str(args.checkpoint_dir))
+        wal.ensure(start_round - 1 if start_round else None)
+        if start_round:
+            log.info("server: resumed from checkpoint+WAL at round %d",
+                     start_round)
 
     history = []
-    for r in range(rounds):
+    for r in range(start_round, rounds):
         t0 = time.time()
+        # kill-rank-0 chaos hook: fires BETWEEN rounds — the previous
+        # round is fully applied+journaled, exactly the crash window
+        # the WAL resume contract covers
+        maybe_crash_at_round(args, 0, r)
         with tracer.span("round", cat="round", round=r):
-            got = {}
-            while len(got) < num_silos:
-                msg = ep.recv()
-                if msg.get_type() != MSG_TYPE_SILO_PARTIAL:
-                    continue
-                if int(msg.get("round_idx")) != r:
-                    log.warning("server: dropping stale round-%s partial",
-                                msg.get("round_idx"))
-                    continue
-                got[int(msg.get("silo"))] = msg
-            with tracer.span("combine", cat="round", round=r):
-                partials = [got[s + 1].get("partial")
-                            for s in range(num_silos)]
-                api.apply_partials(partials)
-                jax.block_until_ready(api.state.global_params)
+            live = set(expected) - (guard.dead_ranks() if guard
+                                    else set())
             state_dict = fser.to_state_dict(api.state)
-            for s in range(num_silos):
-                sync = Message(MSG_TYPE_STATE_SYNC, 0, s + 1)
+            for s in expected:
+                sync = Message(MSG_TYPE_STATE_SYNC, 0, s)
                 sync.add_params("round_idx", r)
                 sync.add_params("state", state_dict)
+                if s not in live:
+                    # lease-dead rank: still PROBE it with the dispatch
+                    # (the state sync IS the rejoin path for a restarted
+                    # or healed silo) but fire-and-forget — no
+                    # retransmit obligations toward a peer that may
+                    # never come back, and no quorum wait on it below
+                    sync.add_params(KEY_UNRELIABLE, True)
                 ep.send(sync)
-        loss_w = sum(float(np.asarray(got[s + 1].get("loss_w")))
-                     for s in range(num_silos))
-        w_total = sum(float(got[s + 1].get("silo_w"))
-                      for s in range(num_silos))
-        history.append({"round": r, "train_loss": loss_w / max(w_total, 1e-9),
+            got, live = _collect_quorum(ep, guard, r, expected, quorum,
+                                        deadline_s, recv_timeout_s,
+                                        tracer)
+            with tracer.span("combine", cat="round", round=r,
+                             quorum=len(got)):
+                partials = [got[s].get("partial") for s in sorted(got)]
+                # pad the arrived set to S with zero partials: the
+                # combine keeps ONE compiled shape at every quorum size
+                # and the algebra stays exact (zero num, zero den)
+                if len(partials) < num_silos:
+                    pad = federated.zero_like_partial(partials[0])
+                    partials += [pad] * (num_silos - len(partials))
+                api.apply_partials(partials)
+                jax.block_until_ready(api.state.global_params)
+            if wal is not None:
+                api.maybe_checkpoint(r)
+                wal.record(
+                    r, msg_ids=[str(m.get(obs_context.KEY_MSG_ID))
+                                for m in got.values()
+                                if m.get(obs_context.KEY_MSG_ID)],
+                    quorum=len(got))
+        dead = sorted(set(expected) - live)
+        tracer.counter("comm.quorum_size", float(len(got)), round=r)
+        tracer.counter("comm.quorum_missing_ranks",
+                       float(num_silos - len(got)), round=r)
+        tracer.counter("comm.quorum_deficit",
+                       float(max(quorum - len(got), 0)), round=r)
+        tracer.counter("comm.dead_ranks", float(len(dead)), round=r)
+        loss_w = sum(float(np.asarray(m.get("loss_w")))
+                     for m in got.values())
+        w_total = sum(float(m.get("silo_w")) for m in got.values())
+        history.append({"round": r,
+                        "train_loss": loss_w / max(w_total, 1e-9),
                         "round_time": time.time() - t0,
-                        "silos": num_silos})
-        log.info("server round %d: train_loss=%.4f (%.2fs)", r,
-                 history[-1]["train_loss"], history[-1]["round_time"])
-    for s in range(num_silos):
-        ep.send(Message(MSG_TYPE_FINISH, 0, s + 1))
+                        "silos": num_silos, "quorum": len(got),
+                        "dead_ranks": dead})
+        log.info("server round %d: train_loss=%.4f (%.2fs, %d/%d silos)",
+                 r, history[-1]["train_loss"], history[-1]["round_time"],
+                 len(got), num_silos)
+    for s in expected:
+        ep.send(Message(MSG_TYPE_FINISH, 0, s))
     return history
 
 
-def _run_silo_tier(api, ep, rank, rounds, args, tracer):
+def _run_silo_tier(api, ep, rank, args, tracer):
+    """Reactive silo loop: whatever round rank 0 dispatches (a
+    STATE_SYNC carrying the current state), compute that round's slice
+    and upload the partial.  A restarted silo rejoins by simply
+    answering the next dispatch — the state rides every sync."""
     import flax.serialization as fser
 
     from ..core.distributed.communication.message import Message
 
+    guard = ep.guard
+    if guard is not None:
+        guard.start_heartbeats()
+    recv_timeout_s = float(getattr(args, "comm_recv_timeout_s", 120.0)
+                           or 120.0)
     slow_rank = int(getattr(args, "silo_slow_rank", 0) or 0)
     slow_s = float(getattr(args, "silo_slow_s", 0.0) or 0.0)
-    for r in range(rounds):
+    while True:
+        msg = ep.recv(timeout_s=recv_timeout_s,
+                      expect="MSG_TYPE_STATE_SYNC/MSG_TYPE_FINISH "
+                             "from rank 0")
+        if msg.get_type() == MSG_TYPE_FINISH:
+            return
+        if msg.get_type() != MSG_TYPE_STATE_SYNC:
+            continue
+        # NOTE: a re-dispatched round (same round_idx, new msg_id — a
+        # restarted rank 0 whose collect window died with it) is
+        # recomputed and re-uploaded; retransmits of ONE dispatch share
+        # a msg_id and are deduped below us, and the server keys arrived
+        # partials by silo, so answering again is always safe
+        r = int(msg.get("round_idx"))
+        api.state = fser.from_state_dict(api.state, msg.get("state"))
+        # crash-at-round chaos: dies on receipt of round r's dispatch,
+        # BEFORE computing — the round must close at quorum without us
+        maybe_crash_at_round(args, rank, r)
         with tracer.span("silo.round", cat="round", round=r, silo=rank):
             partial, silo_w, loss_w, _steps, _new_c = api.silo_partial(
                 r, rank - 1)
@@ -382,19 +533,3 @@ def _run_silo_tier(api, ep, rank, rounds, args, tracer):
         up.add_params("silo_w", silo_w)
         up.add_params("loss_w", np.asarray(loss_w))
         ep.send(up)
-        while True:
-            msg = ep.recv()
-            if msg.get_type() == MSG_TYPE_FINISH:
-                return
-            if msg.get_type() == MSG_TYPE_STATE_SYNC \
-                    and int(msg.get("round_idx")) == r:
-                api.state = fser.from_state_dict(api.state,
-                                                 msg.get("state"))
-                break
-    # drain the finish marker so the server's send never blocks
-    try:
-        while True:
-            if ep.recv(timeout_s=10.0).get_type() == MSG_TYPE_FINISH:
-                break
-    except queue.Empty:
-        pass

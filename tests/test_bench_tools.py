@@ -199,6 +199,40 @@ def test_bench_async_quick(monkeypatch):
     assert out["fedbuff_steady_host_s_per_apply"] > 0
 
 
+def test_bench_chaos_quick(monkeypatch):
+    """bench.py --chaos smoke (fedguard, docs/FAULT_TOLERANCE.md): the
+    four-scenario fault-tolerance matrix runs green on the real
+    multi-rank driver — clean parity vs the in-process API, every round
+    completed at quorum with one silo crashed, the partition heals, a
+    killed-and-restarted rank 0 resumes from the WAL with zero
+    double-applied rounds, and the quorum-padded combine never
+    recompiles."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_CHAOS_QUICK", "1")
+    out = bench.bench_chaos()
+    assert out["quick"] is True
+    rounds = out["rounds"]
+    # crash-one-silo: completes EVERY round, at full strength before the
+    # crash and at quorum 2/3 from the crash round on
+    assert out["rounds_completed_under_chaos"] == rounds
+    traj = out["crash_quorum_trajectory"]
+    assert traj[0] == 3 and traj[-1] == 2 and min(traj) >= out["quorum"]
+    assert out["crash_loss_delta_vs_clean"] < 0.25
+    # clean distributed run == in-process hierarchical math (the wire
+    # adds serialization, not math; quick-mode rounds keep drift tiny)
+    assert out["wire_vs_inprocess_loss_delta"] < 1e-2
+    # partition-and-heal: dips to quorum inside the window, heals after
+    assert out["partition_rounds_completed"] == rounds
+    assert min(out["partition_quorum_trajectory"]) == out["quorum"]
+    assert out["partition_healed"] is True
+    # kill-and-restart rank 0: WAL covers every round exactly once
+    assert out["kill_rank0_double_applied"] == 0
+    assert sorted(out["kill_rank0_wal_rounds"]) == list(range(rounds))
+    assert out["kill_rank0_resumed_rounds"][0] == out["crash_round"]
+    # quorum closes pad with zero partials — one compiled combine shape
+    assert out["steady_compiles_quorum"] == 0
+
+
 def test_bench_verify_quick(monkeypatch):
     """bench.py --verify smoke: the fedverify census row runs green —
     programs lower+compile, zero unsuppressed contract violations, and

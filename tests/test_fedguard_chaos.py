@@ -1,0 +1,171 @@
+"""fedguard acceptance (docs/FAULT_TOLERANCE.md): REAL OS-process chaos
+over the two-tier driver and the filestore backend.
+
+Two scenarios, both with reliable delivery + heartbeat leases on:
+
+- **crash one silo mid-run** (a true ``os._exit`` — no finally blocks,
+  exactly what a SIGKILL leaves behind): the federation must complete
+  EVERY round, closing at quorum 2/3 from the crash round on, with the
+  pre-crash rounds matching the in-process ``HierarchicalSiloAPI``
+  math and the final loss within tolerance of it.
+- **kill-and-restart rank 0**: the coordinator dies between rounds and
+  is relaunched over the same filestore run + checkpoint dir; it must
+  resume from the applied-round WAL with ZERO double-applied rounds
+  (the journal is the pinned witness) while the silo ranks simply
+  answer the re-dispatches.
+
+The fast mechanics behind these (backoff, dedupe, leases, WAL replay,
+partition/bandwidth chaos) are unit-tested in ``test_reliability.py``;
+the thread-level scenario matrix runs in ``bench.py --chaos``
+(``FEDML_CHAOS_QUICK`` smoke in ``test_bench_tools.py``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENTRY = textwrap.dedent("""
+    import os, sys, json
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import fedml_tpu
+    from fedml_tpu import data as data_mod, model as model_mod
+
+    rank = int(sys.argv[1]); tmp = sys.argv[2]
+    over = json.loads(sys.argv[3])
+    args = fedml_tpu.load_arguments()
+    args.update(
+        backend="filestore", filestore_dir=tmp, rank=rank,
+        run_id="fedguard1", dataset="synthetic", num_classes=4,
+        input_shape=(8, 8, 1), train_size=256, test_size=64, model="lr",
+        client_num_in_total=12, client_num_per_round=6, comm_round=5,
+        epochs=1, batch_size=8, learning_rate=0.1, random_seed=3,
+        partition_method="homo", num_silos=3,
+        frequency_of_the_test=10**9,
+        reliable_delivery=True, quorum=2, quorum_deadline_s=2.0,
+        heartbeat_interval_s=0.3, lease_s=2.5,
+        retry_base_s=0.1, retry_deadline_s=8.0,
+        comm_recv_timeout_s=90.0,
+    )
+    args.update(**over)
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    from fedml_tpu.store.hierarchy import run_silo_federation
+    hist = run_silo_federation(args, None, dataset, model)
+    if rank == 0:
+        with open(os.path.join(tmp, "hist.json"), "w") as f:
+            json.dump(hist, f)
+""")
+
+
+def _spawn(entry, rank, tmp_path, over):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    return subprocess.Popen(
+        [sys.executable, str(entry), str(rank), str(tmp_path),
+         json.dumps(over)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _inprocess_history(n_rounds=5, num_silos=3):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import fedml_tpu
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.store.hierarchy import HierarchicalSiloAPI
+
+    args = fedml_tpu.load_arguments()
+    args.update(dataset="synthetic", num_classes=4, input_shape=(8, 8, 1),
+                train_size=256, test_size=64, model="lr",
+                client_num_in_total=12, client_num_per_round=6,
+                comm_round=n_rounds, epochs=1, batch_size=8,
+                learning_rate=0.1, random_seed=3,
+                partition_method="homo", num_silos=num_silos,
+                frequency_of_the_test=10 ** 9)
+    args = fedml_tpu.init(args, should_init_logs=False)
+    dataset, out_dim = data_mod.load(args)
+    api = HierarchicalSiloAPI(args, None, dataset,
+                              model_mod.create(args, out_dim))
+    return [float(api.train_one_round(r)["train_loss"])
+            for r in range(n_rounds)]
+
+
+@pytest.mark.slow
+def test_three_process_crash_silo_completes_at_quorum_with_parity(tmp_path):
+    entry = tmp_path / "entry.py"
+    entry.write_text(ENTRY)
+    crash_round = 2
+    procs = {r: _spawn(entry, r, tmp_path,
+                       {"chaos_crash_rank": 3,
+                        "chaos_crash_round": crash_round}
+                       if r == 3 else {})
+             for r in (1, 2, 3, 0)}
+    codes = {}
+    for r, p in procs.items():
+        out, err = p.communicate(timeout=420)
+        codes[r] = p.returncode
+        if r != 3:
+            assert p.returncode == 0, (r, err.decode()[-2000:])
+    # the crashed silo died the HARD way (os._exit(3), no cleanup)
+    assert codes[3] == 3
+
+    hist = json.load(open(tmp_path / "hist.json"))
+    assert [h["round"] for h in hist] == [0, 1, 2, 3, 4]
+    # full strength before the crash, quorum closes from it on — and the
+    # dead rank is eventually named by lease expiry
+    assert [h["quorum"] for h in hist][:crash_round] == [3] * crash_round
+    assert all(h["quorum"] == 2 for h in hist[crash_round:])
+    assert any(3 in h["dead_ranks"] for h in hist)
+
+    ref = _inprocess_history()
+    # pre-crash rounds are the in-process math over the wire
+    for r in range(crash_round):
+        assert abs(hist[r]["train_loss"] - ref[r]) < 1e-3, r
+    # post-crash rounds lose one cohort slice: parity within tolerance
+    assert abs(hist[-1]["train_loss"] - ref[-1]) < 0.25
+
+
+@pytest.mark.slow
+def test_kill_and_restart_rank0_resumes_from_wal(tmp_path):
+    from fedml_tpu.core.distributed.reliability import RoundWAL
+
+    entry = tmp_path / "entry.py"
+    entry.write_text(ENTRY)
+    ckpt = str(tmp_path / "ckpt")
+    crash_round = 2
+    silos = {r: _spawn(entry, r, tmp_path, {}) for r in (1, 2, 3)}
+    # first coordinator life: journals rounds 0..1, then dies between
+    # rounds (os._exit — the WAL/checkpoint pair is all that survives)
+    first = _spawn(entry, 0, tmp_path,
+                   {"checkpoint_dir": ckpt, "chaos_crash_rank": 0,
+                    "chaos_crash_round": crash_round})
+    out, err = first.communicate(timeout=420)
+    assert first.returncode == 3, err.decode()[-2000:]
+    wal = RoundWAL(ckpt)
+    assert wal.rounds() == list(range(crash_round)), \
+        "first life must journal exactly the applied rounds"
+
+    # second life: same filestore run + checkpoint dir, no crash
+    second = _spawn(entry, 0, tmp_path, {"checkpoint_dir": ckpt})
+    out, err = second.communicate(timeout=420)
+    assert second.returncode == 0, err.decode()[-2000:]
+    for r, p in silos.items():
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, (r, err.decode()[-2000:])
+
+    # resumed exactly at the WAL round; every round applied EXACTLY once
+    hist = json.load(open(tmp_path / "hist.json"))
+    assert [h["round"] for h in hist] == [2, 3, 4]
+    wal_rounds = RoundWAL(ckpt).rounds()
+    assert sorted(wal_rounds) == [0, 1, 2, 3, 4]
+    assert len(wal_rounds) == len(set(wal_rounds)), \
+        "double-applied round in the WAL"

@@ -337,6 +337,50 @@ def test_check_trace_flags_observed_drop():
     assert _rules(fs) == ["trace-observed-drop"]
 
 
+def test_check_trace_retransmissions_share_msg_id_not_duplicates():
+    """fedguard retries: every retransmission marks a ``comm.retry``
+    span sharing the logical msg_id, so N retries permit up to 1+N
+    deliveries — a retry surviving loss is NOT a duplicate-delivery
+    finding.  Deliveries beyond that budget still flag."""
+    def _retry_ev(mid, attempt):
+        return {"name": "comm.retry", "ph": "B", "ts": 1.5,
+                "args": {"span_id": f"rt{attempt}", "msg_type": "1",
+                         "msg_id": mid, "attempt": attempt}}
+
+    # one send + one retry, both copies delivered (receiver dedupes
+    # above the FSM, but the recv spans are per delivery): clean
+    t = _tr(_send_ev("s1", "1", "m1"), _retry_ev("m1", 1),
+            _recv_ev("s1", "1", "m1"), _recv_ev("s1", "1", "m1"))
+    assert fp.check_trace([t], "mini", MINI_TRACE_MANIFEST) == []
+    # the SAME double delivery without a retry span is a real duplicate
+    t2 = _tr(_send_ev("s1", "1", "m1"),
+             _recv_ev("s1", "1", "m1"), _recv_ev("s1", "1", "m1"))
+    assert _rules(fp.check_trace([t2, ], "mini", MINI_TRACE_MANIFEST)) \
+        == ["trace-duplicate-delivery"]
+    # deliveries beyond the 1 + retries budget still flag
+    t3 = _tr(_send_ev("s1", "1", "m1"), _retry_ev("m1", 1),
+             _recv_ev("s1", "1", "m1"), _recv_ev("s1", "1", "m1"),
+             _recv_ev("s1", "1", "m1"))
+    fs = fp.check_trace([t3], "mini", MINI_TRACE_MANIFEST)
+    assert _rules(fs) == ["trace-duplicate-delivery"]
+    assert "budget of 2" in fs[0].message
+
+
+def test_check_trace_accepts_manifest_transport_types():
+    """Families flagged ``transport`` pin the fedguard ack/heartbeat
+    types; check-trace must accept them in both directions (the
+    reliability layer emits their comm.recv spans itself), while a
+    family WITHOUT the block still rejects them."""
+    manifest = json.loads(json.dumps(MINI_TRACE_MANIFEST))
+    manifest["families"]["mini"]["transport"] = dict(fp.TRANSPORT_TYPES)
+    t = _tr(_send_ev("s1", "1", "m1"), _recv_ev("s1", "1", "m1"),
+            _send_ev("s2", "690", "a1"), _recv_ev("s2", "690", "a1"),
+            _send_ev("s3", "691", "h1"), _recv_ev("s3", "691", "h1"))
+    assert fp.check_trace([t], "mini", manifest) == []
+    fs = fp.check_trace([t], "mini", MINI_TRACE_MANIFEST)
+    assert sum(f.rule == "trace-unknown-type" for f in fs) == 4
+
+
 def test_check_trace_spans_multiple_captures():
     """Send and recv on DIFFERENT per-process captures still pair."""
     a = _tr(_send_ev("s1", "1", "m1"))

@@ -1,0 +1,372 @@
+"""fedguard unit tier (docs/FAULT_TOLERANCE.md): retry schedule, ack /
+retransmit / dedupe mechanics, heartbeat leases, the applied-round WAL,
+endpoint timeout semantics, the new chaos modes, and the fedmon SLO
+rules — everything the slow 3-process chaos tests compose, proven fast
+and hermetically here."""
+
+import queue
+import time
+
+import pytest
+
+from fedml_tpu.core.distributed.communication.fault_injection import (
+    FaultInjectingCommManager, PartitionSpec, SiloCrashed,
+    maybe_crash_at_round, parse_partitions)
+from fedml_tpu.core.distributed.communication.message import Message
+from fedml_tpu.core.distributed.reliability import (
+    KEY_ACK_OF, KEY_HB_RANK, KEY_UNRELIABLE, MSG_TYPE_ACK,
+    MSG_TYPE_HEARTBEAT, ReliableCommManager, ReliableEndpoint,
+    RetryPolicy, RoundWAL)
+from fedml_tpu.obs import context as obs_context
+
+
+class _Wire:
+    """Fake backend: records sends, hand-delivers into observers."""
+
+    def __init__(self):
+        self.sent = []
+        self._obs = []
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def add_observer(self, o):
+        self._obs.append(o)
+
+    def remove_observer(self, o):
+        self._obs.remove(o)
+
+    def handle_receive_message(self):
+        ...
+
+    def stop_receive_message(self):
+        ...
+
+    def deliver(self, msg):
+        for o in list(self._obs):
+            o.receive_message(msg.get_type(), msg)
+
+    def types(self):
+        return [m.get_type() for m in self.sent]
+
+
+class _Sink:
+    def __init__(self):
+        self.got = []
+
+    def receive_message(self, msg_type, msg):
+        self.got.append(msg)
+
+
+def _wait(cond, timeout_s=3.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def _msg(t=601, s=1, r=0, mid=None, **params):
+    m = Message(t, s, r)
+    if mid is not None:
+        m.add_params(obs_context.KEY_MSG_ID, mid)
+    for k, v in params.items():
+        m.add_params(k, v)
+    return m
+
+
+# -- retry schedule ----------------------------------------------------------
+
+def test_backoff_schedule_exponential_capped_and_deterministic():
+    p = RetryPolicy(base_s=0.1, multiplier=2.0, max_backoff_s=0.5,
+                    jitter=0.25, deadline_s=10.0)
+    a = [p.delay("m1", n) for n in range(1, 8)]
+    b = [p.delay("m1", n) for n in range(1, 8)]
+    assert a == b, "jitter must be a pure function of (msg_id, attempt)"
+    # raw backoff grows 0.1, 0.2, 0.4 then caps at 0.5; jitter only ADDS
+    # up to 25%
+    for n, d in enumerate(a, start=1):
+        raw = min(0.1 * 2.0 ** (n - 1), 0.5)
+        assert raw <= d <= raw * 1.25, (n, d)
+    assert a[6] <= 0.5 * 1.25
+    # different messages jitter differently (decorrelated retry storms)
+    assert p.delay("m1", 1) != p.delay("m2", 1)
+
+
+def test_retry_policy_reads_args():
+    class A:
+        retry_base_s = 0.2
+        retry_multiplier = 3.0
+        retry_max_backoff_s = 1.5
+        retry_jitter = 0.0
+        retry_deadline_s = 9.0
+
+    p = RetryPolicy.from_args(A())
+    assert (p.base_s, p.multiplier, p.max_backoff_s, p.jitter,
+            p.deadline_s) == (0.2, 3.0, 1.5, 0.0, 9.0)
+    assert p.delay("x", 2) == pytest.approx(0.6)
+
+
+# -- ack / retransmit / dedupe ----------------------------------------------
+
+def test_retransmits_until_acked_with_shared_msg_id():
+    wire = _Wire()
+    g = ReliableCommManager(
+        wire, rank=1, reliable_types=[601],
+        policy=RetryPolicy(base_s=0.03, multiplier=1.0,
+                           max_backoff_s=0.03, jitter=0.0,
+                           deadline_s=5.0))
+    g.send_message(_msg())
+    assert len(wire.sent) == 1
+    mid = wire.sent[0].get(obs_context.KEY_MSG_ID)
+    assert mid, "reliable send must stamp the logical msg_id"
+    assert _wait(lambda: len(wire.sent) >= 3)
+    assert {m.get(obs_context.KEY_MSG_ID) for m in wire.sent} == {mid}, \
+        "every retransmission shares the logical msg_id"
+    # ACK stops the retransmit stream
+    ack = _msg(t=MSG_TYPE_ACK, s=0, r=1)
+    ack.add_params(KEY_ACK_OF, mid)
+    wire.deliver(ack)
+    assert _wait(lambda: g.outstanding() == 0)
+    n = len(wire.sent)
+    time.sleep(0.12)
+    assert len(wire.sent) == n, "acked message kept retransmitting"
+    assert g.stats["acked"] == 1 and g.stats["retries"] >= 2
+    g.stop_receive_message()
+
+
+def test_receiver_acks_and_dedupes_by_msg_id():
+    wire = _Wire()
+    g = ReliableCommManager(wire, rank=0, reliable_types=[601])
+    sink = _Sink()
+    g.add_observer(sink)
+    m = _msg(mid="mm1")
+    wire.deliver(m)
+    wire.deliver(m)   # retransmission (same msg_id)
+    assert len(sink.got) == 1, "dedupe must make retries idempotent"
+    # BOTH deliveries are ACKed — the first ACK may itself have been lost
+    assert wire.types() == [MSG_TYPE_ACK, MSG_TYPE_ACK]
+    assert all(a.get(KEY_ACK_OF) == "mm1" for a in wire.sent)
+    assert g.stats["dup_dropped"] == 1
+    g.stop_receive_message()
+
+
+def test_retry_deadline_exhausts_and_reports():
+    wire = _Wire()
+    g = ReliableCommManager(
+        wire, rank=1, reliable_types=[601],
+        policy=RetryPolicy(base_s=0.02, multiplier=1.0,
+                           max_backoff_s=0.02, jitter=0.0,
+                           deadline_s=0.15))
+    g.send_message(_msg(mid="gone"))
+    assert _wait(lambda: g.outstanding() == 0)
+    assert g.failed_msg_ids() == ["gone"]
+    assert g.stats["exhausted"] == 1
+    g.stop_receive_message()
+
+
+def test_unreliable_param_opts_out_of_tracking():
+    wire = _Wire()
+    g = ReliableCommManager(wire, rank=0, reliable_types=[602])
+    probe = _msg(t=602, s=0, r=1)
+    probe.add_params(KEY_UNRELIABLE, True)
+    g.send_message(probe)
+    assert len(wire.sent) == 1 and g.outstanding() == 0
+
+
+# -- heartbeat leases --------------------------------------------------------
+
+def test_lease_expiry_declares_dead_and_heals_on_beacon():
+    wire = _Wire()
+    g = ReliableCommManager(wire, rank=0, lease_s=0.15)
+    g.start_heartbeats(expected_ranks=[1, 2])
+    assert g.dead_ranks() == set(), "fresh leases must not read as dead"
+    assert _wait(lambda: g.dead_ranks() == {1, 2}, timeout_s=1.0), \
+        "a rank that NEVER beacons must still expire"
+    hb = _msg(t=MSG_TYPE_HEARTBEAT, s=1, r=0)
+    hb.add_params(KEY_HB_RANK, 1)
+    wire.deliver(hb)
+    assert g.dead_ranks() == {2}, "a resumed beacon must heal the lease"
+    g.stop_receive_message()
+
+
+def test_heartbeat_beacon_thread_sends_to_server_rank():
+    wire = _Wire()
+    g = ReliableCommManager(wire, rank=2, heartbeat_interval_s=0.03,
+                            server_rank=0)
+    g.start_heartbeats()
+    assert _wait(lambda: len(wire.sent) >= 2)
+    hb = wire.sent[0]
+    assert hb.get_type() == MSG_TYPE_HEARTBEAT
+    assert hb.get_receiver_id() == 0
+    assert int(hb.get(KEY_HB_RANK)) == 2
+    g.stop_receive_message()
+
+
+def test_transport_types_pinned_in_fedproto():
+    """fedproto's TRANSPORT_TYPES table (the manifest `transport` block)
+    mirrors the reliability module's wire constants."""
+    from fedml_tpu.analysis import fedproto as fp
+
+    assert fp.TRANSPORT_TYPES == {"ack": str(MSG_TYPE_ACK),
+                                  "heartbeat": str(MSG_TYPE_HEARTBEAT)}
+
+
+# -- endpoint recv timeout (the bare-queue.Empty satellite) ------------------
+
+class _FakeMgr:
+    com_manager = None
+
+    def run(self):
+        ...
+
+
+def test_endpoint_recv_raises_named_timeout():
+    ep = ReliableEndpoint(_FakeMgr(), queue.Queue(), rank=3)
+    with pytest.raises(TimeoutError) as e:
+        ep.recv(timeout_s=0.05, expect="MSG_TYPE_STATE_SYNC from rank 0")
+    msg = str(e.value)
+    assert "rank 3" in msg
+    assert "MSG_TYPE_STATE_SYNC" in msg
+    assert "0.0" in msg or "0.1" in msg   # elapsed seconds
+    assert not isinstance(e.value, queue.Empty)
+    assert ep.poll(timeout_s=0.01) is None   # tick variant never raises
+
+
+# -- applied-round WAL -------------------------------------------------------
+
+def test_wal_roundtrip_and_invariants(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    assert wal.last_applied() is None and wal.rounds() == []
+    wal.record(0, msg_ids=["a", "b"], quorum=3)
+    wal.record(1, msg_ids=["c"], quorum=2)
+    assert wal.rounds() == [0, 1]
+    assert wal.last_applied() == 1
+    assert wal.applied_msg_ids() == {"a", "b", "c"}
+    assert wal.entries()[1]["quorum"] == 2
+    # a second WAL handle over the same dir sees the same journal (the
+    # restarted-coordinator read path)
+    assert RoundWAL(str(tmp_path)).last_applied() == 1
+
+
+def test_wal_tolerates_torn_tail_and_ensure_backfills(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    wal.record(0)
+    wal.record(1)
+    with open(wal.path, "a") as fh:
+        fh.write('{"round": 2, "msg_i')   # crash mid-append
+    assert wal.rounds() == [0, 1], "torn tail must be ignored"
+    # ensure() backfills the checkpoint round if its entry is missing
+    # (crash in the checkpoint->append window), exactly once
+    wal2 = RoundWAL(str(tmp_path))
+    wal2.ensure(1)
+    assert wal2.rounds() == [0, 1]
+    wal2.ensure(2)
+    assert wal2.rounds() == [0, 1, 2]
+    assert wal2.entries()[-1]["recovered"] is True
+    wal2.ensure(None)   # fresh start — no-op
+    assert len(wal2.rounds()) == len(set(wal2.rounds()))
+
+
+# -- chaos modes -------------------------------------------------------------
+
+def test_crash_at_round_schedule():
+    class A:
+        chaos_crash_rank = 2
+        chaos_crash_round = 3
+        chaos_crash_mode = "raise"
+
+    maybe_crash_at_round(A(), 2, 2)   # wrong round — no-op
+    maybe_crash_at_round(A(), 1, 3)   # wrong rank — no-op
+    with pytest.raises(SiloCrashed, match="rank 2 .*round 3"):
+        maybe_crash_at_round(A(), 2, 3)
+
+
+def test_partition_spec_parse_and_windows():
+    assert parse_partitions("1>0:2-3") == [PartitionSpec(1, 0, 2, 3)]
+    assert parse_partitions(["1>0:2-3", "0>2:0-1"])[1].dst == 2
+    assert parse_partitions(None) == []
+    with pytest.raises(ValueError, match="chaos_partition"):
+        parse_partitions("nonsense")
+    p = PartitionSpec(1, 0, 2, 3)
+    assert p.blocks(1, 0, 2) and p.blocks(1, 0, 3)
+    assert not p.blocks(1, 0, 1) and not p.blocks(1, 0, 4)
+    assert not p.blocks(0, 1, 2), "partitions are DIRECTIONAL"
+    assert not p.blocks(1, 0, None)
+
+
+class _Rec:
+    def __init__(self):
+        self.sent = []
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def add_observer(self, o):
+        ...
+
+    def remove_observer(self, o):
+        ...
+
+    def handle_receive_message(self):
+        ...
+
+    def stop_receive_message(self):
+        ...
+
+
+def test_partition_drops_in_window_and_cursor_gates_transport():
+    rec = _Rec()
+    fi = FaultInjectingCommManager(
+        rec, partitions=[PartitionSpec(1, 0, 1, 2)])
+    fi.send_message(_msg(mid="r0", round_idx=0))     # before window
+    fi.send_message(_msg(mid="r1", round_idx=1))     # in window: dropped
+    hb = _msg(t=MSG_TYPE_HEARTBEAT, s=1, r=0)
+    fi.send_message(hb)   # round-less: follows the cursor (1) — dropped
+    fi.send_message(_msg(mid="r3", round_idx=3))     # past window
+    hb2 = _msg(t=MSG_TYPE_HEARTBEAT, s=1, r=0)
+    fi.send_message(hb2)  # cursor now 3 — heals with the partition
+    assert [m.get("round_idx") for m in rec.sent
+            if m.get_type() == 601] == [0, 3]
+    assert [m for m in rec.sent
+            if m.get_type() == MSG_TYPE_HEARTBEAT] == [hb2]
+    assert fi.stats["partitioned"] == 2
+    fi.stop_receive_message()
+
+
+def test_bandwidth_cap_defers_delivery_then_flushes():
+    import numpy as np
+    rec = _Rec()
+    fi = FaultInjectingCommManager(rec, bandwidth_bps=8_000.0)  # 1 KB/s
+    big = _msg(mid="blob")
+    big.add_params("payload", np.zeros(5000, np.uint8))  # ~5s of "wire"
+    fi.send_message(big)
+    assert rec.sent == [], "capped payload must not deliver instantly"
+    assert fi.stats["bw_delayed"] == 1
+    fi.stop_receive_message()   # flush semantics: deferred != dropped
+    assert [m.get(obs_context.KEY_MSG_ID) for m in rec.sent] == ["blob"]
+
+
+# -- fedmon SLO rules --------------------------------------------------------
+
+def test_default_slo_rules_grade_quorum_and_retries():
+    from fedml_tpu.obs.health import DEFAULT_SLO_RULES, evaluate_slos
+
+    def status(metrics):
+        return evaluate_slos(DEFAULT_SLO_RULES, metrics)["status"]
+
+    base = {"comm.retry_rate": 0.0, "comm.quorum_missing_ranks": 0.0,
+            "comm.quorum_deficit": 0.0, "comm.dead_ranks": 0.0}
+    assert status(base) == "ok"
+    # quorum below S (a rank missing) -> degraded
+    assert status({**base, "comm.quorum_missing_ranks": 1.0}) == "degraded"
+    # quorum below Q (deficit) -> unhealthy
+    assert status({**base, "comm.quorum_deficit": 1.0}) == "unhealthy"
+    # retry storm grades by severity
+    assert status({**base, "comm.retry_rate": 0.4}) == "degraded"
+    assert status({**base, "comm.retry_rate": 0.9}) == "unhealthy"
+    # a lease-dead rank degrades until it heals
+    assert status({**base, "comm.dead_ranks": 2.0}) == "degraded"
+    # absent fedguard metrics skip — a train-only run stays ok
+    assert status({}) == "ok"

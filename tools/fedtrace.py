@@ -286,6 +286,30 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         out["async_updates_dropped"] = counters["async.updates_dropped"]
     if "async.sim_time_s" in counters:
         out["async_sim_time_s"] = round(counters["async.sim_time_s"], 6)
+    # fedguard fault-tolerance plane (docs/FAULT_TOLERANCE.md): retry
+    # totals of the reliable-delivery layer, the per-round quorum
+    # trajectory (every comm.quorum_size sample, in order — the shape of
+    # a chaos run: full, then degraded, then healed), and the lease-dead
+    # rank gauge
+    if "comm.retries" in counters:
+        out["comm_retries_total"] = counters["comm.retries"]
+    if "comm.retry_rate" in counters:
+        out["comm_retry_rate_last"] = round(counters["comm.retry_rate"], 6)
+    if "comm.retry_exhausted" in counters:
+        out["comm_retry_exhausted"] = counters["comm.retry_exhausted"]
+    if "comm.ack_rtt" in counters:
+        out["comm_ack_rtt_last_s"] = round(counters["comm.ack_rtt"], 6)
+    quorum_traj = [int(e["args"]["value"]) for e in events
+                   if e.get("ph") == "C"
+                   and e.get("name") == "comm.quorum_size"]
+    if quorum_traj:
+        out["quorum_trajectory"] = quorum_traj
+        out["quorum_size_last"] = quorum_traj[-1]
+        out["quorum_size_min"] = min(quorum_traj)
+    if "comm.dead_ranks" in counters:
+        out["dead_ranks_last"] = counters["comm.dead_ranks"]
+    if "comm.dup_dropped" in counters:
+        out["comm_dup_dropped"] = counters["comm.dup_dropped"]
     # multi-tenant serving plane (docs/SERVING.md): admission spans and
     # the batching engine's host counters — admission-queue depth,
     # windowed tokens/s, and per-adapter request counts ("base" is
@@ -794,6 +818,14 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"{s.get('staleness_p99', 0.0):g}   dropped "
             f"{s.get('async_updates_dropped', 0.0):g}   sim clock "
             f"{s.get('async_sim_time_s', 0.0):g}s")
+    if "comm_retries_total" in s or "quorum_trajectory" in s:
+        traj = s.get("quorum_trajectory", [])
+        lines.append(
+            f"fedguard: {s.get('comm_retries_total', 0.0):g} retries "
+            f"(rate {s.get('comm_retry_rate_last', 0.0):g})   quorum "
+            f"{'-'.join(str(q) for q in traj) or '?'}   dead ranks "
+            f"(last) {s.get('dead_ranks_last', 0.0):g}   deduped "
+            f"{s.get('comm_dup_dropped', 0.0):g}")
     if "serve_admits" in s or "serve_adapter_requests" in s:
         ad = s.get("serve_adapter_requests", {})
         lines.append(

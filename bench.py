@@ -1273,6 +1273,180 @@ def bench_chaos(rounds: int | None = None) -> dict:
     }
 
 
+# -- fedwire quantized-wire benchmark (--wire) -------------------------------
+def bench_wire(rounds: int | None = None) -> dict:
+    """--wire: the fedwire localhost-DCN matrix over the REAL two-tier
+    driver (docs/WIRE.md).  One federation per wire precision (1 server +
+    2 silos as threads on the hermetic local backend, tracing on):
+
+    - **off** — the legacy fp32 flax-state-dict wire, the byte and
+      parity baseline;
+    - **fp32 / bf16 / int8** — the fedwire codec at each precision
+      (int8 with per-link error feedback);
+    - **int8_overlap** — int8 plus the writer-thread compute/DCN
+      overlap (silo r+1 compute overlaps the round-r upload);
+    - **int8_chunk_cap** — int8, chunked frames riding reliable
+      delivery, under a fedguard bandwidth cap: the graceful-degradation
+      variant — rounds COMPLETE instead of stalling.
+
+    Each run reports measured ``comm.bytes.silo_server``, the codec's
+    modeled census and their ``wire_bytes_ratio`` (fedtrace summarize),
+    wall clock, and final-loss delta vs the off baseline (PR 5 parity
+    tolerances).  Headline: measured fp32-wire bytes over int8-wire
+    bytes — the ~4x the in-mesh blockscale layer already gets, now on
+    the distributed tier.  Plus the compile pin: wire decode feeds the
+    SAME jitted silo/combine programs, so JaxRuntimeAudit must count 0
+    steady-state compiles with the codec on.  FEDML_WIRE_QUICK=1
+    shrinks rounds for the tier-1 smoke."""
+    import threading
+
+    import fedml_tpu
+    from fedml_tpu import data as data_mod, model as model_mod, obs
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu.core.distributed.communication.local import (
+        local_comm_manager)
+    from fedml_tpu.store.hierarchy import (HierarchicalSiloAPI,
+                                           run_silo_federation)
+
+    quick = os.environ.get("FEDML_WIRE_QUICK") == "1"
+    num_silos = 2
+    n_rounds = rounds or (3 if quick else 8)
+
+    def make_args(rank, run_id, **over):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=6 * 4 * BATCH, test_size=64, model="lr",
+            client_num_in_total=12, client_num_per_round=6,
+            comm_round=n_rounds, epochs=1, batch_size=BATCH,
+            learning_rate=0.1, random_seed=7, partition_method="homo",
+            num_silos=num_silos, frequency_of_the_test=10 ** 9,
+            rank=rank, backend="local", run_id=run_id,
+            comm_recv_timeout_s=120.0)
+        args.update(**over)
+        return fedml_tpu.init(args, should_init_logs=False)
+
+    def run_rank(rank, run_id, out, **over):
+        args = make_args(rank, run_id, **over)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        out[rank] = run_silo_federation(args, None, dataset, model)
+
+    fedtrace = _import_fedtrace()
+
+    def federate(run_id, **over):
+        """One traced federation; returns (history, wall_s, summary)."""
+        obs.configure(enabled=True, reset=True)
+        out: dict = {}
+        ths = [threading.Thread(target=run_rank, args=(r, run_id, out),
+                                kwargs=over, daemon=True)
+               for r in range(1, num_silos + 1)]
+        for t in ths:
+            t.start()
+        t0 = time.time()
+        run_rank(0, run_id, out, **over)
+        wall = time.time() - t0
+        for t in ths:
+            t.join(timeout=120)
+        local_comm_manager.reset_run(run_id)
+        summary = fedtrace.summarize(obs.get_tracer().export_chrome())
+        obs.configure(enabled=False)
+        hist = out[0]
+        assert len(hist) == n_rounds, \
+            f"{run_id}: {len(hist)}/{n_rounds} rounds"
+        return hist, wall, summary
+
+    variants = {
+        "off": {},
+        "fp32": dict(wire_precision="fp32"),
+        "bf16": dict(wire_precision="bf16"),
+        "int8": dict(wire_precision="int8"),
+        "int8_overlap": dict(wire_precision="int8", wire_overlap=True),
+        # graceful degradation under fedguard's bandwidth cap: bounded
+        # frames ride reliable delivery per-chunk, so the capped link
+        # streams instead of stalling on one monolithic partial
+        "int8_chunk_cap": dict(
+            wire_precision="int8", wire_chunk_bytes=4096,
+            reliable_delivery=True, retry_base_s=0.05,
+            retry_deadline_s=30.0,
+            chaos_bandwidth_bps=2_000_000, chaos_seed=11),
+    }
+    rows: dict = {}
+    try:
+        for name, over in variants.items():
+            hist, wall, summary = federate(f"wire_{name}", **over)
+            counters = summary["counters"]
+            rows[name] = {
+                "wall_s": round(wall, 2),
+                "final_loss": round(hist[-1]["train_loss"], 6),
+                "silo_server_bytes": int(
+                    counters.get("comm.bytes.silo_server", 0)),
+                "wire_modeled_bytes": int(
+                    counters.get("wire.modeled_bytes", 0)),
+            }
+            if "wire_bytes_ratio" in summary:
+                rows[name]["wire_bytes_ratio"] = summary[
+                    "wire_bytes_ratio"]
+            if "comm_chunks_sent" in summary:
+                rows[name]["chunks_sent"] = int(
+                    summary["comm_chunks_sent"])
+    finally:
+        obs.configure(enabled=False)
+
+    base_loss = rows["off"]["final_loss"]
+    for name in rows:
+        rows[name]["loss_delta_vs_off"] = round(
+            abs(rows[name]["final_loss"] - base_loss), 6)
+
+    # compile pin: the codec decodes to host numpy trees with the same
+    # structure every round, so the warm silo/combine programs never
+    # re-trace — audit two steady-state rounds with wire int8 on
+    ref = make_args(0, "wire_ref", wire_precision="int8")
+    dataset, out_dim = data_mod.load(ref)
+    api = HierarchicalSiloAPI(ref, None, dataset,
+                              model_mod.create(ref, out_dim))
+    for r in range(2):
+        api.train_one_round(r)
+    _readback(api.state.global_params)
+    with JaxRuntimeAudit() as audit:
+        for r in range(2, 4):
+            api.train_one_round(r)
+        _readback(api.state.global_params)
+    steady_compiles = audit.compilations
+
+    fp32_b = rows["fp32"]["silo_server_bytes"]
+    int8_b = rows["int8"]["silo_server_bytes"]
+    out = {
+        "quick": quick, "num_silos": num_silos, "rounds": n_rounds,
+        "variants": rows,
+        # headline: measured wire-byte reduction, int8 vs fp32 wire
+        "wire_bytes_fp32_over_int8": round(fp32_b / int8_b, 3)
+        if int8_b else None,
+        "wire_bytes_off_over_int8": round(
+            rows["off"]["silo_server_bytes"] / int8_b, 3)
+        if int8_b else None,
+        "int8_loss_delta_vs_off": rows["int8"]["loss_delta_vs_off"],
+        "bf16_loss_delta_vs_off": rows["bf16"]["loss_delta_vs_off"],
+        "overlap_wall_s": rows["int8_overlap"]["wall_s"],
+        "capped_rounds_completed": n_rounds,
+        "steady_compiles_wire": steady_compiles,
+    }
+    # perf-regression gate (tools/fedtrace.py regress): score THIS row
+    # against the committed BENCH trajectory + tolerance bands
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = fedtrace.regress(
+            out, fedtrace.load_bands(
+                os.path.join(repo, fedtrace.DEFAULT_BANDS_FILE)),
+            fedtrace.load_trajectory(repo))
+        out["regress"] = {"ok": r["ok"], "checked": r["checked"],
+                          "regressions": r["regressions"]}
+    except (OSError, ValueError, KeyError) as e:
+        out["regress"] = {"error": str(e)}
+    return out
+
+
 # -- fedtrace overhead + breakdown benchmark (--trace) -----------------------
 def _import_fedtrace():
     sys.path.insert(0, os.path.join(os.path.dirname(
@@ -2241,6 +2415,19 @@ def main():
             "value": result["wallclock_overhead_vs_clean"],
             "unit": "x_wallclock_crash_vs_clean",
             "vs_baseline": result["rounds_completed_under_chaos"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--wire" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = bench_wire()
+        result.update({
+            "metric": "fedwire_quantized_wire_matrix",
+            "value": result["wire_bytes_fp32_over_int8"],
+            "unit": "x_measured_wire_bytes_fp32_over_int8",
+            "vs_baseline": result["int8_loss_delta_vs_off"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -129,14 +129,29 @@ CONTEXT_KEY_PREFIX = "fedscope."
 #: transport-plane params keys (core/distributed/reliability.py) — like
 #: the fedscope context, below every FSM's param contract
 TRANSPORT_KEY_PREFIX = "fedguard."
-#: transport-plane message types the fedguard reliability layer
-#: exchanges BELOW every FSM (ack/retransmit + heartbeat leases,
-#: docs/FAULT_TOLERANCE.md).  Values mirror ``reliability.MSG_TYPE_ACK``
-#: / ``MSG_TYPE_HEARTBEAT`` (pinned in sync by tests/test_reliability.py);
-#: families flagged ``"transport": True`` in PROTOCOL_FAMILIES pin this
-#: block in their manifest and :func:`check_trace` accepts the types in
-#: both directions.
-TRANSPORT_TYPES = {"ack": "690", "heartbeat": "691"}
+#: fedwire framing params keys (core/distributed/chunking.py) — also a
+#: transport-plane concern, below the FSM contract
+WIRE_KEY_PREFIX = "fedwire."
+#: transport-plane message types exchanged BELOW every FSM: fedguard's
+#: ack/retransmit + heartbeat leases (docs/FAULT_TOLERANCE.md) and
+#: fedwire's chunk frames (docs/WIRE.md).  Values mirror
+#: ``reliability.MSG_TYPE_ACK`` / ``MSG_TYPE_HEARTBEAT`` /
+#: ``chunking.MSG_TYPE_CHUNK`` (pinned in sync by
+#: tests/test_reliability.py); families flagged ``"transport": True`` in
+#: PROTOCOL_FAMILIES pin this block in their manifest and
+#: :func:`check_trace` accepts the types in both directions.
+TRANSPORT_TYPES = {"ack": "690", "heartbeat": "691", "chunk": "692"}
+#: fedwire codec parameters pinned alongside the transport types for
+#: transport families (docs/WIRE.md): the chunk frame type + params
+#: contract and the wire precisions a peer may negotiate — review
+#: surface for the wire format, mirrored by core/wire.py and
+#: core/distributed/chunking.py.
+WIRE_CODEC_PARAMS = {
+    "chunk_type": "692",
+    "chunk_keys": ["fedwire.data", "fedwire.msg_type", "fedwire.parent",
+                   "fedwire.seq", "fedwire.total"],
+    "precisions": ["fp32", "bf16", "int8"],
+}
 #: constant-name suffix of the runtime-emitted readiness message: handlers
 #: for it are entry points, never orphans, and nobody "sends" it
 CONNECTION_READY_SUFFIX = "MSG_TYPE_CONNECTION_IS_READY"
@@ -1338,7 +1353,8 @@ def family_to_manifest(fam: FamilyProtocol) -> Dict[str, Any]:
             keys = sorted(k for k, r in reads.items()
                           if r and k not in IMPLICIT_KEYS
                           and not k.startswith(CONTEXT_KEY_PREFIX)
-                          and not k.startswith(TRANSPORT_KEY_PREFIX))
+                          and not k.startswith(TRANSPORT_KEY_PREFIX)
+                          and not k.startswith(WIRE_KEY_PREFIX))
             if keys:
                 req[reg.msg.key] = keys
             fin = fin or sp.handler_finishes(reg)
@@ -1358,7 +1374,8 @@ def family_to_manifest(fam: FamilyProtocol) -> Dict[str, Any]:
                 f"{sp.name}.{s.method}"
             site = {"method": method,
                     "params": [p for p in s.params
-                               if not p.startswith(TRANSPORT_KEY_PREFIX)]}
+                               if not p.startswith(TRANSPORT_KEY_PREFIX)
+                               and not p.startswith(WIRE_KEY_PREFIX)]}
             if site not in entry["sites"]:
                 entry["sites"].append(site)
         for entry in srow.values():
@@ -1368,9 +1385,13 @@ def family_to_manifest(fam: FamilyProtocol) -> Dict[str, Any]:
            "requires": requires, "finish_roles": sorted(finish_roles),
            "queue_style": fam.queue_style}
     if fam.config.get("transport"):
-        # fedguard ack/heartbeat ride below this family's FSM — pin the
-        # transport types so check-trace knows them (both directions)
+        # fedguard ack/heartbeat + fedwire chunk frames ride below this
+        # family's FSM — pin the transport types so check-trace knows
+        # them (both directions), and the wire codec contract next to
+        # them (docs/WIRE.md)
         out["transport"] = dict(TRANSPORT_TYPES)
+        out["wire"] = {k: list(v) if isinstance(v, list) else v
+                       for k, v in WIRE_CODEC_PARAMS.items()}
     return out
 
 
@@ -1554,7 +1575,16 @@ def check_trace(traces: Sequence[Any], family: str,
     DID follow it: every send delivered exactly once (matching by the
     propagated span link, falling back to the stamped ``fedscope.msg_id``
     so duplicated deliveries don't read as losses), every observed type
-    known to the protocol, every fault-injection drop surfaced."""
+    known to the protocol, every fault-injection drop surfaced.
+
+    fedwire chunked framing (docs/WIRE.md): one logical message may ride
+    the wire as N type-692 chunk frames sharing one ``fedwire.parent`` —
+    the logical ``fedscope.msg_id``.  Frames self-account (per-frame
+    ``comm.send``/``comm.recv`` under derived ids), the logical message
+    has a ``comm.recv`` but no backend ``comm.send``; this checker groups
+    observed frames by parent and requires the parent's logical delivery
+    instead — a torn stream (frames seen, parent never reassembled) is a
+    loss of the LOGICAL message."""
     if manifest is not None:
         entry = manifest.get("families", {}).get(family)
     elif fams is not None and family in fams:
@@ -1578,10 +1608,13 @@ def check_trace(traces: Sequence[Any], family: str,
     known_handled |= transport_types
     known_sent |= transport_types
 
+    chunk_type = str((entry.get("transport") or {}).get("chunk", "692"))
+
     sends: List[dict] = []
     recvs: List[dict] = []
     drops: List[dict] = []
     retries: List[dict] = []
+    chunk_parents: Dict[str, str] = {}   # parent msg_id -> original type
     for trace in traces:
         for e in _trace_events(trace):
             if e.get("ph") != "B":
@@ -1596,10 +1629,19 @@ def check_trace(traces: Sequence[Any], family: str,
                 sends.append(rec)
             elif e.get("name") == "comm.recv":
                 recvs.append(rec)
+                if str(rec.get("msg_type")) == chunk_type and \
+                        args.get("parent"):
+                    chunk_parents.setdefault(str(args["parent"]), "?")
             elif e.get("name") == "comm.drop":
                 drops.append(rec)
             elif e.get("name") == "comm.retry":
                 retries.append(rec)
+            elif e.get("name") == "comm.chunk" and args.get("parent"):
+                # sender-side frame evidence: the logical message behind
+                # these frames must reassemble into a comm.recv under
+                # the parent msg_id
+                chunk_parents[str(args["parent"])] = \
+                    str(args.get("msg_type", "?"))
 
     out: List[Finding] = []
     tpath = f"<trace:{family}>"
@@ -1667,6 +1709,16 @@ def check_trace(traces: Sequence[Any], family: str,
             f"{1 + retry_counts.get(mid, 0)} deliberate send(s) — "
             "re-delivery the FSM must tolerate (fedguard "
             "retransmissions sharing the msg_id are not flagged)"))
+    # fedwire chunk-stream completeness: every parent whose frames were
+    # observed must have reassembled into the parent's logical comm.recv
+    # (one logical partial = N chunk frames under one fedscope.msg_id)
+    for parent, orig_t in sorted(chunk_parents.items()):
+        if parent not in recv_id_set:
+            out.append(_mk(
+                "trace-message-loss", tpath, 1,
+                f"[{family}] chunk frames of logical message {parent} "
+                f"(msg_type {orig_t}) were observed but the message never "
+                "reassembled into a comm.recv — torn chunk stream"))
     # observed fault-injection drops
     for rec in drops:
         t = maybe_type(rec) or "?"

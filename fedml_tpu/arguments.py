@@ -249,6 +249,32 @@ _DEFAULTS: Dict[str, Any] = dict(
     chaos_crash_mode="exit",
     chaos_partition=None,
     chaos_bandwidth_bps=0.0,
+    # fedwire quantized wire codec for the distributed tier (docs/WIRE.md):
+    # wire_precision = off | fp32 | bf16 | int8 selects the payload format
+    # for silo->server partials, async worker updates and coordinator
+    # state sync ("off" keeps legacy flax state-dict messages); int8 keeps
+    # a host-side per-link error-feedback residual.  wire_block is the
+    # per-absmax-scale chunk (0 = quant_block); wire_chunk_bytes > 0
+    # streams every large message as bounded frames that ride reliable
+    # delivery per-chunk; wire_overlap moves partial serialization+upload
+    # to a writer thread so round r+1 compute overlaps the round-r upload.
+    # checkpoint_codec = orbax | wire unifies round checkpoints on the
+    # same codec (wire-fp32 msgpack files instead of orbax).
+    wire_precision="off",
+    wire_block=0,
+    wire_chunk_bytes=0,
+    wire_overlap=False,
+    checkpoint_codec="orbax",
+    # fedstore data paging (docs/WIRE.md, docs/CLIENT_STORE.md): page
+    # cohort EXAMPLE tensors through the LRU+spill pager so a
+    # 1M-registered run streams data as well as state — rows are single
+    # examples in a read-only ClientStateStore; data_page_size examples
+    # per page, data_max_pages resident pages (0 = unbounded; >0 needs
+    # data_spill_dir)
+    data_paging=False,
+    data_page_size=0,
+    data_max_pages=0,
+    data_spill_dir=None,
     compute_dtype="float32",
     clients_per_device=1,
 )
@@ -282,6 +308,21 @@ def validate_args(args) -> None:
                 f"{' + '.join(bad)} — the buffered-async driver applies "
                 "the update buffer event-by-event on the sp engine "
                 "(docs/ASYNC.md)")
+    wp = str(getattr(args, "wire_precision", "off") or "off").lower()
+    if wp not in ("off", "fp32", "bf16", "int8"):
+        raise ValueError(
+            f"unknown wire_precision {wp!r} — expected off | fp32 | bf16 "
+            "| int8 (docs/WIRE.md)")
+    cc = str(getattr(args, "checkpoint_codec", "orbax") or "orbax").lower()
+    if cc not in ("orbax", "wire"):
+        raise ValueError(
+            f"unknown checkpoint_codec {cc!r} — expected orbax | wire "
+            "(docs/WIRE.md)")
+    if int(getattr(args, "data_max_pages", 0) or 0) > 0 and \
+            not getattr(args, "data_spill_dir", None):
+        raise ValueError(
+            "incompatible flags: data_max_pages > 0 needs data_spill_dir "
+            "— evicted example pages must spill somewhere (docs/WIRE.md)")
     if bool(getattr(args, "health", False)) and \
             bool(getattr(args, "cohort_bucketing", False)):
         raise ValueError(

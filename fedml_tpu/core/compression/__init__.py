@@ -2,8 +2,10 @@
 ``python/fedml/utils/compression.py`` rebuilt as pure pytree transforms —
 see ``compressors.py``)."""
 
-from .blockscale import (COLLECTIVE_PRECISIONS, bf16_stochastic_round,
-                         blockscale_dequantize, blockscale_quantize,
+from .blockscale import (COLLECTIVE_PRECISIONS, bf16_expand_np,
+                         bf16_round_np, bf16_stochastic_round,
+                         blockscale_dequantize, blockscale_dequantize_np,
+                         blockscale_quantize, blockscale_quantize_np,
                          collective_payload_nbytes, collective_quantize,
                          modeled_collective_bytes)
 from .compressors import (EFTopKCompressor, NoneCompressor, QSGDCompressor,
@@ -18,6 +20,8 @@ __all__ = [
     "is_compressed_payload", "payload_nbytes", "tree_nbytes",
     "FedMLCompression",
     "COLLECTIVE_PRECISIONS", "blockscale_quantize", "blockscale_dequantize",
+    "blockscale_quantize_np", "blockscale_dequantize_np",
+    "bf16_round_np", "bf16_expand_np",
     "bf16_stochastic_round", "collective_quantize",
     "collective_payload_nbytes", "modeled_collective_bytes",
 ]

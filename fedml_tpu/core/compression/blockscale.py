@@ -149,6 +149,61 @@ def quantize_broadcast(master: jnp.ndarray, ef, precision: str, key=None,
     return deq, v - deq, err_sq
 
 
+# -- host-side (numpy) mirrors ----------------------------------------------
+#
+# The fedwire codec (core/wire.py, docs/WIRE.md) quantizes message payloads
+# on the HOST — often on a writer thread, always outside jit — so it needs
+# pure-numpy twins of the quantizer that match the jnp round-to-nearest
+# path bit-for-bit in layout (same block shape, same absmax scales, same
+# padding).  tests/test_wire.py pins np-vs-jnp parity.
+
+def blockscale_quantize_np(vec, *, bits: int = 8, block: int = DEFAULT_BLOCK):
+    """Numpy mirror of :func:`blockscale_quantize` with round-to-nearest
+    (the ``key=None`` path).  Returns ``(q, scales)`` with ``q`` shaped
+    ``(ceil(n/block), block)``."""
+    import numpy as np
+    levels = (1 << (bits - 1)) - 1
+    store = np.int8 if bits <= 8 else np.int16
+    x = np.asarray(vec, np.float32).reshape(-1)
+    n = x.shape[0]
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        x = np.concatenate([x, np.zeros((pad,), np.float32)])
+    chunks = x.reshape(nb, block)
+    scales = np.maximum(np.max(np.abs(chunks), axis=1), 1e-12) / levels
+    q = chunks / scales[:, None]
+    q = np.sign(q) * np.round(np.abs(q))
+    q = np.clip(q, -levels, levels).astype(store)
+    return q, scales.astype(np.float32)
+
+
+def blockscale_dequantize_np(q, scales, n: int):
+    """Numpy mirror of :func:`blockscale_dequantize`."""
+    import numpy as np
+    x = np.asarray(q, np.float32) * np.asarray(scales,
+                                               np.float32)[:, None]
+    return x.reshape(-1)[:n]
+
+
+def bf16_round_np(vec):
+    """f32 → bf16 bit pattern (uint16) with round-to-nearest-even — the
+    numpy twin of ``jnp.asarray(x).astype(bfloat16)``; the codec ships
+    the raw 16-bit payload and :func:`bf16_expand_np` restores f32."""
+    import numpy as np
+    bits = np.asarray(vec, np.float32).reshape(-1).view(np.uint32)
+    # RNE: add 0x7FFF plus the parity of the kept LSB, then truncate
+    bias = np.uint32(0x7FFF) + ((bits >> np.uint32(16)) & np.uint32(1))
+    return ((bits + bias) >> np.uint32(16)).astype(np.uint16)
+
+
+def bf16_expand_np(h):
+    """Inverse of :func:`bf16_round_np`: uint16 bf16 bits → f32."""
+    import numpy as np
+    return (np.asarray(h, np.uint16).astype(np.uint32)
+            << np.uint32(16)).view(np.float32)
+
+
 # -- wire-size model ---------------------------------------------------------
 
 def collective_payload_nbytes(n: int, precision: str,

@@ -45,7 +45,12 @@ class FileStoreCommManager(BaseCommunicationManager):
                            dst=msg.get_receiver_id(), tier=tier,
                            msg_type=str(msg.get_type()),
                            msg_id=msg.get(obs_context.KEY_MSG_ID),
-                           round=msg.get("round_idx"))
+                           round=msg.get("round_idx"),
+                           # fedwire chunk frames (docs/WIRE.md): priced
+                           # below at their ACTUAL framed bytes; seq/total
+                           # make streaming overlap visible per-frame
+                           seq=msg.get("fedwire.seq"),
+                           total=msg.get("fedwire.total"))
         with span:
             obs_context.inject(msg.get_params(), tracer)
             blob = encode_tree(msg.get_params())

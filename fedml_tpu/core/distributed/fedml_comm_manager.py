@@ -133,13 +133,19 @@ def create_comm_backend(args, rank: int, size: int,
     (``communication/fault_injection.py``); ``reliable_delivery`` adds
     the fedguard ack/retransmit + heartbeat-lease layer OUTSIDE chaos —
     ``Reliable(Chaos(Raw))`` — so retransmissions traverse the injected
-    faults (``reliability.py``, docs/FAULT_TOLERANCE.md)."""
+    faults (``reliability.py``, docs/FAULT_TOLERANCE.md);
+    ``wire_chunk_bytes`` adds fedwire chunked framing OUTERMOST —
+    ``Chunking(Reliable(Chaos(Raw)))`` — so every bounded frame is its
+    own reliable message (``chunking.py``, docs/WIRE.md)."""
+    from .chunking import maybe_wrap_chunking
     from .communication.fault_injection import maybe_wrap_with_chaos
     from .reliability import maybe_wrap_reliable
-    return maybe_wrap_reliable(
-        maybe_wrap_with_chaos(
-            _create_raw_backend(args, rank, size, backend), args, rank),
-        args, rank, size)
+    return maybe_wrap_chunking(
+        maybe_wrap_reliable(
+            maybe_wrap_with_chaos(
+                _create_raw_backend(args, rank, size, backend), args, rank),
+            args, rank, size),
+        args, rank)
 
 
 def _create_raw_backend(args, rank: int, size: int,

@@ -572,13 +572,20 @@ class RoundWAL:
         self.path = os.path.join(directory, name)
 
     def record(self, round_idx: int, msg_ids: Sequence[str] = (),
-               quorum: Optional[int] = None, recovered: bool = False):
+               quorum: Optional[int] = None, recovered: bool = False,
+               state_digest: Optional[str] = None):
         entry: Dict[str, Any] = {"round": int(round_idx),
                                  "msg_ids": list(msg_ids)}
         if quorum is not None:
             entry["quorum"] = int(quorum)
         if recovered:
             entry["recovered"] = True
+        if state_digest is not None:
+            # fedwire unification (docs/WIRE.md): crc32 of the round's
+            # ENCODED state payload — the same bytes the wire shipped and
+            # the wire checkpoint wrote — ties journal, wire and
+            # checkpoint to one codec
+            entry["state_digest"] = str(state_digest)
         # terminate any torn tail first (crash mid-append), so the new
         # record never concatenates onto half a line
         lead = ""

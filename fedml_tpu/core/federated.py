@@ -375,6 +375,26 @@ def zero_like_partial(partial: Dict[str, Any]) -> Dict[str, Any]:
     return jax.tree_util.tree_map(zero, partial)
 
 
+def wire_roundtrip_partial(partial: Dict[str, Any], wire_link,
+                           link: str) -> Dict[str, Any]:
+    """Quantize/dequantize one partial aggregate through the fedwire
+    codec WITH the link's error feedback (docs/WIRE.md) — exactly the
+    transform the distributed tier applies when it ships the partial.
+
+    The in-process :class:`~fedml_tpu.store.hierarchy.HierarchicalSiloAPI`
+    runs this per silo so its numerics (including the EF trajectory on
+    each ``partial:<i>`` link) MATCH the multi-rank wire — the parity
+    tests compare the two drivers leaf-for-leaf.  Float leaves of at
+    least a block ride the quantized vector; the ``{num, den}`` algebra's
+    denominators and counters ride raw, so combine stays exact."""
+    import flax.serialization as fser
+
+    from .wire import WireCodec
+
+    return fser.from_state_dict(partial, WireCodec.decode(
+        wire_link.encode(fser.to_state_dict(partial), link=link)))
+
+
 def scale_partial(spec: "AlgorithmSpec", partial: Dict[str, Any],
                   s) -> Dict[str, Any]:
     """Staleness-discount a :class:`PartialReducer` partial by ``s``:

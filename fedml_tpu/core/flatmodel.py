@@ -94,6 +94,27 @@ class FlatSpec:
     def zeros(self) -> jnp.ndarray:
         return jnp.zeros((self.padded_size,), jnp.float32)
 
+    @staticmethod
+    def leaf_paths(tree: Pytree) -> Tuple[str, ...]:
+        """Slash-joined key paths in ``tree_flatten`` leaf order — the
+        order this spec concatenates leaves.  Dict keys flatten SORTED
+        and sequences by index, which is exactly how the fedwire codec
+        (``core/wire.py``) walks a state dict, so the wire's flat vector
+        and a :meth:`flatten` of the same tree share one layout — two
+        ends can derive it independently, pinned by a test."""
+        out = []
+        for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            parts = []
+            for k in kp:
+                if isinstance(k, jax.tree_util.DictKey):
+                    parts.append(str(k.key))
+                elif isinstance(k, jax.tree_util.SequenceKey):
+                    parts.append(str(k.idx))
+                else:
+                    parts.append(str(getattr(k, "name", k)))
+            out.append("/".join(parts))
+        return tuple(out)
+
 
 def flat_spec(tree: Pytree, multiple: int = 1) -> FlatSpec:
     """Convenience constructor mirroring ``FlatSpec.of``."""

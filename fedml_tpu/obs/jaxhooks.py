@@ -58,12 +58,16 @@ def unsubscribe(fn: Callable):
 
 
 def tree_nbytes(x) -> int:
-    """Total buffer bytes across the pytree's array leaves."""
+    """Total buffer bytes across the pytree's array leaves (raw
+    ``bytes`` leaves — fedwire chunk frames — count at their length)."""
     import jax
 
     total = 0
     for leaf in jax.tree_util.tree_leaves(x):
-        total += int(getattr(leaf, "nbytes", 0) or 0)
+        if isinstance(leaf, (bytes, bytearray)):
+            total += len(leaf)
+        else:
+            total += int(getattr(leaf, "nbytes", 0) or 0)
     return total
 
 

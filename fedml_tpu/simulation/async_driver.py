@@ -152,7 +152,14 @@ def _run_async_server(api, ep, workers, args, tracer):
     lease-dead workers are excluded from re-dispatch until they heal."""
     import flax.serialization as fser
 
+    from ..core import wire
     from ..core.distributed.communication.message import Message
+
+    # fedwire (docs/WIRE.md): per-worker dispatch links — workers receive
+    # the state at different versions, so each (server → worker) edge
+    # keeps its own int8 EF residual
+    codec = wire.codec_from_args(args)
+    wire_link = wire.WireLink(codec) if codec is not None else None
 
     spec = api.server_opt.spec
     rounds = int(getattr(args, "comm_round", 1))
@@ -174,7 +181,12 @@ def _run_async_server(api, ep, workers, args, tracer):
         msg = Message(MSG_TYPE_ASYNC_DISPATCH, 0, worker)
         msg.add_params("gen", gen)
         msg.add_params("version", version)
-        msg.add_params("state", fser.to_state_dict(api.state))
+        sd = fser.to_state_dict(api.state)
+        if wire_link is not None:
+            with tracer.span("wire.encode", cat="comm", version=version,
+                             link=f"state:{worker}"):
+                sd = wire_link.encode(sd, link=f"state:{worker}")
+        msg.add_params("state", sd)
         ep.send(msg)
 
     version = 0
@@ -256,7 +268,7 @@ def _run_async_server(api, ep, workers, args, tracer):
         else:
             s = float((1.0 + tau) ** (-alpha))
             buffered.append(federated.scale_partial(
-                spec, msg.get("partial"), s))
+                spec, wire.maybe_decode(msg.get("partial")), s))
             loss_w += s * float(np.asarray(msg.get("loss_w")))
             w_sum += s * float(msg.get("w_sum"))
             stales.append(tau)
@@ -279,9 +291,17 @@ def _run_async_server(api, ep, workers, args, tracer):
 def _run_async_worker(api, ep, rank, args, tracer):
     """Ranks 1..W: stage the dispatched generation's cohort, reduce it to
     an unfinished partial, sleep the injected heavy-tailed latency, send
-    the update up, wait for the next dispatch."""
-    import flax.serialization as fser
+    the update up, wait for the next dispatch.
 
+    fedwire (docs/WIRE.md): ``wire_precision`` quantizes the uploaded
+    partial on this worker's own EF link; ``wire_overlap`` moves the
+    device→host materialization + encode + send to a writer thread, so
+    the loop is back on ``recv`` — and staging the NEXT generation the
+    moment it arrives — while the upload is still serializing."""
+    import flax.serialization as fser
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..core import wire
     from ..core.distributed.communication.message import Message
 
     spec = api.server_opt.spec
@@ -309,41 +329,69 @@ def _run_async_worker(api, ep, rank, args, tracer):
         guard.start_heartbeats()
     recv_timeout_s = float(getattr(args, "comm_recv_timeout_s", 120.0)
                            or 120.0)
-    dispatches = 0
-    while True:
-        msg = ep.recv(timeout_s=recv_timeout_s,
-                      expect="MSG_TYPE_ASYNC_DISPATCH/"
-                             "MSG_TYPE_ASYNC_FINISH from rank 0")
-        if msg.get_type() == MSG_TYPE_ASYNC_FINISH:
-            return
-        if msg.get_type() != MSG_TYPE_ASYNC_DISPATCH:
-            continue
-        gen = int(msg.get("gen"))
-        version = int(msg.get("version"))
-        # crash-at-round chaos: dies on this worker's Nth dispatch
-        # (gen ids are assigned in arrival order, so the worker's own
-        # dispatch ordinal is the deterministic schedule key here) —
-        # the buffer must flush at the deadline without us
-        maybe_crash_at_round(args, rank, dispatches)
-        dispatches += 1
-        api.state = fser.from_state_dict(api.state, msg.get("state"))
-        with tracer.span("async.worker_round", cat="round", gen=gen,
-                         worker=rank):
-            _clients, idx, mask, w, _steps = api._stage_round_arrays(gen)
-            key = rng_util.round_key(rng_util.root_key(api.seed), gen)
-            partial, lw, ws = partial_fn(api.state, jnp.asarray(idx),
-                                         jnp.asarray(mask),
-                                         jnp.asarray(w), key)
-            jax.block_until_ready(partial)
-            if lat_median > 0:
-                rng = hostrng.gen(seed, WORKER_LATENCY_TAG, rank, gen)
-                time.sleep(float(traffic.lognormal_latencies(
-                    rng, lat_median, lat_sigma, 1)[0]))
+    codec = wire.codec_from_args(args)
+    wire_link = wire.WireLink(codec) if codec is not None else None
+    writer = (ThreadPoolExecutor(max_workers=1)
+              if bool(getattr(args, "wire_overlap", False)) else None)
+    pending = None
+
+    def upload(gen, version, partial, lw, ws):
+        sd = fser.to_state_dict(partial)
+        if wire_link is not None:
+            with tracer.span("wire.encode", cat="comm", gen=gen,
+                             link="partial"):
+                sd = wire_link.encode(sd, link="partial")
         up = Message(MSG_TYPE_ASYNC_UPDATE, rank, 0)
         up.add_params("gen", gen)
         up.add_params("version", version)
         up.add_params("worker", rank)
-        up.add_params("partial", fser.to_state_dict(partial))
+        up.add_params("partial", sd)
         up.add_params("loss_w", np.asarray(lw))
         up.add_params("w_sum", float(ws))
         ep.send(up)
+
+    dispatches = 0
+    try:
+        while True:
+            msg = ep.recv(timeout_s=recv_timeout_s,
+                          expect="MSG_TYPE_ASYNC_DISPATCH/"
+                                 "MSG_TYPE_ASYNC_FINISH from rank 0")
+            if msg.get_type() == MSG_TYPE_ASYNC_FINISH:
+                return
+            if msg.get_type() != MSG_TYPE_ASYNC_DISPATCH:
+                continue
+            gen = int(msg.get("gen"))
+            version = int(msg.get("version"))
+            # crash-at-round chaos: dies on this worker's Nth dispatch
+            # (gen ids are assigned in arrival order, so the worker's own
+            # dispatch ordinal is the deterministic schedule key here) —
+            # the buffer must flush at the deadline without us
+            maybe_crash_at_round(args, rank, dispatches)
+            dispatches += 1
+            api.state = fser.from_state_dict(
+                api.state, wire.maybe_decode(msg.get("state")))
+            with tracer.span("async.worker_round", cat="round", gen=gen,
+                             worker=rank):
+                _clients, idx, mask, w, _steps = api._stage_round_arrays(
+                    gen)
+                key = rng_util.round_key(rng_util.root_key(api.seed), gen)
+                partial, lw, ws = partial_fn(api.state, jnp.asarray(idx),
+                                             jnp.asarray(mask),
+                                             jnp.asarray(w), key)
+                jax.block_until_ready(partial)
+                if lat_median > 0:
+                    rng = hostrng.gen(seed, WORKER_LATENCY_TAG, rank, gen)
+                    time.sleep(float(traffic.lognormal_latencies(
+                        rng, lat_median, lat_sigma, 1)[0]))
+            if writer is not None:
+                if pending is not None:
+                    pending.result()   # surface the previous upload first
+                pending = writer.submit(upload, gen, version, partial,
+                                        lw, ws)
+            else:
+                upload(gen, version, partial, lw, ws)
+    finally:
+        if writer is not None:
+            if pending is not None:
+                pending.result()
+            writer.shutdown(wait=True)

@@ -199,6 +199,15 @@ class FedAvgAPI:
         if self.population and self.client_table is not None:
             self.client_table = federated.stack_member_states(
                 self.client_table, self.population.size)
+        # fedstore DATA plane (docs/WIRE.md): with ``args.data_paging`` the
+        # cohort EXAMPLE tensors stream through the same LRU+spill pager as
+        # client state — host RSS is bounded by the resident page cap, not
+        # the dataset, so a 1M-registered multi-host-shaped run pages data
+        # as well as state.
+        self._data_store = None
+        self._data_pager = None
+        if bool(getattr(args, "data_paging", False)):
+            self._init_data_pager()
         self.metrics_history = []
 
     #: donate the ServerState buffers into the round (in-place update on
@@ -219,8 +228,11 @@ class FedAvgAPI:
             # the bucketed round host-stages per-bucket cohorts; don't
             # upload a device-resident dataset copy nothing will read
             return None
-        if bool(getattr(self.args, "device_data", True)):
+        if bool(getattr(self.args, "device_data", True)) \
+                and not bool(getattr(self.args, "data_paging", False)):
             # dataset device-resident once; rounds ship only index tensors
+            # (data_paging forces the host-staged path — a paged dataset
+            # must never be uploaded whole)
             self._dev_x = jnp.asarray(self.dataset.train_x)
             self._dev_y = jnp.asarray(self.dataset.train_y)
             if self.population:
@@ -311,6 +323,71 @@ class FedAvgAPI:
                 [self._client_sampling(r)
                  for r in range(round_idx, round_idx + k)]))
         return self._client_sampling(round_idx)
+
+    # -- fedstore data paging (docs/WIRE.md) -------------------------------
+    def _init_data_pager(self):
+        """Page cohort EXAMPLE tensors through the LRU+spill pager: rows
+        are single ``{"x", "y"}`` examples in a read-only
+        :class:`~fedml_tpu.store.ClientStateStore` keyed by train index,
+        gathered per round by the same :class:`CohortStatePager` that
+        pages client state (page-in overlaps compute on its worker
+        thread; no write-backs — data is immutable)."""
+        from ...store import ClientStateStore, CohortStatePager
+        args = self.args
+        ds = self.dataset
+        row_t = {"x": np.zeros(ds.train_x.shape[1:], ds.train_x.dtype),
+                 "y": np.zeros(ds.train_y.shape[1:], ds.train_y.dtype)}
+        page = int(getattr(args, "data_page_size", 0) or 0) or \
+            int(getattr(args, "store_page_size", 256) or 256)
+        self._data_store = ClientStateStore(
+            row_t, ds.train_data_num, page_size=page,
+            max_resident_pages=int(getattr(args, "data_max_pages", 0)
+                                   or 0),
+            spill_dir=getattr(args, "data_spill_dir", None))
+        # one-time fill in page-sized slices: with a resident-page cap the
+        # LRU spills as we go, so peak RSS never holds a second dense copy
+        for lo in range(0, ds.train_data_num, page):
+            ids = np.arange(lo, min(lo + page, ds.train_data_num),
+                            dtype=np.int64)
+            self._data_store.scatter(
+                ids, {"x": ds.train_x[ids], "y": ds.train_y[ids]})
+        self._data_pager = CohortStatePager(
+            self._data_store, self._example_ids_for,
+            depth=int(getattr(args, "staging_depth", 1) or 1),
+            limit=self.comm_rounds,
+            enabled=bool(getattr(args, "async_staging", True)))
+
+    def _example_ids_for(self, round_idx: int) -> np.ndarray:
+        """Example rows round ``round_idx`` touches — pure in the round
+        index (sampling and batch schedules are), so the pager's worker
+        thread may page them in ahead of the round."""
+        clients = self._client_sampling(round_idx)
+        idx, _m, _w = self.dataset.cohort_indices(
+            self._data_ids(clients), self.batch_size, self.seed,
+            round_idx, self.epochs)
+        return np.unique(idx.ravel())
+
+    def _paged_cohort_batches(self, clients, round_idx: int):
+        """``dataset.cohort_batches`` values via the example pager: gather
+        the round's unique rows once (prefetched pages resident), then fan
+        them out to the ``(cohort, steps, batch, ...)`` layout by
+        position.  Padding steps carry row-0 values under a zero mask —
+        the device-gather path's padding convention."""
+        ds = self.dataset
+        idx, mask, w = ds.cohort_indices(
+            self._data_ids(clients), self.batch_size, self.seed,
+            round_idx, self.epochs)
+        uniq = np.unique(idx.ravel())
+        nxt = round_idx + 1
+        rows = self._data_pager.gather(
+            round_idx, uniq,
+            prefetch=nxt if nxt < self.comm_rounds else None)
+        pos = np.searchsorted(uniq, idx.ravel())
+        x = np.asarray(rows["x"])[pos].reshape(
+            idx.shape + ds.train_x.shape[1:])
+        y = np.asarray(rows["y"])[pos].reshape(
+            idx.shape + ds.train_y.shape[1:])
+        return x, y, mask, w
 
     def _put_rows(self, rows):
         """Host cohort-row stack -> device (the mesh engine shards the
@@ -479,9 +556,13 @@ class FedAvgAPI:
             c_stacked = self._gather_c(cohort, round_idx=round_idx)
             with self._tracer.span("staging", cat="staging",
                                    round=round_idx):
-                x, y, mask, w = self.dataset.cohort_batches(
-                    self._data_ids(clients), self.batch_size, self.seed,
-                    round_idx, self.epochs)
+                if self._data_pager is not None:
+                    x, y, mask, w = self._paged_cohort_batches(clients,
+                                                               round_idx)
+                else:
+                    x, y, mask, w = self.dataset.cohort_batches(
+                        self._data_ids(clients), self.batch_size,
+                        self.seed, round_idx, self.epochs)
                 steps = next_pow2(x.shape[1])
                 if steps != x.shape[1]:
                     pad = steps - x.shape[1]
@@ -749,10 +830,18 @@ class FedAvgAPI:
         ckpt_dir = getattr(self.args, "checkpoint_dir", None)
         if not ckpt_dir:
             return None
-        from ...core.checkpoint import RoundCheckpointer
         if not hasattr(self, "_ckpt"):
-            self._ckpt = RoundCheckpointer(
-                ckpt_dir, int(getattr(self.args, "checkpoint_keep", 3)))
+            codec = str(getattr(self.args, "checkpoint_codec", "orbax")
+                        or "orbax").lower()
+            keep = int(getattr(self.args, "checkpoint_keep", 3))
+            if codec == "wire":
+                # fedwire-unified checkpoints (docs/WIRE.md): the same
+                # codec that frames wire messages writes the round files
+                from ...core.checkpoint import WireCheckpointer
+                self._ckpt = WireCheckpointer(ckpt_dir, keep)
+            else:
+                from ...core.checkpoint import RoundCheckpointer
+                self._ckpt = RoundCheckpointer(ckpt_dir, keep)
         return self._ckpt
 
     def maybe_resume(self) -> int:
@@ -962,6 +1051,8 @@ class FedAvgAPI:
             # final round before anyone reads/checkpoints it
             self._pager.drain_writebacks()
             log.info("fedstore: %s", self._pager.stats())
+        if self._data_pager is not None:
+            log.info("fedstore data plane: %s", self._data_pager.stats())
         log.info("finished %d rounds in %.1fs (%.3fs/round)",
                  self.comm_rounds, total, total / max(self.comm_rounds, 1))
         if self._tracer.enabled and self._tracer.path:

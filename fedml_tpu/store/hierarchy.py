@@ -34,6 +34,7 @@ import numpy as np
 
 from ..core import federated
 from ..core import rng as rng_util
+from ..core import wire
 from ..core.distributed.communication.fault_injection import (
     maybe_crash_at_round)
 from ..core.distributed.reliability import (KEY_UNRELIABLE,
@@ -72,9 +73,18 @@ class HierarchicalSiloAPI(FedAvgAPI):
         if self.collective_precision != "fp32":
             raise ValueError(
                 "hierarchical silo aggregation combines fp32 partial "
-                "aggregates; collective_precision must stay 'fp32'")
+                "aggregates; collective_precision must stay 'fp32' — "
+                "quantize the silo→server tier with wire_precision "
+                "instead (fedwire, docs/WIRE.md)")
         self._silo_fn = None
         self._combine_fn = None
+        # fedwire (docs/WIRE.md): with wire_precision set, the in-process
+        # round passes every silo partial through the SAME encode→decode
+        # the distributed tier ships — so wire numerics (including the
+        # stateful algorithms the multi-process driver rejects) are
+        # testable without processes
+        codec = wire.codec_from_args(args)
+        self._wire = wire.WireLink(codec) if codec is not None else None
         # one-round staging cache: the distributed driver calls
         # silo_partial() for a single slice, but staging is a pure
         # function of round_idx — stage the full cohort once per round
@@ -130,9 +140,13 @@ class HierarchicalSiloAPI(FedAvgAPI):
                     mask = np.pad(mask, [(0, 0), (0, pad)])
                 x = y = None
             else:
-                x, y, mask, w = self.dataset.cohort_batches(
-                    self._data_ids(clients), self.batch_size, self.seed,
-                    round_idx, self.epochs)
+                if self._data_pager is not None:
+                    x, y, mask, w = self._paged_cohort_batches(clients,
+                                                               round_idx)
+                else:
+                    x, y, mask, w = self.dataset.cohort_batches(
+                        self._data_ids(clients), self.batch_size,
+                        self.seed, round_idx, self.epochs)
                 steps = next_pow2(x.shape[1])
                 if steps != x.shape[1]:
                     pad = steps - x.shape[1]
@@ -187,6 +201,9 @@ class HierarchicalSiloAPI(FedAvgAPI):
         loss_w = steps_total = 0.0
         for i in range(s):
             partial, _sw, lw, ts, new_c = self.silo_partial(round_idx, i)
+            if self._wire is not None:
+                partial = federated.wire_roundtrip_partial(
+                    partial, self._wire, link=f"partial:{i}")
             partials.append(partial)
             new_cs.append(new_c)
             loss_w = loss_w + lw
@@ -389,10 +406,20 @@ def _collect_quorum(ep, guard, round_idx, expected, quorum, deadline_s,
 
 
 def _run_combine_tier(api, ep, num_silos, rounds, args, tracer):
+    import zlib
+
     import flax.serialization as fser
 
-    from ..core.distributed.communication.message import Message
+    from ..core.distributed.communication.message import (Message,
+                                                          encode_tree)
     from ..obs import context as obs_context
+
+    # fedwire (docs/WIRE.md): quantize the state-sync fan-out on ONE link
+    # — every silo receives the same bytes (bitwise-identical replicas),
+    # and the int8 EF residual advances once per round, the host-side
+    # quantize_broadcast algebra
+    codec = wire.codec_from_args(args)
+    wire_link = wire.WireLink(codec) if codec is not None else None
 
     guard = ep.guard
     expected = list(range(1, num_silos + 1))
@@ -428,6 +455,17 @@ def _run_combine_tier(api, ep, num_silos, rounds, args, tracer):
             live = set(expected) - (guard.dead_ranks() if guard
                                     else set())
             state_dict = fser.to_state_dict(api.state)
+            state_digest = None
+            if wire_link is not None:
+                with tracer.span("wire.encode", cat="comm", round=r,
+                                 link="state_sync"):
+                    state_dict = wire_link.encode(state_dict,
+                                                  link="state_sync")
+                if wal is not None:
+                    # the digest of the ENCODED payload — the exact bytes
+                    # the wire ships and the wire checkpoint would write
+                    state_digest = (
+                        f"{zlib.crc32(encode_tree(state_dict)):08x}")
             for s in expected:
                 sync = Message(MSG_TYPE_STATE_SYNC, 0, s)
                 sync.add_params("round_idx", r)
@@ -445,7 +483,8 @@ def _run_combine_tier(api, ep, num_silos, rounds, args, tracer):
                                         tracer)
             with tracer.span("combine", cat="round", round=r,
                              quorum=len(got)):
-                partials = [got[s].get("partial") for s in sorted(got)]
+                partials = [wire.maybe_decode(got[s].get("partial"))
+                            for s in sorted(got)]
                 # pad the arrived set to S with zero partials: the
                 # combine keeps ONE compiled shape at every quorum size
                 # and the algebra stays exact (zero num, zero den)
@@ -460,7 +499,7 @@ def _run_combine_tier(api, ep, num_silos, rounds, args, tracer):
                     r, msg_ids=[str(m.get(obs_context.KEY_MSG_ID))
                                 for m in got.values()
                                 if m.get(obs_context.KEY_MSG_ID)],
-                    quorum=len(got))
+                    quorum=len(got), state_digest=state_digest)
         dead = sorted(set(expected) - live)
         tracer.counter("comm.quorum_size", float(len(got)), round=r)
         tracer.counter("comm.quorum_missing_ranks",
@@ -488,8 +527,18 @@ def _run_silo_tier(api, ep, rank, args, tracer):
     """Reactive silo loop: whatever round rank 0 dispatches (a
     STATE_SYNC carrying the current state), compute that round's slice
     and upload the partial.  A restarted silo rejoins by simply
-    answering the next dispatch — the state rides every sync."""
+    answering the next dispatch — the state rides every sync.
+
+    fedwire compute/DCN overlap (``args.wire_overlap``, docs/WIRE.md):
+    the round-r partial's device→host materialization, wire encode and
+    send run on a single writer thread (the AsyncCohortStager /
+    CohortStatePager write-back pattern), so this loop is already
+    blocked on round r+1's dispatch — and, once it arrives, decoding
+    state and staging the next cohort — while round r's bytes are still
+    leaving.  One upload in flight at a time: the next submit first
+    surfaces the previous one's failure."""
     import flax.serialization as fser
+    from concurrent.futures import ThreadPoolExecutor
 
     from ..core.distributed.communication.message import Message
 
@@ -500,36 +549,64 @@ def _run_silo_tier(api, ep, rank, args, tracer):
                            or 120.0)
     slow_rank = int(getattr(args, "silo_slow_rank", 0) or 0)
     slow_s = float(getattr(args, "silo_slow_s", 0.0) or 0.0)
-    while True:
-        msg = ep.recv(timeout_s=recv_timeout_s,
-                      expect="MSG_TYPE_STATE_SYNC/MSG_TYPE_FINISH "
-                             "from rank 0")
-        if msg.get_type() == MSG_TYPE_FINISH:
-            return
-        if msg.get_type() != MSG_TYPE_STATE_SYNC:
-            continue
-        # NOTE: a re-dispatched round (same round_idx, new msg_id — a
-        # restarted rank 0 whose collect window died with it) is
-        # recomputed and re-uploaded; retransmits of ONE dispatch share
-        # a msg_id and are deduped below us, and the server keys arrived
-        # partials by silo, so answering again is always safe
-        r = int(msg.get("round_idx"))
-        api.state = fser.from_state_dict(api.state, msg.get("state"))
-        # crash-at-round chaos: dies on receipt of round r's dispatch,
-        # BEFORE computing — the round must close at quorum without us
-        maybe_crash_at_round(args, rank, r)
-        with tracer.span("silo.round", cat="round", round=r, silo=rank):
-            partial, silo_w, loss_w, _steps, _new_c = api.silo_partial(
-                r, rank - 1)
-            # materialize before the span closes so the span covers the
-            # silo's real device compute, not just the dispatch
-            jax.block_until_ready(partial)
-            if slow_rank == rank and slow_s > 0:
-                time.sleep(slow_s)   # injected straggler
+    codec = wire.codec_from_args(args)
+    wire_link = wire.WireLink(codec) if codec is not None else None
+    writer = (ThreadPoolExecutor(max_workers=1)
+              if bool(getattr(args, "wire_overlap", False)) else None)
+    pending = None
+
+    def upload(r, partial, silo_w, loss_w):
+        sd = fser.to_state_dict(partial)
+        if wire_link is not None:
+            with tracer.span("wire.encode", cat="comm", round=r,
+                             link="partial"):
+                sd = wire_link.encode(sd, link="partial")
         up = Message(MSG_TYPE_SILO_PARTIAL, rank, 0)
         up.add_params("round_idx", r)
         up.add_params("silo", rank)
-        up.add_params("partial", fser.to_state_dict(partial))
+        up.add_params("partial", sd)
         up.add_params("silo_w", silo_w)
         up.add_params("loss_w", np.asarray(loss_w))
         ep.send(up)
+
+    try:
+        while True:
+            msg = ep.recv(timeout_s=recv_timeout_s,
+                          expect="MSG_TYPE_STATE_SYNC/MSG_TYPE_FINISH "
+                                 "from rank 0")
+            if msg.get_type() == MSG_TYPE_FINISH:
+                return
+            if msg.get_type() != MSG_TYPE_STATE_SYNC:
+                continue
+            # NOTE: a re-dispatched round (same round_idx, new msg_id — a
+            # restarted rank 0 whose collect window died with it) is
+            # recomputed and re-uploaded; retransmits of ONE dispatch share
+            # a msg_id and are deduped below us, and the server keys arrived
+            # partials by silo, so answering again is always safe
+            r = int(msg.get("round_idx"))
+            api.state = fser.from_state_dict(
+                api.state, wire.maybe_decode(msg.get("state")))
+            # crash-at-round chaos: dies on receipt of round r's dispatch,
+            # BEFORE computing — the round must close at quorum without us
+            maybe_crash_at_round(args, rank, r)
+            with tracer.span("silo.round", cat="round", round=r,
+                             silo=rank):
+                partial, silo_w, loss_w, _steps, _new_c = api.silo_partial(
+                    r, rank - 1)
+                # materialize before the span closes so the span covers the
+                # silo's real device compute, not just the dispatch
+                jax.block_until_ready(partial)
+                if slow_rank == rank and slow_s > 0:
+                    time.sleep(slow_s)   # injected straggler
+            if writer is not None:
+                if pending is not None:
+                    pending.result()   # surface round r-1 upload failures
+                pending = writer.submit(upload, r, partial, silo_w,
+                                        loss_w)
+            else:
+                upload(r, partial, silo_w, loss_w)
+    finally:
+        if writer is not None:
+            if pending is not None:
+                pending.result()
+            writer.shutdown(wait=True)

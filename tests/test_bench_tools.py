@@ -289,6 +289,36 @@ def test_bench_mesh2d_quick(monkeypatch):
     assert ls["mesh2d_per_chip_gib"] <= ls["hbm_per_chip_gib"]
 
 
+def test_bench_wire_quick(monkeypatch):
+    """FEDML_WIRE_QUICK smoke (docs/WIRE.md): bench.py --wire runs the
+    fedwire matrix green on the real two-tier driver — measured wire
+    bytes drop ~4x int8 vs fp32 (byte ratios are round-count-independent,
+    so the acceptance direction holds in this trimmed run), parity stays
+    inside the PR 5 tolerances, the chunked bandwidth-capped variant
+    completes every round, and the codec adds zero steady-state
+    recompiles."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_WIRE_QUICK", "1")
+    out = bench.bench_wire()
+    assert out["quick"] is True
+    assert out["rounds"] == 3 and out["num_silos"] == 2
+    assert out["wire_bytes_fp32_over_int8"] > 3.0
+    assert out["wire_bytes_off_over_int8"] > 3.0
+    assert out["int8_loss_delta_vs_off"] < 1e-2
+    assert out["bf16_loss_delta_vs_off"] < 2e-3
+    assert out["steady_compiles_wire"] == 0
+    assert out["capped_rounds_completed"] == 3
+    rows = out["variants"]
+    for name in ("off", "fp32", "bf16", "int8", "int8_overlap",
+                 "int8_chunk_cap"):
+        assert rows[name]["silo_server_bytes"] > 0, name
+    # the capped variant really streamed frames on reliable delivery
+    assert rows["int8_chunk_cap"]["chunks_sent"] > 0
+    # measured-vs-modeled census agreement (the fedtrace headline)
+    for name in ("fp32", "bf16", "int8"):
+        assert 1.1 < rows[name]["wire_bytes_ratio"] < 1.6, name
+
+
 def test_fedtrace_regress_smoke(tmp_path, monkeypatch):
     """FEDML_TRACE_REGRESS smoke (ISSUE 11): the perf-regression gate
     runs green over the committed BENCH trajectory + tolerance bands,

@@ -206,11 +206,24 @@ def test_heartbeat_beacon_thread_sends_to_server_rank():
 
 def test_transport_types_pinned_in_fedproto():
     """fedproto's TRANSPORT_TYPES table (the manifest `transport` block)
-    mirrors the reliability module's wire constants."""
+    mirrors the reliability + chunking modules' wire constants."""
     from fedml_tpu.analysis import fedproto as fp
+    from fedml_tpu.core.distributed.chunking import (KEY_CHUNK_DATA,
+                                                     KEY_CHUNK_PARENT,
+                                                     KEY_CHUNK_SEQ,
+                                                     KEY_CHUNK_TOTAL,
+                                                     KEY_CHUNK_TYPE,
+                                                     MSG_TYPE_CHUNK)
+    from fedml_tpu.core.wire import WIRE_PRECISIONS
 
     assert fp.TRANSPORT_TYPES == {"ack": str(MSG_TYPE_ACK),
-                                  "heartbeat": str(MSG_TYPE_HEARTBEAT)}
+                                  "heartbeat": str(MSG_TYPE_HEARTBEAT),
+                                  "chunk": str(MSG_TYPE_CHUNK)}
+    assert fp.WIRE_CODEC_PARAMS["chunk_type"] == str(MSG_TYPE_CHUNK)
+    assert fp.WIRE_CODEC_PARAMS["chunk_keys"] == sorted(
+        [KEY_CHUNK_DATA, KEY_CHUNK_TYPE, KEY_CHUNK_PARENT,
+         KEY_CHUNK_SEQ, KEY_CHUNK_TOTAL])
+    assert fp.WIRE_CODEC_PARAMS["precisions"] == list(WIRE_PRECISIONS)
 
 
 # -- endpoint recv timeout (the bare-queue.Empty satellite) ------------------

@@ -310,6 +310,24 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         out["dead_ranks_last"] = counters["comm.dead_ranks"]
     if "comm.dup_dropped" in counters:
         out["comm_dup_dropped"] = counters["comm.dup_dropped"]
+    # fedwire quantized wire plane (docs/WIRE.md): cumulative encoded
+    # payload bytes, the codec's byte-model prediction, the last EF
+    # residual norm, chunk-frame totals — and the headline
+    # ``wire_bytes_ratio``: measured silo<->server wire bytes over the
+    # modeled census.  ~1.0x (framing overhead only) proves the census
+    # math IS what the wire carries; a tolerance band pins it in tests.
+    if "wire.bytes" in counters:
+        out["wire_bytes_total"] = counters["wire.bytes"]
+    if "wire.modeled_bytes" in counters:
+        out["wire_modeled_bytes_total"] = counters["wire.modeled_bytes"]
+        measured = counters.get("comm.bytes.silo_server")
+        if measured:
+            out["wire_bytes_ratio"] = round(
+                float(measured) / float(counters["wire.modeled_bytes"]), 6)
+    if "wire.ef_norm" in counters:
+        out["wire_ef_norm_last"] = round(counters["wire.ef_norm"], 6)
+    if "comm.chunks_sent" in counters:
+        out["comm_chunks_sent"] = counters["comm.chunks_sent"]
     # multi-tenant serving plane (docs/SERVING.md): admission spans and
     # the batching engine's host counters — admission-queue depth,
     # windowed tokens/s, and per-adapter request counts ("base" is

@@ -22,6 +22,15 @@ buffers, double-buffered staging) — every one of those patterns fails
   ``tools/fedproto.py`` and enforced in tier-1 by ``tests/test_fedproto.py``.
 - :mod:`fedml_tpu.analysis.fedverify` — AOT lowering-level contract checks
   over the canonical program registry (``tools/fedverify.py``).
+- :mod:`fedml_tpu.analysis.fedrace` — the host concurrency plane's checker:
+  extracts thread roots, lock objects and shared mutable attributes
+  package-wide, then checks unguarded shared writes, lock-order cycles,
+  blocking calls under held locks, and leaked threads against the surface
+  pinned in ``tests/data/fedrace/concurrency.json``.  The runtime half
+  (:class:`~fedml_tpu.analysis.runtime.LockOrderAudit`) wraps live locks
+  and asserts the OBSERVED acquisition graph is acyclic and a subgraph of
+  that pin.  Exposed as ``tools/fedrace.py`` and enforced in tier-1 by
+  ``tests/test_fedrace.py``.
 """
 
 from .fedlint import (  # noqa: F401
@@ -33,6 +42,7 @@ from .fedlint import (  # noqa: F401
     findings_to_json,
 )
 from . import fedproto  # noqa: F401  (pure stdlib, like fedlint)
+from . import fedrace  # noqa: F401  (pure stdlib, like fedlint)
 
 __all__ = [
     "Finding",
@@ -42,4 +52,5 @@ __all__ = [
     "render_findings",
     "findings_to_json",
     "fedproto",
+    "fedrace",
 ]

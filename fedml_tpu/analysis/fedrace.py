@@ -1,0 +1,1408 @@
+"""fedrace — lock-discipline & deadlock checker for the host concurrency
+plane (docs/FEDRACE.md).
+
+The fifth static-analysis layer (after fedlint, fedverify, fedproto and the
+runtime audit): the planes those checkers cover all run *under threads* —
+async staging pools, pager write-back executors, fedguard retransmit and
+heartbeat beacons, metricsd scrape handlers, the serving engine loop — and
+nothing checked the host locking discipline that keeps them coherent.
+
+Pure stdlib like fedlint/fedproto: loaded by file path from
+``tools/fedrace.py`` so no jax install is needed.  The extraction half
+builds, per class ("scope"):
+
+- **thread roots** — methods spawned via ``threading.Thread(target=)``,
+  ``threading.Timer``, ``executor.submit``, ``atexit.register``, nested
+  ``BaseHTTPRequestHandler`` ``do_*`` methods, plus the implicit
+  ``<caller>`` root (public API called from the driver thread),
+- **locks** — ``Lock``/``RLock``/``Condition`` attributes, with
+  ``Condition(self._lock)`` aliased to the lock it wraps,
+- **accesses** — reads/writes of shared mutable attributes together with
+  the set of locks held (lexical ``with self._lock:`` regions plus a
+  fixpoint over intra-class call sites: a helper only ever called under a
+  lock inherits that lock),
+- **acquisition edges** — nested lock acquisitions, including cross-class
+  edges through attributes whose type is another package class,
+- **spawn sites** — thread/timer/executor construction and their
+  join/cancel/daemon/shutdown cleanup paths.
+
+Four rule families check that surface (see RACE_RULES); the witnessed
+concurrency surface pins into ``tests/data/fedrace/concurrency.json`` with
+``--update-manifest`` preserving suppressions (the fedproto/fedverify
+workflow), and the runtime half (:class:`fedml_tpu.analysis.runtime.
+LockOrderAudit`) replays live acquisition order against the same pin.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+try:  # package import (tests); tools/fedrace.py loads by file path instead
+    from .fedlint import (
+        ERROR,
+        WARNING,
+        Rule,
+        Finding,
+        dotted_name,
+        last_attr,
+        build_parents,
+        iter_py_files,
+        render_findings,
+        findings_to_json,
+        exit_code,
+    )
+except ImportError:  # pragma: no cover - exercised via tools/fedrace.py
+    from fedlint import (  # type: ignore
+        ERROR,
+        WARNING,
+        Rule,
+        Finding,
+        dotted_name,
+        last_attr,
+        build_parents,
+        iter_py_files,
+        render_findings,
+        findings_to_json,
+        exit_code,
+    )
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+
+RACE_RULES: Dict[str, Rule] = {
+    r.name: r
+    for r in [
+        Rule("unguarded-shared-write", ERROR,
+             "an attribute written on one thread root and read/written on "
+             "another with no common guarding lock — a torn read or lost "
+             "update under the live federation's thread mix"),
+        Rule("lock-order-cycle", ERROR,
+             "the package-wide nested-acquisition graph has a cycle "
+             "(including cross-class edges through typed attributes) — two "
+             "threads taking the locks in opposite order deadlock"),
+        Rule("blocking-under-lock", ERROR,
+             "a blocking call (thread/future join, device sync, fsync, "
+             "sleep, comm send, queue.get without timeout, executor "
+             "shutdown) inside a held lock region — stalls every thread "
+             "contending for the lock and invites deadlock"),
+        Rule("leaked-thread", ERROR,
+             "a thread/timer/executor created with no join/cancel/daemon/"
+             "shutdown path — the fedproto finish-liveness analogue for "
+             "host threads: shutdown never converges"),
+        Rule("unresolved-concurrency", WARNING,
+             "a thread target / timer callback the extractor cannot "
+             "resolve to a method — the scope's root set is incomplete"),
+        Rule("manifest-drift", ERROR,
+             "the extracted concurrency surface drifted from the pinned "
+             "manifest — review and refresh with --update-manifest"),
+        Rule("manifest-missing", WARNING,
+             "a concurrency scope has no manifest entry yet — run "
+             "tools/fedrace.py check --update-manifest"),
+    ]
+}
+
+
+# --------------------------------------------------------------------------
+# extraction data model
+# --------------------------------------------------------------------------
+
+CALLER_ROOT = "<caller>"
+
+# attribute types that are internally synchronized (or are thread handles,
+# which the leaked-thread rule owns) — excluded from shared-write analysis
+_SYNCED_TYPES = {
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event",
+    "Semaphore", "BoundedSemaphore", "Barrier", "local",
+    "Thread", "Timer", "ThreadPoolExecutor", "ProcessPoolExecutor",
+}
+
+_LOCK_TYPES = {"Lock", "RLock"}
+
+# container / dict-like constructors whose method calls can mutate
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "add", "discard",
+    "setdefault", "sort", "reverse", "move_to_end",
+}
+
+# blocking calls flagged under a held lock: attr-name -> description
+_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync",
+    "device_get": "device transfer",
+    "fsync": "fsync",
+    "sleep": "sleep",
+    "send_message": "comm send",
+    "serve_forever": "serve loop",
+    "recv": "socket recv",
+}
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    kind: str                    # "read" | "write"
+    method: str
+    line: int
+    col: int
+    locks: FrozenSet[str]        # canonical lock names held lexically
+
+
+@dataclasses.dataclass
+class Spawn:
+    kind: str                    # "thread" | "timer" | "executor"
+    target: Optional[str]        # resolved root method name (threads/timers)
+    handle: Optional[str]        # "self.X" attr or local var the handle binds to
+    method: str
+    line: int
+    col: int
+    cleanup: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class AcqEdge:
+    src: str                     # canonical "Scope.lock"
+    dst: str
+    method: str
+    line: int
+
+
+@dataclasses.dataclass
+class BlockSite:
+    lock: str                    # canonical lock name held
+    call: str                    # rendered call, e.g. "self._t.join"
+    why: str
+    method: str
+    line: int
+    col: int
+
+
+@dataclasses.dataclass
+class Scope:
+    """Concurrency surface of one class (or a module's top level)."""
+
+    name: str                    # "module.ClassName" or "module.<module>"
+    path: str
+    line: int
+    locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    lock_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+    roots: Dict[str, str] = dataclasses.field(default_factory=dict)
+    root_closure: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=dict)
+    methods: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    spawns: List[Spawn] = dataclasses.field(default_factory=list)
+    edges: List[AcqEdge] = dataclasses.field(default_factory=list)
+    blocking: List[BlockSite] = dataclasses.field(default_factory=list)
+    entry_locks: Dict[str, FrozenSet[str]] = dataclasses.field(
+        default_factory=dict)
+
+    def canonical_lock(self, attr: str) -> Optional[str]:
+        attr = self.lock_aliases.get(attr, attr)
+        return attr if attr in self.locks else None
+
+    def qualified(self, lock: str) -> str:
+        return f"{self.name.rsplit('.', 1)[-1]}.{lock}"
+
+    def interesting(self) -> bool:
+        """Scopes with any concurrency surface enter the manifest."""
+        return bool(self.locks or self.spawns
+                    or any(k != "caller" for k in self.roots.values()))
+
+
+# --------------------------------------------------------------------------
+# small helpers
+# --------------------------------------------------------------------------
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' for a ``self.X`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _call_type(call: ast.Call) -> Optional[str]:
+    """Constructor class name for ``threading.Lock()`` / ``dict()`` etc."""
+    return last_attr(call.func)
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_str_receiver(node: ast.AST) -> bool:
+    """True for ``"".join`` / ``b",".join`` / ``os.path.join`` receivers."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (str, bytes)):
+        return True
+    d = dotted_name(node)
+    return bool(d) and (d == "os.path" or d.endswith(".path") or d == "os")
+
+
+class _FuncScopes:
+    """Maps every node in a method to the function whose body owns it,
+    without descending into nested ClassDefs (nested handler classes are
+    extracted separately)."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+
+
+def _assign_calls(value: ast.AST) -> List[ast.Call]:
+    """Constructor call(s) on the RHS of an assignment, looking through
+    conditional expressions (``TPE(...) if enabled else None``)."""
+    if isinstance(value, ast.Call):
+        return [value]
+    if isinstance(value, ast.IfExp):
+        return _assign_calls(value.body) + _assign_calls(value.orelse)
+    if isinstance(value, (ast.BoolOp,)):
+        out: List[ast.Call] = []
+        for v in value.values:
+            out.extend(_assign_calls(v))
+        return out
+    return []
+
+
+def _iter_body(fn: ast.AST):
+    """Walk a function body without entering nested ClassDef bodies."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# per-scope extractor
+# --------------------------------------------------------------------------
+
+class ScopeExtractor:
+    """One class (or one module top level) -> a :class:`Scope`."""
+
+    def __init__(self, name: str, path: str, node: ast.AST,
+                 class_names: Dict[str, str]):
+        self.scope = Scope(name=name, path=path,
+                           line=getattr(node, "lineno", 1))
+        self.node = node
+        self.class_names = class_names  # ClassName -> scope name (package)
+        self.warnings: List[Finding] = []
+        # method name -> set of method names it calls via self.M(...)
+        self.calls: Dict[str, Set[str]] = {}
+        # method name -> list of (callee, locks-held-at-site)
+        self.call_sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        # method -> list of (attr, callee-method-or-None, locks) for
+        # cross-class edges through typed attributes
+        self.xcalls: Dict[str, List[Tuple[str, Optional[str],
+                                          FrozenSet[str]]]] = {}
+
+    # -- pass 1: methods, locks, attribute types ---------------------------
+
+    def collect_methods(self):
+        body = self.node.body if hasattr(self.node, "body") else []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.scope.methods[stmt.name] = stmt
+
+    def collect_types(self):
+        """Classify ``self.X = <ctor>()`` assignments (any method, so
+        lazily-built locks/pools are seen too)."""
+        assigns: List[Tuple[ast.Assign, ast.Call]] = []
+        for fn in self.scope.methods.values():
+            for node in _iter_body(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for value in _assign_calls(node.value):
+                    assigns.append((node, value))
+        # plain locks first so `Condition(self._lock)` aliases resolve
+        # regardless of statement visit order
+        for node, value in assigns:
+            if _call_type(value) in _LOCK_TYPES:
+                self._classify_assign(node, value)
+        for node, value in assigns:
+            if _call_type(value) not in _LOCK_TYPES:
+                self._classify_assign(node, value)
+
+    def _classify_assign(self, node: ast.Assign, value: ast.Call):
+        ctor = _call_type(value)
+        if ctor is None:
+            return
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            if ctor in _LOCK_TYPES:
+                self.scope.locks.setdefault(attr, ctor)
+            elif ctor == "Condition":
+                wrapped = None
+                if value.args:
+                    wrapped = _self_attr(value.args[0])
+                if wrapped and wrapped in self.scope.locks:
+                    self.scope.lock_aliases[attr] = wrapped
+                else:
+                    self.scope.locks.setdefault(attr, "Condition")
+            self.scope.attr_types.setdefault(attr, ctor)
+
+    # -- pass 2: thread roots + spawn sites --------------------------------
+
+    def _resolve_target(self, node: ast.AST, method: str,
+                        line: int) -> Optional[str]:
+        if isinstance(node, ast.Call) and \
+                last_attr(node.func) == "partial" and node.args:
+            return self._resolve_target(node.args[0], method, line)
+        attr = _self_attr(node)
+        if attr is not None and attr in self.scope.methods:
+            return attr
+        if isinstance(node, ast.Name) and node.id in self.scope.methods:
+            return node.id
+        if isinstance(node, ast.Attribute):
+            # dotted target (`self._httpd.serve_forever`, `conn.run`):
+            # the body runs in another scope — spawn hygiene still applies
+            # through the handle, so no warning
+            return None
+        if isinstance(node, ast.Name):
+            # local closure defined in the same method: treat the closure
+            # as a pseudo-method so its body is analyzed under a root
+            fn = self.scope.methods.get(method)
+            if fn is not None:
+                for sub in _iter_body(fn):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) and \
+                            sub.name == node.id:
+                        pseudo = f"{method}.{node.id}"
+                        self.scope.methods.setdefault(pseudo, sub)
+                        return pseudo
+        self.warnings.append(Finding(
+            "unresolved-concurrency", WARNING, self.scope.path, line, 0,
+            f"[{self.scope.name}] cannot resolve thread target "
+            f"{ast.dump(node)[:60]} to a method"))
+        return None
+
+    def collect_roots(self):
+        for mname, fn in list(self.scope.methods.items()):
+            for node in _iter_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                ctor = _call_type(node)
+                dn = dotted_name(node.func) or ""
+                if ctor == "Thread":
+                    tgt = _kw(node, "target")
+                    root = tgt is not None and self._resolve_target(
+                        tgt, mname, node.lineno) or None
+                    if root:
+                        self.scope.roots.setdefault(root, "thread")
+                    self._record_spawn("thread", root, node, mname)
+                elif ctor == "Timer":
+                    cb = node.args[1] if len(node.args) > 1 else \
+                        _kw(node, "function")
+                    root = cb is not None and self._resolve_target(
+                        cb, mname, node.lineno) or None
+                    if root:
+                        self.scope.roots.setdefault(root, "timer")
+                    self._record_spawn("timer", root, node, mname)
+                elif ctor in ("ThreadPoolExecutor", "ProcessPoolExecutor"):
+                    self._record_spawn("executor", None, node, mname)
+                elif last_attr(node.func) == "submit" and node.args:
+                    root = self._resolve_submit(node.args[0], mname)
+                    if root:
+                        self.scope.roots.setdefault(root, "executor")
+                elif dn.endswith("atexit.register") or dn == "register" and \
+                        dotted_name(node.func) == "atexit.register":
+                    if node.args:
+                        root = _self_attr(node.args[0])
+                        if root and root in self.scope.methods:
+                            self.scope.roots.setdefault(root, "atexit")
+        # nested HTTP handler classes: their do_* methods run on server
+        # threads; outer methods they call become http-root reachable
+        self._collect_http_roots()
+
+    def _resolve_submit(self, node: ast.AST, method: str) -> Optional[str]:
+        attr = _self_attr(node)
+        if attr is not None and attr in self.scope.methods:
+            return attr
+        if isinstance(node, ast.Name) and node.id in self.scope.methods:
+            return node.id
+        return None
+
+    def _record_spawn(self, kind: str, target: Optional[str],
+                      call: ast.Call, method: str):
+        handle = None
+        parent = self._assign_parent.get(call)
+        if parent is not None:
+            tgt = parent.targets[0] if isinstance(parent, ast.Assign) and \
+                parent.targets else None
+            attr = _self_attr(tgt) if tgt is not None else None
+            if attr is not None:
+                handle = f"self.{attr}"
+            elif isinstance(tgt, ast.Name):
+                handle = tgt.id
+        sp = Spawn(kind=kind, target=target, handle=handle, method=method,
+                   line=call.lineno, col=call.col_offset)
+        daemon = _kw(call, "daemon")
+        if isinstance(daemon, ast.Constant) and daemon.value is True:
+            sp.cleanup.add("daemon")
+        if self._withitem_calls.get(call):
+            sp.cleanup.add("context")    # `with ThreadPoolExecutor() as ..`
+        self.scope.spawns.append(sp)
+
+    def _collect_http_roots(self):
+        body = getattr(self.node, "body", [])
+        nested: List[ast.ClassDef] = []
+        for fn in self.scope.methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.ClassDef):
+                    nested.append(node)
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                nested.append(stmt)
+        for cls in nested:
+            bases = {last_attr(b) or "" for b in cls.bases}
+            if not bases & {"BaseHTTPRequestHandler",
+                            "SimpleHTTPRequestHandler"}:
+                continue
+            # any outer-scope method the handler body names is reachable
+            # from an HTTP root
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call):
+                    callee = last_attr(node.func)
+                    if callee in self.scope.methods:
+                        self.scope.roots.setdefault(callee, "http")
+
+    # -- pass 3: guarded regions, accesses, edges, blocking ---------------
+
+    def _prepass(self):
+        """Index Assign parents and with-items for spawn handle binding."""
+        self._assign_parent: Dict[ast.AST, ast.Assign] = {}
+        self._withitem_calls: Dict[ast.AST, bool] = {}
+        for fn in self.scope.methods.values():
+            for node in _iter_body(fn):
+                if isinstance(node, ast.Assign):
+                    for call in _assign_calls(node.value):
+                        self._assign_parent[call] = node
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        if isinstance(item.context_expr, ast.Call):
+                            self._withitem_calls[item.context_expr] = True
+
+    def walk_method(self, mname: str, fn: ast.AST):
+        held: List[str] = list(self.scope.entry_locks.get(mname, ()))
+        self._walk_stmts(getattr(fn, "body", []), mname, held,
+                         local_types=self._local_types(fn))
+
+    def _local_types(self, fn: ast.AST) -> Dict[str, str]:
+        """Local var -> ctor type, for join/result receiver typing."""
+        out: Dict[str, str] = {}
+        for node in _iter_body(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                ctor = _call_type(node.value)
+                callee = last_attr(node.value.func)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if ctor in ("Thread", "Timer",
+                                    "ThreadPoolExecutor"):
+                            out[tgt.id] = ctor
+                        elif callee == "submit":
+                            out[tgt.id] = "Future"
+        return out
+
+    def _walk_stmts(self, stmts: Sequence[ast.stmt], mname: str,
+                    held: List[str], local_types: Dict[str, str]):
+        for stmt in stmts:
+            self._walk_stmt(stmt, mname, held, local_types)
+
+    def _walk_stmt(self, stmt: ast.stmt, mname: str, held: List[str],
+                   local_types: Dict[str, str]):
+        if isinstance(stmt, ast.With):
+            acquired: List[str] = []
+            for item in stmt.items:
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    if held:
+                        self.scope.edges.append(AcqEdge(
+                            src=self.scope.qualified(held[-1]),
+                            dst=self.scope.qualified(lk),
+                            method=mname, line=stmt.lineno))
+                    held.append(lk)
+                    acquired.append(lk)
+                else:
+                    self._visit_expr(item.context_expr, mname, held,
+                                     local_types)
+            self._walk_stmts(stmt.body, mname, held, local_types)
+            for _ in acquired:
+                held.pop()
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return          # nested defs analyzed only if they are roots
+        if isinstance(stmt, ast.ClassDef):
+            return
+        # acquire()/release() outside `with` — conservative region
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            callee = last_attr(call.func)
+            if callee in ("acquire", "release") and \
+                    isinstance(call.func, ast.Attribute):
+                lk = self._lock_of_node(call.func.value)
+                if lk is not None:
+                    if callee == "acquire":
+                        if held:
+                            self.scope.edges.append(AcqEdge(
+                                src=self.scope.qualified(held[-1]),
+                                dst=self.scope.qualified(lk),
+                                method=mname, line=stmt.lineno))
+                        held.append(lk)
+                    elif lk in held:
+                        held.remove(lk)
+                    return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.stmt):
+                self._walk_stmt(node, mname, held, local_types)
+            elif isinstance(node, ast.excepthandler):
+                # not an ast.stmt — without this branch an except body
+                # would fall to the expression visitor and lose the held
+                # stack, mis-flagging `with lock:` regions inside handlers
+                self._walk_stmts(node.body, mname, held, local_types)
+            else:
+                self._visit_expr(node, mname, held, local_types,
+                                 store_ctx=self._store_target(stmt))
+
+    def _store_target(self, stmt: ast.stmt) -> Set[ast.AST]:
+        """Expression nodes that are *written* by this statement."""
+        out: Set[ast.AST] = set()
+        if isinstance(stmt, ast.Assign):
+            out.update(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            out.add(stmt.target)
+        elif isinstance(stmt, ast.Delete):
+            out.update(stmt.targets)
+        return out
+
+    def _lock_of(self, node: ast.AST) -> Optional[str]:
+        """Canonical lock for `self._lock` / `self._cv` context exprs."""
+        return self._lock_of_node(node)
+
+    def _lock_of_node(self, node: ast.AST) -> Optional[str]:
+        attr = _self_attr(node)
+        if attr is None:
+            return None
+        return self.scope.canonical_lock(attr)
+
+    def _visit_expr(self, node: ast.AST, mname: str, held: List[str],
+                    local_types: Dict[str, str],
+                    store_ctx: Optional[Set[ast.AST]] = None):
+        store_ctx = store_ctx or set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._visit_call(sub, mname, held, local_types)
+            attr = _self_attr(sub)
+            if attr is None:
+                continue
+            if self.scope.canonical_lock(attr) is not None:
+                continue
+            kind = "write" if (
+                sub in store_ctx or
+                isinstance(getattr(sub, "ctx", None),
+                           (ast.Store, ast.Del))) else "read"
+            self.scope.accesses.append(Access(
+                attr=attr, kind=kind, method=mname,
+                line=sub.lineno, col=sub.col_offset,
+                locks=frozenset(held)))
+        # subscript stores: self.X[k] = v writes the container X
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Subscript) and \
+                    isinstance(getattr(sub, "ctx", None),
+                               (ast.Store, ast.Del)):
+                attr = _self_attr(sub.value)
+                if attr is not None and \
+                        self.scope.canonical_lock(attr) is None:
+                    self.scope.accesses.append(Access(
+                        attr=attr, kind="write", method=mname,
+                        line=sub.lineno, col=sub.col_offset,
+                        locks=frozenset(held)))
+
+    def _visit_call(self, call: ast.Call, mname: str, held: List[str],
+                    local_types: Dict[str, str]):
+        callee = last_attr(call.func)
+        recv = call.func.value if isinstance(call.func, ast.Attribute) \
+            else None
+        # self.M(...) intra-class call graph (+ held locks at the site)
+        if recv is not None and isinstance(recv, ast.Name) and \
+                recv.id == "self" and callee in self.scope.methods:
+            self.calls.setdefault(mname, set()).add(callee)
+            self.call_sites.setdefault(callee, []).append(
+                (mname, frozenset(held)))
+        # self.attr.M(...) cross-class call through a typed attribute
+        if recv is not None:
+            rattr = _self_attr(recv)
+            if rattr is not None and rattr in self.scope.attr_types:
+                rtype = self.scope.attr_types[rattr]
+                if rtype in self.class_names and held:
+                    self.xcalls.setdefault(mname, []).append(
+                        (rattr, callee, frozenset(held)))
+            # mutating container method = write access on the attribute
+            if rattr is not None and callee in _MUTATOR_METHODS and \
+                    self.scope.canonical_lock(rattr) is None:
+                self.scope.accesses.append(Access(
+                    attr=rattr, kind="write", method=mname,
+                    line=call.lineno, col=call.col_offset,
+                    locks=frozenset(held)))
+        if held:
+            self._check_blocking(call, callee, recv, mname, held,
+                                 local_types)
+
+    def _check_blocking(self, call: ast.Call, callee: Optional[str],
+                        recv: Optional[ast.AST], mname: str,
+                        held: List[str], local_types: Dict[str, str]):
+        why = None
+        rendered = dotted_name(call.func) or callee or "<call>"
+        if callee in _BLOCKING_ATTRS:
+            why = _BLOCKING_ATTRS[callee]
+        elif callee == "join" and recv is not None and \
+                not _is_str_receiver(recv):
+            rattr = _self_attr(recv)
+            rtype = None
+            if rattr is not None:
+                rtype = self.scope.attr_types.get(rattr)
+            elif isinstance(recv, ast.Name):
+                rtype = local_types.get(recv.id)
+            if rtype in ("Thread", "Timer"):
+                why = "thread join"
+        elif callee == "result":
+            rattr = _self_attr(recv) if recv is not None else None
+            rtype = None
+            if rattr is not None:
+                rtype = self.scope.attr_types.get(rattr)
+            elif isinstance(recv, ast.Name):
+                rtype = local_types.get(recv.id)
+            if rtype == "Future":
+                why = "future wait"
+        elif callee == "shutdown" and recv is not None:
+            rattr = _self_attr(recv)
+            rtype = self.scope.attr_types.get(rattr) if rattr else None
+            if isinstance(recv, ast.Name):
+                rtype = local_types.get(recv.id)
+            wait = _kw(call, "wait")
+            if rtype in ("ThreadPoolExecutor", "ProcessPoolExecutor") and \
+                    not (isinstance(wait, ast.Constant)
+                         and wait.value is False):
+                why = "executor shutdown"
+        elif callee == "get" and recv is not None:
+            rattr = _self_attr(recv)
+            rtype = self.scope.attr_types.get(rattr) if rattr else None
+            if rtype in ("Queue", "LifoQueue", "PriorityQueue",
+                         "SimpleQueue"):
+                timeout = _kw(call, "timeout")
+                blocking = _kw(call, "block")
+                untimed = timeout is None or (
+                    isinstance(timeout, ast.Constant)
+                    and timeout.value is None)
+                nonblock = isinstance(blocking, ast.Constant) and \
+                    blocking.value is False
+                if untimed and not nonblock and not call.args:
+                    why = "queue get without timeout"
+        if why is not None:
+            self.scope.blocking.append(BlockSite(
+                lock=held[-1], call=rendered, why=why, method=mname,
+                line=call.lineno, col=call.col_offset))
+
+    # -- pass 4: closures + guard fixpoint --------------------------------
+
+    def _closure(self, starts: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [s for s in starts if s in self.scope.methods]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(self.calls.get(m, ()))
+        return seen
+
+    def build_closures(self):
+        spawn_targets = set(self.scope.roots)
+        for root in list(self.scope.roots):
+            self.scope.root_closure[root] = self._closure([root])
+        # implicit caller root: public surface + dunders, minus methods
+        # that exist only as spawn targets
+        public = [m for m in self.scope.methods
+                  if (not m.startswith("_") or
+                      (m.startswith("__") and m.endswith("__")))
+                  and m not in ("__init__",)
+                  and m not in spawn_targets and "." not in m]
+        caller = self._closure(public)
+        caller -= {"__init__"}
+        if caller:
+            self.scope.roots[CALLER_ROOT] = "caller"
+            self.scope.root_closure[CALLER_ROOT] = caller
+
+    def guard_fixpoint(self):
+        """A method only ever called with lock L held inherits L; iterate
+        so the guarantee flows through helper chains."""
+        entry: Dict[str, FrozenSet[str]] = {}
+        for _ in range(4):
+            changed = False
+            for m in self.scope.methods:
+                sites = self.call_sites.get(m, [])
+                if not sites:
+                    continue
+                # entry guard = intersection over every in-class call site
+                # (caller's own entry guard unions with locks at the site)
+                acc: Optional[Set[str]] = None
+                for caller, locks in sites:
+                    eff = set(locks) | set(entry.get(caller, ()))
+                    acc = eff if acc is None else (acc & eff)
+                # publicly reachable methods can also be called bare
+                if m in self.scope.root_closure.get(CALLER_ROOT, set()) and \
+                        not m.startswith("_"):
+                    acc = set()
+                if m in self.scope.roots:
+                    acc = set()
+                new = frozenset(acc or ())
+                if entry.get(m, frozenset()) != new:
+                    entry[m] = new
+                    changed = True
+            if not changed:
+                break
+        self.scope.entry_locks = entry
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> Scope:
+        self.collect_methods()
+        self.collect_types()
+        self._prepass()
+        self.collect_roots()
+        # first pass: accesses with lexical locks + call graph
+        for mname, fn in list(self.scope.methods.items()):
+            self.walk_method(mname, fn)
+        self.build_closures()
+        self.guard_fixpoint()
+        if any(self.scope.entry_locks.values()):
+            # re-walk with entry guards seeding the held stack so helper
+            # accesses/edges/blocking reflect the inherited lock
+            self.scope.accesses = []
+            self.scope.edges = []
+            self.scope.blocking = []
+            self.calls = {}
+            self.call_sites = {}
+            self.xcalls = {}
+            for mname, fn in list(self.scope.methods.items()):
+                self.walk_method(mname, fn)
+        return self.scope
+
+
+# --------------------------------------------------------------------------
+# package extraction
+# --------------------------------------------------------------------------
+
+def _scope_name(path: str, cls: Optional[str]) -> str:
+    base = os.path.splitext(os.path.basename(path))[0]
+    return f"{base}.{cls}" if cls else f"{base}.<module>"
+
+
+def extract_concurrency(paths: Iterable[str]
+                        ) -> Tuple[Dict[str, Scope], List[Finding],
+                                   Dict[str, "ScopeExtractor"]]:
+    """Extract every class scope (plus per-module top-level pseudo-scopes
+    for spawn hygiene) under `paths`."""
+    files = iter_py_files(paths)
+    class_names: Dict[str, str] = {}
+    trees: List[Tuple[str, ast.Module]] = []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError):
+            continue
+        trees.append((path, tree))
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                class_names.setdefault(stmt.name,
+                                       _scope_name(path, stmt.name))
+    scopes: Dict[str, Scope] = {}
+    extractors: Dict[str, ScopeExtractor] = {}
+    warnings: List[Finding] = []
+    for path, tree in trees:
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                ex = ScopeExtractor(_scope_name(path, stmt.name), path,
+                                    stmt, class_names)
+                sc = ex.run()
+                if sc.interesting():
+                    scopes[sc.name] = sc
+                    extractors[sc.name] = ex
+                    warnings.extend(ex.warnings)
+        # module top level: wrap top-level functions in a pseudo-scope so
+        # leaked threads spawned outside classes are still seen
+        mod_fns = [s for s in tree.body
+                   if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        if mod_fns:
+            pseudo = ast.ClassDef(name="<module>", bases=[], keywords=[],
+                                  body=list(mod_fns), decorator_list=[])
+            pseudo.lineno = 1
+            pseudo.col_offset = 0
+            ex = ScopeExtractor(_scope_name(path, None), path, pseudo,
+                                class_names)
+            sc = ex.run()
+            if sc.spawns or any(k != "caller" for k in sc.roots.values()):
+                scopes[sc.name] = sc
+                extractors[sc.name] = ex
+                warnings.extend(ex.warnings)
+    # resolve spawn cleanup paths NOW, so the extracted surface is fully
+    # determined before any consumer runs — a manifest written before the
+    # leaked-thread check must serialize the same cleanup sets the check
+    # later sees (otherwise --update-manifest self-reports drift)
+    for name, sc in scopes.items():
+        for sp in sc.spawns:
+            sp.cleanup = _spawn_cleanup(sc, extractors[name], sp)
+    return scopes, warnings, extractors
+
+
+# --------------------------------------------------------------------------
+# rule checks
+# --------------------------------------------------------------------------
+
+def _mk(rule: str, path: str, line: int, msg: str,
+        col: int = 0) -> Finding:
+    return Finding(rule, RACE_RULES[rule].severity, path, line, col, msg)
+
+
+def _shared_attrs(sc: Scope) -> Dict[str, List[Access]]:
+    """Attrs with >=1 write outside __init__ (config assigned once in
+    __init__ is happens-before thread start and exempt), excluding locks,
+    synced types, and pure bool/None publishes."""
+    by_attr: Dict[str, List[Access]] = {}
+    for a in sc.accesses:
+        if a.method == "__init__":
+            continue
+        if sc.attr_types.get(a.attr) in _SYNCED_TYPES:
+            continue
+        by_attr.setdefault(a.attr, []).append(a)
+    out: Dict[str, List[Access]] = {}
+    for attr, accs in by_attr.items():
+        if any(a.kind == "write" for a in accs):
+            out[attr] = accs
+    return out
+
+
+def _roots_of(sc: Scope, method: str) -> Set[str]:
+    return {root for root, clo in sc.root_closure.items() if method in clo}
+
+
+def _is_publish_only(sc: Scope, attr: str, extractor: "ScopeExtractor"
+                     ) -> bool:
+    """True when every non-init write of `attr` stores a bare constant —
+    an atomic publish under the GIL (e.g. ``self._closed = True``)."""
+    for mname, fn in extractor.scope.methods.items():
+        if mname == "__init__":
+            continue
+        for node in _iter_body(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if _self_attr(tgt) == attr and \
+                            not isinstance(node.value, ast.Constant):
+                        return False
+                    if isinstance(tgt, (ast.Subscript, ast.Attribute)) \
+                            and _self_attr(getattr(tgt, "value", None)) \
+                            == attr:
+                        return False
+            elif isinstance(node, ast.AugAssign):
+                tgt = node.target
+                if _self_attr(tgt) == attr:
+                    return False
+                if isinstance(tgt, (ast.Subscript, ast.Attribute)) and \
+                        _self_attr(getattr(tgt, "value", None)) == attr:
+                    return False
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS and \
+                    _self_attr(node.func.value) == attr:
+                return False
+    return True
+
+
+def check_shared_writes(sc: Scope, extractor: "ScopeExtractor",
+                        out: List[Finding]):
+    # accesses in a spawning method BEFORE its first spawn site are
+    # sequenced happens-before the thread start (`self._server = ...;
+    # Thread(target=self._accept).start()`) and carry no race
+    first_spawn: Dict[str, int] = {}
+    for sp in sc.spawns:
+        if sp.target is not None or sp.handle is not None:
+            cur = first_spawn.get(sp.method)
+            first_spawn[sp.method] = sp.line if cur is None else \
+                min(cur, sp.line)
+    for attr, accs in _shared_attrs(sc).items():
+        accs = [a for a in accs
+                if not (a.method in first_spawn
+                        and a.line < first_spawn[a.method])]
+        if not any(a.kind == "write" for a in accs):
+            continue
+        roots: Set[str] = set()
+        for a in accs:
+            roots |= _roots_of(sc, a.method)
+        if len(roots) < 2:
+            continue
+        common = None
+        for a in accs:
+            eff = set(a.locks) | set(sc.entry_locks.get(a.method, ()))
+            common = eff if common is None else (common & eff)
+        if common:
+            continue
+        if _is_publish_only(sc, attr, extractor):
+            continue
+        writes = [a for a in accs if a.kind == "write"]
+        bare = [a for a in writes if not a.locks] or writes
+        first = min(bare, key=lambda a: (a.line, a.col))
+        others = sorted(roots - _roots_of(sc, first.method)) or \
+            sorted(roots)
+        out.append(_mk(
+            "unguarded-shared-write", sc.path, first.line,
+            f"[{sc.name}] attribute '{attr}' written in "
+            f"{first.method}() on root(s) "
+            f"{'/'.join(sorted(_roots_of(sc, first.method)))} and "
+            f"accessed from root(s) {'/'.join(others)} with no common "
+            f"lock", col=first.col))
+
+
+def global_lock_edges(scopes: Dict[str, Scope],
+                      extractors: Dict[str, "ScopeExtractor"]
+                      ) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+    """Package-wide acquisition edges "Cls.lock" -> "Cls.lock", including
+    cross-class edges through typed attributes: holding A and calling a
+    method of an attribute of class C that acquires C.L adds A -> C.L."""
+    # which locks does each (scope, method closure) acquire?
+    acquires: Dict[str, Dict[str, Set[str]]] = {}
+    by_class: Dict[str, Scope] = {}
+    for sc in scopes.values():
+        by_class[sc.name.rsplit(".", 1)[-1]] = sc
+        per: Dict[str, Set[str]] = {}
+        ex = extractors[sc.name]
+        for mname, fn in sc.methods.items():
+            lks: Set[str] = set(sc.entry_locks.get(mname, ()))
+            for node in _iter_body(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lk = ex._lock_of(item.context_expr)
+                        if lk is not None:
+                            lks.add(lk)
+            per[mname] = lks
+        # close over intra-class calls
+        for _ in range(3):
+            for mname in per:
+                for callee in ex.calls.get(mname, ()):
+                    per[mname] |= per.get(callee, set())
+        acquires[sc.name] = per
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for sc in scopes.values():
+        for e in sc.edges:
+            edges.setdefault((e.src, e.dst), (sc.path, e.line, e.method))
+        ex = extractors[sc.name]
+        for mname, sites in ex.xcalls.items():
+            for rattr, callee, held in sites:
+                rtype = sc.attr_types.get(rattr)
+                tgt_sc = by_class.get(rtype or "")
+                if tgt_sc is None or not held:
+                    continue
+                tgt_ac = acquires.get(tgt_sc.name, {})
+                callee_locks: Set[str] = set()
+                if callee in tgt_ac:
+                    callee_locks = tgt_ac[callee]
+                for hl in held:
+                    for tl in callee_locks:
+                        src = sc.qualified(hl)
+                        dst = tgt_sc.qualified(tl)
+                        if src != dst:
+                            edges.setdefault(
+                                (src, dst),
+                                (sc.path, sc.methods[mname].lineno
+                                 if mname in sc.methods else sc.line,
+                                 mname))
+    return edges
+
+
+def _find_cycles(edges: Iterable[Tuple[str, str]]) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(n: str):
+        color[n] = 1
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, 0) == 0:
+                dfs(m)
+            elif color.get(m) == 1:
+                cyc = stack[stack.index(m):] + [m]
+                key = tuple(sorted(set(cyc)))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    return cycles
+
+
+def check_lock_order(scopes: Dict[str, Scope],
+                     extractors: Dict[str, "ScopeExtractor"],
+                     out: List[Finding]):
+    edges = global_lock_edges(scopes, extractors)
+    # a self-edge on a plain (non-reentrant) Lock deadlocks immediately
+    for (a, b), (path, line, method) in sorted(edges.items()):
+        if a == b:
+            cls, lk = a.rsplit(".", 1)
+            kind = None
+            for sc in scopes.values():
+                if sc.name.rsplit(".", 1)[-1] == cls:
+                    kind = sc.locks.get(lk)
+            if kind == "Lock":
+                out.append(_mk(
+                    "lock-order-cycle", path, line,
+                    f"[{cls}] non-reentrant Lock '{lk}' re-acquired while "
+                    f"already held in {method}() — immediate deadlock"))
+    for cyc in _find_cycles((a, b) for (a, b) in edges if a != b):
+        first = cyc[0]
+        path, line, method = edges.get(
+            (cyc[0], cyc[1]), ("<package>", 1, "?"))
+        cls = first.rsplit(".", 1)[0]
+        out.append(_mk(
+            "lock-order-cycle", path, line,
+            f"[{cls}] acquisition-order cycle "
+            f"{' -> '.join(cyc)} — threads taking these locks in "
+            f"opposite order deadlock"))
+
+
+def check_blocking(sc: Scope, out: List[Finding]):
+    for b in sc.blocking:
+        out.append(_mk(
+            "blocking-under-lock", sc.path, b.line,
+            f"[{sc.name}] {b.why} ({b.call}) in {b.method}() while "
+            f"holding '{b.lock}' — stalls every thread contending for "
+            f"the lock", col=b.col))
+
+
+def _spawn_cleanup(sc: Scope, extractor: "ScopeExtractor",
+                   sp: Spawn) -> Set[str]:
+    """Cleanup paths for a spawn handle: daemon flag (constructor or
+    later attribute store), join, cancel, shutdown, context manager, or
+    escape (returned / yielded handles are the caller's to manage)."""
+    paths = set(sp.cleanup)
+    if sp.handle is None:
+        return paths
+    is_attr = sp.handle.startswith("self.")
+    name = sp.handle.split(".", 1)[1] if is_attr else sp.handle
+    methods = sc.methods.items() if is_attr else \
+        [(sp.method, sc.methods.get(sp.method))]
+    for mname, fn in methods:
+        if fn is None:
+            continue
+        for node in _iter_body(fn):
+            recv_name = None
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "daemon":
+                        base = tgt.value
+                        if (is_attr and _self_attr(base) == name) or \
+                                (not is_attr and
+                                 isinstance(base, ast.Name) and
+                                 base.id == name):
+                            if isinstance(node.value, ast.Constant) and \
+                                    node.value.value is True:
+                                paths.add("daemon")
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                if is_attr and _self_attr(base) == name:
+                    recv_name = name
+                elif not is_attr and isinstance(base, ast.Name) and \
+                        base.id == name:
+                    recv_name = name
+                if recv_name is not None and node.func.attr in (
+                        "join", "cancel", "shutdown"):
+                    paths.add(node.func.attr)
+            if isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if (is_attr and _self_attr(v) == name) or \
+                        (not is_attr and isinstance(v, ast.Name)
+                         and v.id == name):
+                    paths.add("escape")
+    return paths
+
+
+def check_leaked_threads(sc: Scope, extractor: "ScopeExtractor",
+                         out: List[Finding]):
+    for sp in sc.spawns:
+        paths = _spawn_cleanup(sc, extractor, sp)
+        if paths:
+            sp.cleanup = paths
+            continue
+        what = sp.target or sp.handle or sp.kind
+        out.append(_mk(
+            "leaked-thread", sc.path, sp.line,
+            f"[{sc.name}] {sp.kind} '{what}' created in {sp.method}() "
+            f"with no join/cancel/daemon/shutdown path — it outlives "
+            f"close()", col=sp.col))
+
+
+# --------------------------------------------------------------------------
+# manifest
+# --------------------------------------------------------------------------
+
+DEFAULT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "data", "fedrace",
+    "concurrency.json")
+
+
+def scope_to_manifest(sc: Scope) -> Dict[str, Any]:
+    guards: Dict[str, List[str]] = {}
+    for attr, accs in _shared_attrs(sc).items():
+        common = None
+        for a in accs:
+            eff = set(a.locks) | set(sc.entry_locks.get(a.method, ()))
+            common = eff if common is None else (common & eff)
+        if common:
+            guards[attr] = sorted(common)
+    spawns = []
+    for sp in sorted(sc.spawns, key=lambda s: (s.line, s.col)):
+        spawns.append({
+            "kind": sp.kind,
+            "target": sp.target,
+            "cleanup": sorted(sp.cleanup)})
+    return {
+        "locks": dict(sorted(sc.locks.items())),
+        "aliases": dict(sorted(sc.lock_aliases.items())),
+        "roots": {k: v for k, v in sorted(sc.roots.items())},
+        "guards": dict(sorted(guards.items())),
+        "order": sorted({(e.src, e.dst) for e in sc.edges}),
+        "spawns": spawns,
+    }
+
+
+def scopes_to_manifest(scopes: Dict[str, Scope],
+                       extractors: Dict[str, "ScopeExtractor"]
+                       ) -> Dict[str, Any]:
+    man_scopes = {}
+    for name, sc in sorted(scopes.items()):
+        entry = scope_to_manifest(sc)
+        entry["order"] = [list(e) for e in entry["order"]]
+        man_scopes[name] = entry
+    edges = global_lock_edges(scopes, extractors)
+    return {
+        "version": 1,
+        "scopes": man_scopes,
+        "lock_order": sorted([list(e) for e in edges]),
+        "suppressions": [],
+    }
+
+
+def load_manifest(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = path or DEFAULT_MANIFEST
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def update_manifest(scopes: Dict[str, Scope],
+                    extractors: Dict[str, "ScopeExtractor"],
+                    path: Optional[str] = None) -> Dict[str, Any]:
+    """Write the extracted surface, PRESERVING the policy half (the
+    suppressions list) of any existing manifest — the measured half's git
+    diff is the review surface (the fedproto/fedverify pattern)."""
+    path = path or DEFAULT_MANIFEST
+    old = load_manifest(path)
+    fresh = scopes_to_manifest(scopes, extractors)
+    if old is not None:
+        fresh["suppressions"] = old.get("suppressions", [])
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(fresh, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return fresh
+
+
+def _diff_paths(a: Any, b: Any, prefix: str = "") -> List[str]:
+    if isinstance(a, dict) and isinstance(b, dict):
+        out: List[str] = []
+        for k in sorted(set(a) | set(b)):
+            p = f"{prefix}.{k}" if prefix else str(k)
+            if k not in a:
+                out.append(f"+{p}")
+            elif k not in b:
+                out.append(f"-{p}")
+            else:
+                out.extend(_diff_paths(a[k], b[k], p))
+        return out
+    if a != b:
+        return [f"~{prefix}: {json.dumps(b)} -> {json.dumps(a)}"]
+    return []
+
+
+def check_manifest(scopes: Dict[str, Scope],
+                   extractors: Dict[str, "ScopeExtractor"],
+                   manifest: Optional[Dict[str, Any]],
+                   out: List[Finding]):
+    if manifest is None:
+        for sc in scopes.values():
+            out.append(_mk("manifest-missing", sc.path, sc.line,
+                           f"[{sc.name}] no concurrency manifest pinned "
+                           "yet — run tools/fedrace.py check "
+                           "--update-manifest"))
+            return   # one finding is enough signal
+        return
+    pinned = manifest.get("scopes", {})
+    for name, sc in sorted(scopes.items()):
+        got = scope_to_manifest(sc)
+        got["order"] = [list(e) for e in got["order"]]
+        if name not in pinned:
+            out.append(_mk("manifest-missing", sc.path, sc.line,
+                           f"[{name}] scope has no manifest entry — run "
+                           "tools/fedrace.py check --update-manifest"))
+            continue
+        if got != pinned[name]:
+            diffs = _diff_paths(got, pinned[name])
+            shown = "; ".join(diffs[:6])
+            more = f" (+{len(diffs) - 6} more)" if len(diffs) > 6 else ""
+            out.append(_mk(
+                "manifest-drift", sc.path, sc.line,
+                f"[{name}] concurrency surface drifted from the pinned "
+                f"manifest: {shown}{more} — review and refresh with "
+                "--update-manifest"))
+    for name in sorted(set(pinned) - set(scopes)):
+        out.append(_mk(
+            "manifest-drift", "<manifest>", 1,
+            f"[{name}] pinned scope no longer extracted — review and "
+            "refresh with --update-manifest"))
+
+
+# --------------------------------------------------------------------------
+# suppression + driver
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedrace:\s*(disable|disable-next-line)\s*=\s*"
+    r"([A-Za-z0-9_,\-]+|all)")
+
+
+def _line_suppressions(path: str) -> Dict[int, Set[str]]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}
+    supp: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        which, rules = m.groups()
+        names = {r.strip() for r in rules.split(",") if r.strip()}
+        target = i + 1 if which == "disable-next-line" else i
+        supp.setdefault(target, set()).update(names)
+    return supp
+
+
+_SCOPE_TAG_RE = re.compile(r"^\[([A-Za-z0-9_.<>\-]+)\]")
+
+
+def apply_suppressions(findings: List[Finding],
+                       manifest: Optional[Dict[str, Any]]) -> List[Finding]:
+    """Source-comment suppressions by (path, line); manifest-level
+    ``{"scope", "rule", "reason"}`` entries match the scope tag every
+    fedrace message leads with (scope "*" matches all; a scope value
+    ending in '*' is a prefix match, for whole legacy subtrees)."""
+    by_path: Dict[str, Dict[int, Set[str]]] = {}
+    man_sup = (manifest or {}).get("suppressions", [])
+    for f in findings:
+        if f.path not in by_path:
+            by_path[f.path] = _line_suppressions(f.path)
+        marked = by_path[f.path].get(f.line, set())
+        if "all" in marked or f.rule in marked:
+            f.suppressed = True
+            continue
+        m = _SCOPE_TAG_RE.match(f.message)
+        scope = m.group(1) if m else None
+        for sup in man_sup:
+            if sup.get("rule") not in (f.rule, "*"):
+                continue
+            pat = sup.get("scope", "")
+            if pat == "*" or pat == scope or (
+                    pat.endswith("*") and scope is not None
+                    and scope.startswith(pat[:-1])):
+                f.suppressed = True
+                break
+    return findings
+
+
+def check_concurrency(scopes: Dict[str, Scope],
+                      extractors: Dict[str, "ScopeExtractor"],
+                      manifest: Optional[Dict[str, Any]] = None,
+                      warnings: Optional[List[Finding]] = None,
+                      rules: Optional[Set[str]] = None) -> List[Finding]:
+    out: List[Finding] = list(warnings or [])
+    for sc in scopes.values():
+        check_shared_writes(sc, extractors[sc.name], out)
+        check_blocking(sc, out)
+        check_leaked_threads(sc, extractors[sc.name], out)
+    check_lock_order(scopes, extractors, out)
+    if rules is None or "manifest-drift" in rules or \
+            "manifest-missing" in rules:
+        check_manifest(scopes, extractors, manifest, out)
+    if rules is not None:
+        out = [f for f in out if f.rule in rules]
+    seen: Set[Tuple] = set()
+    deduped: List[Finding] = []
+    for f in sorted(out, key=lambda f: (f.path, f.line, f.rule, f.message)):
+        k = (f.path, f.line, f.rule, f.message)
+        if k in seen:
+            continue
+        seen.add(k)
+        deduped.append(f)
+    return apply_suppressions(deduped, manifest)
+
+
+def analyze_paths(paths: Iterable[str],
+                  manifest: Optional[Dict[str, Any]] = None,
+                  rules: Optional[Set[str]] = None) -> List[Finding]:
+    scopes, warnings, extractors = extract_concurrency(paths)
+    return check_concurrency(scopes, extractors, manifest, warnings, rules)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Single-source entry point for fixture tests — no manifest rules."""
+    import tempfile
+    if rules is None:
+        rules = set(RACE_RULES) - {"manifest-drift", "manifest-missing"}
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, os.path.basename(path) if path != "<string>"
+                         else "fixture.py")
+        with open(p, "w", encoding="utf-8") as fh:
+            fh.write(source)
+        findings = analyze_paths([p], manifest=None, rules=rules)
+    for f in findings:
+        f.path = path
+    return findings

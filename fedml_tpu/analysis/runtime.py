@@ -35,8 +35,10 @@ compiles and zero explicit transfers).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
-from typing import List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import jax
 
@@ -112,3 +114,221 @@ def count_compilations(fn, *args, **kwargs):
     with JaxRuntimeAudit() as audit:
         result = fn(*args, **kwargs)
     return result, audit.compilations
+
+
+# --------------------------------------------------------------------------
+# LockOrderAudit — runtime half of the fedrace plane (docs/FEDRACE.md)
+# --------------------------------------------------------------------------
+
+class _AuditedLock:
+    """Transparent proxy over a ``threading`` lock primitive that reports
+    acquire/release ordering to a :class:`LockOrderAudit`.  Supports the
+    context protocol plus ``acquire``/``release``/``locked``, so both
+    ``with obj._lock:`` and explicit acquire/release call sites keep
+    working unchanged while wrapped."""
+
+    def __init__(self, audit: "LockOrderAudit", name: str, inner):
+        self._audit = audit
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._audit._on_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._audit._on_release(self._name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_AuditedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<_AuditedLock {self._name} over {self._inner!r}>"
+
+    def __getattr__(self, item):
+        # Condition attrs (wait/notify/notify_all) and RLock internals
+        # pass straight through; only acquire/release order is audited.
+        return getattr(self._inner, item)
+
+
+class LockOrderAudit:
+    """Observed-acquisition-order audit over package locks.
+
+    The static half (:mod:`fedml_tpu.analysis.fedrace`) pins the *lexical*
+    acquisition graph; this wraps live lock attributes in audited proxies
+    and records what threads actually do under load — the per-thread
+    acquisition-order edges (top-of-held-stack → newly acquired) and any
+    blocking events noted while locks are held.  Two verdicts:
+
+    - :meth:`assert_acyclic` — the observed graph has no cycle (a cycle
+      is a witnessed deadlock *schedule*, not just a potential one).
+    - :meth:`assert_subgraph_of` — every observed edge appears in the
+      static pin (``tests/data/fedrace/concurrency.json``), i.e. runtime
+      never discovered an ordering the extractor didn't see.
+
+    Usage (the chaos + serving-load harnesses run exactly this shape)::
+
+        audit = LockOrderAudit()
+        audit.wrap(engine, "_cond", name="ContinuousBatchingEngine._cond")
+        audit.wrap(engine, "_stats_lock",
+                   name="ContinuousBatchingEngine._stats_lock")
+        try:
+            ... hammer the object from many threads ...
+        finally:
+            audit.unwrap_all()
+        audit.assert_acyclic()
+        audit.assert_subgraph_of("tests/data/fedrace/concurrency.json")
+
+    Limitation: a ``Condition`` built on a lock *before* it was wrapped
+    keeps a reference to the raw primitive, so acquisitions through that
+    condition bypass the proxy — wrap plain ``Lock``/``RLock`` attributes,
+    or the condition attribute itself.  Reentrant re-acquisition of the
+    same name records no self-edge (RLocks are legal to nest).
+    """
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._mu = threading.Lock()          # guards the aggregates below
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.acquisitions: Dict[str, int] = {}
+        self.blocking: List[Tuple[str, Tuple[str, ...]]] = []
+        self._wrapped: List[Tuple[Any, str, Any]] = []
+
+    # -- per-thread bookkeeping -------------------------------------------
+    def _held_stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, name: str) -> None:
+        st = self._held_stack()
+        with self._mu:
+            self.acquisitions[name] = self.acquisitions.get(name, 0) + 1
+            if st and st[-1] != name:
+                key = (st[-1], name)
+                self.edges[key] = self.edges.get(key, 0) + 1
+        st.append(name)
+
+    def _on_release(self, name: str) -> None:
+        st = self._held_stack()
+        # locks may release out of LIFO order; drop the LAST occurrence
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                return
+
+    def held(self) -> Tuple[str, ...]:
+        """Audited locks currently held by the CALLING thread."""
+        return tuple(self._held_stack())
+
+    def note_blocking(self, event: str) -> None:
+        """Record a blocking operation (a send, a join, a device sync);
+        kept only when the calling thread holds audited locks — the
+        runtime analogue of the static blocking-under-lock rule."""
+        held = self.held()
+        if held:
+            with self._mu:
+                self.blocking.append((str(event), held))
+
+    # -- wrapping ----------------------------------------------------------
+    def wrap(self, obj: Any, attr: str, name: Optional[str] = None):
+        """Replace ``obj.<attr>`` with an audited proxy.  ``name``
+        defaults to ``"<Class>.<attr>"`` — the manifest's qualified lock
+        form, so observed edges compare directly against the pin."""
+        inner = getattr(obj, attr)
+        if isinstance(inner, _AuditedLock):
+            return inner
+        nm = name or f"{type(obj).__name__}.{attr}"
+        proxy = _AuditedLock(self, nm, inner)
+        setattr(obj, attr, proxy)
+        self._wrapped.append((obj, attr, inner))
+        return proxy
+
+    def unwrap_all(self) -> None:
+        """Restore every wrapped attribute (reverse order); idempotent."""
+        for obj, attr, inner in reversed(self._wrapped):
+            setattr(obj, attr, inner)
+        self._wrapped.clear()
+
+    def __enter__(self) -> "LockOrderAudit":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.unwrap_all()
+        return None
+
+    # -- verdicts ----------------------------------------------------------
+    def observed_edges(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return sorted(self.edges)
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A lock-name cycle in the observed graph, or ``None``."""
+        graph: Dict[str, List[str]] = {}
+        for s, d in self.observed_edges():
+            graph.setdefault(s, []).append(d)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+        path: List[str] = []
+
+        def dfs(n: str) -> Optional[List[str]]:
+            color[n] = GRAY
+            path.append(n)
+            for m in graph.get(n, ()):
+                c = color.get(m, WHITE)
+                if c == GRAY:
+                    return path[path.index(m):] + [m]
+                if c == WHITE:
+                    found = dfs(m)
+                    if found:
+                        return found
+            path.pop()
+            color[n] = BLACK
+            return None
+
+        for n in list(graph):
+            if color.get(n, WHITE) == WHITE:
+                cyc = dfs(n)
+                if cyc:
+                    return cyc
+        return None
+
+    def assert_acyclic(self) -> None:
+        cyc = self.find_cycle()
+        if cyc:
+            raise AssertionError(
+                "observed lock-order cycle (witnessed deadlock schedule): "
+                + " -> ".join(cyc))
+
+    def assert_subgraph_of(self, pinned) -> None:
+        """Every observed edge must appear in ``pinned`` — a manifest
+        dict, a path to one, or an iterable of ``(src, dst)`` pairs.
+        Manifest dicts contribute both the global ``lock_order`` edges and
+        each scope's intra-class ``order`` list."""
+        if isinstance(pinned, (str, os.PathLike)):
+            with open(pinned) as fh:
+                pinned = json.load(fh)
+        if isinstance(pinned, dict):
+            edges: List[Iterable[str]] = list(pinned.get("lock_order", []))
+            for entry in pinned.get("scopes", {}).values():
+                edges.extend(entry.get("order", []))
+            pinned = edges
+        allowed = {tuple(e) for e in pinned}
+        extra = [e for e in self.observed_edges() if e not in allowed]
+        if extra:
+            raise AssertionError(
+                "observed lock-order edge(s) missing from the static pin "
+                "(run tools/fedrace.py check --update-manifest and review "
+                "the diff): " + ", ".join(f"{s} -> {d}" for s, d in extra))

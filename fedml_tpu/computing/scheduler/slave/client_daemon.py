@@ -36,6 +36,10 @@ class AgentDaemon:
         self.max_restarts = int(max_restarts)
         self.window_s = float(window_s)
         self.backoff_s = float(backoff_s)
+        # guards proc/_logf: the supervisor thread respawns while stop()
+        # terminates — an unguarded swap can leave a freshly-respawned
+        # agent running after stop() killed only the old pid
+        self._plock = threading.Lock()
         self.proc: Optional[subprocess.Popen] = None
         self._logf = None
         self.restarts: List[float] = []
@@ -66,9 +70,11 @@ class AgentDaemon:
                                 stderr=subprocess.STDOUT)
 
     def _loop(self) -> None:
-        self.proc = self._spawn()
+        with self._plock:
+            self.proc = self._spawn()
         while not self._stop.is_set():
-            rc = self.proc.poll()
+            with self._plock:
+                rc = self.proc.poll()
             if rc is None:
                 time.sleep(0.1)
                 continue
@@ -85,7 +91,12 @@ class AgentDaemon:
                     return
                 time.sleep(self.backoff_s * (1 + len(self.restarts)))
             self.restarts.append(now)
-            if not self._stop.is_set():
+            with self._plock:
+                # stop-check and respawn are one atomic step: once stop()
+                # has set the flag (it holds _plock to read proc), no new
+                # agent can appear for it to miss
+                if self._stop.is_set():
+                    return
                 self.proc = self._spawn()
 
     def start(self) -> None:
@@ -95,18 +106,23 @@ class AgentDaemon:
 
     def stop(self) -> None:
         self._stop.set()
-        if self.proc is not None and self.proc.poll() is None:
-            self.proc.terminate()
+        with self._plock:
+            proc = self.proc
+        # terminate/wait on the local ref OUTSIDE _plock (wait blocks up
+        # to 5s; the supervisor thread needs the lock to observe _stop)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
             try:
-                self.proc.wait(timeout=5.0)
+                proc.wait(timeout=5.0)
             except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait()
+                proc.kill()
+                proc.wait()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
-        if self._logf is not None:
-            self._logf.close()
-            self._logf = None
+        with self._plock:
+            if self._logf is not None:
+                self._logf.close()
+                self._logf = None
 
     def agent_pid(self, timeout_s: float = 60.0) -> int:
         """Pid of the CURRENT agent process (survives respawns via the
@@ -114,11 +130,13 @@ class AgentDaemon:
         path = os.path.join(self.work_dir, "agent.pid")
         deadline = time.time() + timeout_s
         while time.time() < deadline:
-            if self.proc is not None and self.proc.poll() is None \
+            with self._plock:
+                proc = self.proc
+            if proc is not None and proc.poll() is None \
                     and os.path.exists(path):
                 with open(path) as f:
                     txt = f.read().strip()
-                if txt and int(txt) == self.proc.pid:
+                if txt and int(txt) == proc.pid:
                     return int(txt)
             time.sleep(0.05)
         raise TimeoutError("agent pidfile never matched a live agent")

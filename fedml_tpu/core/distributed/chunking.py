@@ -212,7 +212,17 @@ class ChunkingCommManager(BaseCommunicationManager, Observer):
         self.inner.handle_receive_message()
 
     def stop_receive_message(self, *a, **kw):
+        # drain-then-close: the inner stop (reliable flush window rides
+        # through *a/**kw) finishes first, THEN torn reassembly buffers
+        # drop — a stream that completes during the flush still delivers
         self.inner.stop_receive_message(*a, **kw)
+        with self._lock:
+            if self._partial:
+                log.warning("fedwire: dropping %d torn chunk stream(s) "
+                            "at close", len(self._partial))
+                self.stats["streams_dropped"] += len(self._partial)
+            self._partial.clear()
+            self._expected.clear()
 
 
 def maybe_wrap_chunking(manager: BaseCommunicationManager, args,

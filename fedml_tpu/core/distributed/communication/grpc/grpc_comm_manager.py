@@ -72,8 +72,12 @@ class GRPCCommManager(BaseCommunicationManager):
                 request_deserializer=lambda b: b,
                 response_serializer=lambda b: b)},
         )
+        # keep a handle on the handler pool: grpc.server() does not shut
+        # its executor down on stop(), so an anonymous pool leaks 8
+        # non-daemon workers per manager across a multi-round test run
+        self._server_pool = futures.ThreadPoolExecutor(max_workers=8)
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8),
+            self._server_pool,
             options=[("grpc.max_send_message_length", _MAX_MSG),
                      ("grpc.max_receive_message_length", _MAX_MSG)])
         self._server.add_generic_rpc_handlers((handler,))
@@ -153,5 +157,6 @@ class GRPCCommManager(BaseCommunicationManager):
             self._cv.notify_all()
         if self._server is not None:
             self._server.stop(grace=0.5)
+            self._server_pool.shutdown(wait=False)
         for ch in self._channels.values():
             ch.close()

@@ -208,6 +208,11 @@ class Client:
         self._pass: Optional[str] = None
         self._pid = 0
         self._pid_lock = threading.Lock()
+        # guards _inflight/_pubrel_sent: publish() registers pids from
+        # caller threads while the reader thread (_handle) retires them
+        # on PUBACK/PUBREC/PUBCOMP — an unguarded dict mutation from both
+        # sides can drop an ack and wedge wait_for_publish() forever
+        self._track_lock = threading.Lock()
         self._inflight: Dict[int, MessageInfo] = {}
         self._pubrel_sent: Dict[int, MessageInfo] = {}
         self._qos2_inbound: set = set()
@@ -243,10 +248,13 @@ class Client:
 
     # -- lifecycle ---------------------------------------------------------
     def connect(self, host: str, port: int = 1883, keepalive: int = 60):
-        self._keepalive = int(keepalive)
-        self._sock = socket.create_connection((host, port), timeout=10.0)
+        # connect() happens-before loop_start() by API contract (paho's
+        # too), so the reader/ping threads that later read these three
+        # cannot exist yet — no lock needed for the setup writes
+        self._keepalive = int(keepalive)  # fedrace: disable=unguarded-shared-write
+        self._sock = socket.create_connection((host, port), timeout=10.0)  # fedrace: disable=unguarded-shared-write
         self._sock.settimeout(None)
-        self._reader = PacketReader(self._sock.recv)
+        self._reader = PacketReader(self._sock.recv)  # fedrace: disable=unguarded-shared-write
         self._send(make_connect(self.client_id, self.clean_session,
                                 self._keepalive, self._will, self._user,
                                 self._pass))
@@ -278,7 +286,8 @@ class Client:
             info._done.set()
             return info
         pid = self._next_pid()
-        self._inflight[pid] = info
+        with self._track_lock:
+            self._inflight[pid] = info
         self._send(make_publish(topic, payload, qos, retain, pid))
         return info
 
@@ -369,18 +378,21 @@ class Client:
                                 MqttMessage(topic, payload, qos, retain))
         elif ptype == PUBACK:
             pid, = struct.unpack(">H", body)
-            info = self._inflight.pop(pid, None)
+            with self._track_lock:
+                info = self._inflight.pop(pid, None)
             if info:
                 info._done.set()
         elif ptype == PUBREC:
             pid, = struct.unpack(">H", body)
-            info = self._inflight.pop(pid, None)
-            if info is not None:
-                self._pubrel_sent[pid] = info
+            with self._track_lock:
+                info = self._inflight.pop(pid, None)
+                if info is not None:
+                    self._pubrel_sent[pid] = info
             self._send(make_pid_packet(PUBREL, pid))
         elif ptype == PUBCOMP:
             pid, = struct.unpack(">H", body)
-            info = self._pubrel_sent.pop(pid, None)
+            with self._track_lock:
+                info = self._pubrel_sent.pop(pid, None)
             if info:
                 info._done.set()
         elif ptype == PUBREL:

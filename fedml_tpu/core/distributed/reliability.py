@@ -174,6 +174,10 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         self._failed: List[str] = []
         self._started_at = time.monotonic()
         self._running = False
+        self._closed = False
+        # set by close(): wakes the beacon out of its inter-beat wait
+        # immediately instead of lagging shutdown by up to one interval
+        self._hb_wake = threading.Event()
         self._retx_thread: Optional[threading.Thread] = None
         self._hb_thread: Optional[threading.Thread] = None
         self.stats = {"sent": 0, "reliable_sent": 0, "retries": 0,
@@ -211,7 +215,7 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
             self._emit_rates()
 
     def _ensure_retx_thread(self):
-        if self._retx_thread is None:
+        if self._retx_thread is None and not self._closed:
             self._running = True
             self._retx_thread = threading.Thread(
                 target=self._retransmit_loop,
@@ -384,7 +388,8 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                 self._leases.setdefault(int(r), _Lease(now))
         if (self.heartbeat_interval_s > 0
                 and self.rank != self.server_rank
-                and self._hb_thread is None):
+                and self._hb_thread is None
+                and not self._closed):
             self._running = True
             self._hb_thread = threading.Thread(
                 target=self._heartbeat_loop,
@@ -406,7 +411,10 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
                     self.stats["heartbeats"] += 1
             except Exception:  # noqa: BLE001 — beacon must outlive faults
                 log.exception("fedguard: heartbeat send failed")
-            time.sleep(self.heartbeat_interval_s)
+            # interruptible inter-beat wait: close() sets _hb_wake so
+            # shutdown never blocks on a full heartbeat interval
+            if self._hb_wake.wait(self.heartbeat_interval_s):
+                return
 
     def dead_ranks(self) -> Set[int]:
         """Ranks whose heartbeat lease expired.  Dynamic: a healed rank
@@ -441,16 +449,33 @@ class ReliableCommManager(BaseCommunicationManager, Observer):
         """Stop the retransmit/heartbeat threads, optionally granting
         in-flight reliable sends ``flush_s`` to get acked first (the
         server's FINISH fan-out)."""
-        if flush_s > 0:
+        self.close(flush_s=flush_s)
+
+    def close(self, flush_s: float = 0.0):
+        """Idempotent shutdown: optionally flush, then cancel every
+        outstanding retransmit obligation, stop the retransmit loop and
+        heartbeat beacon with bounded joins, and stop the inner backend
+        exactly once.  Safe to call from atexit, a crash handler, AND the
+        normal exit path in any order — later calls are no-ops."""
+        if flush_s > 0 and not self._closed:
             deadline = time.monotonic() + flush_s
             while time.monotonic() < deadline and self.outstanding():
                 time.sleep(0.02)
         with self._cv:
+            if self._closed:
+                return
+            self._closed = True
             self._running = False
+            # unacked sends are cancelled, not failed: shutdown is not a
+            # delivery verdict, so they don't join _failed
+            self._outstanding.clear()
+            self._hb_wake.set()
             self._cv.notify_all()
         for th in (self._retx_thread, self._hb_thread):
             if th is not None:
                 th.join(timeout=2.0)
+        self._retx_thread = None
+        self._hb_thread = None
         self.inner.stop_receive_message()
 
 

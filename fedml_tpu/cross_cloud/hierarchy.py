@@ -142,6 +142,11 @@ class CloudBridgeManager(FedMLServerManager):
 
     # -- round close: escalate instead of finishing -------------------------
     def _finish_round(self):
+        """Caller holds _round_lock (base-class contract): aggregate the
+        buffered uploads into the cloud partial under the lock, return a
+        closure that performs the global-plane send after the caller
+        releases it — the escalation is blocking wire I/O, same rule as
+        the base class's sync-model broadcast."""
         agg = self.aggregator
         weights, partial = [], None
         for i in sorted(agg.model_dict):
@@ -155,10 +160,14 @@ class CloudBridgeManager(FedMLServerManager):
         msg.add_params(CloudMsg.ARG_PARTIAL, partial)
         msg.add_params(CloudMsg.ARG_WEIGHT, float(sum(weights)))
         msg.add_params(CloudMsg.ARG_ROUND, self.args.round_idx)
-        self._global.send_message(msg)
-        log.info("cloud %d: escalated round %d partial (%d clients, "
-                 "weight %.1f)", self.cloud_rank, self.args.round_idx,
-                 len(weights), sum(weights))
+        round_idx = self.args.round_idx
+
+        def _escalate():
+            self._global.send_message(msg)
+            log.info("cloud %d: escalated round %d partial (%d clients, "
+                     "weight %.1f)", self.cloud_rank, round_idx,
+                     len(weights), sum(weights))
+        return _escalate
 
     def _on_global_sync(self, msg, finish: bool):
         params = msg.get(CloudMsg.ARG_MODEL)

@@ -197,7 +197,8 @@ class FedMLServerManager(FedMLCommManager):
                 self.client_real_ids.index(sender), params, n)
             if not self.aggregator.check_whether_all_receive():
                 return
-            self._finish_round()
+            broadcast = self._finish_round()
+        broadcast()  # blocking wire I/O runs after _round_lock is released
 
     def _on_aggregation_timeout(self, armed_round: int):
         with self._round_lock:
@@ -215,10 +216,22 @@ class FedMLServerManager(FedMLCommManager):
                         "with %d/%d clients", self.args.round_idx, received,
                         self.client_num)
             self.aggregator.reset_receive_flags()
-            self._finish_round()
+            broadcast = self._finish_round()
+        broadcast()
 
     def _finish_round(self):
-        """Caller holds _round_lock (handler thread or timeout thread)."""
+        """Caller holds _round_lock (handler thread or timeout thread).
+
+        Aggregates and advances the round state under the lock, then
+        returns a zero-arg callable the caller MUST run after releasing
+        it — the callable performs the outbound sends.  Sync-model
+        broadcasts are blocking wire I/O; doing them under _round_lock
+        would stall every concurrent upload handler and the timeout
+        thread for the whole broadcast (and on a reliable backend, for
+        its retransmit windows too).  The round timer is armed before the
+        lock drops, so an upload racing the broadcast still lands in an
+        open, timed round.
+        """
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
@@ -236,18 +249,25 @@ class FedMLServerManager(FedMLCommManager):
                 self._ckpt.save(round_idx, self.aggregator.state, None)
         self.args.round_idx = round_idx + 1
         if self.args.round_idx >= self.round_num:
-            self.send_finish()
-            return
+            def _finish():
+                self.send_finish()
+            return _finish
         client_idxs = self._sampled_client_idxs(self.args.round_idx)
         global_params = self.aggregator.get_global_model_params()
+        msgs = []
         for rank, data_idx in zip(self.client_real_ids, client_idxs):
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                           self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(data_idx))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.args.round_idx)
-            self.send_message(msg)
+            msgs.append(msg)
         self._arm_round_timer()
+
+        def _broadcast():
+            for msg in msgs:
+                self.send_message(msg)
+        return _broadcast
 
     def send_finish(self):
         for rank in self.client_real_ids:

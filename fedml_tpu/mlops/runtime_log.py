@@ -67,24 +67,36 @@ class MLOpsRuntimeLogDaemon:
         self.sink = sink
         self.batch_lines = batch_lines
         self.interval_s = interval_s
+        # _files is registered from run-setup threads while the daemon
+        # thread drains it; _flock keeps the offset read/advance atomic
+        # with registration, so stop_log_processor racing a drain can
+        # never resurrect a just-stopped file's offset entry
+        self._flock = threading.Lock()
         self._files = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start_log_processor(self, run_id: str, log_path: str):
-        self._files[(str(run_id), log_path)] = 0  # byte offset
+        with self._flock:
+            self._files[(str(run_id), log_path)] = 0  # byte offset
 
     def stop_log_processor(self, run_id: str, log_path: str):
-        self._files.pop((str(run_id), log_path), None)
+        with self._flock:
+            self._files.pop((str(run_id), log_path), None)
 
     def _drain_one(self, key) -> bool:
         run_id, path = key
-        off = self._files.get(key, 0)
+        with self._flock:
+            if key not in self._files:
+                return False  # stopped since the drain pass snapshotted
+            off = self._files[key]
         if not os.path.exists(path):
             return False
         size = os.path.getsize(path)
         if size <= off:
             return False
+        # file I/O stays outside _flock — a slow disk must not block
+        # start/stop_log_processor callers
         with open(path, "r", errors="replace") as f:
             f.seek(off)
             chunk = f.read()
@@ -93,7 +105,9 @@ class MLOpsRuntimeLogDaemon:
             if last_nl < 0:
                 return False
             lines = chunk[:last_nl].splitlines()
-            self._files[key] = off + len(chunk[:last_nl + 1].encode())
+            with self._flock:
+                if key in self._files:  # guard against a concurrent stop
+                    self._files[key] = off + len(chunk[:last_nl + 1].encode())
         for i in range(0, len(lines), self.batch_lines):
             self.sink(run_id, lines[i:i + self.batch_lines])
         return True
@@ -102,7 +116,9 @@ class MLOpsRuntimeLogDaemon:
         """One synchronous pass over all watched files (tests/shutdown);
         also flushes a buffering sink (HttpLogSink) so outage-stranded
         batches re-ship even when no new lines arrived."""
-        for key in list(self._files):
+        with self._flock:
+            keys = list(self._files)
+        for key in keys:
             self._drain_one(key)
         flush = getattr(self.sink, "flush", None)
         if callable(flush):

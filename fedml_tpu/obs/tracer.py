@@ -326,6 +326,17 @@ class Tracer:
         configured default path) when one is given.  ``otherData``
         carries the process identity + the unix clock anchor ``fedtrace
         merge`` aligns multi-process captures on."""
+        # identity/clock anchor snapshot under the tracer lock: a round
+        # flush racing reset() (or an end() bumping dropped_ends) must not
+        # tear the (trace_id, origin) pair the multi-process merge aligns
+        # on.  Taken BEFORE events(), which acquires the lock itself.
+        with self._lock:
+            other = {"exporter": "fedml_tpu.obs",
+                     "dropped_ends": self.dropped_ends,
+                     "host": self.host, "pid": self._pid,
+                     "label": self.process_label(),
+                     "trace_id": self.trace_id,
+                     "origin_unix_us": self._origin_unix_us}
         trace = {
             "traceEvents": [
                 {"name": "process_name", "ph": "M", "ts": 0.0,
@@ -336,12 +347,7 @@ class Tracer:
                  "args": {"name": "xla-compile"}},
             ] + self.events(),
             "displayTimeUnit": "ms",
-            "otherData": {"exporter": "fedml_tpu.obs",
-                          "dropped_ends": self.dropped_ends,
-                          "host": self.host, "pid": self._pid,
-                          "label": self.process_label(),
-                          "trace_id": self.trace_id,
-                          "origin_unix_us": self._origin_unix_us},
+            "otherData": other,
         }
         path = path or self.path
         if path:

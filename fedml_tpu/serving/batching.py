@@ -231,6 +231,11 @@ class ContinuousBatchingEngine:
         self.serve_stats: Dict[str, Any] = {
             "admits": 0, "tokens": 0, "requests": {}}
         self._tok_window = [time.monotonic(), 0]
+        # guards serve_stats/_tok_window (engine thread increments, HTTP
+        # submit() and metrics scrapes read).  Strictly innermost: taken
+        # with nothing else held, or nested inside _cond — never the
+        # reverse, so it can never extend the lock-order graph into a cycle
+        self._stats_lock = threading.Lock()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -272,11 +277,13 @@ class ContinuousBatchingEngine:
                     "q": out,
                 })
                 name = adapter if adapter is not None else "base"
-                reqs = self.serve_stats["requests"]
-                reqs[name] = reqs.get(name, 0) + 1
+                with self._stats_lock:   # _cond -> _stats_lock, never reversed
+                    reqs = self.serve_stats["requests"]
+                    reqs[name] = reqs.get(name, 0) + 1
+                    nreq = reqs[name]
                 tracer = get_tracer()
                 if tracer.enabled:
-                    tracer.counter(f"serve.requests.{name}", reqs[name])
+                    tracer.counter(f"serve.requests.{name}", nreq)
                 self._cond.notify()
         except BaseException:
             if self.registry is not None:
@@ -394,8 +401,9 @@ class ContinuousBatchingEngine:
         s.q.put(tok)
         s.remaining -= 1
         s.cur_tok = tok
-        self.serve_stats["tokens"] += 1
-        self._tok_window[1] += 1
+        with self._stats_lock:
+            self.serve_stats["tokens"] += 1
+            self._tok_window[1] += 1
         return s.remaining > 0 and s.pos < self.buf_len
 
     def _admit(self, req: dict, slot: int):
@@ -438,6 +446,11 @@ class ContinuousBatchingEngine:
                                        sub, temp)
         if self.prefix_cache is not None and n > 0:
             self.prefix_cache.insert(ids, cache, self.raw_params, atok)
+        # decode-state arrays (_caches/_aids/_temps/_keys, and _toks/_poss
+        # in _dispatch) are engine-thread-confined: written only between
+        # dispatches on the engine thread, never touched by submit()/HTTP
+        # threads, so they need no lock despite living next to shared state
+        # fedrace: disable-next-line=unguarded-shared-write
         self._caches = self._insert(self._caches, cache, jnp.int32(slot))
         s = self._slots[slot]
         s.live = True
@@ -446,9 +459,9 @@ class ContinuousBatchingEngine:
         s.remaining = req["max_new_tokens"]
         s.eos_id = req["eos_id"]
         s.adapter_row = row
-        self._aids[slot] = row
-        self._temps[slot] = req["temperature"]
-        self._keys[slot] = np.asarray(key)
+        self._aids[slot] = row  # fedrace: disable=unguarded-shared-write
+        self._temps[slot] = req["temperature"]  # fedrace: disable=unguarded-shared-write
+        self._keys[slot] = np.asarray(key)  # fedrace: disable=unguarded-shared-write
         if not self._emit(slot, int(tok)):
             self._finish(slot)
 
@@ -496,6 +509,11 @@ class ContinuousBatchingEngine:
                 # frees the old tree + stale KV eagerly)
                 swap_pending = self._pending_params is not None
                 if swap_pending and not any(s.live for s in self._slots):
+                    # raw_params is swapped only here on the engine thread
+                    # (update_params merely STAGES via _pending_params under
+                    # _cond); all other raw_params uses are engine-thread
+                    # dispatch reads, so the write needs no extra guard
+                    # fedrace: disable-next-line=unguarded-shared-write
                     self.raw_params = self._pending_params
                     self._pending_params = None
                     if self.prefix_cache is not None:
@@ -516,7 +534,8 @@ class ContinuousBatchingEngine:
                 with tracer.span("serve.admit", cat="serve", slot=slot,
                                  adapter_row=req.get("adapter_row", 0)):
                     self._admit(req, slot)
-                self.serve_stats["admits"] += 1
+                with self._stats_lock:
+                    self.serve_stats["admits"] += 1
             if tracer.enabled:
                 tracer.counter("serve.queue_depth", self._waiting.qsize())
 
@@ -526,20 +545,25 @@ class ContinuousBatchingEngine:
             self._dispatch(live)
             self._ticks += 1
             if tracer.enabled:
-                t0, ntok = self._tok_window
                 now = time.monotonic()
-                if now - t0 >= 0.5:
-                    tracer.counter("serve.tokens_per_s", ntok / (now - t0))
-                    tracer.counter("serve.tokens_total",
-                                   self.serve_stats["tokens"])
-                    self._tok_window = [now, 0]
+                rolled = None
+                with self._stats_lock:
+                    t0, ntok = self._tok_window
+                    if now - t0 >= 0.5:
+                        rolled = (ntok, self.serve_stats["tokens"])
+                        self._tok_window = [now, 0]
+                if rolled is not None:   # counter emits outside _stats_lock
+                    tracer.counter("serve.tokens_per_s",
+                                   rolled[0] / (now - t0))
+                    tracer.counter("serve.tokens_total", rolled[1])
 
     def _dispatch(self, live):
         """One device tick for the live slots (overridden by the
         speculative engine): horizon-scanned batched decode + emission."""
         for i in live:
-            self._toks[i] = self._slots[i].cur_tok
-            self._poss[i] = self._slots[i].pos
+            # engine-thread-confined decode state (see _admit)
+            self._toks[i] = self._slots[i].cur_tok  # fedrace: disable=unguarded-shared-write
+            self._poss[i] = self._slots[i].pos  # fedrace: disable=unguarded-shared-write
         if self.registry is not None:
             # snapshot + dispatch under the registry lock so a concurrent
             # register()'s donated row write cannot invalidate the bank

@@ -516,6 +516,11 @@ class OpenAICompatServer:
         # one batched decode program; without an engine each request
         # carries its tree through the single-request path.  The reference
         # serves one full model copy per personalized endpoint.
+        # serializes hot-swap writers (update_params / add_adapter /
+        # evict_adapter on the training/promotion thread) against HTTP
+        # worker threads snapshotting a coherent (params, draft_params,
+        # prefix_cache, adapter) set at the top of _complete
+        self._swap_lock = threading.Lock()
         self.adapters = None
         self._zero_lora = None
         self.registry = None
@@ -616,26 +621,35 @@ class OpenAICompatServer:
         # other than the server's base model id (so a federated client
         # points its stock OpenAI SDK at its own cohort's adapter)
         adapter_name = req.get("adapter")
-        if not adapter_name:
-            m = req.get("model")
-            if (isinstance(m, str) and m and m != self.model_name
-                    and (self.adapters is not None
-                         or self.registry is not None)):
-                adapter_name = m
-        lora = None
-        if self.registry is not None:
-            pass  # resolved (and pinned) per-path below
-        elif self.adapters is not None:
-            if adapter_name:
-                if adapter_name not in self.adapters:
-                    raise RequestError(
-                        f"unknown adapter {adapter_name!r}; have "
-                        f"{sorted(self.adapters)}", status=404)
-                lora = self.adapters[adapter_name]
-            else:
-                lora = self._zero_lora
-        elif adapter_name:
-            raise RequestError("server has no adapters configured")
+        # one coherent weight snapshot per request: update_params /
+        # add_adapter / evict_adapter swap these under _swap_lock on the
+        # promotion thread, so grab (params, draft_params, prefix_cache,
+        # lora) together — a mid-request swap then serves entirely-old or
+        # entirely-new weights, never a torn mix
+        with self._swap_lock:
+            if not adapter_name:
+                m = req.get("model")
+                if (isinstance(m, str) and m and m != self.model_name
+                        and (self.adapters is not None
+                             or self.registry is not None)):
+                    adapter_name = m
+            params = self.params
+            draft_params = self.draft_params
+            prefix_cache = self.prefix_cache
+            lora = None
+            if self.registry is not None:
+                pass  # resolved (and pinned) per-path below
+            elif self.adapters is not None:
+                if adapter_name:
+                    if adapter_name not in self.adapters:
+                        raise RequestError(
+                            f"unknown adapter {adapter_name!r}; have "
+                            f"{sorted(self.adapters)}", status=404)
+                    lora = self.adapters[adapter_name]
+                else:
+                    lora = self._zero_lora
+            elif adapter_name:
+                raise RequestError("server has no adapters configured")
 
         # per-request top_k/top_p cannot ride the engine (its sampler is
         # one compiled program for the pool) — rather than silently
@@ -694,8 +708,8 @@ class OpenAICompatServer:
                 if self.draft_model is not None and temp == 0.0:
                     from ..speculative import speculative_generate
                     out, _spec_stats = speculative_generate(
-                        self.model, self.params, self.draft_model,
-                        self.draft_params, tok.encode(prompt),
+                        self.model, params, self.draft_model,
+                        draft_params, tok.encode(prompt),
                         max_new_tokens=int(req.get("max_tokens", 64)),
                         buf_len=self.buf_len,
                         eos_id=getattr(tok, "eos_id", None),
@@ -703,7 +717,7 @@ class OpenAICompatServer:
                         lora=lora)
                 else:
                     out = generate(
-                        self.apply_fn, self.params, tok.encode(prompt),
+                        self.apply_fn, params, tok.encode(prompt),
                         max_new_tokens=int(req.get("max_tokens", 64)),
                         temperature=temp,
                         top_k=req_top_k,
@@ -713,7 +727,7 @@ class OpenAICompatServer:
                         eos_id=getattr(tok, "eos_id", None),
                         on_token=emit if on_text else None,
                         model=self.model,
-                        prefix_cache=(self.prefix_cache
+                        prefix_cache=(prefix_cache
                                       if self._engine is None else None),
                         lora=lora)
             finally:
@@ -829,11 +843,12 @@ class OpenAICompatServer:
         if self.registry is not None:
             self.registry.register(str(name), lora_tree)
             return
-        if self.adapters is None:
-            raise ValueError("server built without adapters= — construct "
-                             "with adapters={} (or batch_slots + "
-                             "adapter_slots) to enable personalization")
-        self.adapters[str(name)] = lora_tree
+        with self._swap_lock:
+            if self.adapters is None:
+                raise ValueError("server built without adapters= — construct "
+                                 "with adapters={} (or batch_slots + "
+                                 "adapter_slots) to enable personalization")
+            self.adapters[str(name)] = lora_tree
 
     def evict_adapter(self, name: str) -> None:
         """Stop routing ``name``.  Engine mode delegates to the registry
@@ -842,9 +857,10 @@ class OpenAICompatServer:
         if self.registry is not None:
             self.registry.evict(str(name))
             return
-        if self.adapters is None or str(name) not in self.adapters:
-            raise KeyError(f"unknown adapter {name!r}")
-        del self.adapters[str(name)]
+        with self._swap_lock:
+            if self.adapters is None or str(name) not in self.adapters:
+                raise KeyError(f"unknown adapter {name!r}")
+            del self.adapters[str(name)]
 
     def update_params(self, params, draft_params=None,
                       timeout: float = 60.0) -> None:
@@ -879,11 +895,15 @@ class OpenAICompatServer:
                                            timeout=timeout)
             else:
                 self._engine.update_params(params, timeout=timeout)
-        self.params = params
-        if draft_params is not None:
-            self.draft_params = draft_params
-        if self._engine is None and self.prefix_cache is not None:
-            self.prefix_cache.clear()
+        # the engine drain above can block for seconds — only the final
+        # pointer swap runs under _swap_lock, paired with the coherent
+        # snapshot HTTP workers take at the top of _complete
+        with self._swap_lock:
+            self.params = params
+            if draft_params is not None:
+                self.draft_params = draft_params
+            if self._engine is None and self.prefix_cache is not None:
+                self.prefix_cache.clear()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:

@@ -26,10 +26,17 @@ synchronous builds instead of raising on a shut-down executor).  After a
 delivered failure the worker pool is torn down and rebuilt (counted in
 ``stats()["worker_restarts"]``) so a poisoned thread never serves the next
 speculative build.
+
+Thread discipline (ISSUE 17, fedrace): ``_pending``/``_failed`` and the
+counters are shared between the driver thread and the worker — every access
+holds ``_lock``, while the actual builds (``fut.result()``, the synchronous
+miss path) run OUTSIDE it so a slow build never blocks a concurrent
+``stats()`` scrape (metricsd) or ``close()``.
 """
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from ..obs import get_tracer
@@ -54,6 +61,7 @@ class AsyncCohortStager:
         self._depth = max(int(depth), 1)
         self._stride = max(int(stride), 1)
         self._limit = limit
+        self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=1) if enabled else None
         self._pending = {}
         self._failed = None   # first uncollected worker-thread exception
@@ -73,16 +81,18 @@ class AsyncCohortStager:
         try:
             return self._traced_build(round_idx)
         except BaseException as e:  # surfaced via _failed at the next get()
-            if self._failed is None:
-                self._failed = e
+            with self._lock:
+                if self._failed is None:
+                    self._failed = e
             raise
 
-    def _restart_pool(self):
+    def _restart_pool_locked(self):
         """Tear down and rebuild the worker after a delivered failure so a
         poisoned speculative build never serves the next round.  Every
         pending speculative future belonged to the old pool — cancel and
         drop them (the driver rebuilds those rounds synchronously) so a
-        later ``get()`` never surfaces a bare ``CancelledError``."""
+        later ``get()`` never surfaces a bare ``CancelledError``.  Caller
+        holds ``_lock``; shutdown(wait=False) never blocks under it."""
         if not self._enabled or self._closed:
             return
         for f in self._pending.values():
@@ -93,60 +103,74 @@ class AsyncCohortStager:
         self._restarts += 1
 
     def get(self, round_idx: int, prefetch=None):
-        # a pending future for an already-passed round can never be
-        # consumed — drop it so it neither leaks nor masks a failure
-        for stale in [r for r in self._pending if r < round_idx]:
-            self._pending.pop(stale).cancel()
-        fut = self._pending.pop(round_idx, None)
-        if self._failed is not None and fut is None:
-            # a speculative build (possibly for a LATER round) already
-            # failed: re-raise promptly instead of waiting until the driver
-            # reaches that round
-            err, self._failed = self._failed, None
-            for f in self._pending.values():
-                f.cancel()
-            self._pending.clear()
-            self._restart_pool()
-            raise err
+        with self._lock:
+            # a pending future for an already-passed round can never be
+            # consumed — drop it so it neither leaks nor masks a failure
+            for stale in [r for r in self._pending if r < round_idx]:
+                self._pending.pop(stale).cancel()
+            fut = self._pending.pop(round_idx, None)
+            if self._failed is not None and fut is None:
+                # a speculative build (possibly for a LATER round) already
+                # failed: re-raise promptly instead of waiting until the
+                # driver reaches that round
+                err, self._failed = self._failed, None
+                for f in self._pending.values():
+                    f.cancel()
+                self._pending.clear()
+                self._restart_pool_locked()
+                raise err
         if fut is not None:
             try:
-                staged = fut.result()
+                staged = fut.result()   # blocking wait happens off-lock
             except BaseException:
                 # this failure is being delivered right here; don't
                 # re-deliver it on the next get()
-                self._failed = None
-                self._restart_pool()
+                with self._lock:
+                    self._failed = None
+                    self._restart_pool_locked()
                 raise
-            self._hits += 1
+            hit = True
         else:
             staged = self._traced_build(round_idx)
-            self._misses += 1
-        if self._enabled and not self._closed and prefetch is not None:
-            for i in range(self._depth):
-                nxt = prefetch + i * self._stride
-                if self._limit is not None and nxt >= self._limit:
-                    break
-                if nxt not in self._pending:
-                    self._pending[nxt] = self._pool.submit(
-                        self._worker_build, nxt)
+            hit = False
+        with self._lock:
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+            if self._enabled and not self._closed and prefetch is not None:
+                for i in range(self._depth):
+                    nxt = prefetch + i * self._stride
+                    if self._limit is not None and nxt >= self._limit:
+                        break
+                    if nxt not in self._pending:
+                        self._pending[nxt] = self._pool.submit(
+                            self._worker_build, nxt)
+            depth = len(self._pending)
         tr = get_tracer()
         if tr.enabled:
-            tr.counter("staging.queue_depth", len(self._pending))
+            tr.counter("staging.queue_depth", depth)
         return staged
 
     def stats(self) -> dict:
         """Prefetch effectiveness counters: ``hits`` (served from a
         speculative worker build), ``misses`` (built synchronously in front
         of the dispatch), ``worker_restarts`` (pool rebuilds after a
-        delivered build failure), ``pending`` (builds in flight)."""
-        return {"hits": self._hits, "misses": self._misses,
-                "worker_restarts": self._restarts,
-                "pending": len(self._pending)}
+        delivered build failure), ``pending`` (builds in flight).  The
+        snapshot is taken under the worker lock so a concurrent build
+        completion never tears it (a metricsd scrape races the driver)."""
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "worker_restarts": self._restarts,
+                    "pending": len(self._pending)}
 
     def close(self):
-        if self._closed:
-            return
-        self._closed = True
-        self._pending.clear()
-        if self._pool is not None:
-            self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for f in self._pending.values():
+                f.cancel()
+            self._pending.clear()
+            if self._pool is not None:
+                self._pool.shutdown(wait=False, cancel_futures=True)

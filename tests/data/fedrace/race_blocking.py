@@ -1,0 +1,36 @@
+"""fedrace golden fixture — the blocking-under-lock family
+(docs/FEDRACE.md).
+
+Clean as committed: the worker snapshots the backlog under ``_lock`` and
+does its slow work (the ``sleep`` stands in for wire I/O) AFTER
+releasing it.  The mutation test (tests/test_fedrace.py) pulls the sleep
+inside the guarded region and the rule MUST fire.
+"""
+
+import threading
+import time
+
+
+class PacedWriter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._backlog = []
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                batch = list(self._backlog)
+                self._backlog = []
+            if batch:
+                time.sleep(0.001)
+
+    def put(self, item):
+        with self._lock:
+            self._backlog.append(item)
+
+    def close(self):
+        self._stop.set()
+        self._t.join()

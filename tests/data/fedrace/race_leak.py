@@ -1,0 +1,25 @@
+"""fedrace golden fixture — the leaked-thread family (docs/FEDRACE.md).
+
+Clean as committed: the beacon thread has a stop event and ``close()``
+joins it.  The mutation test (tests/test_fedrace.py) drops the join (the
+only cleanup path — no daemon flag, no cancel, no escape) and the rule
+MUST fire.
+"""
+
+import threading
+
+
+class Beacon:
+    def __init__(self, interval_s=0.05):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._beat)
+        self._t.start()
+
+    def _beat(self):
+        while not self._stop.wait(self.interval_s):
+            pass
+
+    def close(self):
+        self._stop.set()
+        self._t.join()

@@ -1,0 +1,31 @@
+"""fedrace golden fixture — the lock-order-cycle family
+(docs/FEDRACE.md).
+
+Clean as committed: both methods nest ``_meta`` -> ``_data`` in the same
+order, so the acquisition graph is a single consistent edge.  The
+mutation test (tests/test_fedrace.py) inverts ``flush``'s nesting and
+the rule MUST fire on the resulting two-lock cycle.
+"""
+
+import threading
+
+
+class OrderedPair:
+    def __init__(self):
+        self._meta = threading.Lock()
+        self._data = threading.Lock()
+        self._items = {}
+        self._gen = 0
+
+    def ingest(self, key, value):
+        with self._meta:
+            with self._data:
+                self._items[key] = value
+                self._gen += 1
+
+    def flush(self):
+        with self._meta:
+            with self._data:
+                out = dict(self._items)
+                self._items = {}
+        return out

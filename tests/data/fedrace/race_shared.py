@@ -1,0 +1,32 @@
+"""fedrace golden fixture — the unguarded-shared-write family
+(docs/FEDRACE.md).
+
+Clean as committed: ``_count`` is written on the worker root and read on
+the ``<caller>`` root, both under ``_lock``.  The mutation test
+(tests/test_fedrace.py) deletes the worker's ``with self._lock:`` region
+and the rule MUST fire.
+"""
+
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._count
+
+    def close(self):
+        self._stop.set()
+        self._t.join()

@@ -533,3 +533,53 @@ def test_fedproto_cli_smoke(tmp_path):
                         str(tmp_path / "missing.json")], cwd=REPO,
                        capture_output=True, text=True)
     assert r.returncode == 2
+
+
+def test_fedrace_cli_smoke(tmp_path):
+    """FEDML_RACE_QUICK smoke (ISSUE 17): the fedrace CLI contract —
+    `check --json` exits 0 with zero unsuppressed findings and the
+    extracted scopes attached, an `--update-manifest` round-trip to a
+    fresh path reproduces the committed pin's measured half, a tampered
+    manifest exits 1 naming manifest-drift, and bad usage exits 2.  Pure
+    stdlib (no jax import in the CLI)."""
+    import subprocess
+
+    cli = os.path.join(REPO, "tools", "fedrace.py")
+
+    r = subprocess.run([sys.executable, cli, "check", "--json"], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert [f for f in payload["findings"] if not f["suppressed"]] == []
+    committed = json.load(open(os.path.join(
+        REPO, "tests", "data", "fedrace", "concurrency.json")))
+    assert set(payload["scopes"]) == set(committed["scopes"])
+
+    # --update-manifest round-trip: fresh pin == committed measured half
+    fresh = str(tmp_path / "concurrency.json")
+    r = subprocess.run([sys.executable, cli, "check", "--manifest", fresh,
+                        "--update-manifest"], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = json.load(open(fresh))
+    assert got["scopes"] == committed["scopes"]
+    assert got["lock_order"] == committed["lock_order"]
+
+    # tampered pin = reviewed-diff failure (exit 1, manifest-drift named)
+    del got["scopes"]["staging.AsyncCohortStager"]["locks"]["_lock"]
+    with open(fresh, "w") as fh:
+        json.dump(got, fh)
+    r = subprocess.run([sys.executable, cli, "check", "--manifest", fresh],
+                       cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1 and "manifest-drift" in r.stdout
+
+    # usage errors exit 2; --list-rules documents every rule family
+    r = subprocess.run([sys.executable, cli], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 2
+    r = subprocess.run([sys.executable, cli, "--list-rules"], cwd=REPO,
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+    for rule in ("unguarded-shared-write", "lock-order-cycle",
+                 "blocking-under-lock", "leaked-thread"):
+        assert rule in r.stdout

@@ -1,0 +1,574 @@
+"""fedrace — the enforced host-concurrency gate (docs/FEDRACE.md).
+
+Six layers:
+
+1. extraction units — the real package's extracted surface contains the
+   constructs the extractor must model (guard inference, Condition
+   aliasing, thread/executor roots, eager spawn-cleanup resolution, the
+   package-wide acquisition graph with the stats lock innermost);
+2. the tier-1 GATE — the whole package extracts and checks clean against
+   the manifest pinned in ``tests/data/fedrace/concurrency.json`` with
+   zero unsuppressed findings (the fedlint/fedproto/fedverify pattern);
+3. mutation tests — each rule family MUST fire when its invariant is
+   broken in the matching golden fixture (drop a lock / invert an
+   acquisition / pull a sleep under the lock / drop a join);
+4. manifest mechanics — missing-pin warning, tamper → drift, and the
+   ``--update-manifest`` round-trip preserving the suppressions policy;
+5. :class:`~fedml_tpu.analysis.runtime.LockOrderAudit` units — observed
+   edges, cycle detection, RLock reentry, blocking notes, wrap/unwrap;
+6. runtime integration + regressions — the serving-load stager hammer
+   and a fedguard shutdown run under a live audit checked against the
+   SAME pin the static half enforces, plus regression tests for the
+   concurrency defects this plane's first sweep found and fixed
+   (stager stats/failure delivery, tracer scrape-vs-flush, reliable
+   close idempotency, chunking drain-then-close).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fedml_tpu.analysis import fedrace as fr
+from fedml_tpu.analysis.runtime import LockOrderAudit, _AuditedLock
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fedml_tpu")
+FIXDIR = os.path.join(REPO, "tests", "data", "fedrace")
+
+
+def _unsuppressed(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+# -- 1. extraction units (over the real package) ----------------------------
+
+@pytest.fixture(scope="module")
+def extracted():
+    return fr.extract_concurrency([PKG])
+
+
+def test_stager_scope_guards_and_roots(extracted):
+    """AsyncCohortStager: every shared counter is inferred guarded by
+    ``_lock``, and the worker pool contributes an executor root next to
+    the implicit ``<caller>`` root."""
+    scopes, _, _ = extracted
+    sc = scopes["staging.AsyncCohortStager"]
+    m = fr.scope_to_manifest(sc)
+    assert m["locks"] == {"_lock": "Lock"}
+    for attr in ("_hits", "_misses", "_pending", "_restarts", "_failed"):
+        assert m["guards"].get(attr) == ["_lock"], (attr, m["guards"])
+    assert m["roots"] == {"<caller>": "caller", "_worker_build": "executor"}
+
+
+def test_condition_aliases_to_wrapped_lock(extracted):
+    """``Condition(self._lock)`` canonicalizes to the lock it wraps, so a
+    ``with self._cv:`` region guards the same attrs as ``with
+    self._lock:`` (fedguard's whole locking scheme depends on it)."""
+    scopes, _, _ = extracted
+    sc = scopes["reliability.ReliableCommManager"]
+    assert sc.lock_aliases == {"_cv": "_lock"}
+    assert sc.canonical_lock("_cv") == "_lock"
+    assert sc.canonical_lock("_lock") == "_lock"
+    assert sc.canonical_lock("_outstanding") is None
+
+
+def test_thread_roots_and_spawn_cleanup_resolved_at_extraction(extracted):
+    """Spawn cleanup paths resolve EAGERLY in extract_concurrency — a
+    manifest written straight after extraction must serialize the same
+    cleanup sets the leaked-thread check later sees (the
+    --update-manifest self-drift regression)."""
+    scopes, _, _ = extracted
+    rel = scopes["reliability.ReliableCommManager"]
+    assert set(rel.roots) >= {"<caller>", "_heartbeat_loop",
+                              "_retransmit_loop"}
+    assert [sp.cleanup for sp in rel.spawns] == [{"daemon"}, {"daemon"}]
+    stager = scopes["staging.AsyncCohortStager"]
+    assert all(sp.cleanup == {"shutdown"} for sp in stager.spawns)
+
+
+def test_global_lock_order_acyclic_with_stats_lock_innermost(extracted):
+    """The package-wide acquisition graph has no cycle, and pins the
+    serving engine's stats lock strictly inside the batching condition
+    (the discipline the ISSUE 17 fixes established)."""
+    scopes, _, extractors = extracted
+    edges = fr.global_lock_edges(scopes, extractors)
+    assert ("ContinuousBatchingEngine._cond",
+            "ContinuousBatchingEngine._stats_lock") in edges
+    assert ("ContinuousBatchingEngine._stats_lock",
+            "ContinuousBatchingEngine._cond") not in edges
+    assert fr._find_cycles((a, b) for (a, b) in edges if a != b) == []
+
+
+# -- 2. the tier-1 gate -----------------------------------------------------
+
+def test_package_gate_zero_unsuppressed(extracted):
+    """THE gate: the whole package checks clean against the committed
+    pin — any unsuppressed finding here blocks the merge."""
+    scopes, warnings, extractors = extracted
+    manifest = fr.load_manifest()
+    assert manifest is not None, fr.DEFAULT_MANIFEST
+    findings = fr.check_concurrency(scopes, extractors, manifest,
+                                    list(warnings))
+    assert _unsuppressed(findings) == [], \
+        fr.render_findings(findings, tool="fedrace")
+
+
+def test_suppressed_surface_is_only_confined_shared_writes(extracted):
+    """Every suppression in the package is a source-line waiver of the
+    shared-write rule on engine-thread-confined state — no rule family
+    is blanket-disabled, and the pin carries no manifest-level waivers."""
+    scopes, warnings, extractors = extracted
+    manifest = fr.load_manifest()
+    assert manifest["suppressions"] == []
+    findings = fr.check_concurrency(scopes, extractors, manifest,
+                                    list(warnings))
+    sup = [f for f in findings if f.suppressed]
+    assert sup, "the gate must not pass vacuously"
+    assert {f.rule for f in sup} == {"unguarded-shared-write"}
+
+
+# -- 3. golden fixtures + mutations ----------------------------------------
+
+# fixture -> (clean substring, mutated substring, rule that MUST fire)
+MUTATIONS = {
+    "race_shared.py": (
+        "            with self._lock:\n                self._count += 1",
+        "            self._count += 1",
+        "unguarded-shared-write"),
+    "race_order.py": (
+        "    def flush(self):\n"
+        "        with self._meta:\n            with self._data:",
+        "    def flush(self):\n"
+        "        with self._data:\n            with self._meta:",
+        "lock-order-cycle"),
+    "race_blocking.py": (
+        "                self._backlog = []\n"
+        "            if batch:\n                time.sleep(0.001)",
+        "                self._backlog = []\n"
+        "                if batch:\n                    time.sleep(0.001)",
+        "blocking-under-lock"),
+    "race_leak.py": (
+        "        self._stop.set()\n        self._t.join()",
+        "        self._stop.set()",
+        "leaked-thread"),
+}
+
+
+def _fixture_src(name):
+    with open(os.path.join(FIXDIR, name)) as fh:
+        return fh.read()
+
+
+@pytest.mark.parametrize("name", sorted(MUTATIONS))
+def test_golden_fixture_clean(name):
+    findings = fr.analyze_source(_fixture_src(name), path=name)
+    assert findings == [], [(f.rule, f.line, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("name", sorted(MUTATIONS))
+def test_mutation_fires(name):
+    """Break exactly one discipline in the golden fixture — the matching
+    rule MUST fire (the checker never passes vacuously)."""
+    src = _fixture_src(name)
+    clean, mutated, rule = MUTATIONS[name]
+    assert clean in src, f"{name} drifted from its mutation anchor"
+    findings = fr.analyze_source(src.replace(clean, mutated), path=name)
+    assert rule in {f.rule for f in findings}, \
+        [(f.rule, f.message) for f in findings]
+
+
+# -- 4. manifest mechanics --------------------------------------------------
+
+def test_no_manifest_warns_exactly_once(extracted):
+    scopes, _, extractors = extracted
+    findings = fr.check_concurrency(scopes, extractors, None, [])
+    missing = [f for f in findings if f.rule == "manifest-missing"]
+    assert len(missing) == 1
+    assert missing[0].severity == fr.WARNING
+
+
+def test_tampered_manifest_reports_drift(extracted):
+    scopes, _, extractors = extracted
+    man = json.loads(json.dumps(fr.scopes_to_manifest(scopes, extractors)))
+    del man["scopes"]["staging.AsyncCohortStager"]["locks"]["_lock"]
+    man["scopes"]["ghost.Gone"] = {"locks": {}}
+    findings = fr.check_concurrency(scopes, extractors, man, [])
+    drift = [f for f in findings if f.rule == "manifest-drift"
+             and not f.suppressed]
+    msgs = "\n".join(f.message for f in drift)
+    assert "[staging.AsyncCohortStager]" in msgs
+    assert "[ghost.Gone]" in msgs and "no longer extracted" in msgs
+
+
+def test_update_manifest_preserves_suppressions(extracted, tmp_path):
+    """The fedproto/fedverify workflow: --update-manifest rewrites the
+    MEASURED half; the POLICY half (suppressions) survives verbatim, and
+    the fresh pin immediately checks clean."""
+    scopes, warnings, extractors = extracted
+    path = str(tmp_path / "concurrency.json")
+    policy = [{"scope": "legacy.*", "rule": "blocking-under-lock",
+               "reason": "kept for the round-trip test"}]
+    seeded = fr.scopes_to_manifest(scopes, extractors)
+    seeded["suppressions"] = policy
+    with open(path, "w") as fh:
+        json.dump(seeded, fh)
+    fresh = fr.update_manifest(scopes, extractors, path)
+    assert fresh["suppressions"] == policy
+    reloaded = fr.load_manifest(path)
+    assert reloaded == fresh
+    findings = fr.check_concurrency(scopes, extractors, reloaded,
+                                    list(warnings))
+    assert not [f for f in _unsuppressed(findings)
+                if f.rule.startswith("manifest")]
+
+
+def test_manifest_scope_suppressions_match_tag_and_prefix():
+    f1 = fr.Finding("blocking-under-lock", fr.ERROR, "x.py", 1, 0,
+                    "[pkg.mod.Cls] sleep under '_lock'")
+    f2 = fr.Finding("blocking-under-lock", fr.ERROR, "y.py", 1, 0,
+                    "[other.Cls] sleep under '_lock'")
+    man = {"suppressions": [{"scope": "pkg.*",
+                             "rule": "blocking-under-lock",
+                             "reason": "r"}]}
+    out = fr.apply_suppressions([f1, f2], man)
+    assert [f.suppressed for f in out] == [True, False]
+    man = {"suppressions": [{"scope": "*", "rule": "*", "reason": "r"}]}
+    f2.suppressed = False
+    assert fr.apply_suppressions([f2], man)[0].suppressed is True
+
+
+# -- 5. LockOrderAudit units ------------------------------------------------
+
+class _TwoLocks:
+    def __init__(self, kind=threading.Lock):
+        self.a = kind()
+        self.b = kind()
+
+
+def test_audit_records_nested_edge_and_subgraph():
+    obj = _TwoLocks()
+    audit = LockOrderAudit()
+    audit.wrap(obj, "a", name="T.a")
+    audit.wrap(obj, "b", name="T.b")
+    with obj.a:
+        with obj.b:
+            pass
+    audit.unwrap_all()
+    assert audit.observed_edges() == [("T.a", "T.b")]
+    assert audit.acquisitions == {"T.a": 1, "T.b": 1}
+    audit.assert_acyclic()
+    audit.assert_subgraph_of([("T.a", "T.b")])
+    with pytest.raises(AssertionError, match="missing from the static"):
+        audit.assert_subgraph_of([])
+
+
+def test_audit_detects_inverted_order_cycle():
+    obj = _TwoLocks()
+    with LockOrderAudit() as audit:
+        audit.wrap(obj, "a", name="T.a")
+        audit.wrap(obj, "b", name="T.b")
+        with obj.a:
+            with obj.b:
+                pass
+        with obj.b:
+            with obj.a:
+                pass
+    assert set(audit.observed_edges()) == {("T.a", "T.b"), ("T.b", "T.a")}
+    with pytest.raises(AssertionError, match="witnessed deadlock"):
+        audit.assert_acyclic()
+
+
+def test_audit_rlock_reentry_records_no_self_edge():
+    obj = _TwoLocks(kind=threading.RLock)
+    with LockOrderAudit() as audit:
+        audit.wrap(obj, "a", name="T.a")
+        with obj.a:
+            with obj.a:
+                pass
+    assert audit.observed_edges() == []
+    assert audit.acquisitions["T.a"] == 2
+    audit.assert_acyclic()
+
+
+def test_audit_note_blocking_only_kept_under_held_locks():
+    obj = _TwoLocks()
+    audit = LockOrderAudit()
+    audit.wrap(obj, "a", name="T.a")
+    audit.note_blocking("send")          # nothing held -> not recorded
+    assert audit.blocking == []
+    with obj.a:
+        audit.note_blocking("send")
+    audit.unwrap_all()
+    assert audit.blocking == [("send", ("T.a",))]
+    assert audit.held() == ()
+
+
+def test_audit_wrap_unwrap_restores_and_default_name():
+    obj = _TwoLocks()
+    orig = obj.a
+    audit = LockOrderAudit()
+    proxy = audit.wrap(obj, "a")
+    assert isinstance(obj.a, _AuditedLock)
+    assert proxy._name == "_TwoLocks.a"
+    assert audit.wrap(obj, "a") is proxy     # idempotent
+    assert proxy.locked() is False
+    audit.unwrap_all()
+    assert obj.a is orig
+    audit.unwrap_all()                       # idempotent
+
+
+def test_audit_condition_attrs_pass_through_proxy():
+    class _H:
+        def __init__(self):
+            self._cv = threading.Condition()
+    h = _H()
+    with LockOrderAudit() as audit:
+        audit.wrap(h, "_cv", name="H._lock")
+        fired = []
+
+        def waiter():
+            with h._cv:
+                while not fired:
+                    h._cv.wait(timeout=1.0)
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with h._cv:
+            fired.append(1)
+            h._cv.notify_all()
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+    assert audit.acquisitions["H._lock"] >= 2
+    audit.assert_acyclic()
+
+
+def test_assert_subgraph_accepts_manifest_dict_and_path(tmp_path):
+    obj = _TwoLocks()
+    with LockOrderAudit() as audit:
+        audit.wrap(obj, "a", name="Cls.a")
+        audit.wrap(obj, "b", name="Cls.b")
+        with obj.a:
+            with obj.b:
+                pass
+    man = {"lock_order": [["Cls.a", "Cls.b"]],
+           "scopes": {"m.Cls": {"order": []}}}
+    audit.assert_subgraph_of(man)
+    man2 = {"lock_order": [], "scopes": {"m.Cls": {
+        "order": [["Cls.a", "Cls.b"]]}}}
+    audit.assert_subgraph_of(man2)
+    p = tmp_path / "pin.json"
+    p.write_text(json.dumps(man))
+    audit.assert_subgraph_of(str(p))
+    with pytest.raises(AssertionError):
+        audit.assert_subgraph_of({"lock_order": [], "scopes": {}})
+
+
+# -- 6. runtime integration + defect regressions ----------------------------
+
+def test_stager_hammer_under_live_audit():
+    """Serving-load shape: a driver streams rounds while a metricsd-style
+    scraper hammers stats() and a second closer races close() — all with
+    the stager's lock audited.  The observed acquisition graph must stay
+    acyclic AND a subgraph of the committed static pin, and the counters
+    must stay coherent (each get() lands exactly one hit or miss)."""
+    from fedml_tpu.simulation.staging import AsyncCohortStager
+
+    stager = AsyncCohortStager(lambda r: r * 2, depth=2)
+    audit = LockOrderAudit()
+    audit.wrap(stager, "_lock", name="AsyncCohortStager._lock")
+    rounds = 40
+    errs = []
+    done = threading.Event()
+
+    def scraper():
+        while not done.is_set():
+            s = stager.stats()
+            if set(s) != {"hits", "misses", "worker_restarts", "pending"}:
+                errs.append(s)
+
+    th = threading.Thread(target=scraper)
+    th.start()
+    try:
+        for r in range(rounds):
+            assert stager.get(r, prefetch=r + 1) == r * 2
+    finally:
+        done.set()
+        th.join(timeout=5.0)
+        closers = [threading.Thread(target=stager.close) for _ in range(2)]
+        for c in closers:
+            c.start()
+        for c in closers:
+            c.join(timeout=5.0)
+        audit.unwrap_all()
+    assert errs == []
+    s = stager.stats()
+    assert s["hits"] + s["misses"] == rounds
+    assert s["pending"] == 0
+    assert audit.acquisitions["AsyncCohortStager._lock"] > rounds
+    audit.assert_acyclic()
+    audit.assert_subgraph_of(fr.DEFAULT_MANIFEST)
+
+
+def test_stager_failure_delivery_and_restart_regression():
+    """Regression (ISSUE 17 fix): a worker-thread build failure delivers
+    at the next get(), tears down the poisoned pool exactly once under
+    the lock, and the stager keeps serving afterwards."""
+    from fedml_tpu.simulation.staging import AsyncCohortStager
+
+    def build(r):
+        if r == 3:
+            raise RuntimeError("poisoned build")
+        return r
+
+    stager = AsyncCohortStager(build, depth=1)
+    assert stager.get(0, prefetch=1) == 0
+    assert stager.get(1, prefetch=2) == 1
+    assert stager.get(2, prefetch=3) == 2      # speculates round 3
+    with pytest.raises(RuntimeError, match="poisoned build"):
+        stager.get(3, prefetch=4)
+    s = stager.stats()
+    assert s["worker_restarts"] == 1
+    assert stager.get(4, prefetch=5) == 4      # rebuilt pool serves again
+    with pytest.raises(RuntimeError, match="poisoned build"):
+        stager.get(3)                          # sync path still raises
+    stager.close()
+    stager.close()                             # idempotent
+
+
+def test_tracer_scrape_vs_flush_hammer_regression():
+    """Regression (ISSUE 17 fix): a prometheus scrape / chrome export
+    racing live span emission and reset() never tears — the identity
+    snapshot in export_chrome is taken under the tracer lock, and the
+    final scrape still parses as prometheus text."""
+    from fedml_tpu.obs.metricsd import parse_prometheus_text
+    from fedml_tpu.obs.tracer import Tracer
+
+    tr = Tracer()
+    tr.enabled = True
+    done = threading.Event()
+    errs = []
+
+    def emitter():
+        i = 0
+        while not done.is_set():
+            with tr.span("round", cat="host", i=i):
+                tr.counter("work", float(i))
+            i += 1
+
+    def scraper():
+        while not done.is_set():
+            try:
+                text = tr.export_prometheus()
+                assert "fedtrace_span_seconds_total" in text
+                chrome = tr.export_chrome()
+                other = chrome["otherData"]
+                # reset() rotates trace_id mid-race — the contract is a
+                # coherent identity snapshot, not equality with a later
+                # read of the live tracer
+                assert len(other["trace_id"]) == 32
+                assert "origin_unix_us" in other
+                tr.summary()
+            except Exception as e:  # pragma: no cover - the regression
+                errs.append(e)
+                return
+
+    def resetter():
+        for _ in range(20):
+            time.sleep(0.002)
+            tr.reset()
+
+    threads = [threading.Thread(target=f)
+               for f in (emitter, emitter, scraper, resetter)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    done.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert errs == []
+    with tr.span("final", cat="host"):
+        pass
+    parsed = parse_prometheus_text(tr.export_prometheus())
+    assert any(name == "fedtrace_span_count"
+               for name, _labels, _v in parsed)
+
+
+def test_reliable_close_idempotent_prompt_and_audited():
+    """fedguard shutdown under a live audit (the chaos-harness shape):
+    reliable sends + a racing ack storm, then close() twice — shutdown
+    is idempotent, returns promptly even with a long heartbeat interval
+    (the beacon is woken, not slept out), cancels outstanding sends, and
+    the observed lock order stays inside the committed pin."""
+    import tests.test_reliability as rel_t
+    from fedml_tpu.core.distributed.reliability import ReliableCommManager
+    from fedml_tpu.obs import context as obs_context
+
+    wire = rel_t._Wire()
+    g = ReliableCommManager(wire, rank=1, size=2, reliable_types=[601],
+                            heartbeat_interval_s=30.0, server_rank=0)
+    g.start_heartbeats()
+    audit = LockOrderAudit()
+    # the Condition owns the raw lock, so audit the condition attribute
+    # itself under the manifest's canonical lock name
+    audit.wrap(g, "_cv", name="ReliableCommManager._lock")
+    try:
+        for i in range(8):
+            g.send_message(rel_t._msg(601, s=1, r=0, mid=f"m{i}"))
+        assert g.outstanding() == 8
+
+        def acker():
+            for i in range(0, 8, 2):
+                wire.deliver(rel_t._msg(
+                    690, s=0, r=1, mid=f"ack/m{i}",
+                    **{"fedguard.ack_of": f"m{i}"}))
+        th = threading.Thread(target=acker)
+        th.start()
+        th.join(timeout=5.0)
+        t0 = time.monotonic()
+        g.stop_receive_message(flush_s=0.05)
+        g.stop_receive_message()              # idempotent second close
+        took = time.monotonic() - t0
+    finally:
+        audit.unwrap_all()
+    assert took < 5.0, "close() must not sleep out the 30s beacon"
+    assert g.outstanding() == 0               # cancelled, not leaked
+    assert g._retx_thread is None and g._hb_thread is None
+    assert audit.acquisitions["ReliableCommManager._lock"] > 0
+    audit.assert_acyclic()
+    audit.assert_subgraph_of(fr.DEFAULT_MANIFEST)
+
+
+def test_chunking_close_drains_inner_then_drops_torn_streams():
+    """Regression (ISSUE 17 fix): ChunkingCommManager.close stops the
+    inner backend FIRST (the reliable flush window rides through), then
+    counts and drops torn reassembly buffers instead of leaking them."""
+    from fedml_tpu.core.distributed.chunking import (
+        KEY_CHUNK_DATA, KEY_CHUNK_PARENT, KEY_CHUNK_SEQ, KEY_CHUNK_TOTAL,
+        KEY_CHUNK_TYPE, MSG_TYPE_CHUNK, ChunkingCommManager)
+    import tests.test_reliability as rel_t
+
+    order = []
+
+    class _Inner(rel_t._Wire):
+        def stop_receive_message(self, *a, **kw):
+            order.append("inner-stop")
+
+    inner = _Inner()
+    mgr = ChunkingCommManager(inner, rank=0, max_chunk_bytes=8)
+    torn = rel_t._msg(MSG_TYPE_CHUNK, s=1, r=0, mid="p1/c0",
+                      **{KEY_CHUNK_PARENT: "p1", KEY_CHUNK_SEQ: 0,
+                         KEY_CHUNK_TOTAL: 2, KEY_CHUNK_TYPE: "601",
+                         KEY_CHUNK_DATA: b"half"})
+    mgr.receive_message(MSG_TYPE_CHUNK, torn)
+    assert len(mgr._partial) == 1
+    mgr.stop_receive_message(flush_s=0.0)
+    assert order == ["inner-stop"]
+    assert mgr._partial == {} and mgr._expected == {}
+    assert mgr.stats["streams_dropped"] == 1

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""fedrace CLI — lock-discipline & deadlock checker for the host
+concurrency plane (docs/FEDRACE.md).
+
+Usage:
+    python tools/fedrace.py check                        # whole package
+    python tools/fedrace.py check fedml_tpu/store
+    python tools/fedrace.py check --json
+    python tools/fedrace.py check --update-manifest      # refresh pins
+    python tools/fedrace.py --list-rules
+
+Exit codes mirror fedlint/fedproto/fedverify: 0 = no unsuppressed
+errors, 1 = at least one (or any unsuppressed finding with --strict),
+2 = usage error.
+
+Pure stdlib like ``tools/fedlint.py``: the analyzer is loaded by file
+path (fedlint first, then fedrace, which imports it), so race checking
+needs no jax install — it runs on CI lint shards and pre-commit hooks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_fedrace():
+    """Load fedlint + fedrace directly, bypassing fedml_tpu/__init__
+    (which imports jax and initializes a backend)."""
+    analysis = os.path.join(REPO, "fedml_tpu", "analysis")
+
+    def load(name, fname):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(analysis, fname))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    load("fedlint", "fedlint.py")   # fedrace's ImportError fallback name
+    return load("fedrace", "fedrace.py")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fedrace", description="lock-discipline & deadlock checker "
+        "for the host concurrency plane (shared-write guards, "
+        "acquisition-order cycles, blocking-under-lock, leaked threads)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    sub = ap.add_subparsers(dest="cmd")
+
+    chk = sub.add_parser("check", help="extract + check the package's "
+                         "concurrency surface")
+    chk.add_argument("paths", nargs="*", default=None,
+                     help="files/dirs to analyze (default: fedml_tpu/)")
+    chk.add_argument("--json", action="store_true", dest="as_json")
+    chk.add_argument("--strict", action="store_true",
+                     help="exit 1 on warnings too")
+    chk.add_argument("--show-suppressed", action="store_true")
+    chk.add_argument("--manifest", default=None,
+                     help="concurrency.json path (default: "
+                          "tests/data/fedrace/concurrency.json)")
+    chk.add_argument("--update-manifest", action="store_true",
+                     help="rewrite the manifest's extracted surface "
+                          "(suppressions are preserved); the git diff is "
+                          "the review surface")
+
+    args = ap.parse_args(argv)
+    fr = _load_fedrace()
+
+    if args.list_rules:
+        for r in fr.RACE_RULES.values():
+            print(f"{r.name:24s} [{r.severity}] {r.doc}")
+        return 0
+    if args.cmd is None:
+        ap.print_usage(sys.stderr)
+        print("fedrace: error: choose a subcommand (check)",
+              file=sys.stderr)
+        return 2
+
+    paths = args.paths or [os.path.join(REPO, "fedml_tpu")]
+    scopes, warnings, extractors = fr.extract_concurrency(paths)
+    if args.update_manifest:
+        fr.update_manifest(scopes, extractors, args.manifest)
+    manifest = fr.load_manifest(args.manifest)
+    findings = fr.check_concurrency(scopes, extractors, manifest, warnings)
+    if args.as_json:
+        print(json.dumps({
+            "findings": json.loads(fr.findings_to_json(findings)),
+            "scopes": {n: fr.scope_to_manifest(s)
+                       for n, s in sorted(scopes.items())},
+        }, indent=2, default=list))
+    else:
+        print(fr.render_findings(findings,
+                                 show_suppressed=args.show_suppressed,
+                                 tool="fedrace"))
+    return fr.exit_code(findings, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

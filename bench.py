@@ -34,6 +34,14 @@ records the LLM_SCALE row the 2-D layout unlocks: the largest model whose
 per-chip HBM estimate fits ``(4, 2)`` but exceeds one chip on the 1-D
 layout (``core/memory_estimate.py``), one json line.
 
+``python bench.py --pipeline`` compares the 2-D ``(4, 2)`` layout vs the 3-D
+``(2, 2, 2)`` ``client × stage × model`` pipeline layout (``args.mesh_shape``,
+docs/PIPELINE.md) at a fixed 8-chip count on the layer-stacked ``pipe_mlp``
+model — s/round + the three-way per-axis modeled interconnect byte split —
+and records the LLM_SCALE row the stage axis unlocks: the estimator-picked
+``(c, s, m)`` whose per-chip HBM estimate beats the best ``(c, m)`` at equal
+chips for a 98%-staged 1B model (``core/memory_estimate.py``), one json line.
+
 ``python bench.py --population`` compares a P-member hyperparameter sweep
 run as ONE vmapped-population dispatch (``args.population_axes``,
 docs/PRIMITIVES.md) against P sequential single-config runs at P in
@@ -456,10 +464,10 @@ def bench_verify() -> dict:
     analysis of what XLA compiles.  FEDML_VERIFY_QUICK=1 restricts to
     the three cheapest programs for smoke tests."""
     from fedml_tpu.analysis import fedverify as fv
+    from fedml_tpu.analysis import programs as program_registry
 
     quick = os.environ.get("FEDML_VERIFY_QUICK") == "1"
-    names = (["sp_round", "mesh1d_scatter", "serving_insert_cache"]
-             if quick else None)
+    names = program_registry.names(quick=True) if quick else None
     findings, reports = fv.verify_programs(names)
     active = [f for f in findings if not f.suppressed]
     out = {"quick": quick, "violations": len(active),
@@ -596,6 +604,148 @@ def bench_mesh2d(rounds: int | None = None,
         "mesh1d_fits": est1["total"] <= budget,
         "mesh2d_per_chip_gib": round(est2["total_gib"], 2),
         "mesh2d_fits": est2["total"] <= budget,
+    }
+    return out
+
+
+# -- 3-D pipeline benchmark (--pipeline) -------------------------------------
+def bench_pipeline(rounds: int | None = None,
+                   clients_per_round: int | None = None) -> dict:
+    """--pipeline: the 2-D ``(4, 2)`` client × model layout vs the 3-D
+    ``(2, 2, 2)`` client × stage × model pipeline layout
+    (``args.mesh_shape``, docs/PIPELINE.md) at a FIXED 8-chip count on
+    the layer-stacked ``pipe_mlp`` model: steady-state s/round plus the
+    per-axis modeled interconnect bytes each round carries in its own
+    ObsCarry record (``collective_bytes_client`` /
+    ``collective_bytes_stage`` / ``collective_bytes_model``), and
+    round-1 losses so layout parity is visible in the json line.
+    Stage-axis traffic — the microbatched ppermute ring — exists exactly
+    on the 3-D layout; the client-axis merge payload stays
+    layout-independent.
+
+    The LLM_SCALE row is the scale unlock itself: for a model that is
+    almost entirely stage-partitionable (``stage_fraction=0.98``) and
+    whose model-axis efficiency saturates at 4 shards
+    (``max_model_parallel=4``, docs/PIPELINE.md byte model), the
+    estimator scans every 8-chip ``(c, s, m)`` factorization and picks
+    the one whose per-chip HBM estimate beats the BEST 2-D ``(c, m)``
+    layout at EQUAL chips — the headroom fedverify's HBM family confirms
+    upper-bounds the real lowering (ISSUE 18 acceptance).
+    FEDML_PIPE_QUICK=1 shrinks the cohort for smoke tests."""
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.core.memory_estimate import (
+        GIB, HBM_PER_CHIP, MeshStateLayout, estimate_mesh_state_memory)
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    quick = os.environ.get("FEDML_PIPE_QUICK") == "1"
+    cpr = clients_per_round or (16 if quick else CLIENTS_PER_ROUND)
+    total = max(4 * cpr, 64) if quick else TOTAL_CLIENTS
+    timed_rounds = rounds or (2 if quick else ROUNDS_TIMED)
+    rtt = None
+    out = {"clients_per_round": cpr, "quick": quick,
+           "update_sharding": "scatter", "model": "pipe_mlp",
+           "microbatches": 5}
+
+    # microbatches only splits the batch on the pipeline layout; the 2-D
+    # run keeps the un-split batch (same per-step gradient either way —
+    # equal microbatches preserve the mean)
+    for label, shape, micro in (("mesh2d", "4,2", 1),
+                                ("mesh3d", "2,2,2", 5)):
+        args = load_arguments()
+        args.update(
+            dataset="synthetic", num_classes=NUM_CLASSES, input_shape=IMG,
+            train_size=total * BATCH * STEPS_PER_CLIENT, test_size=256,
+            model="pipe_mlp", model_dim=32, model_layers=4,
+            client_num_in_total=total,
+            client_num_per_round=cpr, comm_round=timed_rounds + 2,
+            epochs=1, batch_size=BATCH, learning_rate=0.03,
+            partition_method="homo", frequency_of_the_test=10 ** 9,
+            random_seed=0, federated_optimizer="FedOpt",
+            # same rationale as --mesh2d: toy-default server_lr saturates
+            # the synthetic task in one round; 0.03 keeps parity visible
+            server_lr=0.03,
+            update_sharding="scatter", mesh_shape=shape,
+            microbatches=micro,
+        )
+        args = fedml_tpu.init(args, should_init_logs=False)
+        dataset, out_dim = data_mod.load(args)
+        model = model_mod.create(args, out_dim)
+        api = MeshFedAvgAPI(args, None, dataset, model)
+        out[f"{label}_shape"] = [api.n_shards, api.n_stage_shards,
+                                 api.n_model_shards]
+        metrics = api.train_one_round(0)  # compile
+        # per-axis modeled bytes from the round's own ObsCarry record
+        # (trace-time static, so round 0's value is steady-state)
+        obs = metrics["obs"]
+        out[f"{label}_client_bytes_per_round"] = int(
+            np.asarray(obs.collective_bytes_client))
+        out[f"{label}_stage_bytes_per_round"] = int(
+            np.asarray(obs.collective_bytes_stage))
+        out[f"{label}_model_bytes_per_round"] = int(
+            np.asarray(obs.collective_bytes_model))
+        m2 = api.train_one_round(1)
+        out[f"{label}_round1_loss"] = round(float(
+            np.asarray(m2["train_loss"])), 6)
+        _readback(api.state.global_params)
+        if rtt is None:
+            rtt = measure_rtt()
+        rounds_done = [2]
+
+        def run_n(n):
+            for _ in range(n):
+                api.train_one_round(rounds_done[0] % args.comm_round)
+                rounds_done[0] += 1
+
+        dt = _timed_chain(run_n,
+                          lambda: _readback(api.state.global_params),
+                          min_total_s=0.5 if quick else 2.0,
+                          n0=timed_rounds, rtt=rtt)
+        out[f"{label}_s_per_round"] = round(dt, 5)
+    out["mesh3d_vs_2d_round"] = round(
+        out["mesh2d_s_per_round"] / out["mesh3d_s_per_round"], 3)
+
+    # -- LLM_SCALE row: the layout the stage axis unlocks --------------------
+    # at 1B params with a 98%-staged model and model-parallel efficiency
+    # capped at 4 shards, the best 2-D factorization can only divide the
+    # staged plane by eff_model <= 4; adding the stage axis divides it by
+    # eff_stage * eff_model, so the estimator-picked (c, s, m) lands
+    # under the best (c, m) per-chip total at the SAME 8 chips
+    chip = "v5e"
+    budget = HBM_PER_CHIP[chip]
+    est_kw = dict(clients_per_round=8, algorithm="fedopt",
+                  collective_precision="int8", param_bytes=2,
+                  stage_fraction=0.98, max_model_parallel=4)
+    n = 1.0e9
+    shapes2d = [(8, 1), (4, 2), (2, 4), (1, 8)]
+    shapes3d = [(2, 2, 2), (1, 2, 4), (1, 4, 2),
+                (2, 4, 1), (4, 2, 1), (1, 8, 1)]
+
+    def per_chip(shape):
+        return estimate_mesh_state_memory(
+            MeshStateLayout(n_params=n, mesh_shape=shape, **est_kw))
+
+    est2 = {s: per_chip(s) for s in shapes2d}
+    est3 = {s: per_chip(s) for s in shapes3d}
+    best2 = min(shapes2d, key=lambda s: (est2[s]["total"], s))
+    best3 = min(shapes3d, key=lambda s: (est3[s]["total"], s))
+    out["llm_scale"] = {
+        "chip": chip, "hbm_per_chip_gib": round(budget / GIB, 2),
+        "n_params": n,
+        "stage_fraction": est_kw["stage_fraction"],
+        "max_model_parallel": est_kw["max_model_parallel"],
+        "mesh2d_shape": list(best2),
+        "mesh3d_shape": list(best3),
+        "per_chip_gib_by_shape": {
+            "x".join(str(d) for d in s): round(e["total_gib"], 3)
+            for s, e in list(est2.items()) + list(est3.items())},
+        "mesh2d_per_chip_gib": round(est2[best2]["total_gib"], 2),
+        "mesh3d_per_chip_gib": round(est3[best3]["total_gib"], 2),
+        "mesh2d_fits": est2[best2]["total"] <= budget,
+        "mesh3d_fits": est3[best3]["total"] <= budget,
+        "mesh3d_vs_2d_per_chip": round(
+            est3[best3]["total"] / est2[best2]["total"], 4),
     }
     return out
 
@@ -2382,6 +2532,25 @@ def main():
             "value": result["mesh2d_s_per_round"],
             "unit": "s/round",
             "vs_baseline": result["mesh2d_vs_1d_round"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--pipeline" in sys.argv:
+        # fixed 8-chip count for the 2-D (4,2) vs 3-D (2,2,2) pipeline
+        # comparison; force 8 virtual host devices like --agg/--mesh2d
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        info = _platform_info(measure_peak=False)
+        result = bench_pipeline()
+        result.update({
+            "metric": "mesh3d_pipeline_layout",
+            "value": result["mesh3d_s_per_round"],
+            "unit": "s/round",
+            "vs_baseline": result["mesh3d_vs_2d_round"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -188,6 +188,7 @@ _TRACE_WRAPPERS = _JIT_WRAPPERS | {
 _TRACE_MARKERS = {
     "scan", "while_loop", "fori_loop", "cond", "switch", "psum", "pmean",
     "pmax", "pmin", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "collective_permute",
     "pshuffle", "axis_index", "axis_size", "vmap", "grad", "value_and_grad",
     "stop_gradient", "dynamic_slice", "dynamic_update_slice", "select",
     "associative_scan",
@@ -197,6 +198,10 @@ _COLLECTIVES_AXIS_POS = {
     # call -> positional index of the axis-name argument
     "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
     "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    # the stage-ring activation mover of the 3-D pipeline layout
+    # (docs/PIPELINE.md); ``collective_permute`` is the wrapper alias
+    # some call sites use for the same primitive
+    "collective_permute": 1,
     "axis_index": 0, "axis_size": 0,
 }
 
@@ -880,7 +885,7 @@ def check_rng_key_reuse(mv: ModuleView, out: List[Finding]):
 #: take no payload) — targets of the fp32-upcast sub-check
 _COLLECTIVES_WITH_PAYLOAD = {
     "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
-    "all_to_all", "ppermute", "pshuffle",
+    "all_to_all", "ppermute", "pshuffle", "collective_permute",
 }
 
 _F32_NAMES = {"float32", "f32"}

@@ -62,6 +62,7 @@ import os
 import re
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from . import programs as registry
 from .fedlint import (ERROR, WARNING, Finding, Rule, exit_code,  # noqa: F401
                       findings_to_json, render_findings)
 
@@ -103,7 +104,7 @@ VERIFY_RULES: Dict[str, Rule] = {
 }
 
 #: mesh-axis buckets census ops classify into
-AXES = ("client", "model", "world", "none")
+AXES = ("client", "stage", "model", "world", "none")
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
@@ -198,25 +199,33 @@ def _parse_replica_groups(text: str) -> List[List[int]]:
 
 
 def classify_groups(groups: Sequence[Sequence[int]],
-                    mesh_shape: Tuple[int, int]) -> str:
+                    mesh_shape: Tuple[int, ...]) -> str:
     """Which mesh axis a collective's device groups span.
 
-    Device ids follow the canonical 4-axis mesh layout
-    (``core.mesh.make_mesh``) with data/seq pinned to 1, so
-    ``id = client_coord * n_model_shards + model_coord``."""
-    c, m = int(mesh_shape[0]), int(mesh_shape[1])
+    Device ids follow the canonical mesh layout (``core.mesh.make_mesh``)
+    with data/seq pinned to 1: on the 2-D ``(c, m)`` layout
+    ``id = client_coord * m + model_coord``; on the 3-D pipeline layout
+    ``(c, s, m)`` it is ``(client_coord * s + stage_coord) * m +
+    model_coord`` (docs/PIPELINE.md) — so a stage-ring
+    ``collective-permute``'s pairs vary only the middle coordinate."""
+    dims = tuple(int(d) for d in mesh_shape)
+    names = (("client", "model") if len(dims) == 2
+             else ("client", "stage", "model"))
     axes: Set[str] = set()
     for g in groups:
         if len(g) <= 1:
             continue
-        cs = {d // m for d in g}
-        ms = {d % m for d in g}
-        if len(cs) > 1 and len(ms) > 1:
+        varying: Set[str] = set()
+        inner = 1
+        for i in range(len(dims) - 1, -1, -1):
+            coords = {(d // inner) % dims[i] for d in g}
+            if len(coords) > 1:
+                varying.add(names[i])
+            inner *= dims[i]
+        if len(varying) > 1:
             axes.add("world")
-        elif len(cs) > 1:
-            axes.add("client")
-        elif len(ms) > 1:
-            axes.add("model")
+        elif varying:
+            axes.add(varying.pop())
     if not axes:
         return "none"
     if len(axes) == 1:
@@ -524,7 +533,7 @@ def run_checks(report: ProgramReport, entry: Optional[Dict[str, Any]],
         # 2b. ObsCarry byte-model cross-check ------------------------------
         band = entry.get("model_ratio_band", list(DEFAULT_RATIO_BAND))
         lo, hi = float(band[0]), float(band[1])
-        for axis in ("client", "model"):
+        for axis in ("client", "stage", "model"):
             modeled = float(report.modeled_bytes.get(axis, 0.0))
             actual = got_b.get(axis, 0.0)
             if modeled <= 0.0:
@@ -888,6 +897,21 @@ def _data_plane_bytes(args_tuple, state) -> float:
     return total
 
 
+def _stage_fraction(api) -> float:
+    """Fraction of the params living in the staged leaves (the layer-
+    stacked chunks that shard over ``stage`` — docs/PIPELINE.md)."""
+    import jax
+    params = api.state.global_params
+    staged = set(api.trainer.pipe.stage_leaves)
+    total = sta = 0
+    for name, sub in params.items():
+        n = sum(int(l.size) for l in jax.tree_util.tree_leaves(sub))
+        total += n
+        if name in staged:
+            sta += n
+    return sta / max(1, total)
+
+
 def _mesh_round_estimate(api, args_tuple, members: int = 1,
                          steps: int = 1, rounds_fused: int = 1) -> float:
     """Upper-bound per-chip footprint from core/memory_estimate.py plus
@@ -897,13 +921,16 @@ def _mesh_round_estimate(api, args_tuple, members: int = 1,
                                         estimate_round_footprint)
     c = int(getattr(api, "n_shards", 1))
     m = int(getattr(api, "n_model_shards", 1))
+    s = int(getattr(api, "n_stage_shards", 1))
     n_params = tree_util.num_params(
         api.state.global_params) // max(1, members)
+    shape = (c, s, m) if s > 1 else (c, m)
     lo = MeshStateLayout(
-        n_params=n_params, mesh_shape=(c, m),
+        n_params=n_params, mesh_shape=shape,
         clients_per_round=api.clients_per_round,
         algorithm=api.server_opt.algorithm,
-        collective_precision=api.collective_precision)
+        collective_precision=api.collective_precision,
+        stage_fraction=_stage_fraction(api) if s > 1 else 1.0)
     cohort_bytes = _cohort_work_bytes(api, steps)
     data_bytes = _data_plane_bytes(args_tuple, api.state)
     return estimate_round_footprint(
@@ -922,7 +949,7 @@ def _cohort_work_bytes(api, steps: int) -> float:
                  * (feat + 1) * 4)
 
 
-def _modeled_round_bytes(api) -> Dict[str, float]:
+def _modeled_round_bytes(api, steps: int = 1) -> Dict[str, float]:
     """The ObsCarry collective_bytes model for one mesh round — computed
     exactly the way ``mesh/engine.py::_bytes_model`` does."""
     from ..core import tree as tree_util
@@ -935,17 +962,27 @@ def _modeled_round_bytes(api) -> Dict[str, float]:
         n_flat = tree_util.num_params(api.state.global_params)
     mode = "scatter" if scatter else "replicated"
     m = api.n_model_shards
-    n_payload = n_flat if scatter else -(-n_flat // m)
+    s = int(getattr(api, "n_stage_shards", 1))
+    n_payload = n_flat if scatter else -(-n_flat // (m * s))
     cbytes = coll.client_axis_bytes(n_payload, api.n_shards,
                                     api.collective_precision,
                                     api.quant_block, mode)
     mbytes = coll.model_axis_bytes(n_flat, m, mode=mode)
-    return {"client": float(cbytes), "model": float(mbytes)}
+    out = {"client": float(cbytes), "model": float(mbytes)}
+    if s > 1:
+        tr = api.trainer
+        out["stage"] = float(coll.stage_axis_bytes(
+            n_flat, s, mode=mode, hidden=tr.hidden,
+            microbatch=api.batch_size // tr.n_micro,
+            n_micro=tr.n_micro, steps=steps))
+    return out
 
 
 def _build_sp(name: str, **over) -> ProgramReport:
     api = _make_api(_canonical_args(backend="sp", **over))
-    fn, args, donate = api.round_program(0)
+    progs = {kind: (fn, args, donate)
+             for kind, fn, args, donate in api.lowerable_programs()}
+    fn, args, donate = progs["round"]
     sigs = [api.round_signature(r) for r in range(SIGNATURE_ROUNDS)]
     members = api.population.size if api.population else 1
     est = _mesh_round_estimate(api, args, members=members,
@@ -954,12 +991,14 @@ def _build_sp(name: str, **over) -> ProgramReport:
                          estimate_bytes=est, signatures=sigs)
 
 
+@registry.register("sp_round", "sp", "round", quick=True)
 def build_sp_round() -> ProgramReport:
     """Single-process round: the reference program every mesh layout must
     match (vmap clients, gather cohort)."""
     return _build_sp("sp_round")
 
 
+@registry.register("population_p4", "sp", "round")
 def build_population_p4() -> ProgramReport:
     """P=4 experiment population vmapped over the sp round — one
     dispatch, member-stacked state (docs/PRIMITIVES.md)."""
@@ -976,6 +1015,7 @@ def _make_async_api():
     return FedBuffAPI(args, dev, dataset, model)
 
 
+@registry.register("async_dispatch", "async", "dispatch")
 def build_async_dispatch() -> ProgramReport:
     """The buffered-async engine's generation dispatch (docs/ASYNC.md):
     client phase + per-client unreduced aggregate rows, staged exactly
@@ -989,6 +1029,7 @@ def build_async_dispatch() -> ProgramReport:
                          signatures=sigs)
 
 
+@registry.register("async_buffer_apply", "async", "buffer")
 def build_async_apply() -> ProgramReport:
     """The buffered-async engine's buffer apply: finish the size-K row
     buffer (occupancy/staleness as traced data) + server transition,
@@ -1002,26 +1043,26 @@ def build_async_apply() -> ProgramReport:
 
 def _build_mesh(name: str, mesh_shape: str, update_sharding: str,
                 alg: str = "FedAvg", block: int = 1,
-                precision: str = "fp32") -> ProgramReport:
+                precision: str = "fp32", **over) -> ProgramReport:
     api = _make_api(_canonical_args(
         backend="mesh", mesh_shape=mesh_shape,
         update_sharding=update_sharding, federated_optimizer=alg,
-        collective_precision=precision, round_block=block))
+        collective_precision=precision, round_block=block, **over))
     scatter = api.update_sharding == "scatter"
     quantized = api.collective_precision != "fp32"
+    progs = {kind: (fn, args, donate)
+             for kind, fn, args, donate in api.lowerable_programs()}
+    expected = {0: api.layout.state_sharding(api.state, scatter,
+                                             quantized)}
     if block > 1:
-        fn, args, donate = api.block_program(0)
-        expected = {0: api.layout.state_sharding(api.state, scatter,
-                                                 quantized)}
+        fn, args, donate = progs["block"]
         if api.client_table is not None:
             expected[2] = api.layout.table_sharding(api.client_table)
         sigs = [api.block_signature(s)
                 for s in range(0, api.comm_rounds, block)]
         steps = int(args[1].shape[2])
     else:
-        fn, args, donate = api.round_program(0)
-        expected = {0: api.layout.state_sharding(api.state, scatter,
-                                                 quantized)}
+        fn, args, donate = progs["round"]
         sigs = [api.round_signature(r) for r in range(SIGNATURE_ROUNDS)]
         steps = int(args[1].shape[1])
     est = _mesh_round_estimate(api, args, steps=steps,
@@ -1029,42 +1070,74 @@ def _build_mesh(name: str, mesh_shape: str, update_sharding: str,
     # a fused block's census covers K rounds' collectives; scale the
     # per-round ObsCarry model to match
     modeled = {k: v * max(1, block)
-               for k, v in _modeled_round_bytes(api).items()}
+               for k, v in _modeled_round_bytes(api, steps=steps).items()}
+    s = int(getattr(api, "n_stage_shards", 1))
+    shape = ((api.n_shards, s, api.n_model_shards) if s > 1
+             else (api.n_shards, api.n_model_shards))
     return lower_program(
-        name, fn, args, donate,
-        mesh_shape=(api.n_shards, api.n_model_shards),
+        name, fn, args, donate, mesh_shape=shape,
         expected_out=expected, modeled_bytes=modeled,
         estimate_bytes=est, signatures=sigs)
 
 
+@registry.register("mesh1d_replicated", "mesh", "round")
 def build_mesh1d_replicated() -> ProgramReport:
     """8-shard 1-D mesh, replicated merge (per-leaf psum all-reduce)."""
     return _build_mesh("mesh1d_replicated", "8,1", "replicated")
 
 
+@registry.register("mesh1d_scatter", "mesh", "round", quick=True)
 def build_mesh1d_scatter() -> ProgramReport:
     """8-shard 1-D mesh, reduce-scatter merge + shard-resident FedOpt
     moments (the arXiv:2004.13336 cross-replica layout)."""
     return _build_mesh("mesh1d_scatter", "8,1", "scatter", alg="FedOpt")
 
 
+@registry.register("mesh2d_replicated", "mesh", "round")
 def build_mesh2d_replicated() -> ProgramReport:
     """(4,2) client x model mesh, replicated merge — the GSPMD partial-
     auto shard_map layout (docs/MESH_2D.md)."""
     return _build_mesh("mesh2d_replicated", "4,2", "replicated")
 
 
+@registry.register("mesh2d_scatter", "mesh", "round")
 def build_mesh2d_scatter() -> ProgramReport:
     """(4,2) client x model mesh, scatter merge: flat server state over
     BOTH axes — the layout the PR 6 re-replication bug hit."""
     return _build_mesh("mesh2d_scatter", "4,2", "scatter", alg="FedOpt")
 
 
+@registry.register("mesh_block8", "mesh", "block")
 def build_mesh_block8() -> ProgramReport:
     """Fused round_block=8 scan on the 8-shard scatter mesh with the
     SCAFFOLD client table threading the donated carry."""
     return _build_mesh("mesh_block8", "8,1", "scatter", alg="SCAFFOLD",
                        block=8)
+
+
+#: 3-D pipeline canonical config (docs/PIPELINE.md): pipe_mlp's stacked
+#: blocks split 4 layers over s=2 stages, rows over m=2; microbatches=2
+_PIPE_OVER = dict(model="pipe_mlp", model_dim=16, model_layers=4,
+                  microbatches=2)
+
+
+@registry.register("mesh3d_scatter", "mesh", "round")
+def build_mesh3d_scatter() -> ProgramReport:
+    """(2,2,2) client x stage x model pipeline mesh, scatter merge +
+    FedOpt moments over c*s*m: the microbatched-pipeline train phase
+    (stage-ring collective-permutes) feeding the byte-identical client
+    merge (docs/PIPELINE.md)."""
+    return _build_mesh("mesh3d_scatter", "2,2,2", "scatter", alg="FedOpt",
+                       **_PIPE_OVER)
+
+
+@registry.register("mesh3d_block8", "mesh", "block")
+def build_mesh3d_block8() -> ProgramReport:
+    """Fused round_block=8 scan on the (2,2,2) pipeline mesh with the
+    SCAFFOLD client table — the fully-manual pipeline shard_map under the
+    fused scan (docs/PIPELINE.md, docs/ROUND_FUSION.md)."""
+    return _build_mesh("mesh3d_block8", "2,2,2", "scatter", alg="SCAFFOLD",
+                       block=8, **_PIPE_OVER)
 
 
 def _serving_engine():
@@ -1109,33 +1182,25 @@ def _build_serving(which: str) -> ProgramReport:
         eng.stop()
 
 
+@registry.register("serving_decode_step", "serving", "step")
 def build_serving_step() -> ProgramReport:
     """The continuous-batching engine's batched decode step (vmapped
     KV-cache decode over all slots, horizon-scanned)."""
     return _build_serving("decode_step")
 
 
+@registry.register("serving_insert_cache", "serving", "step", quick=True)
 def build_serving_insert() -> ProgramReport:
     """The engine's donated cache-insert (admission writes one slot's KV
     into the stacked cache in place)."""
     return _build_serving("insert_cache")
 
 
-#: name -> builder; the canonical verification surface.  Ordering is the
-#: report order everywhere (CLI, manifest, bench --verify).
-PROGRAMS = {
-    "sp_round": build_sp_round,
-    "mesh1d_replicated": build_mesh1d_replicated,
-    "mesh1d_scatter": build_mesh1d_scatter,
-    "mesh2d_replicated": build_mesh2d_replicated,
-    "mesh2d_scatter": build_mesh2d_scatter,
-    "mesh_block8": build_mesh_block8,
-    "population_p4": build_population_p4,
-    "async_dispatch": build_async_dispatch,
-    "async_buffer_apply": build_async_apply,
-    "serving_decode_step": build_serving_step,
-    "serving_insert_cache": build_serving_insert,
-}
+#: name -> builder; the canonical verification surface, derived from the
+#: first-class Program registry (``analysis/programs.py``, ISSUE 18) —
+#: registration order is the report order everywhere (CLI, manifest,
+#: bench --verify).
+PROGRAMS = {p.name: p.build for p in registry.registered()}
 
 
 def verify_programs(names: Optional[Sequence[str]] = None,

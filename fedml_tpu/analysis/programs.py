@@ -1,0 +1,110 @@
+"""programs — the first-class registry of lowerable staged programs.
+
+Before ISSUE 18, the canonical verification surface was an ad-hoc dict of
+11 builder functions private to ``fedverify``, ``bench.py --verify``
+hard-coded its quick subset, and each engine exposed
+``round_program``/``block_program`` hooks that every caller had to know
+about individually.  This module is the ONE list all three iterate:
+
+- **fedverify** registers each canonical builder here (the
+  ``@register`` decorator) and derives its ``PROGRAMS`` mapping from
+  :func:`registered` — adding a program (e.g. the 3-D pipeline round) is
+  a registration, not a 12th parallel edit.
+- **bench.py --verify** asks the registry for names (all, or the
+  ``quick`` subset flagged at registration).
+- **engines** (``FedAvgAPI`` / ``MeshFedAvgAPI``) expose their lowerable
+  surface through :func:`lowerable`, which walks :data:`ENGINE_HOOKS` —
+  one list of hook names instead of per-caller knowledge of which
+  methods exist (docs/FEDVERIFY.md, "How to add a program").
+
+The registry holds NAMES and metadata only; builders import jax/engines
+lazily when called, so importing this module (or fedverify's pure-stdlib
+parsing half) stays dependency-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Program:
+    """One registered lowerable program: a named, canonical
+    ``(jit_fn, staged args, donate_argnums)`` family that AOT-lowers on
+    abstract shapes.  ``build()`` returns the
+    :class:`~.fedverify.ProgramReport` the contract checks consume."""
+    name: str
+    family: str                  # "sp" | "mesh" | "async" | "serving"
+    kind: str                    # "round" | "block" | "dispatch" | "step"
+    description: str
+    build: Callable[[], Any]
+    quick: bool = False          # part of the FEDML_VERIFY_QUICK subset
+
+
+_REGISTRY: Dict[str, Program] = {}
+
+
+def register(name: str, family: str, kind: str, quick: bool = False):
+    """Decorator: register a ProgramReport builder under ``name``.
+    Registration order is the canonical report order everywhere (CLI,
+    manifest, ``bench --verify``)."""
+    def deco(fn):
+        _REGISTRY[name] = Program(
+            name=name, family=family, kind=kind,
+            description=" ".join((fn.__doc__ or "").split()),
+            build=fn, quick=quick)
+        return fn
+    return deco
+
+
+def registered() -> Tuple[Program, ...]:
+    """Every registered program, in registration order."""
+    return tuple(_REGISTRY.values())
+
+
+def names(quick: bool = False) -> List[str]:
+    return [p.name for p in _REGISTRY.values() if p.quick or not quick]
+
+
+def get(name: str) -> Program:
+    return _REGISTRY[name]
+
+
+#: engine methods producing a lowerable ``(fn, args, donate)`` triple —
+#: the single list :func:`lowerable` walks.  ``block_program`` only
+#: applies when the config actually fuses rounds.
+ENGINE_HOOKS: Tuple[Tuple[str, str], ...] = (
+    ("round", "round_program"),
+    ("block", "block_program"),
+    ("dispatch", "dispatch_program"),
+    ("buffer", "buffer_program"),
+)
+
+
+def lowerable(api) -> List[Tuple[str, Any, tuple, tuple]]:
+    """The engine side of the registry: every ``(kind, fn, args,
+    donate)`` this engine instance can stage at its current config.
+    Engines expose it as ``lowerable_programs()``; fedverify's builders
+    and any future driver iterate THIS instead of knowing hook names."""
+    out = []
+    for kind, hook in ENGINE_HOOKS:
+        if not hasattr(api, hook):
+            continue
+        if kind == "block" and int(
+                getattr(api, "_round_block", None)
+                or getattr(api, "round_block", 1) or 1) <= 1:
+            continue
+        try:
+            fn, args, donate = getattr(api, hook)()
+        except (NotImplementedError, AttributeError):
+            # the hook exists (e.g. inherited) but this config can't
+            # stage it — bucketed cohorts, host-resident data, or an
+            # async engine that round-trips through dispatch instead
+            continue
+        out.append((kind, fn, args, donate))
+    return out
+
+
+__all__ = ["Program", "register", "registered", "names", "get",
+           "lowerable", "ENGINE_HOOKS"]

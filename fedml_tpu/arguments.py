@@ -121,12 +121,20 @@ _DEFAULTS: Dict[str, Any] = dict(
     enable_tracking=False,
     # tpu_args
     mesh_client=-1,
+    mesh_stage=1,
     mesh_data=1,
     mesh_model=1,
     mesh_seq=1,
-    # 2-D (n_client_shards, n_model_shards) mesh (docs/MESH_2D.md): a
-    # 2-tuple/"c,m" string; wins over the per-axis mesh_* knobs when set
+    # 2-D (n_client_shards, n_model_shards) mesh (docs/MESH_2D.md) or 3-D
+    # (n_client_shards, n_stage_shards, n_model_shards) pipeline mesh
+    # (docs/PIPELINE.md): a tuple / "c,m" / "c,s,m" string; wins over the
+    # per-axis mesh_* knobs when set
     mesh_shape=None,
+    # microbatches per local SGD step on the 3-D pipeline layout: the
+    # batch splits into this many equal microbatches flowing through the
+    # stage ring (bubble fraction (s-1)/(microbatches+s-1)); must divide
+    # batch_size.  Ignored off the pipeline layout.
+    microbatches=1,
     # server-update layout on the mesh: replicated | scatter | auto
     # (auto = scatter whenever the client axis has > 1 shard)
     update_sharding="auto",
@@ -308,6 +316,48 @@ def validate_args(args) -> None:
                 f"{' + '.join(bad)} — the buffered-async driver applies "
                 "the update buffer event-by-event on the sp engine "
                 "(docs/ASYNC.md)")
+    # 3-D pipeline layout (docs/PIPELINE.md): a stage factor > 1 — from a
+    # 3-tuple mesh_shape or the mesh_stage knob — is lockstep-cohort only
+    # and needs a loss with no global-parameter-norm terms
+    shape = getattr(args, "mesh_shape", None)
+    stages = 1
+    if shape is not None:
+        from .core.mesh import parse_mesh_shape
+        parsed = parse_mesh_shape(shape)
+        if parsed is not None and len(parsed) == 3:
+            stages = int(parsed[1])
+    stages = max(stages, int(getattr(args, "mesh_stage", 1) or 1))
+    if stages > 1:
+        src = ("mesh_shape" if shape is not None else "mesh_stage")
+        bad = [flag for flag, on in (
+            ("population", int(getattr(args, "population", 0) or 0) > 1
+             or bool(getattr(args, "population_axes", None))),
+            ("federated_optimizer=fedbuff", alg == "fedbuff"),
+            ("cohort_bucketing",
+             bool(getattr(args, "cohort_bucketing", False))),
+        ) if on]
+        if bad:
+            raise ValueError(
+                f"incompatible flags: {src} with n_stage_shards={stages} + "
+                f"{' + '.join(bad)} — the pipeline train phase is one "
+                "fully-manual fixed-shape shard_map over (client, stage, "
+                "model); population vmap, buffered-async applies and "
+                "data-dependent bucket shapes cannot ride it "
+                "(docs/PIPELINE.md)")
+        if alg in ("fedprox", "feddyn"):
+            raise ValueError(
+                f"incompatible flags: {src} with n_stage_shards={stages} + "
+                f"federated_optimizer={alg} — its loss adds a global "
+                "parameter-norm regularizer, which does not decompose "
+                "over stage/model shards (docs/PIPELINE.md, Limits)")
+        micro = int(getattr(args, "microbatches", 1) or 1)
+        bsz = int(getattr(args, "batch_size", 10) or 10)
+        if micro < 1 or bsz % micro:
+            raise ValueError(
+                f"incompatible flags: microbatches={micro} must be >= 1 "
+                f"and divide batch_size={bsz} — equal microbatches keep "
+                "the pipelined loss exactly the full-batch mean "
+                "(docs/PIPELINE.md)")
     wp = str(getattr(args, "wire_precision", "off") or "off").lower()
     if wp not in ("off", "fp32", "bf16", "int8"):
         raise ValueError(

@@ -140,7 +140,20 @@ class MeshStateLayout:
     (docs/MESH_2D.md): the broadcast params copy, the shard-resident flat
     server state, the quantized-collective buffers, and the vmapped
     cohort's per-client params copies.  ``mesh_shape`` is
-    ``(n_client_shards, n_model_shards)`` — ``args.mesh_shape``."""
+    ``(n_client_shards, n_model_shards)`` or the 3-D pipeline form
+    ``(n_client_shards, n_stage_shards, n_model_shards)`` —
+    ``args.mesh_shape`` (docs/PIPELINE.md).
+
+    The ``max_*_parallel`` bounds encode the model's DIVISIBILITY
+    ceilings, mirroring ``MeshLayout.param_spec``'s guard (a leaf only
+    shards a dim the shard count divides): ``max_model_parallel`` is the
+    largest useful ``model`` factor (≈ the hidden width — beyond it,
+    extra model shards hold replicated leaf copies and stop reducing the
+    params plane) and ``max_stage_parallel`` the largest useful ``stage``
+    factor (the stacked layer depth).  0 = unbounded (the historical 2-D
+    behavior).  ``stage_fraction`` is the fraction of ``n_params`` living
+    in the staged leaves on the 3-D layout (embed/head replicate over
+    stage AND model — docs/PIPELINE.md); ignored when ``s == 1``."""
     n_params: float
     mesh_shape: tuple = (8, 1)
     clients_per_round: int = 8
@@ -148,14 +161,34 @@ class MeshStateLayout:
     collective_precision: str = "fp32"
     param_bytes: int = 4         # f32 params (the LR/MLP zoo); LLMs pass 2
     safety: float = 1.25
+    stage_fraction: float = 1.0
+    max_model_parallel: int = 0
+    max_stage_parallel: int = 0
 
     @property
     def n_client_shards(self) -> int:
         return int(self.mesh_shape[0])
 
     @property
+    def n_stage_shards(self) -> int:
+        return int(self.mesh_shape[1]) if len(self.mesh_shape) == 3 else 1
+
+    @property
     def n_model_shards(self) -> int:
-        return int(self.mesh_shape[1])
+        return int(self.mesh_shape[-1])
+
+    @property
+    def eff_model(self) -> int:
+        """Model factor actually reducing per-leaf bytes (divisibility)."""
+        m = self.n_model_shards
+        return min(m, self.max_model_parallel) if self.max_model_parallel \
+            else m
+
+    @property
+    def eff_stage(self) -> int:
+        s = self.n_stage_shards
+        return min(s, self.max_stage_parallel) if self.max_stage_parallel \
+            else s
 
 
 def estimate_mesh_state_memory(lo: MeshStateLayout) -> Dict[str, float]:
@@ -168,26 +201,42 @@ def estimate_mesh_state_memory(lo: MeshStateLayout) -> Dict[str, float]:
     chunk over BOTH axes (each chip owns ``1/(c*m)``), and the per-shard
     EF rows because their columns shard over ``model``.  On the 1-D layout
     (``m == 1``) params replicate and one client's model must fit in one
-    chip's HBM — the ceiling this estimator makes visible."""
-    c, m = lo.n_client_shards, lo.n_model_shards
-    flat = -(-int(lo.n_params) // (c * m)) * (c * m)   # padded flat length
+    chip's HBM — the ceiling this estimator makes visible.
+
+    On the 3-D pipeline layout (``mesh_shape`` a 3-tuple with a stage
+    factor, docs/PIPELINE.md) the STAGED fraction of the params/cohort
+    plane divides by the effective ``stage × model`` product (layer
+    chunks over ``stage``, rows over ``model``) while the non-staged
+    remainder (embed/head) replicates over both; flat aux vectors chunk
+    over all three axes with no divisibility ceiling (they pad)."""
+    c, s, m = lo.n_client_shards, lo.n_stage_shards, lo.n_model_shards
+    flat = -(-int(lo.n_params) // (c * s * m)) * (c * s * m)  # padded flat
     quantized = lo.collective_precision != "fp32"
+    if s > 1:
+        # staged leaves divide by the EFFECTIVE s*m (divisibility-bounded);
+        # embed/head replicate over stage and model
+        sf = min(max(float(lo.stage_fraction), 0.0), 1.0)
+        leaf_div = 1.0 / (sf / (lo.eff_stage * lo.eff_model) + (1.0 - sf))
+    else:
+        # historical 2-D rule: matrix leaves shard one dim over ``model``
+        leaf_div = float(lo.eff_model)
     # broadcast params copy the clients train from: replicated on 1-D,
-    # matrix leaves sharded over ``model`` on 2-D
-    params = lo.n_params * lo.param_bytes / m
-    # scatter-mode flat aux state, f32, each chip owns 1/(c*m)
+    # leaf-sharded per the model (and stage) rules otherwise
+    params = lo.n_params * lo.param_bytes / leaf_div
+    # scatter-mode flat aux state, f32, each chip owns 1/(c*s*m)
     n_flat_slots = OPT_FLAT_SLOTS.get(lo.algorithm.lower(), 2)
     if quantized:
         n_flat_slots += 2            # master_flat + ef_bcast
-    opt_state = n_flat_slots * 4.0 * flat / (c * m)
-    # per-shard EF rows: one (flat,) row per client shard, columns over m
-    ef_rows = (4.0 * flat / m) if quantized else 0.0
+    opt_state = n_flat_slots * 4.0 * flat / (c * s * m)
+    # per-shard EF rows: one (flat,) row per client shard, columns over
+    # the stage/model axes
+    ef_rows = (4.0 * flat / (s * m)) if quantized else 0.0
     # vmapped cohort: each client shard trains its cohort slice, and every
-    # live client's params/update copy (outs.params) shards over ``model``
+    # live client's params/update copy (outs.params) follows the leaf rules
     clients_per_shard = -(-lo.clients_per_round // c)
-    cohort = clients_per_shard * lo.n_params * 4.0 / m
+    cohort = clients_per_shard * lo.n_params * 4.0 / leaf_div
     # merge scratch: the flat numerator + one reduce-scattered chunk
-    scratch = 4.0 * flat / m + 4.0 * flat / (c * m)
+    scratch = 4.0 * flat / (s * m) + 4.0 * flat / (c * s * m)
     total = (params + opt_state + ef_rows + cohort + scratch) * lo.safety
     return {
         "params_bcast": params,

@@ -8,6 +8,10 @@ named ``jax.sharding.Mesh`` and each FedML parallelism strategy is an axis:
 - ``client`` — federated data parallelism: simulated clients sharded across
   chips (replaces `simulation/nccl` per-GPU local aggregators and the MPI
   rank-per-client layout).
+- ``stage``  — pipeline (MPMD) parallelism: layer-partitioned client models
+  with microbatched forward/backward and ``collective_permute`` moving
+  activations between adjacent stage shards (arXiv:2412.14374; absent from
+  the reference — one client's model exceeds tensor-parallel reach).
 - ``data``   — intra-silo data parallelism (replaces torch DDP,
   ``cross_silo/client/process_group_manager.py:28``).
 - ``model``  — tensor/FSDP-style parameter sharding (replaces the DeepSpeed
@@ -15,8 +19,13 @@ named ``jax.sharding.Mesh`` and each FedML parallelism strategy is an axis:
 - ``seq``    — sequence/context parallelism for long-context LLM training
   (ring attention; absent from the reference, demanded by the TPU target).
 
-Axes of size 1 are free, so a single canonical 4-axis mesh covers every
+Axes of size 1 are free, so a single canonical 5-axis mesh covers every
 deployment mode; collectives ride ICI within a slice and DCN across slices.
+``stage`` sits directly inside ``client`` so a stage group's devices are
+ICI-adjacent (the permute ring never crosses a client-shard boundary) and
+the flat device id decomposes as ``(c*s + s_coord)*m + m_coord`` with
+data/seq pinned to 1 — the id math docs/PIPELINE.md and fedverify's group
+classifier share.
 """
 
 from __future__ import annotations
@@ -29,15 +38,17 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENT_AXIS = "client"
+STAGE_AXIS = "stage"
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
-ALL_AXES = (CLIENT_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+ALL_AXES = (CLIENT_AXIS, STAGE_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
 
 
 def make_mesh(
     client: int = -1,
+    stage: int = 1,
     data: int = 1,
     model: int = 1,
     seq: int = 1,
@@ -50,46 +61,57 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = data * model * seq
+    fixed = stage * data * model * seq
     if client == -1:
         if n % fixed != 0:
-            raise ValueError(f"{n} devices not divisible by data*model*seq={fixed}")
+            raise ValueError(
+                f"{n} devices not divisible by stage*data*model*seq={fixed}")
         client = n // fixed
     total = client * fixed
     if total > n:
         raise ValueError(f"mesh wants {total} devices, have {n}")
-    arr = np.array(devices[:total]).reshape(client, data, model, seq)
+    arr = np.array(devices[:total]).reshape(client, stage, data, model, seq)
     return Mesh(arr, ALL_AXES)
 
 
 def parse_mesh_shape(value) -> Optional[tuple]:
     """Normalize ``args.mesh_shape`` to ``(n_client_shards,
-    n_model_shards)`` or None.  Accepts a 2-tuple/list, or a string like
-    ``"4,2"`` / ``"4x2"``; ``-1`` in the client slot absorbs the remaining
-    devices (``make_mesh`` semantics)."""
+    n_model_shards)`` or ``(n_client_shards, n_stage_shards,
+    n_model_shards)`` or None.  Accepts a 2-/3-tuple/list, or a string
+    like ``"4,2"`` / ``"4x2"`` / ``"2,2,2"``; ``-1`` in the client slot
+    absorbs the remaining devices (``make_mesh`` semantics).  The
+    3-tuple form selects the pipeline layout (docs/PIPELINE.md) when the
+    stage factor exceeds 1."""
     if value in (None, "", "none", "auto"):
         return None
     if isinstance(value, str):
         parts = value.replace("x", ",").split(",")
         value = [int(p) for p in parts if p.strip()]
     shape = tuple(int(v) for v in value)
-    if len(shape) != 2:
+    if len(shape) not in (2, 3):
         raise ValueError(
-            f"mesh_shape must be (n_client_shards, n_model_shards), "
+            f"mesh_shape must be (n_client_shards, n_model_shards) or "
+            f"(n_client_shards, n_stage_shards, n_model_shards), "
             f"got {shape!r}")
-    if shape[1] < 1:
-        raise ValueError(f"n_model_shards must be >= 1, got {shape[1]}")
+    if len(shape) == 3 and shape[1] < 1:
+        raise ValueError(f"n_stage_shards must be >= 1, got {shape[1]}")
+    if shape[-1] < 1:
+        raise ValueError(f"n_model_shards must be >= 1, got {shape[-1]}")
     return shape
 
 
 def make_mesh2d(mesh_shape, devices: Optional[Sequence[jax.Device]] = None
                 ) -> Mesh:
-    """2-D ``(client, model)`` mesh factory (docs/MESH_2D.md): clients
-    sharded along ``client``, each client's model spanning the
-    ``n_model_shards`` chips of its ``model`` group.  Returns the
-    canonical 4-axis mesh with data/seq pinned to 1, so every existing
-    ``P(CLIENT_AXIS)`` spec keeps working."""
-    c, m = parse_mesh_shape(mesh_shape)
+    """2-D ``(client, model)`` / 3-D ``(client, stage, model)`` mesh
+    factory (docs/MESH_2D.md, docs/PIPELINE.md): clients sharded along
+    ``client``, each client's model spanning the ``stage × model`` chips
+    of its group.  Returns the canonical 5-axis mesh with data/seq pinned
+    to 1, so every existing ``P(CLIENT_AXIS)`` spec keeps working."""
+    shape = parse_mesh_shape(mesh_shape)
+    if len(shape) == 3:
+        c, s, m = shape
+        return make_mesh(client=c, stage=s, model=m, devices=devices)
+    c, m = shape
     return make_mesh(client=c, model=m, devices=devices)
 
 

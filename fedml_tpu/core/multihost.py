@@ -24,7 +24,7 @@ from typing import Optional
 import jax
 
 from .mesh import ALL_AXES as AXES
-from .mesh import CLIENT_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from .mesh import CLIENT_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS
 
 log = logging.getLogger(__name__)
 
@@ -47,8 +47,8 @@ class MultiHostSpec:
 
 
 def init_multihost(spec: Optional[MultiHostSpec] = None, *,
-                   client: int = 1, data: int = 1, model: int = 1,
-                   seq: int = 1):
+                   client: int = 1, stage: int = 1, data: int = 1,
+                   model: int = 1, seq: int = 1):
     """Join the distributed job (no-op for a single process) and build the
     canonical mesh over ALL processes' devices.
 
@@ -67,8 +67,8 @@ def init_multihost(spec: Optional[MultiHostSpec] = None, *,
         log.info("joined distributed job: process %d/%d, %d global devices",
                  spec.process_id, spec.num_processes, jax.device_count())
 
-    sizes = {CLIENT_AXIS: client, DATA_AXIS: data, MODEL_AXIS: model,
-             SEQ_AXIS: seq}
+    sizes = {CLIENT_AXIS: client, STAGE_AXIS: stage, DATA_AXIS: data,
+             MODEL_AXIS: model, SEQ_AXIS: seq}
     n = jax.device_count()
     fixed = 1
     wild = [a for a, s in sizes.items() if s == -1]
@@ -109,6 +109,7 @@ def init_multihost(spec: Optional[MultiHostSpec] = None, *,
         return jax.sharding.Mesh(devices, AXES)
     from .mesh import make_mesh
     return make_mesh(**{CLIENT_AXIS: sizes[CLIENT_AXIS],
+                        STAGE_AXIS: sizes[STAGE_AXIS],
                         DATA_AXIS: sizes[DATA_AXIS],
                         MODEL_AXIS: sizes[MODEL_AXIS],
                         SEQ_AXIS: sizes[SEQ_AXIS]})

@@ -18,6 +18,36 @@ import jax.numpy as jnp
 
 
 @dataclasses.dataclass
+class PipelineDef:
+    """Layer-indexed stage assignment of a staged model (docs/PIPELINE.md).
+
+    A model opts into the ``client × stage × model`` pipeline layout by
+    carrying one of these: the named ``stage_leaves`` are top-level param
+    entries stacked on a leading LAYER axis (dim 0), which the mesh layout
+    shards over ``stage`` (contiguous layer chunks — depth must divide by
+    the stage count) and, for ndim >= 3 leaves, over ``model`` on dim 1
+    (row-parallel).  The three pure functions are the model's forward split
+    at the stage boundaries; each runs INSIDE a fully-manual ``shard_map``
+    on shard-local leaves, so ``blocks`` must route its matmuls through
+    ``ops.pipeline.tp_dense`` for the model factor.
+    """
+
+    #: top-level param names stacked (depth, ...) on dim 0
+    stage_leaves: Tuple[str, ...]
+    #: activation width crossing stage boundaries (the ppermute payload's
+    #: trailing dim — byte models and the pipeline carry shape use it)
+    hidden: int
+    #: (params, x) -> h: the stage-0 input transform (non-staged leaves
+    #: replicate over stage/model, so any shard can run it)
+    embed: Callable[[Any, Any], Any]
+    #: (params_local, h, model_axis) -> h: THIS shard's stacked layer
+    #: chunk applied in order (lax.scan over the local layer axis)
+    blocks: Callable[[Any, Any, str], Any]
+    #: (params, h) -> logits: the last-stage output head
+    head: Callable[[Any, Any], Any]
+
+
+@dataclasses.dataclass
 class FlaxModel:
     module: nn.Module
     #: shape of ONE example (no batch dim) + dtype, used for shape-inference init
@@ -27,6 +57,9 @@ class FlaxModel:
     task: str = "classification"
     #: whether apply needs an rng (dropout) and a train flag
     has_dropout: bool = False
+    #: staged-execution metadata — set on models that support the 3-D
+    #: ``client × stage × model`` pipeline layout (docs/PIPELINE.md)
+    pipeline: Optional[PipelineDef] = None
 
     def init(self, rng: jax.Array):
         dummy = jnp.zeros((1,) + tuple(self.input_shape), self.input_dtype)

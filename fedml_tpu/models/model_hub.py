@@ -51,6 +51,15 @@ def create(args, output_dim: int = 10) -> FlaxModel:
                          task=task)
     if name == "mlp":
         return FlaxModel(MLP(hidden=128, output_dim=output_dim), _img_shape(args))
+    if name == "pipe_mlp":
+        # layer-stacked MLP with staged-execution metadata — the canonical
+        # model of the 3-D ``client × stage × model`` pipeline layout
+        # (docs/PIPELINE.md); depth must divide by the stage count and
+        # hidden by the model-shard count
+        from .pipe_mlp import pipe_mlp
+        return pipe_mlp(hidden=int(getattr(args, "model_dim", 64) or 64),
+                        depth=int(getattr(args, "model_layers", 4) or 4),
+                        output_dim=output_dim, input_shape=_img_shape(args))
     if name == "cnn":
         # reference: CNN_DropOut for femnist/mnist (model_hub.py:30-40);
         # honor an explicit input_shape (e.g. the 8x8 real-digits shard) —

@@ -1,0 +1,90 @@
+"""PipeMLP — the uniform-depth staged model of the pipeline layout.
+
+The reference has no model deep enough to exceed one accelerator
+(SURVEY §2.9); this is the repo's canonical LAYER-STACKED architecture:
+an embedding dense, ``depth`` uniform ``hidden × hidden`` residual-free
+blocks stored as ONE stacked ``(depth, hidden, hidden)`` parameter (so
+the layer axis is a real array axis the mesh can shard over ``stage``),
+and an output head.  ``docs/PIPELINE.md`` documents the stage assignment:
+contiguous layer chunks per stage shard, blocks row-parallel over
+``model`` inside each stage, embed/head replicated (stage 0 / last stage
+use them; their gradients psum over the stage ring).
+
+The flax ``__call__`` and the :class:`~.base.PipelineDef` split functions
+are the SAME math (``relu(x @ W_e + b_e)`` → scan of ``relu(h @ W_l +
+b_l)`` → ``h @ W_h + b_h``), so the sp engine, the 2-D GSPMD layout and
+the 3-D microbatched pipeline agree to fp32 tolerance — the §7-style
+parity tests in ``tests/test_mesh3d.py`` pin it at 2e-5.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.pipeline import tp_dense
+from .base import FlaxModel, PipelineDef
+
+
+class PipeMLP(nn.Module):
+    """Embed → ``depth`` stacked relu blocks (``lax.scan`` over the layer
+    axis) → head.  The stacked-block storage is what makes the model
+    stage-shardable: ``blocks_w`` is ``(depth, hidden, hidden)`` and
+    ``blocks_b`` ``(depth, hidden)``, both partitioned on dim 0."""
+
+    hidden: int
+    depth: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        h = nn.relu(nn.Dense(self.hidden, name="embed")(x))
+        bw = self.param("blocks_w", nn.initializers.lecun_normal(),
+                        (self.depth, self.hidden, self.hidden))
+        bb = self.param("blocks_b", nn.initializers.zeros_init(),
+                        (self.depth, self.hidden))
+
+        def blk(h, wb):
+            w, b = wb
+            return jnp.maximum(h @ w + b, 0.0), None
+
+        h, _ = jax.lax.scan(blk, h, (bw, bb))
+        return nn.Dense(self.output_dim, name="head")(h)
+
+
+# -- PipelineDef split (shard-local pure functions) --------------------------
+
+def _embed(params, x):
+    x = x.reshape((x.shape[0], -1))
+    e = params["embed"]
+    return jnp.maximum(x @ e["kernel"] + e["bias"], 0.0)
+
+
+def _blocks(params, h, model_axis: str):
+    def blk(h, wb):
+        w, b = wb
+        return jnp.maximum(tp_dense(h, w, b, model_axis), 0.0), None
+
+    h, _ = jax.lax.scan(blk, h, (params["blocks_w"], params["blocks_b"]))
+    return h
+
+
+def _head(params, h):
+    d = params["head"]
+    return h @ d["kernel"] + d["bias"]
+
+
+def pipe_mlp(hidden: int, depth: int, output_dim: int, input_shape,
+             task: str = "classification") -> FlaxModel:
+    """:class:`FlaxModel` factory carrying the staged-execution metadata."""
+    return FlaxModel(
+        PipeMLP(hidden=hidden, depth=depth, output_dim=output_dim),
+        tuple(input_shape), task=task,
+        pipeline=PipelineDef(stage_leaves=("blocks_w", "blocks_b"),
+                             hidden=hidden, embed=_embed, blocks=_blocks,
+                             head=_head))
+
+
+__all__ = ["PipeMLP", "pipe_mlp"]

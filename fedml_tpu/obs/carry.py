@@ -60,11 +60,14 @@ class ObsCarry:
     # and the L2 norm of this round's quantization residual (0 at fp32)
     collective_bytes: jnp.ndarray
     quant_error_norm: jnp.ndarray
-    # per-mesh-axis split of collective_bytes (docs/MESH_2D.md): merge +
-    # broadcast payload crossing the ``client`` axis vs. the model-parallel
-    # traffic crossing the ``model`` axis (0 on 1-D layouts; the two sum
-    # to collective_bytes)
+    # per-mesh-axis split of collective_bytes (docs/MESH_2D.md,
+    # docs/PIPELINE.md): merge + broadcast payload crossing the ``client``
+    # axis, the pipeline permute + flat-view traffic crossing ``stage``
+    # (0 off the 3-D layout), and the model-parallel traffic crossing
+    # ``model`` (0 on 1-D layouts).  client + stage + model ==
+    # collective_bytes, pinned by tests/test_fedtrace.py
     collective_bytes_client: jnp.ndarray
+    collective_bytes_stage: jnp.ndarray
     collective_bytes_model: jnp.ndarray
 
 
@@ -78,6 +81,7 @@ def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
               batch: int, feat: int, opt_flops_per_param: float,
               collective_bytes: float = 0.0,
               collective_bytes_client: float = None,
+              collective_bytes_stage: float = 0.0,
               collective_bytes_model: float = 0.0,
               quant_error=None) -> ObsCarry:
     """Build the ObsCarry INSIDE the compiled round.
@@ -116,6 +120,8 @@ def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
                                       else jnp.asarray(quant_error, f32)),
                     collective_bytes_client=jnp.asarray(
                         float(collective_bytes_client), f32),
+                    collective_bytes_stage=jnp.asarray(
+                        float(collective_bytes_stage), f32),
                     collective_bytes_model=jnp.asarray(
                         float(collective_bytes_model), f32))
 
@@ -124,7 +130,7 @@ def round_obs(old_params: Any, new_params: Any, *, real_steps, real_clients,
 #    log-round sync points; the values are already computed on device) ------
 
 def _row(steps, clients, examples, norm, pf, cbytes, qerr, cb_client,
-         cb_model) -> Dict[str, float]:
+         cb_stage, cb_model) -> Dict[str, float]:
     out = {"steps": float(steps), "clients": float(clients),
            "examples": float(examples), "update_norm": float(norm)}
     for i, phase in enumerate(DEVICE_PHASES):
@@ -132,6 +138,7 @@ def _row(steps, clients, examples, norm, pf, cbytes, qerr, cb_client,
     out["collective_bytes"] = float(cbytes)
     out["quant_error_norm"] = float(qerr)
     out["collective_bytes_client"] = float(cb_client)
+    out["collective_bytes_stage"] = float(cb_stage)
     out["collective_bytes_model"] = float(cb_model)
     return out
 
@@ -144,6 +151,7 @@ def obs_host(carry: ObsCarry) -> Dict[str, float]:
                 np.asarray(carry.collective_bytes),
                 np.asarray(carry.quant_error_norm),
                 np.asarray(carry.collective_bytes_client),
+                np.asarray(carry.collective_bytes_stage),
                 np.asarray(carry.collective_bytes_model))
 
 
@@ -177,6 +185,7 @@ def obs_population_rows(carry: ObsCarry, losses) -> List[Dict[str, float]]:
                    col(carry.phase_flops, j), col(carry.collective_bytes, j),
                    col(carry.quant_error_norm, j),
                    col(carry.collective_bytes_client, j),
+                   col(carry.collective_bytes_stage, j),
                    col(carry.collective_bytes_model, j))
         row["members"] = float(p)
         row["member_loss_best"] = float(losses[:, j].min())
@@ -203,9 +212,11 @@ def obs_host_rows(carry: ObsCarry) -> List[Dict[str, float]]:
     cb = np.asarray(carry.collective_bytes)
     qe = np.asarray(carry.quant_error_norm)
     cbc = np.asarray(carry.collective_bytes_client)
+    cbs = np.asarray(carry.collective_bytes_stage)
     cbm = np.asarray(carry.collective_bytes_model)
     if steps.ndim == 0:
-        return [_row(steps, clients, examples, norm, pf, cb, qe, cbc, cbm)]
+        return [_row(steps, clients, examples, norm, pf, cb, qe, cbc, cbs,
+                     cbm)]
     return [_row(steps[j], clients[j], examples[j], norm[j], pf[j],
-                 cb[j], qe[j], cbc[j], cbm[j])
+                 cb[j], qe[j], cbc[j], cbs[j], cbm[j])
             for j in range(steps.shape[0])]

@@ -15,8 +15,82 @@ so ``jax.grad`` through :func:`pipeline_apply` IS pipelined backward.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+
+
+# -- exact-transpose manual collectives (docs/PIPELINE.md) -------------------
+#
+# Inside a FULLY-MANUAL ``shard_map`` with replication checking off
+# (``check_vma=False`` — the repo-wide setting, see compat.py), the
+# autodiff transpose of ``psum`` is ``psum`` again.  That is correct when
+# the cotangent is a sum of per-device partials, but over-counts by the
+# axis size when the cotangent is REPLICATED (the scalar-loss case): the
+# probe that locked this design measured gradients scaled by exactly
+# ``n_stages * n_model_shards``.  The classic Megatron f/g conjugate pair
+# restores exact transposes by construction:
+#
+# - :func:`psum_keepgrad` (psum forward, identity backward) closes a
+#   row-parallel matmul and the final loss reduction — its output
+#   cotangent is replicated, so the true vjp is the identity.
+# - :func:`sumgrad` (identity forward, psum backward) opens a sliced
+#   computation on a replicated activation — each device's slice produces
+#   a PARTIAL input cotangent, and the true vjp sums them.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_keepgrad(x, axis_name):
+    """``psum`` with an identity backward: exact when the consumer's
+    cotangent is replicated over ``axis_name`` (loss scalars, the closing
+    reduction of a row-parallel dense)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _pk_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _pk_bwd(axis_name, _, g):
+    return (g,)
+
+
+psum_keepgrad.defvjp(_pk_fwd, _pk_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def sumgrad(x, axis_name):
+    """Identity forward, ``psum`` backward: marks a replicated activation
+    entering a computation that each device slices differently, so the
+    partial input cotangents sum into the true one."""
+    return x
+
+
+def _sg_fwd(x, axis_name):
+    return x, None
+
+
+def _sg_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+sumgrad.defvjp(_sg_fwd, _sg_bwd)
+
+
+def tp_dense(x, w, b, axis_name: str):
+    """Row-parallel dense on a manually-sharded mesh axis.
+
+    ``x`` is the replicated activation ``(..., in_dim)``; ``w`` is THIS
+    device's row shard ``(in_dim/k, out)``; ``b`` replicated ``(out,)``.
+    Each device slices its rows out of ``x``, computes the local partial
+    matmul and the closing :func:`psum_keepgrad` rebuilds the replicated
+    output — gradients are exact through the f/g pair above.  With the
+    axis absent from the mesh (k == 1) this is a plain dense."""
+    x = sumgrad(x, axis_name)
+    k = jax.lax.axis_index(axis_name)
+    rows = w.shape[0]
+    xs = jax.lax.dynamic_slice_in_dim(x, k * rows, rows, axis=-1)
+    return psum_keepgrad(xs @ w, axis_name) + b
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, axis_name: str):
@@ -80,4 +154,5 @@ def make_pipelined_forward(stage_fn, mesh, axis_name: str):
     return jax.jit(fwd)
 
 
-__all__ = ["pipeline_apply", "make_pipelined_forward"]
+__all__ = ["pipeline_apply", "make_pipelined_forward", "psum_keepgrad",
+           "sumgrad", "tp_dense"]

@@ -93,6 +93,39 @@ def client_axis_bytes(n_flat: int, n_client_shards: int, precision: str,
         n_flat, n_client_shards, precision, quant_block, mode))
 
 
+def stage_axis_bytes(n_flat: int, n_stage_shards: int,
+                     param_bytes: int = 4, mode: str = "scatter",
+                     hidden: int = 0, microbatch: int = 0,
+                     n_micro: int = 0, steps: int = 0) -> float:
+    """Payload bytes/round crossing the ``stage`` axis on the 3-D pipeline
+    layout (docs/PIPELINE.md).  Two planes:
+
+    - merge plane — same flat-view moves as :func:`model_axis_bytes`:
+      in scatter mode the pre-merge replication of the stage-sharded
+      params into ``gflat`` and the post-update flat→tree assembly each
+      move ``(s-1)/s`` of the flat length along ``stage``; zero
+      replicated (params REST stage-sharded on round exit).
+    - train plane — the pipeline's ``collective_permute`` traffic: every
+      schedule tick moves one ``(microbatch, hidden)`` fp32 activation
+      per chip around the stage ring, ``n_micro + s - 1`` ticks per SGD
+      step, and the transposed backward moves the activation-grads the
+      same way (the ``2.0``); ``steps`` local steps per round.
+
+    Hand-checkable: ``(2,2,2)`` mesh, hidden=8, batch=8, n_micro=2
+    (microbatch=4), steps=2 → train plane = 2·(2+1)·4·8·4·2 = 1536.0
+    bytes.  A modeled lower bound like the other axes — masked bubble
+    ticks still move full payloads (ppermute has no mask), which is why
+    the bubble ticks are INCLUDED here.  Zero when ``s == 1``."""
+    if n_stage_shards <= 1:
+        return 0.0
+    merge = (2.0 * float(n_flat) * (n_stage_shards - 1) / n_stage_shards
+             * float(param_bytes)) if mode == "scatter" else 0.0
+    ticks = n_micro + n_stage_shards - 1
+    train = (2.0 * float(ticks) * float(microbatch) * float(hidden)
+             * float(param_bytes) * float(steps))
+    return merge + train
+
+
 def model_axis_bytes(n_flat: int, n_model_shards: int,
                      param_bytes: int = 4,
                      mode: str = "scatter") -> float:

@@ -73,6 +73,14 @@ from .layout import MeshLayout
 log = logging.getLogger(__name__)
 
 
+def _stage_leaves(trainer) -> tuple:
+    """Staged-leaf names of the trainer's model (empty when the model
+    carries no PipelineDef) — what MeshLayout needs to shard a nontrivial
+    ``stage`` factor (docs/PIPELINE.md)."""
+    pipe = getattr(getattr(trainer, "model", None), "pipeline", None)
+    return tuple(getattr(pipe, "stage_leaves", ()) or ())
+
+
 def make_mesh_round_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                        mesh: Mesh, gather: bool = False,
                        sharded_data: bool = False,
@@ -122,7 +130,7 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
     local_train = trainer.make_local_train()
     alg = server_opt.algorithm
     spec = server_opt.spec
-    layout = MeshLayout(mesh)
+    layout = MeshLayout(mesh, stage_leaves=_stage_leaves(trainer))
     n_shards = layout.n_client_shards
     scatter = update_sharding == "scatter"
     precision = collective_precision
@@ -144,6 +152,22 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
     flat = (layout.flat_spec_of(state_template.global_params)
             if state_template is not None else None)
 
+    pipe_cohort = None
+    if layout.pipeline:
+        # 3-D layout (docs/PIPELINE.md): the train phase is the fully-
+        # manual microbatched pipeline shard_map, NOT the GSPMD vmap below
+        from .pipeline import PipelineTrainer, make_pipeline_cohort
+        if not isinstance(trainer, PipelineTrainer):
+            raise TypeError(
+                "a mesh with n_stage_shards > 1 needs a PipelineTrainer "
+                "(MeshFedAvgAPI builds one when the mesh has a stage "
+                "factor; direct make_mesh_round_fn callers must too)")
+        pipe_cohort = make_pipeline_cohort(trainer, layout)
+    # trace-time statics for the stage byte model (hoisted so the jit-
+    # reachable _bytes_model below stays int()-free — fedlint)
+    pipe_hidden = int(trainer.pipe.hidden) if layout.pipeline else 0
+    pipe_micro = int(trainer.n_micro) if layout.pipeline else 1
+
     def run_cohort(state: ServerState, x, y, mask, rngs, c_clients):
         # Client train phase — runs at the JIT level (GSPMD), NOT inside
         # the merge shard_map: cohort arrays are client-sharded, params
@@ -159,6 +183,9 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             idx, (train_x, train_y) = x, y
             x = jnp.take(train_x, idx, axis=0)
             y = jnp.take(train_y, idx, axis=0)
+        if pipe_cohort is not None:
+            return pipe_cohort(state.global_params, state.c_server,
+                               state.momentum, x, y, mask, rngs, c_clients)
         ctx = make_server_ctx(trainer, state)
         fn = lambda xb, yb, mb, rng, cc: local_train(
             state.global_params, xb, yb, mb, rng, ctx, cc)
@@ -166,29 +193,37 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
 
     def _cohort_dims(x, y):
         """Trace-time statics for the ObsCarry phase weights: examples per
-        step (B) and elements per example (feat)."""
+        step (B), elements per example (feat), local steps per client."""
         batch = int(x.shape[2])
         src_shape = y[0].shape[1:] if use_ingather else x.shape[3:]
-        return batch, math.prod(src_shape)
+        return batch, math.prod(src_shape), int(x.shape[1])
 
-    def _bytes_model(params) -> tuple:
+    def _bytes_model(params, batch: int, steps: int) -> tuple:
         """Trace-time statics: modeled interconnect payload bytes/round,
         split per mesh axis (ObsCarry; consumed by ``fedtrace summarize``
-        and ``bench.py --comms/--mesh2d``)."""
+        and ``bench.py --comms/--mesh2d/--pipeline``)."""
         if scatter:
             n_flat = flat.padded_size
         else:
             n_flat = tree_util.num_params(params)
         mode = "scatter" if scatter else "replicated"
         m = layout.n_model_shards
+        s = layout.n_stage_shards
         # replicated merge of model-sharded leaves: each chip's psum
         # payload is its 1/m shard, not the full flat length (the
         # fedverify census pinned the 2-D drift — ISSUE 10)
-        n_payload = n_flat if scatter else -(-n_flat // m)
+        n_payload = n_flat if scatter else -(-n_flat // (m * s))
         cbytes = coll.client_axis_bytes(n_payload, n_shards, precision,
                                         quant_block, mode)
         mbytes = coll.model_axis_bytes(n_flat, m, mode=mode)
-        return cbytes, mbytes
+        if layout.pipeline:
+            sbytes = coll.stage_axis_bytes(
+                n_flat, s, mode=mode, hidden=pipe_hidden,
+                microbatch=batch // pipe_micro, n_micro=pipe_micro,
+                steps=steps)
+        else:
+            sbytes = 0.0
+        return cbytes, sbytes, mbytes
 
     def raw_metrics(outs, w, quant_err_sq=None):
         """Per-shard psums of the round scalars; the ObsCarry itself is
@@ -335,8 +370,8 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
         )
 
     def assemble_metrics(mraw, old_params, new_params, x, y):
-        batch, feat = _cohort_dims(x, y)
-        cbytes, mbytes = _bytes_model(old_params)
+        batch, feat, steps = _cohort_dims(x, y)
+        cbytes, sbytes, mbytes = _bytes_model(old_params, batch, steps)
         qerr = (jnp.sqrt(mraw.pop("quant_err_sq")) if quantized else None)
         metrics = {"train_loss": mraw["train_loss"],
                    "total_steps": mraw["total_steps"]}
@@ -347,9 +382,9 @@ def _make_mesh_round_core(trainer: LocalTrainer, server_opt: ServerOptimizer,
             old_params, new_params, real_steps=mraw["total_steps"],
             real_clients=mraw["clients"], batch=batch, feat=feat,
             opt_flops_per_param=OPT_FLOPS.get(alg, 4.0),
-            collective_bytes=cbytes + mbytes,
-            collective_bytes_client=cbytes, collective_bytes_model=mbytes,
-            quant_error=qerr)
+            collective_bytes=cbytes + sbytes + mbytes,
+            collective_bytes_client=cbytes, collective_bytes_stage=sbytes,
+            collective_bytes_model=mbytes, quant_error=qerr)
         return metrics
 
     def round_fn(state, x, y, mask, w, key, c_clients):
@@ -453,7 +488,7 @@ def make_mesh_block_fn(trainer: LocalTrainer, server_opt: ServerOptimizer,
                                  state_template, collective_precision,
                                  quant_block, health)
     has_table = server_opt.algorithm in ("scaffold", "feddyn")
-    layout = MeshLayout(mesh)
+    layout = MeshLayout(mesh, stage_leaves=_stage_leaves(trainer))
     row_sharding = NamedSharding(mesh, P(CLIENT_AXIS))
 
     def block_fn(state: ServerState, x_blk, dev_data, mask_blk, w_blk,
@@ -497,9 +532,10 @@ class MeshFedAvgAPI(FedAvgAPI):
     """
 
     def __init__(self, args, device, dataset, model, mesh: Mesh = None):
-        self.layout = MeshLayout.from_args(args, mesh)
+        self.layout = MeshLayout.from_args(args, mesh, model=model)
         self.mesh = self.layout.mesh
         self.n_shards = self.layout.n_client_shards
+        self.n_stage_shards = self.layout.n_stage_shards
         self.n_model_shards = self.layout.n_model_shards
         mode = str(getattr(args, "update_sharding", "auto") or "auto").lower()
         if mode == "auto":
@@ -523,6 +559,19 @@ class MeshFedAvgAPI(FedAvgAPI):
             enabled=bool(getattr(args, "async_staging", True)),
             depth=int(getattr(args, "staging_depth", 1) or 1),
             limit=self.comm_rounds)
+
+    def _make_trainer(self, model, args):
+        """3-D layout (docs/PIPELINE.md): the microbatched pipeline trainer
+        — ``loss_fn`` replaced, every optimizer/SCAFFOLD step inherited."""
+        if not self.layout.pipeline:
+            return LocalTrainer(model, args)
+        from .pipeline import (PipelineTrainer, check_pipeline_shapes)
+        micro = int(getattr(args, "microbatches", 1) or 1)
+        check_pipeline_shapes(model, self.layout,
+                              int(getattr(args, "batch_size", 10)), micro)
+        return PipelineTrainer(model, args,
+                               n_stages=self.layout.n_stage_shards,
+                               microbatches=micro)
 
     def _build_round_fn(self, client_mode: str):
         # device_data: True/"replicated" | "sharded" | False ("host")

@@ -88,7 +88,7 @@ class FedAvgAPI:
             self.metrics_server = start_from_args(
                 args, monitor=self.health_monitor)
 
-        self.trainer = LocalTrainer(model, args)
+        self.trainer = self._make_trainer(model, args)
         self.server_opt = ServerOptimizer(args)
         # vmapped experiment population (ISSUE 7, docs/PRIMITIVES.md):
         # args.population / population_axes turn the round into a batch of
@@ -214,6 +214,12 @@ class FedAvgAPI:
     #: device). Subclasses that call round_fn with states sharing buffers
     #: (hierarchical group loop) must turn this off.
     DONATE_STATE = True
+
+    def _make_trainer(self, model, args) -> LocalTrainer:
+        """Trainer factory hook: the mesh subclass swaps in the
+        :class:`~..mesh.pipeline.PipelineTrainer` when the mesh carries a
+        nontrivial ``stage`` factor (docs/PIPELINE.md)."""
+        return LocalTrainer(model, args)
 
     def _init_server_state(self, params):
         """Initial ServerState; with a quantized collective layer it also
@@ -712,6 +718,14 @@ class FedAvgAPI:
         return metrics
 
     # -- fedverify hooks (ISSUE 10, docs/FEDVERIFY.md) ---------------------
+    def lowerable_programs(self):
+        """Every ``(kind, fn, args, donate)`` this engine can stage at
+        its current config — the Program registry's engine surface
+        (``analysis/programs.py``, ISSUE 18).  Callers iterate THIS one
+        list; the per-kind hooks below are its implementation."""
+        from ...analysis import programs as program_registry
+        return program_registry.lowerable(self)
+
     def round_program(self, round_idx: int = 0):
         """Expose the exact jitted round program + one round's staged
         arguments + the donated argnums, so ``analysis/fedverify.py`` can

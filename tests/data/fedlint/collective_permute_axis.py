@@ -1,0 +1,21 @@
+"""Fixture: 3-D ``(client, stage, model)`` meshes (docs/PIPELINE.md) —
+3-tuple axis declarations and ``ppermute``/``collective_permute`` axis
+resolution through the stage ring."""
+import jax
+
+CLIENT_AXIS = "client"
+STAGE_AXIS = "stage"
+MODEL_AXIS = "model"
+
+# 3-tuple mesh via the positional axis_names form
+mesh3d = jax.make_mesh((2, 2, 2), (CLIENT_AXIS, STAGE_AXIS, MODEL_AXIS))
+
+
+def pipeline_tick(h, n_stages):
+    perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+    nxt = jax.lax.ppermute(h, STAGE_AXIS, perm)          # ok: declared
+    also = jax.lax.collective_permute(h, "stage", perm)  # ok: alias form
+    rank = jax.lax.axis_index(STAGE_AXIS)                # ok: declared
+    bad = jax.lax.ppermute(h, "pipe", perm)              # 'pipe' undeclared
+    worse = jax.lax.collective_permute(h, "ring", perm)  # undeclared
+    return nxt, also, rank, bad, worse

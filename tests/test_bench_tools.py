@@ -289,6 +289,41 @@ def test_bench_mesh2d_quick(monkeypatch):
     assert ls["mesh2d_per_chip_gib"] <= ls["hbm_per_chip_gib"]
 
 
+def test_bench_pipeline_quick(monkeypatch):
+    """bench.py --pipeline smoke: the 2-D (4,2) vs 3-D (2,2,2) pipeline
+    comparison runs green at a fixed 8-chip count, the THREE-way per-axis
+    ObsCarry byte split is plumbed through (stage-axis bytes appear
+    exactly on the pipeline layout; the client-axis merge payload is
+    layout-independent), layout parity is visible in the round-1 losses,
+    and the LLM_SCALE row's estimator-picked (c, s, m) per-chip HBM
+    beats the best (c, m) at equal chips (ISSUE 18 acceptance;
+    docs/PIPELINE.md)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_PIPE_QUICK", "1")
+    out = bench.bench_pipeline()
+    assert out["quick"] is True
+    assert out["mesh2d_shape"] == [4, 1, 2]
+    assert out["mesh3d_shape"] == [2, 2, 2]
+    assert out["mesh2d_s_per_round"] > 0
+    assert out["mesh3d_s_per_round"] > 0
+    # client-axis merge payload is layout-independent; stage-axis traffic
+    # (the microbatched ppermute ring) exists exactly on the 3-D layout
+    assert out["mesh3d_client_bytes_per_round"] == \
+        out["mesh2d_client_bytes_per_round"] > 0
+    assert out["mesh2d_stage_bytes_per_round"] == 0
+    assert out["mesh3d_stage_bytes_per_round"] > 0
+    assert out["mesh3d_model_bytes_per_round"] > 0
+    # same seed, same cohort: microbatched pipeline trains the same model
+    assert abs(out["mesh2d_round1_loss"] - out["mesh3d_round1_loss"]) < 2e-5
+    ls = out["llm_scale"]
+    assert len(ls["mesh3d_shape"]) == 3 and ls["mesh3d_shape"][1] > 1
+    assert ls["mesh3d_fits"] is True
+    # the scale unlock: the stage axis lands UNDER the best 2-D per-chip
+    # total at the same 8 chips for the 98%-staged 1B model
+    assert ls["mesh3d_per_chip_gib"] < ls["mesh2d_per_chip_gib"]
+    assert ls["mesh3d_vs_2d_per_chip"] < 1.0
+
+
 def test_bench_wire_quick(monkeypatch):
     """FEDML_WIRE_QUICK smoke (docs/WIRE.md): bench.py --wire runs the
     fedwire matrix green on the real two-tier driver — measured wire

@@ -255,19 +255,27 @@ def test_fedtrace_summarize_golden_fixture():
 def test_fedtrace_golden_values_are_hand_checkable():
     """The fixture's numbers are chosen so the attribution is checkable
     by hand: round 0 (0.2s, weights 10/60/20/10) + round 1 (0.1s,
-    weights 10/70/10/10); collective bytes 40000 + 20000 with quant-error
+    weights 10/70/10/10); collective bytes 41536 + 21536 with quant-error
     norms 0.02 then 0.01 (docs/COLLECTIVE_PRECISION.md fields)."""
     s = fedtrace.summarize(fedtrace.load_trace(FIXTURE))
     assert s["phases"] == {"staging": 0.15, "gather": 0.03,
                            "client_steps": 0.19, "merge": 0.05,
                            "server_update": 0.03}
     assert s["compile_count"] == 1 and s["compile_s"] == 0.05
-    assert s["collective_bytes_per_round"] == 30000.0
-    assert s["collective_bytes_total"] == 60000.0
-    # per-axis split (docs/MESH_2D.md): 30000+15000 client, 10000+5000
-    # model — the two axis averages sum to the total average
+    assert s["collective_bytes_per_round"] == 31536.0
+    assert s["collective_bytes_total"] == 63072.0
+    # per-axis split (docs/MESH_2D.md, docs/PIPELINE.md): 30000+15000
+    # client, 10000+5000 model, and the pipeline's trace-time-static
+    # stage constant — 2*(n_micro+s-1)*microbatch*hidden*4*steps =
+    # 2*(2+1)*4*8*4*2 = 1536 B on the canonical (2,2,2) config, the
+    # same both rounds — and the three axis averages sum to the total
     assert s["collective_bytes_client_per_round"] == 22500.0
+    assert s["collective_bytes_stage_per_round"] == 1536.0
     assert s["collective_bytes_model_per_round"] == 7500.0
+    assert (s["collective_bytes_client_per_round"]
+            + s["collective_bytes_stage_per_round"]
+            + s["collective_bytes_model_per_round"]
+            == s["collective_bytes_per_round"])
     assert s["quant_error_norm_last"] == 0.01
     # vmapped population fields (docs/PRIMITIVES.md): the member-loss
     # envelope comes from the last round's record; the byte models are

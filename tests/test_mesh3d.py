@@ -1,0 +1,457 @@
+"""3-D ``client × stage × model`` pipeline mesh (ISSUE 18):
+``args.mesh_shape = (c, s, m)`` runs each client's train step as a
+microbatched pipeline over ``s`` stage shards (staged leaves partition
+their layer axis; activations/grads move through a ``ppermute`` stage
+ring inside a fully-manual ``shard_map``) while the FedAvg merge keeps
+the 2-D partial-auto pattern and the flat server state shards over ALL
+THREE axes — docs/PIPELINE.md.
+
+Pinned here:
+
+- parity: sp ≡ 2-D ``(4, 2)`` ≡ 3-D ``(2, 2, 2)`` to 2e-5 for
+  fedavg/fedopt/scaffold on the SAME ``pipe_mlp`` model, with
+  ``microbatches > 1`` on the pipeline layout (equal microbatches keep
+  the pipelined loss exactly the full-batch mean), incl. the
+  ``round_block=8`` ragged tail (fused ≡ unfused bitwise);
+- layout: staged leaves shard their layer axis over ``stage``, flat aux
+  vectors chunk over ``c·s·m``, EF rows keep rows on ``client`` /
+  columns on ``(stage, model)``;
+- orbax round-trip of the stage-sharded state — into the SAME mesh and
+  into a differently-shaped ``(2, 4)`` mesh of the same chips;
+- ``JaxRuntimeAudit``: ZERO steady-state recompiles on the 3-D layout,
+  per-round and fused;
+- ObsCarry's three-way byte split: client + stage + model == total, and
+  the stage train plane is hand-checkable
+  (``2·(n_micro+s-1)·microbatch·hidden·4·steps``);
+- ``core/memory_estimate.py``: the staged fraction divides by
+  ``eff_stage · eff_model``, so the estimator-picked ``(c, s, m)``
+  beats the best ``(c, m)`` at equal chips once model-parallel
+  efficiency saturates (the ISSUE 18 acceptance config);
+- ``validate_args``: pipeline × population/fedbuff/cohort_bucketing/
+  fedprox/feddyn and non-dividing ``microbatches`` are rejected at
+  ``init()`` time;
+- the first-class ``analysis.programs`` registry: fedverify's PROGRAMS
+  derive from it and the engines' ``lowerable_programs()`` walks it.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import fedml_tpu
+from fedml_tpu.arguments import load_arguments
+from fedml_tpu.core import tree as tree_util
+from fedml_tpu.core.memory_estimate import (HBM_PER_CHIP, MeshStateLayout,
+                                            estimate_mesh_state_memory)
+from fedml_tpu.core.mesh import (CLIENT_AXIS, MODEL_AXIS, STAGE_AXIS,
+                                 make_mesh2d, parse_mesh_shape)
+
+ALGS = ["FedAvg", "FedOpt", "SCAFFOLD"]
+#: FedOpt's toy-default server_lr=1.0 amplifies ulp noise chaotically
+#: (test_mesh2d precedent) — parity runs at a sane 0.03
+SANE = {"FedOpt": {"server_lr": 0.03}}
+#: canonical staged model: 4 stacked layers over s=2 stages, hidden 16
+#: divisible by the m=2 model factor
+PIPE = dict(model="pipe_mlp", model_dim=16, model_layers=4)
+
+
+def args_for(rounds=3, **over):
+    args = load_arguments()
+    args.update(
+        dataset="synthetic", num_classes=10, input_shape=(28, 28, 1),
+        train_size=1024, test_size=256,
+        client_num_in_total=16, client_num_per_round=8, comm_round=rounds,
+        epochs=1, batch_size=16, learning_rate=0.1, random_seed=7,
+        partition_method="homo", frequency_of_the_test=10 ** 9,
+        **PIPE,
+    )
+    args.update(**over)
+    return args
+
+
+def make_api(backend, rounds=3, **over):
+    from fedml_tpu import data as data_mod, model as model_mod
+
+    args = fedml_tpu.init(args_for(rounds=rounds, **over))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    if backend == "sp":
+        from fedml_tpu.simulation.sp.fedavg_api import FedAvgAPI
+        return FedAvgAPI(args, None, dataset, model)
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+    return MeshFedAvgAPI(args, None, dataset, model)
+
+
+def run_rounds(api, rounds):
+    return [float(api.train_one_round(r)["train_loss"])
+            for r in range(rounds)]
+
+
+def assert_tree_close(a, b, atol, rtol=1e-4, msg=""):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=atol, rtol=rtol, err_msg=msg)
+
+
+# -- mesh_shape plumbing -----------------------------------------------------
+
+def test_parse_mesh_shape_3tuple_forms():
+    assert parse_mesh_shape("2,2,2") == (2, 2, 2)
+    assert parse_mesh_shape("2x2x2") == (2, 2, 2)
+    assert parse_mesh_shape((1, 2, 4)) == (1, 2, 4)
+    assert parse_mesh_shape([-1, 2, 2]) == (-1, 2, 2)
+    with pytest.raises(ValueError, match="n_stage_shards"):
+        parse_mesh_shape("2,0,2")
+    with pytest.raises(ValueError, match="mesh_shape"):
+        parse_mesh_shape("2,2,2,2")
+
+
+def test_make_mesh2d_3tuple_axes():
+    mesh = make_mesh2d("2,2,2")
+    assert int(mesh.shape[CLIENT_AXIS]) == 2
+    assert int(mesh.shape[STAGE_AXIS]) == 2
+    assert int(mesh.shape[MODEL_AXIS]) == 2
+    # -1 absorbs the remaining devices given the stage x model factors
+    mesh = make_mesh2d((-1, 2, 2))
+    assert int(mesh.shape[CLIENT_AXIS]) == jax.device_count() // 4
+
+
+# -- parity: sp ≡ 2-D ≡ 3-D --------------------------------------------------
+
+@pytest.mark.parametrize("opt", ALGS)
+def test_parity_sp_2d_3d(opt):
+    """ISSUE 18 acceptance: the microbatched pipeline computes the SAME
+    federated round — 3-D within 2e-5 of the 2-D mesh on the same staged
+    model (microbatches=4 splitting every batch on the pipeline layout),
+    and both mesh layouts track the sp engine.  FedOpt's sp-vs-mesh band
+    is looser: the 4-layer staged model amplifies the psum-vs-sequential
+    reduction-order ulp noise through server Adam (~5e-5 by round 4 —
+    present on the 2-D mesh alone, test_collective_precision
+    precedent)."""
+    over = SANE.get(opt, {})
+    sp_tol = 1e-4 if opt == "FedOpt" else 2e-5
+    # Adam's ulp chaos compounds into the params faster than the losses
+    # (a few e-3 on isolated elements by round 4 on the 2-D mesh alone)
+    sp_param_tol = 5e-3 if opt == "FedOpt" else 2e-5
+    runs = {}
+    for name, backend, kw in (
+            ("sp", "sp", {}),
+            ("mesh2d", "mesh", {"mesh_shape": "4,2"}),
+            ("mesh3d", "mesh", {"mesh_shape": "2,2,2",
+                                "microbatches": 4})):
+        api = make_api(backend, rounds=4, federated_optimizer=opt,
+                       **{**over, **kw})
+        if name == "mesh3d":
+            assert (api.n_shards, api.n_stage_shards,
+                    api.n_model_shards) == (2, 2, 2)
+        runs[name] = (run_rounds(api, 4), api.state.global_params)
+
+    sp_losses, sp_params = runs["sp"]
+    for name in ("mesh2d", "mesh3d"):
+        losses, params = runs[name]
+        np.testing.assert_allclose(losses, sp_losses, atol=sp_tol,
+                                   err_msg=f"{opt}/{name} loss curve")
+        assert_tree_close(params, sp_params, atol=sp_param_tol,
+                          rtol=0.15 if opt == "FedOpt" else 1e-4,
+                          msg=f"{opt}/{name} params")
+    # the pipeline itself holds the tight band against the 2-D layout:
+    # losses at 2e-5 for every alg, params at 2e-5 for the deterministic
+    # algs — FedOpt params share the Adam band (the two layouts'
+    # reduction orders differ and isolated elements drift ~1e-3, same
+    # scale as either layout vs sp)
+    np.testing.assert_allclose(runs["mesh3d"][0], runs["mesh2d"][0],
+                               atol=2e-5,
+                               err_msg=f"{opt} 3-D vs 2-D loss curve")
+    assert_tree_close(runs["mesh3d"][1], runs["mesh2d"][1],
+                      atol=sp_param_tol,
+                      rtol=0.15 if opt == "FedOpt" else 1e-4,
+                      msg=f"{opt} 3-D vs 2-D params")
+
+
+@pytest.mark.parametrize("opt", ["FedAvg", "SCAFFOLD"])
+def test_parity_3d_fused_ragged(opt):
+    """round_block=8 over 10 rounds (8 + ragged 2) on the pipeline
+    layout: the scan body IS the per-round body, so fused ≡ unfused
+    bitwise — incl. SCAFFOLD's triple-axis-sharded client-state table
+    riding the carry."""
+    ref = make_api("mesh", rounds=10, federated_optimizer=opt,
+                   mesh_shape="2,2,2", microbatches=4, round_block=1)
+    ref_losses = run_rounds(ref, 10)
+    fused = make_api("mesh", rounds=10, federated_optimizer=opt,
+                     mesh_shape="2,2,2", microbatches=4, round_block=8)
+    losses, r = [], 0
+    while r < 10:
+        k, ms = fused.train_block(r)
+        losses += [float(x) for x in np.asarray(ms["train_loss"])]
+        r += k
+    assert losses == ref_losses
+    assert_tree_close(ref.state.global_params, fused.state.global_params,
+                      atol=0, rtol=0, msg="3-D fused params drifted")
+
+
+# -- layout: triple-axis sharding --------------------------------------------
+
+def test_3d_state_layout():
+    """Staged leaves shard their layer axis over ``stage``; flat aux
+    state chunks over all THREE axes (each chip owns 1/(c*s*m)); EF rows
+    keep rows on ``client`` / columns on ``(stage, model)``; non-staged
+    leaves replicate (the pipeline body computes embed/head redundantly
+    per stage group)."""
+    api = make_api("mesh", rounds=1, federated_optimizer="FedOpt",
+                   mesh_shape="2,2,2", microbatches=4,
+                   update_sharding="scatter", collective_precision="int8")
+    api.train_one_round(0)
+    st = api.state
+    assert api.layout.flat_multiple == 8
+    flat_len = tree_util.padded_flat_size(st.global_params, 8)
+    assert st.master_flat.shape == (flat_len,)
+    assert st.master_flat.sharding.spec == P(
+        (CLIENT_AXIS, STAGE_AXIS, MODEL_AXIS))
+    assert st.ef_bcast.sharding.spec == P(
+        (CLIENT_AXIS, STAGE_AXIS, MODEL_AXIS))
+    assert st.ef_num.shape == (api.n_shards, flat_len)
+    assert st.ef_num.sharding.spec == P(CLIENT_AXIS,
+                                        (STAGE_AXIS, MODEL_AXIS))
+    for leaf in jax.tree_util.tree_leaves(st.opt_state):
+        if np.ndim(leaf) >= 1:
+            assert leaf.sharding.spec == P(
+                (CLIENT_AXIS, STAGE_AXIS, MODEL_AXIS))
+    # staged leaves put STAGE on dim 0; non-staged leaves replicate
+    staged = set(api.layout.stage_leaves)
+    assert staged
+    for name, leaf in st.global_params.items():
+        for l in jax.tree_util.tree_leaves(leaf):
+            spec = l.sharding.spec
+            if name in staged:
+                assert spec and spec[0] == STAGE_AXIS, (name, spec)
+            else:
+                assert all(ax is None for ax in spec), (name, spec)
+
+
+def test_3d_obs_byte_split():
+    """ObsCarry's three-way per-axis split: client + stage + model ==
+    total on the scatter config, and on a replicated hand-check config
+    the stage share is EXACTLY the pipeline train plane —
+    2·(n_micro+s-1)·microbatch·hidden·4·steps = 2·(2+1)·4·8·4·2 = 1536
+    bytes (docs/PIPELINE.md byte model; the fedtrace golden pins the
+    same constant)."""
+    api = make_api("mesh", rounds=1, mesh_shape="2,2,2", microbatches=4)
+    obs = api.train_one_round(0)["obs"]
+    c = float(np.asarray(obs.collective_bytes_client))
+    s = float(np.asarray(obs.collective_bytes_stage))
+    m = float(np.asarray(obs.collective_bytes_model))
+    assert s > 0 and m > 0
+    assert c + s + m == float(np.asarray(obs.collective_bytes))
+
+    # 16 clients x 2 batches of 8 = 256 examples -> steps=2 per client
+    hand = make_api("mesh", rounds=1, mesh_shape="2,2,2", model_dim=8,
+                    batch_size=8, microbatches=2, train_size=256,
+                    update_sharding="replicated")
+    obs_h = hand.train_one_round(0)["obs"]
+    assert float(np.asarray(obs_h.collective_bytes_stage)) == 1536.0
+
+
+# -- checkpoint: stage-sharded state round-trips -----------------------------
+
+def test_3d_checkpoint_roundtrip_same_mesh(tmp_path):
+    """The triple-axis-sharded opt_state/EF/master ride the existing
+    orbax path byte-exactly, and the restored run continues on the
+    uninterrupted curve."""
+    ck = str(tmp_path / "ck")
+    kw = dict(federated_optimizer="FedOpt", mesh_shape="2,2,2",
+              microbatches=4, collective_precision="int8",
+              checkpoint_dir=ck, checkpoint_freq=1)
+    api = make_api("mesh", **kw)
+    run_rounds(api, 2)
+    api.maybe_checkpoint(1)
+
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    args = fedml_tpu.init(args_for(**kw))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api2 = MeshFedAvgAPI(args, None, dataset, model)
+    assert api2.maybe_resume() == 2
+    for field in ("ef_num", "master_flat", "ef_bcast"):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(getattr(api.state, field))),
+            np.asarray(jax.device_get(getattr(api2.state, field))),
+            err_msg=f"restored {field} differs")
+    assert_tree_close(api.state.opt_state, api2.state.opt_state, atol=0,
+                      rtol=0, msg="restored opt_state differs")
+    uninterrupted = make_api("mesh", **{**kw, "checkpoint_dir": None})
+    run_rounds(uninterrupted, 3)
+    api2.train_one_round(2)
+    assert_tree_close(uninterrupted.state.global_params,
+                      api2.state.global_params, atol=2e-5)
+
+
+def test_3d_checkpoint_restores_into_2d_mesh(tmp_path):
+    """A pipeline run's checkpoint restores onto a DIFFERENTLY-shaped
+    mesh of the same chips — here the 2-D (2, 4) layout, which keeps the
+    client factor and flat pad multiple (c·s·m == c·m == 8) so the flat
+    aux vectors reshard transparently — and continues on the
+    uninterrupted fp32 curve (3-D ≡ 2-D parity)."""
+    ck = str(tmp_path / "ck")
+    api = make_api("mesh", federated_optimizer="FedOpt", server_lr=0.03,
+                   mesh_shape="2,2,2", microbatches=4,
+                   checkpoint_dir=ck, checkpoint_freq=1)
+    run_rounds(api, 2)
+    api.maybe_checkpoint(1)
+
+    from fedml_tpu import data as data_mod, model as model_mod
+    from fedml_tpu.simulation.mesh.mesh_simulator import MeshFedAvgAPI
+
+    args = fedml_tpu.init(args_for(federated_optimizer="FedOpt",
+                                   server_lr=0.03, mesh_shape="2,4",
+                                   checkpoint_dir=ck, checkpoint_freq=1))
+    dataset, out_dim = data_mod.load(args)
+    model = model_mod.create(args, out_dim)
+    api2 = MeshFedAvgAPI(args, None, dataset, model)
+    assert (api2.n_shards, api2.n_stage_shards, api2.n_model_shards) \
+        == (2, 1, 4)
+    assert api2.maybe_resume() == 2
+    assert_tree_close(api.state.global_params, api2.state.global_params,
+                      atol=0, rtol=0, msg="restored params differ")
+    uninterrupted = make_api("mesh", federated_optimizer="FedOpt",
+                             server_lr=0.03, mesh_shape="2,2,2",
+                             microbatches=4)
+    run_rounds(uninterrupted, 3)
+    api2.train_one_round(2)
+    assert_tree_close(uninterrupted.state.global_params,
+                      api2.state.global_params, atol=2e-5)
+
+
+# -- runtime contract: zero steady-state recompiles on 3-D -------------------
+
+def test_3d_round_compiles_once():
+    """ISSUE 18 acceptance: the microbatched pipeline round is ONE
+    compiled program — steady-state rounds add ZERO XLA compiles."""
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+
+    api = make_api("mesh", rounds=6, federated_optimizer="SCAFFOLD",
+                   mesh_shape="2,2,2", microbatches=4,
+                   collective_precision="int8", async_staging=False)
+    api.train_one_round(0)
+    api.train_one_round(1)
+    with JaxRuntimeAudit() as audit:
+        for r in (2, 3, 4):
+            api.train_one_round(r)
+    assert audit.compilations == 0, (
+        f"steady-state 3-D rounds recompiled {audit.compilations}x: "
+        f"{audit.compiled}")
+
+
+def test_3d_fused_block_compiles_once():
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+
+    api = make_api("mesh", rounds=12, federated_optimizer="SCAFFOLD",
+                   mesh_shape="2,2,2", microbatches=4, round_block=4,
+                   async_staging=False)
+    api.train_block(0)
+    api.train_block(4)
+    with JaxRuntimeAudit() as audit:
+        api.train_block(8)
+    assert audit.compilations == 0, (
+        f"steady-state 3-D block recompiled {audit.compilations}x: "
+        f"{audit.compiled}")
+
+
+# -- memory estimate ---------------------------------------------------------
+
+def test_mesh_state_memory_estimate_stage_division():
+    """The staged fraction divides by eff_stage*eff_model while the flat
+    aux state divides by c*s*m — at a fixed 8-chip count the 2-D totals
+    stay byte-identical to the 2-tuple form, and the stage axis keeps
+    dividing the staged plane past the max_model_parallel saturation
+    point."""
+    kw = dict(n_params=1e9, clients_per_round=8, algorithm="fedopt",
+              collective_precision="int8", param_bytes=2,
+              stage_fraction=0.98, max_model_parallel=4)
+    e2 = estimate_mesh_state_memory(MeshStateLayout(mesh_shape=(2, 4), **kw))
+    e3 = estimate_mesh_state_memory(
+        MeshStateLayout(mesh_shape=(2, 1, 4), **kw))
+    assert e3["total"] == pytest.approx(e2["total"])
+    # (1, 8) saturates at eff_model=4; (1, 2, 4) divides the staged
+    # plane by 2*4=8 — strictly below every 2-D factorization
+    sat = estimate_mesh_state_memory(MeshStateLayout(mesh_shape=(1, 8), **kw))
+    pipe = estimate_mesh_state_memory(
+        MeshStateLayout(mesh_shape=(1, 2, 4), **kw))
+    assert pipe["total"] < sat["total"]
+    for shape in ((8, 1), (4, 2), (2, 4), (1, 8)):
+        e = estimate_mesh_state_memory(MeshStateLayout(mesh_shape=shape, **kw))
+        assert pipe["total"] < e["total"], shape
+    # flat aux is layout-independent at fixed chips
+    assert pipe["opt_state_flat"] == pytest.approx(e2["opt_state_flat"])
+
+
+def test_mesh_state_memory_estimate_3d_acceptance_config():
+    """The ISSUE 18 acceptance config priced: at 8 v5e chips and a
+    98%-staged 1B model, the estimator-picked (c, s, m) fits with
+    per-chip headroom the best (c, m) cannot reach."""
+    budget = HBM_PER_CHIP["v5e"]
+    kw = dict(n_params=1e9, clients_per_round=8, algorithm="fedopt",
+              collective_precision="int8", param_bytes=2,
+              stage_fraction=0.98, max_model_parallel=4)
+    best2 = min(
+        estimate_mesh_state_memory(MeshStateLayout(mesh_shape=s, **kw))
+        ["total"] for s in ((8, 1), (4, 2), (2, 4), (1, 8)))
+    best3 = min(
+        estimate_mesh_state_memory(MeshStateLayout(mesh_shape=s, **kw))
+        ["total"] for s in ((2, 2, 2), (1, 2, 4), (1, 4, 2), (1, 8, 1)))
+    assert best3 < best2 <= budget
+
+
+# -- validate_args: pipeline compatibility gate ------------------------------
+
+@pytest.mark.parametrize("over,match", [
+    (dict(population=4), "population"),
+    (dict(federated_optimizer="FedBuff"), "fedbuff"),
+    (dict(cohort_bucketing=True), "cohort_bucketing"),
+    (dict(federated_optimizer="FedProx"), "fedprox"),
+    (dict(federated_optimizer="FedDyn"), "feddyn"),
+    (dict(microbatches=3), "microbatches"),
+])
+def test_validate_args_rejects_pipeline_incompatible(over, match):
+    """The pipeline train phase is one fully-manual fixed-shape
+    shard_map; incompatible flags fail fast at init() time with the flag
+    names in the message (docs/PIPELINE.md, Limits)."""
+    with pytest.raises(ValueError, match=match):
+        fedml_tpu.init(args_for(mesh_shape="2,2,2", **over))
+
+
+def test_validate_args_microbatches_ignored_off_pipeline():
+    """microbatches only gates pipeline layouts — a 2-D mesh with a
+    non-dividing value initializes fine (the knob is inert there)."""
+    args = fedml_tpu.init(args_for(mesh_shape="4,2", microbatches=3))
+    assert args.microbatches == 3
+
+
+# -- the program registry ----------------------------------------------------
+
+def test_program_registry_is_the_one_list():
+    """fedverify's PROGRAMS derive from analysis.programs; the 3-D
+    pipeline programs are registered; the quick subset is a strict
+    subset; and the engines' lowerable_programs() walks ENGINE_HOOKS —
+    per-round configs stage exactly the round program, fused configs add
+    the block program."""
+    from fedml_tpu.analysis import fedverify as fv
+    from fedml_tpu.analysis import programs
+
+    names = programs.names()
+    assert "mesh3d_scatter" in names and "mesh3d_block8" in names
+    assert set(fv.PROGRAMS) == set(names)
+    quick = programs.names(quick=True)
+    assert set(quick) < set(names) and quick
+    assert programs.get("mesh3d_scatter").kind == "round"
+
+    api = make_api("mesh", rounds=2, mesh_shape="2,2,2", microbatches=4)
+    kinds = [k for k, _, _, _ in api.lowerable_programs()]
+    assert kinds == ["round"]
+    fused = make_api("mesh", rounds=4, mesh_shape="2,2,2", microbatches=4,
+                     round_block=2)
+    kinds = [k for k, _, _, _ in fused.lowerable_programs()]
+    assert "block" in kinds

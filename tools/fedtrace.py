@@ -235,10 +235,11 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
         if cb:
             out["collective_bytes_per_round"] = round(sum(cb) / len(cb), 1)
             out["collective_bytes_total"] = round(sum(cb), 1)
-        # per-mesh-axis split (docs/MESH_2D.md): merge/broadcast payload on
-        # the ``client`` axis vs model-parallel traffic on ``model`` (only
-        # 2-D ``mesh_shape`` layouts report a nonzero model share)
-        for axis in ("client", "model"):
+        # per-mesh-axis split (docs/MESH_2D.md, docs/PIPELINE.md):
+        # merge/broadcast payload on ``client``, the pipeline permute +
+        # flat-view traffic on ``stage`` (3-D layouts only), model-parallel
+        # traffic on ``model``
+        for axis in ("client", "stage", "model"):
             vals = [float(r[f"collective_bytes_{axis}"]) for r in recs
                     if f"collective_bytes_{axis}" in r]
             if vals:
@@ -807,9 +808,13 @@ def _render_summary(s: Dict[str, Any]) -> str:
     if "collective_bytes_per_round" in s:
         axis = ""
         if "collective_bytes_client_per_round" in s:
+            stage = ""
+            if s.get("collective_bytes_stage_per_round", 0.0):
+                stage = (f" + stage "
+                         f"{s['collective_bytes_stage_per_round']:.0f}")
             axis = (f" (client "
                     f"{s['collective_bytes_client_per_round']:.0f}"
-                    f" + model "
+                    f"{stage} + model "
                     f"{s.get('collective_bytes_model_per_round', 0.0):.0f})")
         lines.append(
             f"collective bytes/round: "

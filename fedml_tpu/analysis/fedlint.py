@@ -225,6 +225,12 @@ _TRACER_SINK_ATTRS = {"counter", "add_bytes", "round_obs"}
 # returns the stat rows through the metrics pytree and observes at the
 # driver's flush
 _HEALTH_SINK_ATTRS = {"observe", "observe_round", "flag"}
+# fedslo histogram sinks (docs/OBSERVABILITY.md): Histogram.record /
+# .observe_latency take already-materialized host floats on the engine
+# or HTTP threads — feeding one a traced value inside a jitted region is
+# the same hidden sync the tracer sinks are; the sanctioned pattern
+# measures with host clocks at the engine's existing sync points
+_HISTOGRAM_SINK_ATTRS = {"record", "observe_latency"}
 
 _HOST_STORE_ATTRS = {"get", "gather", "scatter", "page_in", "write_back",
                      "lookup", "load"}
@@ -551,6 +557,14 @@ def _is_health_receiver(node: ast.AST) -> bool:
                                  or "monitor" in name.lower())
 
 
+def _is_histogram_receiver(node: ast.AST) -> bool:
+    """``ttft_hist.record(...)`` / ``self.serve_hists.ttft
+    .observe_latency(...)`` — receivers naming a fedslo histogram (the
+    ``hist`` lexical convention; ``histogram`` matches too)."""
+    name = _receiver_name(node)
+    return name is not None and "hist" in name.lower()
+
+
 def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
     for node in ast.walk(mv.mod.tree):
         if not isinstance(node, (ast.Call, ast.Subscript)):
@@ -617,6 +631,17 @@ def check_jit_host_sync(mv: ModuleView, out: List[Finding]):
                        "host sync at this line; return the per-client "
                        "stat rows through the metrics pytree and observe "
                        "at the driver's flush (docs/OBSERVABILITY.md)")
+            elif fn.attr in _HISTOGRAM_SINK_ATTRS and \
+                    _is_histogram_receiver(fn.value) and \
+                    any(not _is_staticish(a) for a in
+                        list(node.args)
+                        + [kw.value for kw in node.keywords]):
+                msg = (f"fedslo histogram sink .{fn.attr}() fed a "
+                       "(possibly traced) value inside jit-reachable "
+                       f"'{func_name(mv.reach.innermost_fn(node))}' — a "
+                       "host sync at this line; histograms take host-"
+                       "clock measurements at the engine's existing sync "
+                       "points (docs/OBSERVABILITY.md)")
             elif fn.attr in _HOST_STORE_ATTRS and \
                     _is_store_name(_receiver_name(fn.value)):
                 msg = (f"host client-state store access "

@@ -60,12 +60,24 @@ _CARRY_EXPORTS = ("ObsCarry", "OPT_FLOPS", "obs_host", "obs_host_rows",
 #: :mod:`.metricsd` exports, lazy for the same reason (http.server)
 _METRICSD_EXPORTS = ("MetricsServer", "parse_prometheus_text",
                      "prom_value", "start_from_args")
+#: fedslo exports (:mod:`.histogram` / :mod:`.slo` / :mod:`.canary`) —
+#: stdlib-only, lazy so disabled-telemetry imports stay featherweight
+_FEDSLO_EXPORTS = {
+    "BoundedLabels": "histogram", "Histogram": "histogram",
+    "ServeHistograms": "histogram",
+    "buckets_from_samples": "histogram",
+    "merge_bucket_entries": "histogram",
+    "quantile_from_buckets": "histogram",
+    "BURN_WINDOWS": "slo", "ObjectiveWindow": "slo",
+    "evaluate_objective_rules": "slo", "windows_for_rules": "slo",
+    "CanaryJudge": "canary", "validate_audit_log": "canary",
+}
 
 __all__ = ["DEVICE_PHASES", "PHASES", "DEFAULT_SLO_RULES", "HealthConfig",
            "HealthMonitor", "Tracer", "configure", "context",
            "escape_label_value", "evaluate_slos", "get_tracer",
            "load_slo_rules", "sanitize_metric_name", "trace_enabled",
-           *_CARRY_EXPORTS, *_METRICSD_EXPORTS]
+           *_CARRY_EXPORTS, *_METRICSD_EXPORTS, *_FEDSLO_EXPORTS]
 
 
 def __getattr__(name):
@@ -75,4 +87,9 @@ def __getattr__(name):
     if name in _METRICSD_EXPORTS:
         from . import metricsd
         return getattr(metricsd, name)
+    if name in _FEDSLO_EXPORTS:
+        import importlib
+        mod = importlib.import_module(
+            f".{_FEDSLO_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -350,14 +350,24 @@ DEFAULT_SLO_RULES: List[Dict[str, Any]] = [
      "max": 16.0, "crit": 128.0},
     {"name": "serve_p99", "metric": "serve.latency_p99_ms",
      "max": 250.0},
+    # fedslo objective rule (docs/OBSERVABILITY.md): "p99 TTFT < 200 ms
+    # over 99% of requests", evaluated as multi-window burn-rate alerts
+    # when the caller wires an ObjectiveWindow stream (obs/slo.py);
+    # skipped, like any absent metric, on processes without one
+    {"name": "serve_ttft_p99",
+     "objective": {"metric": "serve_ttft_seconds", "threshold": 0.2,
+                   "compliance": 0.99}},
 ]
 
 
 def load_slo_rules(path: str) -> List[Dict[str, Any]]:
-    """SLO rules from YAML (``{"slos": [...]}`` or a bare list).  Each
-    rule: ``name``, ``metric`` (a tracer-counter / fedmon gauge name),
-    and ``max`` and/or ``min`` warn bounds with optional ``crit`` /
-    ``crit_min`` critical bounds."""
+    """SLO rules from YAML (``{"slos": [...]}`` or a bare list).  Two
+    rule shapes: point rules — ``name``, ``metric`` (a tracer-counter /
+    fedmon gauge name), ``max`` and/or ``min`` warn bounds with optional
+    ``crit`` / ``crit_min`` critical bounds — and fedslo objective rules
+    — ``name`` plus an ``objective`` mapping (``metric``, ``threshold``,
+    ``compliance``) evaluated as multi-window burn-rate alerts
+    (:mod:`fedml_tpu.obs.slo`)."""
     import yaml
     with open(path) as fh:
         data = yaml.safe_load(fh) or {}
@@ -365,22 +375,54 @@ def load_slo_rules(path: str) -> List[Dict[str, Any]]:
     if not isinstance(rules, list):
         raise ValueError(f"{path}: expected a list or {{'slos': [...]}}")
     for r in rules:
-        if "metric" not in r:
+        if "objective" in r:
+            from .slo import validate_objective
+            validate_objective(r["objective"],
+                               where=f"{path}: {r.get('name', r)!r}")
+        elif "metric" not in r:
             raise ValueError(f"{path}: SLO rule missing 'metric': {r!r}")
     return rules
 
 
 def evaluate_slos(rules: Iterable[Dict[str, Any]],
-                  metrics: Dict[str, float]) -> Dict[str, Any]:
+                  metrics: Dict[str, float],
+                  objectives: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
     """ok / degraded / unhealthy over the rule set.
 
-    A rule breaches *warn* when the metric exceeds ``max`` (or falls
-    below ``min``), *crit* at ``crit`` / ``crit_min``.  Any crit breach
-    ⇒ unhealthy; any warn breach ⇒ degraded; rules whose metric is
-    absent are reported as skipped and do not affect the verdict."""
+    A point rule breaches *warn* when the metric exceeds ``max`` (or
+    falls below ``min``), *crit* at ``crit`` / ``crit_min``.  Any crit
+    breach ⇒ unhealthy; any warn breach ⇒ degraded; rules whose metric
+    is absent are reported as skipped and do not affect the verdict.
+
+    Objective rules (``rule["objective"]``) evaluate as multi-window
+    burn-rate alerts against the matching
+    :class:`~fedml_tpu.obs.slo.ObjectiveWindow` in ``objectives``
+    (keyed by rule name or objective metric); with no stream wired they
+    are skipped, same as an absent point metric."""
     checks: List[Dict[str, Any]] = []
     status = "ok"
+    order = ("ok", "degraded", "unhealthy")
+    rules = list(rules)
+    # evaluate objective rules up front, then emit every row in the
+    # caller's DECLARED rule order (checks[i] stays rule i)
+    objective_rules = [r for r in rules if r.get("objective")]
+    obj_rows: Dict[int, Dict[str, Any]] = {}
+    if objective_rules:
+        from .slo import evaluate_objective_rules
+        obj_rows = {
+            id(r): row for r, row in zip(
+                objective_rules,
+                evaluate_objective_rules(objective_rules,
+                                         objectives or {}))}
     for rule in rules:
+        if rule.get("objective"):
+            row = obj_rows[id(rule)]
+            checks.append(row)
+            lvl = row.get("status", "skipped")
+            if lvl in order and order.index(lvl) > order.index(status):
+                status = lvl
+            continue
         metric = rule["metric"]
         v = metrics.get(metric)
         row: Dict[str, Any] = {"name": rule.get("name", metric),

@@ -120,12 +120,20 @@ class MetricsServer:
     def __init__(self, tracer: Optional[Tracer] = None,
                  monitor: Optional[HealthMonitor] = None,
                  slo_rules: Optional[List[Dict[str, Any]]] = None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 extra_text=None, objectives=None):
         self.tracer = tracer or get_tracer()
         self.monitor = monitor
         self.slo_rules = slo_rules
         self.host = host
         self.port = int(port)
+        # fedslo extensions: ``extra_text`` — zero-arg callables whose
+        # text appends to /metrics (the serving engine passes its
+        # ServeHistograms exposition); ``objectives`` — rule-name →
+        # ObjectiveWindow streams so /healthz evaluates burn-rate rules,
+        # not just point checks
+        self.extra_text = list(extra_text or [])
+        self.objectives = objectives
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- payloads (also unit-testable without a socket) ---------------------
@@ -133,6 +141,8 @@ class MetricsServer:
         text = self.tracer.export_prometheus()
         if self.monitor is not None:
             text += render_gauges(self.monitor.gauges())
+        for provider in self.extra_text:
+            text += provider()
         return text
 
     def healthz(self) -> Dict[str, Any]:
@@ -144,7 +154,7 @@ class MetricsServer:
         else:
             rules = self.slo_rules or DEFAULT_SLO_RULES
             metrics = counters
-        return evaluate_slos(rules, metrics)
+        return evaluate_slos(rules, metrics, objectives=self.objectives)
 
     def debug_health(self) -> Dict[str, Any]:
         if self.monitor is None:

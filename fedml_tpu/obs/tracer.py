@@ -231,17 +231,23 @@ class Tracer:
         return _SpanCtx(self, name, cat, args)
 
     def complete(self, name: str, duration_s: float, cat: str = "host",
-                 tid: int = COMPILE_TID, **args):
+                 tid: int = COMPILE_TID, end_s_ago: float = 0.0, **args):
         """Retroactive B/E pair on a synthetic lane — for events whose
-        duration is only known after the fact (XLA compiles)."""
+        duration is only known after the fact (XLA compiles; the fedslo
+        request span tree emitted at request finish).  ``end_s_ago``
+        shifts the pair back so finish-time emission can place child
+        phases (queue/prefill/decode) at their true host-clock offsets;
+        ``None``-valued args are dropped, mirroring ``begin``."""
         if not self.enabled:
             return
-        ts1 = self._ts()
+        ts1 = max(self._ts() - float(end_s_ago) * 1e6, 0.0)
         ts0 = max(ts1 - float(duration_s) * 1e6, 0.0)
         base = {"name": name, "pid": self._pid, "tid": tid, "cat": cat,
                 "host": self.host}
         b: Dict[str, Any] = {**base, "ph": "B", "ts": ts0}
-        b["args"] = dict(args, span_id=trace_context.new_span_id())
+        b["args"] = dict(
+            {k: v for k, v in args.items() if v is not None},
+            span_id=trace_context.new_span_id())
         e: Dict[str, Any] = {"name": name, "ph": "E", "ts": ts1,
                              "pid": self._pid, "tid": tid,
                              "host": self.host}

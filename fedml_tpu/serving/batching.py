@@ -32,6 +32,7 @@ so sampling streams match it too.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -43,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import get_tracer
+from ..obs.histogram import ServeHistograms
 from .adapters import AdapterRegistry
 from .templates.openai_compat import (TAIL_BLOCK, PrefixCache,
                                       _build_cached_decode,
@@ -59,7 +61,12 @@ def _unwrap_params(params):
 
 class _Slot:
     __slots__ = ("live", "q", "pos", "remaining", "eos_id", "cur_tok",
-                 "adapter_row")
+                 "adapter_row",
+                 # fedslo request-lifecycle telemetry (host monotonic
+                 # clocks, engine-thread-confined like the decode state)
+                 "t_submit", "t_admit", "t_prefill_end", "t_first",
+                 "prompt_tokens", "out_tokens", "adapter_label",
+                 "traceparent", "drafts_proposed", "drafts_accepted")
 
     def __init__(self):
         self.live = False
@@ -69,6 +76,16 @@ class _Slot:
         self.eos_id: Optional[int] = None
         self.cur_tok = 0
         self.adapter_row = 0
+        self.t_submit = 0.0
+        self.t_admit: Optional[float] = None
+        self.t_prefill_end = 0.0
+        self.t_first: Optional[float] = None
+        self.prompt_tokens = 0
+        self.out_tokens = 0
+        self.adapter_label = "base"
+        self.traceparent: Optional[str] = None
+        self.drafts_proposed = 0
+        self.drafts_accepted = 0
 
 
 class ContinuousBatchingEngine:
@@ -81,15 +98,33 @@ class ContinuousBatchingEngine:
                  prefix_max_tail: int = TAIL_BLOCK,
                  adapter_registry: Optional[AdapterRegistry] = None,
                  adapter_slots: int = 0,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 hist_labels: int = 8,
+                 slo_rules: Optional[List[Dict[str, Any]]] = None):
         self.model = model
+        # fedslo (docs/OBSERVABILITY.md): per-request lifecycle histograms
+        # (TTFT / e2e / queue wait / phase times / decode rate) with
+        # bounded per-adapter labels (first-K + "other", hist_labels caps
+        # the series count), and optional burn-rate objective streams fed
+        # per finished request — host floats only, recorded on the engine
+        # thread at request finish, never inside the jitted step
+        self.serve_hists = ServeHistograms(max_labels=int(hist_labels))
+        self.slo_windows: Dict[str, Any] = {}
+        if slo_rules:
+            from ..obs.slo import windows_for_rules
+            self.slo_windows = windows_for_rules(slo_rules)
         # fedmon live export (docs/OBSERVABILITY.md): metrics_port serves
         # /metrics + /healthz over the global tracer's serve.* gauges
-        # (0 = ephemeral; None = off); closed by stop()
+        # (0 = ephemeral; None = off); closed by stop().  The serve
+        # histograms append to /metrics; the objective windows make
+        # /healthz evaluate multi-window burn rates, not just point rules
         self.metrics_server = None
         if metrics_port is not None:
             from ..obs.metricsd import MetricsServer
-            self.metrics_server = MetricsServer(port=int(metrics_port))
+            self.metrics_server = MetricsServer(
+                port=int(metrics_port), slo_rules=slo_rules,
+                extra_text=[self.serve_hists.render_prometheus],
+                objectives=self.slo_windows or None)
             self.metrics_server.start()
         self.raw_params = _unwrap_params(params)
         self.n_slots = int(slots)
@@ -243,12 +278,15 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids: List[int], max_new_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               adapter: Optional[str] = None) -> "queue.Queue":
+               adapter: Optional[str] = None,
+               traceparent: Optional[str] = None) -> "queue.Queue":
         """Enqueue a request; returns a queue yielding token ids then
         ``None``.  ``adapter`` names a registered bank row (multi-tenant
         engines only; ``KeyError`` for unknown names) — the row is pinned
         until the request finishes, so an eviction or re-registration
-        mid-stream can never change the weights under an in-flight slot."""
+        mid-stream can never change the weights under an in-flight slot.
+        ``traceparent`` (W3C header value) joins the request's span tree
+        to the caller's fedscope trace."""
         out: "queue.Queue" = queue.Queue()
         row, atok = 0, None
         if self.registry is not None:
@@ -266,6 +304,7 @@ class ContinuousBatchingEngine:
             with self._cond:
                 if self._stopped or not self._thread.is_alive():
                     raise RuntimeError("engine stopped")
+                name = adapter if adapter is not None else "base"
                 self._waiting.put({
                     "prompt_ids": list(prompt_ids)[-(self.buf_len - 1):],
                     "max_new_tokens": int(max_new_tokens),
@@ -274,16 +313,28 @@ class ContinuousBatchingEngine:
                     "eos_id": eos_id,
                     "adapter_row": row,
                     "adapter_token": atok,
+                    "adapter_label": name,
+                    "traceparent": traceparent,
+                    "t_submit": time.monotonic(),
                     "q": out,
                 })
-                name = adapter if adapter is not None else "base"
                 with self._stats_lock:   # _cond -> _stats_lock, never reversed
                     reqs = self.serve_stats["requests"]
                     reqs[name] = reqs.get(name, 0) + 1
                     nreq = reqs[name]
+                # bounded-cardinality request counter: ONE metric with an
+                # adapter label (capped at hist_labels + "other"), replacing
+                # PR 9's per-adapter metric NAMES which grew one series per
+                # registered adapter.  The old names re-appear only behind
+                # the deprecation flag, kept for one release.
+                label, label_n = self.serve_hists.labels.resolve(name)
                 tracer = get_tracer()
                 if tracer.enabled:
-                    tracer.counter(f"serve.requests.{name}", nreq)
+                    tracer.counter("serve.requests_by_adapter", label_n,
+                                   adapter=label)
+                    if os.environ.get(
+                            "FEDML_SERVE_LEGACY_ADAPTER_COUNTERS") == "1":
+                        tracer.counter(f"serve.requests.{name}", nreq)
                 self._cond.notify()
         except BaseException:
             if self.registry is not None:
@@ -378,8 +429,11 @@ class ContinuousBatchingEngine:
                 return i
         return None
 
-    def _finish(self, i: int):
+    def _finish(self, i: int, aborted: bool = False):
         s = self._slots[i]
+        if not aborted and s.t_admit is not None:
+            self._observe_finish(i, s)
+        s.t_admit = None
         s.live = False
         if s.q is not None:
             s.q.put(None)
@@ -387,6 +441,52 @@ class ContinuousBatchingEngine:
         if self.registry is not None and s.adapter_row:
             self.registry.release(s.adapter_row)
             s.adapter_row = 0
+
+    def _observe_finish(self, i: int, s: "_Slot") -> None:
+        """fedslo request-lifecycle telemetry at natural completion
+        (engine thread, host clocks only — the jitted step is untouched):
+        the phase breakdown lands in the serve histograms, the objective
+        windows, and — when tracing is on — a retroactive span tree on a
+        per-slot synthetic lane (same-slot requests never overlap, so
+        B/E pairing survives the export's timestamp sort)."""
+        now = time.monotonic()
+        queue_s = max(s.t_admit - s.t_submit, 0.0)
+        prefill_s = max(s.t_prefill_end - s.t_admit, 0.0)
+        e2e_s = max(now - s.t_submit, 0.0)
+        decode_s = max(now - s.t_prefill_end, 0.0)
+        ttft_s = max(s.t_first - s.t_submit, 0.0) \
+            if s.t_first is not None else None
+        self.serve_hists.record_request(
+            s.adapter_label, queue_s=queue_s, prefill_s=prefill_s,
+            e2e_s=e2e_s, ttft_s=ttft_s, decode_s=decode_s,
+            output_tokens=s.out_tokens)
+        for win in self.slo_windows.values():
+            v = {"serve_ttft_seconds": ttft_s,
+                 "serve_e2e_seconds": e2e_s,
+                 "serve_queue_wait_seconds": queue_s,
+                 "serve_prefill_seconds": prefill_s,
+                 "serve_decode_seconds": decode_s}.get(win.metric)
+            if v is not None:
+                win.observe(v)
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        lane = -16 - i  # per-slot synthetic lane, clear of COMPILE_TID
+        tracer.complete(
+            "serve.request", e2e_s, cat="serve", tid=lane,
+            adapter=s.adapter_label, slot=i,
+            prompt_tokens=s.prompt_tokens, output_tokens=s.out_tokens,
+            queue_s=round(queue_s, 6), prefill_s=round(prefill_s, 6),
+            ttft_s=round(ttft_s, 6) if ttft_s is not None else None,
+            decode_s=round(decode_s, 6), e2e_s=round(e2e_s, 6),
+            traceparent=s.traceparent,
+            drafts_proposed=s.drafts_proposed or None,
+            drafts_accepted=(s.drafts_accepted if s.drafts_proposed
+                             else None))
+        tracer.complete("serve.queue", queue_s, cat="serve", tid=lane,
+                        end_s_ago=max(e2e_s - queue_s, 0.0), slot=i)
+        tracer.complete("serve.decode", decode_s, cat="serve", tid=lane,
+                        slot=i)
 
     def _emit(self, i: int, tok: int) -> bool:
         """Deliver one sampled token; returns False when the slot is done
@@ -401,12 +501,16 @@ class ContinuousBatchingEngine:
         s.q.put(tok)
         s.remaining -= 1
         s.cur_tok = tok
+        if s.t_first is None:
+            s.t_first = time.monotonic()
+        s.out_tokens += 1
         with self._stats_lock:
             self.serve_stats["tokens"] += 1
             self._tok_window[1] += 1
         return s.remaining > 0 and s.pos < self.buf_len
 
     def _admit(self, req: dict, slot: int):
+        t_admit = time.monotonic()
         ids = req["prompt_ids"]
         n = len(ids)
         buf = np.zeros((1, self.buf_len), np.int32)
@@ -427,23 +531,33 @@ class ContinuousBatchingEngine:
                                                        atok)
                               if self.prefix_cache is not None and n > 0
                               else (0, None))
-        if hit_cache is not None:
-            # shared replay discipline (openai_compat._replay_tail): exact
-            # hits rewrite only the last position (idempotent); fitting
-            # multi-token tails replay as ONE tail_block dispatch
-            cache = hit_cache
-            start = min(hit_len, n - 1)
-            max_seq = getattr(getattr(self.model, "cfg", None),
-                              "max_seq_len", self.buf_len)
-            tok, cache, key = _replay_tail(
-                partial(self._tail_step, self.raw_params, lora),
-                partial(self._tail_block, self.raw_params, lora),
-                cache, jnp.asarray(buf), ids, start, n, max_seq, key, temp)
-        else:
-            key, sub = jax.random.split(key)
-            tok, cache = self._prefill(self.raw_params, lora,
-                                       jnp.asarray(buf), jnp.int32(n),
-                                       sub, temp)
+        # serve.prefill is the one LIVE phase span (nests under the
+        # caller's serve.admit); it closes on the int() below — the
+        # engine's pre-existing sync point, not a new one
+        with get_tracer().span("serve.prefill", cat="serve", slot=slot,
+                               prompt_tokens=n,
+                               cache_hit=int(hit_cache is not None)):
+            if hit_cache is not None:
+                # shared replay discipline (openai_compat._replay_tail):
+                # exact hits rewrite only the last position (idempotent);
+                # fitting multi-token tails replay as ONE tail_block
+                # dispatch
+                cache = hit_cache
+                start = min(hit_len, n - 1)
+                max_seq = getattr(getattr(self.model, "cfg", None),
+                                  "max_seq_len", self.buf_len)
+                tok, cache, key = _replay_tail(
+                    partial(self._tail_step, self.raw_params, lora),
+                    partial(self._tail_block, self.raw_params, lora),
+                    cache, jnp.asarray(buf), ids, start, n, max_seq, key,
+                    temp)
+            else:
+                key, sub = jax.random.split(key)
+                tok, cache = self._prefill(self.raw_params, lora,
+                                           jnp.asarray(buf), jnp.int32(n),
+                                           sub, temp)
+            tok_host = int(tok)
+        t_prefill_end = time.monotonic()
         if self.prefix_cache is not None and n > 0:
             self.prefix_cache.insert(ids, cache, self.raw_params, atok)
         # decode-state arrays (_caches/_aids/_temps/_keys, and _toks/_poss
@@ -459,10 +573,22 @@ class ContinuousBatchingEngine:
         s.remaining = req["max_new_tokens"]
         s.eos_id = req["eos_id"]
         s.adapter_row = row
+        # request-lifecycle telemetry (engine-thread-confined, read back
+        # by _observe_finish): host clocks + counts only
+        s.t_submit = req.get("t_submit", t_admit)
+        s.t_admit = t_admit
+        s.t_prefill_end = t_prefill_end
+        s.t_first = None
+        s.prompt_tokens = n
+        s.out_tokens = 0
+        s.adapter_label = req.get("adapter_label", "base")
+        s.traceparent = req.get("traceparent")
+        s.drafts_proposed = 0
+        s.drafts_accepted = 0
         self._aids[slot] = row  # fedrace: disable=unguarded-shared-write
         self._temps[slot] = req["temperature"]  # fedrace: disable=unguarded-shared-write
         self._keys[slot] = np.asarray(key)  # fedrace: disable=unguarded-shared-write
-        if not self._emit(slot, int(tok)):
+        if not self._emit(slot, tok_host):
             self._finish(slot)
 
     def _drain_waiting(self):
@@ -485,7 +611,7 @@ class ContinuousBatchingEngine:
                 self._stopped = True
                 for i, s in enumerate(self._slots):
                     if s.live:
-                        self._finish(i)
+                        self._finish(i, aborted=True)
                 self._drain_waiting()
                 self._cond.notify_all()  # wake update_params waiters
 
@@ -499,7 +625,7 @@ class ContinuousBatchingEngine:
                 if self._stopped:
                     for i, s in enumerate(self._slots):
                         if s.live:
-                            self._finish(i)
+                            self._finish(i, aborted=True)
                     self._drain_waiting()
                     self._cond.notify_all()
                     return
@@ -612,7 +738,9 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     def __init__(self, model, params, draft_model, draft_params,
                  slots: int = 4, buf_len: int = 256, k: int = 4,
                  prefix_cache_slots: int = 0,
-                 prefix_max_tail: int = TAIL_BLOCK):
+                 prefix_max_tail: int = TAIL_BLOCK,
+                 hist_labels: int = 8,
+                 slo_rules: Optional[List[Dict[str, Any]]] = None):
         self.k = int(k)
         assert self.k >= 1
         for m, name in ((model, "model"), (draft_model, "draft_model")):
@@ -635,7 +763,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         super().__init__(model, params, slots=slots, buf_len=buf_len,
                          top_k=0, horizon=1,
                          prefix_cache_slots=prefix_cache_slots,
-                         prefix_max_tail=prefix_max_tail)
+                         prefix_max_tail=prefix_max_tail,
+                         hist_labels=hist_labels, slo_rules=slo_rules)
 
         from ..llm.quantization import dequantize_params, weight_dtype
         t_wdtype = weight_dtype(model)
@@ -693,7 +822,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     def submit(self, prompt_ids, max_new_tokens: int = 64,
                temperature: float = 0.0, seed: int = 0, eos_id=None,
-               adapter: Optional[str] = None):
+               adapter: Optional[str] = None,
+               traceparent: Optional[str] = None):
         if float(temperature) != 0.0:
             raise ValueError("SpeculativeBatchingEngine is greedy-only "
                              "(temperature 0); use ContinuousBatchingEngine "
@@ -702,7 +832,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         # registry), so the kwarg just rides through for signature parity
         return super().submit(prompt_ids, max_new_tokens=max_new_tokens,
                               temperature=0.0, seed=seed, eos_id=eos_id,
-                              adapter=adapter)
+                              adapter=adapter, traceparent=traceparent)
 
     def _admit(self, req, slot):
         self._hist[slot] = list(req["prompt_ids"])
@@ -757,6 +887,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 # truncate the acceptance loop mid-block, and charging the
                 # full k would understate real draft acceptance
                 self.stats["proposed"] += 1
+                s.drafts_proposed += 1
                 dj, gj = int(d_host[i, j]), int(g_host[i, j])
                 s.pos += 1
                 if dj != gj:
@@ -765,6 +896,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                         self._finish(i)
                     break
                 self.stats["accepted"] += 1
+                s.drafts_accepted += 1
                 if not self._emit(i, dj):
                     self._finish(i)
                     break

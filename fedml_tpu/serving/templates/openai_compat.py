@@ -39,6 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...obs.context import parse_traceparent
+from ...obs.tracer import get_tracer
+
 log = logging.getLogger(__name__)
 
 
@@ -455,7 +458,8 @@ class OpenAICompatServer:
                  prefix_cache_slots: int = 0,
                  prefix_max_tail: int = TAIL_BLOCK,
                  adapters=None, adapter_slots: int = 0,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 slo_rules: Optional[List[dict]] = None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -477,6 +481,10 @@ class OpenAICompatServer:
         # the tracer's serve.* gauges (started/stopped with the server)
         self.metrics_port = metrics_port
         self.metrics_server = None
+        # fedslo: objective-style SLO rules ride into the engine (per-
+        # request burn-rate streams) and the metrics endpoint (/healthz
+        # multi-window evaluation) — see docs/OBSERVABILITY.md
+        self.slo_rules = slo_rules
         self.buf_len = buf_len
         self.model = model
         # speculative decode (requires model + a draft; greedy requests
@@ -581,7 +589,8 @@ class OpenAICompatServer:
                     slots=int(batch_slots), buf_len=buf_len,
                     k=int(spec_k),
                     prefix_cache_slots=int(prefix_cache_slots),
-                    prefix_max_tail=int(prefix_max_tail))
+                    prefix_max_tail=int(prefix_max_tail),
+                    slo_rules=slo_rules)
                 self.prefix_cache = self._engine.prefix_cache
                 self._engine_greedy_only = True
             else:
@@ -591,19 +600,24 @@ class OpenAICompatServer:
                     horizon=int(decode_horizon),
                     prefix_cache_slots=int(prefix_cache_slots),
                     prefix_max_tail=int(prefix_max_tail),
-                    adapter_registry=self.registry)
+                    adapter_registry=self.registry,
+                    slo_rules=slo_rules)
                 self.prefix_cache = self._engine.prefix_cache
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------
     def _complete(self, prompt: str, req: dict,
-                  on_text: Optional[Callable[[str], None]] = None) -> str:
+                  on_text: Optional[Callable[[str], None]] = None,
+                  traceparent: Optional[str] = None) -> str:
         """Run generation; ``on_text`` (if given) receives incremental text
         deltas on UTF-8 boundaries — a raw per-token decode would shred
-        multi-byte characters with the byte tokenizer."""
+        multi-byte characters with the byte tokenizer.  ``traceparent``
+        (validated W3C header value) joins the request's span tree to the
+        caller's fedscope trace."""
         tok = self.tokenizer
         ids: List[int] = []
         sent = 0
+        t_submit = time.monotonic()
 
         def emit(t: int):
             nonlocal sent
@@ -675,7 +689,8 @@ class OpenAICompatServer:
                     temperature=temp,
                     seed=int(req.get("seed", 0)),
                     eos_id=getattr(tok, "eos_id", None),
-                    adapter=adapter_name)
+                    adapter=adapter_name,
+                    traceparent=traceparent)
             except KeyError as e:
                 # unknown adapter — resolved at submit so the 404 happens
                 # before any slot/queue state is touched
@@ -733,6 +748,19 @@ class OpenAICompatServer:
             finally:
                 if release_row is not None:
                     self.registry.release(release_row)
+            # the engine emits its own request span tree at _finish; the
+            # single-request fall-through emits one here (HTTP-thread
+            # lane, host clocks) so every served request has a
+            # serve.request span regardless of path
+            tracer = get_tracer()
+            if tracer.enabled:
+                e2e_s = time.monotonic() - t_submit
+                tracer.complete(
+                    "serve.request", e2e_s, cat="serve",
+                    tid=threading.get_ident(),
+                    adapter=adapter_name or "base",
+                    output_tokens=len(out), e2e_s=round(e2e_s, 6),
+                    traceparent=traceparent, path="fallthrough")
         text = tok.decode(out)
         if on_text and len(text) > sent:
             on_text(text[sent:])  # flush any held-back tail
@@ -777,7 +805,8 @@ class OpenAICompatServer:
                     self.wfile.write(f"data: {data}\n\n".encode())
                     self.wfile.flush()
 
-                run(write_piece)
+                with get_tracer().span("serve.stream", cat="serve"):
+                    run(write_piece)
                 self.wfile.write(b"data: [DONE]\n\n")
 
             def do_POST(self):
@@ -789,6 +818,12 @@ class OpenAICompatServer:
                     return
                 rid = f"cmpl-{uuid.uuid4().hex[:24]}"
                 now = int(time.time())
+                # fedscope trace context: a valid W3C traceparent header
+                # joins this request's span tree to the caller's trace
+                # (malformed values are dropped, not propagated)
+                tp_raw = self.headers.get("traceparent")
+                tparent = tp_raw if (tp_raw and
+                                     parse_traceparent(tp_raw)) else None
                 try:
                     if self.path == "/v1/chat/completions":
                         prompt = _render_chat(req.get("messages", []))
@@ -802,9 +837,11 @@ class OpenAICompatServer:
                                                  {"content": p},
                                                  "finish_reason": None}]},
                                 lambda writer: outer._complete(
-                                    prompt, req, on_text=writer))
+                                    prompt, req, on_text=writer,
+                                    traceparent=tparent))
                             return
-                        text = outer._complete(prompt, req)
+                        text = outer._complete(prompt, req,
+                                               traceparent=tparent)
                         self._send_json(200, {
                             "id": rid, "object": "chat.completion",
                             "created": now, "model": outer.model_name,
@@ -813,7 +850,8 @@ class OpenAICompatServer:
                                           "content": text},
                                          "finish_reason": "stop"}]})
                     elif self.path == "/v1/completions":
-                        text = outer._complete(str(req.get("prompt", "")), req)
+                        text = outer._complete(str(req.get("prompt", "")),
+                                               req, traceparent=tparent)
                         self._send_json(200, {
                             "id": rid, "object": "text_completion",
                             "created": now, "model": outer.model_name,
@@ -914,8 +952,16 @@ class OpenAICompatServer:
                          daemon=True).start()
         if self.metrics_port is not None and self.metrics_server is None:
             from ...obs.metricsd import MetricsServer
+            extra, objectives = [], None
+            if self._engine is not None:
+                # the engine's request-lifecycle histograms append to
+                # /metrics; its objective windows drive /healthz burn rates
+                extra = [self._engine.serve_hists.render_prometheus]
+                objectives = self._engine.slo_windows or None
             self.metrics_server = MetricsServer(
-                port=int(self.metrics_port), host=self.host)
+                port=int(self.metrics_port), host=self.host,
+                slo_rules=self.slo_rules, extra_text=extra,
+                objectives=objectives)
             self.metrics_server.start()
         log.info("openai-compatible endpoint on %s:%d", self.host, self.port)
         return self.port

@@ -1,0 +1,49 @@
+"""Fixture: a fedslo HISTOGRAM sink fed a traced/device value inside the
+compiled step (the serving-latency sibling of the tracer/health-sink
+rules).
+
+``ttft_hist.record(...)`` / ``serve_hists.decode.observe_latency(...)``
+bucket already-materialized host floats — handing one a traced scalar
+inside a jitted region forces a blocking device→host sync at that exact
+line (or a trace error).  The clean form measures with host clocks at
+the engine's EXISTING sync point (the ``int(tok)`` after dispatch) and
+records outside the traced function (docs/OBSERVABILITY.md).
+"""
+import jax
+import jax.numpy as jnp
+
+
+class Histogram:
+    """Stand-in for fedml_tpu.obs.histogram.Histogram (host sink)."""
+
+    def record(self, *a, **k):
+        pass
+
+    def observe_latency(self, *a, **k):
+        pass
+
+
+ttft_hist = Histogram()
+decode_histogram = Histogram()
+
+
+@jax.jit
+def decode_step_leaky(state, tok):
+    logits = state @ jnp.ones((state.shape[-1], 4))
+    ttft_hist.record(jnp.max(logits))                     # traced -> sync
+    decode_histogram.observe_latency(logits[0], label="base")  # same, arg
+    return jnp.argmax(logits, axis=-1)
+
+
+@jax.jit
+def decode_step_clean(state, tok):
+    logits = state @ jnp.ones((state.shape[-1], 4))
+    return jnp.argmax(logits, axis=-1)
+
+
+def engine_loop(state, tok, t_admit, now):
+    out = decode_step_clean(state, tok)
+    tok_host = int(out[0])  # the engine's pre-existing sync point
+    # host clocks AFTER the sync — the sanctioned measurement point
+    ttft_hist.record(now - t_admit, label="base")
+    return tok_host

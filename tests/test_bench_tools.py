@@ -151,6 +151,36 @@ def test_bench_serve_mt_quick(monkeypatch):
     assert load["tokens_per_s"] > 0
 
 
+def test_bench_serve_slo_quick(monkeypatch):
+    """FEDML_SLO_QUICK smoke (fedslo, docs/OBSERVABILITY.md): bench.py
+    --serve-slo runs the serving-SLO plane green end-to-end — telemetry
+    on ≡ off under JaxRuntimeAudit with zero steady-state recompiles,
+    burn-rate windows ok on clean traffic, the CanaryJudge promoting the
+    clean candidate AND rolling back the service-time-degraded one, and
+    the two-engine fleet's merged native histograms agreeing with exact
+    sample quantiles within one bucket width (the ≤2% overhead
+    acceptance number comes from the full-size BENCH_r15 run — the
+    trimmed battery is too short to measure it)."""
+    bench = _import_bench()
+    monkeypatch.setenv("FEDML_SLO_QUICK", "1")
+    out = bench.serve_slo_bench()
+    assert out["quick"] is True
+    assert out["steady_state_recompiles"] == 0
+    assert out["audit_equal_on_off"] == 1
+    assert out["tok_s_telemetry_off"] > 0
+    assert out["tok_s_telemetry_on"] > 0
+    assert out["slo_status"] == "ok"
+    assert out["serve_ttft_p99_ms"] > 0
+    slo = out["serve_slo"]
+    assert slo["promote_verdict"] == "promote"
+    assert slo["rollback_verdict"] == "rollback"
+    assert slo["rollback_detected"] == 1
+    assert slo["rollback_bad_fraction"] > 0
+    assert slo["audit_records"] == 2 and slo["audit_valid"] == 1
+    assert slo["fleet_merge_ok"] == 1
+    assert all(slo["merge_checks"].values())
+
+
 def test_bench_health_quick(monkeypatch):
     """FEDML_HEALTH_QUICK smoke (ISSUE 14): bench.py --health runs the
     fedmon plane green end-to-end — label-flip detection verdict on a

@@ -303,6 +303,28 @@ def test_fedtrace_golden_values_are_hand_checkable():
     assert s["async_sim_time_s"] == 12.5
     assert s["spans"]["async.dispatch"] == {"count": 1, "total_s": 0.03}
     assert s["spans"]["async.arrival"] == {"count": 2, "total_s": 0.002}
+    # fedslo serving section (docs/OBSERVABILITY.md): three requests with
+    # round-number phase args — ttft 0.035/0.06/0.09 gives p50 = 0.06 and
+    # p99 = 0.06 + 0.98*(0.09-0.06) = 0.0894 (linear interpolation);
+    # e2e 0.1/0.2/0.3 -> p99 0.298; queue 0.01/0.02/0.03 -> p99 0.0298;
+    # phase shares are the summed phases over the 0.6s e2e total
+    # (0.06/0.10/0.44).  Adapter counts merge the bounded-label counter
+    # (cohort7=2, base=1) with the deprecated per-name counters
+    # (base=2, cohort7=5) by max.
+    assert s["serve_requests"] == 3
+    assert s["serve_ttft_p50"] == 0.06
+    assert s["serve_ttft_p99"] == 0.0894
+    assert s["serve_e2e_p99"] == 0.298
+    assert s["serve_queue_wait_p99"] == 0.0298
+    assert s["serve_phase_breakdown"] == {"queue": 0.1,
+                                          "prefill": 0.166667,
+                                          "decode": 0.733333}
+    assert s["serve_adapter_requests"] == {"base": 2, "cohort7": 5}
+    assert s["serve_adapter_shares"] == {"base": 0.285714,
+                                         "cohort7": 0.714286}
+    assert s["spans"]["serve.request"] == {"count": 3, "total_s": 0.6}
+    assert s["spans"]["serve.queue"] == {"count": 3, "total_s": 0.06}
+    assert s["spans"]["serve.decode"] == {"count": 3, "total_s": 0.44}
 
 
 def _run_cli(*args):

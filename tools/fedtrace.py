@@ -342,11 +342,70 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
             counters["serve.tokens_per_s"], 6)
     if "serve.tokens_total" in counters:
         out["serve_tokens_total"] = counters["serve.tokens_total"]
-    adapter_reqs = {k[len("serve.requests."):]: int(v)
-                    for k, v in counters.items()
-                    if k.startswith("serve.requests.")}
+    # per-adapter request counts: the bounded-label counter (ONE metric,
+    # ``adapter`` arg, capped at top-K + "other") is authoritative; the
+    # deprecated per-adapter metric NAMES (serve.requests.<name>, behind
+    # FEDML_SERVE_LEGACY_ADAPTER_COUNTERS for one release) merge in by
+    # max so a flag-on trace doesn't double count
+    adapter_reqs: Dict[str, int] = {}
+    for e in events:
+        if (e.get("ph") == "C"
+                and e.get("name") == "serve.requests_by_adapter"):
+            a = e.get("args") or {}
+            if "adapter" in a:
+                adapter_reqs[str(a["adapter"])] = int(a["value"])
+    for k, v in counters.items():
+        if k.startswith("serve.requests."):
+            name = k[len("serve.requests."):]
+            adapter_reqs[name] = max(adapter_reqs.get(name, 0), int(v))
     if adapter_reqs:
         out["serve_adapter_requests"] = adapter_reqs
+        total_req = sum(adapter_reqs.values())
+        if total_req:
+            out["serve_adapter_shares"] = {
+                k: round(v / total_req, 6)
+                for k, v in sorted(adapter_reqs.items())}
+    # fedslo request lifecycle (docs/OBSERVABILITY.md): each finished
+    # request's serve.request span carries its full host-clock phase
+    # breakdown in the B-event args, so the percentiles here are exact
+    # over the trace's requests (hand-checkable against the mini-trace
+    # golden), not bucket estimates
+    req_args = [e.get("args") or {} for e in events
+                if e.get("ph") == "B" and e.get("name") == "serve.request"]
+    if req_args:
+        out["serve_requests"] = len(req_args)
+
+        def _vals(key):
+            return sorted(float(a[key]) for a in req_args if key in a)
+
+        def _pct(vals, q):
+            # linear interpolation between closest ranks (numpy default)
+            if not vals:
+                return None
+            pos = (len(vals) - 1) * q
+            lo = int(pos)
+            hi = min(lo + 1, len(vals) - 1)
+            return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+        ttft, e2e, qw = _vals("ttft_s"), _vals("e2e_s"), _vals("queue_s")
+        if ttft:
+            out["serve_ttft_p50"] = round(_pct(ttft, 0.50), 6)
+            out["serve_ttft_p99"] = round(_pct(ttft, 0.99), 6)
+        if e2e:
+            out["serve_e2e_p99"] = round(_pct(e2e, 0.99), 6)
+        if qw:
+            out["serve_queue_wait_p99"] = round(_pct(qw, 0.99), 6)
+        e2e_total = sum(e2e)
+        if e2e_total > 0:
+            out["serve_phase_breakdown"] = {
+                ph: round(sum(float(a.get(f"{ph}_s", 0.0))
+                              for a in req_args) / e2e_total, 6)
+                for ph in ("queue", "prefill", "decode")}
+        drafts = sum(int(a.get("drafts_proposed", 0)) for a in req_args)
+        if drafts:
+            out["serve_drafts_proposed"] = drafts
+            out["serve_drafts_accepted"] = sum(
+                int(a.get("drafts_accepted", 0)) for a in req_args)
     return out
 
 
@@ -856,6 +915,16 @@ def _render_summary(s: Dict[str, Any]) -> str:
             f"queue depth (last) {s.get('serve_queue_depth_last', 0.0):g}   "
             f"tokens/s (last) {s.get('serve_tokens_per_s_last', 0.0):g}   "
             f"{len(ad)} adapters / {sum(ad.values())} requests")
+    if "serve_requests" in s:
+        pb = s.get("serve_phase_breakdown", {})
+        lines.append(
+            f"serve slo: {s['serve_requests']} requests   ttft p50/p99 "
+            f"{s.get('serve_ttft_p50', 0.0):g}/"
+            f"{s.get('serve_ttft_p99', 0.0):g}s   e2e p99 "
+            f"{s.get('serve_e2e_p99', 0.0):g}s   queue p99 "
+            f"{s.get('serve_queue_wait_p99', 0.0):g}s   phases "
+            + "/".join(f"{p} {pb.get(p, 0.0):.0%}"
+                       for p in ("queue", "prefill", "decode")))
     if s.get("device_phase_source") == "measured":
         lines.append("device phases: MEASURED (trace_device probe; "
                      "FLOP proxy deltas "

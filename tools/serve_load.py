@@ -24,10 +24,17 @@ p50/p99 of both, aggregate tokens/s, achieved admission RPS vs target,
 and the admission-queue depth envelope — the numbers ``bench.py
 --serve-mt`` folds into the BENCH json.
 
+``--multi N`` (fedslo, docs/OBSERVABILITY.md) drives N independent
+engine replicas, scrapes each one's live ``/metrics``, and merges the
+native ``serve_ttft_seconds`` histograms by bucket addition into FLEET
+percentiles — then cross-checks the bucket-estimated fleet p50/p99
+against the harness's exact sample percentiles (must agree within one
+bucket width, the merge-correctness canary for multi-replica scrapes).
+
 Usage (self-contained tiny-model demo):
     python tools/serve_load.py [--rps 20] [--requests 64] [--adapters 8]
-Writes SERVE_LOAD.json at the repo root; ``run_load`` is importable for
-driving any engine in-process.
+Writes SERVE_LOAD.json at the repo root; ``run_load`` / ``run_fleet``
+are importable for driving any engine(s) in-process.
 """
 
 from __future__ import annotations
@@ -67,7 +74,8 @@ def run_load(engine, *, target_rps: float, n_requests: int,
              vocab: int = 256, seed: int = 0,
              timeout_s: float = 300.0,
              scrape_url: Optional[str] = None,
-             scrape_rel_tol: float = 0.6) -> Dict:
+             scrape_rel_tol: float = 0.6,
+             keep_samples: bool = False) -> Dict:
     """Drive ``engine`` at ``target_rps`` and report the latency/throughput
     envelope.  ``adapters`` lists the routing choices in popularity order
     (``None`` = base traffic); the Zipf mix makes the first entries hot.
@@ -96,6 +104,9 @@ def run_load(engine, *, target_rps: float, n_requests: int,
 
     lat: List[float] = [0.0] * n_requests
     ttft: List[float] = [0.0] * n_requests
+    # first token since the actual submit call (the engine's own ttft
+    # clock convention) — what histogram cross-checks compare against
+    ttft_sub: List[float] = [0.0] * n_requests
     toks: List[int] = [0] * n_requests
     failed: List[int] = []
     queue_depths: List[int] = []
@@ -105,7 +116,7 @@ def run_load(engine, *, target_rps: float, n_requests: int,
     # windowed tokens/s to compare against the engine's windowed gauge
     tok_clock = [0]
 
-    def collect(i: int, q, t_sched: float):
+    def collect(i: int, q, t_sched: float, t_sub: float):
         first = None
         count = 0
         deadline = time.monotonic() + timeout_s
@@ -127,6 +138,7 @@ def run_load(engine, *, target_rps: float, n_requests: int,
         with lock:
             lat[i] = now - t_sched
             ttft[i] = first - t_sched
+            ttft_sub[i] = first - t_sub
             toks[i] = count
 
     scrape: Dict[str, float] = {}
@@ -178,6 +190,7 @@ def run_load(engine, *, target_rps: float, n_requests: int,
         name = adapters[int(choice[i])]
         adapter_counts[name or "base"] = \
             adapter_counts.get(name or "base", 0) + 1
+        t_sub = time.monotonic()
         q = engine.submit(prompts[i], max_new_tokens=max_new_tokens,
                           adapter=name) if name is not None else \
             engine.submit(prompts[i], max_new_tokens=max_new_tokens)
@@ -185,7 +198,7 @@ def run_load(engine, *, target_rps: float, n_requests: int,
         if scrape_url and i == scrape_at:
             scrape_thread = threading.Thread(target=do_scrape, daemon=True)
             scrape_thread.start()
-        th = threading.Thread(target=collect, args=(i, q, t_sched),
+        th = threading.Thread(target=collect, args=(i, q, t_sched, t_sub),
                               daemon=True)
         th.start()
         threads.append(th)
@@ -265,6 +278,129 @@ def run_load(engine, *, target_rps: float, n_requests: int,
         "prompt_len_max_actual": int(np.max(lens)),
         "makespan_s": round(makespan, 3),
         **({"scrape": scrape_report} if scrape_report is not None else {}),
+        # raw per-request samples for fleet-level exact percentiles
+        # (run_fleet pops this before reporting)
+        **({"_samples": {"ttft": ttft_ok,
+                         "ttft_submit": [ttft_sub[i] for i in ok],
+                         "latency": lat_ok}}
+           if keep_samples else {}),
+    }
+
+
+def merge_fleet_histograms(texts: Sequence[str],
+                           metric: str = "serve_ttft_seconds",
+                           label_key: str = "adapter",
+                           baseline_texts: Optional[Sequence[str]] = None
+                           ) -> Dict:
+    """Merge N engines' ``/metrics`` texts into fleet histogram entries
+    (fedslo, docs/OBSERVABILITY.md): parse each scrape, reassemble the
+    native histogram per adapter label, and add buckets — valid because
+    every engine shares the same fixed boundary grid.
+
+    ``baseline_texts`` (one earlier scrape per engine, same order)
+    subtracts each engine's pre-window counts first — the Prometheus
+    ``rate()`` discipline, which is how warm-up/compile requests are
+    kept out of a measurement window over cumulative histograms.
+
+    Returns ``{"labels": {label: entry}, "fleet": entry|None}`` where
+    each entry is ``snapshot()``-shaped (feed it straight to
+    :func:`~fedml_tpu.obs.histogram.quantile_from_buckets`).
+    """
+    from fedml_tpu.obs.histogram import (buckets_from_samples,
+                                         diff_bucket_entries,
+                                         merge_bucket_entries)
+    from fedml_tpu.obs.metricsd import parse_prometheus_text
+    per_engine = [buckets_from_samples(parse_prometheus_text(t), metric,
+                                       label_key=label_key)
+                  for t in texts]
+    if baseline_texts is not None:
+        base = [buckets_from_samples(parse_prometheus_text(t), metric,
+                                     label_key=label_key)
+                for t in baseline_texts]
+        per_engine = [{lbl: diff_bucket_entries(e, b.get(lbl))
+                       for lbl, e in pe.items()}
+                      for pe, b in zip(per_engine, base)]
+    labels = sorted({lbl for pe in per_engine for lbl in pe})
+    merged = {lbl: merge_bucket_entries([pe.get(lbl) for pe in per_engine])
+              for lbl in labels}
+    fleet = merge_bucket_entries([e for pe in per_engine
+                                  for e in pe.values()])
+    return {"labels": merged, "fleet": fleet}
+
+
+def run_fleet(engines: Sequence, metrics_urls: Sequence[str], *,
+              target_rps: float, n_requests: int,
+              adapters: Sequence[Optional[str]] = (None,),
+              max_new_tokens: int = 16, vocab: int = 256, seed: int = 0,
+              timeout_s: float = 300.0) -> Dict:
+    """Drive each engine replica with an equal share of the load, scrape
+    every live ``/metrics`` endpoint, and merge the per-engine native
+    TTFT histograms into fleet percentiles by bucket addition.
+
+    The cross-check: the bucket-estimated fleet p50/p99 must land within
+    one bucket width of the harness's exact sample percentiles over ALL
+    replicas' requests (``merge_ok``) — if merging were wrong (boundary
+    drift, double count, dropped replica) the estimate falls outside the
+    width guarantee of a single correct histogram.
+    """
+    import urllib.request
+
+    from fedml_tpu.obs.histogram import (bucket_width_at,
+                                         quantile_from_buckets)
+
+    def _scrape(u: str) -> str:
+        url = u.rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        return urllib.request.urlopen(url, timeout=10).read().decode()
+
+    n_eng = len(engines)
+    share = max(1, n_requests // n_eng)
+    # pre-window scrape: whatever the engines served before this run
+    # (warm-up/compile requests) is subtracted rate()-style
+    baseline_texts = [_scrape(u) for u in metrics_urls]
+    reports: List[Dict] = []
+    ttft_all: List[float] = []
+    for k, eng in enumerate(engines):
+        rep = run_load(eng, target_rps=target_rps / n_eng,
+                       n_requests=share, adapters=adapters,
+                       max_new_tokens=max_new_tokens, vocab=vocab,
+                       seed=seed + 101 * k, timeout_s=timeout_s,
+                       keep_samples=True)
+        # submit-based samples: the engine's own ttft clock convention,
+        # so the check exercises the histogram algebra, not the gap
+        # between scheduled-arrival and submit clocks
+        ttft_all.extend(rep.pop("_samples")["ttft_submit"])
+        reports.append(rep)
+    texts = [_scrape(u) for u in metrics_urls]
+    merged = merge_fleet_histograms(texts, metric="serve_ttft_seconds",
+                                    baseline_texts=baseline_texts)
+    fleet = merged["fleet"]
+    checks: Dict[str, bool] = {}
+    fleet_pct: Dict[str, Optional[float]] = {}
+    if fleet is not None and ttft_all:
+        for qname, q in (("p50", 0.50), ("p99", 0.99)):
+            est = quantile_from_buckets(fleet, q)
+            exact = _percentile(ttft_all, q * 100.0)
+            width = bucket_width_at(fleet, exact)
+            fleet_pct[qname] = est
+            checks[f"ttft_{qname}_within_bucket"] = (
+                est is not None and abs(est - exact) <= width + 1e-9)
+        checks["fleet_count_matches"] = \
+            fleet["count"] == sum(r["completed"] for r in reports)
+    return {
+        "engines": n_eng,
+        "fleet_requests": sum(r["completed"] for r in reports),
+        "fleet_failed": sum(r["failed"] for r in reports),
+        "fleet_tokens_per_s": round(sum(r["tokens_per_s"]
+                                        for r in reports), 1),
+        "fleet_ttft_p50_ms": round((fleet_pct.get("p50") or 0.0) * 1e3, 2),
+        "fleet_ttft_p99_ms": round((fleet_pct.get("p99") or 0.0) * 1e3, 2),
+        "fleet_hist_count": int(fleet["count"]) if fleet else 0,
+        "adapter_labels": sorted(merged["labels"]),
+        "merge_checks": checks,
+        "merge_ok": bool(checks) and all(checks.values()),
+        "per_engine": reports,
     }
 
 
@@ -278,6 +414,11 @@ def main():
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=os.path.join(REPO, "SERVE_LOAD.json"))
+    ap.add_argument("--multi", type=int, default=1, metavar="N",
+                    help="drive N engine replicas, scrape each /metrics, "
+                         "merge the native TTFT histograms into fleet "
+                         "percentiles and cross-check them against exact "
+                         "sample percentiles (fedslo)")
     ap.add_argument("--scrape-metrics", default=None, metavar="URL",
                     help="scrape this live fedmon /metrics endpoint "
                          "mid-run and cross-check the serve.* gauges "
@@ -301,6 +442,39 @@ def main():
     model = LlamaLM(cfg)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 8), jnp.int32))
+
+    if args.multi > 1:
+        engines = []
+        for _k in range(args.multi):
+            eng = ContinuousBatchingEngine(
+                model, variables["params"], slots=args.slots,
+                buf_len=buf_len, adapter_slots=args.adapters + 2,
+                metrics_port=0)
+            for i in range(args.adapters):
+                eng.registry.register(
+                    f"cohort{i}",
+                    lora_init(jax.random.PRNGKey(100 + i),
+                              variables["lora"]))
+            engines.append(eng)
+        names = [f"cohort{i}" for i in range(args.adapters)]
+        try:
+            for eng in engines:   # warm both compiled programs off-clock
+                eng.generate([5, 17, 42], max_new_tokens=2,
+                             adapter=names[0] if names else None)
+            report = run_fleet(
+                engines, [e.metrics_server.url for e in engines],
+                target_rps=args.rps, n_requests=args.requests,
+                adapters=[None] + names,
+                max_new_tokens=args.max_new_tokens,
+                vocab=cfg.vocab_size, seed=args.seed)
+        finally:
+            for eng in engines:
+                eng.stop()
+        print(json.dumps(report))
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        return
+
     engine = ContinuousBatchingEngine(
         model, variables["params"], slots=args.slots, buf_len=buf_len,
         adapter_slots=args.adapters + 2)

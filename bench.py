@@ -2715,6 +2715,234 @@ def serve_slo_bench() -> dict:
     return result
 
 
+def serve_paged_bench() -> dict:
+    """fedkv (ISSUE 20): the paged serving memory plane.
+
+    Three acceptance pins land in the BENCH row:
+
+    - slot capacity at EQUAL KV HBM: a dense engine reserves buf_len
+      tokens of KV per slot up front, the paged engine reserves only the
+      pages each request needs — with the same pool bytes the paged
+      engine must sustain >= 1.5x concurrently live slots (measured as
+      peak live occupancy under an over-subscribed burst, not computed
+      from the block math);
+    - latency under a long-prompt mix: chunked prefill keeps TTFT and
+      e2e p50/p99 bounded while decode lanes keep ticking;
+    - adapter scale at FLAT bank HBM: one engine serving 32 -> 10k
+      registered adapter names through an N-row cache over the fedstore
+      tier, with the bank's resident bytes pinned constant across the
+      sweep and the hit-rate / latency curve recorded per scale.
+
+    Plus the standing serving invariant: ZERO steady-state recompiles
+    (JaxRuntimeAudit) across page churn, prefix sharing, and adapter
+    miss -> evict -> page-in cycles.
+    """
+    import queue
+
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.analysis.runtime import JaxRuntimeAudit
+    from fedml_tpu.llm.fedllm import lora_init
+    from fedml_tpu.llm.model import LlamaConfig, LlamaLM
+    from fedml_tpu.serving.batching import ContinuousBatchingEngine
+
+    quick = os.environ.get("FEDML_SERVE_PAGED_QUICK") == "1"
+    buf = 128 if quick else 256
+    ptok = 16
+    dense_slots = 2 if quick else 4
+    n_new = 8 if quick else 16
+    cfg = LlamaConfig(vocab_size=258, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=4, ffn_dim=128, max_seq_len=buf,
+                      dtype=jnp.float32, lora_rank=0)
+    model = LlamaLM(cfg)
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+
+    head_dim = cfg.dim // cfg.n_heads
+    # dense engine: 2 (k,v) * layers * hkv * buf * d fp32 per slot,
+    # reserved up front whatever the request needs
+    dense_slot_bytes = 2 * cfg.n_layers * cfg.n_kv_heads * buf * head_dim * 4
+    kv_budget = dense_slots * dense_slot_bytes
+    # paged pool at the SAME budget: page bytes across layers and k/v
+    page_bytes = 2 * cfg.n_layers * cfg.n_kv_heads * ptok * head_dim * 4
+    pool_pages = kv_budget // page_bytes + 1  # +1: page 0 is the trash page
+    # over-subscribe the slot array; live occupancy is page-limited
+    paged_slots = dense_slots * 8
+
+    result = {"quick": quick, "kv_hbm_budget_mib":
+              round(kv_budget / 2**20, 3),
+              "dense_slots_equal_hbm": dense_slots,
+              "kv_page_tokens": ptok, "kv_pool_pages": int(pool_pages)}
+
+    def _row(name, value):
+        result[name] = value
+        print(f"[serve-paged-row] {name}={value} "
+              f"t={time.perf_counter():.0f}", flush=True)
+
+    def _peak_live(engine, prompts, n_new):
+        """Submit the burst, sample peak concurrent live+prefilling
+        occupancy while draining, and return (peak, ttft_ms, e2e_ms)."""
+        t0 = {}
+        qs = []
+        for i, p in enumerate(prompts):
+            t0[i] = time.perf_counter()
+            qs.append(engine.submit(p, max_new_tokens=n_new))
+        peak, ttft, e2e = 0, [], []
+        pending = {i: q for i, q in enumerate(qs)}
+        first = {}
+        while pending:
+            occ = sum(1 for s in engine._slots if s.live or s.prefilling)
+            peak = max(peak, occ)
+            done = []
+            for i, q in list(pending.items()):
+                try:
+                    tok = q.get(timeout=0.002)
+                except queue.Empty:
+                    continue
+                now = time.perf_counter()
+                if i not in first:
+                    first[i] = now
+                if tok is None:
+                    ttft.append((first[i] - t0[i]) * 1e3)
+                    e2e.append((now - t0[i]) * 1e3)
+                    done.append(i)
+            for i in done:
+                del pending[i]
+        ttft.sort(); e2e.sort()
+        pct = lambda xs, p: xs[min(len(xs) - 1, int(p * len(xs)))]
+        return peak, {"ttft_p50_ms": round(pct(ttft, 0.50), 2),
+                      "ttft_p99_ms": round(pct(ttft, 0.99), 2),
+                      "e2e_p50_ms": round(pct(e2e, 0.50), 2),
+                      "e2e_p99_ms": round(pct(e2e, 0.99), 2)}
+
+    # long-prompt mix: heavy-tailed lengths, all well under buf so the
+    # paged reservation (pages for len+max_new) stays far below the
+    # dense engine's up-front buf_len per slot
+    rng = np.random.default_rng(0)
+    n_req = 2 * paged_slots
+    lens = np.minimum(8 + rng.geometric(1 / 12.0, size=n_req), buf // 4)
+    prompts = [list(rng.integers(1, cfg.vocab_size, int(n)))
+               for n in lens]
+
+    dense = ContinuousBatchingEngine(model, params, slots=dense_slots,
+                                     buf_len=buf)
+    paged = ContinuousBatchingEngine(
+        model, params, slots=paged_slots, buf_len=buf,
+        kv_page_tokens=ptok, kv_pool_pages=int(pool_pages),
+        prefill_chunk_tokens=32, prefill_lanes=2)
+    try:
+        # warm both engines' programs off-clock
+        dense.generate([5, 17, 42], max_new_tokens=2)
+        paged.generate(prompts[0], max_new_tokens=2)
+
+        t0 = time.perf_counter()
+        peak_d, lat_d = _peak_live(dense, prompts, n_new)
+        dense_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with JaxRuntimeAudit() as audit:
+            peak_p, lat_p = _peak_live(paged, prompts, n_new)
+        paged_s = time.perf_counter() - t0
+
+        _row("peak_live_dense", peak_d)
+        _row("peak_live_paged", peak_p)
+        _row("paged_vs_dense_slots", round(peak_p / peak_d, 2))
+        _row("steady_state_recompiles", audit.compilations)
+        _row("dense_tok_s", round(n_req * n_new / dense_s, 1))
+        _row("paged_tok_s", round(n_req * n_new / paged_s, 1))
+        result["latency_dense"] = lat_d
+        result["latency_paged"] = lat_p
+        _row("paged_ttft_p99_ms", lat_p["ttft_p99_ms"])
+        _row("paged_e2e_p99_ms", lat_p["e2e_p99_ms"])
+        kv = paged.kv_stats()
+        result["kv_stats"] = {k: kv[k] for k in
+                              ("prefill_chunks", "pages_free", "pool_pages",
+                               "pages_shared", "pages_private")}
+        # all pages must be back on the free list after the burst drains
+        _row("pages_leaked", kv["pool_pages"] - 1 - kv["pages_free"])
+    finally:
+        dense.stop()
+        paged.stop()
+
+    # ---- adapter scale sweep: 32 -> 10k names, ONE engine, flat HBM ----
+    import tempfile
+    scales = [8, 32] if quick else [32, 1024, 10000]
+    cache_slots = 4 if quick else 16
+    sweep_req = 16 if quick else 48
+    mt_cfg = dataclasses.replace(cfg, lora_rank=8)
+    mt_model = LlamaLM(mt_cfg)
+    variables = mt_model.init(jax.random.PRNGKey(0), dummy)
+    seed_tree = jax.tree_util.tree_map(
+        np.asarray, lora_init(jax.random.PRNGKey(7), variables["lora"]))
+    sweep = {}
+    bank_bytes = set()
+    with tempfile.TemporaryDirectory() as tmp:
+        for n_names in scales:
+            eng = ContinuousBatchingEngine(
+                mt_model, variables["params"], slots=dense_slots,
+                buf_len=buf, kv_page_tokens=ptok,
+                kv_pool_pages=int(pool_pages), prefill_chunk_tokens=32,
+                adapter_cache_slots=cache_slots,
+                adapter_store_dir=os.path.join(tmp, f"n{n_names}"))
+            try:
+                # registration = a fedstore put (the bank row is paged in
+                # on first use); vary the seed tree per name on the host
+                for i in range(n_names):
+                    scale = 1.0 + (i % 13) / 13.0
+                    eng.registry.register(
+                        f"a{i}", jax.tree_util.tree_map(
+                            lambda x: x * scale, seed_tree))
+                # Zipf-ish mix: most traffic on a head that fits the
+                # cache, a long tail forcing miss -> evict -> page-in
+                head = max(2, cache_slots - 1)
+                mix = [f"a{int(i)}" for i in
+                       np.minimum(rng.zipf(1.5, size=sweep_req) - 1,
+                                  n_names - 1)]
+                mix = [m if int(m[1:]) < n_names else f"a{i % head}"
+                       for i, m in enumerate(mix)]
+                eng.generate(prompts[0][:8], max_new_tokens=2,
+                             adapter=mix[0])  # warm adapter programs
+                t0 = time.perf_counter()
+                e2e = []
+                qs = [(time.perf_counter(),
+                       eng.submit(prompts[i % len(prompts)],
+                                  max_new_tokens=n_new, adapter=mix[i]))
+                      for i in range(sweep_req)]
+                for ts, q in qs:
+                    while q.get(timeout=600) is not None:
+                        pass
+                    e2e.append((time.perf_counter() - ts) * 1e3)
+                dt = time.perf_counter() - t0
+                e2e.sort()
+                st = eng.registry.stats
+                hits, misses = st["cache_hits"], st["cache_misses"]
+                rows_b = sum(np.asarray(x).nbytes for x in
+                             jax.tree_util.tree_leaves(eng.registry.bank))
+                bank_bytes.add(rows_b)
+                sweep[str(n_names)] = {
+                    "tok_s": round(sweep_req * n_new / dt, 1),
+                    "hit_rate": round(hits / max(1, hits + misses), 3),
+                    "cache_evictions": st["cache_evictions"],
+                    "e2e_p50_ms": round(e2e[len(e2e) // 2], 2),
+                    "e2e_p99_ms": round(e2e[min(len(e2e) - 1,
+                                                int(0.99 * len(e2e)))], 2),
+                    "bank_rows": cache_slots,
+                    "bank_mib": round(rows_b / 2**20, 3),
+                }
+                print(f"[serve-paged-row] sweep_{n_names}="
+                      f"{sweep[str(n_names)]} "
+                      f"t={time.perf_counter():.0f}", flush=True)
+            finally:
+                eng.stop()
+    result["adapter_sweep"] = sweep
+    # the flat-HBM pin: bank bytes identical at every sweep scale
+    _row("bank_hbm_flat_across_scales", int(len(bank_bytes) == 1))
+    top = sweep[str(scales[-1])]
+    _row("adapters_max_scale", scales[-1])
+    _row("max_scale_tok_s", top["tok_s"])
+    _row("max_scale_hit_rate", top["hit_rate"])
+    return result
+
+
 def main():
     if "--agg" in sys.argv:
         # the scatter-vs-replicated comparison needs a multi-shard mesh;
@@ -2928,6 +3156,19 @@ def main():
             "value": result["serve_ttft_p99_ms"],
             "unit": "ms_ttft_p99_native_histogram",
             "vs_baseline": result["serve_slo"]["rollback_detected"],
+            **{k: info[k] for k in _HOST_CTX_KEYS},
+        })
+        print(json.dumps(result))
+        return
+
+    if "--serve-paged" in sys.argv:
+        info = _platform_info(measure_peak=False)
+        result = serve_paged_bench()
+        result.update({
+            "metric": "serve_paged_kv_adapter_cache",
+            "value": result["paged_vs_dense_slots"],
+            "unit": "x_live_slots_at_equal_kv_hbm",
+            "vs_baseline": result["max_scale_hit_rate"],
             **{k: info[k] for k in _HOST_CTX_KEYS},
         })
         print(json.dumps(result))

@@ -1196,6 +1196,70 @@ def build_serving_insert() -> ProgramReport:
     return _build_serving("insert_cache")
 
 
+def _serving_paged_engine():
+    import jax
+    import jax.numpy as jnp
+    from ..llm.model import LlamaConfig, LlamaLM
+    from ..serving.batching import ContinuousBatchingEngine
+    cfg = LlamaConfig(vocab_size=97, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_dim=64, max_seq_len=48,
+                      dtype=jnp.float32, attn_impl="blockwise")
+    model = LlamaLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    return ContinuousBatchingEngine(model, variables["params"], slots=4,
+                                    buf_len=48, kv_page_tokens=8,
+                                    prefill_chunk_tokens=16)
+
+
+def _serving_paged_estimate(eng) -> float:
+    import jax
+    from ..core.memory_estimate import estimate_paged_serving_memory
+    from ..core import tree as tree_util
+    pool_leaves = jax.tree_util.tree_leaves(eng._pool)
+    pool_bytes = sum(l.nbytes for l in pool_leaves)
+    # transient gather window: pool[block_tables] per layer — price K+V
+    # for ~2 live layers at the full per-slot window width
+    per_page = max(l.nbytes / l.shape[0] for l in pool_leaves)
+    window = 2 * 2 * eng.n_slots * eng.max_blocks * per_page
+    return estimate_paged_serving_memory(
+        n_params=tree_util.num_params(eng.raw_params), param_bytes=4,
+        n_slots=eng.n_slots, pool_bytes=pool_bytes,
+        block_table_bytes=float(eng._btabs.nbytes), window_bytes=window,
+        vocab_size=97, horizon=eng.horizon)["total"]
+
+
+def _build_serving_paged(which: str) -> ProgramReport:
+    eng = _serving_paged_engine()
+    try:
+        est = _serving_paged_estimate(eng)
+        progs = {n: (fn, args, donate)
+                 for n, fn, args, donate in eng.step_programs()}
+        fn, args, donate = progs[which]
+        return lower_program(f"serving_paged_{which}", fn, args, donate,
+                             mesh_shape=(1, 1), estimate_bytes=est)
+    finally:
+        eng.stop()
+
+
+@registry.register("serving_paged_decode_step", "serving", "step")
+def build_serving_paged_step() -> ProgramReport:
+    """The paged engine's batched decode step: one shared page pool
+    (DONATED — page moves are block-table data, never copies) addressed
+    through traced per-slot block tables, horizon-scanned.  Pins the
+    zero-steady-state-recompile memory plane of docs/SERVING.md."""
+    return _build_serving_paged("decode_step")
+
+
+@registry.register("serving_paged_prefill_chunk", "serving", "step",
+                   quick=True)
+def build_serving_paged_chunk() -> ProgramReport:
+    """The paged engine's fixed-shape prefill chunk (donated pool,
+    traced sample index): ONE program serves every chunk of every
+    prompt — intermediate and final alike."""
+    return _build_serving_paged("prefill_chunk")
+
+
 #: name -> builder; the canonical verification surface, derived from the
 #: first-class Program registry (``analysis/programs.py``, ISSUE 18) —
 #: registration order is the report order everywhere (CLI, manifest,

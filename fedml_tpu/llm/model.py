@@ -79,6 +79,18 @@ class LlamaConfig:
     #: decode bottleneck) at ~1% attention-output error.  Dequantization
     #: folds into the score/output einsums, so HBM reads stay int8.
     kv_cache_dtype: str = "native"  # native | int8
+    #: Paged KV cache (serving): >0 switches the decode path to a single
+    #: shared page pool of ``kv_pool_pages`` pages of ``kv_page_tokens``
+    #: tokens each per layer, addressed through a per-slot block table
+    #: passed as TRACED data — slot admission/eviction never recompiles,
+    #: and slots share prefix pages copy-on-write.  Page 0 is the
+    #: reserved trash page: unallocated block-table entries point at it,
+    #: and mask discipline (every attended position <= the query's own
+    #: position was written by the owning slot first) keeps its garbage
+    #: out of every softmax.  0 = dense per-slot caches (training and
+    #: the single-request paths are always dense).
+    kv_page_tokens: int = 0
+    kv_pool_pages: int = 0
 
     def __post_init__(self):
         # typos must fail loudly — a silently-defaulted knob produces
@@ -92,6 +104,15 @@ class LlamaConfig:
         if self.attn_impl not in ("auto", "blockwise", "flash", "ring"):
             raise ValueError(f"attn_impl={self.attn_impl!r}: must be "
                              "'auto', 'blockwise', 'flash', or 'ring'")
+        if self.kv_page_tokens < 0 or self.kv_pool_pages < 0:
+            raise ValueError("kv_page_tokens/kv_pool_pages must be >= 0")
+        if (self.kv_pool_pages > 0) != (self.kv_page_tokens > 0):
+            raise ValueError(
+                "paged KV needs BOTH kv_page_tokens and kv_pool_pages "
+                f"(got {self.kv_page_tokens}/{self.kv_pool_pages})")
+        if self.kv_pool_pages == 1:
+            raise ValueError("kv_pool_pages=1 is only the reserved trash "
+                             "page — need at least 2")
 
     @property
     def store_dtype(self):
@@ -105,11 +126,15 @@ LLAMA2_7B = LlamaConfig()
 
 
 def _rope(x, positions, theta: float):
-    """Rotary position embedding; x: (B, H, S, D_head)."""
+    """Rotary position embedding; x: (B, H, S, D_head).  ``positions`` is
+    (S,) shared across the batch, or (B, S) per-row (the paged serving
+    step, where every slot sits at its own depth)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (S, d/2)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (..., S, d/2)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if positions.ndim == 2:          # (B, S, d/2) -> (B, 1, S, d/2)
+        cos, sin = cos[:, None], sin[:, None]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     out1 = x1 * cos - x2 * sin
     out2 = x2 * cos + x1 * sin
@@ -174,7 +199,8 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, decode: bool = False):
+    def __call__(self, x, positions, decode: bool = False,
+                 block_tables=None):
         cfg = self.cfg
         head_dim = cfg.dim // cfg.n_heads
         if cfg.lora_rank > 0:
@@ -196,6 +222,10 @@ class Attention(nn.Module):
         k = _rope(k, positions, cfg.rope_theta)
 
         if decode:
+            if block_tables is not None:
+                return self._paged_decode_attend(q, k, v, positions,
+                                                 block_tables, b, s,
+                                                 head_dim, dense)
             return self._decode_attend(q, k, v, positions, b, s, head_dim,
                                        dense)
 
@@ -301,6 +331,105 @@ class Attention(nn.Module):
             b, s, cfg.n_heads * head_dim)
         return dense(cfg.dim, "wo")(out)
 
+    def _paged_decode_attend(self, q, k, v, positions, block_tables, b, s,
+                             head_dim, dense):
+        """Paged KV attention: one page pool per layer SHARED across all
+        slots (no batch axis — the chunked-prefill program at b=1 and the
+        batched decode step at b=slots mutate the same buffers), addressed
+        through a per-slot ``block_tables`` (b, max_blocks) int32 carried
+        as traced data.  ``positions`` is (b, s) — every slot at its own
+        depth.  Writes scatter each new token into
+        ``pool[table[pos // P], :, pos % P]``; reads gather the slot's
+        whole block-table window and mask ``kv_pos <= position``.  The
+        window index of a gathered token IS its logical position, so the
+        softmax (masked to -1e30, exp -> 0.0 exactly in f32) is bitwise
+        what the dense cache computes over the same prefix.
+
+        Unallocated block-table entries are 0 — the trash page.  Writes
+        past a slot's reservation (chunk padding, horizon burn-out) land
+        there; reads of it are always masked because a reserved prefix
+        covers every window position <= the slot's own position.
+        """
+        cfg = self.cfg
+        ptok = cfg.kv_page_tokens
+        pool_pages = cfg.kv_pool_pages
+        int8_kv = cfg.kv_cache_dtype == "int8"
+        store_dtype = jnp.int8 if int8_kv else cfg.dtype
+        pk = self.variable("cache", "k", jnp.zeros,
+                           (pool_pages, cfg.n_kv_heads, ptok, head_dim),
+                           store_dtype)
+        pv = self.variable("cache", "v", jnp.zeros,
+                           (pool_pages, cfg.n_kv_heads, ptok, head_dim),
+                           store_dtype)
+        pos = positions.astype(jnp.int32)                   # (b, s)
+        page = jnp.take_along_axis(block_tables, pos // ptok, axis=1)
+        offs = pos % ptok                                   # (b, s)
+        # (b, s, hkv, d) — advanced indices (page at axis 0, offs at axis
+        # 2) are separated by the head slice, so numpy indexing moves them
+        # to the front: the scatter target is exactly (b, s, hkv, d)
+        k_w = k.transpose(0, 2, 1, 3)
+        v_w = v.transpose(0, 2, 1, 3)
+        if int8_kv:
+            pks = self.variable("cache", "k_scale", jnp.zeros,
+                                (pool_pages, cfg.n_kv_heads, ptok),
+                                jnp.float32)
+            pvs = self.variable("cache", "v_scale", jnp.zeros,
+                                (pool_pages, cfg.n_kv_heads, ptok),
+                                jnp.float32)
+
+            def quant_rows(x):
+                xf = x.astype(jnp.float32)
+                scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1),
+                                    1e-8) / 127.0
+                q8 = jnp.clip(jnp.round(xf / scale[..., None]),
+                              -127, 127).astype(jnp.int8)
+                return q8, scale
+
+            k8, ks = quant_rows(k_w)
+            v8, vs = quant_rows(v_w)
+            pk.value = pk.value.at[page, :, offs].set(k8)
+            pv.value = pv.value.at[page, :, offs].set(v8)
+            pks.value = pks.value.at[page, :, offs].set(ks)
+            pvs.value = pvs.value.at[page, :, offs].set(vs)
+        else:
+            pk.value = pk.value.at[page, :, offs].set(
+                k_w.astype(cfg.dtype))
+            pv.value = pv.value.at[page, :, offs].set(
+                v_w.astype(cfg.dtype))
+        # gather the slot windows AFTER the write so a chunk attends to
+        # its own earlier tokens (in-chunk causality via the mask below)
+        max_blocks = block_tables.shape[1]
+        window = max_blocks * ptok
+
+        def gather_window(pool):                     # -> (b, hkv, W, ...)
+            g = pool[block_tables]                   # (b, MB, hkv, P, ...)
+            g = jnp.moveaxis(g, 2, 1)                # (b, hkv, MB, P, ...)
+            return g.reshape((b, cfg.n_kv_heads, window) + g.shape[4:])
+
+        kf = gather_window(pk.value)
+        vf = gather_window(pv.value)
+        rep = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(b, cfg.n_kv_heads, rep, s, head_dim)
+        scores = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kf.astype(qg.dtype),
+                            preferred_element_type=jnp.float32)
+        if int8_kv:
+            scores = scores * gather_window(pks.value)[:, :, None, None]
+        scores = scores / (head_dim ** 0.5)
+        kv_pos = jnp.arange(window)
+        mask = kv_pos[None, None, :] <= pos[:, :, None]    # (b, s, W)
+        scores = jnp.where(mask[:, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if int8_kv:
+            probs = probs * gather_window(pvs.value)[:, :, None, None]
+        probs = probs.astype(cfg.dtype)
+        out = jnp.einsum("bgrqk,bgkd->bgrqd", probs, vf.astype(cfg.dtype),
+                         preferred_element_type=jnp.float32
+                         ).astype(cfg.dtype)
+        out = out.reshape(b, cfg.n_heads, s, head_dim)
+        out = out.transpose(0, 2, 1, 3).reshape(
+            b, s, cfg.n_heads * head_dim)
+        return dense(cfg.dim, "wo")(out)
+
 
 class MLP(nn.Module):
     cfg: LlamaConfig
@@ -320,10 +449,11 @@ class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, decode: bool = False):
+    def __call__(self, x, positions, decode: bool = False,
+                 block_tables=None):
         h = x + Attention(self.cfg, name="attention")(
             RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions,
-            decode=decode)
+            decode=decode, block_tables=block_tables)
         if self.cfg.n_experts > 0:
             from .moe import MoEMLP
             ffn = MoEMLP(dim=self.cfg.dim, ffn_dim=self.cfg.ffn_dim,
@@ -340,20 +470,28 @@ class LlamaLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False,
-                 start_pos=None, return_hidden: bool = False):
+                 start_pos=None, return_hidden: bool = False,
+                 block_tables=None):
         """``decode=True`` switches attention to the KV-cached path: the
         flax "cache" collection must be mutable in ``apply``, and
-        ``start_pos`` (scalar int array) gives the sequence position of
+        ``start_pos`` (scalar int array — or a (B,) vector on the paged
+        path, one depth per slot) gives the sequence position of
         ``tokens[:, 0]`` — the caller owns position bookkeeping so the
-        jitted single-token step stays stateless.  ``return_hidden=True``
-        returns final-norm hidden states without the lm_head projection
-        (the streaming cross-entropy path)."""
+        jitted single-token step stays stateless.  ``block_tables``
+        ((B, max_blocks) int32, traced) selects the paged-pool decode
+        path (``kv_page_tokens``/``kv_pool_pages`` on the config).
+        ``return_hidden=True`` returns final-norm hidden states without
+        the lm_head projection (the streaming cross-entropy path)."""
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
                      param_dtype=cfg.store_dtype, name="tok_embed")(tokens)
         positions = jnp.arange(tokens.shape[-1])
         if start_pos is not None:
-            positions = positions + start_pos
+            start_pos = jnp.asarray(start_pos)
+            if start_pos.ndim == 1:      # per-slot depths -> (B, T)
+                positions = positions[None, :] + start_pos[:, None]
+            else:
+                positions = positions + start_pos
         if cfg.remat == "none":
             mk_block = Block
         elif cfg.remat == "dots":
@@ -367,7 +505,7 @@ class LlamaLM(nn.Module):
             mk_block = nn.remat(Block, static_argnums=(3,))  # FLOPs
         for i in range(cfg.n_layers):
             block = mk_block(cfg, name=f"layer_{i}")
-            x = block(x, positions, decode)
+            x = block(x, positions, decode, block_tables)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_hidden:
             # streaming cross-entropy path (ops/xent.py): the caller fuses

@@ -357,6 +357,15 @@ DEFAULT_SLO_RULES: List[Dict[str, Any]] = [
     {"name": "serve_ttft_p99",
      "objective": {"metric": "serve_ttft_seconds", "threshold": 0.2,
                    "compliance": 0.99}},
+    # paged serving memory plane (docs/SERVING.md): a drained page pool
+    # means admissions are parking — degraded before it becomes queue
+    # growth; an adapter-miss storm (most acquires paging in from the
+    # store) means the HBM cache is thrashing — resize
+    # adapter_cache_slots or shard the adapter population
+    {"name": "kv_page_pool", "metric": "serve.kv_pages_free",
+     "min": 1.0},
+    {"name": "adapter_miss_storm", "metric": "serve.adapter_miss_rate",
+     "max": 0.5},
 ]
 
 

@@ -26,6 +26,18 @@ the engine's decode thread):
 - Row 0 is the reserved **zero adapter** (A = B = 0 — the exact base
   model): requests without an adapter ride the same gathered program, so
   base and personalized traffic share one batch.
+
+Cache mode (``store=``, an :class:`~fedml_tpu.serving.adapter_store
+.AdapterStore`): the bank is demoted from *the* registered population to
+an N-row HBM cache over the host/disk store.  ``register`` writes
+through to the store and only unroutes any stale resident copy — rows
+page in lazily on first ``acquire``.  A miss kicks an async store read
+(:class:`~fedml_tpu.store.pager.AsyncRowFetcher`) and raises
+:class:`AdapterMissError`; the engine parks the request and retries
+after the fetch lands.  Residents evict LRU-unpinned under pressure
+(their bytes live on in the store), pinned rows never evict, and
+``BankFullError`` disappears: registered-adapter count is bounded by the
+store, not HBM.
 """
 
 from __future__ import annotations
@@ -40,7 +52,19 @@ import jax.numpy as jnp
 
 class BankFullError(RuntimeError):
     """Every non-reserved bank row is registered or still pinned by an
-    in-flight request — evict something (or wait for a drain) first."""
+    in-flight request — evict something (or wait for a drain) first.
+    (Bank-only registries; cache-mode registries page in/evict instead.)"""
+
+
+class AdapterMissError(RuntimeError):
+    """Cache-mode ``acquire`` miss: the adapter lives in the store but is
+    not bank-resident (or every row is pinned).  An async page-in is
+    already running — park the request and retry when it lands."""
+
+    def __init__(self, name: str):
+        super().__init__(f"adapter {name!r} not bank-resident — "
+                         "page-in in flight, requeue the request")
+        self.name = name
 
 
 class _Row:
@@ -64,7 +88,8 @@ class AdapterRegistry:
     base traffic.  All public methods are thread-safe.
     """
 
-    def __init__(self, model, capacity: int = 8, dtype=jnp.float32):
+    def __init__(self, model, capacity: int = 8, dtype=jnp.float32,
+                 store=None):
         if getattr(getattr(model, "cfg", None), "lora_rank", 0) <= 0:
             raise ValueError("AdapterRegistry requires a lora_rank>0 model "
                              "config (LoRADense layers)")
@@ -73,6 +98,8 @@ class AdapterRegistry:
             raise ValueError(f"capacity={capacity}: need >= 2 (row 0 is the "
                              "reserved zero adapter)")
         self.capacity = capacity
+        # cache mode: the bank caches rows of this AdapterStore
+        self.store = store
         # eval_shape + zeros, NOT model.init: init would materialize a full
         # base-parameter tree just to read the lora collection's structure
         shapes = jax.eval_shape(
@@ -98,31 +125,124 @@ class AdapterRegistry:
         self._rows = [_Row() for _ in range(capacity)]
         self._free: List[int] = list(range(1, capacity))
         self.stats = {"registered": 0, "evicted": 0, "copy_on_write": 0,
-                      "rows_reclaimed": 0}
+                      "rows_reclaimed": 0, "cache_hits": 0,
+                      "cache_misses": 0, "cache_evictions": 0}
+        # cache-mode state: per-name registration version (stale in-flight
+        # fetches are dropped on arrival), LRU clock per row, fetched rows
+        # waiting for a free/unpinned slot
+        self._ver: Dict[str, int] = {}
+        self._lru: Dict[int, int] = {}
+        self._lru_clock = 0
+        self._pending_install: Dict[str, tuple] = {}
+        self._fetcher = None
+        self.on_fetch_done = None   # engine wake-up hook (set post-ctor)
+        if store is not None:
+            from ..store.pager import AsyncRowFetcher
+            self._fetcher = AsyncRowFetcher(on_done=self._fetch_done)
+
+    def _fetch_done(self, name: str) -> None:
+        cb = self.on_fetch_done
+        if cb is not None:
+            cb(name)
+
+    def close(self) -> None:
+        if self._fetcher is not None:
+            self._fetcher.close()
 
     # -- routing -----------------------------------------------------------
     def names(self) -> List[str]:
         with self.lock:
+            if self.store is not None:
+                return sorted(set(self._names) | set(self.store.names()))
             return sorted(self._names)
 
     def __contains__(self, name: str) -> bool:
         with self.lock:
+            if self.store is not None and name in self.store:
+                return True
             return name in self._names
+
+    def _touch(self, row: int) -> None:
+        self._lru_clock += 1
+        self._lru[row] = self._lru_clock
+
+    def _install_row(self, name: str, tree) -> Optional[int]:
+        """Write a fetched row into the bank (lock held): a free row if
+        any, else LRU-evict an unpinned resident.  None when every row is
+        pinned (caller re-parks)."""
+        if self._free:
+            row = self._free.pop()
+        else:
+            cands = [(self._lru.get(i, 0), i)
+                     for i, r in enumerate(self._rows)
+                     if i and r.name is not None and r.pins == 0
+                     and not r.zombie]
+            if not cands:
+                return None
+            _, row = min(cands)
+            old = self._rows[row].name
+            del self._names[old]
+            self._rows[row].name = None
+            self.stats["cache_evictions"] += 1
+        self.bank = self._set_row(self.bank, tree, jnp.int32(row))
+        r = self._rows[row]
+        r.name = name
+        r.zombie = False
+        r.token = object()
+        self._names[name] = row
+        self._touch(row)
+        return row
 
     def acquire(self, name: Optional[str]):
         """Resolve ``name`` to ``(row, token)`` and pin the row for the
         lifetime of one request (``None`` → the zero row, never pinned —
         it cannot be evicted or rewritten).  Raises ``KeyError`` for
-        unknown names."""
+        unknown names.
+
+        Cache mode: a bank-resident name pins and LRU-touches its row; a
+        store-only name kicks an async page-in and raises
+        :class:`AdapterMissError` (requeue and retry)."""
         with self.lock:
             if name is None:
                 return 0, self._rows[0].token
-            if name not in self._names:
+            row = self._names.get(name)
+            if row is not None:
+                self._rows[row].pins += 1
+                if self.store is not None:
+                    self._touch(row)
+                    self.stats["cache_hits"] += 1
+                return row, self._rows[row].token
+            if self.store is None:
                 raise KeyError(
                     f"unknown adapter {name!r}; have {sorted(self._names)}")
-            row = self._names[name]
-            self._rows[row].pins += 1
-            return row, self._rows[row].token
+            # fetched already? install now (engine thread holds the lock,
+            # so the donated bank write cannot race a dispatch snapshot)
+            pending = self._pending_install.pop(name, None)
+            if pending is None:
+                ok, val = self._fetcher.take(name)
+                if ok:
+                    pending = val
+            if pending is not None:
+                ver, tree = pending
+                if ver == self._ver.get(name):
+                    row = self._install_row(name, tree)
+                    if row is not None:
+                        self._rows[row].pins += 1
+                        self.stats["cache_hits"] += 1
+                        return row, self._rows[row].token
+                    # every row pinned right now — hold the bytes, retry
+                    self._pending_install[name] = pending
+                    raise AdapterMissError(name)
+                # stale version fetched mid-re-register: refetch below
+            if name not in self.store:
+                raise KeyError(
+                    f"unknown adapter {name!r}; have {self.names()}")
+            ver = self._ver.get(name)
+            store = self.store
+            if self._fetcher.request(
+                    name, lambda: (ver, store.get(name))):
+                self.stats["cache_misses"] += 1
+            raise AdapterMissError(name)
 
     def release(self, row: int) -> None:
         """Drop one pin; a zombie row whose pins drain returns to the free
@@ -165,9 +285,31 @@ class AdapterRegistry:
         A re-register of an *unpinned* name rewrites its row in place; a
         *pinned* name moves to a fresh row (copy-on-write) so in-flight
         requests keep decoding against the weights they started with.
-        Raises :class:`BankFullError` when no row is free."""
+        Raises :class:`BankFullError` when no row is free.
+
+        Cache mode writes through to the STORE, not the bank: any stale
+        resident copy is unrouted (zombie while pinned — in-flight
+        streams finish on the weights they started with) and the new
+        version pages into a row lazily on first ``acquire``.  Returns
+        -1 (no resident row yet); never raises ``BankFullError``."""
         name = str(name)
         self._check_tree(lora_tree)
+        if self.store is not None:
+            with self.lock:
+                self._ver[name] = self._ver.get(name, 0) + 1
+                self.store.put(name, lora_tree)
+                self._pending_install.pop(name, None)
+                row = self._names.pop(name, None)
+                if row is not None:
+                    r = self._rows[row]
+                    r.name = None
+                    if r.pins > 0:
+                        r.zombie = True
+                        self.stats["copy_on_write"] += 1
+                    else:
+                        self._free.append(row)
+                self.stats["registered"] += 1
+                return -1
         with self.lock:
             row = self._names.get(name)
             if row is not None and self._rows[row].pins > 0:
@@ -196,14 +338,27 @@ class AdapterRegistry:
     def evict(self, name: str) -> None:
         """Unroute ``name``.  New requests for it fail immediately; a row
         still pinned by in-flight requests survives as a zombie until they
-        drain, then frees."""
+        drain, then frees.  Cache mode also drops the store copy (and
+        invalidates any in-flight page-in of it)."""
+        name = str(name)
         with self.lock:
-            row = self._names.pop(str(name), None)
-            if row is None:
+            row = self._names.pop(name, None)
+            if self.store is not None:
+                known = row is not None or name in self.store
+                if not known:
+                    raise KeyError(f"unknown adapter {name!r}")
+                self.store.remove(name)
+                self._ver[name] = self._ver.get(name, 0) + 1
+                self._pending_install.pop(name, None)
+                self.stats["evicted"] += 1
+                if row is None:
+                    return
+            elif row is None:
                 raise KeyError(f"unknown adapter {name!r}")
+            else:
+                self.stats["evicted"] += 1
             r = self._rows[row]
             r.name = None
-            self.stats["evicted"] += 1
             if r.pins > 0:
                 r.zombie = True
             else:
@@ -236,4 +391,4 @@ class AdapterRegistry:
         return self.register(name, tree)
 
 
-__all__ = ["AdapterRegistry", "BankFullError"]
+__all__ = ["AdapterRegistry", "AdapterMissError", "BankFullError"]

@@ -32,6 +32,8 @@ so sampling streams match it too.
 
 from __future__ import annotations
 
+import dataclasses
+import math
 import os
 import queue
 import threading
@@ -45,10 +47,24 @@ import numpy as np
 
 from ..obs import get_tracer
 from ..obs.histogram import ServeHistograms
-from .adapters import AdapterRegistry
+from .adapters import AdapterMissError, AdapterRegistry
+from .paged_kv import PagedBlockPool, PagedPrefixCache, PageExhaustedError
 from .templates.openai_compat import (TAIL_BLOCK, PrefixCache,
                                       _build_cached_decode,
                                       _replay_tail, _sample_live)
+
+
+class PagedKVUnsupportedError(ValueError):
+    """Raised at engine construction for the paged-KV × speculative
+    combo: the draft/target verify blocks assume contiguous per-slot
+    caches and would silently corrupt positions against a page pool.
+    Use the dense speculative engine, or the paged non-speculative one."""
+
+
+class _UnservableError(Exception):
+    """A request whose page reservation can NEVER succeed on this pool
+    (need exceeds total non-trash pages) — failed open instead of parked,
+    or parking would deadlock the engine."""
 
 
 def _unwrap_params(params):
@@ -62,6 +78,12 @@ def _unwrap_params(params):
 class _Slot:
     __slots__ = ("live", "q", "pos", "remaining", "eos_id", "cur_tok",
                  "adapter_row",
+                 # paged-KV prefill state machine (free → prefilling →
+                 # live): prompt ids + replay cursor for the chunked
+                 # prefill lanes, the admission-split sample key, and the
+                 # slot's block-table reservation size
+                 "prefilling", "pf_ids", "pf_next", "pf_n", "pf_sub",
+                 "pf_atok", "n_blocks",
                  # fedslo request-lifecycle telemetry (host monotonic
                  # clocks, engine-thread-confined like the decode state)
                  "t_submit", "t_admit", "t_prefill_end", "t_first",
@@ -76,6 +98,13 @@ class _Slot:
         self.eos_id: Optional[int] = None
         self.cur_tok = 0
         self.adapter_row = 0
+        self.prefilling = False
+        self.pf_ids: Optional[List[int]] = None
+        self.pf_next = 0
+        self.pf_n = 0
+        self.pf_sub = None
+        self.pf_atok = None
+        self.n_blocks = 0
         self.t_submit = 0.0
         self.t_admit: Optional[float] = None
         self.t_prefill_end = 0.0
@@ -100,7 +129,11 @@ class ContinuousBatchingEngine:
                  adapter_slots: int = 0,
                  metrics_port: Optional[int] = None,
                  hist_labels: int = 8,
-                 slo_rules: Optional[List[Dict[str, Any]]] = None):
+                 slo_rules: Optional[List[Dict[str, Any]]] = None,
+                 kv_page_tokens: int = 0, kv_pool_pages: int = 0,
+                 prefill_chunk_tokens: int = 0, prefill_lanes: int = 1,
+                 adapter_cache_slots: int = 0,
+                 adapter_store_dir: Optional[str] = None):
         self.model = model
         # fedslo (docs/OBSERVABILITY.md): per-request lifecycle histograms
         # (TTFT / e2e / queue wait / phase times / decode rate) with
@@ -138,9 +171,29 @@ class ContinuousBatchingEngine:
         # data, so requests landing on different adapters never recompile.
         # ``adapter_slots=N`` builds a capacity-N registry; passing
         # ``adapter_registry`` shares one bank across engines.
+        # adapter cache mode (serving/adapter_store.py, docs/SERVING.md):
+        # ``adapter_cache_slots=N`` demotes the bank to an N-row HBM
+        # cache over a host/disk adapter store — registered adapters
+        # scale past HBM like client state did (fedstore), misses page
+        # in asynchronously and the request requeues.  Pins are deferred
+        # to admission (the engine thread owns install/evict).
         self.registry = adapter_registry
-        if adapter_slots and self.registry is None:
+        self._owns_registry = False
+        if adapter_cache_slots and self.registry is None:
+            from .adapter_store import AdapterStore
+            store = AdapterStore(
+                model, spill_dir=adapter_store_dir,
+                max_resident_pages=(16 if adapter_store_dir else 0))
+            self.registry = AdapterRegistry(
+                model, capacity=int(adapter_cache_slots), store=store)
+            self._owns_registry = True
+        elif adapter_slots and self.registry is None:
             self.registry = AdapterRegistry(model, capacity=int(adapter_slots))
+            self._owns_registry = True
+        self._store_mode = (self.registry is not None
+                            and self.registry.store is not None)
+        if self._store_mode:
+            self.registry.on_fetch_done = self._on_adapter_fetched
         # decode horizon: tokens generated per device dispatch.  horizon=1 is
         # token-granularity admission (lowest queueing latency); horizon=H
         # runs H steps as one lax.scan on-device so per-token host round-trip
@@ -152,17 +205,67 @@ class ContinuousBatchingEngine:
         # next admission).
         self.horizon = max(1, int(horizon))
 
+        # paged KV (serving/paged_kv.py, docs/SERVING.md memory plane):
+        # kv_page_tokens>0 replaces the per-slot stacked caches with ONE
+        # page pool per layer + a per-slot block table carried as traced
+        # data.  Admission reserves ceil(min(n+max_new, buf_len)/P)
+        # pages host-side (parking the request when the pool is dry);
+        # prefill runs in fixed prefill_chunk_tokens chunks on a per-tick
+        # lane budget so long prompts stop head-of-line-blocking decode.
+        self.kv_page_tokens = int(kv_page_tokens)
+        self.paged = self.kv_page_tokens > 0
+        self.paged_model = None
+        self.page_pool = None
+        if self.paged:
+            cfg = getattr(model, "cfg", None)
+            if cfg is None or not hasattr(cfg, "kv_page_tokens"):
+                raise PagedKVUnsupportedError(
+                    "paged KV needs a LlamaLM-style model carrying a "
+                    "LlamaConfig (engine rebuilds it with the pool "
+                    "geometry)")
+            ptok = self.kv_page_tokens
+            self.prefill_chunk = int(prefill_chunk_tokens) or \
+                min(64, self.buf_len)
+            self.prefill_lanes = max(1, int(prefill_lanes))
+            # per-slot block-table width: the window covers buf_len plus
+            # the worst chunk-padding / horizon-burn overhang, so every
+            # out-of-reservation write lands on a real (trash) table
+            # entry instead of index-clamping into a live page
+            overhang = max(self.prefill_chunk, self.horizon)
+            self.max_blocks = math.ceil((self.buf_len + overhang) / ptok)
+            # pages a single slot may ever RESERVE (positions < buf_len)
+            self.blocks_cap = math.ceil(self.buf_len / ptok)
+            pool_pages = int(kv_pool_pages) or \
+                (1 + self.n_slots * self.blocks_cap)
+            self.kv_pool_pages = pool_pages
+            self.paged_model = type(model)(dataclasses.replace(
+                cfg, kv_page_tokens=ptok, kv_pool_pages=pool_pages))
+            self.page_pool = PagedBlockPool(pool_pages)
+            self._btabs = np.zeros((self.n_slots, self.max_blocks),
+                                   np.int32)
+            self._chunks_total = 0
+            self._pages_shared = 0
+            self._pages_private = 0
+
         self._prefill, self._tail_step, self._tail_block = \
             _build_cached_decode(model, self.top_k, self.top_p)
         # prefix_cache_slots > 0: admission reuses prefill KV for shared
         # prompt prefixes (templates/openai_compat.PrefixCache — LRU,
         # longest-common-prefix, params-identity invalidation); only the
         # engine thread touches it during _admit, but the cache carries
-        # its own lock anyway
+        # its own lock anyway.  Paged engines share *pages* instead of
+        # copying KV: PagedPrefixCache lends refcounted full pages into
+        # the new slot's block table, and the chunk replay starts past
+        # the shared span, so lent pages stay read-only under sharers.
         self.prefix_cache = None
         if prefix_cache_slots:
-            self.prefix_cache = PrefixCache(prefix_cache_slots,
-                                            max_tail=int(prefix_max_tail))
+            if self.paged:
+                self.prefix_cache = PagedPrefixCache(
+                    prefix_cache_slots, self.kv_page_tokens,
+                    self.page_pool)
+            else:
+                self.prefix_cache = PrefixCache(prefix_cache_slots,
+                                                max_tail=int(prefix_max_tail))
 
         from ..llm.quantization import dequantize_params, weight_dtype
         wdtype = weight_dtype(model)
@@ -227,6 +330,86 @@ class ContinuousBatchingEngine:
         self._step = batched_step if self.registry is None \
             else batched_step_mt
 
+        if self.paged:
+            pm = self.paged_model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def paged_step(params, pool, btabs, toks, poss, keys, temps):
+                # ONE batched apply against the shared pool — no vmap:
+                # every slot addresses its own pages via the traced block
+                # tables, per-slot depths ride the (b,) start_pos vector.
+                # The per-slot key splits replay the dense engine's
+                # sequence exactly (split[0]=carry, split[1]=sample).
+                params = dequantize_params(params, wdtype)
+
+                def body(carry, _):
+                    pool, toks, poss, keys = carry
+                    logits, mut = pm.apply(
+                        {"params": params, "cache": pool}, toks[:, None],
+                        decode=True, start_pos=poss, block_tables=btabs,
+                        mutable=["cache"])
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys2, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(
+                        lambda lg, sub, temp: _sample_live(
+                            lg, sub, temp, self.top_k, self.top_p)
+                    )(logits[:, 0], subs, temps)
+                    return (mut["cache"], nxt, poss + 1, keys2), nxt
+
+                (pool, toks, poss, keys), hist = jax.lax.scan(
+                    body, (pool, toks, poss, keys), None,
+                    length=self.horizon)
+                return hist.T, pool, keys
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def paged_step_mt(params, bank, pool, btabs, toks, poss, keys,
+                              temps, aids):
+                params = dequantize_params(params, wdtype)
+                lora_slots = jax.tree_util.tree_map(
+                    lambda b: b[aids], bank)
+
+                def body(carry, _):
+                    pool, toks, poss, keys = carry
+                    logits, mut = pm.apply(
+                        {"params": params, "lora": lora_slots,
+                         "cache": pool}, toks[:, None],
+                        decode=True, start_pos=poss, block_tables=btabs,
+                        mutable=["cache"])
+                    split = jax.vmap(jax.random.split)(keys)
+                    keys2, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(
+                        lambda lg, sub, temp: _sample_live(
+                            lg, sub, temp, self.top_k, self.top_p)
+                    )(logits[:, 0], subs, temps)
+                    return (mut["cache"], nxt, poss + 1, keys2), nxt
+
+                (pool, toks, poss, keys), hist = jax.lax.scan(
+                    body, (pool, toks, poss, keys), None,
+                    length=self.horizon)
+                return hist.T, pool, keys
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def paged_chunk(params, lora, pool, chunk, btab, start, idx,
+                            key, temp):
+                # one fixed-shape (1, C) prefill chunk for one slot; the
+                # sample index is TRACED so intermediate chunks (token
+                # discarded) and the final chunk (token at n-1-chunk_start)
+                # ride one compiled program
+                params = dequantize_params(params, wdtype)
+                variables = {"params": params, "cache": pool}
+                if lora is not None:
+                    variables["lora"] = lora
+                logits, mut = pm.apply(
+                    variables, chunk, decode=True, start_pos=start,
+                    block_tables=btab, mutable=["cache"])
+                tok = _sample_live(logits[0, idx], key, temp, self.top_k,
+                                   self.top_p)
+                return tok, mut["cache"]
+
+            self._step = paged_step if self.registry is None \
+                else paged_step_mt
+            self._chunk = paged_chunk
+
         @partial(jax.jit, donate_argnums=(0,))
         def insert_cache(caches, cache, slot):
             return jax.tree_util.tree_map(
@@ -234,17 +417,38 @@ class ContinuousBatchingEngine:
 
         self._insert = insert_cache
 
-        # materialize the stacked cache template from one dummy prefill
-        # (MT engines pass the zero bank row — a lora_rank>0 model can't
-        # apply without its "lora" collection)
-        dummy = jnp.zeros((1, self.buf_len), jnp.int32)
         dummy_lora = (self.registry.lora_for_row(0)
                       if self.registry is not None else None)
-        _, cache0 = self._prefill(self.raw_params, dummy_lora, dummy,
-                                  jnp.int32(1), jax.random.PRNGKey(0),
-                                  jnp.float32(0.0))
-        self._caches = jax.tree_util.tree_map(
-            lambda c: jnp.zeros((self.n_slots,) + c.shape, c.dtype), cache0)
+        if self.paged:
+            # materialize the page pool from the chunk program's shape —
+            # eval_shape only, nothing dense ever allocates
+            self._caches = None
+            chunk0 = jnp.zeros((1, self.prefill_chunk), jnp.int32)
+            btab0 = jnp.zeros((1, self.max_blocks), jnp.int32)
+
+            def _shape_probe(p):
+                variables = {"params": p}
+                if dummy_lora is not None:
+                    variables["lora"] = dummy_lora
+                return self.paged_model.apply(
+                    variables, chunk0, decode=True,
+                    start_pos=jnp.zeros((1,), jnp.int32),
+                    block_tables=btab0, mutable=["cache"])
+
+            _, shapes = jax.eval_shape(_shape_probe, self.raw_params)
+            self._pool = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+        else:
+            # materialize the stacked cache template from one dummy
+            # prefill (MT engines pass the zero bank row — a lora_rank>0
+            # model can't apply without its "lora" collection)
+            dummy = jnp.zeros((1, self.buf_len), jnp.int32)
+            _, cache0 = self._prefill(self.raw_params, dummy_lora, dummy,
+                                      jnp.int32(1), jax.random.PRNGKey(0),
+                                      jnp.float32(0.0))
+            self._caches = jax.tree_util.tree_map(
+                lambda c: jnp.zeros((self.n_slots,) + c.shape, c.dtype),
+                cache0)
 
         self._slots = [_Slot() for _ in range(self.n_slots)]
         self._toks = np.zeros(self.n_slots, np.int32)
@@ -254,6 +458,18 @@ class ContinuousBatchingEngine:
         self._keys = np.stack(
             [np.asarray(jax.random.PRNGKey(i)) for i in range(self.n_slots)])
         self._waiting: "queue.Queue[dict]" = queue.Queue()
+        # requests pulled off _waiting but not admittable yet (adapter
+        # page-in in flight, page pool dry) — engine-thread-confined,
+        # retried at the top of every iteration before new admissions
+        self._parked: List[dict] = []
+        # set (under _cond) by the adapter fetch worker; cleared by the
+        # engine's parked-retry pass
+        self._fetch_ready = False
+        # engine-thread flag: a slot finish released an adapter pin (or
+        # pages) — a parked request whose install lost to an all-pinned
+        # cache must retry now, even with nothing live to keep the loop
+        # ticking.  Cleared with _fetch_ready by the retry pass.
+        self._pin_released = False
         self._cond = threading.Condition()
         self._stopped = False
         # weight swap staged by update_params(); applied by the engine
@@ -289,7 +505,15 @@ class ContinuousBatchingEngine:
         to the caller's fedscope trace."""
         out: "queue.Queue" = queue.Queue()
         row, atok = 0, None
-        if self.registry is not None:
+        if self._store_mode:
+            # cache mode: validate the name against the store here (so
+            # unknown adapters still fail the caller) but defer the PIN
+            # to admission — the engine thread owns page-in/install, and
+            # a miss parks the request instead of blocking submit
+            if adapter is not None and adapter not in self.registry:
+                raise KeyError(f"unknown adapter {adapter!r}; have "
+                               f"{self.registry.names()}")
+        elif self.registry is not None:
             # resolve at submit so unknown adapters fail the caller, not
             # the engine thread; the pin travels with the request
             row, atok = self.registry.acquire(adapter)
@@ -311,6 +535,7 @@ class ContinuousBatchingEngine:
                     "temperature": float(temperature),
                     "seed": int(seed),
                     "eos_id": eos_id,
+                    "adapter": adapter,
                     "adapter_row": row,
                     "adapter_token": atok,
                     "adapter_label": name,
@@ -389,6 +614,13 @@ class ContinuousBatchingEngine:
         """Hook run (under ``_cond``) when the staged swap is applied —
         the speculative subclass swaps its draft tree here."""
 
+    def _on_adapter_fetched(self, name: str) -> None:
+        """Fetch-worker callback (cache mode): wake the engine so parked
+        adapter-miss requests retry immediately."""
+        with self._cond:
+            self._fetch_ready = True
+            self._cond.notify()
+
     def stop(self):
         self._stopped = True
         with self._cond:
@@ -397,6 +629,8 @@ class ContinuousBatchingEngine:
         if self.metrics_server is not None:
             self.metrics_server.close()
             self.metrics_server = None
+        if self._owns_registry and self.registry is not None:
+            self.registry.close()
 
     def step_programs(self):
         """fedverify hook (ISSUE 10, docs/FEDVERIFY.md): the engine's
@@ -409,6 +643,32 @@ class ContinuousBatchingEngine:
         poss = jnp.asarray(self._poss)
         keys = jnp.asarray(self._keys)
         temps = jnp.asarray(self._temps)
+        if self.paged:
+            # paged memory plane: the decode step donates the page pool
+            # (argnum after params[/bank]) and the chunk program is the
+            # third compiled citizen — both pinned so a page-geometry
+            # change shows up as a contract diff, not a silent regression
+            btabs = jnp.asarray(self._btabs)
+            if self.registry is not None:
+                step_args = (self.raw_params, self.registry.bank,
+                             self._pool, btabs, toks, poss, keys, temps,
+                             jnp.asarray(self._aids))
+                step_donate = (2,)
+            else:
+                step_args = (self.raw_params, self._pool, btabs, toks,
+                             poss, keys, temps)
+                step_donate = (1,)
+            lora = (self.registry.lora_for_row(0)
+                    if self.registry is not None else None)
+            chunk_args = (self.raw_params, lora, self._pool,
+                          jnp.zeros((1, self.prefill_chunk), jnp.int32),
+                          jnp.zeros((1, self.max_blocks), jnp.int32),
+                          jnp.zeros((1,), jnp.int32), jnp.int32(0),
+                          jax.random.PRNGKey(0), jnp.float32(0.0))
+            return [
+                ("decode_step", self._step, step_args, step_donate),
+                ("prefill_chunk", self._chunk, chunk_args, (2,)),
+            ]
         if self.registry is not None:
             step_args = (self.raw_params, self.registry.bank, self._caches,
                          toks, poss, keys, temps, jnp.asarray(self._aids))
@@ -425,7 +685,7 @@ class ContinuousBatchingEngine:
     # -- engine loop -------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
-            if not s.live:
+            if not s.live and not s.prefilling:
                 return i
         return None
 
@@ -435,12 +695,24 @@ class ContinuousBatchingEngine:
             self._observe_finish(i, s)
         s.t_admit = None
         s.live = False
+        s.prefilling = False
+        s.pf_ids = None
+        s.pf_sub = None
+        if self.paged and s.n_blocks:
+            # drop the slot's hold on its block-table pages (shared
+            # prefix pages survive under the cache / other sharers)
+            self.page_pool.release(
+                [int(p) for p in self._btabs[i, :s.n_blocks]])
+            self._btabs[i, :] = 0  # fedrace: disable=unguarded-shared-write
+            s.n_blocks = 0
         if s.q is not None:
             s.q.put(None)
         s.q = None
         if self.registry is not None and s.adapter_row:
             self.registry.release(s.adapter_row)
             s.adapter_row = 0
+        # fedrace: disable-next-line=unguarded-shared-write
+        self._pin_released = True
 
     def _observe_finish(self, i: int, s: "_Slot") -> None:
         """fedslo request-lifecycle telemetry at natural completion
@@ -559,6 +831,9 @@ class ContinuousBatchingEngine:
             tok_host = int(tok)
         t_prefill_end = time.monotonic()
         if self.prefix_cache is not None and n > 0:
+            # the cache object is internally locked; the reference itself
+            # is set once in the ctor and never rebound
+            # fedrace: disable-next-line=unguarded-shared-write
             self.prefix_cache.insert(ids, cache, self.raw_params, atok)
         # decode-state arrays (_caches/_aids/_temps/_keys, and _toks/_poss
         # in _dispatch) are engine-thread-confined: written only between
@@ -591,14 +866,224 @@ class ContinuousBatchingEngine:
         if not self._emit(slot, tok_host):
             self._finish(slot)
 
+    # -- paged admission ---------------------------------------------------
+    def _reserve_pages(self, req: dict, slot: int) -> None:
+        """Wire ``slot``'s block table: longest shareable prefix pages
+        (incref'd) + fresh private pages for the rest of the request's
+        worst-case window.  Raises :class:`PageExhaustedError` when the
+        pool is dry (caller parks) and :class:`_UnservableError` when the
+        reservation can never fit (caller fails the request open)."""
+        ids = req["prompt_ids"]
+        n = len(ids)
+        ptok = self.kv_page_tokens
+        need = min(n + req["max_new_tokens"], self.buf_len)
+        need_blocks = max(1, math.ceil(need / ptok))
+        if need_blocks > self.page_pool.n_pages - 1:
+            raise _UnservableError(
+                f"request needs {need_blocks} pages; pool has "
+                f"{self.page_pool.n_pages - 1} usable")
+        atok = req.get("adapter_token")
+        full, shared = (self.prefix_cache.lookup(ids, self.raw_params, atok)
+                        if self.prefix_cache is not None and n > 0
+                        else (0, []))
+        # incref the lent pages FIRST: evict_for_pages below may drop the
+        # very entry we matched, and only our hold keeps its pages alive
+        self.page_pool.share(shared)
+        priv = need_blocks - full
+        try:
+            if not self.page_pool.can_reserve(priv) \
+                    and self.prefix_cache is not None:
+                self.prefix_cache.evict_for_pages(priv)
+            pages = self.page_pool.reserve(priv)
+        except PageExhaustedError:
+            self.page_pool.release(shared)
+            raise
+        self._btabs[slot, :] = 0  # fedrace: disable=unguarded-shared-write
+        self._btabs[slot, :full] = shared
+        self._btabs[slot, full:need_blocks] = pages
+        req["_kv"] = (full, need_blocks)
+        with self._stats_lock:  # kv_stats() reads from caller threads
+            self._pages_shared += full
+            self._pages_private += priv
+
+    def _admit_paged(self, req: dict, slot: int) -> None:
+        """Enter the prefilling state (free → prefilling): block table is
+        already wired by ``_reserve_pages``; the chunk lanes in
+        ``_prefill_tick`` replay the prompt from the shared-page boundary
+        and flip the slot live on the final chunk."""
+        t_admit = time.monotonic()
+        ids = req["prompt_ids"]
+        n = len(ids)
+        full, need_blocks = req.pop("_kv")
+        key = jax.random.PRNGKey(req["seed"])
+        # same split sequence as the dense prefill path: sub samples the
+        # first token (on the final chunk), key carries into decode
+        key, sub = jax.random.split(key)
+        s = self._slots[slot]
+        s.prefilling = True
+        s.live = False
+        s.q = req["q"]
+        s.pos = 0
+        s.remaining = req["max_new_tokens"]
+        s.eos_id = req["eos_id"]
+        s.cur_tok = 0
+        s.adapter_row = req.get("adapter_row", 0)
+        s.pf_ids = ids
+        s.pf_n = n
+        s.pf_next = full * self.kv_page_tokens
+        s.pf_sub = sub
+        s.pf_atok = req.get("adapter_token")
+        s.n_blocks = need_blocks
+        s.t_submit = req.get("t_submit", t_admit)
+        s.t_admit = t_admit
+        s.t_prefill_end = t_admit
+        s.t_first = None
+        s.prompt_tokens = n
+        s.out_tokens = 0
+        s.adapter_label = req.get("adapter_label", "base")
+        s.traceparent = req.get("traceparent")
+        s.drafts_proposed = 0
+        s.drafts_accepted = 0
+        self._aids[slot] = s.adapter_row  # fedrace: disable=unguarded-shared-write
+        self._temps[slot] = req["temperature"]  # fedrace: disable=unguarded-shared-write
+        self._keys[slot] = np.asarray(key)  # fedrace: disable=unguarded-shared-write
+
+    def _prefill_tick(self) -> None:
+        """Run up to ``prefill_lanes`` fixed-shape prefill chunks, one per
+        prefilling slot — chunked prefill shares the tick with decode, so
+        a 4k-token prompt costs each tick one chunk, not a stall."""
+        lanes = self.prefill_lanes
+        C = self.prefill_chunk
+        for i, s in enumerate(self._slots):
+            if lanes <= 0:
+                break
+            if not s.prefilling:
+                continue
+            lanes -= 1
+            cs = s.pf_next
+            n = s.pf_n
+            chunk = np.zeros((1, C), np.int32)
+            seg = s.pf_ids[cs:cs + C]
+            chunk[0, :len(seg)] = seg
+            final = cs + C >= n
+            # sample index is traced: intermediate chunks discard token 0,
+            # the final chunk samples at the prompt's last position
+            idx = max(n - 1 - cs, 0) if final else 0
+            lora = (self.registry.lora_for_row(s.adapter_row)
+                    if self.registry is not None else None)
+            tok, self._pool = self._chunk(
+                self.raw_params, lora, self._pool, jnp.asarray(chunk),
+                jnp.asarray(self._btabs[i][None]),
+                jnp.asarray([cs], jnp.int32), jnp.int32(idx), s.pf_sub,
+                jnp.float32(self._temps[i]))
+            with self._stats_lock:
+                self._chunks_total += 1
+            if not final:
+                s.pf_next = cs + C
+                continue
+            tok_host = int(tok)
+            s.prefilling = False
+            s.live = True
+            s.pos = n
+            s.t_prefill_end = time.monotonic()
+            if self.prefix_cache is not None and n > 0:
+                fullpages = n // self.kv_page_tokens
+                if fullpages:
+                    self.prefix_cache.insert(
+                        s.pf_ids,
+                        [int(p) for p in self._btabs[i, :fullpages]],
+                        self.raw_params, s.pf_atok)
+            s.pf_ids = None
+            s.pf_sub = None
+            if not self._emit(i, tok_host):
+                self._finish(i)
+
+    def _admit_one(self, req: dict, slot: int, tracer) -> bool:
+        """Admission front door for both engines: cache-mode adapter pin
+        (deferred from submit) + paged page reservation, then the real
+        admit.  Returns False when the request parked (adapter page-in in
+        flight / pool dry) or failed open — the slot stays free."""
+        try:
+            if (self._store_mode and req.get("adapter") is not None
+                    and req.get("adapter_token") is None):
+                row, atok = self.registry.acquire(req["adapter"])
+                req["adapter_row"], req["adapter_token"] = row, atok
+            if self.paged:
+                self._reserve_pages(req, slot)
+        except AdapterMissError:
+            req["_park_reason"] = "adapter"
+            self._parked.append(req)
+            return False
+        except PageExhaustedError:
+            # drop a just-taken pin so the row isn't held while parked
+            if self._store_mode and req.get("adapter_row"):
+                self.registry.release(req["adapter_row"])
+                req["adapter_row"], req["adapter_token"] = 0, None
+            req["_park_reason"] = "pages"
+            self._parked.append(req)
+            return False
+        except (_UnservableError, KeyError, RuntimeError):
+            # unservable reservation, adapter evicted between submit and
+            # admission, or a fetch failure re-raised from take(): fail
+            # this request open, keep the engine alive
+            if self._store_mode and req.get("adapter_row"):
+                self.registry.release(req["adapter_row"])
+            req["q"].put(None)
+            return False
+        with tracer.span("serve.admit", cat="serve", slot=slot,
+                         adapter_row=req.get("adapter_row", 0)):
+            if self.paged:
+                self._admit_paged(req, slot)
+            else:
+                self._admit(req, slot)
+        with self._stats_lock:
+            self.serve_stats["admits"] += 1
+        return True
+
+    def _parked_actionable(self) -> bool:
+        """Caller holds ``_cond``: is a parked retry worth waking for?
+        Page-parked requests retry whenever pages may have freed (any
+        finish notifies); adapter-parked ones only once a fetch landed."""
+        if not self._parked:
+            return False
+        if self._fetch_ready or self._pin_released:
+            return True
+        return any(r.get("_park_reason") == "pages" for r in self._parked)
+
+    def kv_stats(self) -> Dict[str, Any]:
+        """Host-side memory-plane stats (bench + tests): pool occupancy,
+        chunk counts, prefix page-sharing, adapter cache counters."""
+        with self._stats_lock:
+            out: Dict[str, Any] = {"ticks": self._ticks}
+            chunks = self._chunks_total
+            shared, private = self._pages_shared, self._pages_private
+        if self.paged:
+            out["pool"] = dict(self.page_pool.stats)
+            out["pages_free"] = self.page_pool.pages_free
+            out["pool_pages"] = self.page_pool.n_pages
+            out["prefill_chunks"] = chunks
+            out["pages_shared"] = shared
+            out["pages_private"] = private
+            if self.prefix_cache is not None:
+                out["prefix"] = dict(self.prefix_cache.stats)
+        if self.registry is not None:
+            out["adapter"] = dict(self.registry.stats)
+        return out
+
     def _drain_waiting(self):
-        """Fail-open every queued request (caller holds ``_cond``),
-        dropping its adapter pin so evicted rows can still reclaim."""
+        """Fail-open every queued AND parked request (caller holds
+        ``_cond``), dropping adapter pins so evicted rows can still
+        reclaim."""
         while not self._waiting.empty():
             req = self._waiting.get()
             req["q"].put(None)
             if self.registry is not None and req.get("adapter_row"):
                 self.registry.release(req["adapter_row"])
+        for req in self._parked:
+            req["q"].put(None)
+            if self.registry is not None and req.get("adapter_row"):
+                self.registry.release(req["adapter_row"])
+        self._parked.clear()
 
     def _run(self):
         try:
@@ -620,21 +1105,25 @@ class ContinuousBatchingEngine:
             with self._cond:
                 while (not self._stopped and self._waiting.empty()
                        and self._pending_params is None
-                       and not any(s.live for s in self._slots)):
+                       and not any(s.live or s.prefilling
+                                   for s in self._slots)
+                       and not self._parked_actionable()):
                     self._cond.wait(timeout=0.5)
                 if self._stopped:
                     for i, s in enumerate(self._slots):
-                        if s.live:
+                        if s.live or s.prefilling:
                             self._finish(i, aborted=True)
                     self._drain_waiting()
                     self._cond.notify_all()
                     return
-                # apply a staged weight swap once live slots drain; the
-                # prefix cache clears atomically with it (its old entries
-                # are keyed by the old params identity anyway — clearing
-                # frees the old tree + stale KV eagerly)
+                # apply a staged weight swap once in-flight slots drain
+                # (prefilling counts — its KV is half-written under the
+                # old weights); the prefix cache clears atomically with it
+                # (its old entries are keyed by the old params identity
+                # anyway — clearing frees the old tree + stale KV eagerly)
                 swap_pending = self._pending_params is not None
-                if swap_pending and not any(s.live for s in self._slots):
+                if swap_pending and not any(s.live or s.prefilling
+                                            for s in self._slots):
                     # raw_params is swapped only here on the engine thread
                     # (update_params merely STAGES via _pending_params under
                     # _cond); all other raw_params uses are engine-thread
@@ -647,29 +1136,45 @@ class ContinuousBatchingEngine:
                     self._on_swap()
                     swap_pending = False
                     self._cond.notify_all()
+                retry_parked = bool(self._parked) and not swap_pending
+                if retry_parked:
+                    self._fetch_ready = False
+                    self._pin_released = False
 
-            # admit waiting requests into free slots (token-granularity
-            # join) — paused while a swap waits for the drain, so no
-            # request straddles the weight boundary
+            # admit into free slots (token-granularity join) — paused
+            # while a swap waits for the drain, so no request straddles
+            # the weight boundary.  Parked requests retry first (their
+            # adapter may have paged in / pages may have freed); a parked
+            # head never blocks fresh admissions behind it — _admit_one
+            # re-parks and the loop moves on.
             tracer = get_tracer()
+            if retry_parked:
+                retry, self._parked = self._parked, []
+                for j, req in enumerate(retry):
+                    slot = self._free_slot()
+                    if slot is None:
+                        self._parked.extend(retry[j:])
+                        break
+                    self._admit_one(req, slot, tracer)
             while not swap_pending and not self._waiting.empty():
                 slot = self._free_slot()
                 if slot is None:
                     break
                 req = self._waiting.get()
-                with tracer.span("serve.admit", cat="serve", slot=slot,
-                                 adapter_row=req.get("adapter_row", 0)):
-                    self._admit(req, slot)
-                with self._stats_lock:
-                    self.serve_stats["admits"] += 1
+                self._admit_one(req, slot, tracer)
             if tracer.enabled:
-                tracer.counter("serve.queue_depth", self._waiting.qsize())
+                tracer.counter("serve.queue_depth",
+                               self._waiting.qsize() + len(self._parked))
 
+            if self.paged:
+                self._prefill_tick()
             live = [i for i, s in enumerate(self._slots) if s.live]
-            if not live:
+            if live:
+                self._dispatch(live)
+                with self._stats_lock:
+                    self._ticks += 1
+            elif not any(s.prefilling for s in self._slots):
                 continue
-            self._dispatch(live)
-            self._ticks += 1
             if tracer.enabled:
                 now = time.monotonic()
                 rolled = None
@@ -682,6 +1187,27 @@ class ContinuousBatchingEngine:
                     tracer.counter("serve.tokens_per_s",
                                    rolled[0] / (now - t0))
                     tracer.counter("serve.tokens_total", rolled[1])
+                if self.paged:
+                    with self._stats_lock:
+                        shared = self._pages_shared
+                        tot = shared + self._pages_private
+                        chunks = self._chunks_total
+                    tracer.counter("serve.kv_pages_free",
+                                   self.page_pool.pages_free)
+                    tracer.counter("serve.kv_page_hit_rate",
+                                   shared / tot if tot else 0.0)
+                    tracer.counter("serve.prefill_chunks", chunks)
+                if self._store_mode:
+                    st = self.registry.stats
+                    tracer.counter("serve.adapter_cache_hits",
+                                   st["cache_hits"])
+                    tracer.counter("serve.adapter_cache_misses",
+                                   st["cache_misses"])
+                    tracer.counter("serve.adapter_cache_evictions",
+                                   st["cache_evictions"])
+                    tot = st["cache_hits"] + st["cache_misses"]
+                    tracer.counter("serve.adapter_miss_rate",
+                                   st["cache_misses"] / tot if tot else 0.0)
 
     def _dispatch(self, live):
         """One device tick for the live slots (overridden by the
@@ -690,7 +1216,34 @@ class ContinuousBatchingEngine:
             # engine-thread-confined decode state (see _admit)
             self._toks[i] = self._slots[i].cur_tok  # fedrace: disable=unguarded-shared-write
             self._poss[i] = self._slots[i].pos  # fedrace: disable=unguarded-shared-write
-        if self.registry is not None:
+        if self.paged:
+            # block tables ride as TRACED data — page moves, admissions
+            # and evictions between ticks never recompile.  Non-live slots
+            # must see all-trash tables so their burn writes land in
+            # garbage: freed rows are already zeroed, but PREFILLING slots
+            # have real (possibly shared-prefix) pages wired — mask their
+            # rows here or the burn write at their stale position would
+            # scribble into a page another slot is reading
+            bt = self._btabs
+            prefilling = [i for i, s in enumerate(self._slots)
+                          if s.prefilling]
+            if prefilling:
+                bt = bt.copy()
+                bt[prefilling] = 0
+            btabs = jnp.asarray(bt)
+            if self.registry is not None:
+                with self.registry.lock:
+                    toks, self._pool, keys = self._step(
+                        self.raw_params, self.registry.bank, self._pool,
+                        btabs, jnp.asarray(self._toks),
+                        jnp.asarray(self._poss), jnp.asarray(self._keys),
+                        jnp.asarray(self._temps), jnp.asarray(self._aids))
+            else:
+                toks, self._pool, keys = self._step(
+                    self.raw_params, self._pool, btabs,
+                    jnp.asarray(self._toks), jnp.asarray(self._poss),
+                    jnp.asarray(self._keys), jnp.asarray(self._temps))
+        elif self.registry is not None:
             # snapshot + dispatch under the registry lock so a concurrent
             # register()'s donated row write cannot invalidate the bank
             # buffer between the read and the launch (the dispatch itself
@@ -707,7 +1260,12 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._poss), jnp.asarray(self._keys),
                 jnp.asarray(self._temps))
         toks_host = np.asarray(toks)  # (n_slots, horizon)
-        self._keys = np.array(keys)  # writable copy (admit mutates rows)
+        # copy carry keys back for LIVE slots only: a prefilling slot's
+        # admission key must not advance with the burn splits its lane
+        # rode along for (its first real sample comes later)
+        keys_host = np.asarray(keys)
+        for i in live:
+            self._keys[i] = keys_host[i]  # fedrace: disable=unguarded-shared-write
         for i in live:
             for j in range(self.horizon):
                 self._slots[i].pos += 1
@@ -744,6 +1302,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         self.k = int(k)
         assert self.k >= 1
         for m, name in ((model, "model"), (draft_model, "draft_model")):
+            if getattr(getattr(m, "cfg", None), "kv_page_tokens", 0):
+                raise PagedKVUnsupportedError(
+                    f"{name} is built with kv_page_tokens="
+                    f"{m.cfg.kv_page_tokens}: speculative decoding needs "
+                    "contiguous per-slot caches (the draft/target verify "
+                    "blocks write multi-token windows that would corrupt "
+                    "a shared page pool) — use ContinuousBatchingEngine "
+                    "for paged serving, or a dense model here")
             msl = getattr(getattr(m, "cfg", None), "max_seq_len", None)
             if msl is None:
                 raise ValueError(

@@ -459,7 +459,11 @@ class OpenAICompatServer:
                  prefix_max_tail: int = TAIL_BLOCK,
                  adapters=None, adapter_slots: int = 0,
                  metrics_port: Optional[int] = None,
-                 slo_rules: Optional[List[dict]] = None):
+                 slo_rules: Optional[List[dict]] = None,
+                 kv_page_tokens: int = 0, kv_pool_pages: int = 0,
+                 prefill_chunk_tokens: int = 0, prefill_lanes: int = 1,
+                 adapter_cache_slots: int = 0,
+                 adapter_store_dir: Optional[str] = None):
         """``host`` defaults to loopback — the endpoint is unauthenticated,
         so exposing it on all interfaces requires an explicit
         ``host="0.0.0.0"``.  ``model`` (optional): flax module supporting
@@ -471,7 +475,16 @@ class OpenAICompatServer:
         single-request path (one compiled program per distinct filter
         pair) so the fields are honored, never silently ignored.  ``decode_horizon`` > 1 (engine mode only) generates that
         many tokens per device dispatch — same outputs, H-fold fewer host
-        round-trips; streaming granularity coarsens to H tokens."""
+        round-trips; streaming granularity coarsens to H tokens.
+
+        Memory-plane knobs (engine mode only; docs/SERVING.md):
+        ``kv_page_tokens`` > 0 switches the engine to the paged KV cache
+        (``kv_pool_pages`` sizes the pool, 0 = auto) with chunked prefill
+        (``prefill_chunk_tokens``/``prefill_lanes``);
+        ``adapter_cache_slots`` > 0 demotes the adapter bank to an N-row
+        cache over a host/disk store (``adapter_store_dir`` spills cold
+        rows to disk) — use it INSTEAD of ``adapter_slots`` to register
+        adapters past HBM."""
         self.apply_fn = apply_fn
         self.params = params
         self.tokenizer = tokenizer or ByteTokenizer()
@@ -532,7 +545,23 @@ class OpenAICompatServer:
         self.adapters = None
         self._zero_lora = None
         self.registry = None
-        if adapters is not None or adapter_slots:
+        # paged-KV / adapter-cache knobs are engine-mode only (the memory
+        # plane they reshape IS the engine's) — reject up front instead of
+        # silently serving dense
+        if (kv_page_tokens or adapter_cache_slots) and not batch_slots:
+            raise ValueError(
+                "kv_page_tokens / adapter_cache_slots reshape the "
+                "batching engine's memory plane — set batch_slots too")
+        if kv_page_tokens and draft_model is not None:
+            from ..batching import PagedKVUnsupportedError
+            raise PagedKVUnsupportedError(
+                "kv_page_tokens with draft_model: the speculative engine "
+                "needs contiguous per-slot caches — drop one of the two")
+        if adapter_cache_slots and adapter_slots:
+            raise ValueError(
+                "adapter_cache_slots and adapter_slots are mutually "
+                "exclusive: the cache mode replaces the fixed bank")
+        if adapters is not None or adapter_slots or adapter_cache_slots:
             if model is None:
                 raise ValueError("adapters require `model` (KV-cached "
                                  "decode carries the lora collection)")
@@ -544,13 +573,13 @@ class OpenAICompatServer:
                     "adapters and the speculative batching engine are "
                     "incompatible (it is single-tenant greedy) — drop "
                     "draft_model or batch_slots")
-            if batch_slots:
+            if batch_slots and not adapter_cache_slots:
                 from ..adapters import AdapterRegistry
                 cap = int(adapter_slots) or len(adapters or {}) + 8
                 self.registry = AdapterRegistry(model, capacity=cap)
                 for name, tree in (adapters or {}).items():
                     self.registry.register(name, tree)
-            else:
+            elif not batch_slots:
                 # (draft_model + adapters is fine here: greedy requests
                 # route through speculative_generate, which carries the
                 # lora tree — parity-tested)
@@ -601,8 +630,21 @@ class OpenAICompatServer:
                     prefix_cache_slots=int(prefix_cache_slots),
                     prefix_max_tail=int(prefix_max_tail),
                     adapter_registry=self.registry,
-                    slo_rules=slo_rules)
+                    slo_rules=slo_rules,
+                    kv_page_tokens=int(kv_page_tokens),
+                    kv_pool_pages=int(kv_pool_pages),
+                    prefill_chunk_tokens=int(prefill_chunk_tokens),
+                    prefill_lanes=int(prefill_lanes),
+                    adapter_cache_slots=int(adapter_cache_slots),
+                    adapter_store_dir=adapter_store_dir)
                 self.prefix_cache = self._engine.prefix_cache
+                if adapter_cache_slots:
+                    # the engine owns the store-backed registry; alias it
+                    # so add_adapter/evict_adapter and the fall-through
+                    # path route through the same cache
+                    self.registry = self._engine.registry
+                    for name, tree in (adapters or {}).items():
+                        self.registry.register(name, tree)
         self._server: Optional[ThreadingHTTPServer] = None
 
     # -- request handling --------------------------------------------------
@@ -712,12 +754,26 @@ class OpenAICompatServer:
             if self.registry is not None:
                 # fall-through around the MT engine (per-request
                 # top_k/top_p filters): pin the bank row for the whole
-                # generation so an eviction can't reclaim it mid-request
-                try:
-                    release_row, _atok = self.registry.acquire(adapter_name)
-                except KeyError as e:
-                    raise RequestError(str(e.args[0] if e.args else e),
-                                       status=404)
+                # generation so an eviction can't reclaim it mid-request.
+                # Cache-mode misses (row paging in from the store) block-
+                # retry here — this path has a thread to park, unlike the
+                # engine loop
+                from ..adapters import AdapterMissError
+                deadline = time.monotonic() + 30.0
+                while True:
+                    try:
+                        release_row, _atok = self.registry.acquire(
+                            adapter_name)
+                        break
+                    except AdapterMissError:
+                        if time.monotonic() >= deadline:
+                            raise RequestError(
+                                f"adapter {adapter_name!r} did not page "
+                                "in within 30s", status=503)
+                        time.sleep(0.02)
+                    except KeyError as e:
+                        raise RequestError(
+                            str(e.args[0] if e.args else e), status=404)
                 lora = self.registry.lora_for_row(release_row)
             try:
                 if self.draft_model is not None and temp == 0.0:

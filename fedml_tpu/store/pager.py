@@ -128,3 +128,67 @@ class CohortStatePager:
             self._closed = True
         self._stager.close()
         self._writer.shutdown(wait=True)
+
+
+class AsyncRowFetcher:
+    """Single-worker keyed fetch with completion callback — the paged
+    half of the serving adapter cache (``serving/adapters.py``): a cache
+    miss kicks ``request(name, fn)`` and requeues; the worker runs the
+    (possibly disk-backed) store read off the engine thread, parks the
+    result for :meth:`take`, and fires ``on_done`` so the engine wakes.
+
+    Dedup by key: a name already in flight is not fetched twice.  A
+    fetch that raises parks the exception instead — :meth:`take`
+    re-raises it on the caller (the engine fails that request open
+    rather than crashing the loop).
+    """
+
+    def __init__(self, on_done: Optional[Callable[[str], None]] = None):
+        self._worker = ThreadPoolExecutor(max_workers=1)
+        self._lock = threading.Lock()
+        self._inflight: set = set()
+        self._ready: dict = {}
+        self.on_done = on_done
+        self._closed = False
+
+    def request(self, key: str, fn: Callable[[], Any]) -> bool:
+        """Start fetching ``key`` via ``fn()`` unless already in flight
+        or ready; returns True when a new fetch was started."""
+        with self._lock:
+            if self._closed or key in self._inflight or key in self._ready:
+                return False
+            self._inflight.add(key)
+
+        def run():
+            try:
+                val, err = fn(), None
+            except BaseException as e:  # noqa: BLE001 — parked, re-raised
+                val, err = None, e      # on the consumer in take()
+            with self._lock:
+                self._inflight.discard(key)
+                if not self._closed:
+                    self._ready[key] = (val, err)
+            cb = self.on_done
+            if cb is not None:
+                cb(key)
+
+        self._worker.submit(run)
+        return True
+
+    def take(self, key: str):
+        """Pop a completed fetch: ``(True, value)`` when ready (re-raises
+        a parked fetch error), ``(False, None)`` when still in flight or
+        never requested."""
+        with self._lock:
+            if key not in self._ready:
+                return False, None
+            val, err = self._ready.pop(key)
+        if err is not None:
+            raise err
+        return True, val
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._ready.clear()
+        self._worker.shutdown(wait=True)

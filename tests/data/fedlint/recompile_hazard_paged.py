@@ -1,0 +1,23 @@
+"""Fixture: the paged-serving recompile anti-patterns (docs/SERVING.md
+memory plane) — a block table baked into the jitted step's STATIC
+signature (every admission/eviction/page-move then pays a compile; the
+table must ride as traced data) and a Python branch on traced pool
+occupancy inside the step (free-list decisions are host bookkeeping,
+taken between dispatches, never inside the compiled program)."""
+import jax
+
+paged_step = jax.jit(lambda pool, toks, block_tables: toks,
+                     static_argnames=("block_tables",))
+
+
+def dispatch(pool, toks, btabs):
+    # block table as an (unhashable) static arg: one compile per page move
+    return paged_step(pool, toks,
+                      block_tables=[list(r) for r in btabs])
+
+
+@jax.jit
+def paged_attend(pool, pages_free, q):
+    if pages_free > 0:    # Python branch on traced pool occupancy
+        return q @ pool
+    return q

@@ -342,6 +342,27 @@ def summarize(trace: Dict[str, Any]) -> Dict[str, Any]:
             counters["serve.tokens_per_s"], 6)
     if "serve.tokens_total" in counters:
         out["serve_tokens_total"] = counters["serve.tokens_total"]
+    # paged-KV memory plane (docs/SERVING.md): pool headroom at trace
+    # end, the cumulative prefix page-share rate, chunked-prefill volume,
+    # and the adapter HBM-cache hit/miss/eviction counters of store-mode
+    # engines — the knobs' feedback loop (resize kv_pool_pages /
+    # adapter_cache_slots on these)
+    if "serve.kv_pages_free" in counters:
+        out["serve_kv_pages_free_last"] = counters["serve.kv_pages_free"]
+    if "serve.kv_page_hit_rate" in counters:
+        out["serve_kv_page_hit_rate"] = round(
+            counters["serve.kv_page_hit_rate"], 6)
+    if "serve.prefill_chunks" in counters:
+        out["serve_prefill_chunks"] = counters["serve.prefill_chunks"]
+    if "serve.adapter_cache_hits" in counters:
+        out["serve_adapter_cache"] = {
+            "hits": counters["serve.adapter_cache_hits"],
+            "misses": counters.get("serve.adapter_cache_misses", 0),
+            "evictions": counters.get("serve.adapter_cache_evictions", 0),
+        }
+    if "serve.adapter_miss_rate" in counters:
+        out["serve_adapter_miss_rate_last"] = round(
+            counters["serve.adapter_miss_rate"], 6)
     # per-adapter request counts: the bounded-label counter (ONE metric,
     # ``adapter`` arg, capped at top-K + "other") is authoritative; the
     # deprecated per-adapter metric NAMES (serve.requests.<name>, behind
